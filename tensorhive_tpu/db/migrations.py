@@ -1,0 +1,42 @@
+"""Sequential schema migrations via ``PRAGMA user_version``.
+
+Reference: tensorhive/database.py:72-87 creates the schema then
+Alembic-stamps/upgrades on boot (18 revisions under tensorhive/migrations/).
+Here each migration is a ``(version, fn)`` pair applied in order; a fresh DB
+gets ``create_all`` and is stamped at the latest version directly.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Callable, List, Tuple
+
+from .engine import Engine
+from .orm import create_all
+
+log = logging.getLogger(__name__)
+
+# append (version, fn) pairs as the schema evolves; fn(engine) must be
+# idempotent enough to re-run after a crash mid-upgrade.
+MIGRATIONS: List[Tuple[int, Callable[[Engine], None]]] = []
+
+SCHEMA_VERSION = 1
+
+
+def ensure_schema(engine: Engine) -> None:
+    from . import models  # noqa: F401  (register all tables)
+
+    current = engine.user_version
+    if current == 0:
+        create_all(engine)
+        engine.user_version = SCHEMA_VERSION
+        log.info("database schema created at version %d", SCHEMA_VERSION)
+        return
+    for version, migrate in MIGRATIONS:
+        if version > current:
+            log.info("applying migration %d", version)
+            migrate(engine)
+            engine.user_version = version
+    # create any tables added since the stamped version (additive changes)
+    create_all(engine)
+    if engine.user_version < SCHEMA_VERSION:
+        engine.user_version = SCHEMA_VERSION

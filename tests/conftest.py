@@ -1,0 +1,40 @@
+"""Test harness.
+
+Mirrors the reference's pytest setup (pytest.ini sets PYTEST=1 so the DB goes
+in-memory, tensorhive/database.py:15-18; tests/fixtures/database.py rebuilds
+tables per test) and additionally pins JAX to a virtual 8-device CPU platform
+so multi-chip sharding tests run without TPU hardware.
+"""
+import os
+
+# must happen before any jax import anywhere in the test session
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["TPUHIVE_PYTEST"] = "1"
+
+import pytest  # noqa: E402
+
+from tensorhive_tpu.config import Config, reset_config, set_config  # noqa: E402
+from tensorhive_tpu.db.engine import Engine, reset_engine, set_engine  # noqa: E402
+from tensorhive_tpu.db.migrations import ensure_schema  # noqa: E402
+
+
+@pytest.fixture()
+def config(tmp_path):
+    """Fresh default config rooted in a tmp dir."""
+    cfg = Config(config_dir=tmp_path)
+    set_config(cfg)
+    yield cfg
+    reset_config()
+
+
+@pytest.fixture()
+def db(config):
+    """Fresh in-memory database per test (reference tests/fixtures/database.py:4-21)."""
+    engine = Engine(":memory:")
+    ensure_schema(engine)
+    set_engine(engine)
+    yield engine
+    reset_engine()

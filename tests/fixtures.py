@@ -1,0 +1,108 @@
+"""Shared model factories (reference: tests/fixtures/models.py:16-258)."""
+from __future__ import annotations
+
+from datetime import timedelta
+
+from tensorhive_tpu.db.models import (
+    Group,
+    Job,
+    Reservation,
+    Resource,
+    Restriction,
+    RestrictionSchedule,
+    Task,
+    User,
+)
+from tensorhive_tpu.utils.timeutils import utcnow
+
+_counter = {"n": 0}
+
+
+def _next(prefix: str) -> str:
+    _counter["n"] += 1
+    return f"{prefix}{_counter['n']}"
+
+
+def make_user(username=None, password="SuperSecret42", admin=False) -> User:
+    user = User(
+        username=username or _next("user"),
+        email=f"{username or _next('mail')}@example.com",
+        password=password,
+    ).save()
+    user.add_role("user")
+    if admin:
+        user.add_role("admin")
+    return user
+
+
+def make_admin(**kwargs) -> User:
+    return make_user(admin=True, **kwargs)
+
+
+def make_resource(uid=None, hostname="tpu-vm-0", index=0, **kwargs) -> Resource:
+    uid = uid or f"{hostname}:tpu:{index}"
+    return Resource(
+        uid=uid,
+        name=f"TPU chip {index}",
+        hostname=hostname,
+        chip_index=index,
+        accelerator_type=kwargs.pop("accelerator_type", "v5litepod-8"),
+        **kwargs,
+    ).save()
+
+
+def make_reservation(user, resource_uid, start_in_h=0.0, duration_h=1.0, **kwargs) -> Reservation:
+    start = utcnow() + timedelta(hours=start_in_h)
+    return Reservation(
+        title=kwargs.pop("title", _next("reservation")),
+        resource_id=resource_uid,
+        user_id=user.id,
+        start=start,
+        end=start + timedelta(hours=duration_h),
+        **kwargs,
+    ).save()
+
+
+def make_permissive_restriction(user=None) -> Restriction:
+    """Global no-expiry restriction (reference fixture `permissive_restriction`)."""
+    restriction = Restriction(
+        name="permissive", starts_at=utcnow() - timedelta(days=1), is_global=True
+    ).save()
+    if user is not None:
+        restriction.apply_to_user(user)
+    return restriction
+
+
+def make_restriction(user=None, resources=(), start_offset_h=-1.0, end_offset_h=24.0, **kw) -> Restriction:
+    restriction = Restriction(
+        name=kw.pop("name", _next("restriction")),
+        starts_at=utcnow() + timedelta(hours=start_offset_h),
+        ends_at=(utcnow() + timedelta(hours=end_offset_h)) if end_offset_h is not None else None,
+        **kw,
+    ).save()
+    if user is not None:
+        restriction.apply_to_user(user)
+    for resource in resources:
+        restriction.apply_to_resource(resource)
+    return restriction
+
+
+def make_schedule(days="1234567", hour_start="00:00", hour_end="23:59") -> RestrictionSchedule:
+    return RestrictionSchedule(
+        schedule_days=days, hour_start=hour_start, hour_end=hour_end
+    ).save()
+
+
+def make_job(user, name=None, **kwargs) -> Job:
+    return Job(name=name or _next("job"), user_id=user.id, **kwargs).save()
+
+
+def make_task(job, hostname="tpu-vm-0", command="python train.py", chips=None) -> Task:
+    task = Task(job_id=job.id, hostname=hostname, command=command).save()
+    if chips is not None:
+        from tensorhive_tpu.db.models.task import CHIP_ENV_VAR, SegmentType
+
+        task.add_cmd_segment(
+            CHIP_ENV_VAR, ",".join(str(c) for c in chips), SegmentType.env_variable
+        )
+    return task

@@ -1,0 +1,93 @@
+"""Config system tests (reference behavior: tensorhive/config.py)."""
+import pytest
+
+from tensorhive_tpu.config import Config, load_config, write_default_configs
+from tensorhive_tpu.utils.exceptions import ConfigurationError
+
+
+def test_defaults_without_files(tmp_path):
+    cfg = load_config(tmp_path)
+    assert cfg.monitoring.interval_s == 2.0
+    assert cfg.job_scheduling.interval_s == 30.0
+    assert cfg.job_scheduling.schedule_queued_when_free_mins == 30.0
+    assert cfg.protection.level == 1
+    assert cfg.ssh.timeout_s == 10.0
+    assert cfg.hosts == {}
+
+
+def test_db_in_memory_under_pytest(tmp_path, monkeypatch):
+    cfg = load_config(tmp_path)
+    assert cfg.db_path == ":memory:"  # TPUHIVE_PYTEST set by conftest
+    monkeypatch.delenv("TPUHIVE_PYTEST")
+    monkeypatch.delenv("PYTEST", raising=False)
+    assert cfg.db_path.endswith("db.sqlite3")
+
+
+def test_main_config_roundtrip(tmp_path):
+    (tmp_path / "config.toml").write_text(
+        """
+[monitoring_service]
+interval_s = 7.5
+enable_cpu_monitor = false
+
+[protection_service]
+level = 2
+kill_mode = 2
+"""
+    )
+    cfg = load_config(tmp_path)
+    assert cfg.monitoring.interval_s == 7.5
+    assert cfg.monitoring.enable_cpu_monitor is False
+    assert cfg.protection.level == 2
+    assert cfg.protection.kill_mode == 2
+
+
+def test_unknown_section_rejected(tmp_path):
+    # the reference silently ignored a misnamed section (SURVEY.md §5 gotcha:
+    # main_config.ini:68 [task_scheduling_service] vs config.py:255); we reject.
+    (tmp_path / "config.toml").write_text("[task_scheduling_service]\ninterval_s = 1\n")
+    with pytest.raises(ConfigurationError):
+        load_config(tmp_path)
+
+
+def test_unknown_key_rejected(tmp_path):
+    (tmp_path / "config.toml").write_text("[monitoring_service]\nintervall = 2\n")
+    with pytest.raises(ConfigurationError):
+        load_config(tmp_path)
+
+
+def test_hosts_inventory_and_slices(tmp_path):
+    (tmp_path / "hosts.toml").write_text(
+        """
+[hosts.v5e-w0]
+address = "10.0.0.1"
+user = "hive"
+accelerator_type = "v5litepod-16"
+topology = "4x4"
+chips = 4
+slice_name = "v5e"
+worker_index = 0
+
+[hosts.v5e-w1]
+address = "10.0.0.2"
+user = "hive"
+accelerator_type = "v5litepod-16"
+chips = 4
+slice_name = "v5e"
+worker_index = 1
+"""
+    )
+    cfg = load_config(tmp_path)
+    assert set(cfg.hosts) == {"v5e-w0", "v5e-w1"}
+    assert cfg.hosts["v5e-w0"].address == "10.0.0.1"
+    assert cfg.hosts["v5e-w1"].chips == 4
+    slices = cfg.slices
+    assert [h.name for h in slices["v5e"]] == ["v5e-w0", "v5e-w1"]
+
+
+def test_write_default_configs(tmp_path):
+    write_default_configs(tmp_path, secret_key="s3cr3t")
+    cfg = load_config(tmp_path)
+    assert cfg.api.secret_key == "s3cr3t"
+    assert (tmp_path / "hosts.toml").exists()
+    assert (tmp_path / "config.toml").stat().st_mode & 0o777 == 0o600

@@ -1,0 +1,99 @@
+"""Job/Task/CommandSegment model tests (reference: models/Job.py, Task.py)."""
+from datetime import timedelta
+
+import pytest
+
+from tensorhive_tpu.db.models import Job, JobStatus, Task, TaskStatus
+from tensorhive_tpu.db.models.task import CHIP_ENV_VAR, SegmentType
+from tensorhive_tpu.utils.exceptions import ValidationError
+from tensorhive_tpu.utils.timeutils import utcnow
+
+from ..fixtures import make_job, make_task, make_user
+
+
+def test_full_command_assembly(db):
+    user = make_user()
+    job = make_job(user)
+    task = make_task(job, command="python train.py", chips=[0, 1])
+    task.add_cmd_segment("JAX_PLATFORMS", "tpu", SegmentType.env_variable)
+    task.add_cmd_segment("--epochs", "10")
+    task.add_cmd_segment("--verbose", "")
+    cmd = task.full_command
+    assert cmd == (
+        f"{CHIP_ENV_VAR}=0,1 JAX_PLATFORMS=tpu python train.py --epochs=10 --verbose"
+    )
+
+
+def test_segment_update_and_remove(db):
+    job = make_job(make_user())
+    task = make_task(job)
+    task.add_cmd_segment("--lr", "0.1")
+    task.add_cmd_segment("--lr", "0.2")  # update, not duplicate
+    assert task.get_segment_value("--lr") == "0.2"
+    assert len(task.param_segments) == 1
+    assert task.remove_cmd_segment("--lr")
+    assert not task.remove_cmd_segment("--lr")
+
+
+def test_segment_value_quoting(db):
+    job = make_job(make_user())
+    task = make_task(job)
+    task.add_cmd_segment("--name", "two words")
+    assert "--name='two words'" in task.full_command
+
+
+def test_chip_uids(db):
+    job = make_job(make_user())
+    task = make_task(job, hostname="vmX", chips=[2, 3])
+    assert task.chip_ids == [2, 3]
+    assert task.chip_uids == ["vmX:tpu:2", "vmX:tpu:3"]
+    assert job.chip_uids == ["vmX:tpu:2", "vmX:tpu:3"]
+
+
+def test_job_status_synchronization(db):
+    job = make_job(make_user())
+    t1, t2 = make_task(job), make_task(job)
+    t1.set_status(TaskStatus.running)
+    assert Job.get(job.id).status is JobStatus.running
+    t1.set_status(TaskStatus.terminated)
+    assert Job.get(job.id).status is JobStatus.not_running  # t2 never ran
+    t2.set_status(TaskStatus.terminated)
+    assert Job.get(job.id).status is JobStatus.terminated
+    t1.set_status(TaskStatus.unsynchronized)
+    assert Job.get(job.id).status is JobStatus.unsynchronized
+
+
+def test_queue_fifo_and_guards(db):
+    user = make_user()
+    a, b = make_job(user), make_job(user)
+    a.enqueue()
+    b.enqueue()
+    assert [j.id for j in Job.get_job_queue()] == [a.id, b.id]
+    a.status = JobStatus.running
+    a.save()
+    assert [j.id for j in Job.get_job_queue()] == [b.id]
+    assert [j.id for j in Job.get_jobs_running_from_queue()] == [a.id]
+    with pytest.raises(ValidationError):
+        a.enqueue()
+    b.dequeue()
+    assert Job.get_job_queue() == []
+    assert Job.get(b.id).status is JobStatus.not_running
+
+
+def test_scheduled_start_stop_queries(db):
+    user = make_user()
+    due = make_job(user, start_at=utcnow() - timedelta(minutes=1))
+    make_job(user, start_at=utcnow() + timedelta(hours=1))
+    running = make_job(user, stop_at=utcnow() - timedelta(minutes=1))
+    running.status = JobStatus.running
+    running.save()
+    assert [j.id for j in Job.find_scheduled_to_start()] == [due.id]
+    assert [j.id for j in Job.find_scheduled_to_stop()] == [running.id]
+
+
+def test_task_validation(db):
+    job = make_job(make_user())
+    with pytest.raises(ValidationError):
+        Task(job_id=job.id, hostname="", command="x").save()
+    with pytest.raises(ValidationError):
+        Task(job_id=job.id, hostname="h", command="").save()

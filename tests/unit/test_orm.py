@@ -1,0 +1,77 @@
+"""ORM-lite behavior tests (capability parity with reference CRUDModel)."""
+from datetime import datetime
+
+import pytest
+
+from tensorhive_tpu.db.orm import Column, Model, _camel
+from tensorhive_tpu.utils.exceptions import NotFoundError, ValidationError
+
+
+class Widget(Model):
+    __tablename__ = "test_widgets"
+    __public__ = ("id", "name", "made_at", "is_big")
+
+    id = Column(int, primary_key=True)
+    name = Column(str, nullable=False, unique=True)
+    made_at = Column(datetime)
+    is_big = Column(bool, default=False)
+    weight = Column(float, default=1.5)
+
+    def check_assertions(self):
+        if self.name == "bad":
+            raise ValidationError("bad name")
+
+
+def test_insert_get_update_delete(db):
+    w = Widget(name="a", made_at=datetime(2026, 1, 2, 3, 4, 5)).save()
+    assert w.id is not None
+    loaded = Widget.get(w.id)
+    assert loaded.name == "a"
+    assert loaded.made_at == datetime(2026, 1, 2, 3, 4, 5)
+    assert loaded.is_big is False
+    assert loaded.weight == 1.5
+
+    loaded.is_big = True
+    loaded.save()
+    assert Widget.get(w.id).is_big is True
+
+    loaded.destroy()
+    with pytest.raises(NotFoundError):
+        Widget.get(w.id)
+
+
+def test_validation_hook_blocks_save(db):
+    with pytest.raises(ValidationError):
+        Widget(name="bad").save()
+    assert Widget.count() == 0
+
+
+def test_filter_and_where(db):
+    Widget(name="x", is_big=True).save()
+    Widget(name="y", is_big=False).save()
+    assert {w.name for w in Widget.filter_by(is_big=True)} == {"x"}
+    assert {w.name for w in Widget.where("name IN (?, ?)", ["x", "y"])} == {"x", "y"}
+    assert Widget.first_by(name="nope") is None
+
+
+def test_unique_constraint(db):
+    Widget(name="dup").save()
+    import sqlite3
+
+    with pytest.raises(sqlite3.IntegrityError):
+        Widget(name="dup").save()
+
+
+def test_as_dict_camel_case(db):
+    w = Widget(name="z", made_at=datetime(2026, 5, 1)).save()
+    d = w.as_dict()
+    assert d["name"] == "z"
+    assert d["madeAt"] == "2026-05-01T00:00:00Z"
+    assert d["isBig"] is False
+    assert "weight" not in d  # not in __public__
+
+
+def test_camel_helper():
+    assert _camel("hbm_util_avg") == "hbmUtilAvg"
+    assert _camel("_status") == "status"
+    assert _camel("id") == "id"

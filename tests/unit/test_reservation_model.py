@@ -1,0 +1,94 @@
+"""Reservation invariants (reference: tensorhive/models/Reservation.py:38-131)."""
+from datetime import timedelta
+
+import pytest
+
+from tensorhive_tpu.db.models import Reservation
+from tensorhive_tpu.utils.exceptions import ValidationError
+from tensorhive_tpu.utils.timeutils import utcnow
+
+from ..fixtures import make_reservation, make_resource, make_user
+
+
+def test_min_and_max_duration(db):
+    user = make_user()
+    resource = make_resource()
+    start = utcnow()
+    with pytest.raises(ValidationError):
+        Reservation(
+            title="too short", resource_id=resource.uid, user_id=user.id,
+            start=start, end=start + timedelta(minutes=29),
+        ).save()
+    with pytest.raises(ValidationError):
+        Reservation(
+            title="too long", resource_id=resource.uid, user_id=user.id,
+            start=start, end=start + timedelta(days=9),
+        ).save()
+    Reservation(
+        title="ok", resource_id=resource.uid, user_id=user.id,
+        start=start, end=start + timedelta(minutes=30),
+    ).save()
+
+
+def test_end_before_start_rejected(db):
+    user, resource = make_user(), make_resource()
+    start = utcnow()
+    with pytest.raises(ValidationError):
+        Reservation(
+            title="backwards", resource_id=resource.uid, user_id=user.id,
+            start=start, end=start - timedelta(hours=1),
+        ).save()
+
+
+def test_overlap_detection(db):
+    user, resource = make_user(), make_resource()
+    make_reservation(user, resource.uid, start_in_h=0, duration_h=2)
+    with pytest.raises(ValidationError):
+        make_reservation(user, resource.uid, start_in_h=1, duration_h=2)
+    # touching intervals do not overlap (half-open)
+    make_reservation(user, resource.uid, start_in_h=2, duration_h=1)
+    # other resources unaffected
+    other = make_resource(hostname="vm1")
+    make_reservation(user, other.uid, start_in_h=1, duration_h=2)
+
+
+def test_cancelled_reservations_do_not_block(db):
+    user, resource = make_user(), make_resource()
+    first = make_reservation(user, resource.uid, start_in_h=0, duration_h=2)
+    first.is_cancelled = True
+    first.save()
+    make_reservation(user, resource.uid, start_in_h=1, duration_h=2)
+
+
+def test_update_does_not_conflict_with_itself(db):
+    user, resource = make_user(), make_resource()
+    reservation = make_reservation(user, resource.uid, start_in_h=0, duration_h=2)
+    reservation.title = "renamed"
+    reservation.save()  # must not see itself as an overlap
+
+
+def test_current_and_upcoming_queries(db):
+    user, resource = make_user(), make_resource()
+    past = make_reservation(user, resource.uid, start_in_h=-3, duration_h=1)
+    active = make_reservation(user, resource.uid, start_in_h=-1, duration_h=2)
+    future = make_reservation(user, resource.uid, start_in_h=5, duration_h=1)
+
+    current = Reservation.current_events()
+    assert [r.id for r in current] == [active.id]
+    assert Reservation.current_for_resource(resource.uid).id == active.id
+
+    upcoming = Reservation.upcoming_events_for_resource(resource.uid)
+    assert [r.id for r in upcoming] == [active.id, future.id]
+    assert past.id not in {r.id for r in upcoming}
+
+
+def test_filter_by_uids_and_time_range(db):
+    user = make_user()
+    r0, r1 = make_resource(index=0), make_resource(index=1)
+    a = make_reservation(user, r0.uid, start_in_h=0, duration_h=1)
+    make_reservation(user, r1.uid, start_in_h=10, duration_h=1)
+    found = Reservation.filter_by_uids_and_time_range(
+        [r0.uid, r1.uid], utcnow() - timedelta(hours=1), utcnow() + timedelta(hours=2)
+    )
+    assert [r.id for r in found] == [a.id]
+    assert Reservation.filter_by_uids_and_time_range([], utcnow(), utcnow()) == []

@@ -1,0 +1,102 @@
+"""Restriction/schedule activity logic (reference: tests/unit/models/test_restriction_model.py — 22 cases)."""
+from datetime import timedelta
+
+import pytest
+
+from tensorhive_tpu.db.models import Restriction, RestrictionSchedule
+from tensorhive_tpu.utils.exceptions import ValidationError
+from tensorhive_tpu.utils.timeutils import utcnow
+
+from ..fixtures import make_resource, make_restriction, make_schedule, make_user
+
+
+def test_validation(db):
+    with pytest.raises(ValidationError):
+        Restriction(starts_at=None).save()
+    now = utcnow()
+    with pytest.raises(ValidationError):
+        Restriction(starts_at=now, ends_at=now - timedelta(hours=1)).save()
+
+
+def test_active_window(db):
+    active = make_restriction(start_offset_h=-1, end_offset_h=1)
+    future = make_restriction(start_offset_h=1, end_offset_h=2)
+    expired = make_restriction(start_offset_h=-2, end_offset_h=-1)
+    indefinite = make_restriction(start_offset_h=-1, end_offset_h=None)
+    assert active.is_active()
+    assert not future.is_active()
+    assert not expired.is_active()
+    assert indefinite.is_active()
+
+
+def test_schedule_gating(db):
+    restriction = make_restriction(start_offset_h=-1, end_offset_h=24)
+    always = make_schedule(days="1234567", hour_start="00:00", hour_end="23:59")
+    restriction.add_schedule(always)
+    assert restriction.is_active()
+
+    restriction2 = make_restriction(start_offset_h=-1, end_offset_h=24)
+    now = utcnow()
+    off_day = str(now.isoweekday() % 7 + 1)  # tomorrow's weekday, never today
+    inactive_today = make_schedule(days=off_day)
+    restriction2.add_schedule(inactive_today)
+    assert not restriction2.is_active()
+    # adding an active schedule makes it active (any-of semantics)
+    restriction2.add_schedule(always)
+    assert restriction2.is_active()
+
+
+def test_schedule_validation(db):
+    with pytest.raises(ValidationError):
+        RestrictionSchedule(schedule_days="8", hour_start="00:00", hour_end="10:00").save()
+    with pytest.raises(ValidationError):
+        RestrictionSchedule(schedule_days="1", hour_start="10:00", hour_end="09:00").save()
+    with pytest.raises(ValidationError):
+        RestrictionSchedule(schedule_days="", hour_start="00:00", hour_end="10:00").save()
+    with pytest.raises(ValidationError):
+        RestrictionSchedule(schedule_days="1", hour_start="zz", hour_end="10:00").save()
+
+
+def test_schedule_is_active_hours(db):
+    now = utcnow()
+    today = str(now.isoweekday())
+    in_window = make_schedule(days=today, hour_start="00:00", hour_end="23:59")
+    assert in_window.is_active()
+    if now.hour < 23:
+        after = make_schedule(
+            days=today, hour_start=f"{now.hour + 1:02d}:00", hour_end="23:59"
+        )
+        assert not after.is_active()
+
+
+def test_apply_remove_links(db):
+    user = make_user()
+    resource = make_resource()
+    restriction = make_restriction()
+    restriction.apply_to_user(user)
+    restriction.apply_to_user(user)  # idempotent
+    restriction.apply_to_resource(resource)
+    assert [u.id for u in restriction.users] == [user.id]
+    assert [r.id for r in restriction.resources] == [resource.id]
+    restriction.remove_from_user(user)
+    restriction.remove_from_resource(resource)
+    assert restriction.users == [] and restriction.resources == []
+
+
+def test_apply_by_hostname(db):
+    make_resource(hostname="vmA", index=0)
+    make_resource(hostname="vmA", index=1)
+    make_resource(hostname="vmB", index=0)
+    restriction = make_restriction()
+    assert restriction.apply_to_resources_by_hostname("vmA") == 2
+    assert {r.hostname for r in restriction.resources} == {"vmA"}
+
+
+def test_global_restrictions_query(db):
+    make_restriction(is_global=True, start_offset_h=-1, end_offset_h=None)
+    expired = make_restriction(is_global=True, start_offset_h=-2, end_offset_h=-1)
+    make_restriction()  # non-global
+    active_globals = Restriction.get_global_restrictions()
+    assert len(active_globals) == 1
+    assert expired.id not in {r.id for r in active_globals}
+    assert len(Restriction.get_global_restrictions(include_expired=True)) == 2

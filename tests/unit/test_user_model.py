@@ -1,0 +1,89 @@
+"""User/Role/Group model tests (reference: tests/unit/models/)."""
+import pytest
+
+from tensorhive_tpu.db.models import Group, User
+from tensorhive_tpu.db.models.user import hash_password, verify_password
+from tensorhive_tpu.utils.exceptions import ValidationError
+
+from ..fixtures import make_permissive_restriction, make_resource, make_restriction, make_user
+
+
+def test_password_hash_roundtrip():
+    hashed = hash_password("hunter2hunter2")
+    assert hashed != "hunter2hunter2"
+    assert verify_password("hunter2hunter2", hashed)
+    assert not verify_password("wrong", hashed)
+    assert not verify_password("x", "garbage")
+
+
+def test_user_validation(db):
+    with pytest.raises(ValidationError):
+        User(username="ab", email="a@b.co", password="longenough").save()
+    with pytest.raises(ValidationError):
+        User(username="valid", email="notanemail", password="longenough").save()
+    with pytest.raises(ValidationError):
+        User(username="valid", email="a@b.co", password="short")
+    user = User(username="valid", email="a@b.co", password="longenough").save()
+    assert User.find_by_username("valid").id == user.id
+
+
+def test_roles(db):
+    user = make_user(admin=True)
+    assert user.has_role("admin")
+    assert set(user.roles) == {"user", "admin"}
+    user.remove_role("admin")
+    assert not User.get(user.id).has_role("admin")
+    with pytest.raises(ValidationError):
+        user.add_role("superduper")
+
+
+def test_groups_membership(db):
+    user = make_user()
+    group = Group(name="team").save()
+    group.add_user(user)
+    group.add_user(user)  # idempotent
+    assert [g.name for g in user.groups] == ["team"]
+    assert [u.id for u in group.users] == [user.id]
+    group.remove_user(user)
+    assert user.groups == []
+
+
+def test_default_groups(db):
+    Group(name="everyone", is_default=True).save()
+    Group(name="special").save()
+    assert [g.name for g in Group.get_default_groups()] == ["everyone"]
+
+
+def test_restrictions_via_group_and_global(db):
+    user = make_user()
+    group = Group(name="team").save()
+    group.add_user(user)
+    r_direct = make_restriction(user=user)
+    r_group = make_restriction()
+    r_group.apply_to_group(group)
+    r_global = make_permissive_restriction()
+    ids = {r.id for r in user.get_restrictions()}
+    assert ids == {r_direct.id, r_group.id, r_global.id}
+
+
+def test_filter_infrastructure_by_restrictions(db):
+    user = make_user()
+    chip0 = make_resource(hostname="vm0", index=0)
+    make_resource(hostname="vm0", index=1)
+    make_restriction(user=user, resources=[chip0])
+    infra = {
+        "vm0": {
+            "TPU": {
+                "vm0:tpu:0": {"duty_cycle": 10},
+                "vm0:tpu:1": {"duty_cycle": 20},
+            },
+            "CPU": {"util": 5},
+        }
+    }
+    filtered = user.filter_infrastructure_by_user_restrictions(infra)
+    assert set(filtered["vm0"]["TPU"]) == {"vm0:tpu:0"}
+    assert filtered["vm0"]["CPU"] == {"util": 5}
+
+    # a global restriction lifts all filtering
+    make_permissive_restriction(user)
+    assert user.filter_infrastructure_by_user_restrictions(infra) is infra
