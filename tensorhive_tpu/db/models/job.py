@@ -14,7 +14,7 @@ from datetime import datetime
 from typing import Any, Dict, List, Optional
 
 from ...utils.exceptions import ValidationError
-from ...utils.timeutils import utcnow
+from ...utils.timeutils import iso_utc, utcnow
 from ..orm import Column, Model
 
 
@@ -134,7 +134,7 @@ class Job(Model):
         at = at or utcnow()
         return cls.where(
             "start_at IS NOT NULL AND start_at <= ? AND _status IN (?, ?)",
-            [at.isoformat(), JobStatus.not_running.value, JobStatus.pending.value],
+            [iso_utc(at), JobStatus.not_running.value, JobStatus.pending.value],
         )
 
     @classmethod
@@ -142,7 +142,7 @@ class Job(Model):
         at = at or utcnow()
         return cls.where(
             "stop_at IS NOT NULL AND stop_at <= ? AND _status = ?",
-            [at.isoformat(), JobStatus.running.value],
+            [iso_utc(at), JobStatus.running.value],
         )
 
     def as_dict(self, include_private: bool = False) -> Dict[str, Any]:

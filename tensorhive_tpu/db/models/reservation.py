@@ -14,7 +14,7 @@ from datetime import datetime, timedelta
 from typing import Any, Dict, Iterable, List, Optional
 
 from ...utils.exceptions import ValidationError
-from ...utils.timeutils import utcnow
+from ...utils.timeutils import iso_utc, utcnow
 from ..orm import Column, Model
 
 
@@ -71,7 +71,7 @@ class Reservation(Model):
     # -- overlap (reference Reservation.py:120-131) ------------------------
     def would_interfere(self) -> bool:
         clauses = "resource_id = ? AND is_cancelled = 0 AND start < ? AND end > ?"
-        params: List[Any] = [self.resource_id, self.end.isoformat(), self.start.isoformat()]
+        params: List[Any] = [self.resource_id, iso_utc(self.end), iso_utc(self.start)]
         if self.id is not None:
             clauses += " AND id != ?"
             params.append(self.id)
@@ -81,13 +81,13 @@ class Reservation(Model):
     @classmethod
     def current_events(cls, at: Optional[datetime] = None) -> List["Reservation"]:
         at = at or utcnow()
-        iso = at.isoformat()
+        iso = iso_utc(at)
         return cls.where("is_cancelled = 0 AND start <= ? AND end > ?", [iso, iso])
 
     @classmethod
     def current_for_resource(cls, resource_id: str, at: Optional[datetime] = None) -> Optional["Reservation"]:
         at = at or utcnow()
-        iso = at.isoformat()
+        iso = iso_utc(at)
         rows = cls.where(
             "is_cancelled = 0 AND resource_id = ? AND start <= ? AND end > ?",
             [resource_id, iso, iso],
@@ -102,7 +102,7 @@ class Reservation(Model):
         at = at or utcnow()
         rows = cls.where(
             "is_cancelled = 0 AND resource_id = ? AND end > ?",
-            [resource_id, at.isoformat()],
+            [resource_id, iso_utc(at)],
         )
         rows.sort(key=lambda r: r.start)
         return rows
@@ -118,7 +118,7 @@ class Reservation(Model):
         placeholders = ", ".join("?" * len(uids))
         return cls.where(
             f"resource_id IN ({placeholders}) AND start < ? AND end > ?",
-            [*uids, end.isoformat(), start.isoformat()],
+            [*uids, iso_utc(end), iso_utc(start)],
         )
 
     def is_active(self, at: Optional[datetime] = None) -> bool:

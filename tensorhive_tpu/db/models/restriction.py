@@ -55,52 +55,57 @@ class Restriction(Model):
     def users(self) -> List:
         from .user import User
 
-        return [User.get(l.user_id) for l in Restriction2User.filter_by(restriction_id=self.id)]
+        return User.get_many(
+            [l.user_id for l in Restriction2User.filter_by(restriction_id=self.id)]
+        )
 
     @property
     def groups(self) -> List:
         from .user import Group
 
-        return [Group.get(l.group_id) for l in Restriction2Group.filter_by(restriction_id=self.id)]
+        return Group.get_many(
+            [l.group_id for l in Restriction2Group.filter_by(restriction_id=self.id)]
+        )
 
     @property
     def resources(self) -> List:
         from .resource import Resource
 
-        return [
-            Resource.get(l.resource_id)
-            for l in Restriction2Resource.filter_by(restriction_id=self.id)
-        ]
+        return Resource.get_many(
+            [l.resource_id for l in Restriction2Resource.filter_by(restriction_id=self.id)]
+        )
 
     @property
     def schedules(self) -> List:
         from .schedule import RestrictionSchedule
 
-        return [
-            RestrictionSchedule.get(l.schedule_id)
-            for l in Restriction2Schedule.filter_by(restriction_id=self.id)
-        ]
+        return RestrictionSchedule.get_many(
+            [l.schedule_id for l in Restriction2Schedule.filter_by(restriction_id=self.id)]
+        )
 
     # -- apply/remove (reference Restriction.py:108-178) -------------------
     def apply_to_user(self, user) -> None:
-        if not Restriction2User.filter_by(restriction_id=self.id, user_id=user.id):
-            Restriction2User(restriction_id=self.id, user_id=user.id).save()
+        with Restriction2User.atomically():
+            if not Restriction2User.filter_by(restriction_id=self.id, user_id=user.id):
+                Restriction2User(restriction_id=self.id, user_id=user.id).save()
 
     def remove_from_user(self, user) -> None:
         for link in Restriction2User.filter_by(restriction_id=self.id, user_id=user.id):
             link.destroy()
 
     def apply_to_group(self, group) -> None:
-        if not Restriction2Group.filter_by(restriction_id=self.id, group_id=group.id):
-            Restriction2Group(restriction_id=self.id, group_id=group.id).save()
+        with Restriction2Group.atomically():
+            if not Restriction2Group.filter_by(restriction_id=self.id, group_id=group.id):
+                Restriction2Group(restriction_id=self.id, group_id=group.id).save()
 
     def remove_from_group(self, group) -> None:
         for link in Restriction2Group.filter_by(restriction_id=self.id, group_id=group.id):
             link.destroy()
 
     def apply_to_resource(self, resource) -> None:
-        if not Restriction2Resource.filter_by(restriction_id=self.id, resource_id=resource.id):
-            Restriction2Resource(restriction_id=self.id, resource_id=resource.id).save()
+        with Restriction2Resource.atomically():
+            if not Restriction2Resource.filter_by(restriction_id=self.id, resource_id=resource.id):
+                Restriction2Resource(restriction_id=self.id, resource_id=resource.id).save()
 
     def remove_from_resource(self, resource) -> None:
         for link in Restriction2Resource.filter_by(
@@ -120,8 +125,11 @@ class Restriction(Model):
         return count
 
     def add_schedule(self, schedule) -> None:
-        if not Restriction2Schedule.filter_by(restriction_id=self.id, schedule_id=schedule.id):
-            Restriction2Schedule(restriction_id=self.id, schedule_id=schedule.id).save()
+        with Restriction2Schedule.atomically():
+            if not Restriction2Schedule.filter_by(
+                restriction_id=self.id, schedule_id=schedule.id
+            ):
+                Restriction2Schedule(restriction_id=self.id, schedule_id=schedule.id).save()
 
     def remove_schedule(self, schedule) -> None:
         for link in Restriction2Schedule.filter_by(
@@ -140,22 +148,21 @@ class Restriction(Model):
 
     @classmethod
     def for_user(cls, user_id: int) -> List["Restriction"]:
-        return [
-            cls.get(l.restriction_id) for l in Restriction2User.filter_by(user_id=user_id)
-        ]
+        return cls.get_many(
+            [l.restriction_id for l in Restriction2User.filter_by(user_id=user_id)]
+        )
 
     @classmethod
     def for_group(cls, group_id: int) -> List["Restriction"]:
-        return [
-            cls.get(l.restriction_id) for l in Restriction2Group.filter_by(group_id=group_id)
-        ]
+        return cls.get_many(
+            [l.restriction_id for l in Restriction2Group.filter_by(group_id=group_id)]
+        )
 
     @classmethod
     def for_resource(cls, resource_id: int) -> List["Restriction"]:
-        return [
-            cls.get(l.restriction_id)
-            for l in Restriction2Resource.filter_by(resource_id=resource_id)
-        ]
+        return cls.get_many(
+            [l.restriction_id for l in Restriction2Resource.filter_by(resource_id=resource_id)]
+        )
 
     def as_dict(self, include_private: bool = False) -> Dict[str, Any]:
         out = super().as_dict(include_private)

@@ -94,20 +94,21 @@ class Task(Model):
 
     def add_cmd_segment(self, name: str, value: str = "", segment_type=SegmentType.parameter) -> "CommandSegment":
         segment_type = SegmentType(segment_type)
-        segment = CommandSegment.first_by(name=name, _segment_type=segment_type.value)
-        if segment is None:
-            segment = CommandSegment(name=name, _segment_type=segment_type.value).save()
-        existing = CommandSegment2Task.filter_by(task_id=self.id, segment_id=segment.id)
-        if existing:
-            link = existing[0]
-            link.value = value
-            link.save()
-        else:
-            links = self.segment_links
-            next_position = max((l.position for l in links), default=0) + 1
-            CommandSegment2Task(
-                task_id=self.id, segment_id=segment.id, value=value, position=next_position
-            ).save()
+        with CommandSegment.atomically():
+            segment = CommandSegment.first_by(name=name, _segment_type=segment_type.value)
+            if segment is None:
+                segment = CommandSegment(name=name, _segment_type=segment_type.value).save()
+            existing = CommandSegment2Task.filter_by(task_id=self.id, segment_id=segment.id)
+            if existing:
+                link = existing[0]
+                link.value = value
+                link.save()
+            else:
+                links = self.segment_links
+                next_position = max((l.position for l in links), default=0) + 1
+                CommandSegment2Task(
+                    task_id=self.id, segment_id=segment.id, value=value, position=next_position
+                ).save()
         return segment
 
     def remove_cmd_segment(self, name: str) -> bool:

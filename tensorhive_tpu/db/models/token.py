@@ -25,5 +25,6 @@ class RevokedToken(Model):
 
     @classmethod
     def add(cls, jti: str) -> None:
-        if not cls.is_jti_blacklisted(jti):
-            cls(jti=jti).save()
+        with cls.atomically():
+            if not cls.is_jti_blacklisted(jti):
+                cls(jti=jti).save()

@@ -105,13 +105,9 @@ class User(Model):
         return name in self.roles
 
     def add_role(self, name: str) -> None:
-        import sqlite3
-
-        try:
+        with Role.atomically():
             if not self.has_role(name):
                 Role(name=name, user_id=self.id).save()
-        except sqlite3.IntegrityError:
-            pass  # concurrent add of the same role; UNIQUE(user_id, name) wins
 
     def remove_role(self, name: str) -> None:
         for role in Role.filter_by(user_id=self.id, name=name):
@@ -121,7 +117,7 @@ class User(Model):
     @property
     def groups(self) -> List["Group"]:
         links = User2Group.filter_by(user_id=self.id)
-        return [Group.get(link.group_id) for link in links]
+        return Group.get_many([link.group_id for link in links])
 
     # -- restrictions (reference User.py:149-164) --------------------------
     def get_restrictions(self, include_group: bool = True, include_global: bool = True):
@@ -216,11 +212,14 @@ class Group(Model):
 
     @property
     def users(self) -> List[User]:
-        return [User.get(link.user_id) for link in User2Group.filter_by(group_id=self.id)]
+        return User.get_many(
+            [link.user_id for link in User2Group.filter_by(group_id=self.id)]
+        )
 
     def add_user(self, user: User) -> None:
-        if not User2Group.filter_by(group_id=self.id, user_id=user.id):
-            User2Group(group_id=self.id, user_id=user.id).save()
+        with User2Group.atomically():
+            if not User2Group.filter_by(group_id=self.id, user_id=user.id):
+                User2Group(group_id=self.id, user_id=user.id).save()
 
     def remove_user(self, user: User) -> None:
         for link in User2Group.filter_by(group_id=self.id, user_id=user.id):

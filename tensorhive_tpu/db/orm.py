@@ -159,8 +159,11 @@ class Model(metaclass=ModelMeta):
         (reference: CRUDModel save-time assertion hook, CRUDModel.py:21)."""
 
     # -- CRUD --------------------------------------------------------------
-    def save(self: T, engine: Optional[Engine] = None) -> T:
-        engine = engine or get_engine()
+    def save(self: T) -> T:
+        # always the process-wide engine: check_assertions runs arbitrary
+        # model queries which resolve via get_engine(), so accepting a
+        # different engine here would validate against the wrong database
+        engine = get_engine()
         # run validation and the write under one engine lock so
         # check-then-insert invariants (e.g. reservation overlap,
         # Reservation.would_interfere) are atomic across threads
@@ -203,8 +206,8 @@ class Model(metaclass=ModelMeta):
                 )
         return self
 
-    def destroy(self, engine: Optional[Engine] = None) -> None:
-        engine = engine or get_engine()
+    def destroy(self) -> None:
+        engine = get_engine()
         pk = self.pk_column()
         engine.execute(
             f"DELETE FROM {self.__tablename__} WHERE {pk.name} = ?",
@@ -267,6 +270,33 @@ class Model(metaclass=ModelMeta):
         engine = engine or get_engine()
         rows = engine.query(f"SELECT * FROM {cls.__tablename__} WHERE {sql}", params)
         return [cls._from_row(r) for r in rows]
+
+    @classmethod
+    def get_many(cls: Type[T], pk_values: Sequence[Any], engine: Optional[Engine] = None) -> List[T]:
+        """Batched ``get`` preserving input order — one ``IN ()`` query
+        instead of N point lookups (link-table traversal helper)."""
+        pk_values = list(pk_values)
+        if not pk_values:
+            return []
+        pk = cls.pk_column()
+        unique = list(dict.fromkeys(pk_values))
+        placeholders = ", ".join("?" * len(unique))
+        rows = cls.where(
+            f"{pk.name} IN ({placeholders})",
+            [pk.to_sql(v) for v in unique],
+            engine=engine,
+        )
+        by_pk = {getattr(obj, pk.name): obj for obj in rows}
+        missing = [v for v in unique if v not in by_pk]
+        if missing:
+            raise NotFoundError(f"{cls.__name__} ids not found: {missing}")
+        return [by_pk[v] for v in pk_values]
+
+    @classmethod
+    def atomically(cls):
+        """Engine-lock context for caller-level check-then-write sequences
+        (e.g. link-table 'insert if absent' helpers)."""
+        return get_engine().transaction()
 
     @classmethod
     def count(cls, engine: Optional[Engine] = None, **eq: Any) -> int:

@@ -49,6 +49,12 @@ def parse_datetime(value: Union[str, datetime, None]) -> Optional[datetime]:
     raise ValueError(f"unparseable datetime: {value!r}")
 
 
+def iso_utc(dt: datetime) -> str:
+    """Canonical naive-UTC ISO text for SQL comparison parameters — matches
+    exactly how Column.to_sql stores datetimes."""
+    return to_utc_naive(dt).isoformat()
+
+
 def isoformat(dt: Optional[datetime]) -> Optional[str]:
     """Serialize naive-UTC datetime to API form with trailing Z."""
     if dt is None:

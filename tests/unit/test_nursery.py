@@ -1,0 +1,157 @@
+"""Nursery lifecycle tests — REAL detached processes via the local transport
+(the reference never tests this path: task_nursery.py:34 "TODO Write tests"),
+plus parity checks for the fake implementation.
+"""
+import getpass
+import time
+
+import pytest
+
+from tensorhive_tpu.config import HostConfig
+from tensorhive_tpu.core.nursery import HostOps, Termination
+from tensorhive_tpu.core.transport import FakeCluster, LocalTransport
+from tensorhive_tpu.core.transport.fake import FakeHostOps
+from tensorhive_tpu.utils.exceptions import SpawnError, TransportError
+
+
+@pytest.fixture()
+def ops(config, tmp_path):
+    transport = LocalTransport(HostConfig(name="localhost", backend="local"), config=config)
+    return HostOps(transport, run_dir=str(tmp_path / "run"), log_dir=str(tmp_path / "logs"))
+
+
+def wait_until(predicate, timeout=5.0, step=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(step)
+    return predicate()
+
+
+class TestRealProcesses:
+    def test_spawn_running_log_terminate(self, ops):
+        pid = ops.spawn("echo started; sleep 30", task_id=7)
+        assert pid > 0
+        assert ops.running_tasks() == {7: pid}
+        assert wait_until(lambda: "started" in ops.fetch_log(7))
+
+        assert ops.terminate(pid, Termination.interrupt)
+        assert wait_until(lambda: 7 not in ops.running_tasks())
+
+    def test_exit_code_and_log_capture(self, ops):
+        ops.spawn("echo out; echo err >&2; exit 0", task_id=1)
+        assert wait_until(lambda: 1 not in ops.running_tasks())
+        log_text = ops.fetch_log(1)
+        assert "out" in log_text and "err" in log_text
+
+    def test_adoption_across_instances(self, ops, config, tmp_path):
+        # simulate daemon restart: a NEW HostOps instance must re-adopt the
+        # running pid from its pidfile (reference synchronize() semantics)
+        pid = ops.spawn("sleep 30", task_id=42)
+        fresh = HostOps(
+            LocalTransport(HostConfig(name="localhost", backend="local"), config=config),
+            run_dir=ops.run_dir,
+            log_dir=ops.log_dir,
+        )
+        assert fresh.running_tasks() == {42: pid}
+        fresh.terminate(pid, Termination.kill)
+        assert wait_until(lambda: 42 not in fresh.running_tasks())
+
+    def test_stale_pidfile_pruned_and_marker_guard(self, ops, tmp_path):
+        pid = ops.spawn("sleep 30", task_id=9)
+        ops.terminate(pid, Termination.kill)
+        assert wait_until(lambda: 9 not in ops.running_tasks())
+        # dead task's pidfile must be gone after the scan
+        assert not (tmp_path / "run" / "task_9.pid").exists()
+
+        # PID-reuse guard: pidfile pointing at an alive process WITHOUT the
+        # marker (e.g. recycled pid) must not be adopted
+        (tmp_path / "run").mkdir(exist_ok=True)
+        import os
+
+        (tmp_path / "run" / "task_11.pid").write_text(str(os.getpid()))
+        assert 11 not in ops.running_tasks()
+        assert not (tmp_path / "run" / "task_11.pid").exists()
+
+    def test_process_group_killed_with_wrapper(self, ops):
+        # the command spawns its own child; terminating the group must kill both
+        pid = ops.spawn("sleep 60 & sleep 60", task_id=3)
+        time.sleep(0.3)
+        ops.terminate(pid, Termination.kill)
+        assert wait_until(lambda: 3 not in ops.running_tasks())
+        # no LIVE process left in the task's group (zombies awaiting init's
+        # reap are fine — they hold no resources)
+        transport = ops.transport
+        out = transport.run(
+            f"ps -o stat= -g {pid} | grep -cv '^Z' || true"
+        ).stdout.strip()
+        assert out == "0"
+
+    def test_fetch_log_tail(self, ops):
+        ops.spawn("for i in 1 2 3 4 5; do echo line$i; done", task_id=5)
+        assert wait_until(lambda: 5 not in ops.running_tasks())
+        assert wait_until(lambda: "line5" in ops.fetch_log(5))
+        tail = ops.fetch_log(5, tail=2)
+        assert tail.splitlines() == ["line4", "line5"]
+
+    def test_fetch_log_missing(self, ops):
+        with pytest.raises(TransportError):
+            ops.fetch_log(999)
+
+    def test_owner_lookup_batched(self, ops):
+        pid = ops.spawn("sleep 10", task_id=6)
+        me = getpass.getuser()
+        assert ops.process_owner(pid) == me
+        assert ops.process_owners([pid, 999999]) == {pid: me}
+        ops.terminate(pid, Termination.kill)
+
+
+class TestFakeParity:
+    def test_fake_lifecycle(self):
+        cluster = FakeCluster()
+        cluster.add_host("vm0", chips=4)
+        ops = FakeHostOps(cluster, "vm0", user="alice")
+        pid = ops.spawn("python train.py", task_id=1)
+        assert ops.running_tasks() == {1: pid}
+        assert "started" in ops.fetch_log(1)
+        assert ops.terminate(pid, Termination.interrupt)
+        assert ops.running_tasks() == {}
+        assert "SIGINT" in ops.fetch_log(1)
+
+    def test_fake_stubborn_process_needs_kill(self):
+        cluster = FakeCluster()
+        cluster.add_host("vm0")
+        ops = FakeHostOps(cluster, "vm0")
+        pid = ops.spawn("stubborn", task_id=2)
+        cluster.host("vm0").processes[pid].dies_on = ("KILL",)
+        ops.terminate(pid, Termination.interrupt)
+        assert ops.running_tasks() == {2: pid}  # survived SIGINT
+        ops.terminate(pid, Termination.kill)
+        assert ops.running_tasks() == {}
+
+    def test_fake_spawn_failure(self):
+        cluster = FakeCluster()
+        cluster.add_host("vm0")
+        cluster.spawn_failures["vm0"] = "no space left"
+        with pytest.raises(SpawnError):
+            FakeHostOps(cluster, "vm0").spawn("x", task_id=1)
+
+    def test_fake_kill_permissions(self):
+        cluster = FakeCluster()
+        cluster.add_host("vm0")
+        intruder = cluster.start_process("vm0", user="mallory", chip_ids=[])
+        # as a different non-sudo user: EPERM
+        assert not FakeHostOps(cluster, "vm0", user="alice").kill_pid(intruder.pid)
+        # as the owner
+        assert FakeHostOps(cluster, "vm0", user="mallory").kill_pid(intruder.pid)
+        assert not cluster.host("vm0").processes[intruder.pid].alive
+
+    def test_fake_ptys(self):
+        cluster = FakeCluster()
+        host = cluster.add_host("vm0")
+        host.ptys = [("mallory", "pts/0"), ("alice", "pts/1")]
+        ops = FakeHostOps(cluster, "vm0")
+        assert ops.pty_sessions() == [("mallory", "pts/0"), ("alice", "pts/1")]
+        ops.write_to_ptys(["pts/0"], "get off my chip")
+        assert host.pty_messages["pts/0"] == ["get off my chip"]

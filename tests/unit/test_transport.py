@@ -1,0 +1,91 @@
+"""Transport layer tests (reference behaviors: core/ssh.py, SSHConnectionManager)."""
+import pytest
+
+from tensorhive_tpu.config import HostConfig
+from tensorhive_tpu.core.transport import (
+    FakeCluster,
+    FakeTransport,
+    LocalTransport,
+    TransportManager,
+)
+from tensorhive_tpu.core.transport.base import make_transport
+from tensorhive_tpu.utils.exceptions import TransportError
+
+
+def local_host(name="localhost"):
+    return HostConfig(name=name, address=name, user="", backend="local")
+
+
+def test_local_transport_run_and_exit_codes(config):
+    transport = LocalTransport(local_host(), config=config)
+    result = transport.run("echo hello && echo err >&2")
+    assert result.ok and result.stdout.strip() == "hello" and result.stderr.strip() == "err"
+    assert transport.run("exit 3").exit_code == 3
+    assert transport.test()
+
+
+def test_local_check_output_raises_on_failure(config):
+    transport = LocalTransport(local_host(), config=config)
+    assert transport.check_output("echo ok").strip() == "ok"
+    with pytest.raises(TransportError):
+        transport.check_output("echo boom >&2; exit 1")
+
+
+def test_local_timeout(config):
+    transport = LocalTransport(local_host(), config=config)
+    with pytest.raises(TransportError):
+        transport.run("sleep 5", timeout=0.2)
+
+
+def test_make_transport_backend_selection(config):
+    config.ssh.default_backend = "local"
+    host = HostConfig(name="h1", address="h1")
+    assert isinstance(make_transport(host, config=config), LocalTransport)
+    host_bad = HostConfig(name="h2", backend="carrier-pigeon")
+    with pytest.raises(TransportError):
+        make_transport(host_bad, config=config)
+
+
+def test_manager_caching_and_unknown_host(config):
+    config.hosts["localhost"] = local_host()
+    manager = TransportManager(config)
+    t1 = manager.for_host("localhost")
+    assert manager.for_host("localhost") is t1
+    assert manager.for_host("localhost", user="alice") is not t1
+    manager.invalidate("localhost")
+    assert manager.for_host("localhost") is not t1
+    with pytest.raises(TransportError):
+        manager.for_host("ghost")
+
+
+def test_run_on_all_isolates_failures(config):
+    # one reachable fake host + one unreachable: the fan-out must return a
+    # result per host, never raise (reference stop_on_errors=False semantics)
+    cluster = FakeCluster()
+    cluster.add_host("good")
+    bad = cluster.add_host("bad")
+    bad.reachable = False
+
+    config.hosts = {
+        "good": HostConfig(name="good", backend="fake"),
+        "bad": HostConfig(name="bad", backend="fake"),
+    }
+    from tensorhive_tpu.core.transport.base import register_backend
+
+    register_backend("fake", lambda host, user=None, config=None: FakeTransport(host, cluster, user))
+    manager = TransportManager(config)
+    results = manager.run_on_all("uname")
+    assert results["good"].ok
+    assert not results["bad"].ok and results["bad"].exit_code == 255
+    statuses = manager.test_all_connections()
+    assert statuses == {"good": True, "bad": False}
+
+
+def test_fake_transport_handlers(config):
+    cluster = FakeCluster()
+    cluster.add_host("h")
+    transport = FakeTransport(HostConfig(name="h"), cluster)
+    transport.on(lambda c: c.startswith("cat /proc/stat"), lambda c: "cpu 1 2 3\n")
+    assert transport.run("cat /proc/stat").stdout == "cpu 1 2 3\n"
+    assert transport.run("uname").stdout.strip() == "Linux"
+    assert transport.run("unknown-cmd").exit_code == 127
