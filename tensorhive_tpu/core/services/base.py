@@ -1,0 +1,79 @@
+"""Service base class.
+
+Reference: tensorhive/core/services/Service.py (16 LoC) + StoppableThread —
+a thread with an abstract ``inject`` hook through which ServiceManager pushes
+the shared managers (ServiceManager.py:configure_all_services). Here the
+injection is explicit and typed, and every service gets uniform tick timing:
+the reference hand-rolled per-loop perf_counter bookkeeping in each service
+(MonitoringService.py:38-54, ProtectionService.py:81) — that bookkeeping is
+the *only* profiling the reference has (SURVEY.md §5 Tracing), so it is kept
+and centralized, feeding the poll-latency metric BASELINE.md asks for.
+"""
+from __future__ import annotations
+
+import collections
+import logging
+import statistics
+import time
+from typing import TYPE_CHECKING, Deque, Optional
+
+from ...utils.threading import StoppableThread
+
+if TYPE_CHECKING:
+    from ..managers.infrastructure import InfrastructureManager
+    from ..transport.base import TransportManager
+
+log = logging.getLogger(__name__)
+
+
+class Service(StoppableThread):
+    """Periodic daemon thread: ``do_run()`` every ``interval_s`` seconds.
+
+    Subclasses implement :meth:`do_run`; the run loop measures each tick and
+    sleeps out the interval remainder (interruptible by shutdown).
+    """
+
+    def __init__(self, interval_s: float, name: Optional[str] = None) -> None:
+        super().__init__(name=name or type(self).__name__)
+        self.interval_s = interval_s
+        self.infrastructure_manager: Optional["InfrastructureManager"] = None
+        self.transport_manager: Optional["TransportManager"] = None
+        #: rolling window of tick durations (seconds) for latency stats
+        self.tick_durations: Deque[float] = collections.deque(maxlen=256)
+        self.ticks_completed = 0
+
+    def inject(self, infrastructure_manager: "InfrastructureManager",
+               transport_manager: "TransportManager") -> None:
+        """Receive shared managers (reference Service.inject)."""
+        self.infrastructure_manager = infrastructure_manager
+        self.transport_manager = transport_manager
+
+    # -- loop ---------------------------------------------------------------
+    def run(self) -> None:
+        while not self.stopped:
+            started = time.perf_counter()
+            try:
+                self.do_run()
+            except Exception:
+                # a crashing tick must not kill the daemon thread (the
+                # reference would die silently here — its threads have no
+                # guard and a monitor exception stops all monitoring)
+                log.exception("%s tick failed", self.name)
+            elapsed = time.perf_counter() - started
+            self.tick_durations.append(elapsed)
+            self.ticks_completed += 1
+            remaining = self.interval_s - elapsed
+            if remaining > 0:
+                self.wait(remaining)
+            else:
+                log.debug("%s tick overran interval: %.3fs > %.3fs",
+                          self.name, elapsed, self.interval_s)
+
+    def do_run(self) -> None:
+        raise NotImplementedError
+
+    # -- introspection ------------------------------------------------------
+    def tick_latency_p50(self) -> Optional[float]:
+        if not self.tick_durations:
+            return None
+        return statistics.median(self.tick_durations)

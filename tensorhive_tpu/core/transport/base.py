@@ -99,7 +99,11 @@ class TransportManager:
         self.config = config or get_config()
         self._cache: Dict[Tuple[str, Optional[str]], Transport] = {}
         self._cache_lock = threading.Lock()
-        self._max_workers = max_workers
+        # persistent pool: run_on_all fires once per monitor per ~2s tick, so
+        # per-call executor construction would churn threads on the hot path
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max_workers, thread_name_prefix="transport"
+        )
 
     @property
     def hostnames(self) -> List[str]:
@@ -150,11 +154,12 @@ class TransportManager:
                     host=name, command=command, exit_code=255, stdout="", stderr=str(exc)
                 )
 
-        workers = min(self._max_workers, len(hostnames))
-        with concurrent.futures.ThreadPoolExecutor(max_workers=workers) as pool:
-            for name, result in zip(hostnames, pool.map(_one, hostnames)):
-                results[name] = result
+        for name, result in zip(hostnames, self._pool.map(_one, hostnames)):
+            results[name] = result
         return results
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False)
 
     def test_all_connections(self) -> Dict[str, bool]:
         """Startup connectivity probe (reference TensorHiveManager.test_ssh:47-69)."""

@@ -47,6 +47,12 @@ class FakeHost:
     reachable: bool = True
     # chip telemetry: chip_index -> metrics dict (mutated by tests)
     chips: Dict[int, Dict] = field(default_factory=dict)
+    # cumulative cpu jiffies + memory, advanced by tests for util deltas
+    cpu_total_jiffies: int = 0
+    cpu_idle_jiffies: int = 0
+    ncpu: int = 8
+    mem_total_kb: int = 16 * 2**20
+    mem_avail_kb: int = 12 * 2**20
 
 
 class FakeCluster:
@@ -114,6 +120,39 @@ class FakeCluster:
                         host.chips[chip]["pid"] = None
                         host.chips[chip]["user"] = None
 
+    def probe_json(self, hostname: str) -> str:
+        """Render this host's state in the probe's schema-v1 JSON, so fake
+        monitoring traverses the exact same parse path as production."""
+        from ..monitors.probe import render_probe_json
+
+        with self._lock:
+            host = self.host(hostname)
+            chips, metrics = [], {}
+            for index, chip in sorted(host.chips.items()):
+                pids = sorted({
+                    pid for pid, proc in host.processes.items()
+                    if proc.alive and index in proc.chip_ids
+                } | ({chip["pid"]} if chip.get("pid") else set()))
+                chips.append({"index": index, "dev": f"/dev/accel{index}", "pids": pids})
+                metrics[str(index)] = {
+                    "hbm_used_bytes": chip.get("hbm_used_bytes"),
+                    "hbm_total_bytes": chip.get("hbm_total_bytes"),
+                    "duty_cycle_pct": chip.get("duty_cycle_pct"),
+                    "age_s": chip.get("metrics_age_s", 0.0),
+                }
+            procs = {
+                pid: {"user": proc.user, "cmd": proc.command}
+                for pid, proc in host.processes.items()
+                if proc.alive
+            }
+            return render_probe_json(
+                chips, procs,
+                cpu={"total": host.cpu_total_jiffies, "idle": host.cpu_idle_jiffies,
+                     "ncpu": host.ncpu},
+                mem={"total_kb": host.mem_total_kb, "avail_kb": host.mem_avail_kb},
+                metrics=metrics,
+            )
+
 
 class FakeTransport(Transport):
     """Transport running canned handlers instead of a shell. Tests register
@@ -136,6 +175,12 @@ class FakeTransport(Transport):
                 return CommandResult(self.hostname, command, 0, respond(command))
         if command.strip() == "uname":
             return CommandResult(self.hostname, command, 0, "Linux\n")
+        from ..monitors.probe import PROBE_MARKER
+
+        if PROBE_MARKER in command:
+            return CommandResult(
+                self.hostname, command, 0, self.cluster.probe_json(self.hostname) + "\n"
+            )
         return CommandResult(self.hostname, command, 127, "", f"fake: unhandled command {command!r}")
 
 

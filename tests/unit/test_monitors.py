@@ -1,0 +1,229 @@
+"""Monitoring layer tests: probe parsing, TPU/CPU monitors over the fake
+cluster, infrastructure store semantics, and the MonitoringService tick.
+
+The reference ships NO tests for monitors or services (SURVEY.md §4 "no
+tests for monitors, services, task_nursery"); this suite closes that gap via
+the fake cluster, which renders real schema-v1 probe JSON so the production
+parser is on the tested path.
+"""
+import pytest
+
+from tensorhive_tpu.config import HostConfig
+from tensorhive_tpu.core.managers.infrastructure import InfrastructureManager, chip_uid
+from tensorhive_tpu.core.monitors.cpu import CpuMonitor
+from tensorhive_tpu.core.monitors.probe import (
+    PROBE_MARKER,
+    PYTHON_PROBE_SOURCE,
+    parse_probe_output,
+    probe_command,
+)
+from tensorhive_tpu.core.monitors.tpu import TpuMonitor
+from tensorhive_tpu.core.services.monitoring import MonitoringService
+from tensorhive_tpu.core.transport.base import TransportManager, register_backend
+from tensorhive_tpu.core.transport.fake import FakeCluster, FakeTransport
+from tensorhive_tpu.utils.exceptions import TelemetryError
+
+
+@pytest.fixture()
+def cluster(config):
+    cluster = FakeCluster()
+    register_backend(
+        "fake", lambda host, user=None, config=None: FakeTransport(host, cluster, user)
+    )
+    for name in ("vm-0", "vm-1"):
+        config.hosts[name] = HostConfig(
+            name=name, user="hive", backend="fake",
+            accelerator_type="v5litepod-8", chips=4,
+        )
+        cluster.add_host(name, chips=4)
+    return cluster
+
+
+@pytest.fixture()
+def transports(config, cluster):
+    return TransportManager(config)
+
+
+# -- probe command / parser -------------------------------------------------
+
+def test_probe_command_carries_marker_and_fallback():
+    command = probe_command()
+    assert PROBE_MARKER in command
+    assert "python3 -c" in command
+    assert ".tpuhive/bin/tpuhive-probe" in command
+
+
+def test_python_probe_runs_locally_and_parses(tmp_path):
+    """The inline fallback must execute on a plain Linux box and emit valid
+    schema-v1 JSON (no accelerators present here — chips list empty)."""
+    import subprocess
+    import sys
+
+    proc = subprocess.run(
+        [sys.executable, "-c", PYTHON_PROBE_SOURCE],
+        capture_output=True, text=True, timeout=60,
+    )
+    assert proc.returncode == 0, proc.stderr
+    sample = parse_probe_output(proc.stdout)
+    assert sample.cpu_total is not None and sample.cpu_total > 0
+    assert sample.mem_total_kb > 0
+
+
+def test_parse_probe_output_rejects_garbage():
+    with pytest.raises(TelemetryError):
+        parse_probe_output("not json at all")
+    with pytest.raises(TelemetryError):
+        parse_probe_output('{"v": 99}')
+
+
+def test_parse_probe_output_skips_noise_lines():
+    sample = parse_probe_output(
+        'Welcome to the VM!\n{"v":1,"chips":[{"index":0,"dev":"/dev/accel0","pids":[7]}],'
+        '"procs":{"7":{"user":"a","cmd":"python"}},"cpu":{},"mem":{},"metrics":{}}\n'
+    )
+    assert sample.chips[0].pids == [7]
+    assert sample.procs[7]["user"] == "a"
+
+
+def test_parse_probe_ignores_stale_runtime_metrics():
+    text = (
+        '{"v":1,"chips":[{"index":0,"dev":"d","pids":[]}],"procs":{},"cpu":{},"mem":{},'
+        '"metrics":{"0":{"hbm_used_bytes":5,"hbm_total_bytes":10,'
+        '"duty_cycle_pct":50.0,"age_s":999.0}}}'
+    )
+    sample = parse_probe_output(text)
+    assert sample.chips[0].hbm_used_bytes is None  # stale → dropped
+    assert sample.chips[0].metrics_age_s == 999.0
+
+
+# -- TpuMonitor over the fake cluster ----------------------------------------
+
+def test_tpu_monitor_populates_infrastructure(cluster, transports):
+    cluster.host("vm-0").chips[1].update(
+        hbm_used_bytes=8 * 2**30, hbm_total_bytes=16 * 2**30, duty_cycle_pct=87.5
+    )
+    cluster.start_process("vm-0", user="alice", command="python train.py", chip_ids=[1])
+
+    infra = InfrastructureManager(["vm-0", "vm-1"])
+    monitor = TpuMonitor()
+    monitor.update(transports, infra)
+
+    chips = infra.infrastructure["vm-0"]["TPU"]
+    assert len(chips) == 4
+    busy = chips[chip_uid("vm-0", 1)]
+    assert busy["hbm_used_mib"] == 8 * 1024
+    assert busy["hbm_util_pct"] == 50.0
+    assert busy["duty_cycle_pct"] == 87.5
+    assert busy["accelerator_type"] == "v5litepod-8"
+    assert busy["processes"] == [
+        {"pid": busy["processes"][0]["pid"], "user": "alice", "command": "python train.py"}
+    ]
+    idle = chips[chip_uid("vm-0", 0)]
+    assert idle["processes"] == []
+
+
+def test_tpu_monitor_isolates_unreachable_host(cluster, transports):
+    cluster.host("vm-1").reachable = False
+    infra = InfrastructureManager(["vm-0", "vm-1"])
+    monitor = TpuMonitor()
+    monitor.update(transports, infra)
+    snapshot = infra.infrastructure
+    assert "TPU" in snapshot["vm-0"]
+    assert "TPU" not in snapshot["vm-1"]  # stale data dropped, not retained
+
+
+def test_tpu_monitor_drops_stale_subtree_when_host_goes_dark(cluster, transports):
+    infra = InfrastructureManager(["vm-0"])
+    monitor = TpuMonitor()
+    monitor.update(transports, infra)
+    assert "TPU" in infra.infrastructure["vm-0"]
+    cluster.host("vm-0").reachable = False
+    monitor.update(transports, infra)
+    assert "TPU" not in infra.infrastructure["vm-0"]
+
+
+# -- CpuMonitor ---------------------------------------------------------------
+
+def test_cpu_monitor_diffs_jiffies_across_ticks(cluster, transports):
+    host = cluster.host("vm-0")
+    host.cpu_total_jiffies, host.cpu_idle_jiffies = 1000, 800
+    infra = InfrastructureManager(["vm-0", "vm-1"])
+    tpu = TpuMonitor()
+    cpu = CpuMonitor(tpu_monitor=tpu)
+
+    tpu.update(transports, infra)
+    cpu.update(transports, infra)
+    first = infra.infrastructure["vm-0"]["CPU"]["CPU_vm-0"]
+    assert first["util_pct"] is None  # no delta yet
+    assert first["mem_total_mib"] == 16 * 1024
+
+    host.cpu_total_jiffies, host.cpu_idle_jiffies = 2000, 1550  # 25% busy delta
+    tpu.update(transports, infra)
+    cpu.update(transports, infra)
+    second = infra.infrastructure["vm-0"]["CPU"]["CPU_vm-0"]
+    assert second["util_pct"] == 25.0
+
+
+def test_cpu_monitor_standalone_without_tpu_monitor(cluster, transports):
+    infra = InfrastructureManager(["vm-0"])
+    CpuMonitor(tpu_monitor=None).update(transports, infra)
+    assert "CPU_vm-0" in infra.infrastructure["vm-0"]["CPU"]
+
+
+# -- InfrastructureManager ----------------------------------------------------
+
+def test_infrastructure_process_queries_and_ignore_list():
+    infra = InfrastructureManager(["vm-0"])
+    uid = chip_uid("vm-0", 0)
+    infra.update_subtree("vm-0", "TPU", {
+        uid: {"uid": uid, "index": 0, "processes": [
+            {"pid": 1, "user": "a", "command": "python train.py"},
+            {"pid": 2, "user": "root", "command": "tpu-runtime --daemon"},
+        ]},
+    })
+    procs = infra.node_tpu_processes("vm-0")
+    assert [p["pid"] for p in procs[uid]] == [1]  # daemon filtered
+    assert infra.all_nodes_with_tpu_processes() == {"vm-0": procs}
+    assert infra.find_chip_hostname(uid) == "vm-0"
+    assert infra.find_chip(uid)["index"] == 0
+    assert infra.find_chip("nope") is None
+
+
+def test_infrastructure_snapshots_are_isolated():
+    infra = InfrastructureManager(["vm-0"])
+    infra.update_subtree("vm-0", "TPU", {"u": {"processes": []}})
+    snapshot = infra.infrastructure
+    snapshot["vm-0"]["TPU"]["u"]["processes"].append({"pid": 666})
+    assert infra.infrastructure["vm-0"]["TPU"]["u"]["processes"] == []
+
+
+# -- MonitoringService --------------------------------------------------------
+
+def test_monitoring_service_tick(cluster, transports, config):
+    infra = InfrastructureManager(list(config.hosts))
+    service = MonitoringService(config=config)
+    service.inject(infra, transports)
+    service.do_run()
+    snapshot = infra.infrastructure
+    for name in ("vm-0", "vm-1"):
+        assert "TPU" in snapshot[name] and "CPU" in snapshot[name]
+
+
+def test_monitoring_service_threaded_lifecycle(cluster, transports, config):
+    config.monitoring.interval_s = 0.01
+    infra = InfrastructureManager(list(config.hosts))
+    service = MonitoringService(config=config)
+    service.inject(infra, transports)
+    service.start()
+    try:
+        import time
+
+        deadline = time.time() + 5
+        while service.ticks_completed < 3 and time.time() < deadline:
+            time.sleep(0.01)
+        assert service.ticks_completed >= 3
+        assert service.tick_latency_p50() is not None
+    finally:
+        service.shutdown()
+        service.join(timeout=5)
+    assert not service.is_alive()
