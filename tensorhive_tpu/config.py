@@ -85,6 +85,9 @@ class MonitoringConfig:
     enable_tpu_monitor: bool = True
     enable_cpu_monitor: bool = True
     interval_s: float = 2.0
+    # build + push the native probe binary to managed hosts at boot; hosts
+    # where this fails use the inline python fallback automatically
+    deploy_native_probe: bool = True
 
 
 @dataclasses.dataclass

@@ -57,12 +57,19 @@ class TpuHiveManager:
         if self.service_manager is None:
             self.configure_services_from_config()
         assert self.service_manager is not None
+        if self.config.monitoring.deploy_native_probe and self.config.hosts:
+            from ..monitors.deploy import deploy_probe
+
+            statuses = deploy_probe(self.transport_manager)
+            deployed = sum(statuses.values())
+            log.info("native probe deployed to %d/%d hosts", deployed, len(statuses))
         self.service_manager.start_all_services()
         self._started = True
 
     def shutdown(self) -> None:
         if self.service_manager is not None and self._started:
             self.service_manager.shutdown_all_services()
+        self.transport_manager.close()
         self._started = False
 
 

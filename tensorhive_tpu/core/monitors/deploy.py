@@ -1,0 +1,93 @@
+"""Build + deploy the native telemetry probe onto managed hosts.
+
+The reference assumes ``nvidia-smi`` pre-exists on every managed node (it
+ships with the driver). The TPU probe has no such luck, so the manager pushes
+its own binary at startup: build locally with the in-tree Makefile (or use a
+prebuilt), then copy to ``~/.tpuhive/bin/tpuhive-probe`` on each host. Hosts
+where deployment fails silently fall back to the inline Python probe — the
+monitoring tick works either way, just slower (interpreter startup dominates
+the fallback's latency; see native/probe.cpp header).
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import hashlib
+import logging
+import subprocess
+from pathlib import Path
+from typing import Dict, Optional
+
+from ...utils.exceptions import TelemetryError, TransportError
+from ..transport.base import TransportManager
+from .probe import PROBE_REMOTE_PATH
+
+log = logging.getLogger(__name__)
+
+NATIVE_DIR = Path(__file__).resolve().parents[3] / "native"
+
+
+def build_probe(native_dir: Optional[Path] = None) -> Path:
+    """Compile the probe with the in-tree Makefile; returns the binary path.
+    Raises TelemetryError when no toolchain is available."""
+    native_dir = native_dir or NATIVE_DIR
+    binary = native_dir / "bin" / "tpuhive-probe"
+    if not (native_dir / "Makefile").exists():
+        if binary.exists():
+            return binary
+        raise TelemetryError(f"native sources not found under {native_dir}")
+    try:
+        proc = subprocess.run(
+            ["make", "-C", str(native_dir)], capture_output=True, text=True, timeout=120
+        )
+    except (OSError, subprocess.TimeoutExpired) as exc:
+        raise TelemetryError(f"probe build failed to run: {exc}") from exc
+    if proc.returncode != 0:
+        raise TelemetryError(f"probe build failed:\n{proc.stderr[-2000:]}")
+    if not binary.exists():
+        raise TelemetryError(f"probe build produced no binary at {binary}")
+    return binary
+
+
+def deploy_probe(
+    transports: TransportManager, binary: Optional[Path] = None
+) -> Dict[str, bool]:
+    """Push the probe binary to every managed host; returns per-host success.
+    A host that already has a byte-identical probe (sha256 match) is
+    skipped; freshly pushed binaries are verified by executing them."""
+    if binary is None:
+        try:
+            binary = build_probe()
+        except TelemetryError as exc:
+            log.warning("cannot build native probe (%s); hosts will use the "
+                        "python fallback", exc)
+            return {name: False for name in transports.hostnames}
+    with open(binary, "rb") as fh:
+        local_sha = hashlib.sha256(fh.read()).hexdigest()
+
+    def _deploy_one(hostname: str) -> bool:
+        transport = transports.for_host(hostname)
+        try:
+            current = transport.run(
+                f"sha256sum {PROBE_REMOTE_PATH} 2>/dev/null | cut -d' ' -f1"
+            )
+            if current.ok and current.stdout.strip() == local_sha:
+                return True
+            transport.put_file(str(binary), PROBE_REMOTE_PATH)
+            check = transport.run(PROBE_REMOTE_PATH)
+            deployed = check.ok and check.stdout.lstrip().startswith("{")
+            if not deployed:
+                log.warning("deployed probe does not run on %s (foreign arch?); "
+                            "python fallback will be used", hostname)
+            return deployed
+        except TransportError as exc:
+            log.warning("probe deployment to %s failed: %s", hostname, exc)
+            return False
+
+    # deploy in parallel: boot cost is max(host), not sum(hosts)
+    hostnames = transports.hostnames
+    if not hostnames:
+        return {}
+    with concurrent.futures.ThreadPoolExecutor(
+        max_workers=min(16, len(hostnames))
+    ) as pool:
+        return dict(zip(hostnames, pool.map(_deploy_one, hostnames)))

@@ -61,7 +61,8 @@ METRICS_MAX_AGE_S = 120.0
 # stdout. Mirrors native/probe.cpp — change both together (schema v1).
 PYTHON_PROBE_SOURCE = r"""
 import glob, json, os, pwd, time
-out = {"v": 1, "chips": [], "procs": {}, "cpu": {}, "mem": {}, "metrics": {}}
+out = {"v": 1, "chips": [], "procs": {}, "cpu": {}, "mem": {}, "metrics": {},
+       "restricted": 0}
 devs = sorted(glob.glob("/dev/accel[0-9]*")) + sorted(glob.glob("/dev/vfio/[0-9]*"))
 dev_index = {os.path.realpath(d): i for i, d in enumerate(devs)}
 holders = {}
@@ -69,6 +70,9 @@ for pid in filter(str.isdigit, os.listdir("/proc")):
     fd_dir = "/proc/%s/fd" % pid
     try:
         fds = os.listdir(fd_dir)
+    except PermissionError:
+        out["restricted"] += 1
+        continue
     except OSError:
         continue
     for fd in fds:
@@ -113,7 +117,7 @@ try:
                   "avail_kb": info.get("MemAvailable", info.get("MemFree", 0))}
 except OSError:
     pass
-mdir = os.path.expanduser("~/.tpuhive/metrics")
+mdir = os.environ.get("TPUHIVE_METRICS_DIR") or os.path.expanduser("~/.tpuhive/metrics")
 now = time.time()
 try:
     names = sorted(os.listdir(mdir))
@@ -141,14 +145,23 @@ print(json.dumps(out, separators=(",", ":")))
 
 
 def probe_command() -> str:
-    """Shell command: run the native probe if installed, else the inline
-    Python fallback. The base64 wrapper survives any quoting the transport
-    applies (the script itself never reaches the remote shell verbatim)."""
+    """Shell command: run the native probe if installed — privileged via
+    passwordless sudo when available, because /proc/<pid>/fd of *other
+    users'* processes is unreadable without it and chip-ownership data is
+    exactly what the protection service needs (the probe reports how many
+    processes it could not inspect via ``restricted``). Falls back to the
+    inline Python probe when the binary is absent; the base64 wrapper
+    survives any quoting the transport applies."""
     encoded = base64.b64encode(PYTHON_PROBE_SOURCE.encode()).decode()
     fallback = (
         f'python3 -c "import base64 as b;exec(b.b64decode(\'{encoded}\'))"'
     )
-    return f"{PROBE_REMOTE_PATH} 2>/dev/null || {fallback}  # {PROBE_MARKER}"
+    sudo_env = f'TPUHIVE_METRICS_DIR="$HOME/.tpuhive/metrics"'
+    return (
+        f"sudo -n {sudo_env} {PROBE_REMOTE_PATH} 2>/dev/null "
+        f"|| {PROBE_REMOTE_PATH} 2>/dev/null "
+        f"|| {fallback}  # {PROBE_MARKER}"
+    )
 
 
 @dataclass
@@ -173,6 +186,9 @@ class ProbeSample:
     ncpu: int = 1
     mem_total_kb: int = 0
     mem_avail_kb: int = 0
+    #: processes whose /proc/<pid>/fd was unreadable (probe unprivileged);
+    #: >0 means chip-ownership data may be incomplete
+    restricted: int = 0
 
 
 def parse_probe_output(text: str) -> ProbeSample:
@@ -225,6 +241,7 @@ def _build_sample(doc: Dict[str, Any]) -> ProbeSample:
     mem = doc.get("mem") or {}
     sample.mem_total_kb = int(mem.get("total_kb", 0) or 0)
     sample.mem_avail_kb = int(mem.get("avail_kb", 0) or 0)
+    sample.restricted = int(doc.get("restricted", 0) or 0)
     return sample
 
 

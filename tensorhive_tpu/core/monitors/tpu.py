@@ -35,6 +35,7 @@ class TpuMonitor(Monitor):
         #: latest parsed samples, shared with CpuMonitor to avoid a second
         #: round-trip (the probe already carries cpu/mem counters)
         self.last_samples: Dict[str, ProbeSample] = {}
+        self._restricted_warned: set = set()
 
     def update(self, transports: "TransportManager", infra: "InfrastructureManager") -> None:
         samples = collect_probe_samples(transports, self._command)
@@ -43,6 +44,14 @@ class TpuMonitor(Monitor):
             if sample is None:
                 infra.mark_unreachable(hostname, self.key)
                 continue
+            if sample.restricted > 0 and hostname not in self._restricted_warned:
+                self._restricted_warned.add(hostname)
+                log.warning(
+                    "probe on %s runs unprivileged: %d processes were not "
+                    "inspectable — chip ownership may be incomplete; grant "
+                    "passwordless sudo for the probe to fix this", hostname,
+                    sample.restricted,
+                )
             infra.update_subtree(hostname, self.key, self._chip_subtree(hostname, sample))
 
     # ------------------------------------------------------------------

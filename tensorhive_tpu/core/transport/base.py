@@ -7,6 +7,7 @@ import concurrent.futures
 import dataclasses
 import logging
 import threading
+from shlex import quote as shlex_quote
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ...config import Config, HostConfig, get_config
@@ -66,6 +67,42 @@ class Transport:
             return self.run("uname", timeout=10).ok
         except TransportError:
             return False
+
+    def expand_remote_path(self, remote_path: str) -> str:
+        """Resolve ``$HOME``/``~`` in a remote path against the host's actual
+        home directory, so later uses can be safely shell-quoted (quoting a
+        path that still contains ``$HOME`` would create a literal '$HOME'
+        directory)."""
+        if "$HOME" in remote_path or remote_path.startswith("~"):
+            home = self.check_output('printf %s "$HOME"').strip()
+            if not home:
+                raise TransportError(f"[{self.hostname}] cannot resolve $HOME")
+            remote_path = remote_path.replace("$HOME", home)
+            if remote_path.startswith("~"):
+                remote_path = home + remote_path[1:]
+        return remote_path
+
+    def put_file(self, local_path: str, remote_path: str, mode: int = 0o755) -> None:
+        """Copy a local file onto the host. Default implementation streams
+        base64 chunks through ``run`` (works over any command channel);
+        backends with a real copy path (scp, cp) override it."""
+        import base64
+
+        with open(local_path, "rb") as fh:
+            data = fh.read()
+        encoded = base64.b64encode(data).decode()
+        quoted = shlex_quote(self.expand_remote_path(remote_path))
+        self.check_output(f"mkdir -p $(dirname {quoted}) && : > {quoted}.b64")
+        chunk_size = 64 * 1024  # keep each command line well under ARG_MAX
+        try:
+            for offset in range(0, len(encoded), chunk_size):
+                chunk = encoded[offset:offset + chunk_size]
+                self.check_output(f"printf %s {chunk} >> {quoted}.b64")
+            self.check_output(
+                f"base64 -d {quoted}.b64 > {quoted} && chmod {mode:o} {quoted}"
+            )
+        finally:
+            self.run(f"rm -f {quoted}.b64")
 
 
 _BACKENDS: Dict[str, Callable[..., Transport]] = {}

@@ -41,4 +41,14 @@ class LocalTransport(Transport):
         )
 
 
+    def put_file(self, local_path: str, remote_path: str, mode: int = 0o755) -> None:
+        import os
+        import shutil
+
+        expanded = os.path.expandvars(os.path.expanduser(remote_path))
+        os.makedirs(os.path.dirname(expanded) or ".", exist_ok=True)
+        shutil.copyfile(local_path, expanded)
+        os.chmod(expanded, mode)
+
+
 register_backend("local", LocalTransport)

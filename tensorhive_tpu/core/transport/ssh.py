@@ -30,10 +30,11 @@ class SshTransport(Transport):
         self._config = config
         self.timeout_s = config.ssh.timeout_s if config else 10.0
 
-    def _base_args(self) -> List[str]:
+    def _common_options(self) -> List[str]:
+        """Options shared by ssh and scp invocations (port excluded: ssh
+        spells it -p, scp spells it -P)."""
         cfg = self._config
         args = [
-            "ssh",
             "-o", "BatchMode=yes",
             "-o", "StrictHostKeyChecking=accept-new",
             "-o", f"ConnectTimeout={int(self.timeout_s)}",
@@ -41,7 +42,6 @@ class SshTransport(Transport):
             "-o", "ControlMaster=auto",
             "-o", "ControlPersist=60s",
             "-o", "ControlPath=~/.ssh/tpuhive-%r@%h:%p",
-            "-p", str(self.host.port),
         ]
         if cfg is not None:
             key_path = cfg.ssh_key_path
@@ -53,6 +53,9 @@ class SshTransport(Transport):
                     "-J", f"{proxy_user}@{cfg.ssh.proxy_host}:{cfg.ssh.proxy_port}"
                 ]
         return args
+
+    def _base_args(self) -> List[str]:
+        return ["ssh"] + self._common_options() + ["-p", str(self.host.port)]
 
     def run(self, command: str, timeout: Optional[float] = None) -> CommandResult:
         target = f"{self.user}@{self.host.address}" if self.user else self.host.address
@@ -84,6 +87,25 @@ class SshTransport(Transport):
             stdout=proc.stdout,
             stderr=proc.stderr,
         )
+
+
+    def put_file(self, local_path: str, remote_path: str, mode: int = 0o755) -> None:
+        """scp with the same multiplexed connection options as run()."""
+        target = f"{self.user}@{self.host.address}" if self.user else self.host.address
+        remote_path = self.expand_remote_path(remote_path)
+        self.check_output(f"mkdir -p $(dirname {shlex.quote(remote_path)})")
+        argv = ["scp"] + self._common_options() + ["-P", str(self.host.port),
+                local_path, f"{target}:{remote_path}"]
+        try:
+            proc = subprocess.run(argv, capture_output=True, text=True,
+                                  timeout=self.timeout_s * 6)
+        except (subprocess.TimeoutExpired, OSError) as exc:
+            raise TransportError(f"[{self.hostname}] scp failed: {exc}") from exc
+        if proc.returncode != 0:
+            raise TransportError(
+                f"[{self.hostname}] scp failed: {proc.stderr.strip()}"
+            )
+        self.check_output(f"chmod {mode:o} {shlex.quote(remote_path)}")
 
 
 _SSH_FAILURE_MARKERS = (

@@ -41,7 +41,9 @@ def cluster(config):
 
 @pytest.fixture()
 def transports(config, cluster):
-    return TransportManager(config)
+    manager = TransportManager(config)
+    yield manager
+    manager.close()
 
 
 # -- probe command / parser -------------------------------------------------
