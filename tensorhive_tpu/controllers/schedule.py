@@ -1,0 +1,52 @@
+"""Schedule controller (reference: tensorhive/controllers/schedule.py, 135
+LoC): RestrictionSchedule CRUD."""
+from __future__ import annotations
+
+from ..api.app import RequestContext, json_body, route
+from ..db.models.schedule import RestrictionSchedule
+
+
+_get_or_404 = RestrictionSchedule.get  # raises NotFoundError (→ 404) itself
+
+
+@route("/schedules", ["GET"], summary="List schedules", tag="schedules")
+def list_schedules(context: RequestContext):
+    return [s.as_dict() for s in RestrictionSchedule.all()]
+
+
+@route("/schedules/<int:schedule_id>", ["GET"], summary="Get one schedule", tag="schedules")
+def get_schedule(context: RequestContext, schedule_id: int):
+    return _get_or_404(schedule_id).as_dict()
+
+
+@route("/schedules", ["POST"], auth="admin", summary="Create a schedule", tag="schedules")
+def create_schedule(context: RequestContext):
+    data = json_body(context, "scheduleDays", "hourStart", "hourEnd")
+    schedule = RestrictionSchedule(
+        schedule_days=data["scheduleDays"],
+        hour_start=data["hourStart"],
+        hour_end=data["hourEnd"],
+    ).save()
+    return schedule.as_dict(), 201
+
+
+@route("/schedules/<int:schedule_id>", ["PUT"], auth="admin", summary="Update a schedule",
+       tag="schedules")
+def update_schedule(context: RequestContext, schedule_id: int):
+    schedule = _get_or_404(schedule_id)
+    data = context.json()
+    if "scheduleDays" in data:
+        schedule.schedule_days = data["scheduleDays"]
+    if "hourStart" in data:
+        schedule.hour_start = data["hourStart"]
+    if "hourEnd" in data:
+        schedule.hour_end = data["hourEnd"]
+    schedule.save()
+    return schedule.as_dict()
+
+
+@route("/schedules/<int:schedule_id>", ["DELETE"], auth="admin",
+       summary="Delete a schedule", tag="schedules")
+def delete_schedule(context: RequestContext, schedule_id: int):
+    _get_or_404(schedule_id).destroy()
+    return {"msg": "schedule deleted"}

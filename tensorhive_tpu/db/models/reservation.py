@@ -13,7 +13,7 @@ from __future__ import annotations
 from datetime import datetime, timedelta
 from typing import Any, Dict, Iterable, List, Optional
 
-from ...utils.exceptions import ValidationError
+from ...utils.exceptions import ConflictError, ValidationError
 from ...utils.timeutils import iso_utc, utcnow
 from ..orm import Column, Model
 
@@ -63,7 +63,7 @@ class Reservation(Model):
         if duration > self.MAX_DURATION:
             raise ValidationError(f"reservation must not exceed {self.MAX_DURATION}")
         if self.would_interfere():
-            raise ValidationError(
+            raise ConflictError(
                 "reservation would overlap an existing reservation for "
                 f"resource {self.resource_id}"
             )
@@ -109,17 +109,30 @@ class Reservation(Model):
 
     @classmethod
     def filter_by_uids_and_time_range(
-        cls, uids: Iterable[str], start: datetime, end: datetime
+        cls,
+        uids: Optional[Iterable[str]] = None,
+        start: Optional[datetime] = None,
+        end: Optional[datetime] = None,
     ) -> List["Reservation"]:
-        """Calendar read path (reference Reservation.py:133)."""
-        uids = list(uids)
-        if not uids:
-            return []
-        placeholders = ", ".join("?" * len(uids))
-        return cls.where(
-            f"resource_id IN ({placeholders}) AND start < ? AND end > ?",
-            [*uids, iso_utc(end), iso_utc(start)],
-        )
+        """Calendar read path (reference Reservation.py:133). Each filter is
+        optional: uids only, time range only, or both."""
+        clauses: List[str] = []
+        params: List[Any] = []
+        if uids is not None:
+            uids = list(uids)
+            if not uids:
+                return []
+            clauses.append(f"resource_id IN ({', '.join('?' * len(uids))})")
+            params.extend(uids)
+        if end is not None:
+            clauses.append("start < ?")
+            params.append(iso_utc(end))
+        if start is not None:
+            clauses.append("end > ?")
+            params.append(iso_utc(start))
+        if not clauses:
+            return cls.all()
+        return cls.where(" AND ".join(clauses), params)
 
     def is_active(self, at: Optional[datetime] = None) -> bool:
         at = at or utcnow()

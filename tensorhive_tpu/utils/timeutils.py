@@ -46,7 +46,10 @@ def parse_datetime(value: Union[str, datetime, None]) -> Optional[datetime]:
             return datetime.strptime(text, fmt)
         except ValueError:
             continue
-    raise ValueError(f"unparseable datetime: {value!r}")
+    # ValidationError (a ValueError subclass) so API inputs map to 422
+    from .exceptions import ValidationError
+
+    raise ValidationError(f"unparseable datetime: {value!r}")
 
 
 def iso_utc(dt: datetime) -> str:

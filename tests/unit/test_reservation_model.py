@@ -4,7 +4,7 @@ from datetime import timedelta
 import pytest
 
 from tensorhive_tpu.db.models import Reservation
-from tensorhive_tpu.utils.exceptions import ValidationError
+from tensorhive_tpu.utils.exceptions import ConflictError, ValidationError
 from tensorhive_tpu.utils.timeutils import utcnow
 
 from ..fixtures import make_reservation, make_resource, make_user
@@ -43,7 +43,7 @@ def test_end_before_start_rejected(db):
 def test_overlap_detection(db):
     user, resource = make_user(), make_resource()
     make_reservation(user, resource.uid, start_in_h=0, duration_h=2)
-    with pytest.raises(ValidationError):
+    with pytest.raises(ConflictError):
         make_reservation(user, resource.uid, start_in_h=1, duration_h=2)
     # touching intervals do not overlap (half-open)
     make_reservation(user, resource.uid, start_in_h=2, duration_h=1)
