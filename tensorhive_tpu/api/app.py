@@ -226,3 +226,14 @@ def json_body(context: RequestContext, *required: str) -> Dict[str, Any]:
     if missing:
         raise ValidationError(f"missing required fields: {', '.join(missing)}")
     return data
+
+
+def int_arg(context: RequestContext, name: str) -> Optional[int]:
+    """Optional integer query parameter; malformed values are 422, not 500."""
+    value = context.request.args.get(name)
+    if value is None:
+        return None
+    try:
+        return int(value)
+    except ValueError:
+        raise ValidationError(f"query parameter {name!r} must be an integer")

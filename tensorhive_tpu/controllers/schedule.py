@@ -1,12 +1,33 @@
 """Schedule controller (reference: tensorhive/controllers/schedule.py, 135
-LoC): RestrictionSchedule CRUD."""
+LoC): RestrictionSchedule CRUD. Editing or deleting a schedule changes the
+effective windows of every restriction it is attached to, so both paths
+re-verify affected users' reservations (reference schedule.py:97-98, :125)."""
 from __future__ import annotations
 
 from ..api.app import RequestContext, json_body, route
+from ..core import verifier
 from ..db.models.schedule import RestrictionSchedule
+from ..db.models.user import User
 
 
 _get_or_404 = RestrictionSchedule.get  # raises NotFoundError (→ 404) itself
+
+
+def _reverify_attached(schedule: RestrictionSchedule) -> None:
+    users = {}
+    needs_all = False
+    for restriction in schedule.restrictions:
+        if restriction.is_global:
+            needs_all = True
+            break
+        for user in restriction.users:
+            users.setdefault(user.id, user)
+        for group in restriction.groups:
+            for user in group.users:
+                users.setdefault(user.id, user)
+    affected = User.all() if needs_all else users.values()
+    for user in affected:
+        verifier.reverify_user(user)
 
 
 @route("/schedules", ["GET"], summary="List schedules", tag="schedules")
@@ -42,11 +63,23 @@ def update_schedule(context: RequestContext, schedule_id: int):
     if "hourEnd" in data:
         schedule.hour_end = data["hourEnd"]
     schedule.save()
+    _reverify_attached(schedule)
     return schedule.as_dict()
 
 
 @route("/schedules/<int:schedule_id>", ["DELETE"], auth="admin",
        summary="Delete a schedule", tag="schedules")
 def delete_schedule(context: RequestContext, schedule_id: int):
-    _get_or_404(schedule_id).destroy()
+    schedule = _get_or_404(schedule_id)
+    # collect the attached restrictions BEFORE the row (and its links) go away
+    attached = schedule.restrictions
+    schedule.destroy()
+    for restriction in attached:
+        users = {u.id: u for u in restriction.users}
+        for group in restriction.groups:
+            for user in group.users:
+                users.setdefault(user.id, user)
+        affected = User.all() if restriction.is_global else users.values()
+        for user in affected:
+            verifier.reverify_user(user)
     return {"msg": "schedule deleted"}

@@ -80,8 +80,12 @@ def instantiate_services_from_config(config: Config) -> List[Service]:
     services: List[Service] = []
     if config.monitoring.enabled:
         services.append(MonitoringService(config=config))
-    # protection / usage-logging / job-scheduling clauses are added as each
-    # service module lands (SURVEY.md §7 stages 6, 7, 9)
+    if config.job_scheduling.enabled:
+        from ..services.job_scheduling import JobSchedulingService
+
+        services.append(JobSchedulingService(config=config))
+    # protection / usage-logging clauses are added as each service module
+    # lands (SURVEY.md §7 stages 6, 9)
     return services
 
 

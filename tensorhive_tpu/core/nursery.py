@@ -243,3 +243,21 @@ class OpsFactory:
     @property
     def hostnames(self) -> List[str]:
         return self.manager.hostnames
+
+
+# ---------------------------------------------------------------------------
+_factory: Optional[OpsFactory] = None
+
+
+def get_ops_factory() -> OpsFactory:
+    """Process-wide factory used by controllers/services; tests swap in a
+    FakeOpsFactory via :func:`set_ops_factory`."""
+    global _factory
+    if _factory is None:
+        _factory = OpsFactory()
+    return _factory
+
+
+def set_ops_factory(factory: Optional[OpsFactory]) -> None:
+    global _factory
+    _factory = factory

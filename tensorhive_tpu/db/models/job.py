@@ -130,11 +130,16 @@ class Job(Model):
 
     @classmethod
     def find_scheduled_to_start(cls, at: Optional[datetime] = None) -> List["Job"]:
-        """Timed jobs due to start (reference JobSchedulingService.py:54-61)."""
+        """Timed jobs due to start — and not already past their stop time
+        (reference can_execute_now requires start_at < now < stop_at,
+        JobSchedulingService.py:54-61); an expired window must not trigger a
+        late spawn/kill cycle after downtime."""
         at = at or utcnow()
         return cls.where(
-            "start_at IS NOT NULL AND start_at <= ? AND _status IN (?, ?)",
-            [iso_utc(at), JobStatus.not_running.value, JobStatus.pending.value],
+            "start_at IS NOT NULL AND start_at <= ? "
+            "AND (stop_at IS NULL OR stop_at > ?) AND _status IN (?, ?)",
+            [iso_utc(at), iso_utc(at),
+             JobStatus.not_running.value, JobStatus.pending.value],
         )
 
     @classmethod

@@ -1,0 +1,169 @@
+"""Job/task execution API tests over the fake cluster.
+
+Reference gap closed: the reference never tests spawn/terminate/synchronize
+against remote state (task_nursery.py:34 "TODO Write tests", SURVEY.md §4) —
+here the FakeOpsFactory lets the full business path run in-process.
+"""
+import pytest
+from werkzeug.test import Client
+
+from tensorhive_tpu.api.server import ApiApp
+from tensorhive_tpu.controllers import task as task_controller
+from tensorhive_tpu.core.managers.manager import TpuHiveManager, set_manager
+from tensorhive_tpu.core.nursery import set_ops_factory
+from tensorhive_tpu.core.transport.fake import FakeCluster, FakeOpsFactory
+from tensorhive_tpu.db.models.task import Task, TaskStatus
+from tests.fixtures import make_user
+
+
+@pytest.fixture()
+def cluster(db, config):
+    cluster = FakeCluster()
+    cluster.add_host("vm-0", chips=4)
+    cluster.add_host("vm-1", chips=4)
+    set_ops_factory(FakeOpsFactory(cluster))
+    yield cluster
+    set_ops_factory(None)
+
+
+@pytest.fixture()
+def api(db, config, cluster):
+    config.api.secret_key = "test-secret"
+    manager = TpuHiveManager(config=config, services=[])
+    set_manager(manager)
+    yield Client(ApiApp(url_prefix="api"))
+    set_manager(None)
+
+
+@pytest.fixture()
+def owner(db):
+    return make_user(username="alice", password="SuperSecret42")
+
+
+@pytest.fixture()
+def headers(api, owner):
+    tokens = api.post("/api/user/login", json={
+        "username": "alice", "password": "SuperSecret42",
+    }).get_json()
+    return {"Authorization": f"Bearer {tokens['accessToken']}"}
+
+
+def _create_job_with_task(api, headers, hostname="vm-0", chips=(0, 1)):
+    job = api.post("/api/jobs", json={"name": "train"}, headers=headers).get_json()
+    task = api.post("/api/tasks", json={
+        "jobId": job["id"], "hostname": hostname, "command": "python train.py",
+        "chips": list(chips),
+        "envVariables": [{"name": "JAX_PLATFORMS", "value": "tpu"}],
+        "parameters": [{"name": "--steps", "value": "100"}],
+    }, headers=headers).get_json()
+    return job, task
+
+
+def test_job_task_crud_and_full_command(api, headers, cluster):
+    job, task = _create_job_with_task(api, headers)
+    fetched = Task.get(task["id"])
+    assert fetched.full_command == (
+        "JAX_PLATFORMS=tpu TPU_VISIBLE_CHIPS=0,1 python train.py --steps=100"
+    )
+    job_payload = api.get(f"/api/jobs/{job['id']}", headers=headers).get_json()
+    assert len(job_payload["tasks"]) == 1
+    assert job_payload["status"] == "not_running"
+
+
+def test_execute_and_stop_job(api, headers, cluster):
+    job, task = _create_job_with_task(api, headers)
+    executed = api.post(f"/api/jobs/{job['id']}/execute", json={}, headers=headers).get_json()
+    assert executed["status"] == "running"
+    host = cluster.host("vm-0")
+    assert len(host.processes) == 1
+    proc = next(iter(host.processes.values()))
+    assert proc.user == "alice"  # spawned AS the job owner
+    assert "TPU_VISIBLE_CHIPS=0,1" in proc.command
+
+    # double-execute → conflict surfaces per-task, job stays running
+    second = api.post(f"/api/tasks/{task['id']}/spawn", json={}, headers=headers)
+    assert second.status_code == 409
+
+    log_payload = api.get(f"/api/tasks/{task['id']}/log", headers=headers).get_json()
+    assert "started" in log_payload["log"]
+
+    stopped = api.post(f"/api/jobs/{job['id']}/stop", json={"gracefully": True},
+                       headers=headers).get_json()
+    assert stopped["status"] == "terminated"
+    assert proc.received_signals == ["INT"]
+
+
+def test_terminate_escalation_modes(api, headers, cluster):
+    job, task = _create_job_with_task(api, headers)
+    api.post(f"/api/jobs/{job['id']}/execute", json={}, headers=headers)
+    proc = next(iter(cluster.host("vm-0").processes.values()))
+    proc.dies_on = ("KILL",)  # ignores INT and TERM
+
+    api.post(f"/api/tasks/{task['id']}/terminate", json={"gracefully": True}, headers=headers)
+    assert api.get(f"/api/tasks/{task['id']}", headers=headers).get_json()["status"] == "running"
+    api.post(f"/api/tasks/{task['id']}/terminate", json={"gracefully": None}, headers=headers)
+    assert api.get(f"/api/tasks/{task['id']}", headers=headers).get_json()["status"] == "running"
+    killed = api.post(f"/api/tasks/{task['id']}/terminate", json={"gracefully": False},
+                      headers=headers)
+    assert killed.get_json()["status"] == "terminated"
+    assert proc.received_signals == ["INT", "TERM", "KILL"]
+
+
+def test_synchronize_detects_dead_process(api, headers, cluster):
+    job, task = _create_job_with_task(api, headers)
+    api.post(f"/api/jobs/{job['id']}/execute", json={}, headers=headers)
+    pid = next(iter(cluster.host("vm-0").processes))
+    cluster.kill_process("vm-0", pid)  # dies outside the framework's control
+    payload = api.get(f"/api/tasks/{task['id']}", headers=headers).get_json()
+    assert payload["status"] == "terminated"
+    assert payload["pid"] is None
+    job_payload = api.get(f"/api/jobs/{job['id']}", headers=headers).get_json()
+    assert job_payload["status"] == "terminated"
+
+
+def test_synchronize_marks_unreachable_host(api, headers, cluster):
+    job, task = _create_job_with_task(api, headers)
+    api.post(f"/api/jobs/{job['id']}/execute", json={}, headers=headers)
+    cluster.host("vm-0").reachable = False
+    payload = api.get(f"/api/tasks/{task['id']}", headers=headers).get_json()
+    assert payload["status"] == "unsynchronized"
+    # host comes back with the process still alive → re-adopted
+    cluster.host("vm-0").reachable = True
+    payload = api.get(f"/api/tasks/{task['id']}", headers=headers).get_json()
+    assert payload["status"] == "running"
+
+
+def test_task_access_control(api, headers, cluster, owner):
+    job, task = _create_job_with_task(api, headers)
+    make_user(username="mallory", password="SuperSecret42")
+    tokens = api.post("/api/user/login", json={
+        "username": "mallory", "password": "SuperSecret42",
+    }).get_json()
+    mallory = {"Authorization": f"Bearer {tokens['accessToken']}"}
+    assert api.post(f"/api/jobs/{job['id']}/execute", json={}, headers=mallory).status_code == 403
+    assert api.post(f"/api/tasks/{task['id']}/spawn", json={}, headers=mallory).status_code == 403
+    assert api.delete(f"/api/jobs/{job['id']}", headers=mallory).status_code == 403
+
+
+def test_running_job_cannot_be_deleted(api, headers, cluster):
+    job, task = _create_job_with_task(api, headers)
+    api.post(f"/api/jobs/{job['id']}/execute", json={}, headers=headers)
+    assert api.delete(f"/api/jobs/{job['id']}", headers=headers).status_code == 409
+    api.post(f"/api/jobs/{job['id']}/stop", json={"gracefully": False}, headers=headers)
+    assert api.delete(f"/api/jobs/{job['id']}", headers=headers).status_code == 200
+
+
+def test_spawn_failure_surfaces(api, headers, cluster):
+    job, task = _create_job_with_task(api, headers)
+    cluster.spawn_failures["vm-0"] = "disk full"
+    response = api.post(f"/api/tasks/{task['id']}/spawn", json={}, headers=headers)
+    assert response.status_code == 409
+    assert "disk full" in response.get_json()["msg"]
+
+
+def test_enqueue_dequeue(api, headers, cluster):
+    job, _task = _create_job_with_task(api, headers)
+    queued = api.put(f"/api/jobs/{job['id']}/enqueue", headers=headers).get_json()
+    assert queued["isQueued"] is True and queued["status"] == "pending"
+    dequeued = api.put(f"/api/jobs/{job['id']}/dequeue", headers=headers).get_json()
+    assert dequeued["isQueued"] is False and dequeued["status"] == "not_running"

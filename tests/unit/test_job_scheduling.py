@@ -1,0 +1,197 @@
+"""JobSchedulingService tests over the fake cluster.
+
+Reference has no scheduler-service tests (SURVEY.md §4); these drive every
+tick behavior: timed starts (with reservation gating), queue draining via
+GreedyScheduler, stop escalation for stubborn jobs, and preemption of
+queue-launched jobs.
+"""
+from datetime import timedelta
+
+import pytest
+
+from tensorhive_tpu.core.managers.infrastructure import InfrastructureManager, chip_uid
+from tensorhive_tpu.core.nursery import set_ops_factory
+from tensorhive_tpu.core.scheduling import GreedyScheduler
+from tensorhive_tpu.core.services.job_scheduling import JobSchedulingService
+from tensorhive_tpu.core.transport.fake import FakeCluster, FakeOpsFactory
+from tensorhive_tpu.db.models.job import Job, JobStatus
+from tensorhive_tpu.db.models.task import TaskStatus
+from tensorhive_tpu.utils.timeutils import utcnow
+from tests.fixtures import make_job, make_reservation, make_resource, make_task, make_user
+
+
+@pytest.fixture()
+def cluster(db, config):
+    cluster = FakeCluster()
+    cluster.add_host("vm-0", chips=4)
+    set_ops_factory(FakeOpsFactory(cluster))
+    yield cluster
+    set_ops_factory(None)
+
+
+@pytest.fixture()
+def infra(cluster):
+    return InfrastructureManager(["vm-0"])
+
+
+@pytest.fixture()
+def service(config, infra):
+    config.job_scheduling.interval_s = 0.01
+    config.job_scheduling.stop_attempts_after_mins = 5.0
+    service = JobSchedulingService(config=config)
+    service.inject(infra, None)
+    return service
+
+
+@pytest.fixture()
+def owner(db):
+    return make_user(username="alice", password="SuperSecret42")
+
+
+def _chip_resources(db, count=2):
+    return [make_resource(hostname="vm-0", index=i) for i in range(count)]
+
+
+def test_timed_start_executes_due_job(service, owner, cluster, db):
+    job = make_job(owner, start_at=utcnow() - timedelta(minutes=1))
+    make_task(job, hostname="vm-0", chips=[0])
+    service.do_run()
+    assert Job.get(job.id).status is JobStatus.running
+    assert len(cluster.host("vm-0").processes) == 1
+
+
+def test_timed_start_deferred_by_foreign_reservation(service, owner, cluster, db):
+    _chip_resources(db)
+    stranger = make_user(username="strngr", password="SuperSecret42")
+    make_reservation(stranger, chip_uid("vm-0", 0), start_in_h=-0.5, duration_h=2)
+    job = make_job(owner, start_at=utcnow() - timedelta(minutes=1))
+    make_task(job, hostname="vm-0", chips=[0])
+    service.do_run()
+    assert Job.get(job.id).status is JobStatus.not_running
+    assert cluster.host("vm-0").processes == {}
+
+
+def test_timed_start_allowed_under_own_reservation(service, owner, cluster, db):
+    _chip_resources(db)
+    make_reservation(owner, chip_uid("vm-0", 0), start_in_h=-0.5, duration_h=2)
+    job = make_job(owner, start_at=utcnow() - timedelta(minutes=1))
+    make_task(job, hostname="vm-0", chips=[0])
+    service.do_run()
+    assert Job.get(job.id).status is JobStatus.running
+
+
+def test_queue_runs_job_on_free_chips(service, owner, cluster, db):
+    job = make_job(owner)
+    make_task(job, hostname="vm-0", chips=[1])
+    job.enqueue()
+    service.do_run()
+    assert Job.get(job.id).status is JobStatus.running
+
+
+def test_queue_respects_upcoming_reservation(service, owner, cluster, db):
+    _chip_resources(db)
+    stranger = make_user(username="strngr2", password="SuperSecret42")
+    # reservation starts in 10 min < required 30 min free window
+    make_reservation(stranger, chip_uid("vm-0", 1), start_in_h=10 / 60, duration_h=1)
+    job = make_job(owner)
+    make_task(job, hostname="vm-0", chips=[1])
+    job.enqueue()
+    service.do_run()
+    assert Job.get(job.id).status is JobStatus.pending
+    assert cluster.host("vm-0").processes == {}
+
+
+def test_greedy_scheduler_no_double_booking(db, owner):
+    _chip_resources(db)
+    job_a = make_job(owner)
+    make_task(job_a, hostname="vm-0", chips=[0])
+    job_b = make_job(owner)
+    make_task(job_b, hostname="vm-0", chips=[0])  # same chip
+    job_c = make_job(owner)
+    make_task(job_c, hostname="vm-0", chips=[1])
+    for job in (job_a, job_b, job_c):
+        job.enqueue()
+    chosen = GreedyScheduler().schedule_jobs(Job.get_job_queue(), 30.0)
+    assert [j.id for j in chosen] == [job_a.id, job_c.id]
+
+
+def test_queue_runs_inside_owners_own_reservation(service, owner, cluster, db):
+    """Reference GreedyScheduler treats the owner's own reservation as free
+    (scheduling.py:48-56): a user's queued job runs in their reserved
+    window."""
+    _chip_resources(db)
+    make_reservation(owner, chip_uid("vm-0", 1), start_in_h=-0.5, duration_h=2)
+    job = make_job(owner)
+    make_task(job, hostname="vm-0", chips=[1])
+    job.enqueue()
+    service.do_run()
+    assert Job.get(job.id).status is JobStatus.running
+
+
+def test_expired_timed_window_does_not_spawn(service, owner, cluster, db):
+    """A job whose start..stop window fully passed during downtime must not
+    be spawned late (guard in Job.find_scheduled_to_start)."""
+    job = make_job(owner, start_at=utcnow() - timedelta(hours=3),
+                   stop_at=utcnow() - timedelta(hours=1))
+    make_task(job, hostname="vm-0", chips=[0])
+    service.do_run()
+    assert Job.get(job.id).status is JobStatus.not_running
+    assert cluster.host("vm-0").processes == {}
+
+
+def test_timed_stop_and_stubborn_escalation(service, owner, cluster, db):
+    job = make_job(owner, start_at=utcnow() - timedelta(hours=1),
+                   stop_at=utcnow() - timedelta(minutes=1))
+    task = make_task(job, hostname="vm-0", chips=[2])
+    from tensorhive_tpu.controllers.job import business_execute
+
+    business_execute(job.id)
+    proc = next(iter(cluster.host("vm-0").processes.values()))
+    proc.dies_on = ("KILL",)  # ignores graceful signals
+
+    now = utcnow()
+    service.do_run()  # graceful attempt
+    assert Job.get(job.id).status is JobStatus.running
+    assert proc.received_signals == ["INT"]
+    assert job.id not in service.stubborn_job_ids
+
+    # simulate the give-up window passing: first attempt recorded long ago
+    service._stop_first_attempt[job.id] = now - timedelta(minutes=10)
+    service.do_run()
+    assert job.id in service.stubborn_job_ids
+    service.do_run()  # escalated attempt
+    assert Job.get(job.id).status is JobStatus.terminated
+    assert "KILL" in proc.received_signals
+    assert job.id not in service.stubborn_job_ids
+
+
+def test_preemption_of_queued_job_by_reservation(service, owner, cluster, db):
+    _chip_resources(db)
+    job = make_job(owner)
+    make_task(job, hostname="vm-0", chips=[0])
+    job.enqueue()
+    service.do_run()
+    assert Job.get(job.id).status is JobStatus.running
+
+    stranger = make_user(username="strngr3", password="SuperSecret42")
+    make_reservation(stranger, chip_uid("vm-0", 0), start_in_h=10 / 60, duration_h=1)
+    service.do_run()
+    assert Job.get(job.id).status is JobStatus.terminated
+
+
+def test_preemption_by_foreign_process(service, owner, cluster, infra, db):
+    job = make_job(owner)
+    make_task(job, hostname="vm-0", chips=[3])
+    job.enqueue()
+    service.do_run()
+    assert Job.get(job.id).status is JobStatus.running
+
+    # a foreign process appears on the job's chip in live telemetry
+    uid = chip_uid("vm-0", 3)
+    infra.update_subtree("vm-0", "TPU", {
+        uid: {"uid": uid, "index": 3, "processes": [
+            {"pid": 9999, "user": "intruder", "command": "python mine.py"},
+        ]},
+    })
+    service.do_run()
+    assert Job.get(job.id).status is JobStatus.terminated
