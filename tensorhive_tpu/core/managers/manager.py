@@ -80,12 +80,18 @@ def instantiate_services_from_config(config: Config) -> List[Service]:
     services: List[Service] = []
     if config.monitoring.enabled:
         services.append(MonitoringService(config=config))
+    if config.protection.enabled:
+        from ..services.protection import ProtectionService
+
+        services.append(ProtectionService(config=config))
+    if config.usage_logging.enabled:
+        from ..services.usage_logging import UsageLoggingService
+
+        services.append(UsageLoggingService(config=config))
     if config.job_scheduling.enabled:
         from ..services.job_scheduling import JobSchedulingService
 
         services.append(JobSchedulingService(config=config))
-    # protection / usage-logging clauses are added as each service module
-    # lands (SURVEY.md §7 stages 6, 9)
     return services
 
 

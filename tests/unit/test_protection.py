@@ -1,0 +1,200 @@
+"""ProtectionService + violation handler tests (reference ships none —
+SURVEY.md §4 lists violation handlers among the untested components)."""
+from unittest.mock import MagicMock, patch
+
+import pytest
+
+from tensorhive_tpu.core.handlers.base import Violation
+from tensorhive_tpu.core.handlers.email import EmailSendingBehaviour
+from tensorhive_tpu.core.handlers.kill import ProcessKillingBehaviour
+from tensorhive_tpu.core.handlers.message import MessageSendingBehaviour
+from tensorhive_tpu.core.managers.infrastructure import InfrastructureManager, chip_uid
+from tensorhive_tpu.core.mailer import Mailer, MessageBodyTemplater
+from tensorhive_tpu.core.nursery import set_ops_factory
+from tensorhive_tpu.core.services.protection import ProtectionService, default_handlers
+from tensorhive_tpu.core.transport.fake import FakeCluster, FakeOpsFactory
+from tests.fixtures import make_reservation, make_resource, make_user
+
+
+@pytest.fixture()
+def cluster(db, config):
+    cluster = FakeCluster()
+    cluster.add_host("vm-0", chips=4)
+    factory = FakeOpsFactory(cluster)
+    set_ops_factory(factory)
+    yield cluster
+    set_ops_factory(None)
+
+
+@pytest.fixture()
+def infra(cluster):
+    infra = InfrastructureManager(["vm-0"])
+
+    def refresh():
+        chips = {}
+        host = cluster.host("vm-0")
+        for index, chip in host.chips.items():
+            uid = chip_uid("vm-0", index)
+            processes = [
+                {"pid": pid, "user": proc.user, "command": proc.command}
+                for pid, proc in host.processes.items()
+                if proc.alive and index in proc.chip_ids
+            ]
+            chips[uid] = {"uid": uid, "index": index, "processes": processes}
+        infra.update_subtree("vm-0", "TPU", chips)
+
+    infra.refresh = refresh
+    refresh()
+    return infra
+
+
+def _service(config, infra, handlers, level=1):
+    config.protection.level = level
+    service = ProtectionService(config=config, handlers=handlers)
+    service.inject(infra, None)
+    return service
+
+
+def test_detects_intruder_on_reserved_chip(config, cluster, infra, db):
+    owner = make_user(username="alice")
+    make_resource(hostname="vm-0", index=0)
+    make_reservation(owner, chip_uid("vm-0", 0), start_in_h=-0.5, duration_h=2)
+    cluster.start_process("vm-0", user="mallory", command="python mine.py", chip_ids=[0])
+    infra.refresh()
+
+    recorded = []
+
+    from tensorhive_tpu.core.handlers.base import ProtectionHandler
+
+    class Recorder(ProtectionHandler):
+        def trigger_action(self, violation):
+            recorded.append(violation)
+
+    service = _service(config, infra, [Recorder()])
+    service.do_run()
+    assert len(recorded) == 1
+    violation = recorded[0]
+    assert violation.intruder_username == "mallory"
+    assert violation.owner_usernames == ["alice"]
+    assert violation.chip_uids == [chip_uid("vm-0", 0)]
+    assert violation.pids_by_host["vm-0"]
+
+
+def test_owner_processes_are_not_violations(config, cluster, infra, db):
+    owner = make_user(username="alice")
+    make_resource(hostname="vm-0", index=0)
+    make_reservation(owner, chip_uid("vm-0", 0), start_in_h=-0.5, duration_h=2)
+    cluster.start_process("vm-0", user="alice", command="python train.py", chip_ids=[0])
+    infra.refresh()
+    service = _service(config, infra, [])
+    assert service.find_violations() == {}
+
+
+def test_strict_mode_flags_unreserved_use(config, cluster, infra, db):
+    cluster.start_process("vm-0", user="bob", command="python x.py", chip_ids=[1])
+    infra.refresh()
+    lax = _service(config, infra, [], level=1)
+    assert lax.find_violations() == {}
+    strict = _service(config, infra, [], level=2)
+    violations = strict.find_violations()
+    assert violations["bob"].unreserved is True
+    assert violations["bob"].owner_usernames == []
+
+
+def test_pty_warning_reaches_intruder_ttys(config, cluster, infra, db):
+    owner = make_user(username="alice")
+    make_resource(hostname="vm-0", index=0)
+    make_reservation(owner, chip_uid("vm-0", 0), start_in_h=-0.5, duration_h=2)
+    cluster.start_process("vm-0", user="mallory", command="python mine.py", chip_ids=[0])
+    host = cluster.host("vm-0")
+    host.ptys = [("mallory", "pts/3"), ("alice", "pts/1"), ("mallory", "pts/7")]
+    infra.refresh()
+
+    service = _service(config, infra, [MessageSendingBehaviour()])
+    service.do_run()
+    assert set(host.pty_messages) == {"pts/3", "pts/7"}  # only the intruder's
+    assert "alice" in host.pty_messages["pts/3"][0]
+    assert "reservation" in host.pty_messages["pts/3"][0]
+
+
+def test_kill_handler_signals_intruder_pids(config, cluster, infra, db):
+    owner = make_user(username="alice")
+    make_resource(hostname="vm-0", index=0)
+    make_reservation(owner, chip_uid("vm-0", 0), start_in_h=-0.5, duration_h=2)
+    proc = cluster.start_process("vm-0", user="mallory", command="python mine.py",
+                                 chip_ids=[0])
+    infra.refresh()
+    service = _service(config, infra, [ProcessKillingBehaviour(sudo=False)])
+    service.do_run()
+    assert not proc.alive
+    # fake enforces unix permissions: intruder's own account could kill it
+    assert proc.received_signals == ["9"]
+
+
+def test_sudo_kill_handler(config, cluster, infra, db):
+    owner = make_user(username="alice")
+    make_resource(hostname="vm-0", index=0)
+    make_reservation(owner, chip_uid("vm-0", 0), start_in_h=-0.5, duration_h=2)
+    proc = cluster.start_process("vm-0", user="mallory", command="python mine.py",
+                                 chip_ids=[0])
+    infra.refresh()
+    service = _service(config, infra, [ProcessKillingBehaviour(sudo=True)])
+    service.do_run()
+    assert not proc.alive
+
+
+def test_email_handler_rate_limits(config, db):
+    make_user(username="mallory")  # has an account with an email
+    config.mailbot.notify_intruder = True
+    config.mailbot.notify_admin = True
+    config.mailbot.admin_email = "admin@example.com"
+    config.mailbot.smtp_server = "smtp.example.com"
+
+    violation = Violation(
+        intruder_username="mallory",
+        chip_uids=[chip_uid("vm-0", 0)],
+        owner_usernames=["alice"],
+        pids_by_host={"vm-0": [4242]},
+    )
+    with patch("tensorhive_tpu.core.mailer.smtplib.SMTP") as smtp:
+        handler = EmailSendingBehaviour(config.mailbot)
+        handler.trigger_action(violation)
+        sendmail = smtp.return_value.sendmail
+        assert sendmail.call_count == 2  # intruder + admin
+        recipients = [call[0][1] for call in sendmail.call_args_list]
+        assert ["mallory@example.com"] in recipients or any(
+            "mallory" in r[0] for r in recipients
+        )
+        import email as email_parser
+
+        parsed = email_parser.message_from_string(sendmail.call_args_list[0][0][2])
+        body = parsed.get_payload(0).get_payload(decode=True).decode()
+        assert "mallory" in body and "4242" in body and "alice" in body
+        # second trigger inside the rate window sends nothing
+        handler.trigger_action(violation)
+        assert sendmail.call_count == 2
+
+
+def test_email_handler_survives_smtp_failure(config, db):
+    make_user(username="mallory")
+    config.mailbot.notify_intruder = True
+    config.mailbot.smtp_server = "smtp.example.com"
+    violation = Violation(intruder_username="mallory", pids_by_host={"vm-0": [1]})
+    with patch("tensorhive_tpu.core.mailer.smtplib.SMTP", side_effect=OSError("down")):
+        EmailSendingBehaviour(config.mailbot).trigger_action(violation)  # no raise
+
+
+def test_default_handlers_respect_config(config):
+    config.protection.notify_on_pty = True
+    config.protection.notify_via_email = False
+    config.protection.kill_mode = 2
+    handlers = default_handlers(config)
+    kinds = [type(h).__name__ for h in handlers]
+    assert kinds == ["MessageSendingBehaviour", "ProcessKillingBehaviour"]
+    assert handlers[1].sudo is True
+
+
+def test_templater():
+    body = MessageBodyTemplater("hi {name}, chips {chips}").fill_in(
+        {"name": "bob", "chips": "a,b"})
+    assert body == "hi bob, chips a,b"

@@ -1,0 +1,92 @@
+"""UsageLoggingService tests (reference ships none for this service)."""
+import json
+
+import pytest
+
+from tensorhive_tpu.core.managers.infrastructure import InfrastructureManager, chip_uid
+from tensorhive_tpu.core.services.usage_logging import HIDE, KEEP, REMOVE, UsageLoggingService
+from tensorhive_tpu.db.models.reservation import Reservation
+from tests.fixtures import make_reservation, make_resource, make_user
+
+
+@pytest.fixture()
+def infra(db):
+    infra = InfrastructureManager(["vm-0"])
+    uid = chip_uid("vm-0", 0)
+    infra.update_subtree("vm-0", "TPU", {
+        uid: {"uid": uid, "index": 0, "duty_cycle_pct": 80.0,
+              "hbm_util_pct": 40.0, "processes": []},
+    })
+    return infra
+
+
+def _service(config, infra, action=HIDE):
+    config.usage_logging.log_cleanup_action = action
+    service = UsageLoggingService(config=config)
+    service.inject(infra, None)
+    return service
+
+
+def test_samples_active_reservation(config, infra, db):
+    user = make_user()
+    make_resource(hostname="vm-0", index=0)
+    reservation = make_reservation(user, chip_uid("vm-0", 0), start_in_h=-0.5, duration_h=2)
+    service = _service(config, infra)
+    service.do_run()
+    service.do_run()
+    path = service._path(reservation.id)
+    samples = [json.loads(line) for line in path.read_text().splitlines()]
+    assert len(samples) == 2
+    assert samples[0]["duty_cycle_pct"] == 80.0
+
+
+def test_expired_reservation_gets_averages_and_hidden_log(config, infra, db):
+    user = make_user()
+    make_resource(hostname="vm-0", index=0)
+    reservation = make_reservation(user, chip_uid("vm-0", 0), start_in_h=-3, duration_h=1)
+    service = _service(config, infra, action=HIDE)
+    # seed samples as if logged during the (now past) reservation
+    service.log_dir.mkdir(parents=True, exist_ok=True)
+    service._append_sample(reservation.id, {"duty_cycle_pct": 60.0, "hbm_util_pct": 30.0})
+    service._append_sample(reservation.id, {"duty_cycle_pct": 80.0, "hbm_util_pct": 50.0})
+    service.do_run()
+    fetched = Reservation.get(reservation.id)
+    assert fetched.duty_cycle_avg == 70.0
+    assert fetched.hbm_util_avg == 40.0
+    assert not service._path(reservation.id).exists()
+    assert (service.log_dir / f".{reservation.id}.jsonl").exists()
+
+
+def test_cleanup_remove_and_keep(config, infra, db):
+    user = make_user()
+    make_resource(hostname="vm-0", index=0)
+    r1 = make_reservation(user, chip_uid("vm-0", 0), start_in_h=-3, duration_h=1)
+    service = _service(config, infra, action=REMOVE)
+    service.log_dir.mkdir(parents=True, exist_ok=True)
+    service._append_sample(r1.id, {"duty_cycle_pct": 10.0, "hbm_util_pct": 5.0})
+    service.do_run()
+    assert not service._path(r1.id).exists()
+    assert Reservation.get(r1.id).duty_cycle_avg == 10.0
+
+    r2 = make_reservation(user, chip_uid("vm-0", 0), start_in_h=-6, duration_h=1)
+    keeper = _service(config, infra, action=KEEP)
+    keeper._append_sample(r2.id, {"duty_cycle_pct": 20.0, "hbm_util_pct": 10.0})
+    keeper.do_run()
+    done = keeper.log_dir / f"{r2.id}.done.jsonl"
+    assert done.exists()  # kept, marked accounted
+    assert Reservation.get(r2.id).duty_cycle_avg == 20.0
+    # never re-processed: even with all-None samples the marker prevents churn
+    r3 = make_reservation(user, chip_uid("vm-0", 0), start_in_h=-9, duration_h=1)
+    keeper._append_sample(r3.id, {"duty_cycle_pct": None, "hbm_util_pct": None})
+    keeper.do_run()
+    assert (keeper.log_dir / f"{r3.id}.done.jsonl").exists()
+    assert Reservation.get(r3.id).duty_cycle_avg is None
+
+
+def test_orphan_log_is_removed(config, infra, db):
+    service = _service(config, infra)
+    service.log_dir.mkdir(parents=True, exist_ok=True)
+    orphan = service.log_dir / "99999.jsonl"
+    orphan.write_text('{"duty_cycle_pct": 1.0}\n')
+    service.do_run()
+    assert not orphan.exists()
