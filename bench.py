@@ -1,0 +1,88 @@
+"""Benchmark: flagship transformer steps/sec/chip + telemetry poll p50.
+
+Prints exactly ONE JSON line on stdout (driver contract); all diagnostics go
+to stderr. Runs on whatever accelerator jax exposes (the driver provides one
+real TPU chip; BASELINE.md records that the reference publishes no training
+numbers, so ``vs_baseline`` is 1.0 by definition in round 1 and becomes the
+round-over-round ratio once BENCH_r1.json exists).
+"""
+from __future__ import annotations
+
+import json
+import statistics
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+
+def _log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def bench_train(preset: str = "t2t-base") -> dict:
+    import jax
+
+    from tensorhive_tpu.models.transformer import PRESETS
+    from tensorhive_tpu.train import TrainConfig, train_loop
+
+    model_config = PRESETS[preset]
+    on_tpu = jax.default_backend() == "tpu"
+    train_config = TrainConfig(
+        batch_size=16 if on_tpu else 2,
+        seq_len=1024 if on_tpu else 128,
+        warmup_steps=2,
+        total_steps=100,
+    )
+    _log(f"backend={jax.default_backend()} devices={jax.devices()}")
+    _log(f"model={preset} batch={train_config.batch_size} seq={train_config.seq_len}")
+    steps = 12 if on_tpu else 4
+    metrics = train_loop(model_config, train_config, mesh=None,
+                         num_steps=steps, log_every=0)
+    n_chips = max(1, len(jax.devices()))
+    tokens_per_step = train_config.batch_size * train_config.seq_len
+    return {
+        "steps_per_sec_per_chip": metrics["steps_per_sec"] / n_chips,
+        "tokens_per_sec_per_chip": metrics["steps_per_sec"] * tokens_per_step / n_chips,
+        "step_time_ms": metrics["step_time_s"] * 1e3,
+        "loss": metrics["loss"],
+    }
+
+
+def bench_telemetry_poll():
+    """p50 latency (ms) of one native telemetry poll on this machine."""
+    probe = Path(__file__).parent / "native" / "bin" / "tpuhive-probe"
+    if not probe.exists():
+        build = subprocess.run(["make", "-C", str(probe.parent.parent)],
+                               capture_output=True, text=True)
+        if build.returncode != 0 or not probe.exists():
+            _log("native probe unavailable; skipping telemetry bench")
+            return None
+    samples = []
+    for _ in range(21):
+        started = time.perf_counter()
+        subprocess.run([str(probe)], capture_output=True, timeout=30)
+        samples.append((time.perf_counter() - started) * 1e3)
+    return statistics.median(samples)
+
+
+def main() -> None:
+    train = bench_train()
+    poll_p50_ms = bench_telemetry_poll()
+    _log(f"train: {train}")
+    _log(f"telemetry poll p50: {poll_p50_ms} ms")
+    result = {
+        "metric": "t2t_transformer steps/sec/chip",
+        "value": round(train["steps_per_sec_per_chip"], 3),
+        "unit": "steps/s/chip",
+        "vs_baseline": 1.0,  # reference publishes no numbers (BASELINE.md)
+        "tokens_per_sec_per_chip": round(train["tokens_per_sec_per_chip"], 1),
+        "step_time_ms": round(train["step_time_ms"], 2),
+        "telemetry_poll_p50_ms": round(poll_p50_ms, 2) if poll_p50_ms is not None else None,
+        "loss": round(train["loss"], 4),
+    }
+    print(json.dumps(result, allow_nan=False))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,194 @@
+"""Decoder-only transformer LM, TPU-first.
+
+Design choices map straight onto the hardware (task brief + scaling-book
+recipe), not onto any reference code (the reference has no model code at
+all — it launches external t2t/DeepSpeech trainings):
+
+* **bf16 everywhere the MXU is involved**: params are kept in f32 master
+  copies, cast to bf16 for matmuls; logits/loss/softmax in f32.
+* **Static shapes, no data-dependent control flow** — one jit trace.
+* **RoPE** positions (no learned position table to shard), pre-RMSNorm,
+  SwiGLU MLP — the standard modern decoder block, all MXU-dense.
+* **Parallelism-aware**: every weight carries logical axes (see
+  parallel/mesh.py _PARAM_LOGICAL) so the same model runs pure-dp, fsdp,
+  megatron-tp, and ring-attention sp by choosing a mesh; attention runs
+  through the pallas flash kernel on single-shard sequences and through
+  ring attention when the sequence is sharded over ``sp``.
+* **jax.checkpoint** on each block so activation memory trades against
+  HBM bandwidth (remat is the TPU-default tradeoff for long sequences).
+
+Pure-functional: params are a plain dict pytree; ``TransformerLM`` is a
+namespace of ``init`` / ``apply`` / ``loss`` staticmethods.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.flash_attention import flash_attention
+from ..parallel.ring import ring_attention
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32_000
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 6
+    d_ff: int = 1408            # ~8/3 * d_model, SwiGLU sizing
+    max_seq_len: int = 2048
+    dtype: Any = jnp.bfloat16   # activation/matmul dtype
+    rope_theta: float = 10_000.0
+    remat: bool = True
+    #: use the pallas flash kernel for non-sp attention
+    use_flash: bool = True
+
+    @property
+    def d_head(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+
+#: named sizes; "t2t-base" mirrors tensor2tensor transformer_base
+#: (6 layers / d512 / 8 heads / ff2048 — the reference's benchmark config)
+PRESETS: Dict[str, TransformerConfig] = {
+    "tiny": TransformerConfig(vocab_size=512, d_model=64, n_heads=4, n_layers=2,
+                              d_ff=176, max_seq_len=256),
+    "t2t-base": TransformerConfig(vocab_size=32_000, d_model=512, n_heads=8,
+                                  n_layers=6, d_ff=2048, max_seq_len=2048),
+    "t2t-big": TransformerConfig(vocab_size=32_000, d_model=1024, n_heads=16,
+                                 n_layers=6, d_ff=4096, max_seq_len=2048),
+    "1b": TransformerConfig(vocab_size=32_000, d_model=2048, n_heads=16,
+                            n_layers=16, d_ff=5632, max_seq_len=4096),
+}
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding over the last dim of [B, L, H, D]."""
+    d = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    angles = positions[:, :, None, None].astype(jnp.float32) * freqs  # [B,L,1,D/2]
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    rotated = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return rotated.reshape(x.shape).astype(x.dtype)
+
+
+def _rmsnorm(x: jax.Array, scale: jax.Array) -> jax.Array:
+    norm = jnp.asarray(x, jnp.float32)
+    norm = norm * jax.lax.rsqrt(jnp.mean(norm * norm, axis=-1, keepdims=True) + 1e-6)
+    return (norm * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+class TransformerLM:
+    """init / apply / loss over a plain param pytree."""
+
+    # -- init ---------------------------------------------------------------
+    @staticmethod
+    def init(key: jax.Array, config: TransformerConfig) -> Params:
+        keys = iter(jax.random.split(key, 4 + 7 * config.n_layers))
+
+        def dense(key, fan_in, *shape):
+            return (jax.random.normal(key, shape, jnp.float32)
+                    * (1.0 / math.sqrt(fan_in)))
+
+        d, h, dh, f = (config.d_model, config.n_heads, config.d_head, config.d_ff)
+        params: Params = {
+            "tok_embed": jax.random.normal(next(keys), (config.vocab_size, d),
+                                           jnp.float32) * 0.02,
+            "final_norm": {"scale": jnp.ones((d,), jnp.float32)},
+            "w_lm_head": dense(next(keys), d, d, config.vocab_size),
+            "blocks": [],
+        }
+        for _ in range(config.n_layers):
+            params["blocks"].append({
+                "attn_norm": {"scale": jnp.ones((d,), jnp.float32)},
+                "mlp_norm": {"scale": jnp.ones((d,), jnp.float32)},
+                "wq": dense(next(keys), d, d, h * dh),
+                "wk": dense(next(keys), d, d, h * dh),
+                "wv": dense(next(keys), d, d, h * dh),
+                "wo": dense(next(keys), h * dh, h * dh, d),
+                "w_in": dense(next(keys), d, d, f),
+                "w_gate": dense(next(keys), d, d, f),
+                "w_out": dense(next(keys), f, f, d),
+            })
+        return params
+
+    # -- forward ------------------------------------------------------------
+    @staticmethod
+    def apply(
+        params: Params,
+        tokens: jax.Array,                  # [B, L] int32
+        config: TransformerConfig,
+        mesh=None,
+        positions: Optional[jax.Array] = None,
+    ) -> jax.Array:
+        """Returns logits [B, L, vocab] (f32)."""
+        dtype = config.dtype
+        if positions is None:
+            positions = jnp.broadcast_to(
+                jnp.arange(tokens.shape[1], dtype=jnp.int32), tokens.shape
+            )
+        x = params["tok_embed"].astype(dtype)[tokens]
+
+        sp_sharded = mesh is not None and "sp" in getattr(mesh, "axis_names", ()) \
+            and mesh.shape["sp"] > 1
+
+        def block_fn(x, block):
+            h = _rmsnorm(x, block["attn_norm"]["scale"])
+            b, l, d = h.shape
+            q = (h @ block["wq"].astype(dtype)).reshape(b, l, config.n_heads, config.d_head)
+            k = (h @ block["wk"].astype(dtype)).reshape(b, l, config.n_heads, config.d_head)
+            v = (h @ block["wv"].astype(dtype)).reshape(b, l, config.n_heads, config.d_head)
+            q = _rope(q, positions, config.rope_theta)
+            k = _rope(k, positions, config.rope_theta)
+            if sp_sharded:
+                attn = ring_attention(q, k, v, mesh=mesh, causal=True)
+            elif config.use_flash:
+                attn = flash_attention(q, k, v, causal=True)
+            else:
+                from ..ops.flash_attention import reference_attention
+
+                attn = reference_attention(q, k, v, causal=True)
+            attn = attn.reshape(b, l, config.n_heads * config.d_head)
+            x = x + attn @ block["wo"].astype(dtype)
+
+            h = _rmsnorm(x, block["mlp_norm"]["scale"])
+            gated = jax.nn.silu(h @ block["w_gate"].astype(dtype)) * (
+                h @ block["w_in"].astype(dtype)
+            )
+            return x + gated @ block["w_out"].astype(dtype)
+
+        if config.remat:
+            block_fn = jax.checkpoint(block_fn)
+        for block in params["blocks"]:
+            x = block_fn(x, block)
+
+        x = _rmsnorm(x, params["final_norm"]["scale"])
+        logits = x.astype(jnp.float32) @ params["w_lm_head"].astype(jnp.float32)
+        return logits
+
+    # -- loss ---------------------------------------------------------------
+    @staticmethod
+    def loss(
+        params: Params,
+        tokens: jax.Array,                  # [B, L+1] int32 (inputs+shifted)
+        config: TransformerConfig,
+        mesh=None,
+    ) -> jax.Array:
+        """Next-token cross-entropy, mean over tokens (f32)."""
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        logits = TransformerLM.apply(params, inputs, config, mesh=mesh)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        nll = -jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+        return jnp.mean(nll)
+
+    @staticmethod
+    def param_count(params: Params) -> int:
+        return sum(leaf.size for leaf in jax.tree_util.tree_leaves(params))

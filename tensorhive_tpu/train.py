@@ -1,0 +1,207 @@
+"""Sharded training: optimizer, jitted step, checkpointing, data.
+
+The training loop the acceptance workloads run (BASELINE.json configs 3-5).
+One ``make_train_step`` builds a donated, fully-sharded jit:
+
+* params/opt-state sharded by the mesh rules (fsdp/tp),
+* batches sharded dp+fsdp over batch and sp over sequence,
+* loss/grad in f32 with bf16 matmuls (models/transformer.py),
+* gradient sync is implicit — XLA inserts psum/reduce-scatter from the
+  shardings (the scaling-book recipe; no hand-written collectives).
+
+Checkpoint/resume via orbax (the reference has no training checkpoints —
+SURVEY.md §5 "checkpoint/resume: user program's concern"; here the user
+program is part of the framework, so it IS our concern).
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .models.transformer import Params, TransformerConfig, TransformerLM
+from .parallel.mesh import batch_sharding, tree_shardings
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    learning_rate: float = 3e-4
+    warmup_steps: int = 100
+    total_steps: int = 10_000
+    weight_decay: float = 0.01
+    max_grad_norm: float = 1.0
+    batch_size: int = 8
+    seq_len: int = 512
+
+
+def make_optimizer(config: TrainConfig) -> optax.GradientTransformation:
+    schedule = optax.warmup_cosine_decay_schedule(
+        init_value=0.0,
+        peak_value=config.learning_rate,
+        warmup_steps=config.warmup_steps,
+        decay_steps=config.total_steps,
+    )
+    return optax.chain(
+        optax.clip_by_global_norm(config.max_grad_norm),
+        optax.adamw(schedule, weight_decay=config.weight_decay),
+    )
+
+
+def init_train_state(
+    key: jax.Array,
+    model_config: TransformerConfig,
+    train_config: TrainConfig,
+    mesh: Optional[Mesh] = None,
+) -> Tuple[Params, Any]:
+    """Initialize params + opt state, placed according to the mesh rules
+    (init runs through jit with out_shardings so large models materialize
+    directly sharded, never replicated on one device)."""
+    if mesh is None:
+        params = TransformerLM.init(key, model_config)
+        opt_state = make_optimizer(train_config).init(params)
+        return params, opt_state
+
+    param_shape = jax.eval_shape(lambda k: TransformerLM.init(k, model_config), key)
+    shardings = tree_shardings(mesh, param_shape)
+    params = jax.jit(
+        lambda k: TransformerLM.init(k, model_config), out_shardings=shardings
+    )(key)
+    optimizer = make_optimizer(train_config)
+    opt_shape = jax.eval_shape(optimizer.init, param_shape)
+    opt_shardings = _opt_state_shardings(mesh, opt_shape, shardings)
+    opt_state = jax.jit(optimizer.init, out_shardings=opt_shardings)(params)
+    return params, opt_state
+
+
+def _opt_state_shardings(mesh: Mesh, opt_shape, param_shardings):
+    """Shardings for the optimizer state: any subtree structurally identical
+    to the param tree (Adam's mu/nu moments) mirrors the param shardings;
+    everything else (step counts, schedule state) replicates."""
+    param_flat, param_def = jax.tree_util.tree_flatten(param_shardings)
+    replicated = NamedSharding(mesh, P())
+
+    def walk(node):
+        flat, treedef = jax.tree_util.tree_flatten(node)
+        if treedef == param_def:
+            return jax.tree_util.tree_unflatten(treedef, param_flat)
+        if isinstance(node, dict):
+            return {key: walk(child) for key, child in node.items()}
+        if hasattr(node, "_fields"):  # NamedTuple state records
+            return type(node)(*(walk(child) for child in node))
+        if isinstance(node, tuple):
+            return tuple(walk(child) for child in node)
+        if isinstance(node, list):
+            return [walk(child) for child in node]
+        return replicated
+
+    return walk(opt_shape)
+
+
+def make_train_step(
+    model_config: TransformerConfig,
+    train_config: TrainConfig,
+    mesh: Optional[Mesh] = None,
+) -> Callable:
+    """Build the jitted train step: (params, opt_state, tokens) ->
+    (params, opt_state, metrics). Params/opt-state buffers are donated."""
+    optimizer = make_optimizer(train_config)
+
+    def step(params, opt_state, tokens):
+        loss, grads = jax.value_and_grad(TransformerLM.loss)(
+            params, tokens, model_config, mesh
+        )
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        grad_norm = optax.global_norm(grads)
+        return params, opt_state, {"loss": loss, "grad_norm": grad_norm}
+
+    if mesh is None:
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    data_sharding = batch_sharding(mesh)
+    return jax.jit(
+        step,
+        in_shardings=(None, None, data_sharding),  # params keep their placement
+        donate_argnums=(0, 1),
+    )
+
+
+def synthetic_batch(key: jax.Array, train_config: TrainConfig,
+                    vocab_size: int) -> jax.Array:
+    """Deterministic synthetic LM batch [B, L+1] (benchmarks + tests)."""
+    return jax.random.randint(
+        key, (train_config.batch_size, train_config.seq_len + 1), 0, vocab_size,
+        dtype=jnp.int32,
+    )
+
+
+# -- checkpointing (orbax) ---------------------------------------------------
+
+def save_checkpoint(path: str, step: int, params: Params, opt_state) -> None:
+    import orbax.checkpoint as ocp
+
+    with ocp.CheckpointManager(path) as manager:
+        manager.save(step, args=ocp.args.PyTreeSave({"params": params,
+                                                     "opt_state": opt_state}))
+
+
+def restore_checkpoint(path: str, params_like, opt_state_like) -> Tuple[int, Params, Any]:
+    """Restore the latest step; shapes/shardings follow the *_like trees."""
+    import orbax.checkpoint as ocp
+
+    with ocp.CheckpointManager(path) as manager:
+        step = manager.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {path}")
+        restored = manager.restore(
+            step,
+            args=ocp.args.PyTreeRestore({"params": params_like,
+                                         "opt_state": opt_state_like}),
+        )
+    return step, restored["params"], restored["opt_state"]
+
+
+def train_loop(
+    model_config: TransformerConfig,
+    train_config: TrainConfig,
+    mesh: Optional[Mesh] = None,
+    num_steps: int = 10,
+    seed: int = 0,
+    log_every: int = 10,
+    telemetry=None,
+) -> Dict[str, float]:
+    """Minimal complete loop over synthetic data; returns final metrics.
+    Real workloads supply their own data pipeline and call make_train_step
+    directly — this is the self-contained path bench.py and examples use."""
+    key = jax.random.PRNGKey(seed)
+    params, opt_state = init_train_state(key, model_config, train_config, mesh)
+    step_fn = make_train_step(model_config, train_config, mesh)
+    metrics: Dict[str, float] = {}
+    times = []
+    for step_index in range(num_steps):
+        key, data_key = jax.random.split(key)
+        tokens = synthetic_batch(data_key, train_config, model_config.vocab_size)
+        started = time.perf_counter()
+        params, opt_state, metrics_dev = step_fn(params, opt_state, tokens)
+        jax.block_until_ready(metrics_dev["loss"])
+        elapsed = time.perf_counter() - started
+        times.append(elapsed)
+        metrics = {k: float(v) for k, v in metrics_dev.items()}
+        if telemetry is not None:
+            telemetry.sample(step_time_s=elapsed)
+        if log_every and (step_index + 1) % log_every == 0:
+            log.info("step %d loss=%.4f (%.1f ms)", step_index + 1,
+                     metrics["loss"], elapsed * 1e3)
+    # steady-state step time: drop the compile-laden first step
+    steady = times[1:] or times
+    metrics["step_time_s"] = sorted(steady)[len(steady) // 2]
+    metrics["steps_per_sec"] = 1.0 / metrics["step_time_s"]
+    return metrics

@@ -7,12 +7,18 @@ so multi-chip sharding tests run without TPU hardware.
 """
 import os
 
-# must happen before any jax import anywhere in the test session
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# XLA_FLAGS must be in the environment before the first backend init
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ["TPUHIVE_PYTEST"] = "1"
+
+# the axon TPU plugin ignores/overrides the JAX_PLATFORMS env var, so pinning
+# tests to the virtual 8-device CPU platform must go through the config API
+# after import (verified: env-only pinning silently leaves the TPU active)
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
