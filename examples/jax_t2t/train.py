@@ -1,0 +1,55 @@
+"""Multi-worker flagship training entrypoint (acceptance config 3).
+
+Spawned by the `jax` template once per slice worker; the template-provided
+params wire `jax.distributed.initialize`, after which all chips of the slice
+form one mesh and the sharded train step runs data/fsdp-parallel across it.
+Telemetry flows back to the manager's dashboard via the drop-file emitter.
+"""
+import argparse
+
+import jax
+
+from tensorhive_tpu.models.transformer import PRESETS
+from tensorhive_tpu.parallel.mesh import best_mesh_shape, make_mesh
+from tensorhive_tpu.telemetry import TelemetryEmitter
+from tensorhive_tpu.train import TrainConfig, train_loop
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--preset", default="t2t-base", choices=sorted(PRESETS))
+    parser.add_argument("--steps", type=int, default=1000)
+    parser.add_argument("--batch_size", type=int, default=64)
+    parser.add_argument("--seq_len", type=int, default=1024)
+    # auto-filled by the `jax` template:
+    parser.add_argument("--coordinator_address", default=None)
+    parser.add_argument("--num_processes", type=int, default=None)
+    parser.add_argument("--process_id", type=int, default=None)
+    args = parser.parse_args()
+
+    if args.coordinator_address is not None:
+        jax.distributed.initialize(
+            coordinator_address=args.coordinator_address,
+            num_processes=args.num_processes,
+            process_id=args.process_id,
+        )
+
+    mesh = make_mesh(**best_mesh_shape(len(jax.devices())))
+    telemetry = TelemetryEmitter(name="jax_t2t")
+    try:
+        metrics = train_loop(
+            PRESETS[args.preset],
+            TrainConfig(batch_size=args.batch_size, seq_len=args.seq_len,
+                        warmup_steps=100, total_steps=args.steps),
+            mesh=mesh,
+            num_steps=args.steps,
+            telemetry=telemetry,
+        )
+        if jax.process_index() == 0:
+            print(f"final: {metrics}")
+    finally:
+        telemetry.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,25 @@
+"""Tiny CPU training run used by the localhost example (acceptance config 1).
+
+Runs the framework's own transformer trainer at toy scale so the example is
+self-contained — the spawned process exercises the same train step the TPU
+workloads use.
+"""
+import jax
+
+from tensorhive_tpu.models.transformer import PRESETS
+from tensorhive_tpu.train import TrainConfig, train_loop
+
+
+def main() -> None:
+    metrics = train_loop(
+        PRESETS["tiny"],
+        TrainConfig(batch_size=4, seq_len=64, warmup_steps=2, total_steps=30),
+        num_steps=30,
+        log_every=5,
+    )
+    print(f"done on {jax.default_backend()}: "
+          f"loss={metrics['loss']:.3f} steps/s={metrics['steps_per_sec']:.2f}")
+
+
+if __name__ == "__main__":
+    main()

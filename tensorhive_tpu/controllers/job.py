@@ -11,8 +11,9 @@ import logging
 from typing import List, Optional
 
 from ..api.app import RequestContext, int_arg, json_body, route
+from ..core.templates import Placement, render_template, template_names
 from ..db.models.job import Job, JobStatus
-from ..db.models.task import TaskStatus
+from ..db.models.task import SegmentType, Task, TaskStatus
 from ..db.models.user import User
 from ..utils.exceptions import ConflictError, ForbiddenError, TransportError, ValidationError
 from ..utils.timeutils import parse_datetime
@@ -140,6 +141,46 @@ def stop(context: RequestContext, job_id: int):
     if gracefully not in (True, False, None):
         raise ValidationError("gracefully must be true, false or null")
     return business_stop(job_id, gracefully).as_dict()
+
+
+@route("/templates", ["GET"], summary="Available launch-topology templates", tag="jobs")
+def list_templates(context: RequestContext):
+    return template_names()
+
+
+@route("/jobs/<int:job_id>/tasks_from_template", ["POST"],
+       summary="Generate the job's tasks from a distributed-launch template",
+       tag="jobs")
+def tasks_from_template(context: RequestContext, job_id: int):
+    """Body: ``{template, command, placements: [{hostname, address?, chips?}],
+    options?}`` — renders one task per process with auto-filled distributed
+    wiring (the server-side TaskCreate.vue engine, core/templates.py)."""
+    job = _get_or_404(job_id)
+    _assert_owner_or_admin(context, job)
+    data = json_body(context, "template", "command", "placements")
+    if not isinstance(data["placements"], list):
+        raise ValidationError("placements must be a list of objects")
+    placements = []
+    for i, p in enumerate(data["placements"]):
+        if not isinstance(p, dict) or not p.get("hostname"):
+            raise ValidationError(f"placements[{i}] needs a 'hostname'")
+        placements.append(Placement(
+            hostname=p["hostname"],
+            address=p.get("address", ""),
+            chips=p.get("chips"),
+        ))
+    specs = render_template(
+        data["template"], data["command"], placements, data.get("options")
+    )
+    tasks = []
+    for spec in specs:
+        task = Task(job_id=job.id, hostname=spec.hostname, command=spec.command).save()
+        for name, value in spec.env.items():
+            task.add_cmd_segment(name, value, SegmentType.env_variable)
+        for name, value in spec.params.items():
+            task.add_cmd_segment(name, value, SegmentType.parameter)
+        tasks.append(task)
+    return [task.as_dict() for task in tasks], 201
 
 
 @route("/jobs/<int:job_id>/enqueue", ["PUT"], summary="Place job in the scheduler queue",

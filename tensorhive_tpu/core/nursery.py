@@ -94,9 +94,11 @@ class HostOps:
         # signal dispositions — backgrounding with `&` in a non-interactive
         # shell would leave SIGINT/SIGQUIT at SIG_IGN in every descendant,
         # making graceful interrupt-termination impossible
+        # `>` not `>>`: each spawn starts a fresh log (the reference gets the
+        # same semantic from a fresh mktemp per spawn, task_nursery.py:90-96)
         script = (
             f'mkdir -p "{self.run_dir}" "{self.log_dir}" && rm -f "{pidfile}" && '
-            f'setsid --fork bash -c {shlex.quote(wrapper)} >> "{logfile}" 2>&1 < /dev/null; '
+            f'setsid --fork bash -c {shlex.quote(wrapper)} > "{logfile}" 2>&1 < /dev/null; '
             f'for _ in $(seq 1 100); do [ -s "{pidfile}" ] && break; sleep 0.05; done; '
             f'cat "{pidfile}"'
         )

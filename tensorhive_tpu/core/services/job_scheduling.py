@@ -23,6 +23,11 @@ from ...utils.timeutils import minutes_between, utcnow
 from ..scheduling import GreedyScheduler, Scheduler
 from .base import Service
 
+# imported at module scope (not inside tick methods): lazy imports on the
+# service thread race the main thread's own first import of the controller
+# chain (werkzeug) during boot, corrupting the partially-initialized module
+from ...controllers.job import business_execute, business_stop  # noqa: E402
+
 log = logging.getLogger(__name__)
 
 
@@ -52,8 +57,6 @@ class JobSchedulingService(Service):
 
     # -- timed starts (reference :134-171) ----------------------------------
     def execute_scheduled(self, now) -> bool:
-        from ...controllers.job import business_execute
-
         started = False
         for job in Job.find_scheduled_to_start(now):
             if self._job_would_interfere(job, now):
@@ -69,8 +72,6 @@ class JobSchedulingService(Service):
 
     # -- queue draining (reference :197-208) --------------------------------
     def execute_queued(self, now) -> None:
-        from ...controllers.job import business_execute
-
         queue = [job for job in Job.get_job_queue()
                  if not self._has_foreign_process(job)]
         if not queue:
@@ -88,8 +89,6 @@ class JobSchedulingService(Service):
             self.stop_with_grace(job, now)
 
     def stop_with_grace(self, job: Job, now) -> None:
-        from ...controllers.job import business_stop
-
         first_attempt = self._stop_first_attempt.setdefault(job.id, now)
         try:
             if job.id in self.stubborn_job_ids:

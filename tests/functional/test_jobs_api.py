@@ -161,6 +161,34 @@ def test_spawn_failure_surfaces(api, headers, cluster):
     assert "disk full" in response.get_json()["msg"]
 
 
+def test_tasks_from_template_end_to_end(api, headers, cluster):
+    """The full acceptance path: template-render a 2-process jax job, execute
+    it, and verify each spawned process carries its distributed wiring."""
+    job = api.post("/api/jobs", json={"name": "dist"}, headers=headers).get_json()
+    created = api.post(f"/api/jobs/{job['id']}/tasks_from_template", json={
+        "template": "jax",
+        "command": "python train.py",
+        "placements": [
+            {"hostname": "vm-0", "chips": [0, 1]},
+            {"hostname": "vm-1", "chips": [0, 1]},
+        ],
+    }, headers=headers)
+    assert created.status_code == 201
+    tasks = created.get_json()
+    assert len(tasks) == 2
+    full = Task.get(tasks[1]["id"]).full_command
+    assert "TPU_VISIBLE_CHIPS=0,1" in full
+    assert "--coordinator_address=vm-0:8476" in full
+    assert "--process_id=1" in full
+
+    api.post(f"/api/jobs/{job['id']}/execute", json={}, headers=headers)
+    proc_vm1 = next(iter(cluster.host("vm-1").processes.values()))
+    assert "--process_id=1" in proc_vm1.command
+
+    templates = api.get("/api/templates", headers=headers).get_json()
+    assert "jax" in templates and "multislice" in templates
+
+
 def test_enqueue_dequeue(api, headers, cluster):
     job, _task = _create_job_with_task(api, headers)
     queued = api.put(f"/api/jobs/{job['id']}/enqueue", headers=headers).get_json()

@@ -1,0 +1,86 @@
+"""Launch-template engine tests (reference equivalents lived untested in
+TaskCreate.vue — SURVEY.md §2.5; here the engine is server-side and unit
+tested)."""
+import json
+
+import pytest
+
+from tensorhive_tpu.core.templates import (
+    Placement,
+    render_template,
+    template_names,
+)
+from tensorhive_tpu.utils.exceptions import ValidationError
+
+
+def _placements(n, chips=None):
+    return [Placement(hostname=f"vm-{i}", chips=chips) for i in range(n)]
+
+
+def test_template_registry():
+    names = template_names()
+    for expected in ("jax", "multislice", "torch-xla", "tf-config", "tf-cluster", "plain"):
+        assert expected in names
+    with pytest.raises(ValidationError):
+        render_template("nope", "cmd", _placements(1))
+    with pytest.raises(ValidationError):
+        render_template("jax", "cmd", [])
+
+
+def test_jax_template_wires_coordinator():
+    specs = render_template("jax", "python train.py", _placements(4, chips=[0, 1]))
+    assert len(specs) == 4
+    for index, spec in enumerate(specs):
+        assert spec.params["--coordinator_address"] == "vm-0:8476"
+        assert spec.params["--num_processes"] == "4"
+        assert spec.params["--process_id"] == str(index)
+        assert spec.env["TPU_VISIBLE_CHIPS"] == "0,1"
+
+
+def test_multislice_template_megascale_env():
+    specs = render_template("multislice", "python train.py", _placements(2))
+    assert specs[0].env["MEGASCALE_COORDINATOR_ADDRESS"] == "vm-0:8477"
+    assert specs[0].env["MEGASCALE_NUM_SLICES"] == "2"
+    assert [s.env["MEGASCALE_SLICE_ID"] for s in specs] == ["0", "1"]
+
+
+def test_torch_xla_template():
+    specs = render_template("torch-xla", "python ddp.py", _placements(2))
+    for rank, spec in enumerate(specs):
+        assert spec.env["PJRT_DEVICE"] == "TPU"
+        assert spec.env["MASTER_ADDR"] == "vm-0"
+        assert spec.env["NODE_RANK"] == str(rank)
+        assert spec.env["WORLD_SIZE"] == "2"
+
+
+def test_tf_config_smart_ports_per_host():
+    # two processes on the SAME host must get different ports (reference
+    # "Smart TF_CONFIG" auto-assigns per-host ports from 2222)
+    placements = [Placement(hostname="vm-0"), Placement(hostname="vm-0"),
+                  Placement(hostname="vm-1")]
+    specs = render_template("tf-config", "python mnist.py", placements)
+    cluster = json.loads(specs[0].env["TF_CONFIG"])["cluster"]
+    assert cluster["worker"] == ["vm-0:2222", "vm-0:2223", "vm-1:2222"]
+    tasks = [json.loads(s.env["TF_CONFIG"])["task"] for s in specs]
+    assert tasks == [{"type": "worker", "index": 0}, {"type": "worker", "index": 1},
+                     {"type": "worker", "index": 2}]
+
+
+def test_tf_cluster_ps_worker_split():
+    specs = render_template("tf-cluster", "python train.py", _placements(3),
+                            {"num_ps": 1})
+    assert specs[0].params["--job_name"] == "ps"
+    assert specs[0].params["--task_index"] == "0"
+    assert specs[1].params["--job_name"] == "worker"
+    assert specs[1].params["--task_index"] == "0"
+    assert specs[2].params["--task_index"] == "1"
+    assert specs[1].params["--ps_hosts"] == "vm-0:2222"
+    assert specs[1].params["--worker_hosts"] == "vm-1:2222,vm-2:2222"
+    with pytest.raises(ValidationError):
+        render_template("tf-cluster", "cmd", _placements(2), {"num_ps": 2})
+
+
+def test_plain_template_chip_binding_only():
+    specs = render_template("plain", "python x.py", _placements(1, chips=[3]))
+    assert specs[0].env == {"TPU_VISIBLE_CHIPS": "3"}
+    assert specs[0].params == {}
