@@ -340,12 +340,18 @@ bool split_top_level(const std::string& text,
   return true;
 }
 
+// --metrics-dir <path> lets `sudo -n` invocations keep reading the
+// monitoring user's drop-files ($HOME flips to /root under sudo). An argv
+// flag instead of an env assignment because default sudoers (no SETENV
+// tag) rejects `sudo VAR=... cmd` outright.
+std::string g_metrics_dir_override;
+
 std::map<std::string, std::string> runtime_metrics() {
   std::map<std::string, std::string> merged;
-  // TPUHIVE_METRICS_DIR lets `sudo -n` invocations keep reading the
-  // monitoring user's drop-files ($HOME flips to /root under sudo)
   std::string dir;
-  if (const char* override_dir = std::getenv("TPUHIVE_METRICS_DIR")) {
+  if (!g_metrics_dir_override.empty()) {
+    dir = g_metrics_dir_override;
+  } else if (const char* override_dir = std::getenv("TPUHIVE_METRICS_DIR")) {
     dir = override_dir;
   } else if (const char* home = std::getenv("HOME")) {
     dir = std::string(home) + "/.tpuhive/metrics";
@@ -383,7 +389,10 @@ std::map<std::string, std::string> runtime_metrics() {
 
 }  // namespace
 
-int main() {
+int main(int argc, char** argv) {
+  for (int i = 1; i < argc - 1; ++i) {
+    if (std::string(argv[i]) == "--metrics-dir") g_metrics_dir_override = argv[i + 1];
+  }
   const auto devs = accelerator_devices();
   int restricted = 0;
   const auto holders = device_holders(devs, &restricted);

@@ -198,8 +198,13 @@ class ApiApp:
         header = request.headers.get("Authorization", "")
         if not header.startswith("Bearer "):
             raise AuthError("missing bearer token")
-        expected = "refresh" if endpoint.auth == "refresh" else "access"
-        claims = jwt_module.decode(header[len("Bearer "):], expected_type=expected)
+        expected = "refresh" if endpoint.auth in ("refresh", "logout-refresh") else "access"
+        # logout endpoints verify the signature only: revocation must be
+        # idempotent, so a second logout (or one racing expiry) is a 200
+        verify_active = endpoint.auth not in ("logout", "logout-refresh")
+        claims = jwt_module.decode(
+            header[len("Bearer "):], expected_type=expected, verify_active=verify_active
+        )
         if endpoint.auth == "admin" and "admin" not in claims.get("roles", []):
             raise ForbiddenError("admin role required")
         return claims

@@ -50,8 +50,13 @@ def encode(claims: Dict[str, Any]) -> str:
     return f"{header}.{payload}.{signature}"
 
 
-def decode(token: str, expected_type: Optional[str] = "access") -> Dict[str, Any]:
-    """Verify signature + expiry + blacklist; returns the claims dict."""
+def decode(
+    token: str,
+    expected_type: Optional[str] = "access",
+    verify_active: bool = True,
+) -> Dict[str, Any]:
+    """Verify signature (+ expiry + blacklist unless ``verify_active=False``);
+    returns the claims dict."""
     try:
         header_b64, payload_b64, signature_b64 = token.split(".")
     except ValueError:
@@ -68,13 +73,15 @@ def decode(token: str, expected_type: Optional[str] = "access") -> Dict[str, Any
         claims = json.loads(_b64url_decode(payload_b64))
     except (ValueError, TypeError):
         raise AuthError("malformed token payload")
-    if claims.get("exp") is not None and time.time() >= claims["exp"]:
-        raise AuthError("token expired")
+    if verify_active:
+        if claims.get("exp") is not None and time.time() >= claims["exp"]:
+            raise AuthError("token expired")
     if expected_type is not None and claims.get("type") != expected_type:
         raise AuthError(f"wrong token type (expected {expected_type})")
-    jti = claims.get("jti")
-    if jti and RevokedToken.is_jti_blacklisted(jti):
-        raise AuthError("token revoked")
+    if verify_active:
+        jti = claims.get("jti")
+        if jti and RevokedToken.is_jti_blacklisted(jti):
+            raise AuthError("token revoked")
     return claims
 
 
@@ -103,9 +110,17 @@ def create_refresh_token(user_id: int) -> str:
     })
 
 
+def revoke_claims(claims: Dict[str, Any]) -> None:
+    """Blacklist an already-verified token by jti (reference logout,
+    controllers/user.py:207-230). Idempotent: RevokedToken.add atomically
+    no-ops on an already-blacklisted jti, so a repeated POST /user/logout
+    (or logout racing expiry) is not a 401 — the logout auth mode verifies
+    the signature only (``decode(verify_active=False)``)."""
+    jti = claims.get("jti")
+    if jti:
+        RevokedToken.add(jti)
+
+
 def revoke(token: str) -> None:
-    """Blacklist a token by jti regardless of type (reference logout,
-    controllers/user.py:207-230)."""
-    claims = decode(token, expected_type=None)
-    if claims.get("jti"):
-        RevokedToken.add(claims["jti"])
+    """Signature-verify ``token`` and blacklist its jti (idempotent)."""
+    revoke_claims(decode(token, expected_type=None, verify_active=False))

@@ -70,14 +70,23 @@ def business_stop(job_id: int, gracefully: Optional[bool] = True) -> Job:
 
 @route("/jobs", ["GET"], summary="List jobs (optionally ?user_id=)", tag="jobs")
 def list_jobs(context: RequestContext):
+    # Listing everyone's jobs is admin-only; non-admins may only list their
+    # own (fullCommand embeds env segments, which commonly hold secrets).
+    # Reference gates this the same way (reference job.py:48-60).
     user_id = int_arg(context, "user_id")
+    if not context.is_admin:
+        if user_id is not None and user_id != context.user_id:
+            raise ForbiddenError("only admins may list other users' jobs")
+        user_id = context.user_id
     jobs = Job.filter_by(user_id=user_id) if user_id is not None else Job.all()
     return [job.as_dict() for job in jobs]
 
 
 @route("/jobs/<int:job_id>", ["GET"], summary="Get one job with tasks", tag="jobs")
 def get_job(context: RequestContext, job_id: int):
-    return _get_or_404(job_id).as_dict()  # as_dict embeds task list
+    job = _get_or_404(job_id)
+    _assert_owner_or_admin(context, job)
+    return job.as_dict()  # as_dict embeds task list
 
 
 @route("/jobs", ["POST"], summary="Create a job", tag="jobs")

@@ -125,13 +125,23 @@ def business_get_log(task_id: int, tail: Optional[int] = None) -> str:
 
 @route("/tasks", ["GET"], summary="List tasks (optionally ?job_id=)", tag="tasks")
 def list_tasks(context: RequestContext):
+    # Listing all tasks is admin-only; non-admins may only list tasks of a
+    # job they own (fullCommand embeds env-segment values — often secrets).
+    # Reference gates per-record reads to owner-or-admin (task.py:141-147).
     job_id = int_arg(context, "job_id")
+    if not context.is_admin:
+        if job_id is None:
+            raise ForbiddenError("only admins may list all tasks; pass ?job_id=")
+        job = Job.get(job_id)
+        if job.user_id != context.user_id:
+            raise ForbiddenError("only the job owner or an admin may list its tasks")
     tasks = Task.filter_by(job_id=job_id) if job_id is not None else Task.all()
     return [task.as_dict() for task in tasks]
 
 
 @route("/tasks/<int:task_id>", ["GET"], summary="Get one task (synchronized)", tag="tasks")
 def get_task(context: RequestContext, task_id: int):
+    _assert_owner_or_admin(context, _get_or_404(task_id))
     return synchronize(task_id).as_dict()
 
 

@@ -100,18 +100,18 @@ def login(context: RequestContext):
     }
 
 
-@route("/user/logout", ["POST"], summary="Revoke the presented access token", tag="auth")
+@route("/user/logout", ["POST"], auth="logout",
+       summary="Revoke the presented access token", tag="auth")
 def logout(context: RequestContext):
-    header = context.request.headers.get("Authorization", "")
-    jwt_module.revoke(header[len("Bearer "):])
+    # _authenticate already signature-verified the token (auth="logout")
+    jwt_module.revoke_claims(context.claims)
     return {"msg": "access token revoked"}
 
 
-@route("/user/logout/refresh", ["POST"], auth="refresh",
+@route("/user/logout/refresh", ["POST"], auth="logout-refresh",
        summary="Revoke the presented refresh token", tag="auth")
 def logout_refresh(context: RequestContext):
-    header = context.request.headers.get("Authorization", "")
-    jwt_module.revoke(header[len("Bearer "):])
+    jwt_module.revoke_claims(context.claims)
     return {"msg": "refresh token revoked"}
 
 

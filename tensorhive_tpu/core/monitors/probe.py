@@ -156,9 +156,14 @@ def probe_command() -> str:
     fallback = (
         f'python3 -c "import base64 as b;exec(b.b64decode(\'{encoded}\'))"'
     )
-    sudo_env = f'TPUHIVE_METRICS_DIR="$HOME/.tpuhive/metrics"'
+    # The metrics dir travels as an argv flag, NOT an env assignment: with
+    # default sudoers (no SETENV tag) `sudo -n VAR=... cmd` is rejected
+    # wholesale, which would silently degrade to the unprivileged probe and
+    # leave chip-ownership incomplete. A plain NOPASSWD rule suffices for
+    # this form. $HOME expands in the invoking user's shell before sudo runs.
+    sudo_flags = '--metrics-dir "$HOME/.tpuhive/metrics"'
     return (
-        f"sudo -n {sudo_env} {PROBE_REMOTE_PATH} 2>/dev/null "
+        f"sudo -n {PROBE_REMOTE_PATH} {sudo_flags} 2>/dev/null "
         f"|| {PROBE_REMOTE_PATH} 2>/dev/null "
         f"|| {fallback}  # {PROBE_MARKER}"
     )

@@ -92,7 +92,7 @@ class Transport:
             data = fh.read()
         encoded = base64.b64encode(data).decode()
         quoted = shlex_quote(self.expand_remote_path(remote_path))
-        self.check_output(f"mkdir -p $(dirname {quoted}) && : > {quoted}.b64")
+        self.check_output(f'mkdir -p "$(dirname {quoted})" && : > {quoted}.b64')
         chunk_size = 64 * 1024  # keep each command line well under ARG_MAX
         try:
             for offset in range(0, len(encoded), chunk_size):

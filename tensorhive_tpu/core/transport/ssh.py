@@ -93,7 +93,7 @@ class SshTransport(Transport):
         """scp with the same multiplexed connection options as run()."""
         target = f"{self.user}@{self.host.address}" if self.user else self.host.address
         remote_path = self.expand_remote_path(remote_path)
-        self.check_output(f"mkdir -p $(dirname {shlex.quote(remote_path)})")
+        self.check_output(f'mkdir -p "$(dirname {shlex.quote(remote_path)})"')
         argv = ["scp"] + self._common_options() + ["-P", str(self.host.port),
                 local_path, f"{target}:{remote_path}"]
         try:

@@ -195,3 +195,60 @@ def test_enqueue_dequeue(api, headers, cluster):
     assert queued["isQueued"] is True and queued["status"] == "pending"
     dequeued = api.put(f"/api/jobs/{job['id']}/dequeue", headers=headers).get_json()
     assert dequeued["isQueued"] is False and dequeued["status"] == "not_running"
+
+
+# -- authorization: job/task reads are owner-or-admin ------------------------
+# (regression for round-1 advisor finding: fullCommand embeds env-segment
+# values, commonly secrets — reads must be gated like writes)
+
+@pytest.fixture()
+def other_headers(api, db):
+    make_user(username="mallory", password="SuperSecret42")
+    tokens = api.post("/api/user/login", json={
+        "username": "mallory", "password": "SuperSecret42",
+    }).get_json()
+    return {"Authorization": f"Bearer {tokens['accessToken']}"}
+
+
+@pytest.fixture()
+def admin_headers(api, db):
+    from tests.fixtures import make_admin
+    make_admin(username="root-admin", password="SuperSecret42")
+    tokens = api.post("/api/user/login", json={
+        "username": "root-admin", "password": "SuperSecret42",
+    }).get_json()
+    return {"Authorization": f"Bearer {tokens['accessToken']}"}
+
+
+def test_get_job_forbidden_for_non_owner(api, headers, other_headers, cluster):
+    job, task = _create_job_with_task(api, headers)
+    assert api.get(f"/api/jobs/{job['id']}", headers=other_headers).status_code == 403
+    assert api.get(f"/api/tasks/{task['id']}", headers=other_headers).status_code == 403
+    assert api.get(f"/api/tasks?job_id={job['id']}", headers=other_headers).status_code == 403
+
+
+def test_list_jobs_scoped_to_caller_for_non_admin(api, headers, other_headers, owner, cluster):
+    _create_job_with_task(api, headers)
+    # mallory listing all jobs sees only her own (none) — not alice's
+    assert api.get("/api/jobs", headers=other_headers).get_json() == []
+    # explicitly requesting alice's user_id is refused
+    assert api.get(f"/api/jobs?user_id={owner.id}", headers=other_headers).status_code == 403
+    # listing all tasks without a job filter is admin-only
+    assert api.get("/api/tasks", headers=other_headers).status_code == 403
+    # the owner still sees their job
+    assert len(api.get("/api/jobs", headers=headers).get_json()) == 1
+
+
+def test_admin_reads_any_job_and_task(api, headers, admin_headers, cluster):
+    job, task = _create_job_with_task(api, headers)
+    assert api.get(f"/api/jobs/{job['id']}", headers=admin_headers).status_code == 200
+    assert api.get("/api/jobs", headers=admin_headers).status_code == 200
+    assert api.get(f"/api/tasks/{task['id']}", headers=admin_headers).status_code == 200
+    assert api.get("/api/tasks", headers=admin_headers).status_code == 200
+
+
+def test_logout_is_idempotent(api, headers):
+    # revoking the same token twice must not 401 (revocation is idempotent)
+    assert api.post("/api/user/logout", headers=headers).status_code == 200
+    second = api.post("/api/user/logout", headers=headers)
+    assert second.status_code == 200
