@@ -119,8 +119,3 @@ def revoke_claims(claims: Dict[str, Any]) -> None:
     jti = claims.get("jti")
     if jti:
         RevokedToken.add(jti)
-
-
-def revoke(token: str) -> None:
-    """Signature-verify ``token`` and blacklist its jti (idempotent)."""
-    revoke_claims(decode(token, expected_type=None, verify_active=False))

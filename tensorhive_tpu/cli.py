@@ -104,31 +104,19 @@ def init(username: str, email: str, password: str) -> None:
     """Write default configs, create the database and the first admin
     account (reference cli.py:170-214 + AccountCreator)."""
     from .config import get_config, write_default_configs
+    from .core.account_creator import AccountCreator, ensure_default_group_bootstrap
     from .db.engine import get_engine
     from .db.migrations import ensure_schema
-    from .db.models.restriction import Restriction
-    from .db.models.user import Group, User
-    from .utils.timeutils import utcnow
 
     config = get_config()
     write_default_configs(config.config_dir, secret_key=secrets.token_hex(32))
     click.echo(f"configs in {config.config_dir}")
     ensure_schema(get_engine())
 
-    user = User(username=username, email=email, password=password).save()
-    user.add_role("user")
-    user.add_role("admin")
-
     # bootstrap: default group + global everything-allowed restriction
     # (reference AccountCreator._check_restrictions:113-139)
-    if not Group.get_default_groups():
-        group = Group(name="users", is_default=True).save()
-        group.add_user(user)
-        restriction = Restriction(
-            name="default: everything allowed", starts_at=utcnow(), is_global=True
-        ).save()
-        restriction.apply_to_group(group)
-        click.echo("created default group with a permissive global restriction")
+    ensure_default_group_bootstrap(click.echo)
+    AccountCreator.create_account(username, email, password, admin=True)
     click.echo(f"admin account {username!r} created")
 
 
@@ -153,24 +141,40 @@ def create() -> None:
 
 
 @create.command("user")
-@click.option("--username", prompt=True)
-@click.option("--email", prompt=True)
-@click.option("--password", prompt=True, hide_input=True, confirmation_prompt=True)
+@click.option("--username", default=None, help="omit to be prompted")
+@click.option("--email", default=None)
+@click.option("--password", default=None)
 @click.option("--admin", is_flag=True)
-def create_user(username: str, email: str, password: str, admin: bool) -> None:
-    """Create an account (reference cli.py:247-257)."""
+@click.option("--multiple", is_flag=True,
+              help="loop, creating several accounts in one sitting")
+def create_user(username, email, password, admin: bool, multiple: bool) -> None:
+    """Create account(s) (reference cli.py:247-257 + AccountCreator.run_prompt).
+
+    With all of --username/--email/--password given, creates one account
+    non-interactively; otherwise enters the interactive prompt loop, which
+    re-asks on invalid fields and (with --multiple) keeps creating accounts
+    until you stop."""
+    from .core.account_creator import AccountCreator, ensure_default_group_bootstrap
     from .db.engine import get_engine
     from .db.migrations import ensure_schema
-    from .db.models.user import Group, User
+    from .utils.exceptions import ValidationError
 
     ensure_schema(get_engine())
-    user = User(username=username, email=email, password=password).save()
-    user.add_role("user")
-    if admin:
-        user.add_role("admin")
-    for group in Group.get_default_groups():
-        group.add_user(user)
-    click.echo(f"user {username!r} created{' (admin)' if admin else ''}")
+    if username and email and password and not multiple:
+        ensure_default_group_bootstrap(click.echo)
+        try:
+            AccountCreator.create_account(username, email, password, admin)
+        except ValidationError as exc:
+            click.echo(f"error: {exc}", err=True)
+            sys.exit(1)
+        click.echo(f"user {username!r} created{' (admin)' if admin else ''}")
+        return
+    creator = AccountCreator(prompt=click.prompt, confirm=click.confirm, echo=click.echo)
+    created = creator.run_prompt(multiple=multiple, username=username, email=email,
+                                 admin=True if admin else None)
+    click.echo(f"created {len(created)} account(s)")
+    if not created:
+        sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -15,6 +15,7 @@ from ..api import jwt as jwt_module
 from ..api.app import RequestContext, json_body, route
 from ..db.models.user import Group, User
 from ..utils.exceptions import ForbiddenError, ValidationError
+from ..utils.timeutils import utcnow
 
 log = logging.getLogger(__name__)
 
@@ -93,6 +94,8 @@ def login(context: RequestContext):
     user = User.find_by_username(data["username"])
     if user is None or not user.check_password(data["password"]):
         raise jwt_module.AuthError("invalid credentials")
+    user.last_login_at = utcnow()
+    user.save()
     return {
         "user": user.as_dict(),
         "accessToken": jwt_module.create_access_token(user.id, user.roles),

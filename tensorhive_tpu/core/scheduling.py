@@ -10,7 +10,7 @@ this round; skip a slot when the *owner's own* reservation is upcoming
 from __future__ import annotations
 
 import logging
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Set
 
 from ..db.models.job import Job
 from ..db.models.reservation import Reservation
@@ -18,6 +18,11 @@ from ..db.models.user import User
 from ..utils.timeutils import minutes_between, utcnow
 
 log = logging.getLogger(__name__)
+
+#: per-job eligible-host resolver: returns the set of hostnames the job's
+#: owner may launch on, or None for "unrestricted" (reference
+#: get_hosts_with_gpus_eligible_for_jobs, JobSchedulingService.py:174-195)
+EligibleHostsFn = Callable[[Job], Optional[Set[str]]]
 
 
 class Scheduler:
@@ -28,6 +33,7 @@ class Scheduler:
         queued_jobs: List[Job],
         required_free_minutes: float,
         at=None,
+        eligible_hosts: Optional[EligibleHostsFn] = None,
     ) -> List[Job]:
         raise NotImplementedError
 
@@ -68,16 +74,21 @@ class GreedyScheduler(Scheduler):
         queued_jobs: List[Job],
         required_free_minutes: float,
         at=None,
+        eligible_hosts: Optional[EligibleHostsFn] = None,
     ) -> List[Job]:
         at = at or utcnow()
         taken: set = set()
         chosen: List[Job] = []
         for job in queued_jobs:
+            if not self._hosts_eligible(job, eligible_hosts):
+                continue
             uids = job.chip_uids
             if not uids:
-                # no chip claims: runnable whenever its hosts are known;
-                # launch it (CPU-only jobs, reference behavior for tasks
-                # without CUDA_VISIBLE_DEVICES)
+                # no chip claims (CPU-only job): the host-eligibility gate
+                # above is the whole check — reference launches chip-less
+                # jobs only on eligible hosts too (JobSchedulingService.py
+                # :174-195); without it a queued job on an unknown or
+                # restricted host would bypass all gating
                 chosen.append(job)
                 continue
             ok = True
@@ -92,3 +103,16 @@ class GreedyScheduler(Scheduler):
                 taken.update(uids)
                 chosen.append(job)
         return chosen
+
+    @staticmethod
+    def _hosts_eligible(job: Job, eligible_hosts: Optional[EligibleHostsFn]) -> bool:
+        """Every task hostname must be eligible for the job's owner."""
+        if eligible_hosts is None:
+            return True
+        hosts = eligible_hosts(job)
+        if hosts is None:  # unrestricted user
+            return True
+        missing = {task.hostname for task in job.tasks} - hosts
+        if missing:
+            log.debug("job %d skipped: hosts %s not eligible", job.id, sorted(missing))
+        return not missing

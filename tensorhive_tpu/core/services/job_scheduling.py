@@ -76,7 +76,9 @@ class JobSchedulingService(Service):
                  if not self._has_foreign_process(job)]
         if not queue:
             return
-        for job in self.scheduler.schedule_jobs(queue, self.required_free_minutes, at=now):
+        for job in self.scheduler.schedule_jobs(queue, self.required_free_minutes,
+                                                at=now,
+                                                eligible_hosts=self._eligible_hosts_resolver()):
             try:
                 log.info("starting queued job %d (%s)", job.id, job.name)
                 business_execute(job.id)
@@ -136,6 +138,42 @@ class JobSchedulingService(Service):
         foreign processes (reference check_if_resources_available_for_job +
         interferes_with_reservations, :106-132)."""
         return self._reservation_imminent(job, now) or self._has_foreign_process(job)
+
+    def _eligible_hosts_resolver(self):
+        """Per-tick resolver: hosts a job's owner may launch on — known to
+        the monitoring infrastructure and, after restriction filtering,
+        carrying at least one permitted chip (a host reporting zero chips
+        stays eligible for CPU-only work). Reference
+        get_hosts_with_gpus_eligible_for_jobs →
+        User.filter_infrastructure_by_user_restrictions
+        (JobSchedulingService.py:174-195). Returns None (= unrestricted)
+        when no infrastructure manager is wired, e.g. in bare unit tests.
+
+        The infra snapshot (a deepcopy under the RWLock) is taken once per
+        schedule pass and eligibility is memoized per owner, so N queued
+        jobs don't cost N snapshots + N restriction-query sets."""
+        if self.infrastructure_manager is None:
+            return None
+        host_chips = {
+            hostname: set(node["TPU"])
+            for hostname, node in self.infrastructure_manager.infrastructure.items()
+            if "TPU" in node  # absent = never reported or marked unreachable
+        }
+        by_owner: Dict[int, Set[str]] = {}
+
+        def eligible_hosts(job: Job) -> Set[str]:
+            if job.user_id not in by_owner:
+                try:
+                    allowed = User.get(job.user_id).allowed_resource_uids()
+                except NotFoundError:
+                    allowed = set()  # orphaned job: never eligible
+                by_owner[job.user_id] = {
+                    hostname for hostname, chips in host_chips.items()
+                    if allowed is None or not chips or (chips & allowed)
+                }
+            return by_owner[job.user_id]
+
+        return eligible_hosts
 
     def _has_foreign_process(self, job: Job) -> bool:
         if self.infrastructure_manager is None:

@@ -15,11 +15,30 @@ from .orm import create_all
 
 log = logging.getLogger(__name__)
 
+
+def _column_names(engine: Engine, table: str) -> List[str]:
+    return [row[1] for row in engine.execute(f"PRAGMA table_info({table})")]
+
+
+def _add_column(engine: Engine, table: str, column: str, ddl_type: str) -> None:
+    """Idempotent ADD COLUMN: safe to re-run after a crash mid-upgrade."""
+    if column not in _column_names(engine, table):
+        engine.execute(f"ALTER TABLE {table} ADD COLUMN {column} {ddl_type}")
+
+
+def _migration_2_user_last_login(engine: Engine) -> None:
+    """v1 → v2: ``users.last_login_at`` (ISO-8601 TEXT, set by the login
+    controller; shown in the users admin view)."""
+    _add_column(engine, "users", "last_login_at", "TEXT")
+
+
 # append (version, fn) pairs as the schema evolves; fn(engine) must be
 # idempotent enough to re-run after a crash mid-upgrade.
-MIGRATIONS: List[Tuple[int, Callable[[Engine], None]]] = []
+MIGRATIONS: List[Tuple[int, Callable[[Engine], None]]] = [
+    (2, _migration_2_user_last_login),
+]
 
-SCHEMA_VERSION = 1
+SCHEMA_VERSION = 2
 
 
 def ensure_schema(engine: Engine) -> None:

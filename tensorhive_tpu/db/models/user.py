@@ -47,13 +47,16 @@ class User(Model):
     """Reference: tensorhive/models/User.py:31-186."""
 
     __tablename__ = "users"
-    __public__ = ("id", "username", "email", "created_at")
+    __public__ = ("id", "username", "email", "created_at", "last_login_at")
 
     id = Column(int, primary_key=True)
     username = Column(str, nullable=False, unique=True)
     email = Column(str, nullable=False)
     _hashed_password = Column(str, nullable=False)
     created_at = Column(datetime)
+    # schema v2 (db/migrations.py): stamped on successful login, surfaced in
+    # the users admin view
+    last_login_at = Column(datetime)
 
     MIN_USERNAME_LEN = 3
     MIN_PASSWORD_LEN = 8
@@ -64,14 +67,41 @@ class User(Model):
         if password is not None:
             self.password = password
 
+    # -- per-field validators (reference User.py:98-108; used by the
+    # interactive AccountCreator to re-prompt on a single bad field) -------
+    @classmethod
+    def validate_username_format(cls, username: str) -> None:
+        if not username or len(username) < cls.MIN_USERNAME_LEN:
+            raise ValidationError(
+                f"username must have at least {cls.MIN_USERNAME_LEN} characters"
+            )
+
+    @classmethod
+    def validate_username(cls, username: str) -> None:
+        """Format + uniqueness (for NEW accounts; re-saving an existing row
+        must use validate_username_format to avoid self-collision)."""
+        cls.validate_username_format(username)
+        if cls.find_by_username(username) is not None:
+            raise ValidationError(f"username {username!r} is already taken")
+
+    @classmethod
+    def validate_email(cls, email: str) -> None:
+        if not email or not _EMAIL_RE.match(email):
+            raise ValidationError(f"invalid email: {email!r}")
+
+    @classmethod
+    def validate_password(cls, password: str) -> None:
+        if len(password or "") < cls.MIN_PASSWORD_LEN:
+            raise ValidationError(
+                f"password must have at least {cls.MIN_PASSWORD_LEN} characters"
+            )
+
     # -- validation (reference User.py:98-108 validators) ------------------
     def check_assertions(self) -> None:
-        if not self.username or len(self.username) < self.MIN_USERNAME_LEN:
-            raise ValidationError(
-                f"username must have at least {self.MIN_USERNAME_LEN} characters"
-            )
-        if not self.email or not _EMAIL_RE.match(self.email):
-            raise ValidationError(f"invalid email: {self.email!r}")
+        # uniqueness is NOT re-checked here (validate_username does): an
+        # existing row re-saving itself would collide with its own username
+        self.validate_username_format(self.username)
+        self.validate_email(self.email)
         if not self._hashed_password:
             raise ValidationError("password must be set")
 

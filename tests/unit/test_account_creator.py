@@ -1,0 +1,105 @@
+"""AccountCreator interactive-loop tests (reference AccountCreator.py:25-139
+was untested; here scripted prompt/confirm callables drive the loop)."""
+import pytest
+
+from tensorhive_tpu.core.account_creator import AccountCreator, ensure_default_group_bootstrap
+from tensorhive_tpu.db.models.restriction import Restriction
+from tensorhive_tpu.db.models.user import Group, User
+
+
+class Script:
+    """Queue-backed stand-ins for click.prompt / click.confirm."""
+
+    def __init__(self, prompts, confirms):
+        self.prompts = list(prompts)
+        self.confirms = list(confirms)
+        self.echoed = []
+
+    def prompt(self, field, **kwargs):
+        return self.prompts.pop(0)
+
+    def confirm(self, question, default=False):
+        return self.confirms.pop(0)
+
+    def echo(self, message):
+        self.echoed.append(message)
+
+    def creator(self, **kwargs):
+        return AccountCreator(self.prompt, self.confirm, self.echo, **kwargs)
+
+
+def test_bootstrap_is_idempotent(db):
+    ensure_default_group_bootstrap()
+    ensure_default_group_bootstrap()
+    groups = Group.get_default_groups()
+    assert len(groups) == 1
+    restrictions = Restriction.all()
+    assert len(restrictions) == 1 and restrictions[0].is_global
+
+
+def test_single_account_flow(db):
+    script = Script(
+        prompts=["alice", "alice@example.com", "SuperSecret42"],
+        confirms=[True],  # grant admin
+    )
+    created = script.creator().run_prompt(multiple=False)
+    assert [u.username for u in created] == ["alice"]
+    user = User.find_by_username("alice")
+    assert user.has_role("admin")
+    # auto-joined the bootstrap default group
+    assert [g.name for g in user.groups] == ["users"]
+
+
+def test_invalid_fields_reprompt_instead_of_abort(db):
+    script = Script(
+        prompts=[
+            "ab",                 # too short -> re-ask
+            "bob",
+            "not-an-email",       # invalid -> re-ask
+            "bob@example.com",
+            "short",              # too short -> re-ask
+            "LongEnough99",
+        ],
+        confirms=[False],  # not admin
+    )
+    created = script.creator().run_prompt(multiple=False)
+    assert [u.username for u in created] == ["bob"]
+    assert any("invalid username" in e for e in script.echoed)
+    assert any("invalid email" in e for e in script.echoed)
+    assert any("invalid password" in e for e in script.echoed)
+
+
+def test_taken_username_is_rejected_at_prompt(db):
+    Script(["carol", "carol@example.com", "SuperSecret42"], [False]).creator().run_prompt()
+    script = Script(
+        prompts=["carol", "carol2", "c2@example.com", "SuperSecret42"],
+        confirms=[False],
+    )
+    created = script.creator().run_prompt(multiple=False)
+    assert [u.username for u in created] == ["carol2"]
+    assert any("already taken" in e for e in script.echoed)
+
+
+def test_multiple_mode_loops_until_declined(db):
+    script = Script(
+        prompts=[
+            "dave", "dave@example.com", "SuperSecret42",
+            "erin", "erin@example.com", "SuperSecret42",
+        ],
+        confirms=[
+            False, True,   # dave: not admin; create another? yes
+            True, False,   # erin: admin; create another? no
+        ],
+    )
+    created = script.creator().run_prompt(multiple=True)
+    assert [u.username for u in created] == ["dave", "erin"]
+    assert not User.find_by_username("dave").has_role("admin")
+    assert User.find_by_username("erin").has_role("admin")
+
+
+def test_gives_up_after_max_attempts(db):
+    script = Script(prompts=["x"] * 3, confirms=[])
+    created = script.creator(max_attempts_per_field=3).run_prompt(multiple=False)
+    assert created == []
+    assert any("too many invalid attempts" in e for e in script.echoed)
+    assert User.all() == []

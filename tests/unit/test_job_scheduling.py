@@ -17,7 +17,15 @@ from tensorhive_tpu.core.transport.fake import FakeCluster, FakeOpsFactory
 from tensorhive_tpu.db.models.job import Job, JobStatus
 from tensorhive_tpu.db.models.task import TaskStatus
 from tensorhive_tpu.utils.timeutils import utcnow
-from tests.fixtures import make_job, make_reservation, make_resource, make_task, make_user
+from tests.fixtures import (
+    make_job,
+    make_permissive_restriction,
+    make_reservation,
+    make_resource,
+    make_restriction,
+    make_task,
+    make_user,
+)
 
 
 @pytest.fixture()
@@ -31,7 +39,14 @@ def cluster(db, config):
 
 @pytest.fixture()
 def infra(cluster):
-    return InfrastructureManager(["vm-0"])
+    manager = InfrastructureManager(["vm-0"])
+    # queued jobs only launch on hosts with live telemetry (the reference's
+    # eligible-hosts filter walks the monitored-infra dict) — seed the
+    # subtree a MonitoringService tick would have written
+    manager.update_subtree("vm-0", "TPU", {
+        chip_uid("vm-0", i): {"index": i, "processes": []} for i in range(4)
+    })
+    return manager
 
 
 @pytest.fixture()
@@ -45,6 +60,10 @@ def service(config, infra):
 
 @pytest.fixture()
 def owner(db):
+    # `tpuhive init` bootstraps a global permissive restriction (reference
+    # AccountCreator._check_restrictions); queued jobs only launch on hosts
+    # the owner's restrictions permit, so mirror that bootstrap here
+    make_permissive_restriction()
     return make_user(username="alice", password="SuperSecret42")
 
 
@@ -195,3 +214,55 @@ def test_preemption_by_foreign_process(service, owner, cluster, infra, db):
     })
     service.do_run()
     assert Job.get(job.id).status is JobStatus.terminated
+
+
+# -- queue host-eligibility gating (reference JobSchedulingService.py:174-195;
+# round-1 gap: chip-less queued jobs launched unconditionally) ----------------
+
+def test_chipless_queued_job_runs_only_on_monitored_host(service, owner, cluster, db):
+    job = make_job(owner)
+    make_task(job, hostname="vm-0", chips=None)  # CPU-only: no chip claims
+    job.enqueue()
+    service.do_run()
+    assert Job.get(job.id).status is JobStatus.running
+
+
+def test_chipless_queued_job_skipped_on_unknown_host(service, owner, cluster, db):
+    job = make_job(owner)
+    make_task(job, hostname="ghost-vm", chips=None)
+    job.enqueue()
+    service.do_run()
+    assert Job.get(job.id).status is JobStatus.pending  # still queued, not launched
+
+
+def test_chipless_queued_job_skipped_on_unreachable_host(service, owner, cluster, infra, db):
+    infra.mark_unreachable("vm-0", "TPU")
+    job = make_job(owner)
+    make_task(job, hostname="vm-0", chips=None)
+    job.enqueue()
+    service.do_run()
+    assert Job.get(job.id).status is JobStatus.pending
+
+
+def test_queued_job_skipped_when_restrictions_exclude_host(service, cluster, db):
+    # bob's only restriction covers a chip on a DIFFERENT host — vm-0 is not
+    # eligible for him, chips or not
+    bob = make_user(username="bob", password="SuperSecret42")
+    other = make_resource(hostname="vm-9", index=0)
+    make_restriction(user=bob, resources=[other])
+    job = make_job(bob)
+    make_task(job, hostname="vm-0", chips=None)
+    job.enqueue()
+    service.do_run()
+    assert Job.get(job.id).status is JobStatus.pending
+
+
+def test_queued_job_runs_when_restriction_covers_host_chip(service, cluster, db):
+    carol = make_user(username="carol", password="SuperSecret42")
+    chip = make_resource(hostname="vm-0", index=2)
+    make_restriction(user=carol, resources=[chip])
+    job = make_job(carol)
+    make_task(job, hostname="vm-0", chips=[2])
+    job.enqueue()
+    service.do_run()
+    assert Job.get(job.id).status is JobStatus.running

@@ -1,0 +1,84 @@
+"""Schema-upgrade path tests (reference: database.py:72-87 + 18 Alembic
+revisions; round-1 gap: the migration mechanism existed but had never run a
+non-trivial upgrade)."""
+from tensorhive_tpu.db.engine import Engine
+from tensorhive_tpu.db.migrations import MIGRATIONS, SCHEMA_VERSION, ensure_schema
+from tensorhive_tpu.db.models.user import User
+
+
+# the users-table DDL as it shipped at schema version 1 (before
+# last_login_at) — a frozen fixture, NOT derived from the live model
+V1_USERS_DDL = (
+    "CREATE TABLE users (id INTEGER PRIMARY KEY AUTOINCREMENT, "
+    "username TEXT NOT NULL UNIQUE, email TEXT NOT NULL, "
+    "_hashed_password TEXT NOT NULL, created_at TEXT)"
+)
+
+
+def make_v1_db(path) -> Engine:
+    engine = Engine(f"{path}/v1.sqlite3")
+    engine.execute(V1_USERS_DDL)
+    engine.execute(
+        "INSERT INTO users (username, email, _hashed_password, created_at) "
+        "VALUES ('olduser', 'old@example.com', 'pbkdf2-sha256$1$x$y', "
+        "'2025-01-01T00:00:00')"
+    )
+    engine.user_version = 1
+    return engine
+
+
+def test_migrations_registry_is_nonempty_and_ordered():
+    assert MIGRATIONS, "ship at least one real migration"
+    versions = [v for v, _ in MIGRATIONS]
+    assert versions == sorted(versions)
+    assert versions[-1] == SCHEMA_VERSION
+
+
+def test_upgrade_v1_to_current(tmp_path, config):
+    engine = make_v1_db(tmp_path)
+    cols = [row[1] for row in engine.execute("PRAGMA table_info(users)")]
+    assert "last_login_at" not in cols
+
+    ensure_schema(engine)
+
+    assert engine.user_version == SCHEMA_VERSION
+    cols = [row[1] for row in engine.execute("PRAGMA table_info(users)")]
+    assert "last_login_at" in cols
+    # pre-existing data survives and reads back through the ORM
+    row = engine.execute("SELECT username, last_login_at FROM users").fetchone()
+    assert row[0] == "olduser" and row[1] is None
+
+
+def test_upgrade_is_idempotent_after_crash(tmp_path, config):
+    """Re-running ensure_schema (crash between migrate and stamp) is safe."""
+    engine = make_v1_db(tmp_path)
+    for _, migrate in MIGRATIONS:
+        migrate(engine)  # migration ran but version was never stamped
+    assert engine.user_version == 1
+    ensure_schema(engine)  # re-applies everything
+    assert engine.user_version == SCHEMA_VERSION
+    assert engine.execute("SELECT COUNT(*) FROM users").fetchone()[0] == 1
+
+
+def test_fresh_db_is_stamped_at_latest(tmp_path, config):
+    engine = Engine(f"{tmp_path}/fresh.sqlite3")
+    ensure_schema(engine)
+    assert engine.user_version == SCHEMA_VERSION
+    cols = [row[1] for row in engine.execute("PRAGMA table_info(users)")]
+    assert "last_login_at" in cols
+
+
+def test_login_stamps_last_login(db, config):
+    from werkzeug.test import Client
+
+    from tensorhive_tpu.api.server import ApiApp
+    from tests.fixtures import make_user
+
+    config.api.secret_key = "test-secret"
+    make_user(username="zoe", password="SuperSecret42")
+    client = Client(ApiApp(url_prefix="api"))
+    payload = client.post(
+        "/api/user/login", json={"username": "zoe", "password": "SuperSecret42"}
+    ).get_json()
+    assert payload["user"]["lastLoginAt"] is not None
+    assert User.find_by_username("zoe").last_login_at is not None

@@ -1,0 +1,166 @@
+"""SshTransport argv-assembly tests (no sshd needed).
+
+The round-1 gap: ssh.py's option assembly, proxy-jump args, scp paths and
+quoting were only exercised via the local/fake backends — a typo in an ``-o``
+option would ship silently. These tests capture the exact argv handed to
+``subprocess.run`` (reference analog: tests/unit/test_ssh.py builds configs
+without real connections, SURVEY.md §4).
+"""
+import subprocess
+from types import SimpleNamespace
+
+import pytest
+
+from tensorhive_tpu.config import HostConfig
+from tensorhive_tpu.core.transport.ssh import SshTransport, _looks_like_ssh_failure
+from tensorhive_tpu.utils.exceptions import TransportError
+
+
+class ArgvRecorder:
+    """Stands in for subprocess.run; returns canned results, records argv."""
+
+    def __init__(self, returncode=0, stdout="", stderr=""):
+        self.calls = []
+        self.returncode = returncode
+        self.stdout = stdout
+        self.stderr = stderr
+
+    def __call__(self, argv, **kwargs):
+        self.calls.append(list(argv))
+        return SimpleNamespace(
+            returncode=self.returncode, stdout=self.stdout, stderr=self.stderr
+        )
+
+
+@pytest.fixture(autouse=True)
+def fake_ssh_on_path(monkeypatch):
+    """The test image has no openssh client; argv assembly doesn't need one."""
+    import tensorhive_tpu.core.transport.ssh as ssh_module
+
+    monkeypatch.setattr(ssh_module.shutil, "which", lambda name: f"/usr/bin/{name}")
+
+
+@pytest.fixture()
+def recorder(monkeypatch):
+    rec = ArgvRecorder()
+    monkeypatch.setattr(subprocess, "run", rec)
+    return rec
+
+
+def make_transport(config, user="alice", port=2222, address="tpu-vm-0.internal"):
+    host = HostConfig(name="tpu-vm-0", address=address, user=user, port=port)
+    return SshTransport(host, user=user, config=config)
+
+
+def opt_values(argv, flag="-o"):
+    """All values following occurrences of ``flag``."""
+    return [argv[i + 1] for i, a in enumerate(argv) if a == flag]
+
+
+def test_run_argv_shape(config, recorder):
+    transport = make_transport(config)
+    transport.run("uname -a")
+    argv = recorder.calls[0]
+    assert argv[0] == "ssh"
+    # command is ONE argv element — no shell re-splitting on our side
+    assert argv[-1] == "uname -a"
+    assert argv[-2] == "alice@tpu-vm-0.internal"
+    # ssh spells the port -p
+    assert argv[argv.index("-p") + 1] == "2222"
+    opts = opt_values(argv)
+    assert "BatchMode=yes" in opts
+    assert "StrictHostKeyChecking=accept-new" in opts
+    assert "ControlMaster=auto" in opts
+    assert "ControlPersist=60s" in opts
+    assert "ControlPath=~/.ssh/tpuhive-%r@%h:%p" in opts
+    assert f"ConnectTimeout={int(config.ssh.timeout_s)}" in opts
+
+
+def test_run_without_user_targets_bare_address(config, recorder):
+    host = HostConfig(name="vm", address="10.0.0.5", user="", port=22)
+    SshTransport(host, user=None, config=config).run("true")
+    argv = recorder.calls[0]
+    assert argv[-2] == "10.0.0.5"
+    assert "@" not in argv[-2]
+
+
+def test_identity_file_only_when_key_exists(config, recorder, tmp_path):
+    transport = make_transport(config)
+    transport.run("true")
+    assert "-i" not in recorder.calls[0]
+    config.ssh_key_path.parent.mkdir(parents=True, exist_ok=True)
+    config.ssh_key_path.write_text("fake key")
+    transport.run("true")
+    argv = recorder.calls[1]
+    assert argv[argv.index("-i") + 1] == str(config.ssh_key_path)
+
+
+def test_proxy_jump_args(config, recorder):
+    config.ssh.proxy_host = "bastion.corp"
+    config.ssh.proxy_port = 2200
+    config.ssh.proxy_user = "jump"
+    make_transport(config).run("true")
+    argv = recorder.calls[0]
+    assert argv[argv.index("-J") + 1] == "jump@bastion.corp:2200"
+
+
+def test_proxy_user_defaults_to_transport_user(config, recorder):
+    config.ssh.proxy_host = "bastion.corp"
+    config.ssh.proxy_user = ""
+    make_transport(config, user="bob").run("true")
+    argv = recorder.calls[0]
+    assert argv[argv.index("-J") + 1] == "bob@bastion.corp:22"
+
+
+def test_put_file_scp_argv_and_quoting(config, monkeypatch, tmp_path):
+    src = tmp_path / "probe.bin"
+    src.write_bytes(b"\x7fELF")
+    # the ~-expansion leg asks the host for $HOME first
+    rec = ArgvRecorder(stdout="/home/alice")
+    monkeypatch.setattr(subprocess, "run", rec)
+    transport = make_transport(config)
+    transport.put_file(str(src), "~/dir with spaces/probe", mode=0o755)
+    home_argv, mkdir_argv, scp_argv, chmod_argv = rec.calls
+    assert home_argv[-1] == 'printf %s "$HOME"'
+    expanded = "/home/alice/dir with spaces/probe"
+    # mkdir runs over ssh with the dirname substitution double-quoted so a
+    # space-y expansion cannot word-split
+    assert mkdir_argv[0] == "ssh"
+    assert mkdir_argv[-1] == f"mkdir -p \"$(dirname '{expanded}')\""
+    # scp spells the port -P and targets user@host:path
+    assert scp_argv[0] == "scp"
+    assert scp_argv[scp_argv.index("-P") + 1] == "2222"
+    assert scp_argv[-1] == f"alice@tpu-vm-0.internal:{expanded}"
+    assert scp_argv[-2] == str(src)
+    # same multiplexing options on the scp leg
+    assert "ControlMaster=auto" in opt_values(scp_argv)
+    assert chmod_argv[-1] == f"chmod 755 '{expanded}'"
+
+
+def test_exit_255_with_ssh_diagnostics_is_transport_error(config, monkeypatch):
+    rec = ArgvRecorder(returncode=255, stderr="ssh: connect to host x: refused")
+    monkeypatch.setattr(subprocess, "run", rec)
+    with pytest.raises(TransportError):
+        make_transport(config).run("true")
+
+
+def test_exit_255_from_remote_command_is_not_a_channel_failure(config, monkeypatch):
+    rec = ArgvRecorder(returncode=255, stderr="my-tool: fatal")
+    monkeypatch.setattr(subprocess, "run", rec)
+    result = make_transport(config).run("my-tool")
+    assert result.exit_code == 255
+
+
+def test_failure_marker_classifier():
+    assert _looks_like_ssh_failure("Permission denied (publickey)")
+    assert _looks_like_ssh_failure("Could not resolve hostname nope")
+    assert not _looks_like_ssh_failure("training diverged, loss=nan")
+
+
+def test_timeout_maps_to_transport_error(config, monkeypatch):
+    def boom(argv, **kwargs):
+        raise subprocess.TimeoutExpired(argv, 1.0)
+
+    monkeypatch.setattr(subprocess, "run", boom)
+    with pytest.raises(TransportError):
+        make_transport(config).run("sleep 100")
