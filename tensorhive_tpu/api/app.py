@@ -35,6 +35,7 @@ from ..utils.exceptions import (
 )
 from . import jwt as jwt_module
 from .jwt import AuthError
+from .schema import validate as schema_validate
 
 log = logging.getLogger(__name__)
 
@@ -46,16 +47,27 @@ class Endpoint:
     path: str
     methods: List[str]
     handler: Callable
-    auth: Optional[str]          # None | "jwt" | "admin"
+    auth: Optional[str]          # None | "jwt" | "admin" | "refresh" | "logout"*
     summary: str
     tag: str
+    #: request-body schema (api/schema.py subset); validated server-side
+    #: before the handler runs — malformed bodies 422 from the schema layer
+    body: Optional[Dict] = None
+    #: response schemas per status code (emitted in the OpenAPI doc)
+    responses: Optional[Dict[int, Dict]] = None
+    #: query-parameter schemas by name (documentation; int coercion stays
+    #: in int_arg so malformed values 422 consistently)
+    query: Optional[Dict[str, Dict]] = None
 
 
 _REGISTRY: List[Endpoint] = []
 
 
 def route(path: str, methods: List[str], auth: Optional[str] = "jwt",
-          summary: str = "", tag: str = "") -> Callable:
+          summary: str = "", tag: str = "",
+          body: Optional[Dict] = None,
+          responses: Optional[Dict[int, Dict]] = None,
+          query: Optional[Dict[str, Dict]] = None) -> Callable:
     """Register a controller function as an API operation."""
 
     def decorate(fn: Callable) -> Callable:
@@ -66,6 +78,9 @@ def route(path: str, methods: List[str], auth: Optional[str] = "jwt",
             auth=auth,
             summary=summary or (fn.__doc__ or "").strip().splitlines()[0] if fn.__doc__ else summary,
             tag=tag or fn.__module__.rsplit(".", 1)[-1],
+            body=body,
+            responses=responses,
+            query=query,
         ))
         return fn
 
@@ -118,14 +133,18 @@ class RequestContext:
             raise AuthError("token subject no longer exists")
         return user
 
+    _json_cache: Optional[Dict[str, Any]] = None
+
     def json(self) -> Dict[str, Any]:
-        try:
-            data = json.loads(self.request.get_data(as_text=True) or "{}")
-        except json.JSONDecodeError:
-            raise ValidationError("request body is not valid JSON")
-        if not isinstance(data, dict):
-            raise ValidationError("request body must be a JSON object")
-        return data
+        if self._json_cache is None:
+            try:
+                data = json.loads(self.request.get_data(as_text=True) or "{}")
+            except json.JSONDecodeError:
+                raise ValidationError("request body is not valid JSON")
+            if not isinstance(data, dict):
+                raise ValidationError("request body must be a JSON object")
+            self._json_cache = data
+        return self._json_cache
 
 
 class ApiApp:
@@ -168,6 +187,10 @@ class ApiApp:
         try:
             claims = self._authenticate(request, endpoint)
             context = RequestContext(request, claims)
+            if endpoint.body is not None and request.method in ("POST", "PUT", "PATCH"):
+                # spec-driven request validation (reference: Connexion
+                # strict_validation against api_specification.yml schemas)
+                schema_validate(context.json(), endpoint.body)
             result = endpoint.handler(context, **path_args)
             body, status = result if isinstance(result, tuple) else (result, 200)
             response = Response(

@@ -39,23 +39,43 @@ def _path_params(path: str) -> List[Dict]:
 
 
 def build_openapi(url_prefix: str, endpoints: Dict[str, "Endpoint"]) -> Dict:  # noqa: F821
+    from .schema import components
+
     paths: Dict[str, Dict] = {}
     for ep in endpoints.values():
         item = paths.setdefault(_openapi_path(ep.path), {})
         for method in ep.methods:
             if method == "OPTIONS":
                 continue
+            responses: Dict[str, Dict] = {}
+            for status, schema in (ep.responses or {200: None}).items():
+                entry: Dict = {"description": "success" if status < 400 else "error"}
+                if schema is not None:
+                    entry["content"] = {"application/json": {"schema": schema}}
+                responses[str(status)] = entry
             operation = {
                 "summary": ep.summary or "",
                 "tags": [ep.tag],
-                "responses": {"200": {"description": "success"}},
+                "responses": responses,
             }
+            if ep.body is not None and method in ("POST", "PUT", "PATCH"):
+                operation["requestBody"] = {
+                    "required": True,
+                    "content": {"application/json": {"schema": ep.body}},
+                }
+                operation["responses"].setdefault(
+                    "422", {"description": "request body failed schema validation"}
+                )
             if ep.auth is not None:
                 operation["security"] = [{"bearerAuth": []}]
                 operation["responses"]["401"] = {"description": "unauthorized"}
             if ep.auth == "admin":
                 operation["responses"]["403"] = {"description": "admin role required"}
             params = _path_params(ep.path)
+            for name, schema in (ep.query or {}).items():
+                params.append({
+                    "name": name, "in": "query", "required": False, "schema": schema,
+                })
             if params:
                 operation["parameters"] = params
             item[method.lower()] = operation
@@ -66,7 +86,8 @@ def build_openapi(url_prefix: str, endpoints: Dict[str, "Endpoint"]) -> Dict:  #
         "components": {
             "securitySchemes": {
                 "bearerAuth": {"type": "http", "scheme": "bearer", "bearerFormat": "JWT"}
-            }
+            },
+            "schemas": components(),
         },
         "paths": paths,
     }

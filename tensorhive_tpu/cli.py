@@ -171,7 +171,7 @@ def create_user(username, email, password, admin: bool, multiple: bool) -> None:
         return
     creator = AccountCreator(prompt=click.prompt, confirm=click.confirm, echo=click.echo)
     created = creator.run_prompt(multiple=multiple, username=username, email=email,
-                                 admin=True if admin else None)
+                                 password=password, admin=True if admin else None)
     click.echo(f"created {len(created)} account(s)")
     if not created:
         sys.exit(1)

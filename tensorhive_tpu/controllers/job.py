@@ -10,7 +10,9 @@ from __future__ import annotations
 import logging
 from typing import List, Optional
 
-from ..api.app import RequestContext, int_arg, json_body, route
+from ..api import schemas as S
+from ..api.app import RequestContext, int_arg, route
+from ..api.schema import arr, obj, s
 from ..core.templates import Placement, render_template, template_names
 from ..db.models.job import Job, JobStatus
 from ..db.models.task import SegmentType, Task, TaskStatus
@@ -68,7 +70,8 @@ def business_stop(job_id: int, gracefully: Optional[bool] = True) -> Job:
 
 # -- HTTP endpoints ----------------------------------------------------------
 
-@route("/jobs", ["GET"], summary="List jobs (optionally ?user_id=)", tag="jobs")
+@route("/jobs", ["GET"], summary="List jobs (optionally ?user_id=)", tag="jobs",
+       responses={200: arr(S.JOB)}, query={"user_id": s("integer")})
 def list_jobs(context: RequestContext):
     # Listing everyone's jobs is admin-only; non-admins may only list their
     # own (fullCommand embeds env segments, which commonly hold secrets).
@@ -82,16 +85,24 @@ def list_jobs(context: RequestContext):
     return [job.as_dict() for job in jobs]
 
 
-@route("/jobs/<int:job_id>", ["GET"], summary="Get one job with tasks", tag="jobs")
+@route("/jobs/<int:job_id>", ["GET"], summary="Get one job with tasks", tag="jobs",
+       responses={200: S.JOB})
 def get_job(context: RequestContext, job_id: int):
     job = _get_or_404(job_id)
     _assert_owner_or_admin(context, job)
     return job.as_dict()  # as_dict embeds task list
 
 
-@route("/jobs", ["POST"], summary="Create a job", tag="jobs")
+@route("/jobs", ["POST"], summary="Create a job", tag="jobs",
+       body=obj(required=["name"],
+                name=s("string", minLength=1),
+                description=s("string"),
+                userId=s("integer", description="admin-only: create for another user"),
+                startAt=s("string", format="date-time", nullable=True),
+                stopAt=s("string", format="date-time", nullable=True)),
+       responses={201: S.JOB})
 def create_job(context: RequestContext):
-    data = json_body(context, "name")
+    data = context.json()  # required fields enforced by the route schema
     user_id = context.user_id
     if context.is_admin and "userId" in data:
         user_id = User.get(int(data["userId"])).id
@@ -105,7 +116,11 @@ def create_job(context: RequestContext):
     return job.as_dict(), 201
 
 
-@route("/jobs/<int:job_id>", ["PUT"], summary="Update a job", tag="jobs")
+@route("/jobs/<int:job_id>", ["PUT"], summary="Update a job", tag="jobs",
+       body=obj(name=s("string", minLength=1), description=s("string"),
+                startAt=s("string", format="date-time", nullable=True),
+                stopAt=s("string", format="date-time", nullable=True)),
+       responses={200: S.JOB})
 def update_job(context: RequestContext, job_id: int):
     job = _get_or_404(job_id)
     _assert_owner_or_admin(context, job)
@@ -122,7 +137,8 @@ def update_job(context: RequestContext, job_id: int):
     return job.as_dict()
 
 
-@route("/jobs/<int:job_id>", ["DELETE"], summary="Delete a job", tag="jobs")
+@route("/jobs/<int:job_id>", ["DELETE"], summary="Delete a job", tag="jobs",
+       responses={200: S.MSG})
 def delete_job(context: RequestContext, job_id: int):
     job = _get_or_404(job_id)
     _assert_owner_or_admin(context, job)
@@ -135,14 +151,15 @@ def delete_job(context: RequestContext, job_id: int):
 
 
 @route("/jobs/<int:job_id>/execute", ["POST"], summary="Spawn all tasks of a job",
-       tag="jobs")
+       tag="jobs", responses={200: S.JOB})
 def execute(context: RequestContext, job_id: int):
     job = _get_or_404(job_id)
     _assert_owner_or_admin(context, job)
     return business_execute(job_id).as_dict()
 
 
-@route("/jobs/<int:job_id>/stop", ["POST"], summary="Stop all tasks of a job", tag="jobs")
+@route("/jobs/<int:job_id>/stop", ["POST"], summary="Stop all tasks of a job",
+       tag="jobs", body=S.GRACEFULLY_BODY, responses={200: S.JOB})
 def stop(context: RequestContext, job_id: int):
     job = _get_or_404(job_id)
     _assert_owner_or_admin(context, job)
@@ -152,21 +169,31 @@ def stop(context: RequestContext, job_id: int):
     return business_stop(job_id, gracefully).as_dict()
 
 
-@route("/templates", ["GET"], summary="Available launch-topology templates", tag="jobs")
+@route("/templates", ["GET"], summary="Available launch-topology templates",
+       tag="jobs", responses={200: arr(s("string"))})
 def list_templates(context: RequestContext):
     return template_names()
 
 
 @route("/jobs/<int:job_id>/tasks_from_template", ["POST"],
        summary="Generate the job's tasks from a distributed-launch template",
-       tag="jobs")
+       tag="jobs",
+       body=obj(required=["template", "command", "placements"],
+                template=s("string"),
+                command=s("string", minLength=1),
+                placements=arr(obj(required=["hostname"],
+                                   hostname=s("string"),
+                                   address=s("string"),
+                                   chips=arr(s("integer")))),
+                options=obj(extra=True)),
+       responses={201: arr(S.TASK)})
 def tasks_from_template(context: RequestContext, job_id: int):
     """Body: ``{template, command, placements: [{hostname, address?, chips?}],
     options?}`` — renders one task per process with auto-filled distributed
     wiring (the server-side TaskCreate.vue engine, core/templates.py)."""
     job = _get_or_404(job_id)
     _assert_owner_or_admin(context, job)
-    data = json_body(context, "template", "command", "placements")
+    data = context.json()  # required fields enforced by the route schema
     if not isinstance(data["placements"], list):
         raise ValidationError("placements must be a list of objects")
     placements = []
@@ -193,7 +220,7 @@ def tasks_from_template(context: RequestContext, job_id: int):
 
 
 @route("/jobs/<int:job_id>/enqueue", ["PUT"], summary="Place job in the scheduler queue",
-       tag="jobs")
+       tag="jobs", responses={200: S.JOB})
 def enqueue(context: RequestContext, job_id: int):
     job = _get_or_404(job_id)
     _assert_owner_or_admin(context, job)
@@ -202,7 +229,7 @@ def enqueue(context: RequestContext, job_id: int):
 
 
 @route("/jobs/<int:job_id>/dequeue", ["PUT"], summary="Remove job from the queue",
-       tag="jobs")
+       tag="jobs", responses={200: S.JOB})
 def dequeue(context: RequestContext, job_id: int):
     job = _get_or_404(job_id)
     _assert_owner_or_admin(context, job)

@@ -11,7 +11,9 @@ from __future__ import annotations
 import logging
 from typing import Dict, Optional
 
+from ..api import schemas as S
 from ..api.app import RequestContext, route
+from ..api.schema import arr, s
 from ..core.managers.manager import get_manager
 from ..db.models.resource import Resource
 from ..utils.exceptions import NotFoundError
@@ -47,17 +49,20 @@ def get_infrastructure(context: RequestContext) -> Dict:
     return context.current_user().filter_infrastructure_by_user_restrictions(snapshot)
 
 
-@route("/nodes/metrics", ["GET"], summary="Full telemetry snapshot", tag="nodes")
+@route("/nodes/metrics", ["GET"], summary="Full telemetry snapshot", tag="nodes",
+       responses={200: S.INFRASTRUCTURE})
 def get_all_data(context: RequestContext):
     return get_infrastructure(context)
 
 
-@route("/nodes/hostnames", ["GET"], summary="Managed hostnames", tag="nodes")
+@route("/nodes/hostnames", ["GET"], summary="Managed hostnames", tag="nodes",
+       responses={200: arr(s("string"))})
 def get_hostnames(context: RequestContext):
     return get_manager().infrastructure_manager.hostnames
 
 
-@route("/nodes/<hostname>/metrics", ["GET"], summary="One node's telemetry", tag="nodes")
+@route("/nodes/<hostname>/metrics", ["GET"], summary="One node's telemetry",
+       tag="nodes", responses={200: S.NODE})
 def get_node_metrics(context: RequestContext, hostname: str):
     infrastructure = get_infrastructure(context)
     if hostname not in infrastructure:
@@ -65,7 +70,8 @@ def get_node_metrics(context: RequestContext, hostname: str):
     return infrastructure[hostname]
 
 
-@route("/nodes/<hostname>/tpu/info", ["GET"], summary="Chip inventory on a node", tag="nodes")
+@route("/nodes/<hostname>/tpu/info", ["GET"], summary="Chip inventory on a node",
+       tag="nodes", responses={200: arr(S.CHIP_METRICS)})
 def get_tpu_info(context: RequestContext, hostname: str):
     node = get_node_metrics(context, hostname)
     return [
@@ -75,7 +81,11 @@ def get_tpu_info(context: RequestContext, hostname: str):
 
 
 @route("/nodes/<hostname>/tpu/processes", ["GET"],
-       summary="Per-chip processes on a node", tag="nodes")
+       summary="Per-chip processes on a node", tag="nodes",
+       responses={200: {"type": "object",
+                        "additionalProperties": {"type": "array",
+                                                 "items": {"type": "object",
+                                                           "additionalProperties": True}}}})
 def get_tpu_processes(context: RequestContext, hostname: str):
     node = get_node_metrics(context, hostname)
     return {
@@ -83,7 +93,9 @@ def get_tpu_processes(context: RequestContext, hostname: str):
     }
 
 
-@route("/nodes/<hostname>/cpu/metrics", ["GET"], summary="CPU/RAM metrics", tag="nodes")
+@route("/nodes/<hostname>/cpu/metrics", ["GET"], summary="CPU/RAM metrics",
+       tag="nodes",
+       responses={200: {"type": "object", "additionalProperties": True}})
 def get_cpu_metrics(context: RequestContext, hostname: str):
     node = get_node_metrics(context, hostname)
     return node.get("CPU", {})

@@ -7,7 +7,9 @@ only), delete (owners may only delete future reservations; admins any).
 """
 from __future__ import annotations
 
-from ..api.app import RequestContext, json_body, route
+from ..api import schemas as S
+from ..api.app import RequestContext, route
+from ..api.schema import arr, obj, s
 from ..core import verifier
 from ..db.models.reservation import Reservation
 from ..utils.exceptions import ForbiddenError, ValidationError
@@ -17,7 +19,11 @@ from ..utils.timeutils import parse_datetime, utcnow
 _get_or_404 = Reservation.get  # raises NotFoundError (→ 404) itself
 
 
-@route("/reservations", ["GET"], summary="List reservations (filterable)", tag="reservations")
+@route("/reservations", ["GET"], summary="List reservations (filterable)",
+       tag="reservations", responses={200: arr(S.RESERVATION)},
+       query={"resources_ids": s("string", description="comma-separated chip uids"),
+              "start": s("string", format="date-time"),
+              "end": s("string", format="date-time")})
 def list_reservations(context: RequestContext):
     """Query params: ``resources_ids`` (comma-separated uids), ``start``,
     ``end`` (ISO datetimes) — reference filter_by_uuids_and_time_range."""
@@ -30,14 +36,21 @@ def list_reservations(context: RequestContext):
 
 
 @route("/reservations/<int:reservation_id>", ["GET"], summary="Get one reservation",
-       tag="reservations")
+       tag="reservations", responses={200: S.RESERVATION})
 def get_reservation(context: RequestContext, reservation_id: int):
     return _get_or_404(reservation_id).as_dict()
 
 
-@route("/reservations", ["POST"], summary="Create a reservation", tag="reservations")
+@route("/reservations", ["POST"], summary="Create a reservation", tag="reservations",
+       body=obj(required=["title", "resourceId", "start", "end"],
+                title=s("string", minLength=1),
+                description=s("string"),
+                resourceId=s("string"),
+                start=s("string", format="date-time"),
+                end=s("string", format="date-time")),
+       responses={201: S.RESERVATION})
 def create_reservation(context: RequestContext):
-    data = json_body(context, "title", "resourceId", "start", "end")
+    data = context.json()  # required fields enforced by the route schema
     user = context.current_user()
     reservation = Reservation(
         title=data["title"],
@@ -61,7 +74,11 @@ _MUTABLE = ("title", "description", "start", "end")
 
 
 @route("/reservations/<int:reservation_id>", ["PUT"], summary="Update a reservation",
-       tag="reservations")
+       tag="reservations",
+       body=obj(title=s("string", minLength=1), description=s("string"),
+                start=s("string", format="date-time"),
+                end=s("string", format="date-time")),
+       responses={200: S.RESERVATION})
 def update_reservation(context: RequestContext, reservation_id: int):
     reservation = _get_or_404(reservation_id)
     if not context.is_admin and reservation.user_id != context.user_id:
@@ -87,7 +104,7 @@ def update_reservation(context: RequestContext, reservation_id: int):
 
 
 @route("/reservations/<int:reservation_id>", ["DELETE"], summary="Delete a reservation",
-       tag="reservations")
+       tag="reservations", responses={200: S.MSG})
 def delete_reservation(context: RequestContext, reservation_id: int):
     reservation = _get_or_404(reservation_id)
     if not context.is_admin:

@@ -10,7 +10,9 @@ from __future__ import annotations
 
 from typing import Iterable, List
 
-from ..api.app import RequestContext, json_body, route
+from ..api import schemas as S
+from ..api.app import RequestContext, route
+from ..api.schema import arr, obj, s
 from ..core import verifier
 from ..db.models.resource import Resource
 from ..db.models.restriction import Restriction
@@ -44,21 +46,28 @@ def _affected_users(restriction: Restriction) -> List[User]:
     return list(users.values())
 
 
-@route("/restrictions", ["GET"], summary="List restrictions", tag="restrictions")
+@route("/restrictions", ["GET"], summary="List restrictions", tag="restrictions",
+       responses={200: arr(S.RESTRICTION)})
 def list_restrictions(context: RequestContext):
     return [r.as_dict() for r in Restriction.all()]
 
 
 @route("/restrictions/<int:restriction_id>", ["GET"], summary="Get one restriction",
-       tag="restrictions")
+       tag="restrictions", responses={200: S.RESTRICTION})
 def get_restriction(context: RequestContext, restriction_id: int):
     return _get_or_404(restriction_id).as_dict()
 
 
 @route("/restrictions", ["POST"], auth="admin", summary="Create a restriction",
-       tag="restrictions")
+       tag="restrictions",
+       body=obj(required=["name", "startsAt"],
+                name=s("string", minLength=1),
+                startsAt=s("string", format="date-time"),
+                endsAt=s("string", format="date-time", nullable=True),
+                isGlobal=s("boolean")),
+       responses={201: S.RESTRICTION})
 def create_restriction(context: RequestContext):
-    data = json_body(context, "name", "startsAt")
+    data = context.json()  # required fields enforced by the route schema
     restriction = Restriction(
         name=data["name"],
         starts_at=parse_datetime(data["startsAt"]),
@@ -71,7 +80,12 @@ def create_restriction(context: RequestContext):
 
 
 @route("/restrictions/<int:restriction_id>", ["PUT"], auth="admin",
-       summary="Update a restriction", tag="restrictions")
+       summary="Update a restriction", tag="restrictions",
+       body=obj(name=s("string", minLength=1),
+                startsAt=s("string", format="date-time"),
+                endsAt=s("string", format="date-time", nullable=True),
+                isGlobal=s("boolean")),
+       responses={200: S.RESTRICTION})
 def update_restriction(context: RequestContext, restriction_id: int):
     restriction = _get_or_404(restriction_id)
     data = context.json()
@@ -91,7 +105,7 @@ def update_restriction(context: RequestContext, restriction_id: int):
 
 
 @route("/restrictions/<int:restriction_id>", ["DELETE"], auth="admin",
-       summary="Delete a restriction", tag="restrictions")
+       summary="Delete a restriction", tag="restrictions", responses={200: S.MSG})
 def delete_restriction(context: RequestContext, restriction_id: int):
     restriction = _get_or_404(restriction_id)
     affected = User.all() if restriction.is_global else _affected_users(restriction)
@@ -117,7 +131,8 @@ _schedule_or_404 = RestrictionSchedule.get
 
 
 @route("/restrictions/<int:restriction_id>/users/<int:user_id>", ["PUT"], auth="admin",
-       summary="Apply restriction to a user", tag="restrictions")
+       summary="Apply restriction to a user", tag="restrictions",
+       responses={200: S.RESTRICTION})
 def apply_to_user(context: RequestContext, restriction_id: int, user_id: int):
     restriction, user = _get_or_404(restriction_id), _user_or_404(user_id)
     restriction.apply_to_user(user)
@@ -126,7 +141,8 @@ def apply_to_user(context: RequestContext, restriction_id: int, user_id: int):
 
 
 @route("/restrictions/<int:restriction_id>/users/<int:user_id>", ["DELETE"], auth="admin",
-       summary="Remove restriction from a user", tag="restrictions")
+       summary="Remove restriction from a user", tag="restrictions",
+       responses={200: S.RESTRICTION})
 def remove_from_user(context: RequestContext, restriction_id: int, user_id: int):
     restriction, user = _get_or_404(restriction_id), _user_or_404(user_id)
     restriction.remove_from_user(user)
@@ -135,7 +151,8 @@ def remove_from_user(context: RequestContext, restriction_id: int, user_id: int)
 
 
 @route("/restrictions/<int:restriction_id>/groups/<int:group_id>", ["PUT"], auth="admin",
-       summary="Apply restriction to a group", tag="restrictions")
+       summary="Apply restriction to a group", tag="restrictions",
+       responses={200: S.RESTRICTION})
 def apply_to_group(context: RequestContext, restriction_id: int, group_id: int):
     restriction, group = _get_or_404(restriction_id), _group_or_404(group_id)
     restriction.apply_to_group(group)
@@ -144,7 +161,8 @@ def apply_to_group(context: RequestContext, restriction_id: int, group_id: int):
 
 
 @route("/restrictions/<int:restriction_id>/groups/<int:group_id>", ["DELETE"], auth="admin",
-       summary="Remove restriction from a group", tag="restrictions")
+       summary="Remove restriction from a group", tag="restrictions",
+       responses={200: S.RESTRICTION})
 def remove_from_group(context: RequestContext, restriction_id: int, group_id: int):
     restriction, group = _get_or_404(restriction_id), _group_or_404(group_id)
     restriction.remove_from_group(group)
@@ -153,7 +171,8 @@ def remove_from_group(context: RequestContext, restriction_id: int, group_id: in
 
 
 @route("/restrictions/<int:restriction_id>/resources/<uid>", ["PUT"], auth="admin",
-       summary="Apply restriction to a resource", tag="restrictions")
+       summary="Apply restriction to a resource", tag="restrictions",
+       responses={200: S.RESTRICTION})
 def apply_to_resource(context: RequestContext, restriction_id: int, uid: str):
     restriction, resource = _get_or_404(restriction_id), _resource_or_404(uid)
     restriction.apply_to_resource(resource)
@@ -162,7 +181,8 @@ def apply_to_resource(context: RequestContext, restriction_id: int, uid: str):
 
 
 @route("/restrictions/<int:restriction_id>/resources/<uid>", ["DELETE"], auth="admin",
-       summary="Remove restriction from a resource", tag="restrictions")
+       summary="Remove restriction from a resource", tag="restrictions",
+       responses={200: S.RESTRICTION})
 def remove_from_resource(context: RequestContext, restriction_id: int, uid: str):
     restriction, resource = _get_or_404(restriction_id), _resource_or_404(uid)
     restriction.remove_from_resource(resource)
@@ -171,7 +191,8 @@ def remove_from_resource(context: RequestContext, restriction_id: int, uid: str)
 
 
 @route("/restrictions/<int:restriction_id>/hosts/<hostname>", ["PUT"], auth="admin",
-       summary="Apply restriction to every chip of a host", tag="restrictions")
+       summary="Apply restriction to every chip of a host", tag="restrictions",
+       responses={200: S.RESTRICTION})
 def apply_to_hostname(context: RequestContext, restriction_id: int, hostname: str):
     restriction = _get_or_404(restriction_id)
     count = restriction.apply_to_resources_by_hostname(hostname)
@@ -182,7 +203,8 @@ def apply_to_hostname(context: RequestContext, restriction_id: int, hostname: st
 
 
 @route("/restrictions/<int:restriction_id>/schedules/<int:schedule_id>", ["PUT"],
-       auth="admin", summary="Attach a schedule", tag="restrictions")
+       auth="admin", summary="Attach a schedule", tag="restrictions",
+       responses={200: S.RESTRICTION})
 def add_schedule(context: RequestContext, restriction_id: int, schedule_id: int):
     restriction, schedule = _get_or_404(restriction_id), _schedule_or_404(schedule_id)
     restriction.add_schedule(schedule)
@@ -193,7 +215,8 @@ def add_schedule(context: RequestContext, restriction_id: int, schedule_id: int)
 
 
 @route("/restrictions/<int:restriction_id>/schedules/<int:schedule_id>", ["DELETE"],
-       auth="admin", summary="Detach a schedule", tag="restrictions")
+       auth="admin", summary="Detach a schedule", tag="restrictions",
+       responses={200: S.RESTRICTION})
 def remove_schedule(context: RequestContext, restriction_id: int, schedule_id: int):
     restriction, schedule = _get_or_404(restriction_id), _schedule_or_404(schedule_id)
     restriction.remove_schedule(schedule)

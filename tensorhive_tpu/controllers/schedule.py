@@ -4,7 +4,9 @@ effective windows of every restriction it is attached to, so both paths
 re-verify affected users' reservations (reference schedule.py:97-98, :125)."""
 from __future__ import annotations
 
-from ..api.app import RequestContext, json_body, route
+from ..api import schemas as S
+from ..api.app import RequestContext, route
+from ..api.schema import arr, obj, s
 from ..core import verifier
 from ..db.models.schedule import RestrictionSchedule
 from ..db.models.user import User
@@ -30,19 +32,28 @@ def _reverify_attached(schedule: RestrictionSchedule) -> None:
         verifier.reverify_user(user)
 
 
-@route("/schedules", ["GET"], summary="List schedules", tag="schedules")
+@route("/schedules", ["GET"], summary="List schedules", tag="schedules",
+       responses={200: arr(S.SCHEDULE)})
 def list_schedules(context: RequestContext):
     return [s.as_dict() for s in RestrictionSchedule.all()]
 
 
-@route("/schedules/<int:schedule_id>", ["GET"], summary="Get one schedule", tag="schedules")
+@route("/schedules/<int:schedule_id>", ["GET"], summary="Get one schedule",
+       tag="schedules", responses={200: S.SCHEDULE})
 def get_schedule(context: RequestContext, schedule_id: int):
     return _get_or_404(schedule_id).as_dict()
 
 
-@route("/schedules", ["POST"], auth="admin", summary="Create a schedule", tag="schedules")
+@route("/schedules", ["POST"], auth="admin", summary="Create a schedule",
+       tag="schedules",
+       body=obj(required=["scheduleDays", "hourStart", "hourEnd"],
+                scheduleDays=s("string", minLength=1,
+                               description="weekday mask, e.g. '12345'"),
+                hourStart=s("string", example="08:00"),
+                hourEnd=s("string", example="20:00")),
+       responses={201: S.SCHEDULE})
 def create_schedule(context: RequestContext):
-    data = json_body(context, "scheduleDays", "hourStart", "hourEnd")
+    data = context.json()  # required fields enforced by the route schema
     schedule = RestrictionSchedule(
         schedule_days=data["scheduleDays"],
         hour_start=data["hourStart"],
@@ -51,8 +62,11 @@ def create_schedule(context: RequestContext):
     return schedule.as_dict(), 201
 
 
-@route("/schedules/<int:schedule_id>", ["PUT"], auth="admin", summary="Update a schedule",
-       tag="schedules")
+@route("/schedules/<int:schedule_id>", ["PUT"], auth="admin",
+       summary="Update a schedule", tag="schedules",
+       body=obj(scheduleDays=s("string", minLength=1),
+                hourStart=s("string"), hourEnd=s("string")),
+       responses={200: S.SCHEDULE})
 def update_schedule(context: RequestContext, schedule_id: int):
     schedule = _get_or_404(schedule_id)
     data = context.json()
@@ -68,7 +82,7 @@ def update_schedule(context: RequestContext, schedule_id: int):
 
 
 @route("/schedules/<int:schedule_id>", ["DELETE"], auth="admin",
-       summary="Delete a schedule", tag="schedules")
+       summary="Delete a schedule", tag="schedules", responses={200: S.MSG})
 def delete_schedule(context: RequestContext, schedule_id: int):
     schedule = _get_or_404(schedule_id)
     # collect the attached restrictions BEFORE the row (and its links) go away

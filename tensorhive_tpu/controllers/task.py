@@ -14,7 +14,9 @@ from __future__ import annotations
 import logging
 from typing import Optional
 
-from ..api.app import RequestContext, int_arg, json_body, route
+from ..api import schemas as S
+from ..api.app import RequestContext, int_arg, route
+from ..api.schema import arr, obj, s
 from ..core.nursery import Termination, get_ops_factory
 from ..db.models.job import Job
 from ..db.models.task import CHIP_ENV_VAR, SegmentType, Task, TaskStatus
@@ -123,7 +125,8 @@ def business_get_log(task_id: int, tail: Optional[int] = None) -> str:
 
 # -- HTTP endpoints ----------------------------------------------------------
 
-@route("/tasks", ["GET"], summary="List tasks (optionally ?job_id=)", tag="tasks")
+@route("/tasks", ["GET"], summary="List tasks (optionally ?job_id=)", tag="tasks",
+       responses={200: arr(S.TASK)}, query={"job_id": s("integer")})
 def list_tasks(context: RequestContext):
     # Listing all tasks is admin-only; non-admins may only list tasks of a
     # job they own (fullCommand embeds env-segment values — often secrets).
@@ -139,15 +142,24 @@ def list_tasks(context: RequestContext):
     return [task.as_dict() for task in tasks]
 
 
-@route("/tasks/<int:task_id>", ["GET"], summary="Get one task (synchronized)", tag="tasks")
+@route("/tasks/<int:task_id>", ["GET"], summary="Get one task (synchronized)",
+       tag="tasks", responses={200: S.TASK})
 def get_task(context: RequestContext, task_id: int):
     _assert_owner_or_admin(context, _get_or_404(task_id))
     return synchronize(task_id).as_dict()
 
 
-@route("/tasks", ["POST"], summary="Create a task under a job", tag="tasks")
+@route("/tasks", ["POST"], summary="Create a task under a job", tag="tasks",
+       body=obj(required=["jobId", "hostname", "command"],
+                jobId=s("integer"),
+                hostname=s("string", minLength=1),
+                command=s("string", minLength=1),
+                envVariables=arr(obj(required=["name"], name=s("string", minLength=1), value=s("string"))),
+                parameters=arr(obj(required=["name"], name=s("string", minLength=1), value=s("string"))),
+                chips=arr(s("integer"))),
+       responses={201: S.TASK})
 def create_task(context: RequestContext):
-    data = json_body(context, "jobId", "hostname", "command")
+    data = context.json()  # required fields enforced by the route schema
     job = Job.get(int(data["jobId"]))
     if not context.is_admin and job.user_id != context.user_id:
         raise ForbiddenError("only the job owner or an admin may add tasks")
@@ -165,7 +177,13 @@ def create_task(context: RequestContext):
     return task.as_dict(), 201
 
 
-@route("/tasks/<int:task_id>", ["PUT"], summary="Update a task", tag="tasks")
+@route("/tasks/<int:task_id>", ["PUT"], summary="Update a task", tag="tasks",
+       body=obj(hostname=s("string", minLength=1),
+                command=s("string", minLength=1),
+                envVariables=arr(obj(required=["name"], name=s("string", minLength=1), value=s("string"))),
+                parameters=arr(obj(required=["name"], name=s("string", minLength=1), value=s("string"))),
+                removeSegments=arr(s("string"))),
+       responses={200: S.TASK})
 def update_task(context: RequestContext, task_id: int):
     task = _get_or_404(task_id)
     _assert_owner_or_admin(context, task)
@@ -186,7 +204,8 @@ def update_task(context: RequestContext, task_id: int):
     return task.as_dict()
 
 
-@route("/tasks/<int:task_id>", ["DELETE"], summary="Delete a task", tag="tasks")
+@route("/tasks/<int:task_id>", ["DELETE"], summary="Delete a task", tag="tasks",
+       responses={200: S.MSG})
 def delete_task(context: RequestContext, task_id: int):
     task = _get_or_404(task_id)
     _assert_owner_or_admin(context, task)
@@ -198,7 +217,7 @@ def delete_task(context: RequestContext, task_id: int):
 
 
 @route("/tasks/<int:task_id>/spawn", ["POST"], summary="Spawn the task's process",
-       tag="tasks")
+       tag="tasks", responses={200: S.TASK})
 def spawn(context: RequestContext, task_id: int):
     task = _get_or_404(task_id)
     _assert_owner_or_admin(context, task)
@@ -209,7 +228,7 @@ def spawn(context: RequestContext, task_id: int):
 
 
 @route("/tasks/<int:task_id>/terminate", ["POST"], summary="Signal the task's process",
-       tag="tasks")
+       tag="tasks", body=S.GRACEFULLY_BODY, responses={200: S.TASK})
 def terminate(context: RequestContext, task_id: int):
     task = _get_or_404(task_id)
     _assert_owner_or_admin(context, task)
@@ -221,7 +240,7 @@ def terminate(context: RequestContext, task_id: int):
 
 
 @route("/tasks/<int:task_id>/log", ["GET"], summary="Fetch the task's output log",
-       tag="tasks")
+       tag="tasks", responses={200: S.TASK_LOG}, query={"tail": s("integer")})
 def get_log(context: RequestContext, task_id: int):
     task = _get_or_404(task_id)
     _assert_owner_or_admin(context, task)

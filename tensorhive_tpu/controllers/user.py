@@ -12,7 +12,9 @@ from __future__ import annotations
 import logging
 
 from ..api import jwt as jwt_module
-from ..api.app import RequestContext, json_body, route
+from ..api import schemas as S
+from ..api.app import RequestContext, route
+from ..api.schema import arr, obj, s
 from ..db.models.user import Group, User
 from ..utils.exceptions import ForbiddenError, ValidationError
 from ..utils.timeutils import utcnow
@@ -30,21 +32,24 @@ _get_or_404 = User.get  # Model.get raises NotFoundError (→ 404) itself
 
 # -- CRUD -------------------------------------------------------------------
 
-@route("/users", ["GET"], auth="admin", summary="List all users", tag="users")
+@route("/users", ["GET"], auth="admin", summary="List all users", tag="users",
+       responses={200: arr(S.USER)})
 def list_users(context: RequestContext):
     return [user.as_dict() for user in User.all()]
 
 
-@route("/users/<int:user_id>", ["GET"], summary="Get one user", tag="users")
+@route("/users/<int:user_id>", ["GET"], summary="Get one user", tag="users",
+       responses={200: S.USER})
 def get_user(context: RequestContext, user_id: int):
     if not context.is_admin and context.user_id != user_id:
         raise ForbiddenError("only admins may view other accounts")
     return _get_or_404(user_id).as_dict()
 
 
-@route("/users", ["POST"], auth="admin", summary="Create a user", tag="users")
+@route("/users", ["POST"], auth="admin", summary="Create a user", tag="users",
+       body=S.CREATE_USER_BODY, responses={201: S.USER})
 def create_user(context: RequestContext):
-    data = json_body(context, "username", "email", "password")
+    data = context.json()  # required fields enforced by the route schema
     if User.find_by_username(data["username"]) is not None:
         raise ValidationError(f"username {data['username']!r} already taken")
     user = User(
@@ -57,7 +62,8 @@ def create_user(context: RequestContext):
     return user.as_dict(), 201
 
 
-@route("/users/<int:user_id>", ["PUT"], summary="Update a user", tag="users")
+@route("/users/<int:user_id>", ["PUT"], summary="Update a user", tag="users",
+       body=S.UPDATE_USER_BODY, responses={200: S.USER})
 def update_user(context: RequestContext, user_id: int):
     if not context.is_admin and context.user_id != user_id:
         raise ForbiddenError("only admins may modify other accounts")
@@ -80,7 +86,8 @@ def update_user(context: RequestContext, user_id: int):
     return user.as_dict()
 
 
-@route("/users/<int:user_id>", ["DELETE"], auth="admin", summary="Delete a user", tag="users")
+@route("/users/<int:user_id>", ["DELETE"], auth="admin", summary="Delete a user",
+       tag="users", responses={200: S.MSG})
 def delete_user(context: RequestContext, user_id: int):
     _get_or_404(user_id).destroy()
     return {"msg": "user deleted"}
@@ -88,9 +95,10 @@ def delete_user(context: RequestContext, user_id: int):
 
 # -- session ---------------------------------------------------------------
 
-@route("/user/login", ["POST"], auth=None, summary="Log in, returns JWT pair", tag="auth")
+@route("/user/login", ["POST"], auth=None, summary="Log in, returns JWT pair",
+       tag="auth", body=S.LOGIN_BODY, responses={200: S.TOKEN_PAIR})
 def login(context: RequestContext):
-    data = json_body(context, "username", "password")
+    data = context.json()  # required fields enforced by the route schema
     user = User.find_by_username(data["username"])
     if user is None or not user.check_password(data["password"]):
         raise jwt_module.AuthError("invalid credentials")
@@ -104,7 +112,8 @@ def login(context: RequestContext):
 
 
 @route("/user/logout", ["POST"], auth="logout",
-       summary="Revoke the presented access token", tag="auth")
+       summary="Revoke the presented access token", tag="auth",
+       responses={200: S.MSG})
 def logout(context: RequestContext):
     # _authenticate already signature-verified the token (auth="logout")
     jwt_module.revoke_claims(context.claims)
@@ -112,14 +121,16 @@ def logout(context: RequestContext):
 
 
 @route("/user/logout/refresh", ["POST"], auth="logout-refresh",
-       summary="Revoke the presented refresh token", tag="auth")
+       summary="Revoke the presented refresh token", tag="auth",
+       responses={200: S.MSG})
 def logout_refresh(context: RequestContext):
     jwt_module.revoke_claims(context.claims)
     return {"msg": "refresh token revoked"}
 
 
 @route("/user/refresh", ["POST"], auth="refresh",
-       summary="Mint a new access token from a refresh token", tag="auth")
+       summary="Mint a new access token from a refresh token", tag="auth",
+       responses={200: obj(required=["accessToken"], accessToken=s("string"))})
 def refresh(context: RequestContext):
     user = context.current_user()
     return {"accessToken": jwt_module.create_access_token(user.id, user.roles)}
@@ -128,7 +139,8 @@ def refresh(context: RequestContext):
 # -- ssh signup (reference user.py:99-123) ----------------------------------
 
 @route("/user/ssh_signup", ["POST"], auth=None,
-       summary="Sign up by proving SSH access to a managed host", tag="auth")
+       summary="Sign up by proving SSH access to a managed host", tag="auth",
+       body=S.SIGNUP_BODY, responses={201: S.USER})
 def ssh_signup(context: RequestContext):
     """The reference verifies the claimed unix account by connecting to the
     first configured node as that user with the manager's key — same here,
@@ -136,7 +148,7 @@ def ssh_signup(context: RequestContext):
     from ..config import get_config
     from ..core.transport.base import get_transport_manager
 
-    data = json_body(context, "username", "email", "password")
+    data = context.json()  # required fields enforced by the route schema
     config = get_config()
     if not config.hosts:
         raise ValidationError("no managed hosts configured; signup unavailable")
@@ -158,7 +170,9 @@ def ssh_signup(context: RequestContext):
 
 
 @route("/user/authorized_keys_entry", ["GET"], auth=None,
-       summary="Manager public key for ~/.ssh/authorized_keys", tag="auth")
+       summary="Manager public key for ~/.ssh/authorized_keys", tag="auth",
+       responses={200: obj(required=["authorizedKeysEntry"],
+                           authorizedKeysEntry=s("string"))})
 def authorized_keys_entry(context: RequestContext):
     from ..config import get_config
     from ..core.transport.ssh import generate_keypair

@@ -57,7 +57,15 @@ class AccountCreator:
     # -- single-account creation (shared with `init` / non-interactive path) --
     @staticmethod
     def create_account(username: str, email: str, password: str, admin: bool = False) -> User:
-        user = User(username=username, email=email, password=password).save()
+        import sqlite3
+
+        try:
+            user = User(username=username, email=email, password=password).save()
+        except sqlite3.IntegrityError as exc:
+            # duplicate username racing past the prompt-time check — surface
+            # it as the same error type the validators use, so both the CLI
+            # and the interactive loop show a message instead of a traceback
+            raise ValidationError(f"username {username!r} is already taken") from exc
         user.add_role("user")
         if admin:
             user.add_role("admin")
@@ -71,19 +79,20 @@ class AccountCreator:
         multiple: bool = False,
         username: Optional[str] = None,
         email: Optional[str] = None,
+        password: Optional[str] = None,
         admin: Optional[bool] = None,
     ) -> List[User]:
         """Prompt for one account (or several with ``multiple``); invalid
         field values re-prompt instead of aborting the whole flow.
-        Pre-supplied ``username``/``email`` values are tried before
-        prompting (partial CLI flags); ``admin=True`` skips the role
+        Pre-supplied ``username``/``email``/``password`` values are tried
+        before prompting (partial CLI flags); ``admin=True`` skips the role
         question (``--admin`` on the interactive path). Presets apply to
         the first account only when looping."""
         ensure_default_group_bootstrap(self.echo)
         created: List[User] = []
         while True:
-            user = self._prompt_one(username, email, admin)
-            username = email = None  # presets are single-use
+            user = self._prompt_one(username, email, password, admin)
+            username = email = password = None  # presets are single-use
             if user is not None:
                 created.append(user)
                 self.echo(f"user {user.username!r} created")
@@ -94,6 +103,7 @@ class AccountCreator:
         self,
         preset_username: Optional[str] = None,
         preset_email: Optional[str] = None,
+        preset_password: Optional[str] = None,
         admin: Optional[bool] = None,
     ) -> Optional[User]:
         username = self._prompt_valid("username", User.validate_username,
@@ -106,6 +116,7 @@ class AccountCreator:
         password = self._prompt_valid(
             "password",
             User.validate_password,
+            preset=preset_password,
             hide_input=True,
             confirmation_prompt=True,
         )

@@ -416,3 +416,90 @@ def test_malformed_json_body_is_422(api, admin_headers):
     response = api.post("/api/groups", data="{not json",
                         content_type="application/json", headers=admin_headers)
     assert response.status_code == 422
+
+
+# -- OpenAPI schemas + server-side validation (round-1 gap: bare
+# "200: success" responses, no request schemas) ------------------------------
+
+def test_openapi_document_has_typed_schemas_everywhere(api):
+    doc = api.get("/api/openapi.json").get_json()
+    schemas = doc["components"]["schemas"]
+    assert {"User", "Job", "Task", "Reservation", "Restriction", "Schedule",
+            "Group", "Resource", "Msg", "TokenPair"} <= set(schemas)
+    mutating_without_body = []
+    reads_without_schema = []
+    for path, item in doc["paths"].items():
+        for method, op in item.items():
+            if method in ("post", "put", "patch") and "requestBody" not in op:
+                mutating_without_body.append(f"{method.upper()} {path}")
+            ok = op["responses"].get("200") or op["responses"].get("201")
+            if ok is not None and "content" not in ok:
+                reads_without_schema.append(f"{method.upper()} {path}")
+    # every response carries a typed schema...
+    assert reads_without_schema == [], reads_without_schema
+    # ...and only operations that genuinely take no payload lack a request
+    # body (a new POST/PUT shipped without a schema fails here)
+    BODYLESS_OK = {
+        "/jobs/{job_id}/execute", "/jobs/{job_id}/enqueue", "/jobs/{job_id}/dequeue",
+        "/tasks/{task_id}/spawn", "/user/logout", "/user/logout/refresh",
+        "/user/refresh", "/groups/{group_id}/users/{user_id}",
+        "/restrictions/{restriction_id}/users/{user_id}",
+        "/restrictions/{restriction_id}/groups/{group_id}",
+        "/restrictions/{restriction_id}/resources/{uid}",
+        "/restrictions/{restriction_id}/hosts/{hostname}",
+        "/restrictions/{restriction_id}/schedules/{schedule_id}",
+    }
+    unexpected = [entry for entry in mutating_without_body
+                  if entry.split(" ", 1)[1] not in BODYLESS_OK]
+    assert unexpected == [], unexpected
+    # every $ref used anywhere must resolve inside the document
+    text = json.dumps(doc)
+    import re
+    for ref in set(re.findall(r'"\$ref": "([^"]+)"', text)):
+        assert ref.startswith("#/components/schemas/")
+        assert ref.rsplit("/", 1)[-1] in schemas, ref
+
+
+def test_malformed_bodies_rejected_by_schema_layer(api, admin_headers):
+    headers = admin_headers
+    # wrong type
+    r = api.post("/api/jobs", json={"name": 123}, headers=headers)
+    assert r.status_code == 422 and "body.name" in r.get_json()["msg"]
+    # unknown field
+    r = api.post("/api/jobs", json={"name": "ok", "nope": 1}, headers=headers)
+    assert r.status_code == 422 and "unknown field" in r.get_json()["msg"]
+    # missing required field
+    r = api.post("/api/reservations", json={"title": "x"}, headers=headers)
+    assert r.status_code == 422 and "missing required" in r.get_json()["msg"]
+    # nested path: placements item missing hostname
+    job = api.post("/api/jobs", json={"name": "j"}, headers=headers).get_json()
+    r = api.post(f"/api/jobs/{job['id']}/tasks_from_template", headers=headers,
+                 json={"template": "plain", "command": "c",
+                       "placements": [{"address": "10.0.0.1"}]})
+    assert r.status_code == 422 and "placements[0]" in r.get_json()["msg"]
+    # enum violation on roles
+    r = api.post("/api/users", headers=headers,
+                 json={"username": "abc", "email": "a@b.co",
+                       "password": "longenough", "admin": "yes"})
+    assert r.status_code == 422 and "body.admin" in r.get_json()["msg"]
+
+
+def test_response_shapes_match_declared_schemas(api, admin_headers, user):
+    """The wire format must satisfy the very schemas the spec publishes."""
+    from tensorhive_tpu.api import schemas as S
+    from tensorhive_tpu.api.schema import arr as arr_, validate
+
+    headers = admin_headers
+    make_permissive_restriction()
+    res = make_resource(hostname="vm-0", index=0)
+    make_reservation(user, res.uid)
+    validate(api.get("/api/users", headers=headers).get_json(), arr_(S.USER))
+    validate(api.get("/api/reservations", headers=headers).get_json(),
+             arr_(S.RESERVATION))
+    validate(api.get("/api/restrictions", headers=headers).get_json(),
+             arr_(S.RESTRICTION))
+    validate(api.get("/api/resources", headers=headers).get_json(),
+             arr_(S.RESOURCE))
+    job = api.post("/api/jobs", json={"name": "train"}, headers=headers).get_json()
+    validate(job, S.JOB)
+    validate(api.get("/api/jobs", headers=headers).get_json(), arr_(S.JOB))

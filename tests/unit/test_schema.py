@@ -1,0 +1,80 @@
+"""Schema-subset validator tests (api/schema.py)."""
+import pytest
+
+from tensorhive_tpu.api.schema import arr, component, obj, s, validate
+from tensorhive_tpu.utils.exceptions import ValidationError
+
+
+def test_type_checks():
+    validate({"a": 1}, obj(a=s("integer")))
+    validate("x", s("string"))
+    validate(1.5, s("number"))
+    validate(2, s("number"))  # ints are numbers
+    validate(True, s("boolean"))
+    with pytest.raises(ValidationError, match="expected integer"):
+        validate({"a": "1"}, obj(a=s("integer")))
+    with pytest.raises(ValidationError, match="expected integer"):
+        validate({"a": True}, obj(a=s("integer")))  # bool is NOT an integer
+    with pytest.raises(ValidationError, match="expected boolean"):
+        validate({"a": 1}, obj(a=s("boolean")))
+
+
+def test_required_and_unknown_fields():
+    schema = obj(required=["name"], name=s("string"), age=s("integer"))
+    validate({"name": "x"}, schema)
+    with pytest.raises(ValidationError, match="missing required field 'name'"):
+        validate({}, schema)
+    with pytest.raises(ValidationError, match="unknown field 'nope'"):
+        validate({"name": "x", "nope": 1}, schema)
+    # extra=True permits undeclared fields
+    validate({"name": "x", "whatever": 1}, obj(required=["name"], extra=True, name=s("string")))
+
+
+def test_nullable_and_enum():
+    validate(None, s("string", nullable=True))
+    with pytest.raises(ValidationError, match="must not be null"):
+        validate(None, s("string"))
+    validate("a", s("string", enum=["a", "b"]))
+    with pytest.raises(ValidationError, match="must be one of"):
+        validate("c", s("string", enum=["a", "b"]))
+
+
+def test_string_and_number_bounds():
+    with pytest.raises(ValidationError, match="shorter than 3"):
+        validate("ab", s("string", minLength=3))
+    with pytest.raises(ValidationError, match="below minimum 1"):
+        validate(0, s("integer", minimum=1))
+
+
+def test_array_items_and_paths():
+    schema = arr(obj(required=["name"], name=s("string")))
+    validate([{"name": "a"}, {"name": "b"}], schema)
+    with pytest.raises(ValidationError, match=r"body\[1\].name: expected string"):
+        validate([{"name": "a"}, {"name": 2}], schema)
+
+
+def test_nested_path_reporting():
+    schema = obj(outer=obj(inner=s("integer")))
+    with pytest.raises(ValidationError, match="body.outer.inner"):
+        validate({"outer": {"inner": "x"}}, schema)
+
+
+def test_component_refs_resolve():
+    ref = component("TestThing", obj(required=["id"], id=s("integer")))
+    validate({"id": 1}, ref)
+    with pytest.raises(ValidationError):
+        validate({}, ref)
+
+
+def test_unsupported_schema_rejected_at_registration():
+    with pytest.raises(TypeError, match="unsupported schema keys"):
+        component("Bad", {"type": "object", "oneOf": []})
+    with pytest.raises(TypeError, match="unsupported type"):
+        component("Bad2", {"type": "tuple"})
+
+
+def test_additional_properties_schema():
+    schema = {"type": "object", "additionalProperties": s("integer")}
+    validate({"a": 1, "b": 2}, schema)
+    with pytest.raises(ValidationError, match="body.b"):
+        validate({"a": 1, "b": "x"}, schema)
