@@ -1,13 +1,16 @@
-"""Benchmark: flagship transformer steps/sec/chip + telemetry poll p50.
+"""Benchmark: flagship transformer tokens/sec/chip + MFU + telemetry poll p50.
 
 Prints exactly ONE JSON line on stdout (driver contract); all diagnostics go
-to stderr. Runs on whatever accelerator jax exposes (the driver provides one
-real TPU chip; BASELINE.md records that the reference publishes no training
-numbers, so ``vs_baseline`` is 1.0 by definition in round 1 and becomes the
-round-over-round ratio once BENCH_r1.json exists).
+to stderr. Sweeps a small grid of (batch, remat) configurations for the
+headline t2t-base model and reports the best, plus a t2t-big data point, the
+analytic MFU (model FLOPs / bf16 peak), and ``vs_baseline`` as the ratio
+against round 1's recorded 74,788.5 tokens/s/chip (BENCH_r01.json) — the
+reference itself publishes no training numbers (BASELINE.md), so the
+round-over-round ratio is the honest comparison.
 """
 from __future__ import annotations
 
+import dataclasses
 import json
 import statistics
 import subprocess
@@ -15,38 +18,83 @@ import sys
 import time
 from pathlib import Path
 
+#: round-1 recorded throughput on this driver's hardware (BENCH_r01.json)
+R01_TOKENS_PER_SEC_PER_CHIP = 74_788.5
+
+#: v5e bf16 peak (TFLOP/s per chip); used only when the chip reports as v5e
+PEAK_TFLOPS = {"v5 lite": 197.0, "v5": 459.0, "v4": 275.0, "v6 lite": 918.0}
+
 
 def _log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
-def bench_train(preset: str = "t2t-base") -> dict:
+def _peak_tflops() -> float:
     import jax
 
-    from tensorhive_tpu.models.transformer import PRESETS
+    kind = jax.devices()[0].device_kind.lower()
+    for key, peak in PEAK_TFLOPS.items():
+        if key in kind:
+            return peak
+    _log(f"WARNING: unknown device kind {kind!r}; assuming v5e peak "
+         f"{PEAK_TFLOPS['v5 lite']} TFLOP/s for MFU")
+    return PEAK_TFLOPS["v5 lite"]
+
+
+def _run_config(preset: str, batch: int, seq_len: int, remat: bool,
+                steps: int) -> dict:
+    import jax
+
+    from tensorhive_tpu.models.transformer import PRESETS, train_flops_per_token
     from tensorhive_tpu.train import TrainConfig, train_loop
 
-    model_config = PRESETS[preset]
-    on_tpu = jax.default_backend() == "tpu"
-    train_config = TrainConfig(
-        batch_size=16 if on_tpu else 2,
-        seq_len=1024 if on_tpu else 128,
-        warmup_steps=2,
-        total_steps=100,
-    )
-    _log(f"backend={jax.default_backend()} devices={jax.devices()}")
-    _log(f"model={preset} batch={train_config.batch_size} seq={train_config.seq_len}")
-    steps = 12 if on_tpu else 4
+    model_config = dataclasses.replace(PRESETS[preset], remat=remat)
+    train_config = TrainConfig(batch_size=batch, seq_len=seq_len,
+                               warmup_steps=2, total_steps=100)
     metrics = train_loop(model_config, train_config, mesh=None,
                          num_steps=steps, log_every=0)
     n_chips = max(1, len(jax.devices()))
-    tokens_per_step = train_config.batch_size * train_config.seq_len
-    return {
-        "steps_per_sec_per_chip": metrics["steps_per_sec"] / n_chips,
-        "tokens_per_sec_per_chip": metrics["steps_per_sec"] * tokens_per_step / n_chips,
-        "step_time_ms": metrics["step_time_s"] * 1e3,
-        "loss": metrics["loss"],
+    tokens_per_sec = batch * seq_len * metrics["steps_per_sec"] / n_chips
+    # MFU by convention counts MODEL FLOPs (3x forward) regardless of remat
+    # recompute — remat configs' hardware utilization is higher than their
+    # MFU, which is the point of reporting MFU: it measures useful work
+    flops_per_token = train_flops_per_token(model_config, seq_len, remat=False)
+    mfu = tokens_per_sec * flops_per_token / (_peak_tflops() * 1e12)
+    result = {
+        "preset": preset,
+        "batch": batch,
+        "seq_len": seq_len,
+        "remat": remat,
+        "step_time_ms": round(metrics["step_time_s"] * 1e3, 2),
+        "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
+        "steps_per_sec_per_chip": round(metrics["steps_per_sec"] / n_chips, 3),
+        "mfu": round(mfu, 4),
+        "loss": round(metrics["loss"], 4),
     }
+    _log(f"  {result}")
+    return result
+
+
+def bench_train() -> dict:
+    import jax
+
+    on_tpu = jax.default_backend() == "tpu"
+    _log(f"backend={jax.default_backend()} devices={jax.devices()}")
+    if not on_tpu:
+        _log("no TPU: single tiny config")
+        best = _run_config("t2t-base", 2, 128, True, 4)
+        return {"best": best, "sweep": [best], "big": None}
+
+    # sweep the headline model (best-known config first so a driver timeout
+    # mid-sweep still leaves the strongest point recorded)
+    sweep = [
+        _run_config("t2t-base", 64, 1024, False, 8),
+        _run_config("t2t-base", 32, 1024, False, 6),
+        _run_config("t2t-base", 16, 1024, True, 6),
+    ]
+    best = max(sweep, key=lambda r: r["tokens_per_sec_per_chip"])
+    big = _run_config("t2t-big", 32, 1024, False, 6)
+    return {"best": best, "sweep": sweep, "big": big}
 
 
 def bench_telemetry_poll():
@@ -69,17 +117,36 @@ def bench_telemetry_poll():
 def main() -> None:
     train = bench_train()
     poll_p50_ms = bench_telemetry_poll()
-    _log(f"train: {train}")
+    best = train["best"]
+    _log(f"best: {best}")
     _log(f"telemetry poll p50: {poll_p50_ms} ms")
+    import jax
+
+    on_tpu = jax.default_backend() == "tpu"
     result = {
-        "metric": "t2t_transformer steps/sec/chip",
-        "value": round(train["steps_per_sec_per_chip"], 3),
-        "unit": "steps/s/chip",
-        "vs_baseline": 1.0,  # reference publishes no numbers (BASELINE.md)
-        "tokens_per_sec_per_chip": round(train["tokens_per_sec_per_chip"], 1),
-        "step_time_ms": round(train["step_time_ms"], 2),
+        "metric": "t2t_transformer tokens/sec/chip",
+        "value": best["tokens_per_sec_per_chip"],
+        "unit": "tokens/s/chip",
+        # R01 is a TPU v5e number: comparing a CPU smoke run against it
+        # would report a spurious ~1000x regression, so off-TPU pins 1.0
+        "vs_baseline": round(
+            best["tokens_per_sec_per_chip"] / R01_TOKENS_PER_SEC_PER_CHIP, 3
+        ) if on_tpu else 1.0,
+        "mfu": best["mfu"],
+        "steps_per_sec_per_chip": best["steps_per_sec_per_chip"],
+        "step_time_ms": best["step_time_ms"],
+        "best_config": {k: best[k] for k in ("preset", "batch", "seq_len", "remat")},
+        "sweep": [
+            {k: r[k] for k in ("batch", "remat", "tokens_per_sec_per_chip", "mfu")}
+            for r in train["sweep"]
+        ],
+        "t2t_big": (
+            {k: train["big"][k]
+             for k in ("batch", "tokens_per_sec_per_chip", "mfu", "step_time_ms")}
+            if train["big"] else None
+        ),
         "telemetry_poll_p50_ms": round(poll_p50_ms, 2) if poll_p50_ms is not None else None,
-        "loss": round(train["loss"], 4),
+        "loss": best["loss"],
     }
     print(json.dumps(result, allow_nan=False))
 

@@ -76,6 +76,28 @@ def _causal_mask(q_start, k_start, block_q, block_k):
 
 
 
+
+def _online_softmax_block(q_scaled, k_blk, v_blk, acc, row_max, row_sum,
+                          q_start, k_start, causal: bool):
+    """Shared forward block math (resident + streaming kernels): one online-
+    softmax update against a K/V block. All operands f32."""
+    block_q, block_k = q_scaled.shape[0], k_blk.shape[0]
+    scores = jnp.dot(q_scaled, k_blk.T, preferred_element_type=jnp.float32)
+    if causal:
+        mask = _causal_mask(q_start, k_start, block_q, block_k)
+        scores = jnp.where(mask, scores, NEG_INF)
+    block_max = jnp.max(scores, axis=-1)
+    new_max = jnp.maximum(row_max, block_max)
+    correction = jnp.exp(row_max - new_max)
+    probs = jnp.exp(scores - new_max[:, None])
+    if causal:
+        probs = jnp.where(mask, probs, 0.0)
+    acc = acc * correction[:, None] + jnp.dot(
+        probs, v_blk, preferred_element_type=jnp.float32)
+    row_sum = row_sum * correction + jnp.sum(probs, axis=-1)
+    return acc, new_max, row_sum
+
+
 def _kv_resident(seq_len: int, d: int, dtype) -> bool:
     """True when one batch*head's K+V (equivalently Q+dO) fit the resident
     VMEM budget."""
@@ -98,20 +120,8 @@ def _fwd_kernel_resident(q_ref, k_ref, v_ref, out_ref, lse_ref, *,
         k_start = kv_idx * block_k
         k_blk = k_ref[0, pl.ds(k_start, block_k), :].astype(jnp.float32)
         v_blk = v_ref[0, pl.ds(k_start, block_k), :].astype(jnp.float32)
-        scores = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
-        if causal:
-            mask = _causal_mask(q_start, k_start, block_q, block_k)
-            scores = jnp.where(mask, scores, NEG_INF)
-        block_max = jnp.max(scores, axis=-1)
-        new_max = jnp.maximum(row_max, block_max)
-        correction = jnp.exp(row_max - new_max)
-        probs = jnp.exp(scores - new_max[:, None])
-        if causal:
-            probs = jnp.where(mask, probs, 0.0)
-        acc = acc * correction[:, None] + jnp.dot(
-            probs, v_blk, preferred_element_type=jnp.float32)
-        row_sum = row_sum * correction + jnp.sum(probs, axis=-1)
-        return acc, new_max, row_sum
+        return _online_softmax_block(q, k_blk, v_blk, acc, row_max, row_sum,
+                                     q_start, k_start, causal)
 
     num_kv = seq_len // block_k
     if causal:
@@ -148,23 +158,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, out_ref, lse_ref,
         q = q_ref[0].astype(jnp.float32) * scale
         k_blk = k_ref[0].astype(jnp.float32)
         v_blk = v_ref[0].astype(jnp.float32)
-        scores = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
-        if causal:
-            mask = _causal_mask(q_start, k_start, block_q, block_k)
-            scores = jnp.where(mask, scores, NEG_INF)
-        row_max = m_ref[:, 0]
-        block_max = jnp.max(scores, axis=-1)
-        new_max = jnp.maximum(row_max, block_max)
-        correction = jnp.exp(row_max - new_max)
-        probs = jnp.exp(scores - new_max[:, None])
-        if causal:
-            probs = jnp.where(mask, probs, 0.0)
-        acc_ref[...] = acc_ref[...] * correction[:, None] + jnp.dot(
-            probs, v_blk, preferred_element_type=jnp.float32
-        )
-        l_ref[...] = l_ref[...] * correction[:, None] + jnp.sum(
-            probs, axis=-1
-        )[:, None]
+        acc, new_max, row_sum = _online_softmax_block(
+            q, k_blk, v_blk, acc_ref[...], m_ref[:, 0], l_ref[:, 0],
+            q_start, k_start, causal)
+        acc_ref[...] = acc
+        l_ref[...] = jnp.broadcast_to(row_sum[:, None], l_ref.shape)
         m_ref[...] = jnp.broadcast_to(new_max[:, None], m_ref.shape)
 
     @pl.when(pl.program_id(2) == last_kv)
@@ -515,17 +513,20 @@ def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
     out, (out_f, lse) = _flash_fwd_residuals(
         q, k, v, causal, block_q, block_k, interpret
     )
-    return out, (q, k, v, out_f, lse)
+    del out_f  # save the caller-layout out instead: it lives downstream as
+    # an activation anyway, so residualizing the [BH,S,D] copy would hold O
+    # twice in HBM until backward
+    return out, (q, k, v, out, lse)
 
 
 def _flash_vjp_bwd(causal, block_q, block_k, interpret, residuals, grad_out):
-    q, k, v, out_f, lse = residuals
+    q, k, v, out, lse = residuals
     batch, seq_len, heads, d = q.shape
     dq, dk, dv = _flash_bwd_bhsd(
         _to_bhsd(q, batch, seq_len, heads, d),
         _to_bhsd(k, batch, seq_len, heads, d),
         _to_bhsd(v, batch, seq_len, heads, d),
-        out_f,
+        _to_bhsd(out, batch, seq_len, heads, d),
         lse,
         _to_bhsd(grad_out, batch, seq_len, heads, d),
         causal, block_q, block_k, interpret,
