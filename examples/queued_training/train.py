@@ -1,0 +1,132 @@
+"""Preemption-safe long training run for the queue scheduler (config 4).
+
+The TPU-native analog of the reference's DeepSpeech long-training scenario
+(reference examples/deepspeech/README.md): the job sits in the scheduler
+queue, launches whenever its chips are free of other users' reservations,
+and is **preempted with SIGINT** when a foreign reservation approaches
+(core/services/job_scheduling.py sync_running_from_queue; the reference's
+JobSchedulingService.py:254-283).
+
+This script makes preemption lossless:
+
+* SIGINT/SIGTERM set a flag; the loop checkpoints (orbax) and exits 0;
+* on the next launch the loop restores the latest step and continues —
+  run it twice with the same ``--checkpoint-dir`` and it picks up where the
+  preemption stopped.
+
+Enqueue it with the `jax` template:
+
+    POST /jobs                      {"name": "long pretrain"}
+    POST /jobs/<id>/tasks_from_template
+         {"template": "jax", "command": "python3 examples/queued_training/train.py
+          --preset t2t-big --steps 500000 --checkpoint-dir ~/ckpt/pretrain",
+          "placements": [{"hostname": "v5e8-w0", "chips": [0,1,2,3]},
+                         {"hostname": "v5e8-w1", "chips": [0,1,2,3]}]}
+    PUT  /jobs/<id>/enqueue
+"""
+import argparse
+import os
+import signal
+import sys
+import time
+
+import jax
+
+from tensorhive_tpu.models.transformer import PRESETS, TransformerLM
+from tensorhive_tpu.parallel.mesh import best_mesh_shape, make_mesh
+from tensorhive_tpu.train import (
+    TrainConfig,
+    init_train_state,
+    make_train_step,
+    restore_checkpoint,
+    save_checkpoint,
+    synthetic_batch,
+)
+
+_preempted = False
+
+
+def _request_stop(signum, frame):
+    global _preempted
+    _preempted = True
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--preset", default="t2t-big", choices=sorted(PRESETS))
+    parser.add_argument("--steps", type=int, default=500_000)
+    parser.add_argument("--batch_size", type=int, default=32)
+    parser.add_argument("--seq_len", type=int, default=512)
+    parser.add_argument("--checkpoint-dir", default="~/tpuhive-ckpt")
+    parser.add_argument("--checkpoint-every", type=int, default=200)
+    parser.add_argument("--log-every", type=int, default=25)
+    # auto-filled by the `jax` template:
+    parser.add_argument("--coordinator_address", default=None)
+    parser.add_argument("--num_processes", type=int, default=None)
+    parser.add_argument("--process_id", type=int, default=None)
+    args = parser.parse_args()
+
+    if args.coordinator_address is not None:
+        jax.distributed.initialize(
+            coordinator_address=args.coordinator_address,
+            num_processes=args.num_processes,
+            process_id=args.process_id,
+        )
+    signal.signal(signal.SIGINT, _request_stop)
+    signal.signal(signal.SIGTERM, _request_stop)
+
+    checkpoint_dir = os.path.abspath(os.path.expanduser(args.checkpoint_dir))
+    model_config = PRESETS[args.preset]
+    train_config = TrainConfig(batch_size=args.batch_size, seq_len=args.seq_len,
+                               warmup_steps=min(100, max(1, args.steps // 10)),
+                               total_steps=args.steps)
+    mesh = make_mesh(**best_mesh_shape(len(jax.devices())))
+    key = jax.random.PRNGKey(0)
+    params, opt_state = init_train_state(key, model_config, train_config, mesh)
+    start_step = 0
+    try:
+        start_step, params, opt_state = restore_checkpoint(
+            checkpoint_dir, params, opt_state)
+        print(f"resumed from step {start_step} ({checkpoint_dir})", flush=True)
+    except FileNotFoundError:
+        print(f"fresh run ({args.preset}: "
+              f"{TransformerLM.param_count(params) / 1e6:.1f}M params)", flush=True)
+
+    step_fn = make_train_step(model_config, train_config, mesh)
+    step = start_step
+    last_saved = start_step
+    key = jax.random.fold_in(key, start_step)
+
+    def checkpoint(at_step: int) -> None:
+        # orbax refuses to re-save an existing step; dedupe so a preemption
+        # landing on a checkpoint boundary (or an already-finished run) is
+        # still a clean exit
+        nonlocal last_saved
+        if at_step != last_saved:
+            save_checkpoint(checkpoint_dir, at_step, params, opt_state)
+            last_saved = at_step
+
+    while step < args.steps and not _preempted:
+        key, data_key = jax.random.split(key)
+        tokens = synthetic_batch(data_key, train_config, model_config.vocab_size)
+        started = time.perf_counter()
+        params, opt_state, metrics = step_fn(params, opt_state, tokens)
+        step += 1
+        if args.log_every and step % args.log_every == 0:
+            # reading the loss forces a host sync — only do it on log steps
+            # so dispatch of step N+1 overlaps execution of step N otherwise
+            print(f"step {step}/{args.steps} loss={float(metrics['loss']):.4f} "
+                  f"({(time.perf_counter() - started) * 1e3:.0f} ms)", flush=True)
+        if step % args.checkpoint_every == 0:
+            checkpoint(step)
+
+    checkpoint(step)
+    if _preempted:
+        print(f"preempted at step {step}: checkpoint saved, exiting cleanly",
+              flush=True)
+        sys.exit(0)
+    print(f"finished {args.steps} steps", flush=True)
+
+
+if __name__ == "__main__":
+    main()

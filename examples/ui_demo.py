@@ -1,0 +1,159 @@
+"""Boot the full tpuhive stack against the in-process fake cluster.
+
+Development/demo harness: API server + web app server + seeded data, no real
+hosts needed. Gives you a browsable UI (reference: the TensorHive quickstart
+`tensorhive` daemon boot, cli.py:111-148) with:
+
+  - 2 fake v5e hosts x 4 chips with drifting telemetry
+  - users:  admin / admin123   and   alice / alice123
+  - a global permissive restriction, one schedule, one named restriction
+  - a reservation today on vm-0:tpu:0 and a 2-host jax job
+
+Run:  python examples/ui_demo.py   then open http://localhost:5000
+"""
+import math
+import os
+import random
+import threading
+import time
+
+os.environ.setdefault("TPUHIVE_PYTEST", "1")   # in-memory DB
+
+from tensorhive_tpu.app.server import AppServer                     # noqa: E402
+from tensorhive_tpu.api.server import APIServer                     # noqa: E402
+from tensorhive_tpu.config import Config, HostConfig, set_config    # noqa: E402
+from tensorhive_tpu.controllers.nodes import (                      # noqa: E402
+    sync_resources_from_infrastructure,
+)
+from tensorhive_tpu.core.managers.infrastructure import chip_uid    # noqa: E402
+from tensorhive_tpu.core.managers.manager import (                  # noqa: E402
+    TpuHiveManager,
+    set_manager,
+)
+from tensorhive_tpu.core.nursery import set_ops_factory             # noqa: E402
+from tensorhive_tpu.core.transport.fake import (                    # noqa: E402
+    FakeCluster,
+    FakeOpsFactory,
+)
+from tensorhive_tpu.db.engine import Engine, set_engine             # noqa: E402
+from tensorhive_tpu.db.migrations import ensure_schema              # noqa: E402
+from tensorhive_tpu.db.models.reservation import Reservation        # noqa: E402
+from tensorhive_tpu.db.models.restriction import Restriction        # noqa: E402
+from tensorhive_tpu.db.models.schedule import RestrictionSchedule   # noqa: E402
+from tensorhive_tpu.db.models.job import Job                        # noqa: E402
+from tensorhive_tpu.db.models.task import SegmentType, Task         # noqa: E402
+from tensorhive_tpu.db.models.user import Group, User               # noqa: E402
+from tensorhive_tpu.utils.timeutils import utcnow                   # noqa: E402
+from datetime import timedelta                                      # noqa: E402
+
+HOSTS = ("vm-0", "vm-1")
+CHIPS = 4
+
+
+def seed_db():
+    admin = User(username="admin", email="admin@example.com", password="admin123").save()
+    admin.add_role("user"); admin.add_role("admin")
+    alice = User(username="alice", email="alice@example.com", password="alice123").save()
+    alice.add_role("user")
+    group = Group(name="everyone", is_default=True).save()
+    group.add_user(admin); group.add_user(alice)
+
+    always = Restriction(name="default: everything", starts_at=utcnow() - timedelta(days=1),
+                         ends_at=None, is_global=True).save()
+    office = Restriction(name="office hours", starts_at=utcnow() - timedelta(days=1),
+                         ends_at=None, is_global=False).save()
+    schedule = RestrictionSchedule(schedule_days="12345", hour_start="08:00",
+                                   hour_end="20:00").save()
+    office.add_schedule(schedule)
+    office.apply_to_group(group)
+
+    start = utcnow().replace(minute=0, second=0, microsecond=0) + timedelta(hours=1)
+    Reservation(title="flash-attn sweep", description="bench run",
+                resource_id=chip_uid("vm-0", 0), user_id=alice.id,
+                start=start, end=start + timedelta(hours=3)).save()
+
+    job = Job(name="t2t-base training", description="demo job", user_id=alice.id).save()
+    for worker_index, hostname in enumerate(HOSTS):
+        task = Task(job_id=job.id, hostname=hostname,
+                    command="python3 train.py --preset=t2t-base").save()
+        task.add_cmd_segment("TPU_VISIBLE_CHIPS", "0,1,2,3", SegmentType.env_variable)
+        task.add_cmd_segment("--process-id", str(worker_index), SegmentType.parameter)
+    return always
+
+
+def telemetry_loop(manager):
+    """Drifting fake chip metrics so the dashboard charts move."""
+    t0 = time.time()
+    while True:
+        dt = time.time() - t0
+        for host_index, host in enumerate(HOSTS):
+            chips = {}
+            for index in range(CHIPS):
+                phase = host_index * CHIPS + index
+                duty = max(0, min(100, 55 + 40 * math.sin(dt / 17 + phase)
+                                  + random.uniform(-6, 6)))
+                hbm_total = 16384
+                hbm_used = int(hbm_total * (0.35 + 0.25 * math.sin(dt / 29 + phase)))
+                chips[chip_uid(host, index)] = {
+                    "name": f"TPU v5e chip {index}",
+                    "index": index,
+                    "accelerator_type": "v5litepod-8",
+                    "hbm_used_mib": hbm_used,
+                    "hbm_total_mib": hbm_total,
+                    "hbm_util_pct": round(100 * hbm_used / hbm_total),
+                    "duty_cycle_pct": round(duty),
+                    "processes": [
+                        {"pid": 4242 + phase, "user": "alice",
+                         "command": "python3 train.py --preset=t2t-base"},
+                    ] if index < 2 and host == "vm-0" else [],
+                }
+            manager.infrastructure_manager.update_subtree(host, "TPU", chips)
+            manager.infrastructure_manager.update_subtree(host, "CPU", {
+                f"CPU_{host}": {
+                    "util_pct": round(20 + 15 * math.sin(dt / 11 + host_index)),
+                    "mem_used_mib": 3200, "mem_total_mib": 16384,
+                },
+            })
+        sync_resources_from_infrastructure(
+            manager.infrastructure_manager.infrastructure)
+        time.sleep(2)
+
+
+def main():
+    config = Config()
+    config.api.secret_key = "demo-secret"
+    config.api.url_hostname = "127.0.0.1"
+    config.app_server.host = "127.0.0.1"
+    for name in HOSTS:
+        config.hosts[name] = HostConfig(name=name, backend="local",
+                                        accelerator_type="v5litepod-8",
+                                        chips=CHIPS)
+    set_config(config)
+
+    engine = Engine(":memory:")
+    ensure_schema(engine)
+    set_engine(engine)
+
+    cluster = FakeCluster()
+    for name in HOSTS:
+        cluster.add_host(name, chips=CHIPS)
+    set_ops_factory(FakeOpsFactory(cluster))
+
+    manager = TpuHiveManager(config=config, services=[])
+    set_manager(manager)
+    seed_db()
+
+    threading.Thread(target=telemetry_loop, args=(manager,), daemon=True).start()
+    api_port = APIServer(config).start()
+    app_port = AppServer(config).start()
+    print(f"API  : http://127.0.0.1:{api_port}/api")
+    print(f"UI   : http://127.0.0.1:{app_port}  (admin/admin123, alice/alice123)")
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        pass
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,433 @@
+"""UI ↔ API contract tests.
+
+Two guarantees the reference UI never had (SURVEY.md §4 "what is NOT
+tested"):
+
+1. **Coverage**: every registered API operation is reachable from the SPA —
+   ``UI_CALLS`` maps each (method, path) to the literal source fragment in
+   ``tensorhive_tpu/app/static/`` that issues it, and the test fails if an
+   operation is missing from the map or the fragment vanishes from the
+   source (so UI refactors that orphan a route are caught).
+2. **Shapes**: the exact request bodies/query strings the SPA sends are
+   replayed through the real WSGI app (real JWTs, real validation layer) and
+   must succeed end-to-end on the fake cluster.
+"""
+from __future__ import annotations
+
+from datetime import timedelta
+from pathlib import Path
+
+import pytest
+from werkzeug.test import Client
+
+from tensorhive_tpu.api.app import registered_endpoints
+from tensorhive_tpu.api.server import ApiApp
+from tensorhive_tpu.config import HostConfig
+from tensorhive_tpu.core.managers.infrastructure import chip_uid
+from tensorhive_tpu.core.managers.manager import TpuHiveManager, set_manager
+from tensorhive_tpu.core.nursery import set_ops_factory
+from tensorhive_tpu.core.transport.fake import FakeCluster, FakeOpsFactory
+from tensorhive_tpu.utils.timeutils import utcnow
+from tests.fixtures import make_permissive_restriction, make_user
+
+STATIC_DIR = Path(__file__).resolve().parents[2] / "tensorhive_tpu" / "app" / "static"
+
+#: (METHOD, registry path) -> source fragment in the SPA that issues the call.
+#: Kept in sync two ways: test_every_operation_reachable_from_ui fails when an
+#: operation is missing here, test_ui_source_fragments_exist fails when a
+#: fragment no longer appears in app/static/.
+UI_CALLS = {
+    # auth/session (core.js)
+    ("POST", "/user/login"): '"/user/login"',
+    ("POST", "/user/logout"): '"/user/logout"',
+    ("POST", "/user/logout/refresh"): '"/user/logout/refresh"',
+    ("POST", "/user/refresh"): '"/user/refresh"',
+    ("POST", "/user/ssh_signup"): '"/user/ssh_signup"',
+    ("GET", "/user/authorized_keys_entry"): '"/user/authorized_keys_entry"',
+    # users + groups (admin.js)
+    ("GET", "/users"): 'api("/users")',
+    ("GET", "/users/<int:user_id>"): '"/users/" + id',
+    ("POST", "/users"): '"/users", { json:',
+    ("PUT", "/users/<int:user_id>"): '"/users/" + id, { method: "PUT"',
+    ("DELETE", "/users/<int:user_id>"): '"/users/" + id, { method: "DELETE" }',
+    ("GET", "/groups"): 'api("/groups")',
+    ("GET", "/groups/<int:group_id>"): '"/groups/" + id',
+    ("POST", "/groups"): '"/groups", { json:',
+    ("PUT", "/groups/<int:group_id>"): '"/groups/" + id, { method: "PUT"',
+    ("DELETE", "/groups/<int:group_id>"): '"/groups/" + id, { method: "DELETE" }',
+    ("PUT", "/groups/<int:group_id>/users/<int:user_id>"):
+        "`/groups/${groupId}/users/${userId}`",
+    ("DELETE", "/groups/<int:group_id>/users/<int:user_id>"):
+        "`/groups/${groupId}/users/${userId}`",
+    # nodes dashboard (nodes.js)
+    ("GET", "/nodes/metrics"): '"/nodes/metrics"',
+    ("GET", "/nodes/hostnames"): '"/nodes/hostnames"',
+    ("GET", "/nodes/<hostname>/metrics"):
+        "`/nodes/${encodeURIComponent(host)}/metrics`",
+    ("GET", "/nodes/<hostname>/tpu/info"):
+        "`/nodes/${encodeURIComponent(host)}/tpu/info`",
+    ("GET", "/nodes/<hostname>/tpu/processes"):
+        "`/nodes/${encodeURIComponent(host)}/tpu/processes`",
+    ("GET", "/nodes/<hostname>/cpu/metrics"):
+        "`/nodes/${encodeURIComponent(host)}/cpu/metrics`",
+    # reservations calendar (calendar.js)
+    ("GET", "/resources"): 'api("/resources")',
+    ("GET", "/resources/<uid>"): '"/resources/" + encodeURIComponent(uid)',
+    ("GET", "/reservations"): "`/reservations?start=",
+    ("GET", "/reservations/<int:reservation_id>"): '"/reservations/" + id',
+    ("POST", "/reservations"): '"/reservations", { json: payload(uid) }',
+    ("PUT", "/reservations/<int:reservation_id>"):
+        '"/reservations/" + id, { method: "PUT"',
+    ("DELETE", "/reservations/<int:reservation_id>"):
+        '"/reservations/" + id, { method: "DELETE" }',
+    # jobs + task editor (jobs.js)
+    ("GET", "/jobs"): 'api("/jobs")',
+    ("GET", "/jobs/<int:job_id>"): '"/jobs/" + jobsSelectedId',
+    ("POST", "/jobs"): '"/jobs", { json: body }',
+    ("PUT", "/jobs/<int:job_id>"): '"/jobs/" + id, { method: "PUT"',
+    ("DELETE", "/jobs/<int:job_id>"): '"/jobs/" + id, { method: "DELETE" }',
+    ("POST", "/jobs/<int:job_id>/execute"): "`/jobs/${id}/${action}`",
+    ("POST", "/jobs/<int:job_id>/stop"): "`/jobs/${id}/stop`",
+    ("GET", "/templates"): 'api("/templates")',
+    ("POST", "/jobs/<int:job_id>/tasks_from_template"):
+        "`/jobs/${jobId}/tasks_from_template`",
+    ("PUT", "/jobs/<int:job_id>/enqueue"): '${queued ? "dequeue" : "enqueue"}',
+    ("PUT", "/jobs/<int:job_id>/dequeue"): '${queued ? "dequeue" : "enqueue"}',
+    ("GET", "/tasks"): '"/tasks?job_id="',
+    ("GET", "/tasks/<int:task_id>"): '"/tasks/" + taskId',
+    ("POST", "/tasks"): '"/tasks", { json: body }',
+    ("PUT", "/tasks/<int:task_id>"): '"/tasks/" + taskId, { method: "PUT"',
+    ("DELETE", "/tasks/<int:task_id>"): '"/tasks/" + id, { method: "DELETE" }',
+    ("POST", "/tasks/<int:task_id>/spawn"): "`/tasks/${id}/spawn`",
+    ("POST", "/tasks/<int:task_id>/terminate"): "`/tasks/${id}/terminate`",
+    ("GET", "/tasks/<int:task_id>/log"): "`/tasks/${taskId}/log?tail=200`",
+    # restrictions + schedules (access.js)
+    ("GET", "/restrictions"): 'api("/restrictions")',
+    ("GET", "/restrictions/<int:restriction_id>"): '"/restrictions/" + id',
+    ("POST", "/restrictions"): '"/restrictions", { json: body }',
+    ("PUT", "/restrictions/<int:restriction_id>"):
+        '"/restrictions/" + id, { method: "PUT"',
+    ("DELETE", "/restrictions/<int:restriction_id>"):
+        '"/restrictions/" + id, { method: "DELETE" }',
+    ("PUT", "/restrictions/<int:restriction_id>/users/<int:user_id>"): "'users'",
+    ("DELETE", "/restrictions/<int:restriction_id>/users/<int:user_id>"): "'users'",
+    ("PUT", "/restrictions/<int:restriction_id>/groups/<int:group_id>"): "'groups'",
+    ("DELETE", "/restrictions/<int:restriction_id>/groups/<int:group_id>"): "'groups'",
+    ("PUT", "/restrictions/<int:restriction_id>/resources/<uid>"): "'resources'",
+    ("DELETE", "/restrictions/<int:restriction_id>/resources/<uid>"): "'resources'",
+    ("PUT", "/restrictions/<int:restriction_id>/hosts/<hostname>"): "'hosts'",
+    ("PUT", "/restrictions/<int:restriction_id>/schedules/<int:schedule_id>"):
+        "'schedules'",
+    ("DELETE", "/restrictions/<int:restriction_id>/schedules/<int:schedule_id>"):
+        "'schedules'",
+    ("GET", "/schedules"): 'api("/schedules")',
+    ("GET", "/schedules/<int:schedule_id>"): '"/schedules/" + id',
+    ("POST", "/schedules"): '"/schedules", { json: body }',
+    ("PUT", "/schedules/<int:schedule_id>"): '"/schedules/" + id, { method: "PUT"',
+    ("DELETE", "/schedules/<int:schedule_id>"): '"/schedules/" + id, { method: "DELETE" }',
+}
+
+
+def _spa_source() -> str:
+    chunks = []
+    for path in sorted(STATIC_DIR.rglob("*")):
+        if path.suffix in (".js", ".html"):
+            chunks.append(path.read_text())
+    return "\n".join(chunks)
+
+
+def test_every_operation_reachable_from_ui():
+    registered = {
+        (method, endpoint.path)
+        for endpoint in registered_endpoints()
+        for method in endpoint.methods
+    }
+    missing = registered - set(UI_CALLS)
+    assert not missing, f"API operations with no UI caller: {sorted(missing)}"
+    stale = set(UI_CALLS) - registered
+    assert not stale, f"UI_CALLS entries for unregistered operations: {sorted(stale)}"
+
+
+def test_ui_source_fragments_exist():
+    source = _spa_source()
+    gone = {key: frag for key, frag in UI_CALLS.items() if frag not in source}
+    assert not gone, f"UI no longer contains the fragment for: {gone}"
+
+
+# ---------------------------------------------------------------------------
+# shape replay fixtures
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def cluster(db, config):
+    cluster = FakeCluster()
+    cluster.add_host("vm-0", chips=4)
+    cluster.add_host("vm-1", chips=4)
+    set_ops_factory(FakeOpsFactory(cluster))
+    yield cluster
+    set_ops_factory(None)
+
+
+@pytest.fixture()
+def api(db, config, cluster, tmp_path):
+    config.api.secret_key = "test-secret"
+    # the SPA's ssh-signup flow probes the first configured host; the local
+    # backend makes that a subprocess on this machine
+    config.hosts["vm-0"] = HostConfig(name="vm-0", backend="local")
+    manager = TpuHiveManager(config=config, services=[])
+    set_manager(manager)
+    # seed live telemetry the way a monitoring tick would
+    infra = manager.infrastructure_manager
+    for host in ("vm-0", "vm-1"):
+        infra.update_subtree(host, "TPU", {
+            chip_uid(host, index): {
+                "name": f"TPU v5e chip {index}",
+                "index": index,
+                "hbm_used_mib": 100,
+                "hbm_total_mib": 16384,
+                "hbm_util_pct": 1,
+                "duty_cycle_pct": 0,
+                "processes": [],
+            } for index in range(4)
+        })
+        infra.update_subtree(host, "CPU", {
+            f"CPU_{host}": {"util_pct": 7, "mem_used_mib": 900, "mem_total_mib": 8192},
+        })
+    yield Client(ApiApp(url_prefix="api"))
+    set_manager(None)
+
+
+@pytest.fixture()
+def admin(db):
+    return make_user(username="root1", password="SuperSecret42", admin=True)
+
+
+@pytest.fixture()
+def user(db):
+    return make_user(username="alice", password="SuperSecret42")
+
+
+def _login(api, username):
+    response = api.post("/api/user/login", json={
+        "username": username, "password": "SuperSecret42"})
+    assert response.status_code == 200, response.get_data(as_text=True)
+    return response.get_json()
+
+
+@pytest.fixture()
+def admin_headers(api, admin):
+    return {"Authorization": f"Bearer {_login(api, 'root1')['accessToken']}"}
+
+
+@pytest.fixture()
+def user_headers(api, user):
+    return {"Authorization": f"Bearer {_login(api, 'alice')['accessToken']}"}
+
+
+def _ok(response, *codes):
+    codes = codes or (200, 201)
+    assert response.status_code in codes, (
+        f"{response.request.method if hasattr(response, 'request') else ''} "
+        f"-> {response.status_code}: {response.get_data(as_text=True)}")
+    return response.get_json()
+
+
+# ---------------------------------------------------------------------------
+# shape replays — bodies below are byte-for-byte what the SPA builds
+# ---------------------------------------------------------------------------
+
+def test_session_shapes(api, user):
+    tokens = _login(api, "alice")           # doLogin()
+    refresh = {"Authorization": f"Bearer {tokens['refreshToken']}"}
+    access = {"Authorization": f"Bearer {tokens['accessToken']}"}
+    minted = _ok(api.post("/api/user/refresh", headers=refresh))  # tryRefresh()
+    assert "accessToken" in minted
+    # logout() revokes both tokens
+    _ok(api.post("/api/user/logout",
+                 headers={"Authorization": "Bearer " + minted["accessToken"]}))
+    _ok(api.post("/api/user/logout/refresh", headers=refresh))
+    assert api.post("/api/user/refresh", headers=refresh).status_code == 401
+    assert access  # original access token unused past here
+
+
+def test_ssh_signup_shapes(api, monkeypatch):
+    import getpass
+
+    from tensorhive_tpu.core.transport import ssh as ssh_module
+    # this CI image has no ssh-keygen; the signup *shape* is what's under test
+    monkeypatch.setattr(ssh_module, "generate_keypair",
+                        lambda path: "ssh-ed25519 AAAATESTKEY tpuhive")
+    key = _ok(api.get("/api/user/authorized_keys_entry"))
+    assert key["authorizedKeysEntry"].startswith("ssh-")
+    body = {"username": getpass.getuser(), "email": "me@example.com",
+            "password": "SuperSecret42"}      # doSshSignup()
+    created = _ok(api.post("/api/user/ssh_signup", json=body), 201)
+    assert created["username"] == body["username"]
+
+
+def test_nodes_dashboard_shapes(api, user, user_headers):
+    make_permissive_restriction(user)   # non-admins only see permitted chips
+    infra = _ok(api.get("/api/nodes/metrics", headers=user_headers))
+    assert "vm-0" in infra and "TPU" in infra["vm-0"]
+    hostnames = _ok(api.get("/api/nodes/hostnames", headers=user_headers))
+    assert set(hostnames) >= {"vm-0", "vm-1"}
+    node = _ok(api.get("/api/nodes/vm-0/metrics", headers=user_headers))
+    assert len(node["TPU"]) == 4
+    info = _ok(api.get("/api/nodes/vm-0/tpu/info", headers=user_headers))
+    assert all("processes" not in chip for chip in info)
+    processes = _ok(api.get("/api/nodes/vm-0/tpu/processes", headers=user_headers))
+    assert set(processes) == set(node["TPU"])
+    cpu = _ok(api.get("/api/nodes/vm-0/cpu/metrics", headers=user_headers))
+    assert list(cpu.values())[0]["util_pct"] == 7
+
+
+def test_reservation_calendar_shapes(api, user, user_headers):
+    make_permissive_restriction(user)
+    # drawCalendar(): resources + week-window query with toISOString() stamps
+    resources = _ok(api.get("/api/resources", headers=user_headers))
+    assert len(resources) == 8
+    uid = resources[0]["uid"]
+    _ok(api.get("/api/resources/" + uid, headers=user_headers))
+    week_start = utcnow().replace(hour=0, minute=0, second=0, microsecond=0)
+    week_end = week_start + timedelta(days=7)
+    iso = lambda dt: dt.strftime("%Y-%m-%dT%H:%M:%S.000Z")  # noqa: E731
+    _ok(api.get(
+        f"/api/reservations?start={iso(week_start)}&end={iso(week_end)}",
+        headers=user_headers))
+    # createReservations() payload(uid)
+    start = utcnow() + timedelta(hours=1)
+    end = start + timedelta(hours=2)
+    created = _ok(api.post("/api/reservations", headers=user_headers, json={
+        "title": "training run", "description": "", "resourceId": uid,
+        "start": iso(start), "end": iso(end)}), 201)
+    # openReservationDetails() + saveReservation()
+    rid = created["id"]
+    _ok(api.get(f"/api/reservations/{rid}", headers=user_headers))
+    _ok(api.put(f"/api/reservations/{rid}", headers=user_headers, json={
+        "title": "renamed", "description": "tuned",
+        "start": iso(start), "end": iso(end + timedelta(hours=1))}))
+    _ok(api.delete(f"/api/reservations/{rid}", headers=user_headers))
+
+
+def test_job_and_task_editor_shapes(api, user_headers):
+    # createJob() with schedule fields
+    start = utcnow() + timedelta(hours=4)
+    job = _ok(api.post("/api/jobs", headers=user_headers, json={
+        "name": "my training", "description": "",
+        "startAt": start.strftime("%Y-%m-%dT%H:%M:%S.000Z")}), 201)
+    jid = job["id"]
+    _ok(api.get("/api/jobs", headers=user_headers))
+    _ok(api.get(f"/api/jobs/{jid}", headers=user_headers))
+    # saveJob() always sends all four fields (empty schedule -> null)
+    _ok(api.put(f"/api/jobs/{jid}", headers=user_headers, json={
+        "name": "my training", "description": "longer run",
+        "startAt": None, "stopAt": None}))
+    # openTemplateDialog() -> createTasksFromTemplate()
+    templates = _ok(api.get("/api/templates", headers=user_headers))
+    assert "jax" in templates
+    generated = _ok(api.post(f"/api/jobs/{jid}/tasks_from_template",
+                             headers=user_headers, json={
+        "template": "jax", "command": "python3 train.py",
+        "placements": [{"hostname": "vm-0", "chips": [0, 1, 2, 3]},
+                       {"hostname": "vm-1", "chips": [0, 1, 2, 3]}]}), 201)
+    assert len(generated) == 2
+    # drawJobDetails() task list
+    tasks = _ok(api.get(f"/api/tasks?job_id={jid}", headers=user_headers))
+    assert len(tasks) == 2
+    # createTask() manual add with segment rows
+    task = _ok(api.post("/api/tasks", headers=user_headers, json={
+        "jobId": jid, "hostname": "vm-0", "command": "python3 eval.py",
+        "envVariables": [{"name": "WANDB_MODE", "value": "offline"}],
+        "parameters": [{"name": "--steps", "value": "50"}],
+        "chips": [0, 1]}), 201)
+    tid = task["id"]
+    _ok(api.get(f"/api/tasks/{tid}", headers=user_headers))
+    # saveTask(): add one env var, drop one segment
+    _ok(api.put(f"/api/tasks/{tid}", headers=user_headers, json={
+        "hostname": "vm-0", "command": "python3 eval.py",
+        "envVariables": [{"name": "XLA_FLAGS", "value": "--xla_dump_to=/tmp"}],
+        "parameters": [], "removeSegments": ["--steps"]}))
+    # taskSpawn() / showTaskLog() / taskTerminate(null == SIGTERM button)
+    _ok(api.post(f"/api/tasks/{tid}/spawn", headers=user_headers, json={}))
+    log = _ok(api.get(f"/api/tasks/{tid}/log?tail=200", headers=user_headers))
+    assert "log" in log
+    _ok(api.post(f"/api/tasks/{tid}/terminate", headers=user_headers,
+                 json={"gracefully": None}))
+    _ok(api.delete(f"/api/tasks/{tid}", headers=user_headers))
+    # job-level run / stop / queue buttons
+    _ok(api.post(f"/api/jobs/{jid}/execute", headers=user_headers, json={}))
+    _ok(api.post(f"/api/jobs/{jid}/stop", headers=user_headers,
+                 json={"gracefully": True}))
+    _ok(api.put(f"/api/jobs/{jid}/enqueue", headers=user_headers))
+    _ok(api.put(f"/api/jobs/{jid}/dequeue", headers=user_headers))
+    _ok(api.delete(f"/api/jobs/{jid}", headers=user_headers))
+
+
+def test_users_and_groups_admin_shapes(api, admin_headers):
+    # createUser()
+    created = _ok(api.post("/api/users", headers=admin_headers, json={
+        "username": "bob", "email": "bob@example.com",
+        "password": "SuperSecret42", "admin": False}), 201)
+    uid = created["id"]
+    _ok(api.get("/api/users", headers=admin_headers))
+    _ok(api.get(f"/api/users/{uid}", headers=admin_headers))
+    # saveUser() promotes to admin without password change
+    updated = _ok(api.put(f"/api/users/{uid}", headers=admin_headers, json={
+        "email": "bob@corp.example.com", "roles": ["user", "admin"]}))
+    assert set(updated["roles"]) == {"user", "admin"}
+    # groups CRUD + membership buttons
+    group = _ok(api.post("/api/groups", headers=admin_headers, json={
+        "name": "researchers", "isDefault": True}), 201)
+    gid = group["id"]
+    _ok(api.get("/api/groups", headers=admin_headers))
+    _ok(api.get(f"/api/groups/{gid}", headers=admin_headers))
+    _ok(api.put(f"/api/groups/{gid}", headers=admin_headers, json={
+        "name": "researchers", "isDefault": False}))
+    joined = _ok(api.put(f"/api/groups/{gid}/users/{uid}", headers=admin_headers))
+    assert [member["id"] for member in joined["users"]] == [uid]
+    left = _ok(api.delete(f"/api/groups/{gid}/users/{uid}", headers=admin_headers))
+    assert left["users"] == []
+    _ok(api.delete(f"/api/groups/{gid}", headers=admin_headers))
+    _ok(api.delete(f"/api/users/{uid}", headers=admin_headers))
+
+
+def test_access_admin_shapes(api, admin_headers, user):
+    # saveSchedule(): weekday checkboxes -> mask string, <input type=time> values
+    schedule = _ok(api.post("/api/schedules", headers=admin_headers, json={
+        "scheduleDays": "12345", "hourStart": "08:00", "hourEnd": "20:00"}), 201)
+    sid = schedule["id"]
+    _ok(api.get("/api/schedules", headers=admin_headers))
+    _ok(api.get(f"/api/schedules/{sid}", headers=admin_headers))
+    _ok(api.put(f"/api/schedules/{sid}", headers=admin_headers, json={
+        "scheduleDays": "123456", "hourStart": "07:00", "hourEnd": "22:00"}))
+    # saveRestriction(): endsAt null when the field is left empty
+    now = utcnow()
+    restriction = _ok(api.post("/api/restrictions", headers=admin_headers, json={
+        "name": "office hours", "startsAt": now.strftime("%Y-%m-%dT%H:%M:%S.000Z"),
+        "endsAt": None, "isGlobal": False}), 201)
+    rid = restriction["id"]
+    _ok(api.get("/api/restrictions", headers=admin_headers))
+    _ok(api.get(f"/api/restrictions/{rid}", headers=admin_headers))
+    _ok(api.put(f"/api/restrictions/{rid}", headers=admin_headers, json={
+        "name": "office hours", "startsAt": now.strftime("%Y-%m-%dT%H:%M:%S.000Z"),
+        "endsAt": None, "isGlobal": False}))
+    # restrictionApply()/restrictionRemove() for every assignee kind
+    group = _ok(api.post("/api/groups", headers=admin_headers, json={
+        "name": "grp", "isDefault": False}), 201)
+    resources = _ok(api.get("/api/resources", headers=admin_headers))
+    uid = resources[0]["uid"]
+    _ok(api.put(f"/api/restrictions/{rid}/users/{user.id}", headers=admin_headers))
+    _ok(api.put(f"/api/restrictions/{rid}/groups/{group['id']}", headers=admin_headers))
+    _ok(api.put(f"/api/restrictions/{rid}/resources/{uid}", headers=admin_headers))
+    _ok(api.put(f"/api/restrictions/{rid}/hosts/vm-1", headers=admin_headers))
+    _ok(api.put(f"/api/restrictions/{rid}/schedules/{sid}", headers=admin_headers))
+    detailed = _ok(api.get(f"/api/restrictions/{rid}", headers=admin_headers))
+    assert user.id in detailed["users"]
+    assert len(detailed["resources"]) >= 5      # 1 chip + 4 from vm-1
+    _ok(api.delete(f"/api/restrictions/{rid}/users/{user.id}", headers=admin_headers))
+    _ok(api.delete(f"/api/restrictions/{rid}/groups/{group['id']}",
+                   headers=admin_headers))
+    _ok(api.delete(f"/api/restrictions/{rid}/resources/{uid}", headers=admin_headers))
+    _ok(api.delete(f"/api/restrictions/{rid}/schedules/{sid}", headers=admin_headers))
+    _ok(api.delete(f"/api/restrictions/{rid}", headers=admin_headers))
+    _ok(api.delete(f"/api/schedules/{sid}", headers=admin_headers))

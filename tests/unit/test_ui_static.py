@@ -1,0 +1,155 @@
+"""Static checks on the dependency-free SPA.
+
+The reference shipped its UI untested (SURVEY.md §4); we cannot run a
+browser in CI, but two whole classes of SPA breakage are detectable
+statically:
+
+1. unbalanced delimiters (the tokenizer strips strings/comments and handles
+   nested template literals, so real code structure is what's checked);
+2. inline event handlers (onclick= etc.) in generated markup calling
+   functions that no script defines — the classic "renamed the function,
+   forgot the handler" regression in a framework-less SPA.
+"""
+from __future__ import annotations
+
+import re
+from pathlib import Path
+
+import pytest
+
+STATIC_DIR = Path(__file__).resolve().parents[2] / "tensorhive_tpu" / "app" / "static"
+JS_FILES = sorted(STATIC_DIR.glob("js/*.js"))
+
+
+def strip_js(source: str) -> str:
+    """Replace string/comment contents with spaces, keeping delimiters of
+    code structure. Handles '...'/"..."/`...` incl. nested `${ }`."""
+    out = []
+    stack = ["code"]       # code | squote | dquote | template | line | block
+    i, n = 0, len(source)
+    while i < n:
+        ch = source[i]
+        nxt = source[i + 1] if i + 1 < n else ""
+        mode = stack[-1]
+        if mode == "code":
+            if ch == "/" and nxt == "/":
+                stack.append("line"); out.append("  "); i += 2; continue
+            if ch == "/" and nxt == "*":
+                stack.append("block"); out.append("  "); i += 2; continue
+            if ch == "/":
+                # regex literal iff '/' sits in expression position (standard
+                # heuristic: previous significant char opens an expression)
+                prev = next((c for c in reversed(out) if not c.isspace()), "")
+                if prev in "(,=:[!&|?{};" or prev == "":
+                    j, in_class = i + 1, False
+                    while j < n:
+                        cj = source[j]
+                        if cj == "\\":
+                            j += 2; continue
+                        if cj == "[":
+                            in_class = True
+                        elif cj == "]":
+                            in_class = False
+                        elif cj == "/" and not in_class:
+                            break
+                        elif cj == "\n":
+                            break   # not a regex after all
+                        j += 1
+                    if j < n and source[j] == "/":
+                        out.append(" " * (j + 1 - i)); i = j + 1
+                        continue
+            if ch == "'":
+                stack.append("squote"); out.append(" "); i += 1; continue
+            if ch == '"':
+                stack.append("dquote"); out.append(" "); i += 1; continue
+            if ch == "`":
+                stack.append("template"); out.append(" "); i += 1; continue
+            if ch == "}" and len(stack) > 1:
+                # closing a ${ } interpolation -> back to the template literal
+                stack.pop(); out.append(" "); i += 1; continue
+            out.append(ch); i += 1; continue
+        if mode == "line":
+            if ch == "\n":
+                stack.pop(); out.append("\n")
+            else:
+                out.append(" ")
+            i += 1; continue
+        if mode == "block":
+            if ch == "*" and nxt == "/":
+                stack.pop(); out.append("  "); i += 2; continue
+            out.append("\n" if ch == "\n" else " "); i += 1; continue
+        if mode in ("squote", "dquote"):
+            quote = "'" if mode == "squote" else '"'
+            if ch == "\\":
+                out.append("  "); i += 2; continue
+            if ch == quote:
+                stack.pop()
+            out.append(" " if ch != "\n" else "\n"); i += 1; continue
+        if mode == "template":
+            if ch == "\\":
+                out.append("  "); i += 2; continue
+            if ch == "`":
+                stack.pop(); out.append(" "); i += 1; continue
+            if ch == "$" and nxt == "{":
+                stack.append("code"); out.append("  "); i += 2; continue
+            out.append(" " if ch != "\n" else "\n"); i += 1; continue
+    assert stack == ["code"], f"unterminated {stack[-1]}"
+    return "".join(out)
+
+
+def test_tokenizer_sanity():
+    assert strip_js("const x = 'a{b'; // {\nfn(`<b>${y({})}</b>`);").count("{") == 1
+    with pytest.raises(AssertionError):
+        strip_js("const s = 'unterminated")
+
+
+@pytest.mark.parametrize("path", JS_FILES, ids=lambda p: p.name)
+def test_js_delimiters_balanced(path):
+    code = strip_js(path.read_text())
+    pairs = {"(": ")", "[": "]", "{": "}"}
+    stack = []
+    line = 1
+    for ch in code:
+        if ch == "\n":
+            line += 1
+        elif ch in pairs:
+            stack.append((pairs[ch], line))
+        elif ch in pairs.values():
+            assert stack, f"{path.name}:{line}: unmatched closing {ch!r}"
+            want, opened = stack.pop()
+            assert ch == want, (
+                f"{path.name}:{line}: expected {want!r} "
+                f"(opened line {opened}), found {ch!r}")
+    assert not stack, f"{path.name}: unclosed {stack[-1][0]!r} from line {stack[-1][1]}"
+
+
+def _defined_functions() -> set:
+    defined = set()
+    for path in JS_FILES:
+        source = path.read_text()
+        defined.update(re.findall(r"(?:^|\s)(?:async\s+)?function\s+(\w+)\s*\(",
+                                  source))
+        defined.update(re.findall(r"(?:const|let|var)\s+(\w+)\s*=\s*(?:async\s*)?\(",
+                                  source))
+        defined.update(re.findall(r"(?:const|let|var)\s+(\w+)\s*=\s*\w+\s*=>", source))
+    return defined
+
+
+def test_inline_handlers_reference_defined_functions():
+    defined = _defined_functions() | {
+        # DOM/global receivers legitimate in handlers
+        "this", "document", "event", "localStorage", "JSON", "parseInt",
+        "encodeURIComponent", "Number", "String", "Math", "Date",
+    }
+    sources = [(p, p.read_text()) for p in JS_FILES]
+    sources.append((STATIC_DIR / "index.html",
+                    (STATIC_DIR / "index.html").read_text()))
+    problems = []
+    for path, source in sources:
+        for handler in re.findall(r'on(?:click|change|toggle|input)="([^"]*)"',
+                                  source):
+            for called in re.findall(r"(?<![\w.])(\w+)\s*\(", handler):
+                if called not in defined:
+                    problems.append(f"{path.name}: handler calls "
+                                    f"undefined {called!r} in {handler!r}")
+    assert not problems, "\n".join(problems)
