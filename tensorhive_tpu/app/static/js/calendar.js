@@ -210,7 +210,7 @@ function openReservationDialog(start, end) {
     start = new Date(); start.setMinutes(0, 0, 0); start.setHours(start.getHours() + 1);
     end = new Date(start); end.setHours(end.getHours() + 2);
   }
-  const preset = [...calSelected];
+  const preset = calSelected ? [...calSelected] : [];   // pre-first-draw click
   dialog.innerHTML = `<h3>New reservation</h3>
     <label>Title</label><input id="rd-title" value="training run">
     <label>Description</label><input id="rd-desc" value="">

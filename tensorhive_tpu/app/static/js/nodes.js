@@ -6,12 +6,12 @@
 
 const NODES_POLL_MS = 3000;
 const HISTORY_MAX = 200;                      // ~10 min at 3 s/sample
-const chipHistory = {};                       // uid -> {t:[], duty:[], hbm:[]}
+const chipHistory = {};                       // uid -> {duty:[], hbm:[]}
 
 function recordChipSample(uid, duty, hbmPct) {
-  const h = chipHistory[uid] || (chipHistory[uid] = { t: [], duty: [], hbm: [] });
-  h.t.push(Date.now()); h.duty.push(duty ?? 0); h.hbm.push(hbmPct ?? 0);
-  if (h.t.length > HISTORY_MAX) { h.t.shift(); h.duty.shift(); h.hbm.shift(); }
+  const h = chipHistory[uid] || (chipHistory[uid] = { duty: [], hbm: [] });
+  h.duty.push(duty ?? 0); h.hbm.push(hbmPct ?? 0);
+  if (h.duty.length > HISTORY_MAX) { h.duty.shift(); h.hbm.shift(); }
 }
 
 function sparkline(values, cls) {
@@ -162,7 +162,7 @@ function openChipDialog(uid, host) {
 function drawChipChart(uid) {
   const svg = document.getElementById("chip-chart");
   if (!svg) return;
-  const h = chipHistory[uid] || { t: [], duty: [], hbm: [] };
+  const h = chipHistory[uid] || { duty: [], hbm: [] };
   const w = 600, ht = 180;
   const line = (values, color) => {
     if (!values.length) return "";

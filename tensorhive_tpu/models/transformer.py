@@ -23,6 +23,7 @@ namespace of ``init`` / ``apply`` / ``loss`` staticmethods.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 from typing import Any, Dict, Optional
 
@@ -48,6 +49,10 @@ class TransformerConfig:
     remat: bool = True
     #: use the pallas flash kernel for non-sp attention
     use_flash: bool = True
+    #: token-chunk size for the memory-efficient CE loss (0 disables); only
+    #: engaged when the full logits tensor would exceed
+    #: CHUNKED_LOSS_THRESHOLD_BYTES, so small runs keep the fused fast path
+    loss_chunk_tokens: int = 16_384
 
     @property
     def d_head(self) -> int:
@@ -67,6 +72,55 @@ PRESETS: Dict[str, TransformerConfig] = {
     "1b": TransformerConfig(vocab_size=32_000, d_model=2048, n_heads=16,
                             n_layers=16, d_ff=5632, max_seq_len=4096),
 }
+
+
+#: fallback threshold for the chunked CE path when the device can't report
+#: its memory (CPU/interpret): engage once full logits would exceed 2 GiB
+CHUNKED_LOSS_THRESHOLD_BYTES = 2 << 30
+
+
+@functools.lru_cache(maxsize=1)
+def _chunk_threshold_bytes() -> int:
+    """Engage chunking only when the full-logits path would genuinely
+    pressure HBM: measured on v5e, the full path at 8.6 GB logits (b64×s1024
+    ×32k vocab) is ~6% faster than chunked recompute, so chunking must not
+    trigger while the fused path still fits: b64's 8.6 GB logits run fine on
+    a 16 GB v5e (~0.62 of bytes_limit) while b128's 17 GB cannot, so 0.7
+    keeps the measured-good config on the fast path with the flip safely
+    below the OOM point."""
+    device = jax.devices()[0]
+    try:
+        return int(device.memory_stats()["bytes_limit"] * 0.7)
+    except Exception:
+        pass
+    if device.platform == "tpu":
+        # some TPU runtimes don't expose memory_stats; assume the smallest
+        # current-generation HBM (16 GiB, v5e) — underestimating on larger
+        # chips merely engages chunking earlier than strictly needed
+        return int((16 << 30) * 0.7)
+    return CHUNKED_LOSS_THRESHOLD_BYTES
+
+
+def _chunked_ce(x_flat: jax.Array, targets_flat: jax.Array, w_head: jax.Array,
+                dtype: Any, chunk_tokens: int) -> jax.Array:
+    """Sum of (logsumexp − target_logit) over all tokens, computed one
+    token-chunk at a time. ``jax.checkpoint`` on the chunk body means the
+    backward pass recomputes each chunk's logits instead of storing them —
+    peak memory is one [chunk, vocab] f32 buffer either direction."""
+    num_chunks = x_flat.shape[0] // chunk_tokens
+    x_chunks = x_flat.reshape(num_chunks, chunk_tokens, -1)
+    t_chunks = targets_flat.reshape(num_chunks, chunk_tokens)
+
+    @jax.checkpoint
+    def one_chunk(args):
+        x_blk, t_blk = args
+        logits = jnp.dot(x_blk.astype(dtype), w_head.astype(dtype),
+                         preferred_element_type=jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        target_logit = jnp.take_along_axis(logits, t_blk[:, None], axis=-1)[:, 0]
+        return jnp.sum(lse - target_logit)
+
+    return jnp.sum(jax.lax.map(one_chunk, (x_chunks, t_chunks)))
 
 
 def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
@@ -122,14 +176,15 @@ class TransformerLM:
 
     # -- forward ------------------------------------------------------------
     @staticmethod
-    def apply(
+    def apply_trunk(
         params: Params,
         tokens: jax.Array,                  # [B, L] int32
         config: TransformerConfig,
         mesh=None,
         positions: Optional[jax.Array] = None,
     ) -> jax.Array:
-        """Returns logits [B, L, vocab] (f32)."""
+        """Everything before the LM head: returns normed activations
+        [B, L, d_model] (activation dtype, post final rmsnorm)."""
         dtype = config.dtype
         if positions is None:
             positions = jnp.broadcast_to(
@@ -170,14 +225,26 @@ class TransformerLM:
         for block in params["blocks"]:
             x = block_fn(x, block)
 
-        x = _rmsnorm(x, params["final_norm"]["scale"])
+        return _rmsnorm(x, params["final_norm"]["scale"])
+
+    @staticmethod
+    def apply(
+        params: Params,
+        tokens: jax.Array,                  # [B, L] int32
+        config: TransformerConfig,
+        mesh=None,
+        positions: Optional[jax.Array] = None,
+    ) -> jax.Array:
+        """Returns logits [B, L, vocab] (f32)."""
+        x = TransformerLM.apply_trunk(params, tokens, config, mesh=mesh,
+                                      positions=positions)
         # LM head: bf16 operands, f32 MXU accumulation. A full-f32 matmul
         # here runs at ~1/4 MXU throughput and this [*, d]x[d, vocab] matmul
         # is the single largest in the model (~40% of forward FLOPs for
         # t2t-base); bf16-in/f32-out is the standard LM-head precision.
-        logits = jnp.dot(x.astype(dtype), params["w_lm_head"].astype(dtype),
-                         preferred_element_type=jnp.float32)
-        return logits
+        return jnp.dot(x.astype(config.dtype),
+                       params["w_lm_head"].astype(config.dtype),
+                       preferred_element_type=jnp.float32)
 
     # -- loss ---------------------------------------------------------------
     @staticmethod
@@ -189,6 +256,29 @@ class TransformerLM:
     ) -> jax.Array:
         """Next-token cross-entropy, mean over tokens (f32)."""
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        n_tokens = targets.shape[0] * targets.shape[1]
+        logits_bytes = n_tokens * config.vocab_size * 4
+        # shrink the chunk to a divisor of n_tokens (gcd) so awkward batch
+        # sizes still chunk instead of silently falling back to the
+        # full-logits path and OOMing — the exact sizes chunking exists
+        # for. A tiny gcd (odd n_tokens) means tiny matmuls, but this
+        # branch only engages where the full path would not fit at all:
+        # slow-but-runs beats OOM.
+        chunk = math.gcd(n_tokens, config.loss_chunk_tokens) \
+            if config.loss_chunk_tokens else 0
+        if chunk and logits_bytes > _chunk_threshold_bytes():
+            # chunked head+loss: the [N, vocab] f32 logits tensor is the
+            # largest buffer of a training step (17 GB at b128×s1024×32k —
+            # past a v5e's whole HBM). Computing lse/target-logit one token
+            # chunk at a time with per-chunk recompute in the backward keeps
+            # peak memory at one chunk's logits, unlocking batch sizes the
+            # full-logits path cannot hold. Costs one extra head matmul in
+            # the backward (~+2/6 of head FLOPs).
+            x = TransformerLM.apply_trunk(params, inputs, config, mesh=mesh)
+            total = _chunked_ce(
+                x.reshape(n_tokens, -1), targets.reshape(n_tokens),
+                params["w_lm_head"], config.dtype, chunk)
+            return total / n_tokens
         logits = TransformerLM.apply(params, inputs, config, mesh=mesh)
         # logsumexp − target_logit form: never materializes the full [B, L,
         # vocab] log-probability tensor (2 GB at b16×s1024×32k vocab) — the

@@ -224,7 +224,7 @@ def test_config5_multislice_2x_v5p32(api, headers, cluster):
     _run_and_stop(api, headers, cluster, job, ["v5p32-a0", "v5p32-b0"])
 
 
-def test_queued_example_script_resumes_from_checkpoint(tmp_path):
+def test_queued_example_script_resumes_from_checkpoint(tmp_path, capsys):
     """The examples/queued_training script itself: SIGINT-safe resume.
 
     Runs the real training script in-process at toy scale, simulates a
@@ -243,7 +243,7 @@ def test_queued_example_script_resumes_from_checkpoint(tmp_path):
     with mock.patch.object(sys, "argv", ["train.py"] + argv):
         queued_train._preempted = False
         queued_train.main()
-    from tensorhive_tpu.train import restore_checkpoint  # noqa: F401
+    assert "finished 6 steps" in capsys.readouterr().out
     # simulate preemption mid-second-run by flipping the flag via the handler
     queued_train._request_stop(2, None)
     assert queued_train._preempted
@@ -251,4 +251,8 @@ def test_queued_example_script_resumes_from_checkpoint(tmp_path):
             pytest.raises(SystemExit) as excinfo:
         queued_train.main()
     assert excinfo.value.code == 0
+    out = capsys.readouterr().out
+    # the second launch must actually restore the first run's final step —
+    # this line only prints when restore_checkpoint found step 6 on disk
+    assert "resumed from step 6" in out
     queued_train._preempted = False

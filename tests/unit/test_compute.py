@@ -5,6 +5,8 @@ construction, sharding rules, ring attention vs dense oracle, the pallas
 flash kernel (interpret mode), the transformer forward, and the fully
 sharded train step on dp/fsdp/tp/sp meshes.
 """
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -296,3 +298,95 @@ def test_checkpoint_roundtrip(tmp_path):
     assert step == 3
     np.testing.assert_array_equal(np.asarray(params["tok_embed"]),
                                   np.asarray(params2["tok_embed"]))
+
+
+def test_chunked_ce_matches_full_loss():
+    """The memory-efficient chunked CE path must be numerically equivalent
+    (value AND gradients) to the fused full-logits path."""
+    config = dataclasses.replace(
+        PRESETS["tiny"], dtype=jnp.float32, use_flash=False, remat=False)
+    key = jax.random.PRNGKey(3)
+    params = TransformerLM.init(key, config)
+    tokens = jax.random.randint(key, (4, 33), 0, config.vocab_size)
+
+    full_cfg = dataclasses.replace(config, loss_chunk_tokens=0)
+    # force the chunked path regardless of size threshold
+    import tensorhive_tpu.models.transformer as tf_mod
+    chunked_cfg = dataclasses.replace(config, loss_chunk_tokens=32)
+    old = tf_mod._chunk_threshold_bytes
+    tf_mod._chunk_threshold_bytes = lambda: 0
+    try:
+        full_val, full_grad = jax.value_and_grad(TransformerLM.loss)(
+            params, tokens, full_cfg)
+        chunk_val, chunk_grad = jax.value_and_grad(TransformerLM.loss)(
+            params, tokens, chunked_cfg)
+    finally:
+        tf_mod._chunk_threshold_bytes = old
+    np.testing.assert_allclose(full_val, chunk_val, rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(full_grad),
+                    jax.tree_util.tree_leaves(chunk_grad)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_chunked_ce_on_sharded_mesh():
+    """Chunked CE must compile and run under a dp×fsdp mesh (the flattened
+    [N, d] reshape crosses the sharded batch dim) and match the unchunked
+    sharded loss."""
+    import tensorhive_tpu.models.transformer as tf_mod
+
+    config = TransformerConfig(vocab_size=128, d_model=64, n_heads=4, n_layers=2,
+                               d_ff=128, max_seq_len=128, dtype=jnp.float32,
+                               loss_chunk_tokens=64)
+    train_config = TrainConfig(batch_size=8, seq_len=64, warmup_steps=1,
+                               total_steps=10)
+    tokens = synthetic_batch(jax.random.PRNGKey(7), train_config, config.vocab_size)
+    mesh = make_mesh(dp=2, fsdp=4)
+    params, opt_state = init_train_state(jax.random.PRNGKey(0), config,
+                                         train_config, mesh)
+    _, _, metrics_ref = make_train_step(config, train_config, mesh)(
+        params, opt_state, tokens)
+
+    old = tf_mod._chunk_threshold_bytes
+    tf_mod._chunk_threshold_bytes = lambda: 0
+    try:
+        params, opt_state = init_train_state(jax.random.PRNGKey(0), config,
+                                             train_config, mesh)
+        _, _, metrics = make_train_step(config, train_config, mesh)(
+            params, opt_state, tokens)
+    finally:
+        tf_mod._chunk_threshold_bytes = old
+    np.testing.assert_allclose(float(metrics["loss"]),
+                               float(metrics_ref["loss"]), rtol=1e-5)
+
+
+def test_chunked_ce_gcd_fallback_for_awkward_batch():
+    """A token count that isn't a multiple of loss_chunk_tokens must still
+    chunk (via the gcd divisor), not fall back to full logits."""
+    import tensorhive_tpu.models.transformer as tf_mod
+
+    config = dataclasses.replace(
+        PRESETS["tiny"], dtype=jnp.float32, use_flash=False, remat=False,
+        loss_chunk_tokens=48)         # n_tokens = 4*40 = 160; gcd(160,48)=16
+    key = jax.random.PRNGKey(5)
+    params = TransformerLM.init(key, config)
+    tokens = jax.random.randint(key, (4, 41), 0, config.vocab_size)
+
+    calls = []
+    real = tf_mod._chunked_ce
+
+    def spy(x_flat, targets_flat, w_head, dtype, chunk_tokens):
+        calls.append(chunk_tokens)
+        return real(x_flat, targets_flat, w_head, dtype, chunk_tokens)
+
+    old_thresh = tf_mod._chunk_threshold_bytes
+    tf_mod._chunk_threshold_bytes = lambda: 0
+    tf_mod._chunked_ce = spy
+    try:
+        chunked = TransformerLM.loss(params, tokens, config)
+        full = TransformerLM.loss(
+            params, tokens, dataclasses.replace(config, loss_chunk_tokens=0))
+    finally:
+        tf_mod._chunk_threshold_bytes = old_thresh
+        tf_mod._chunked_ce = real
+    assert calls == [16]              # gcd(160, 48), not 48 and not skipped
+    np.testing.assert_allclose(chunked, full, rtol=1e-6)
