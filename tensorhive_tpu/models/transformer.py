@@ -50,8 +50,9 @@ class TransformerConfig:
     #: use the pallas flash kernel for non-sp attention
     use_flash: bool = True
     #: token-chunk size for the memory-efficient CE loss (0 disables); only
-    #: engaged when the full logits tensor would exceed
-    #: CHUNKED_LOSS_THRESHOLD_BYTES, so small runs keep the fused fast path
+    #: engaged when the per-device logits shard would exceed the device
+    #: threshold (_chunk_threshold_bytes: ~0.7× HBM on TPU, 2 GiB where the
+    #: device can't report memory), so fitting runs keep the fused fast path
     loss_chunk_tokens: int = 16_384
 
     @property
@@ -257,7 +258,13 @@ class TransformerLM:
         """Next-token cross-entropy, mean over tokens (f32)."""
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
         n_tokens = targets.shape[0] * targets.shape[1]
-        logits_bytes = n_tokens * config.vocab_size * 4
+        # the batch dim shards over dp×fsdp (parallel/mesh.py batch_sharding),
+        # so what pressures HBM is each device's logits shard, not the global
+        # tensor — compare per-device bytes against the per-device threshold
+        batch_shards = 1
+        if mesh is not None:
+            batch_shards = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+        logits_bytes = n_tokens * config.vocab_size * 4 // batch_shards
         # shrink the chunk to a divisor of n_tokens (gcd) so awkward batch
         # sizes still chunk instead of silently falling back to the
         # full-logits path and OOMing — the exact sizes chunking exists
