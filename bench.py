@@ -51,8 +51,20 @@ def _run_config(preset: str, batch: int, seq_len: int, remat: bool,
     model_config = dataclasses.replace(PRESETS[preset], remat=remat)
     train_config = TrainConfig(batch_size=batch, seq_len=seq_len,
                                warmup_steps=2, total_steps=100)
+    # sync_every>1: enqueue steps back-to-back like a real training loop —
+    # per-step device blocking would charge the host dispatch gap (~25% on
+    # the tunneled chip) to every step
     metrics = train_loop(model_config, train_config, mesh=None,
-                         num_steps=steps, log_every=0)
+                         num_steps=steps, log_every=0,
+                         sync_every=max(1, steps // 3))
+    if metrics["step_time_s"] * 1e3 < 5.0:
+        # tunneled runtimes have been seen skipping device sync on the
+        # first executable of a process; a sub-5ms "step" is physically
+        # impossible for these shapes — measure again
+        _log("  implausible step time, re-measuring")
+        metrics = train_loop(model_config, train_config, mesh=None,
+                             num_steps=steps, log_every=0,
+                             sync_every=max(1, steps // 3))
     n_chips = max(1, len(jax.devices()))
     tokens_per_sec = batch * seq_len * metrics["steps_per_sec"] / n_chips
     # MFU by convention counts MODEL FLOPs (3x forward) regardless of remat
@@ -88,17 +100,17 @@ def bench_train() -> dict:
     # sweep the headline model (best-known config first so a driver timeout
     # mid-sweep still leaves the strongest point recorded)
     sweep = [
-        _run_config("t2t-base", 64, 1024, False, 8),
-        _run_config("t2t-base", 32, 1024, False, 6),
-        _run_config("t2t-base", 16, 1024, True, 6),
+        _run_config("t2t-base", 64, 1024, False, 12),
+        _run_config("t2t-base", 32, 1024, False, 9),
+        _run_config("t2t-base", 16, 1024, True, 9),
     ]
     best = max(sweep, key=lambda r: r["tokens_per_sec_per_chip"])
-    big = _run_config("t2t-big", 32, 1024, False, 6)
+    big = _run_config("t2t-big", 32, 1024, False, 9)
     # long-context single-chip point: seq-4096 backward through the pallas
     # flash kernels + remat (the dense path cannot hold the [B,H,4096,4096]
     # score matrix at any batch size; logits at b8×s4096 still fit, so the
     # chunked-CE path is not engaged here)
-    long_seq = _run_config("t2t-big", 8, 4096, True, 5)
+    long_seq = _run_config("t2t-big", 8, 4096, True, 6)
     return {"best": best, "sweep": sweep, "big": big, "long_seq": long_seq}
 
 

@@ -61,6 +61,7 @@ def main() -> None:
             mesh=mesh,
             num_steps=args.steps,
             telemetry=telemetry,
+            sync_every=10,      # pipeline step dispatch; sync per telemetry window
         )
         if jax.process_index() == 0:
             print(f"final: loss={metrics['loss']:.4f} "

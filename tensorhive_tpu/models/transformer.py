@@ -258,13 +258,15 @@ class TransformerLM:
         """Next-token cross-entropy, mean over tokens (f32)."""
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
         n_tokens = targets.shape[0] * targets.shape[1]
-        # the batch dim shards over dp×fsdp (parallel/mesh.py batch_sharding),
-        # so what pressures HBM is each device's logits shard, not the global
-        # tensor — compare per-device bytes against the per-device threshold
-        batch_shards = 1
+        # the batch dim shards over dp×fsdp and the vocab dim of the LM head
+        # (hence of the logits) over tp (parallel/mesh.py batch_sharding +
+        # _PARAM_LOGICAL), so what pressures HBM is each device's logits
+        # shard — compare per-device bytes against the per-device threshold
+        logits_shards = 1
         if mesh is not None:
-            batch_shards = mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
-        logits_bytes = n_tokens * config.vocab_size * 4 // batch_shards
+            logits_shards = (mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+                             * mesh.shape.get("tp", 1))
+        logits_bytes = n_tokens * config.vocab_size * 4 // logits_shards
         # shrink the chunk to a divisor of n_tokens (gcd) so awkward batch
         # sizes still chunk instead of silently falling back to the
         # full-logits path and OOMing — the exact sizes chunking exists

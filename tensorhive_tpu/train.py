@@ -177,31 +177,55 @@ def train_loop(
     seed: int = 0,
     log_every: int = 10,
     telemetry=None,
+    sync_every: int = 1,
 ) -> Dict[str, float]:
     """Minimal complete loop over synthetic data; returns final metrics.
     Real workloads supply their own data pipeline and call make_train_step
-    directly — this is the self-contained path bench.py and examples use."""
+    directly — this is the self-contained path bench.py and examples use.
+
+    ``sync_every``: block on the device only every N steps. Per-step blocking
+    costs the host→device dispatch gap every step (~25% on a tunneled v5e);
+    real training loops enqueue steps back-to-back, which N>1 reproduces —
+    the reported step time is then wall-clock over each N-step window."""
     key = jax.random.PRNGKey(seed)
     params, opt_state = init_train_state(key, model_config, train_config, mesh)
     step_fn = make_train_step(model_config, train_config, mesh)
-    metrics: Dict[str, float] = {}
-    times = []
+    window_times = []           # (per-step seconds, is_full_window)
+    metrics_dev = None
+    window_start = time.perf_counter()
+    window_len = 0
+    last_logged = 0
     for step_index in range(num_steps):
         key, data_key = jax.random.split(key)
         tokens = synthetic_batch(data_key, train_config, model_config.vocab_size)
-        started = time.perf_counter()
         params, opt_state, metrics_dev = step_fn(params, opt_state, tokens)
-        jax.block_until_ready(metrics_dev["loss"])
-        elapsed = time.perf_counter() - started
-        times.append(elapsed)
-        metrics = {k: float(v) for k, v in metrics_dev.items()}
-        if telemetry is not None:
-            telemetry.sample(step_time_s=elapsed)
-        if log_every and (step_index + 1) % log_every == 0:
-            log.info("step %d loss=%.4f (%.1f ms)", step_index + 1,
-                     metrics["loss"], elapsed * 1e3)
-    # steady-state step time: drop the compile-laden first step
-    steady = times[1:] or times
+        window_len += 1
+        if window_len >= sync_every or step_index == num_steps - 1:
+            # sync via an actual device→host read: block_until_ready has
+            # been observed returning early on tunneled TPU runtimes, which
+            # silently turns timings into dispatch-only measurements — a
+            # 4-byte loss transfer cannot complete before the step has
+            loss_value = float(metrics_dev["loss"])
+            now = time.perf_counter()
+            per_step = (now - window_start) / window_len
+            window_times.append((per_step, window_len >= sync_every))
+            if telemetry is not None:
+                telemetry.sample(step_time_s=per_step)
+            # "log roughly every log_every steps", honored at sync points
+            # (sync_every need not divide log_every)
+            if log_every and (step_index + 1) - last_logged >= log_every:
+                log.info("step %d loss=%.4f (%.1f ms)", step_index + 1,
+                         loss_value, per_step * 1e3)
+                last_logged = step_index + 1
+            window_start = now
+            window_len = 0
+    metrics = {k: float(v) for k, v in metrics_dev.items()}
+    # steady-state step time: drop the compile-laden first window and any
+    # trailing partial window (a short window re-pays the per-sync host gap
+    # the windowing exists to amortize)
+    steady = [t for t, full in window_times[1:] if full] \
+        or [t for t, _ in window_times[1:]] \
+        or [t for t, _ in window_times]
     metrics["step_time_s"] = sorted(steady)[len(steady) // 2]
     metrics["steps_per_sec"] = 1.0 / metrics["step_time_s"]
     return metrics

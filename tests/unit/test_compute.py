@@ -390,3 +390,15 @@ def test_chunked_ce_gcd_fallback_for_awkward_batch():
         tf_mod._chunked_ce = real
     assert calls == [16]              # gcd(160, 48), not 48 and not skipped
     np.testing.assert_allclose(chunked, full, rtol=1e-6)
+
+
+def test_train_loop_windowed_sync():
+    """sync_every>1 (pipelined dispatch) must produce the same metric keys
+    and finite values as per-step sync."""
+    config = TransformerConfig(vocab_size=128, d_model=32, n_heads=2, n_layers=1,
+                               d_ff=64, max_seq_len=64, dtype=jnp.float32)
+    train_config = TrainConfig(batch_size=4, seq_len=32, warmup_steps=1,
+                               total_steps=7)
+    metrics = train_loop(config, train_config, num_steps=7, log_every=0,
+                         sync_every=3)
+    assert np.isfinite(metrics["loss"]) and metrics["steps_per_sec"] > 0
