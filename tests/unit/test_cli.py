@@ -1,0 +1,50 @@
+"""CLI command tests via click's CliRunner.
+
+Reference gap closed: the reference never tested cli.py (SURVEY.md §4). The
+daemon boot path needs live hosts, but `init` and `create user` are pure
+config+DB flows and run against the per-test engine.
+"""
+from click.testing import CliRunner
+
+from tensorhive_tpu.cli import main
+from tensorhive_tpu.db.models.restriction import Restriction
+from tensorhive_tpu.db.models.user import Group, User
+
+
+def test_init_bootstraps_configs_admin_and_global_restriction(db, config):
+    runner = CliRunner()
+    result = runner.invoke(main, [
+        "init", "--username", "root1", "--email", "root@example.com",
+        "--password", "SuperSecret42",
+    ])
+    assert result.exit_code == 0, result.output
+    # configs written into the (tmp) config dir
+    assert (config.config_dir / "config.toml").exists()
+    assert (config.config_dir / "hosts.toml").exists()
+    # first account is an admin
+    admin = User.find_by_username("root1")
+    assert admin is not None and "admin" in admin.roles
+    # bootstrap: default group + the global everything-allowed restriction
+    assert any(g.is_default for g in Group.all())
+    assert any(r.is_global for r in Restriction.all())
+
+
+def test_create_user_noninteractive(db, config):
+    runner = CliRunner()
+    result = runner.invoke(main, [
+        "create", "user", "--username", "alice", "--email", "a@example.com",
+        "--password", "SuperSecret42",
+    ])
+    assert result.exit_code == 0, result.output
+    user = User.find_by_username("alice")
+    assert user is not None and user.roles == ["user"]
+
+
+def test_create_user_rejects_invalid_username(db, config):
+    runner = CliRunner()
+    result = runner.invoke(main, [
+        "create", "user", "--username", "x", "--email", "x@example.com",
+        "--password", "SuperSecret42",
+    ])
+    assert result.exit_code != 0
+    assert User.find_by_username("x") is None

@@ -92,3 +92,36 @@ def test_filter_by_uids_and_time_range(db):
     )
     assert [r.id for r in found] == [a.id]
     assert Reservation.filter_by_uids_and_time_range([], utcnow(), utcnow()) == []
+
+
+def test_concurrent_overlapping_saves_exactly_one_wins(db):
+    """The check-then-insert overlap invariant must hold across threads:
+    save() runs would_interfere + INSERT under one engine lock
+    (db/orm.py save → engine.transaction), so two barrier-synced racers
+    for the same chip+window commit exactly one reservation.
+    SURVEY.md §5 'race detection: none' — the reference has no such test."""
+    import threading
+
+    user = make_user()
+    resource = make_resource()
+    start = utcnow() + timedelta(hours=1)
+    end = start + timedelta(hours=2)
+    barrier = threading.Barrier(2)
+    outcomes = []
+
+    def racer(tag):
+        barrier.wait()
+        try:
+            Reservation(title=f"race-{tag}", resource_id=resource.uid,
+                        user_id=user.id, start=start, end=end).save()
+            outcomes.append(("ok", tag))
+        except ConflictError:
+            outcomes.append(("conflict", tag))
+
+    threads = [threading.Thread(target=racer, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert sorted(o for o, _ in outcomes) == ["conflict", "ok"], outcomes
+    assert len(Reservation.all()) == 1
