@@ -21,6 +21,9 @@ def main() -> None:
     parser.add_argument("--steps", type=int, default=1000)
     parser.add_argument("--batch_size", type=int, default=64)
     parser.add_argument("--seq_len", type=int, default=1024)
+    parser.add_argument("--data", default=None,
+                        help="glob of raw token shards (uint16); synthetic "
+                             "data when omitted")
     # auto-filled by the `jax` template:
     parser.add_argument("--coordinator_address", default=None)
     parser.add_argument("--num_processes", type=int, default=None)
@@ -35,16 +38,29 @@ def main() -> None:
         )
 
     mesh = make_mesh(**best_mesh_shape(len(jax.devices())))
+    batches = None
+    if args.data:
+        from tensorhive_tpu.data import DataConfig, TokenDataset, prefetch_to_device
+        from tensorhive_tpu.parallel.mesh import batch_sharding
+
+        dataset = TokenDataset(DataConfig(
+            pattern=args.data, seq_len=args.seq_len,
+            batch_size=args.batch_size))
+        batches = prefetch_to_device(dataset, start_step=0,
+                                     num_steps=args.steps,
+                                     sharding=batch_sharding(mesh))
     telemetry = TelemetryEmitter(name="jax_t2t")
     try:
         metrics = train_loop(
             PRESETS[args.preset],
             TrainConfig(batch_size=args.batch_size, seq_len=args.seq_len,
-                        warmup_steps=100, total_steps=args.steps),
+                        warmup_steps=min(100, max(1, args.steps // 10)),
+                        total_steps=args.steps),
             mesh=mesh,
             num_steps=args.steps,
             telemetry=telemetry,
             sync_every=10,      # pipeline step dispatch; sync per telemetry window
+            batches=batches,
         )
         if jax.process_index() == 0:
             print(f"final: {metrics}")

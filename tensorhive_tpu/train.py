@@ -178,10 +178,12 @@ def train_loop(
     log_every: int = 10,
     telemetry=None,
     sync_every: int = 1,
+    batches=None,
 ) -> Dict[str, float]:
-    """Minimal complete loop over synthetic data; returns final metrics.
-    Real workloads supply their own data pipeline and call make_train_step
-    directly — this is the self-contained path bench.py and examples use.
+    """Minimal complete loop; returns final metrics. Batches come from the
+    ``batches`` iterator when given (e.g. data.prefetch_to_device over token
+    shards) and synthetic data otherwise — the self-contained path bench.py
+    and the examples' smoke modes use.
 
     ``sync_every``: block on the device only every N steps. Per-step blocking
     costs the host→device dispatch gap every step (~25% on a tunneled v5e);
@@ -196,8 +198,17 @@ def train_loop(
     window_len = 0
     last_logged = 0
     for step_index in range(num_steps):
-        key, data_key = jax.random.split(key)
-        tokens = synthetic_batch(data_key, train_config, model_config.vocab_size)
+        if batches is not None:
+            try:
+                tokens = next(batches)
+            except StopIteration:
+                raise ValueError(
+                    f"batches iterator exhausted at step {step_index} of "
+                    f"{num_steps}") from None
+        else:
+            key, data_key = jax.random.split(key)
+            tokens = synthetic_batch(data_key, train_config,
+                                     model_config.vocab_size)
         params, opt_state, metrics_dev = step_fn(params, opt_state, tokens)
         window_len += 1
         if window_len >= sync_every or step_index == num_steps - 1:

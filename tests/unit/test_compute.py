@@ -506,3 +506,34 @@ def test_flash_ring_bf16_forward_and_grads():
         np.testing.assert_allclose(np.asarray(got, dtype=np.float32),
                                    np.asarray(want), atol=0.2, rtol=0.2,
                                    err_msg=f"d{name}")
+
+
+def test_checkpoint_restores_across_mesh_topologies(tmp_path):
+    """Elastic resume: a checkpoint written under one mesh restores onto a
+    different topology (the *_like trees carry the new shardings; orbax
+    reshards on read). The reference has no training checkpoints at all
+    (SURVEY.md §5) — this is the preemption-recovery path of the queued
+    workload when the re-launch lands on a different slice shape."""
+    from tensorhive_tpu.train import restore_checkpoint, save_checkpoint
+
+    config = TINY
+    train_config = TrainConfig(batch_size=8, seq_len=16)
+    mesh_a = make_mesh(dp=2, fsdp=4)
+    params, opt_state = init_train_state(jax.random.PRNGKey(0), config,
+                                         train_config, mesh_a)
+    save_checkpoint(str(tmp_path / "ckpt"), 7, params, opt_state)
+
+    mesh_b = make_mesh(dp=2, fsdp=2, tp=2)
+    params_b, opt_b = init_train_state(jax.random.PRNGKey(1), config,
+                                       train_config, mesh_b)
+    step, params_r, opt_r = restore_checkpoint(
+        str(tmp_path / "ckpt"), params_b, opt_b)
+    assert step == 7
+    np.testing.assert_array_equal(np.asarray(params["tok_embed"]),
+                                  np.asarray(params_r["tok_embed"]))
+    # restored arrays carry mesh_b's sharding and still train
+    step_fn = make_train_step(config, train_config, mesh_b)
+    tokens = synthetic_batch(jax.random.PRNGKey(2), train_config,
+                             config.vocab_size)
+    _, _, metrics = step_fn(params_r, opt_r, tokens)
+    assert np.isfinite(float(metrics["loss"]))

@@ -1,0 +1,106 @@
+"""Data pipeline tests: determinism, shard boundaries, multihost slicing,
+device prefetch. The reference has no data path at all (launched user
+programs own it, SURVEY.md §0) — this subsystem is new surface."""
+import numpy as np
+import pytest
+
+import jax
+
+from tensorhive_tpu.data import (
+    DataConfig,
+    TokenDataset,
+    fake_shards,
+    prefetch_to_device,
+)
+from tensorhive_tpu.parallel.mesh import batch_sharding, make_mesh
+
+
+@pytest.fixture()
+def dataset(tmp_path):
+    pattern = fake_shards(tmp_path, num_shards=3, tokens_per_shard=1000,
+                          vocab_size=512, seed=7)
+    return TokenDataset(DataConfig(pattern=pattern, seq_len=32, batch_size=8,
+                                   seed=1))
+
+
+def test_batches_are_deterministic_and_step_addressable(dataset, tmp_path):
+    a = dataset.batch_at(5)
+    assert a.shape == (8, 33) and a.dtype == np.int32
+    # a fresh instance (fresh process after preemption) reproduces the batch
+    other = TokenDataset(DataConfig(pattern=str(tmp_path / "shard_*.bin"),
+                                    seq_len=32, batch_size=8, seed=1))
+    np.testing.assert_array_equal(a, other.batch_at(5))
+    # different steps/seeds differ
+    assert not np.array_equal(a, dataset.batch_at(6))
+    reseeded = TokenDataset(DataConfig(pattern=str(tmp_path / "shard_*.bin"),
+                                       seq_len=32, batch_size=8, seed=2))
+    assert not np.array_equal(a, reseeded.batch_at(5))
+
+
+def test_windows_span_shard_boundaries(tmp_path):
+    pattern = fake_shards(tmp_path, num_shards=2, tokens_per_shard=100,
+                          vocab_size=512, seed=3)
+    dataset = TokenDataset(DataConfig(pattern=pattern, seq_len=49,
+                                      batch_size=1))
+    # reconstruct the logical stream and compare a boundary-crossing window
+    shards = sorted((tmp_path).glob("shard_*.bin"))
+    stream = np.concatenate([np.fromfile(p, dtype=np.uint16) for p in shards])
+    window = dataset._read_window(80)          # 80..130 crosses 100
+    np.testing.assert_array_equal(window, stream[80:130].astype(np.int32))
+
+
+def test_host_batch_rows_partition_the_global_batch(dataset):
+    full = dataset.batch_at(3)
+    parts = [dataset.host_batch_at(3, process_index=i, process_count=4)
+             for i in range(4)]
+    np.testing.assert_array_equal(np.concatenate(parts), full)
+    with pytest.raises(ValueError):
+        dataset.host_batch_at(3, process_index=0, process_count=3)
+
+
+def test_prefetch_delivers_sharded_device_batches(dataset):
+    mesh = make_mesh(dp=2, fsdp=4)
+    sharding = batch_sharding(mesh)
+    batches = list(prefetch_to_device(dataset, start_step=10, num_steps=4,
+                                      sharding=sharding))
+    assert len(batches) == 4
+    for step, device_batch in zip(range(10, 14), batches):
+        assert device_batch.sharding == sharding
+        np.testing.assert_array_equal(np.asarray(device_batch),
+                                      dataset.batch_at(step))
+
+
+def test_dataset_rejects_empty_and_too_small(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        TokenDataset(DataConfig(pattern=str(tmp_path / "none_*.bin")))
+    pattern = fake_shards(tmp_path, num_shards=1, tokens_per_shard=10)
+    with pytest.raises(ValueError):
+        TokenDataset(DataConfig(pattern=pattern, seq_len=32, batch_size=1))
+
+
+def test_host_batch_reads_only_local_rows(dataset, monkeypatch):
+    """Disk reads must scale with the host slice, not the global batch."""
+    calls = []
+    real = dataset._read_window
+
+    def counting(offset):
+        calls.append(offset)
+        return real(offset)
+
+    monkeypatch.setattr(dataset, "_read_window", counting)
+    rows = dataset.host_batch_at(3, process_index=1, process_count=4)
+    assert rows.shape[0] == 2 and len(calls) == 2
+
+
+def test_prefetch_surfaces_producer_errors(tmp_path):
+    pattern = fake_shards(tmp_path, num_shards=1, tokens_per_shard=500,
+                          vocab_size=64)
+    dataset = TokenDataset(DataConfig(pattern=pattern, seq_len=16,
+                                      batch_size=2))
+
+    def boom(step):
+        raise OSError("shard vanished")
+
+    dataset.batch_at = boom
+    with pytest.raises(OSError, match="shard vanished"):
+        list(prefetch_to_device(dataset, 0, 3))
