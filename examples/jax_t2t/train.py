@@ -45,7 +45,8 @@ def main() -> None:
 
         dataset = TokenDataset(DataConfig(
             pattern=args.data, seq_len=args.seq_len,
-            batch_size=args.batch_size))
+            batch_size=args.batch_size,
+            vocab_size=PRESETS[args.preset].vocab_size))
         batches = prefetch_to_device(dataset, start_step=0,
                                      num_steps=args.steps,
                                      sharding=batch_sharding(mesh))

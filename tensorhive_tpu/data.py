@@ -38,6 +38,11 @@ class DataConfig:
     batch_size: int = 8          # GLOBAL batch size
     seed: int = 0
     dtype: str = "uint16"
+    #: model vocabulary size; when set, every produced batch is validated —
+    #: jax's gather silently CLAMPS out-of-range ids, so a tokenizer/model
+    #: vocab mismatch would otherwise train on corrupted data with healthy-
+    #: looking metrics
+    vocab_size: Optional[int] = None
 
 
 class TokenDataset:
@@ -89,10 +94,20 @@ class TokenDataset:
         return rng.integers(
             0, self.total_tokens - self.window + 1, size=config.batch_size)
 
+    def _check_vocab(self, batch: np.ndarray) -> np.ndarray:
+        vocab = self.config.vocab_size
+        if vocab is not None:
+            top = int(batch.max())
+            if top >= vocab:
+                raise ValueError(
+                    f"shard token id {top} >= model vocab_size {vocab} — "
+                    f"tokenizer/model mismatch (jax would silently clamp)")
+        return batch
+
     def batch_at(self, step: int) -> np.ndarray:
         """Global batch for ``step``: [batch_size, seq_len+1] int32."""
-        return np.stack([self._read_window(int(o))
-                         for o in self._offsets_at(step)])
+        return self._check_vocab(np.stack(
+            [self._read_window(int(o)) for o in self._offsets_at(step)]))
 
     def host_batch_at(self, step: int, process_index: Optional[int] = None,
                       process_count: Optional[int] = None) -> np.ndarray:
@@ -110,7 +125,8 @@ class TokenDataset:
         rows = self.config.batch_size // process_count
         offsets = self._offsets_at(step)[process_index * rows:
                                          (process_index + 1) * rows]
-        return np.stack([self._read_window(int(o)) for o in offsets])
+        return self._check_vocab(
+            np.stack([self._read_window(int(o)) for o in offsets]))
 
 
 def prefetch_to_device(

@@ -154,17 +154,24 @@ def save_checkpoint(path: str, step: int, params: Params, opt_state) -> None:
 
 
 def restore_checkpoint(path: str, params_like, opt_state_like) -> Tuple[int, Params, Any]:
-    """Restore the latest step; shapes/shardings follow the *_like trees."""
+    """Restore the latest step; shapes AND shardings follow the *_like trees.
+
+    The templates are converted to abstract arrays carrying their shardings
+    so orbax RESHARDS onto the current topology — passing concrete arrays
+    would restore with the sharding recorded at save time, which breaks the
+    elastic-resume path (re-launch on a different slice shape after
+    preemption) the moment the saved mesh's devices no longer exist."""
     import orbax.checkpoint as ocp
 
+    template = {"params": params_like, "opt_state": opt_state_like}
+    restore_args = ocp.checkpoint_utils.construct_restore_args(template)
     with ocp.CheckpointManager(path) as manager:
         step = manager.latest_step()
         if step is None:
             raise FileNotFoundError(f"no checkpoint under {path}")
         restored = manager.restore(
             step,
-            args=ocp.args.PyTreeRestore({"params": params_like,
-                                         "opt_state": opt_state_like}),
+            args=ocp.args.PyTreeRestore(template, restore_args=restore_args),
         )
     return step, restored["params"], restored["opt_state"]
 

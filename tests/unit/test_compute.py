@@ -531,7 +531,12 @@ def test_checkpoint_restores_across_mesh_topologies(tmp_path):
     assert step == 7
     np.testing.assert_array_equal(np.asarray(params["tok_embed"]),
                                   np.asarray(params_r["tok_embed"]))
-    # restored arrays carry mesh_b's sharding and still train
+    # restored arrays must carry mesh_b's sharding (resharded on read), not
+    # the sharding recorded at save time under mesh_a
+    big = params_r["blocks"][0]["w_in"]
+    assert big.sharding == params_b["blocks"][0]["w_in"].sharding
+    assert big.sharding.mesh.shape == mesh_b.shape
+    # and still train under mesh_b
     step_fn = make_train_step(config, train_config, mesh_b)
     tokens = synthetic_batch(jax.random.PRNGKey(2), train_config,
                              config.vocab_size)

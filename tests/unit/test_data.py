@@ -104,3 +104,16 @@ def test_prefetch_surfaces_producer_errors(tmp_path):
     dataset.batch_at = boom
     with pytest.raises(OSError, match="shard vanished"):
         list(prefetch_to_device(dataset, 0, 3))
+
+
+def test_vocab_mismatch_is_caught(tmp_path):
+    """Out-of-vocab shard tokens must error loudly — jax's gather clamps
+    silently, which would train on corrupted data."""
+    pattern = fake_shards(tmp_path, num_shards=1, tokens_per_shard=500,
+                          vocab_size=50_000, dtype="uint16")
+    dataset = TokenDataset(DataConfig(pattern=pattern, seq_len=16,
+                                      batch_size=2, vocab_size=32_000))
+    with pytest.raises(ValueError, match="vocab"):
+        # enough draws that some window contains an id >= 32000
+        for step in range(20):
+            dataset.batch_at(step)
