@@ -26,7 +26,6 @@ from .transformer import (
     TransformerConfig,
     TransformerLM,
     _rmsnorm,
-    _rope,
 )
 
 
@@ -65,38 +64,24 @@ def apply_step(
 ) -> Tuple[jax.Array, KVCache]:
     """One decode step: logits for the NEXT position + updated cache.
 
-    Mirrors TransformerLM.apply (same weights, rmsnorm/RoPE/SwiGLU math)
-    with the sequence dimension collapsed to the cached prefix."""
+    Routes through TransformerLM.block_forward (the single copy of the
+    block math) with a cache-updating attend strategy, so training and
+    decoding cannot architecturally drift."""
     dtype = config.dtype
     x = params["tok_embed"].astype(dtype)[token][:, None, :]   # [B,1,D]
     positions = jnp.full((token.shape[0], 1), position, jnp.int32)
     new_k, new_v = [], []
     for layer_index, block in enumerate(params["blocks"]):
-        h = _rmsnorm(x, block["attn_norm"]["scale"])
-        b, l, d = h.shape
-        q = (h @ block["wq"].astype(dtype)).reshape(b, 1, config.n_heads,
-                                                    config.d_head)
-        k = (h @ block["wk"].astype(dtype)).reshape(b, 1, config.n_heads,
-                                                    config.d_head)
-        v = (h @ block["wv"].astype(dtype)).reshape(b, 1, config.n_heads,
-                                                    config.d_head)
-        q = _rope(q, positions, config.rope_theta)
-        k = _rope(k, positions, config.rope_theta)
-        k_cache = jax.lax.dynamic_update_slice(
-            cache.k[layer_index], k.astype(cache.k.dtype),
-            (0, position, 0, 0))
-        v_cache = jax.lax.dynamic_update_slice(
-            cache.v[layer_index], v.astype(cache.v.dtype),
-            (0, position, 0, 0))
-        new_k.append(k_cache)
-        new_v.append(v_cache)
-        attn = _decode_attend(q, k_cache, v_cache, position)
-        attn = attn.reshape(b, 1, config.n_heads * config.d_head)
-        x = x + attn @ block["wo"].astype(dtype)
-        h = _rmsnorm(x, block["mlp_norm"]["scale"])
-        gated = jax.nn.silu(h @ block["w_gate"].astype(dtype)) * (
-            h @ block["w_in"].astype(dtype))
-        x = x + gated @ block["w_out"].astype(dtype)
+        def attend(q, k, v, _layer=layer_index):
+            k_cache = jax.lax.dynamic_update_slice(
+                cache.k[_layer], k.astype(cache.k.dtype), (0, position, 0, 0))
+            v_cache = jax.lax.dynamic_update_slice(
+                cache.v[_layer], v.astype(cache.v.dtype), (0, position, 0, 0))
+            new_k.append(k_cache)
+            new_v.append(v_cache)
+            return _decode_attend(q, k_cache, v_cache, position)
+
+        x = TransformerLM.block_forward(x, block, config, positions, attend)
     x = _rmsnorm(x, params["final_norm"]["scale"])
     logits = jnp.dot(x[:, 0].astype(dtype), params["w_lm_head"].astype(dtype),
                      preferred_element_type=jnp.float32)
@@ -144,6 +129,12 @@ def generate(
         else:
             scaled = logits / temperature
             if top_k is not None:
+                if not 0 < top_k <= config.vocab_size:
+                    # jnp's index clamping would otherwise silently disable
+                    # the filter (or mask everything at 0)
+                    raise ValueError(
+                        f"top_k must be in (0, {config.vocab_size}], "
+                        f"got {top_k}")
                 kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
                 scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
             key, sample_key = jax.random.split(key)
@@ -151,6 +142,14 @@ def generate(
         tokens = tokens.at[:, position + 1].set(
             next_token.astype(tokens.dtype))
     return tokens
+
+
+@functools.lru_cache(maxsize=8)
+def _eval_loss_fn(config: TransformerConfig, mesh):
+    """Jitted loss per (config, mesh) — a fresh jit per evaluate() call
+    would recompile the whole model on every periodic eval."""
+    return jax.jit(functools.partial(TransformerLM.loss, config=config,
+                                     mesh=mesh))
 
 
 def evaluate(
@@ -162,8 +161,9 @@ def evaluate(
 ) -> Dict[str, float]:
     """Mean held-out loss/perplexity over ``num_batches`` from an iterator
     of [B, L+1] token arrays (e.g. data.prefetch_to_device)."""
-    loss_fn = jax.jit(functools.partial(TransformerLM.loss, config=config,
-                                        mesh=mesh))
+    if num_batches < 1:
+        raise ValueError(f"num_batches must be >= 1, got {num_batches}")
+    loss_fn = _eval_loss_fn(config, mesh)
     total, count = 0.0, 0
     for index in range(num_batches):
         try:

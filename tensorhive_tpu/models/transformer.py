@@ -177,6 +177,33 @@ class TransformerLM:
 
     # -- forward ------------------------------------------------------------
     @staticmethod
+    def block_forward(x, block, config: TransformerConfig, positions,
+                      attend) -> jax.Array:
+        """One transformer block (pre-norm attention + SwiGLU MLP). The
+        SINGLE copy of the block math — training (apply_trunk) and cached
+        decoding (models/decode.py apply_step) both route through it with
+        their own ``attend(q, k, v) -> [B, L, H, Dh]`` strategy, so the
+        architectures cannot drift apart."""
+        dtype = config.dtype
+        h = _rmsnorm(x, block["attn_norm"]["scale"])
+        b, l, d = h.shape
+        q = (h @ block["wq"].astype(dtype)).reshape(b, l, config.n_heads,
+                                                    config.d_head)
+        k = (h @ block["wk"].astype(dtype)).reshape(b, l, config.n_heads,
+                                                    config.d_head)
+        v = (h @ block["wv"].astype(dtype)).reshape(b, l, config.n_heads,
+                                                    config.d_head)
+        q = _rope(q, positions, config.rope_theta)
+        k = _rope(k, positions, config.rope_theta)
+        attn = attend(q, k, v).reshape(b, l, config.n_heads * config.d_head)
+        x = x + attn @ block["wo"].astype(dtype)
+        h = _rmsnorm(x, block["mlp_norm"]["scale"])
+        gated = jax.nn.silu(h @ block["w_gate"].astype(dtype)) * (
+            h @ block["w_in"].astype(dtype)
+        )
+        return x + gated @ block["w_out"].astype(dtype)
+
+    @staticmethod
     def apply_trunk(
         params: Params,
         tokens: jax.Array,                  # [B, L] int32
@@ -196,30 +223,18 @@ class TransformerLM:
         sp_sharded = mesh is not None and "sp" in getattr(mesh, "axis_names", ()) \
             and mesh.shape["sp"] > 1
 
-        def block_fn(x, block):
-            h = _rmsnorm(x, block["attn_norm"]["scale"])
-            b, l, d = h.shape
-            q = (h @ block["wq"].astype(dtype)).reshape(b, l, config.n_heads, config.d_head)
-            k = (h @ block["wk"].astype(dtype)).reshape(b, l, config.n_heads, config.d_head)
-            v = (h @ block["wv"].astype(dtype)).reshape(b, l, config.n_heads, config.d_head)
-            q = _rope(q, positions, config.rope_theta)
-            k = _rope(k, positions, config.rope_theta)
+        def attend(q, k, v):
             if sp_sharded:
-                attn = ring_attention(q, k, v, mesh=mesh, causal=True)
-            elif config.use_flash:
-                attn = flash_attention(q, k, v, causal=True)
-            else:
-                from ..ops.flash_attention import reference_attention
+                return ring_attention(q, k, v, mesh=mesh, causal=True)
+            if config.use_flash:
+                return flash_attention(q, k, v, causal=True)
+            from ..ops.flash_attention import reference_attention
 
-                attn = reference_attention(q, k, v, causal=True)
-            attn = attn.reshape(b, l, config.n_heads * config.d_head)
-            x = x + attn @ block["wo"].astype(dtype)
+            return reference_attention(q, k, v, causal=True)
 
-            h = _rmsnorm(x, block["mlp_norm"]["scale"])
-            gated = jax.nn.silu(h @ block["w_gate"].astype(dtype)) * (
-                h @ block["w_in"].astype(dtype)
-            )
-            return x + gated @ block["w_out"].astype(dtype)
+        def block_fn(x, block):
+            return TransformerLM.block_forward(x, block, config, positions,
+                                               attend)
 
         if config.remat:
             block_fn = jax.checkpoint(block_fn)

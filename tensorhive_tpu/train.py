@@ -38,8 +38,13 @@ class TrainConfig:
     total_steps: int = 10_000
     weight_decay: float = 0.01
     max_grad_norm: float = 1.0
-    batch_size: int = 8
+    batch_size: int = 8          # GLOBAL tokens-batch per optimizer step
     seq_len: int = 512
+    #: microbatches per optimizer step (1 = none). The [batch_size, L+1]
+    #: step input is split into grad_accum_steps microbatches scanned
+    #: sequentially with f32 gradient accumulation — big effective batches
+    #: on small slices at 1/grad_accum_steps the activation memory
+    grad_accum_steps: int = 1
 
 
 def make_optimizer(config: TrainConfig) -> optax.GradientTransformation:
@@ -113,11 +118,37 @@ def make_train_step(
     """Build the jitted train step: (params, opt_state, tokens) ->
     (params, opt_state, metrics). Params/opt-state buffers are donated."""
     optimizer = make_optimizer(train_config)
+    accum = train_config.grad_accum_steps
+    if accum > 1 and train_config.batch_size % accum:
+        raise ValueError(
+            f"batch_size {train_config.batch_size} not divisible by "
+            f"grad_accum_steps {accum}")
+
+    def loss_and_grads(params, tokens):
+        if accum <= 1:
+            return jax.value_and_grad(TransformerLM.loss)(
+                params, tokens, model_config, mesh)
+        micro = train_config.batch_size // accum
+        micro_tokens = tokens.reshape(accum, micro, tokens.shape[-1])
+
+        def one_micro(carry, batch_slice):
+            loss_sum, grads_sum = carry
+            loss, grads = jax.value_and_grad(TransformerLM.loss)(
+                params, batch_slice, model_config, mesh)
+            grads = jax.tree_util.tree_map(
+                lambda acc, g: acc + g.astype(acc.dtype), grads_sum, grads)
+            return (loss_sum + loss, grads), None
+
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        (loss_sum, grads), _ = jax.lax.scan(
+            one_micro, (jnp.float32(0.0), zeros), micro_tokens)
+        scale = 1.0 / accum
+        return loss_sum * scale, jax.tree_util.tree_map(
+            lambda g: (g * scale).astype(jnp.float32), grads)
 
     def step(params, opt_state, tokens):
-        loss, grads = jax.value_and_grad(TransformerLM.loss)(
-            params, tokens, model_config, mesh
-        )
+        loss, grads = loss_and_grads(params, tokens)
         updates, opt_state = optimizer.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
         grad_norm = optax.global_norm(grads)

@@ -542,3 +542,52 @@ def test_checkpoint_restores_across_mesh_topologies(tmp_path):
                              config.vocab_size)
     _, _, metrics = step_fn(params_r, opt_r, tokens)
     assert np.isfinite(float(metrics["loss"]))
+
+
+def test_grad_accumulation_matches_full_batch():
+    """grad_accum_steps=4 over microbatches must produce the same update as
+    one full-batch step (mean-of-means equals full mean when microbatches
+    are equal-sized)."""
+    config = TransformerConfig(vocab_size=128, d_model=32, n_heads=2,
+                               n_layers=1, d_ff=64, max_seq_len=64,
+                               dtype=jnp.float32)
+    base = TrainConfig(batch_size=8, seq_len=32, warmup_steps=1,
+                       total_steps=10)
+    accum = dataclasses.replace(base, grad_accum_steps=4)
+    tokens = synthetic_batch(jax.random.PRNGKey(7), base, config.vocab_size)
+
+    params_a, opt_a = init_train_state(jax.random.PRNGKey(0), config, base)
+    params_a, _, metrics_a = make_train_step(config, base)(
+        params_a, opt_a, tokens)
+
+    params_b, opt_b = init_train_state(jax.random.PRNGKey(0), config, accum)
+    params_b, _, metrics_b = make_train_step(config, accum)(
+        params_b, opt_b, tokens)
+
+    np.testing.assert_allclose(float(metrics_a["loss"]),
+                               float(metrics_b["loss"]), rtol=1e-5)
+    np.testing.assert_allclose(float(metrics_a["grad_norm"]),
+                               float(metrics_b["grad_norm"]), rtol=1e-4)
+    for a, b in zip(jax.tree_util.tree_leaves(params_a),
+                    jax.tree_util.tree_leaves(params_b)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=1e-5, rtol=1e-5)
+
+
+def test_grad_accumulation_on_mesh():
+    config = TransformerConfig(vocab_size=128, d_model=32, n_heads=2,
+                               n_layers=1, d_ff=64, max_seq_len=64,
+                               dtype=jnp.float32)
+    train_config = TrainConfig(batch_size=8, seq_len=32, warmup_steps=1,
+                               total_steps=10, grad_accum_steps=2)
+    mesh = make_mesh(dp=2, fsdp=2, tp=2)
+    params, opt_state = init_train_state(jax.random.PRNGKey(0), config,
+                                         train_config, mesh)
+    tokens = synthetic_batch(jax.random.PRNGKey(7), train_config,
+                             config.vocab_size)
+    _, _, metrics = make_train_step(config, train_config, mesh)(
+        params, opt_state, tokens)
+    assert np.isfinite(float(metrics["loss"]))
+    with pytest.raises(ValueError, match="divisible"):
+        make_train_step(config, dataclasses.replace(train_config,
+                                                    grad_accum_steps=3))

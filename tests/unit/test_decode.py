@@ -89,6 +89,9 @@ def test_sampling_shapes_and_top_k():
     with pytest.raises(ValueError):
         generate(params, F32_TINY, jnp.ones((1, 250), jnp.int32),
                  max_new_tokens=10)     # 260 > tiny max_seq_len 256
+    with pytest.raises(ValueError, match="top_k"):
+        generate(params, F32_TINY, prompt, max_new_tokens=2,
+                 temperature=1.0, top_k=F32_TINY.vocab_size + 1)
 
 
 def test_evaluate_perplexity():
