@@ -113,6 +113,11 @@ def generate(
     if total > config.max_seq_len:
         raise ValueError(
             f"prompt+new = {total} exceeds max_seq_len {config.max_seq_len}")
+    if top_k is not None and not 0 < top_k <= config.vocab_size:
+        # checked up-front (not only on sampling steps): jnp's index
+        # clamping would otherwise silently disable the filter
+        raise ValueError(
+            f"top_k must be in (0, {config.vocab_size}], got {top_k}")
     cache = init_cache(config, batch, max_len=total)
     key = jax.random.PRNGKey(seed)
     tokens = jnp.concatenate(
@@ -129,12 +134,6 @@ def generate(
         else:
             scaled = logits / temperature
             if top_k is not None:
-                if not 0 < top_k <= config.vocab_size:
-                    # jnp's index clamping would otherwise silently disable
-                    # the filter (or mask everything at 0)
-                    raise ValueError(
-                        f"top_k must be in (0, {config.vocab_size}], "
-                        f"got {top_k}")
                 kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
                 scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
             key, sample_key = jax.random.split(key)
@@ -164,7 +163,7 @@ def evaluate(
     if num_batches < 1:
         raise ValueError(f"num_batches must be >= 1, got {num_batches}")
     loss_fn = _eval_loss_fn(config, mesh)
-    total, count = 0.0, 0
+    total = 0.0
     for index in range(num_batches):
         try:
             tokens = next(batches)
@@ -173,7 +172,6 @@ def evaluate(
                 f"batches iterator exhausted at batch {index} of "
                 f"{num_batches}") from None
         total += float(loss_fn(params, tokens))
-        count += 1
-    mean = total / max(1, count)
+    mean = total / num_batches
     return {"loss": mean, "perplexity": float(jnp.exp(mean)),
-            "batches": count}
+            "batches": num_batches}

@@ -119,6 +119,8 @@ def make_train_step(
     (params, opt_state, metrics). Params/opt-state buffers are donated."""
     optimizer = make_optimizer(train_config)
     accum = train_config.grad_accum_steps
+    if accum < 1:
+        raise ValueError(f"grad_accum_steps must be >= 1, got {accum}")
     if accum > 1 and train_config.batch_size % accum:
         raise ValueError(
             f"batch_size {train_config.batch_size} not divisible by "
