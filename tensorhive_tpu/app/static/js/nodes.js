@@ -29,9 +29,11 @@ function sparkline(values, cls) {
 }
 
 function renderNodes(main) {
-  main.innerHTML = `<div id="nodes"></div><dialog id="chip-dialog"></dialog>`;
+  main.innerHTML = `<div id="svc-health"></div>
+    <div id="nodes"></div><dialog id="chip-dialog"></dialog>`;
   const refresh = async () => {
     try {
+      if (isAdmin()) refreshServiceHealth();
       const infra = await api("/nodes/metrics");
       for (const node of Object.values(infra)) {
         for (const [uid, chip] of Object.entries(node.TPU || {})) {
@@ -49,6 +51,31 @@ function renderNodes(main) {
   };
   refresh();
   state.timers.push(setInterval(refresh, NODES_POLL_MS));
+}
+
+/* daemon service health strip (admin): tick p50 + liveness per service */
+async function refreshServiceHealth() {
+  const el = document.getElementById("svc-health");
+  if (!el) return;
+  let services;
+  try { services = await api("/admin/services"); }
+  catch (e) {
+    // a health display must never keep asserting "alive" when the probe
+    // itself fails — mark the whole strip unknown instead
+    el.innerHTML = `<div class="card"><div class="row">
+      <h3 style="margin:0">Services</h3>
+      <span class="badge unsynchronized">health unavailable: ${esc(e.message)}</span>
+    </div></div>`;
+    return;
+  }
+  if (!services.length) { el.innerHTML = ""; return; }
+  el.innerHTML = `<div class="card"><div class="row">
+    <h3 style="margin:0">Services</h3>
+    ${services.map(svc => `<span class="badge ${svc.alive ? "on" : "unsynchronized"}"
+      title="every ${svc.intervalS}s · ${svc.ticksCompleted} ticks">
+      ${esc(svc.name)} ${svc.alive ? "✓" : "DOWN"}
+      ${svc.tickP50Ms != null ? `· ${svc.tickP50Ms}ms` : ""}</span>`).join("")}
+  </div></div>`;
 }
 
 function nodeCard(host, node) {

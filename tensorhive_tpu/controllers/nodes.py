@@ -13,7 +13,7 @@ from typing import Dict, Optional
 
 from ..api import schemas as S
 from ..api.app import RequestContext, route
-from ..api.schema import arr, s
+from ..api.schema import arr, obj, s
 from ..core.managers.manager import get_manager
 from ..db.models.resource import Resource
 from ..utils.exceptions import NotFoundError
@@ -99,3 +99,30 @@ def get_tpu_processes(context: RequestContext, hostname: str):
 def get_cpu_metrics(context: RequestContext, hostname: str):
     node = get_node_metrics(context, hostname)
     return node.get("CPU", {})
+
+
+@route("/admin/services", ["GET"], auth="admin",
+       summary="Daemon service health (tick latency, liveness)", tag="nodes",
+       responses={200: arr(obj(
+           required=["name", "alive", "intervalS", "ticksCompleted"],
+           name=s("string"),
+           alive=s("boolean"),
+           intervalS=s("number"),
+           ticksCompleted=s("integer"),
+           tickP50Ms=s("number", nullable=True)))})
+def get_service_health(context: RequestContext):
+    """Per-service tick stats — the loop-timing observability the reference
+    only wrote to debug logs (MonitoringService.py:38-54; SURVEY.md §5
+    tracing), surfaced as API so the UI can show daemon health."""
+    service_manager = get_manager().service_manager
+    health = []
+    for service in (service_manager.services if service_manager else []):
+        p50 = service.tick_latency_p50()
+        health.append({
+            "name": service.name,
+            "alive": service.is_alive(),
+            "intervalS": service.interval_s,
+            "ticksCompleted": service.ticks_completed,
+            "tickP50Ms": round(p50 * 1000, 2) if p50 is not None else None,
+        })
+    return health

@@ -70,6 +70,7 @@ UI_CALLS = {
         "`/nodes/${encodeURIComponent(host)}/tpu/processes`",
     ("GET", "/nodes/<hostname>/cpu/metrics"):
         "`/nodes/${encodeURIComponent(host)}/cpu/metrics`",
+    ("GET", "/admin/services"): 'api("/admin/services")',
     # reservations calendar (calendar.js)
     ("GET", "/resources"): 'api("/resources")',
     ("GET", "/resources/<uid>"): '"/resources/" + encodeURIComponent(uid)',
@@ -279,6 +280,13 @@ def test_nodes_dashboard_shapes(api, user, user_headers):
     assert set(processes) == set(node["TPU"])
     cpu = _ok(api.get("/api/nodes/vm-0/cpu/metrics", headers=user_headers))
     assert list(cpu.values())[0]["util_pct"] == 7
+
+
+def test_service_health_shapes(api, admin_headers, user_headers):
+    services = _ok(api.get("/api/admin/services", headers=admin_headers))
+    assert isinstance(services, list)       # empty: test manager runs none
+    assert api.get("/api/admin/services",
+                   headers=user_headers).status_code == 403
 
 
 def test_reservation_calendar_shapes(api, user, user_headers):

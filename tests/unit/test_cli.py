@@ -96,6 +96,23 @@ def test_daemon_boot_path(db, config, monkeypatch):
             f"http://127.0.0.1:{servers['port']}/api/openapi.json",
             timeout=10).read())
         assert len(spec["paths"]) >= 40
+        # the daemon's services are live and introspectable over the API
+        from tests.fixtures import make_user
+
+        make_user(username="root1", password="SuperSecret42", admin=True)
+        base = f"http://127.0.0.1:{servers['port']}/api"
+        login = urllib.request.Request(
+            base + "/user/login",
+            data=json.dumps({"username": "root1",
+                             "password": "SuperSecret42"}).encode(),
+            headers={"Content-Type": "application/json"})
+        token = json.loads(opener.open(login, timeout=10).read())["accessToken"]
+        health_request = urllib.request.Request(
+            base + "/admin/services",
+            headers={"Authorization": f"Bearer {token}"})
+        health = json.loads(opener.open(health_request, timeout=10).read())
+        assert any(svc["name"] == "MonitoringService" and svc["alive"]
+                   for svc in health), health
     finally:
         servers["stop"].set()
         boot.join(timeout=30)
