@@ -1,0 +1,27 @@
+"""tensorhive_tpu — a TPU-native cluster resource-management framework.
+
+A from-scratch rebuild of the capabilities of TensorHive (reference:
+kivicode/TensorHive-Fixed) with TPUs as the first-class managed resource:
+
+* calendar-based exclusive reservations of TPU chips/slices with conflict
+  detection (reference: tensorhive/models/Reservation.py),
+* live infrastructure monitoring streaming per-chip HBM / duty-cycle metrics
+  (reference: tensorhive/core/monitors/GPUMonitor.py — rebuilt on a native
+  telemetry collector instead of ``nvidia-smi`` parsing),
+* reservation-violation protection: warn on PTYs, e-mail, or kill intruding
+  processes (reference: tensorhive/core/services/ProtectionService.py),
+* a job-execution module spawning multi-process distributed training jobs on
+  remote hosts (reference: tensorhive/core/task_nursery.py) with
+  ``jax.distributed`` / torch-xla / TF_CONFIG launch templates,
+* a REST API + JWT auth + CLI, and
+* a JAX/pallas compute stack (``models``, ``ops``, ``parallel``) providing the
+  flagship workloads (transformer pretraining) that the job module launches
+  onto reserved slices.
+
+Unlike the reference (pure Python + nvidia-smi over SSH), the hot telemetry
+path binds a C++ collector, and the compute stack is built TPU-first: SPMD via
+``jax.sharding.Mesh`` + ``jax.jit``, sequence parallelism via ring attention
+over ``shard_map``, bfloat16 matmuls for the MXU.
+"""
+
+__version__ = "0.1.0"

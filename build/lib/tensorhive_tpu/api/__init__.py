@@ -1,0 +1,1 @@
+"""REST API layer (reference: tensorhive/api/ + tensorhive/authorization.py)."""

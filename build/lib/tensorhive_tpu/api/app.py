@@ -1,0 +1,267 @@
+"""WSGI application core: routing, auth enforcement, JSON error mapping.
+
+Reference: tensorhive/api/APIServer.py:17-44 builds a Connexion FlaskApp that
+resolves ``operationId``s in a 3793-line OpenAPI YAML onto controller
+functions, with Flask-JWT-Extended decorators per endpoint. The rebuild
+inverts the direction — routes are declared in code next to the controllers
+(one ``@route`` per reference operationId) and the OpenAPI document is
+*generated* from the registry (api/spec.py) — same spec-driven client
+surface, no YAML/implementation drift possible, zero web-framework
+dependencies beyond werkzeug's routing/request primitives.
+
+Auth levels mirror the reference exactly: ``auth=None`` (login/signup),
+``auth="jwt"`` (@jwt_required), ``auth="admin"`` (@admin_required,
+authorization.py:37-45).
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from werkzeug.exceptions import HTTPException
+from werkzeug.routing import Map, Rule
+from werkzeug.wrappers import Request, Response
+
+from ..db.models.user import User
+from ..utils.exceptions import (
+    ConflictError,
+    ForbiddenError,
+    NotFoundError,
+    TransportError,
+    ValidationError,
+)
+from . import jwt as jwt_module
+from .jwt import AuthError
+from .schema import validate as schema_validate
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class Endpoint:
+    """One registered operation (≈ one operationId in the reference spec)."""
+
+    path: str
+    methods: List[str]
+    handler: Callable
+    auth: Optional[str]          # None | "jwt" | "admin" | "refresh" | "logout"*
+    summary: str
+    tag: str
+    #: request-body schema (api/schema.py subset); validated server-side
+    #: before the handler runs — malformed bodies 422 from the schema layer
+    body: Optional[Dict] = None
+    #: response schemas per status code (emitted in the OpenAPI doc)
+    responses: Optional[Dict[int, Dict]] = None
+    #: query-parameter schemas by name (documentation; int coercion stays
+    #: in int_arg so malformed values 422 consistently)
+    query: Optional[Dict[str, Dict]] = None
+
+
+_REGISTRY: List[Endpoint] = []
+
+
+def route(path: str, methods: List[str], auth: Optional[str] = "jwt",
+          summary: str = "", tag: str = "",
+          body: Optional[Dict] = None,
+          responses: Optional[Dict[int, Dict]] = None,
+          query: Optional[Dict[str, Dict]] = None) -> Callable:
+    """Register a controller function as an API operation."""
+
+    def decorate(fn: Callable) -> Callable:
+        _REGISTRY.append(Endpoint(
+            path=path,
+            methods=[m.upper() for m in methods],
+            handler=fn,
+            auth=auth,
+            summary=summary or (fn.__doc__ or "").strip().splitlines()[0] if fn.__doc__ else summary,
+            tag=tag or fn.__module__.rsplit(".", 1)[-1],
+            body=body,
+            responses=responses,
+            query=query,
+        ))
+        return fn
+
+    return decorate
+
+
+def registered_endpoints() -> List[Endpoint]:
+    _load_controllers()
+    return list(_REGISTRY)
+
+
+_controllers_loaded = False
+_load_lock = threading.Lock()
+
+
+def _load_controllers() -> None:
+    """Import every controller module so @route decorators run (reference:
+    RestyResolver scans tensorhive.controllers, api/APIServer.py:31)."""
+    global _controllers_loaded
+    with _load_lock:
+        if _controllers_loaded:
+            return
+        from ..controllers import ALL_MODULES  # noqa: F401  (import side effect)
+
+        _controllers_loaded = True
+
+
+class RequestContext:
+    """Per-request state handed to controllers needing the acting user."""
+
+    def __init__(self, request: Request, claims: Optional[Dict[str, Any]]) -> None:
+        self.request = request
+        self.claims = claims or {}
+
+    @property
+    def user_id(self) -> Optional[int]:
+        return self.claims.get("sub")
+
+    @property
+    def roles(self) -> List[str]:
+        return self.claims.get("roles", [])
+
+    @property
+    def is_admin(self) -> bool:
+        return "admin" in self.roles
+
+    def current_user(self) -> User:
+        user = User.get_or_none(self.user_id) if self.user_id is not None else None
+        if user is None:
+            raise AuthError("token subject no longer exists")
+        return user
+
+    _json_cache: Optional[Dict[str, Any]] = None
+
+    def json(self) -> Dict[str, Any]:
+        if self._json_cache is None:
+            try:
+                data = json.loads(self.request.get_data(as_text=True) or "{}")
+            except json.JSONDecodeError:
+                raise ValidationError("request body is not valid JSON")
+            if not isinstance(data, dict):
+                raise ValidationError("request body must be a JSON object")
+            self._json_cache = data
+        return self._json_cache
+
+
+class ApiApp:
+    """The WSGI application."""
+
+    def __init__(self, url_prefix: str = "api") -> None:
+        _load_controllers()
+        self.url_prefix = url_prefix.strip("/")
+        rules = []
+        self._endpoints: Dict[str, Endpoint] = {}
+        for i, ep in enumerate(_REGISTRY):
+            name = f"ep{i}"
+            self._endpoints[name] = ep
+            prefixed = f"/{self.url_prefix}{ep.path}" if self.url_prefix else ep.path
+            rules.append(Rule(prefixed, methods=ep.methods, endpoint=name))
+        from .spec import spec_rules
+
+        rules.extend(spec_rules(self.url_prefix, self._endpoints))
+        self.url_map = Map(rules)
+
+    # -- dispatch ----------------------------------------------------------
+    def wsgi_app(self, environ, start_response):
+        request = Request(environ)
+        response = self.dispatch(request)
+        return response(environ, start_response)
+
+    __call__ = wsgi_app
+
+    def dispatch(self, request: Request) -> Response:
+        if request.method == "OPTIONS":
+            return self._with_cors(Response(status=204))
+        adapter = self.url_map.bind_to_environ(request.environ)
+        try:
+            endpoint_name, path_args = adapter.match()
+        except HTTPException as exc:
+            return self._with_cors(self._error(exc.code or 500, exc.description))
+        if callable(endpoint_name):  # spec/static endpoints
+            return self._with_cors(endpoint_name(request))
+        endpoint = self._endpoints[endpoint_name]
+        try:
+            claims = self._authenticate(request, endpoint)
+            context = RequestContext(request, claims)
+            if endpoint.body is not None and request.method in ("POST", "PUT", "PATCH"):
+                # spec-driven request validation (reference: Connexion
+                # strict_validation against api_specification.yml schemas)
+                schema_validate(context.json(), endpoint.body)
+            result = endpoint.handler(context, **path_args)
+            body, status = result if isinstance(result, tuple) else (result, 200)
+            response = Response(
+                json.dumps(body, default=str),
+                status=status,
+                content_type="application/json",
+            )
+        except AuthError as exc:
+            response = self._error(401, str(exc))
+        except ForbiddenError as exc:
+            response = self._error(403, str(exc))
+        except NotFoundError as exc:
+            response = self._error(404, str(exc))
+        except ConflictError as exc:
+            response = self._error(409, str(exc))
+        except ValidationError as exc:
+            response = self._error(422, str(exc))
+        except TransportError as exc:
+            response = self._error(502, str(exc))
+        except Exception:
+            log.exception("unhandled error on %s %s", request.method, request.path)
+            response = self._error(500, "internal server error")
+        return self._with_cors(response)
+
+    def _authenticate(self, request: Request, endpoint: Endpoint) -> Optional[Dict]:
+        if endpoint.auth is None:
+            return None
+        header = request.headers.get("Authorization", "")
+        if not header.startswith("Bearer "):
+            raise AuthError("missing bearer token")
+        expected = "refresh" if endpoint.auth in ("refresh", "logout-refresh") else "access"
+        # logout endpoints verify the signature only: revocation must be
+        # idempotent, so a second logout (or one racing expiry) is a 200
+        verify_active = endpoint.auth not in ("logout", "logout-refresh")
+        claims = jwt_module.decode(
+            header[len("Bearer "):], expected_type=expected, verify_active=verify_active
+        )
+        if endpoint.auth == "admin" and "admin" not in claims.get("roles", []):
+            raise ForbiddenError("admin role required")
+        return claims
+
+    @staticmethod
+    def _error(status: int, message: str) -> Response:
+        return Response(
+            json.dumps({"msg": message}), status=status, content_type="application/json"
+        )
+
+    @staticmethod
+    def _with_cors(response: Response) -> Response:
+        """Reference enables blanket CORS for the SPA (APIServer.py CORS)."""
+        response.headers["Access-Control-Allow-Origin"] = "*"
+        response.headers["Access-Control-Allow-Headers"] = "Authorization, Content-Type"
+        response.headers["Access-Control-Allow-Methods"] = "GET, POST, PUT, DELETE, OPTIONS"
+        return response
+
+
+def json_body(context: RequestContext, *required: str) -> Dict[str, Any]:
+    """Parse the JSON body and assert required fields are present."""
+    data = context.json()
+    missing = [field for field in required if field not in data]
+    if missing:
+        raise ValidationError(f"missing required fields: {', '.join(missing)}")
+    return data
+
+
+def int_arg(context: RequestContext, name: str) -> Optional[int]:
+    """Optional integer query parameter; malformed values are 422, not 500."""
+    value = context.request.args.get(name)
+    if value is None:
+        return None
+    try:
+        return int(value)
+    except ValueError:
+        raise ValidationError(f"query parameter {name!r} must be an integer")

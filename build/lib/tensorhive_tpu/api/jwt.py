@@ -1,0 +1,121 @@
+"""Stdlib HS256 JWT implementation.
+
+Reference: Flask-JWT-Extended usage in tensorhive/authorization.py:15-33
+(blacklist loader + roles claim loader) and controllers/user.py:182-240
+(login issues access+refresh tokens, logout blacklists each by jti). The
+dependency-free rebuild keeps the same token semantics: HS256-signed
+access/refresh pairs carrying ``sub`` (user id), ``roles``, ``jti`` (for the
+RevokedToken blacklist), ``type``, ``iat``/``exp``.
+"""
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import json
+import time
+import uuid
+from typing import Any, Dict, Optional
+
+from ..config import get_config
+from ..db.models.token import RevokedToken
+from ..utils.exceptions import TpuHiveError
+
+
+class AuthError(TpuHiveError):
+    """Invalid/expired/revoked token or malformed credentials (→ HTTP 401)."""
+
+
+def _b64url(data: bytes) -> str:
+    return base64.urlsafe_b64encode(data).rstrip(b"=").decode()
+
+
+def _b64url_decode(data: str) -> bytes:
+    padding = "=" * (-len(data) % 4)
+    return base64.urlsafe_b64decode(data + padding)
+
+
+def _secret() -> bytes:
+    secret = get_config().api.secret_key
+    if not secret:
+        raise AuthError("api.secret_key is not configured")
+    return secret.encode()
+
+
+def encode(claims: Dict[str, Any]) -> str:
+    header = _b64url(json.dumps({"alg": "HS256", "typ": "JWT"}, separators=(",", ":")).encode())
+    payload = _b64url(json.dumps(claims, separators=(",", ":")).encode())
+    signing_input = f"{header}.{payload}".encode()
+    signature = _b64url(hmac.new(_secret(), signing_input, hashlib.sha256).digest())
+    return f"{header}.{payload}.{signature}"
+
+
+def decode(
+    token: str,
+    expected_type: Optional[str] = "access",
+    verify_active: bool = True,
+) -> Dict[str, Any]:
+    """Verify signature (+ expiry + blacklist unless ``verify_active=False``);
+    returns the claims dict."""
+    try:
+        header_b64, payload_b64, signature_b64 = token.split(".")
+    except ValueError:
+        raise AuthError("malformed token")
+    signing_input = f"{header_b64}.{payload_b64}".encode()
+    expected = hmac.new(_secret(), signing_input, hashlib.sha256).digest()
+    try:
+        provided = _b64url_decode(signature_b64)
+    except (ValueError, TypeError):
+        raise AuthError("malformed token signature")
+    if not hmac.compare_digest(expected, provided):
+        raise AuthError("invalid token signature")
+    try:
+        claims = json.loads(_b64url_decode(payload_b64))
+    except (ValueError, TypeError):
+        raise AuthError("malformed token payload")
+    if verify_active:
+        if claims.get("exp") is not None and time.time() >= claims["exp"]:
+            raise AuthError("token expired")
+    if expected_type is not None and claims.get("type") != expected_type:
+        raise AuthError(f"wrong token type (expected {expected_type})")
+    if verify_active:
+        jti = claims.get("jti")
+        if jti and RevokedToken.is_jti_blacklisted(jti):
+            raise AuthError("token revoked")
+    return claims
+
+
+def create_access_token(user_id: int, roles: list) -> str:
+    cfg = get_config().api
+    now = time.time()
+    return encode({
+        "sub": user_id,
+        "roles": roles,
+        "type": "access",
+        "jti": uuid.uuid4().hex,
+        "iat": int(now),
+        "exp": int(now + cfg.access_token_minutes * 60),
+    })
+
+
+def create_refresh_token(user_id: int) -> str:
+    cfg = get_config().api
+    now = time.time()
+    return encode({
+        "sub": user_id,
+        "type": "refresh",
+        "jti": uuid.uuid4().hex,
+        "iat": int(now),
+        "exp": int(now + cfg.refresh_token_days * 86400),
+    })
+
+
+def revoke_claims(claims: Dict[str, Any]) -> None:
+    """Blacklist an already-verified token by jti (reference logout,
+    controllers/user.py:207-230). Idempotent: RevokedToken.add atomically
+    no-ops on an already-blacklisted jti, so a repeated POST /user/logout
+    (or logout racing expiry) is not a 401 — the logout auth mode verifies
+    the signature only (``decode(verify_active=False)``)."""
+    jti = claims.get("jti")
+    if jti:
+        RevokedToken.add(jti)

@@ -1,0 +1,161 @@
+"""JSON-Schema subset: component registry + server-side validator.
+
+Reference: tensorhive/api/api_specification.yml declares full request/response
+JSON schemas for every operation and Connexion enforces them server-side
+(``strict_validation=True``, api/APIServer.py:31-44). The rebuild keeps the
+schemas next to the routes (no YAML/implementation drift) and validates with
+this ~150-line interpreter of the OpenAPI-3.0 schema subset the API actually
+uses:
+
+    type (object/array/string/integer/number/boolean), nullable, enum,
+    properties / required / additionalProperties, items, minLength,
+    maxLength, minimum, maximum, format (annotation only), $ref into
+    #/components/schemas/.
+
+Anything outside the subset is rejected at registration time, so the emitted
+OpenAPI document is always enforceable — a schema the validator can't check
+never ships in the spec.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ..utils.exceptions import ValidationError
+
+# -- component registry ------------------------------------------------------
+
+_COMPONENTS: Dict[str, Dict] = {}
+
+_ALLOWED_KEYS = {
+    "type", "nullable", "enum", "properties", "required", "additionalProperties",
+    "items", "minLength", "maxLength", "minimum", "maximum", "format",
+    "description", "example", "$ref", "default",
+}
+_ALLOWED_TYPES = {"object", "array", "string", "integer", "number", "boolean"}
+
+
+def _check_schema(schema: Dict, where: str) -> None:
+    """Registration-time lint: only the enforceable subset may appear."""
+    if not isinstance(schema, dict):
+        raise TypeError(f"{where}: schema must be a dict, got {type(schema).__name__}")
+    unknown = set(schema) - _ALLOWED_KEYS
+    if unknown:
+        raise TypeError(f"{where}: unsupported schema keys {sorted(unknown)}")
+    if "$ref" in schema:
+        ref = schema["$ref"]
+        prefix = "#/components/schemas/"
+        if not ref.startswith(prefix):
+            raise TypeError(f"{where}: $ref must target {prefix}")
+        return
+    stype = schema.get("type")
+    if stype is not None and stype not in _ALLOWED_TYPES:
+        raise TypeError(f"{where}: unsupported type {stype!r}")
+    for name, sub in (schema.get("properties") or {}).items():
+        _check_schema(sub, f"{where}.{name}")
+    if "items" in schema:
+        _check_schema(schema["items"], f"{where}[]")
+    extra = schema.get("additionalProperties")
+    if isinstance(extra, dict):
+        _check_schema(extra, f"{where}.*")
+
+
+def component(name: str, schema: Dict) -> Dict:
+    """Register a named schema; returns the ``$ref`` to embed elsewhere."""
+    _check_schema(schema, name)
+    _COMPONENTS[name] = schema
+    return {"$ref": f"#/components/schemas/{name}"}
+
+
+def components() -> Dict[str, Dict]:
+    return dict(_COMPONENTS)
+
+
+def resolve(schema: Dict) -> Dict:
+    ref = schema.get("$ref")
+    if ref is None:
+        return schema
+    name = ref.rsplit("/", 1)[-1]
+    try:
+        return _COMPONENTS[name]
+    except KeyError:
+        raise TypeError(f"unknown schema component {name!r}")
+
+
+# -- validation --------------------------------------------------------------
+
+def _type_ok(value: Any, stype: str) -> bool:
+    if stype == "object":
+        return isinstance(value, dict)
+    if stype == "array":
+        return isinstance(value, list)
+    if stype == "string":
+        return isinstance(value, str)
+    if stype == "boolean":
+        return isinstance(value, bool)
+    if stype == "integer":
+        return isinstance(value, int) and not isinstance(value, bool)
+    if stype == "number":
+        return isinstance(value, (int, float)) and not isinstance(value, bool)
+    return True
+
+
+def validate(value: Any, schema: Dict, path: str = "body") -> None:
+    """Raise ValidationError (→ HTTP 422) with a precise path on mismatch."""
+    schema = resolve(schema)
+    if value is None:
+        if schema.get("nullable"):
+            return
+        raise ValidationError(f"{path}: must not be null")
+    stype = schema.get("type")
+    if stype and not _type_ok(value, stype):
+        raise ValidationError(f"{path}: expected {stype}, got {type(value).__name__}")
+    if "enum" in schema and value not in schema["enum"]:
+        raise ValidationError(f"{path}: must be one of {schema['enum']}")
+    if stype == "string":
+        if "minLength" in schema and len(value) < schema["minLength"]:
+            raise ValidationError(f"{path}: shorter than {schema['minLength']} characters")
+        if "maxLength" in schema and len(value) > schema["maxLength"]:
+            raise ValidationError(f"{path}: longer than {schema['maxLength']} characters")
+    if stype in ("integer", "number"):
+        if "minimum" in schema and value < schema["minimum"]:
+            raise ValidationError(f"{path}: below minimum {schema['minimum']}")
+        if "maximum" in schema and value > schema["maximum"]:
+            raise ValidationError(f"{path}: above maximum {schema['maximum']}")
+    if stype == "object":
+        props = schema.get("properties") or {}
+        for name in schema.get("required", ()):
+            if name not in value:
+                raise ValidationError(f"{path}: missing required field {name!r}")
+        extra = schema.get("additionalProperties", True)
+        for name, item in value.items():
+            if name in props:
+                validate(item, props[name], f"{path}.{name}")
+            elif extra is False:
+                raise ValidationError(f"{path}: unknown field {name!r}")
+            elif isinstance(extra, dict):
+                validate(item, extra, f"{path}.{name}")
+    if stype == "array":
+        items = schema.get("items")
+        if items is not None:
+            for i, item in enumerate(value):
+                validate(item, items, f"{path}[{i}]")
+
+
+# -- tiny builder helpers (keep route declarations readable) -----------------
+
+def obj(required: Optional[List[str]] = None, extra: bool = False, **props: Dict) -> Dict:
+    """Object schema; fields are keyword args, ``required`` lists names,
+    ``extra`` allows undeclared fields (default: strict)."""
+    out: Dict[str, Any] = {"type": "object", "properties": props,
+                           "additionalProperties": extra}
+    if required:
+        out["required"] = list(required)
+    return out
+
+
+def arr(items: Dict) -> Dict:
+    return {"type": "array", "items": items}
+
+
+def s(stype: str, **kw: Any) -> Dict:
+    return {"type": stype, **kw}

@@ -1,0 +1,182 @@
+"""Shared OpenAPI component schemas (model serialization shapes).
+
+Reference: the ``definitions`` blocks of
+tensorhive/api/api_specification.yml. Each component mirrors the
+corresponding model's ``as_dict`` output exactly (db/models/*), so clients
+can codegen from ``/openapi.json`` and the functional tests can assert the
+spec and the wire format agree.
+"""
+from __future__ import annotations
+
+from .schema import arr, component, obj, s
+
+_dt = s("string", format="date-time", nullable=True)
+_id = s("integer")
+
+MSG = component("Msg", obj(required=["msg"], msg=s("string")))
+
+USER = component("User", obj(
+    required=["id", "username"],
+    id=_id,
+    username=s("string"),
+    email=s("string"),
+    createdAt=_dt,
+    lastLoginAt=_dt,
+    roles=arr(s("string", enum=["user", "admin"])),
+))
+
+TOKEN_PAIR = component("TokenPair", obj(
+    required=["user", "accessToken", "refreshToken"],
+    user=USER,
+    accessToken=s("string"),
+    refreshToken=s("string"),
+))
+
+GROUP = component("Group", obj(
+    required=["id", "name"],
+    id=_id,
+    name=s("string"),
+    isDefault=s("boolean"),
+    createdAt=_dt,
+    users=arr(USER),
+))
+
+SCHEDULE = component("Schedule", obj(
+    required=["id", "scheduleDays", "hourStart", "hourEnd"],
+    id=_id,
+    scheduleDays=s("string", description="weekday mask, e.g. '12345'"),
+    hourStart=s("string", example="08:00"),
+    hourEnd=s("string", example="20:00"),
+))
+
+RESOURCE = component("Resource", obj(
+    required=["id", "uid", "hostname"],
+    id=_id,
+    uid=s("string", description="chip uid '{host}:tpu:{index}'"),
+    name=s("string"),
+    hostname=s("string"),
+    acceleratorType=s("string", nullable=True, example="v5litepod-8"),
+    sliceName=s("string", nullable=True),
+    chipIndex=s("integer", nullable=True),
+))
+
+RESTRICTION = component("Restriction", obj(
+    required=["id", "name", "isGlobal"],
+    id=_id,
+    name=s("string"),
+    startsAt=_dt,
+    endsAt=_dt,
+    isGlobal=s("boolean"),
+    createdAt=_dt,
+    schedules=arr(SCHEDULE),
+    resources=arr(RESOURCE),
+    users=arr(s("integer")),
+    groups=arr(s("integer")),
+))
+
+RESERVATION = component("Reservation", obj(
+    required=["id", "title", "resourceId", "userId", "start", "end"],
+    id=_id,
+    title=s("string"),
+    description=s("string"),
+    resourceId=s("string"),
+    userId=s("integer"),
+    start=s("string", format="date-time"),
+    end=s("string", format="date-time"),
+    isCancelled=s("boolean"),
+    dutyCycleAvg=s("number", nullable=True),
+    hbmUtilAvg=s("number", nullable=True),
+))
+
+CMD_SEGMENT = component("CmdSegment", obj(
+    required=["name", "type"],
+    name=s("string"),
+    value=s("string", nullable=True),
+    type=s("string", enum=["env_variable", "parameter"]),
+    index=s("integer"),
+))
+
+TASK = component("Task", obj(
+    required=["id", "jobId", "hostname", "status", "command"],
+    id=_id,
+    jobId=s("integer"),
+    hostname=s("string"),
+    pid=s("integer", nullable=True),
+    status=s("string", enum=["not_running", "running", "terminated", "unsynchronized"]),
+    command=s("string"),
+    fullCommand=s("string"),
+    cmdSegments=arr(CMD_SEGMENT),
+))
+
+JOB = component("Job", obj(
+    required=["id", "name", "userId", "status"],
+    id=_id,
+    name=s("string"),
+    description=s("string"),
+    userId=s("integer"),
+    status=s("string",
+             enum=["not_running", "running", "terminated", "unsynchronized", "pending"]),
+    startAt=_dt,
+    stopAt=_dt,
+    isQueued=s("boolean"),
+    tasks=arr(TASK),
+))
+
+TASK_LOG = component("TaskLog", obj(required=["log"], log=s("string")))
+
+# node/infrastructure payloads are monitor-shaped (open dictionaries keyed by
+# hostname / chip uid); declare the envelope without freezing telemetry keys
+CHIP_METRICS = component("ChipMetrics", obj(
+    extra=True,
+    index=s("integer"),
+    processes=arr(obj(extra=True, pid=s("integer"), user=s("string", nullable=True),
+                      command=s("string", nullable=True))),
+))
+
+NODE = component("Node", obj(
+    extra=True,
+    TPU={"type": "object", "additionalProperties": CHIP_METRICS,
+         "description": "chip uid -> metrics"},
+    CPU=obj(extra=True),
+))
+
+INFRASTRUCTURE = component("Infrastructure", {
+    "type": "object",
+    "additionalProperties": NODE,
+    "description": "hostname -> node telemetry",
+})
+
+# -- common request bodies ---------------------------------------------------
+
+LOGIN_BODY = component("LoginBody", obj(
+    required=["username", "password"],
+    username=s("string"),
+    password=s("string"),
+))
+
+CREATE_USER_BODY = component("CreateUserBody", obj(
+    required=["username", "email", "password"],
+    username=s("string", minLength=3),
+    email=s("string"),
+    password=s("string", minLength=8),
+    admin=s("boolean", description="also grant the admin role"),
+))
+
+SIGNUP_BODY = component("SignupBody", obj(
+    required=["username", "email", "password"],
+    username=s("string", minLength=3,
+               description="must match a unix account on the first managed host"),
+    email=s("string"),
+    password=s("string", minLength=8),
+))
+
+UPDATE_USER_BODY = component("UpdateUserBody", obj(
+    email=s("string"),
+    password=s("string", minLength=8),
+    roles=arr(s("string", enum=["user", "admin"])),
+))
+
+GRACEFULLY_BODY = component("GracefullyBody", obj(
+    gracefully=s("boolean", nullable=True,
+                 description="true=SIGINT, null=SIGTERM, false=SIGKILL"),
+))

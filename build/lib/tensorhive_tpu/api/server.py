@@ -1,0 +1,56 @@
+"""Threaded WSGI server for the API.
+
+Reference: tensorhive/api/APIServer.py:17-44 — Connexion on a gevent backend,
+blocking the main thread (cli.py:143 ``api_server.run_forever()``). Here a
+stdlib-threaded werkzeug server: requests are short DB/dict reads, the GIL is
+released during sqlite and socket IO, and the monitoring fan-out lives on its
+own threads, so thread-per-request is plenty for a control-plane API.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from werkzeug.serving import make_server
+
+from ..config import Config, get_config
+from .app import ApiApp
+
+log = logging.getLogger(__name__)
+
+
+class APIServer:
+    def __init__(self, config: Optional[Config] = None) -> None:
+        self.config = config or get_config()
+        self.app = ApiApp(url_prefix=self.config.api.url_prefix)
+        self._server = None
+
+    def start(self):
+        """Bind and serve on a background thread; returns the bound port."""
+        import threading
+
+        cfg = self.config.api
+        self._server = make_server(cfg.url_hostname, cfg.url_port, self.app, threaded=True)
+        thread = threading.Thread(target=self._server.serve_forever, daemon=True,
+                                  name="api-server")
+        thread.start()
+        log.info("API listening on %s:%d/%s", cfg.url_hostname,
+                 self._server.server_port, cfg.url_prefix)
+        return self._server.server_port
+
+    def run_forever(self) -> None:
+        """Blocking variant for the CLI main path (reference run_forever)."""
+        cfg = self.config.api
+        self._server = make_server(cfg.url_hostname, cfg.url_port, self.app, threaded=True)
+        log.info("API listening on %s:%d/%s", cfg.url_hostname,
+                 self._server.server_port, cfg.url_prefix)
+        try:
+            self._server.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            self._server.shutdown()
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()

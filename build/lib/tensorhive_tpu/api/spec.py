@@ -1,0 +1,136 @@
+"""OpenAPI document generation + API index page.
+
+Reference: tensorhive/api/api_specification.yml (3793 lines, 44 paths / 66
+operationIds) bound by RestyResolver; swagger UI served at ``/{prefix}/ui/``.
+Here the document is generated from the live route registry, so it can never
+drift from the implementation; it is served at ``/{prefix}/openapi.json``
+with a minimal self-contained HTML explorer at ``/{prefix}/ui/`` (no CDN
+assets — managed clusters are often airgapped).
+"""
+from __future__ import annotations
+
+import json
+import re
+from typing import Dict, List
+
+from werkzeug.routing import Rule
+from werkzeug.wrappers import Request, Response
+
+from .. import __version__
+
+_PATH_PARAM_RE = re.compile(r"<(?:(?P<conv>[^:<>]+):)?(?P<name>[^<>]+)>")
+
+
+def _openapi_path(path: str) -> str:
+    return _PATH_PARAM_RE.sub(lambda m: "{%s}" % m.group("name"), path)
+
+
+def _path_params(path: str) -> List[Dict]:
+    params = []
+    for match in _PATH_PARAM_RE.finditer(path):
+        conv = match.group("conv") or "string"
+        params.append({
+            "name": match.group("name"),
+            "in": "path",
+            "required": True,
+            "schema": {"type": "integer" if conv == "int" else "string"},
+        })
+    return params
+
+
+def build_openapi(url_prefix: str, endpoints: Dict[str, "Endpoint"]) -> Dict:  # noqa: F821
+    from .schema import components
+
+    paths: Dict[str, Dict] = {}
+    for ep in endpoints.values():
+        item = paths.setdefault(_openapi_path(ep.path), {})
+        for method in ep.methods:
+            if method == "OPTIONS":
+                continue
+            responses: Dict[str, Dict] = {}
+            for status, schema in (ep.responses or {200: None}).items():
+                entry: Dict = {"description": "success" if status < 400 else "error"}
+                if schema is not None:
+                    entry["content"] = {"application/json": {"schema": schema}}
+                responses[str(status)] = entry
+            operation = {
+                "summary": ep.summary or "",
+                "tags": [ep.tag],
+                "responses": responses,
+            }
+            if ep.body is not None and method in ("POST", "PUT", "PATCH"):
+                operation["requestBody"] = {
+                    "required": True,
+                    "content": {"application/json": {"schema": ep.body}},
+                }
+                operation["responses"].setdefault(
+                    "422", {"description": "request body failed schema validation"}
+                )
+            if ep.auth is not None:
+                operation["security"] = [{"bearerAuth": []}]
+                operation["responses"]["401"] = {"description": "unauthorized"}
+            if ep.auth == "admin":
+                operation["responses"]["403"] = {"description": "admin role required"}
+            params = _path_params(ep.path)
+            for name, schema in (ep.query or {}).items():
+                params.append({
+                    "name": name, "in": "query", "required": False, "schema": schema,
+                })
+            if params:
+                operation["parameters"] = params
+            item[method.lower()] = operation
+    return {
+        "openapi": "3.0.3",
+        "info": {"title": "tpuhive API", "version": __version__},
+        "servers": [{"url": f"/{url_prefix}" if url_prefix else "/"}],
+        "components": {
+            "securitySchemes": {
+                "bearerAuth": {"type": "http", "scheme": "bearer", "bearerFormat": "JWT"}
+            },
+            "schemas": components(),
+        },
+        "paths": paths,
+    }
+
+
+def spec_rules(url_prefix: str, endpoints: Dict[str, "Endpoint"]) -> List[Rule]:  # noqa: F821
+    prefix = f"/{url_prefix}" if url_prefix else ""
+
+    def serve_spec(request: Request) -> Response:
+        doc = build_openapi(url_prefix, endpoints)
+        return Response(json.dumps(doc, indent=1), content_type="application/json")
+
+    def serve_ui(request: Request) -> Response:
+        doc = build_openapi(url_prefix, endpoints)
+        rows = []
+        for path, item in sorted(doc["paths"].items()):
+            for method, op in item.items():
+                auth = "🔒" if op.get("security") else ""
+                rows.append(
+                    f"<tr><td><code>{method.upper()}</code></td>"
+                    f"<td><code>{path}</code></td><td>{op['summary']}</td>"
+                    f"<td>{auth}</td></tr>"
+                )
+        html = _UI_TEMPLATE.format(version=doc["info"]["version"], rows="\n".join(rows))
+        return Response(html, content_type="text/html")
+
+    return [
+        Rule(f"{prefix}/openapi.json", methods=["GET"], endpoint=serve_spec),
+        Rule(f"{prefix}/ui/", methods=["GET"], endpoint=serve_ui),
+    ]
+
+
+_UI_TEMPLATE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>tpuhive API</title>
+<style>
+ body {{ font-family: system-ui, sans-serif; margin: 2rem auto; max-width: 60rem; }}
+ table {{ border-collapse: collapse; width: 100%; }}
+ td, th {{ border-bottom: 1px solid #ddd; padding: .4rem .6rem; text-align: left; }}
+ code {{ background: #f4f4f4; padding: .1rem .3rem; border-radius: 3px; }}
+</style></head>
+<body><h1>tpuhive API <small>v{version}</small></h1>
+<p>Machine-readable spec: <a href="../openapi.json"><code>openapi.json</code></a></p>
+<table><tr><th>Method</th><th>Path</th><th>Summary</th><th>Auth</th></tr>
+{rows}
+</table></body></html>
+"""

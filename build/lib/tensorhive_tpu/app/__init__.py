@@ -1,0 +1,1 @@
+"""Web application: static SPA + its server (reference: tensorhive/app/web/)."""

@@ -1,0 +1,67 @@
+"""Static web-app server.
+
+Reference: tensorhive/app/web/AppServer.py (89 LoC) — a Flask static server
+with an embedded gunicorn, catch-all route → index.html, and the API URL
+injected into ``dist/static/config.json`` at boot (:44-68). Here: a
+werkzeug-served static dir on a daemon thread (the SPA is a single
+self-contained page — no gunicorn worker pool needed for a file server),
+with ``/config.json`` generated per-request so the API location always
+matches the live config.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import mimetypes
+import threading
+from pathlib import Path
+from typing import Optional
+
+from werkzeug.serving import make_server
+from werkzeug.wrappers import Request, Response
+
+from ..config import Config, get_config
+
+log = logging.getLogger(__name__)
+
+STATIC_DIR = Path(__file__).parent / "static"
+
+
+class AppServer:
+    def __init__(self, config: Optional[Config] = None) -> None:
+        self.config = config or get_config()
+        self._server = None
+        self._thread: Optional[threading.Thread] = None
+
+    # -- wsgi --------------------------------------------------------------
+    def wsgi_app(self, environ, start_response):
+        request = Request(environ)
+        response = self._serve(request.path)
+        return response(environ, start_response)
+
+    def _serve(self, path: str) -> Response:
+        if path == "/config.json":
+            api = self.config.api
+            payload = {"apiUrl": f"{api.url_schema}://{{host}}:{api.url_port}/{api.url_prefix}"}
+            return Response(json.dumps(payload), content_type="application/json")
+        name = path.lstrip("/") or "index.html"
+        target = (STATIC_DIR / name).resolve()
+        if not target.is_relative_to(STATIC_DIR.resolve()) or not target.is_file():
+            target = STATIC_DIR / "index.html"  # SPA catch-all
+        content_type = mimetypes.guess_type(str(target))[0] or "application/octet-stream"
+        return Response(target.read_bytes(), content_type=content_type)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> int:
+        cfg = self.config.app_server
+        self._server = make_server(cfg.host, cfg.port, self.wsgi_app, threaded=True)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True, name="app-server"
+        )
+        self._thread.start()
+        log.info("web app on %s:%d", cfg.host, self._server.server_port)
+        return self._server.server_port
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()

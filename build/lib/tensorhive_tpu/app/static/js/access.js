@@ -1,0 +1,260 @@
+"use strict";
+/* access control: restrictions + schedules administration.
+   Reference: the restriction & schedule admin views the reference UI ships
+   (controllers/restriction.py apply/remove against users, groups, resources,
+   hostnames, schedules; RestrictionSchedule weekday-mask windows). Read-only
+   for non-admins (mutations are admin-gated server-side). */
+
+const DAY_LABELS = ["Mo", "Tu", "We", "Th", "Fr", "Sa", "Su"]; // mask digit 1..7
+
+let accessUsers = [];          // admin-only cache (id -> username display)
+let accessGroups = [];
+let accessResources = [];
+let accessSchedules = [];
+let accessOpenId = null;       // expanded restriction drawer
+
+function renderAccess(main) {
+  main.innerHTML = `<div class="panel-2col">
+    <div class="card">
+      <div class="row"><h3 style="margin:0">Restrictions</h3><span style="flex:1"></span>
+        ${isAdmin() ? `<button class="primary"
+          onclick="openRestrictionDialog()">New restriction</button>` : ""}</div>
+      <p class="muted" style="margin:.3rem 0">A user may only reserve chips
+        granted by an active restriction (direct, via group, or global).</p>
+      <div id="restriction-list" style="margin-top:.5rem"></div>
+    </div>
+    <div class="card">
+      <div class="row"><h3 style="margin:0">Schedules</h3><span style="flex:1"></span>
+        ${isAdmin() ? `<button class="primary"
+          onclick="openScheduleDialog()">New schedule</button>` : ""}</div>
+      <p class="muted" style="margin:.3rem 0">Weekday + hour windows that
+        limit when an attached restriction is active.</p>
+      <div id="schedule-list" style="margin-top:.5rem"></div>
+    </div>
+  </div>
+  <dialog id="access-dialog"></dialog>`;
+  loadAccess().catch(e => toast(e.message, true));
+}
+
+async function loadAccess() {
+  const wants = [
+    api("/restrictions"), api("/schedules"), api("/resources"),
+    isAdmin() ? api("/users") : Promise.resolve([]),
+    api("/groups").catch(() => []),
+  ];
+  const [restrictions, schedules, resources, users, groups] = await Promise.all(wants);
+  accessSchedules = schedules; accessResources = resources;
+  accessUsers = users; accessGroups = groups;
+  drawSchedules(schedules);
+  drawRestrictions(restrictions);
+}
+
+/* ---------- schedules ---------------------------------------------------- */
+function scheduleLabel(schedule) {
+  const days = [...schedule.scheduleDays]
+    .map(d => DAY_LABELS[parseInt(d, 10) - 1] || "?").join(" ");
+  return `${days} · ${schedule.hourStart}–${schedule.hourEnd}`;
+}
+function drawSchedules(schedules) {
+  const el = document.getElementById("schedule-list");
+  if (!el) return;
+  el.innerHTML = schedules.length ? `
+    <table><tr><th>id</th><th>window</th>${isAdmin() ? "<th></th>" : ""}</tr>
+    ${schedules.map(schedule => `<tr>
+      <td>${schedule.id}</td><td>${esc(scheduleLabel(schedule))}</td>
+      ${isAdmin() ? `<td class="row">
+        <button class="ghost small"
+          onclick="openScheduleDialog(${schedule.id})">edit</button>
+        <button class="ghost small danger"
+          onclick="deleteSchedule(${schedule.id})">✕</button></td>` : ""}
+      </tr>`).join("")}</table>` :
+    `<p class="muted">No schedules yet.</p>`;
+}
+async function openScheduleDialog(id) {
+  let schedule = null;
+  if (id) {
+    try { schedule = await api("/schedules/" + id); }
+    catch (e) { return toast(e.message, true); }
+  }
+  const mask = schedule ? schedule.scheduleDays : "12345";
+  const dialog = document.getElementById("access-dialog");
+  dialog.innerHTML = `<h3>${schedule ? "Edit schedule #" + id : "New schedule"}</h3>
+    <label>Days</label>
+    <div class="daypick">${DAY_LABELS.map((label, i) => `
+      <label>${label}<input type="checkbox" class="sd-day" value="${i + 1}"
+        ${mask.includes(String(i + 1)) ? "checked" : ""}></label>`).join("")}</div>
+    <label>From</label><input id="sd-start" type="time"
+      value="${esc(schedule ? schedule.hourStart : "08:00")}">
+    <label>To</label><input id="sd-end" type="time"
+      value="${esc(schedule ? schedule.hourEnd : "20:00")}">
+    <div class="row" style="margin-top:1rem">
+      <button class="primary" onclick="saveSchedule(${id || "null"})">
+        ${schedule ? "Save" : "Create"}</button>
+      <button class="ghost" onclick="this.closest('dialog').close()">Cancel</button>
+    </div>`;
+  dialog.showModal();
+}
+async function saveSchedule(id) {
+  const body = {
+    scheduleDays: [...document.querySelectorAll(".sd-day:checked")]
+      .map(el => el.value).join(""),
+    hourStart: document.getElementById("sd-start").value,
+    hourEnd: document.getElementById("sd-end").value };
+  try {
+    if (id) await api("/schedules/" + id, { method: "PUT", json: body });
+    else await api("/schedules", { json: body });
+    document.getElementById("access-dialog").close(); loadAccess();
+  } catch (e) { toast(e.message, true); }
+}
+async function deleteSchedule(id) {
+  try { await api("/schedules/" + id, { method: "DELETE" }); loadAccess(); }
+  catch (e) { toast(e.message, true); }
+}
+
+/* ---------- restrictions ------------------------------------------------- */
+const userName = id => {
+  const user = accessUsers.find(u => u.id === id);
+  return user ? user.username : "user #" + id;
+};
+const groupName = id => {
+  const group = accessGroups.find(g => g.id === id);
+  return group ? group.name : "group #" + id;
+};
+
+function drawRestrictions(restrictions) {
+  const el = document.getElementById("restriction-list");
+  if (!el) return;
+  el.innerHTML = restrictions.map(r => `
+    <details class="drawer" ${accessOpenId === r.id ? "open" : ""}
+        ontoggle="accessOpenId = this.open ? ${r.id} : null">
+      <summary><b style="color:var(--text)">${esc(r.name)}</b>
+        <span class="muted">#${r.id}</span>
+        ${r.isGlobal ? '<span class="badge on">global</span>' : ""}
+        <span class="muted">${fmtDt(r.startsAt)} →
+          ${r.endsAt ? fmtDt(r.endsAt) : "∞"}</span></summary>
+      ${restrictionBody(r)}
+    </details>`).join("") || `<p class="muted">No restrictions yet.</p>`;
+}
+
+function restrictionBody(r) {
+  const admin = isAdmin();
+  const rm = (kind, key, label) => admin ? `<button class="ghost small danger"
+    onclick="restrictionRemove(${r.id}, '${kind}', '${jsArg(String(key))}')">✕</button>` : "";
+  const assignedScheduleIds = new Set((r.schedules || []).map(s => s.id));
+  const assignedResourceUids = new Set((r.resources || []).map(res => res.uid));
+  const freeSchedules = accessSchedules.filter(s => !assignedScheduleIds.has(s.id));
+  const freeResources = accessResources.filter(res => !assignedResourceUids.has(res.uid));
+  const assignedUserIds = new Set(r.users || []);
+  const assignedGroupIds = new Set(r.groups || []);
+  const freeUsers = accessUsers.filter(u => !assignedUserIds.has(u.id));
+  const freeGroups = accessGroups.filter(g => !assignedGroupIds.has(g.id));
+  const hostnames = [...new Set(accessResources.map(res => res.hostname))];
+  const addRow = (selectId, options, onclick, label) => admin && options.length ? `
+    <div class="row" style="margin:.25rem 0">
+      <select id="${selectId}-${r.id}" style="flex:1">${options}</select>
+      <button class="ghost small" onclick="${onclick}">${label}</button>
+    </div>` : "";
+  return `
+    ${isAdmin() ? `<div class="row" style="margin:.4rem 0">
+      <button class="ghost small" onclick="openRestrictionDialog(${r.id})">edit</button>
+      <button class="ghost small danger"
+        onclick="deleteRestriction(${r.id})">delete</button></div>` : ""}
+    <label>Users</label>
+    <div class="assign-list">${(r.users || []).map(id => `
+      <div class="tagrow"><span>${esc(userName(id))}</span>
+        ${rm("users", id)}</div>`).join("")
+      || '<span class="muted">none directly</span>'}</div>
+    ${addRow("ra-user", freeUsers.map(u =>
+        `<option value="${u.id}">${esc(u.username)}</option>`).join(""),
+      `restrictionApply(${r.id}, 'users',
+        document.getElementById('ra-user-${r.id}').value)`, "Apply to user")}
+    <label>Groups</label>
+    <div class="assign-list">${(r.groups || []).map(id => `
+      <div class="tagrow"><span>${esc(groupName(id))}</span>
+        ${rm("groups", id)}</div>`).join("")
+      || '<span class="muted">none</span>'}</div>
+    ${addRow("ra-group", freeGroups.map(g =>
+        `<option value="${g.id}">${esc(g.name)}</option>`).join(""),
+      `restrictionApply(${r.id}, 'groups',
+        document.getElementById('ra-group-${r.id}').value)`, "Apply to group")}
+    <label>Chips</label>
+    <div class="assign-list">${(r.resources || []).map(res => `
+      <div class="tagrow"><span>${esc(res.uid)}</span>
+        ${rm("resources", res.uid)}</div>`).join("")
+      || `<span class="muted">${r.isGlobal ? "global — all chips" : "none"}</span>`}</div>
+    ${addRow("ra-res", freeResources.map(res =>
+        `<option value="${esc(res.uid)}">${esc(res.uid)}</option>`).join(""),
+      `restrictionApply(${r.id}, 'resources',
+        document.getElementById('ra-res-${r.id}').value)`, "Apply to chip")}
+    ${addRow("ra-host", hostnames.map(h =>
+        `<option value="${esc(h)}">${esc(h)}</option>`).join(""),
+      `restrictionApply(${r.id}, 'hosts',
+        document.getElementById('ra-host-${r.id}').value)`, "Apply whole host")}
+    <label>Schedules</label>
+    <div class="assign-list">${(r.schedules || []).map(schedule => `
+      <div class="tagrow"><span>${esc(scheduleLabel(schedule))}</span>
+        ${rm("schedules", schedule.id)}</div>`).join("")
+      || '<span class="muted">always active within the window</span>'}</div>
+    ${addRow("ra-sched", freeSchedules.map(schedule =>
+        `<option value="${schedule.id}">${esc(scheduleLabel(schedule))}</option>`).join(""),
+      `restrictionApply(${r.id}, 'schedules',
+        document.getElementById('ra-sched-${r.id}').value)`, "Attach schedule")}`;
+}
+
+async function restrictionApply(id, kind, key) {
+  try {
+    await api(`/restrictions/${id}/${kind}/${encodeURIComponent(key)}`,
+      { method: "PUT" });
+    loadAccess();
+  } catch (e) { toast(e.message, true); }
+}
+async function restrictionRemove(id, kind, key) {
+  try {
+    await api(`/restrictions/${id}/${kind}/${encodeURIComponent(key)}`,
+      { method: "DELETE" });
+    loadAccess();
+  } catch (e) { toast(e.message, true); }
+}
+
+function openRestrictionDialog(id) {
+  const existing = id ? { promise: api("/restrictions/" + id) } : null;
+  const show = r => {
+    const dialog = document.getElementById("access-dialog");
+    dialog.innerHTML = `<h3>${r ? "Edit restriction #" + r.id : "New restriction"}</h3>
+      <label>Name</label><input id="rs-name" value="${esc(r ? r.name : "")}">
+      <label>Starts at</label><input id="rs-start" type="datetime-local"
+        value="${r && r.startsAt ? toLocalInput(new Date(r.startsAt))
+                                 : toLocalInput(new Date())}">
+      <label>Ends at <span class="muted">(empty = no end)</span></label>
+      <input id="rs-end" type="datetime-local"
+        value="${r && r.endsAt ? toLocalInput(new Date(r.endsAt)) : ""}">
+      <label class="inline"><input id="rs-global" type="checkbox"
+        ${r && r.isGlobal ? "checked" : ""}>
+        global <span class="muted">(grants every user every chip)</span></label>
+      <div class="row" style="margin-top:1rem">
+        <button class="primary" onclick="saveRestriction(${r ? r.id : "null"})">
+          ${r ? "Save" : "Create"}</button>
+        <button class="ghost" onclick="this.closest('dialog').close()">Cancel</button>
+      </div>`;
+    dialog.showModal();
+  };
+  if (existing) existing.promise.then(show).catch(e => toast(e.message, true));
+  else show(null);
+}
+async function saveRestriction(id) {
+  const end = document.getElementById("rs-end").value;
+  const body = {
+    name: document.getElementById("rs-name").value,
+    startsAt: fromLocalInput(document.getElementById("rs-start").value),
+    endsAt: end ? fromLocalInput(end) : null,
+    isGlobal: document.getElementById("rs-global").checked };
+  try {
+    if (id) await api("/restrictions/" + id, { method: "PUT", json: body });
+    else await api("/restrictions", { json: body });
+    document.getElementById("access-dialog").close(); loadAccess();
+  } catch (e) { toast(e.message, true); }
+}
+async function deleteRestriction(id) {
+  try { await api("/restrictions/" + id, { method: "DELETE" }); loadAccess(); }
+  catch (e) { toast(e.message, true); }
+}

@@ -1,0 +1,187 @@
+"use strict";
+/* users + groups administration.
+   Reference: UsersOverview.vue (user table + role editing) and the group
+   admin parts of the reference UI (default groups auto-attach new users,
+   models/Group.py get_default_groups). */
+
+/* ---------- users -------------------------------------------------------- */
+function renderUsers(main) {
+  main.innerHTML = `<div class="card">
+    <div class="row"><h3 style="margin:0">Users</h3><span style="flex:1"></span>
+      <button class="primary" onclick="openUserDialog()">New user</button></div>
+    <div id="user-list" style="margin-top:.8rem"></div></div>
+    <dialog id="user-dialog"></dialog>`;
+  loadUsers().catch(e => toast(e.message, true));
+}
+async function loadUsers() {
+  const users = await api("/users");
+  const el = document.getElementById("user-list");
+  if (!el) return;
+  el.innerHTML = `
+    <table><tr><th>id</th><th>username</th><th>email</th><th>roles</th>
+      <th>last login</th><th></th></tr>
+    ${users.map(u => `<tr><td>${u.id}</td><td>${esc(u.username)}</td>
+      <td>${esc(u.email)}</td><td>${(u.roles || []).join(", ")}</td>
+      <td class="muted">${fmtDt(u.lastLoginAt)}</td>
+      <td class="row">
+        <button class="ghost small" onclick="openUserEditDialog(${u.id})">edit</button>
+        <button class="ghost small danger" onclick="deleteUser(${u.id})">✕</button>
+      </td></tr>`).join("")}</table>`;
+}
+function openUserDialog() {
+  const dialog = document.getElementById("user-dialog");
+  dialog.innerHTML = `<h3>New user</h3>
+    <label>Username</label><input id="ud-name">
+    <label>Email</label><input id="ud-email">
+    <label>Password</label><input id="ud-pass" type="password">
+    <label class="inline"><input id="ud-admin" type="checkbox"> admin</label>
+    <div class="row" style="margin-top:1rem">
+      <button class="primary" onclick="createUser()">Create</button>
+      <button class="ghost" onclick="this.closest('dialog').close()">Cancel</button>
+    </div>`;
+  dialog.showModal();
+}
+async function createUser() {
+  try {
+    await api("/users", { json: {
+      username: document.getElementById("ud-name").value,
+      email: document.getElementById("ud-email").value,
+      password: document.getElementById("ud-pass").value,
+      admin: document.getElementById("ud-admin").checked } });
+    document.getElementById("user-dialog").close(); loadUsers();
+  } catch (e) { toast(e.message, true); }
+}
+async function openUserEditDialog(id) {
+  let user;
+  try { user = await api("/users/" + id); }
+  catch (e) { return toast(e.message, true); }
+  const dialog = document.getElementById("user-dialog");
+  dialog.innerHTML = `<h3>Edit ${esc(user.username)}
+      <span class="muted">#${user.id}</span></h3>
+    <label>Email</label><input id="ud-email" value="${esc(user.email)}">
+    <label>New password <span class="muted">(leave empty to keep)</span></label>
+    <input id="ud-pass" type="password" autocomplete="new-password">
+    <label class="inline"><input id="ud-admin" type="checkbox"
+      ${(user.roles || []).includes("admin") ? "checked" : ""}> admin</label>
+    <div class="row" style="margin-top:1rem">
+      <button class="primary" onclick="saveUser(${user.id})">Save</button>
+      <button class="ghost" onclick="this.closest('dialog').close()">Cancel</button>
+    </div>`;
+  dialog.showModal();
+}
+async function saveUser(id) {
+  try {
+    const body = { email: document.getElementById("ud-email").value,
+                   roles: document.getElementById("ud-admin").checked
+                     ? ["user", "admin"] : ["user"] };
+    const pass = document.getElementById("ud-pass").value;
+    if (pass) body.password = pass;
+    await api("/users/" + id, { method: "PUT", json: body });
+    document.getElementById("user-dialog").close(); loadUsers();
+  } catch (e) { toast(e.message, true); }
+}
+async function deleteUser(id) {
+  try { await api("/users/" + id, { method: "DELETE" }); loadUsers(); }
+  catch (e) { toast(e.message, true); }
+}
+
+/* ---------- groups ------------------------------------------------------- */
+function renderGroups(main) {
+  main.innerHTML = `<div class="card">
+    <div class="row"><h3 style="margin:0">Groups</h3><span style="flex:1"></span>
+      <button class="primary" onclick="openGroupDialog()">New group</button></div>
+    <div id="group-list" style="margin-top:.8rem"></div></div>
+    <dialog id="group-dialog"></dialog>`;
+  loadGroups().catch(e => toast(e.message, true));
+}
+async function loadGroups() {
+  const groups = await api("/groups");
+  const el = document.getElementById("group-list");
+  if (!el) return;
+  el.innerHTML = groups.length ? `
+    <table><tr><th>id</th><th>name</th><th>default</th><th>members</th><th></th></tr>
+    ${groups.map(g => `<tr><td>${g.id}</td><td>${esc(g.name)}</td>
+      <td>${g.isDefault ? '<span class="badge on">default</span>' : ""}</td>
+      <td class="muted">${(g.users || []).map(u => esc(u.username)).join(", ") || "—"}</td>
+      <td class="row">
+        <button class="ghost small" onclick="openGroupEditDialog(${g.id})">edit</button>
+        <button class="ghost small danger" onclick="deleteGroup(${g.id})">✕</button>
+      </td></tr>`).join("")}</table>` :
+    `<p class="muted">No groups yet.</p>`;
+}
+function openGroupDialog() {
+  const dialog = document.getElementById("group-dialog");
+  dialog.innerHTML = `<h3>New group</h3>
+    <label>Name</label><input id="gd-name">
+    <label class="inline"><input id="gd-default" type="checkbox">
+      default <span class="muted">(new users auto-join)</span></label>
+    <div class="row" style="margin-top:1rem">
+      <button class="primary" onclick="createGroup()">Create</button>
+      <button class="ghost" onclick="this.closest('dialog').close()">Cancel</button>
+    </div>`;
+  dialog.showModal();
+}
+async function createGroup() {
+  try {
+    await api("/groups", { json: {
+      name: document.getElementById("gd-name").value,
+      isDefault: document.getElementById("gd-default").checked } });
+    document.getElementById("group-dialog").close(); loadGroups();
+  } catch (e) { toast(e.message, true); }
+}
+async function openGroupEditDialog(id) {
+  let group, users;
+  try {
+    [group, users] = await Promise.all([api("/groups/" + id), api("/users")]);
+  } catch (e) { return toast(e.message, true); }
+  const memberIds = new Set((group.users || []).map(u => u.id));
+  const nonMembers = users.filter(u => !memberIds.has(u.id));
+  const dialog = document.getElementById("group-dialog");
+  dialog.innerHTML = `<h3>Edit ${esc(group.name)}
+      <span class="muted">#${group.id}</span></h3>
+    <label>Name</label><input id="gd-name" value="${esc(group.name)}">
+    <label class="inline"><input id="gd-default" type="checkbox"
+      ${group.isDefault ? "checked" : ""}> default</label>
+    <label>Members</label>
+    <div class="assign-list">${(group.users || []).map(u => `
+      <div class="tagrow"><span>${esc(u.username)}</span>
+        <button class="ghost small danger"
+          onclick="groupRemoveMember(${group.id}, ${u.id})">✕</button></div>`).join("")
+      || '<span class="muted">none</span>'}</div>
+    <div class="row">
+      <select id="gd-adduser" style="flex:1">${nonMembers.map(u =>
+        `<option value="${u.id}">${esc(u.username)}</option>`).join("")}</select>
+      <button class="ghost" onclick="groupAddMember(${group.id})"
+        ${nonMembers.length ? "" : "disabled"}>Add member</button>
+    </div>
+    <div class="row" style="margin-top:1rem">
+      <button class="primary" onclick="saveGroup(${group.id})">Save</button>
+      <button class="ghost" onclick="this.closest('dialog').close()">Close</button>
+    </div>`;
+  dialog.showModal();
+}
+async function saveGroup(id) {
+  try {
+    await api("/groups/" + id, { method: "PUT", json: {
+      name: document.getElementById("gd-name").value,
+      isDefault: document.getElementById("gd-default").checked } });
+    document.getElementById("group-dialog").close(); loadGroups();
+  } catch (e) { toast(e.message, true); }
+}
+async function groupAddMember(groupId) {
+  const userId = document.getElementById("gd-adduser").value;
+  try {
+    await api(`/groups/${groupId}/users/${userId}`, { method: "PUT" });
+    openGroupEditDialog(groupId); loadGroups();
+  } catch (e) { toast(e.message, true); }
+}
+async function groupRemoveMember(groupId, userId) {
+  try {
+    await api(`/groups/${groupId}/users/${userId}`, { method: "DELETE" });
+    openGroupEditDialog(groupId); loadGroups();
+  } catch (e) { toast(e.message, true); }
+}
+async function deleteGroup(id) {
+  try { await api("/groups/" + id, { method: "DELETE" }); loadGroups(); }
+  catch (e) { toast(e.message, true); }
+}

@@ -1,0 +1,295 @@
+"use strict";
+/* reservations: week time-grid with drag-to-reserve.
+   Reference: ReservationsOverview.vue + FullCalendar*.vue — FullCalendar
+   agendaWeek with GPU multi-select, drag-select to create, click to
+   edit/cancel. Rebuilt on a plain CSS grid: 7 day columns x 48 half-hour
+   slots; events are absolutely positioned; drag is mousedown->mousemove->
+   mouseup snapped to 30-minute slots. */
+
+const SLOT_PX = 22, SLOT_MIN = 30;
+let calStart = startOfWeek(new Date());
+let calResources = [];                        // cached /resources
+let calSelected = null;                       // Set of selected uids
+let calEvents = [];                           // cached reservations for week
+let calDrag = null;                           // {dayIdx, fromSlot, toSlot}
+
+function startOfWeek(d) {
+  d = new Date(d); d.setHours(0, 0, 0, 0);
+  d.setDate(d.getDate() - (d.getDay() + 6) % 7);  // Monday
+  return d;
+}
+function resourceHue(uid) {
+  let acc = 0;
+  for (const ch of uid) acc = (acc * 31 + ch.charCodeAt(0)) % 360;
+  return acc;
+}
+function loadSelected() {
+  try {
+    const saved = JSON.parse(localStorage.getItem("tpuhive-cal") || "null");
+    if (Array.isArray(saved)) return new Set(saved);
+  } catch (e) {}
+  return null;
+}
+
+function renderCalendar(main) {
+  main.innerHTML = `<div class="card">
+    <div class="row">
+      <div class="respick">
+        <button class="ghost" onclick="toggleResPicker()">Chips
+          <span id="respick-count"></span> ▾</button>
+        <div class="panel" id="respick-panel" style="display:none"></div>
+      </div>
+      <button class="ghost" onclick="calShift(-7)">‹ prev</button>
+      <b id="cal-range"></b>
+      <button class="ghost" onclick="calShift(7)">next ›</button>
+      <button class="ghost" onclick="calToday()">today</button>
+      <span style="flex:1"></span>
+      <span class="muted">drag on the grid to reserve</span>
+      <button class="primary" onclick="openReservationDialog()">New reservation</button>
+    </div>
+    <div id="cal" class="tgrid-wrap" style="margin-top:1rem"></div>
+  </div>
+  <dialog id="res-dialog"></dialog>`;
+  drawCalendar();
+}
+function calShift(days) { calStart.setDate(calStart.getDate() + days); drawCalendar(); }
+function calToday() { calStart = startOfWeek(new Date()); drawCalendar(); }
+
+function toggleResPicker() {
+  const panel = document.getElementById("respick-panel");
+  panel.style.display = panel.style.display === "none" ? "block" : "none";
+}
+function calToggleResource(uid) {
+  if (calSelected.has(uid)) calSelected.delete(uid); else calSelected.add(uid);
+  localStorage.setItem("tpuhive-cal", JSON.stringify([...calSelected]));
+  drawCalendar();
+}
+function calSelectHost(hostname, on) {
+  for (const resource of calResources) {
+    if (resource.hostname !== hostname) continue;
+    if (on) calSelected.add(resource.uid); else calSelected.delete(resource.uid);
+  }
+  localStorage.setItem("tpuhive-cal", JSON.stringify([...calSelected]));
+  drawCalendar();
+}
+
+async function drawCalendar() {
+  const end = new Date(calStart); end.setDate(end.getDate() + 7);
+  document.getElementById("cal-range").textContent =
+    calStart.toDateString() + " – " + new Date(end - 1).toDateString();
+  try {
+    [calResources, calEvents] = await Promise.all([
+      api("/resources"),
+      api(`/reservations?start=${calStart.toISOString()}&end=${end.toISOString()}`)]);
+  } catch (e) { return toast(e.message, true); }
+  if (calSelected === null) {
+    calSelected = loadSelected() || new Set(calResources.map(r => r.uid));
+  }
+  drawResPicker();
+  const shown = calEvents.filter(r => calSelected.has(r.resourceId));
+
+  const days = [...Array(7)].map((_, i) => {
+    const d = new Date(calStart); d.setDate(d.getDate() + i); return d; });
+  const today = new Date(); today.setHours(0, 0, 0, 0);
+  let html = `<div class="tgrid"><div class="corner"></div>` +
+    days.map(d => `<div class="dayhead ${+d === +today ? "today" : ""}">
+      ${d.toDateString().slice(0, 10)}</div>`).join("");
+  // body rows: one label column + 7 day columns, each a positioned stack
+  html += `<div style="display:contents">`;
+  html += `<div class="hourlabel"><div style="height:${SLOT_PX * 48}px;position:relative">` +
+    [...Array(24)].map((_, hour) =>
+      `<div style="position:absolute;top:${hour * 2 * SLOT_PX - 7}px;right:4px">
+        ${hour ? String(hour).padStart(2, "0") + ":00" : ""}</div>`).join("") +
+    `</div></div>`;
+  for (let i = 0; i < 7; i++) {
+    const day = days[i], dayEnd = new Date(day); dayEnd.setDate(dayEnd.getDate() + 1);
+    const events = shown.filter(r =>
+      new Date(r.start) < dayEnd && new Date(r.end) > day);
+    html += `<div class="daycol" data-day="${i}"
+        style="height:${SLOT_PX * 48}px">` +
+      [...Array(48)].map(() => `<div class="slot"></div>`).join("") +
+      events.map(r => calEventHtml(r, day, dayEnd)).join("") +
+      `</div>`;
+  }
+  html += `</div></div>`;
+  const cal = document.getElementById("cal");
+  cal.innerHTML = html;
+  attachDragHandlers(cal, days);
+}
+
+function drawResPicker() {
+  document.getElementById("respick-count").textContent =
+    `(${calSelected.size}/${calResources.length})`;
+  const byHost = {};
+  for (const resource of calResources) {
+    (byHost[resource.hostname] = byHost[resource.hostname] || []).push(resource);
+  }
+  document.getElementById("respick-panel").innerHTML =
+    Object.keys(byHost).sort().map(host => {
+      const chips = byHost[host];
+      const allOn = chips.every(r => calSelected.has(r.uid));
+      return `<label><input type="checkbox" ${allOn ? "checked" : ""}
+          onchange="calSelectHost('${jsArg(host)}', this.checked)"><b>${esc(host)}</b></label>` +
+        chips.map(r => `<label style="margin-left:1.1rem">
+          <input type="checkbox" ${calSelected.has(r.uid) ? "checked" : ""}
+            onchange="calToggleResource('${jsArg(r.uid)}')">
+          <span class="legend-dot"
+            style="background:hsl(${resourceHue(r.uid)},65%,60%)"></span>
+          ${esc(r.uid)}</label>`).join("");
+    }).join("") || `<span class="muted">no resources yet</span>`;
+}
+
+function calEventHtml(r, day, dayEnd) {
+  const start = new Date(Math.max(new Date(r.start), day));
+  const end = new Date(Math.min(new Date(r.end), dayEnd));
+  const top = ((start - day) / 6e4 / SLOT_MIN) * SLOT_PX;
+  const height = Math.max(10, ((end - start) / 6e4 / SLOT_MIN) * SLOT_PX - 2);
+  const mine = state.user && r.userId === state.user.id;
+  const hue = resourceHue(r.resourceId);
+  const style = r.isCancelled ? "" :
+    `background:hsl(${hue},65%,${mine ? 70 : 55}%);`;
+  return `<span class="ev ${mine ? "mine" : ""} ${r.isCancelled ? "cancelled" : ""}"
+    style="top:${top}px;height:${height}px;${style}"
+    title="${esc(r.title)} — ${esc(r.resourceId)} (#${r.id})"
+    onclick="openReservationDetails(${r.id});event.stopPropagation()">
+    ${esc(r.title)}<br><small>${esc(r.resourceId.split(":").slice(-2).join(":"))}</small>
+  </span>`;
+}
+
+/* drag-to-select (reference: FullCalendar select callback). The mouseup
+   listener is document-level and persistent — a per-draw {once} listener
+   would be consumed by any unrelated click and disarm dragging. */
+function attachDragHandlers(cal, days) {
+  const slotOfEvent = (col, ev) => {
+    const rect = col.getBoundingClientRect();
+    return Math.max(0, Math.min(48, Math.round((ev.clientY - rect.top) / SLOT_PX)));
+  };
+  cal.querySelectorAll(".daycol").forEach(col => {
+    col.addEventListener("mousedown", ev => {
+      if (ev.target.closest(".ev") || ev.button !== 0) return;
+      calDrag = { day: days[+col.dataset.day], fromSlot: slotOfEvent(col, ev),
+                  toSlot: slotOfEvent(col, ev) + 1, col };
+      updateDragSel();
+      ev.preventDefault();
+    });
+    col.addEventListener("mousemove", ev => {
+      if (!calDrag || calDrag.col !== col) return;
+      calDrag.toSlot = Math.max(calDrag.fromSlot + 1, slotOfEvent(col, ev));
+      updateDragSel();
+    });
+  });
+}
+document.addEventListener("mouseup", () => {
+  if (!calDrag) return;
+  const { day, fromSlot, toSlot } = calDrag;
+  clearDragSel(); calDrag = null;
+  const start = new Date(day);
+  start.setMinutes(start.getMinutes() + fromSlot * SLOT_MIN);
+  const end = new Date(day);
+  end.setMinutes(end.getMinutes() + toSlot * SLOT_MIN);
+  openReservationDialog(start, end);
+});
+function updateDragSel() {
+  clearDragSel();
+  const { col, fromSlot, toSlot } = calDrag;
+  const el = document.createElement("span");
+  el.className = "ev dragsel";
+  el.style.top = fromSlot * SLOT_PX + "px";
+  el.style.height = (toSlot - fromSlot) * SLOT_PX + "px";
+  col.appendChild(el);
+}
+function clearDragSel() {
+  document.querySelectorAll(".ev.dragsel").forEach(el => el.remove());
+}
+
+/* create dialog — one reservation per selected chip (reference creates one
+   event per selected GPU) */
+function openReservationDialog(start, end) {
+  const dialog = document.getElementById("res-dialog");
+  if (!start) {
+    start = new Date(); start.setMinutes(0, 0, 0); start.setHours(start.getHours() + 1);
+    end = new Date(start); end.setHours(end.getHours() + 2);
+  }
+  const preset = calSelected ? [...calSelected] : [];   // pre-first-draw click
+  dialog.innerHTML = `<h3>New reservation</h3>
+    <label>Title</label><input id="rd-title" value="training run">
+    <label>Description</label><input id="rd-desc" value="">
+    <label>Chips <span class="muted">(one reservation per chip)</span></label>
+    <div style="max-height:160px;overflow-y:auto">${calResources.map(r => `
+      <label class="inline"><input type="checkbox" class="rd-chip"
+        value="${esc(r.uid)}" ${preset.includes(r.uid) ? "checked" : ""}>
+        ${esc(r.uid)}</label>`).join("")}</div>
+    <label>Start</label><input id="rd-start" type="datetime-local"
+      value="${toLocalInput(start)}">
+    <label>End</label><input id="rd-end" type="datetime-local"
+      value="${toLocalInput(end)}">
+    <div class="row" style="margin-top:1rem">
+      <button class="primary" onclick="createReservations()">Reserve</button>
+      <button class="ghost" onclick="this.closest('dialog').close()">Cancel</button>
+    </div>`;
+  dialog.showModal();
+}
+async function createReservations() {
+  const chips = [...document.querySelectorAll(".rd-chip:checked")].map(el => el.value);
+  if (!chips.length) return toast("pick at least one chip", true);
+  const payload = uid => ({
+    title: document.getElementById("rd-title").value,
+    description: document.getElementById("rd-desc").value,
+    resourceId: uid,
+    start: fromLocalInput(document.getElementById("rd-start").value),
+    end: fromLocalInput(document.getElementById("rd-end").value) });
+  let created = 0;
+  for (const uid of chips) {
+    try { await api("/reservations", { json: payload(uid) }); created++; }
+    catch (e) { toast(`${uid}: ${e.message}`, true); }
+  }
+  if (created) {
+    toast(`created ${created} reservation${created > 1 ? "s" : ""}`);
+    document.getElementById("res-dialog").close();
+    drawCalendar();
+  }
+}
+
+/* details/edit dialog (reference: event click -> edit/cancel modal) */
+async function openReservationDetails(id) {
+  const r = await api("/reservations/" + id);
+  const dialog = document.getElementById("res-dialog");
+  const editable = isAdmin() || (state.user && r.userId === state.user.id);
+  dialog.innerHTML = `<h3>Reservation <span class="muted">#${r.id}</span></h3>
+    <p class="muted">${esc(r.resourceId)} · user #${r.userId}
+      ${r.isCancelled ? '· <span class="err">cancelled</span>' : ""}<br>
+      ${r.dutyCycleAvg != null ?
+        `avg duty ${r.dutyCycleAvg}% · avg HBM ${r.hbmUtilAvg}%` : ""}</p>
+    <label>Title</label><input id="rd-title" value="${esc(r.title)}"
+      ${editable ? "" : "disabled"}>
+    <label>Description</label><input id="rd-desc" value="${esc(r.description)}"
+      ${editable ? "" : "disabled"}>
+    <label>Start</label><input id="rd-start" type="datetime-local"
+      value="${toLocalInput(new Date(r.start))}" ${editable ? "" : "disabled"}>
+    <label>End</label><input id="rd-end" type="datetime-local"
+      value="${toLocalInput(new Date(r.end))}" ${editable ? "" : "disabled"}>
+    <div class="row" style="margin-top:1rem">
+      ${editable ? `
+        <button class="primary" onclick="saveReservation(${r.id})">Save</button>
+        <button class="ghost danger" onclick="deleteReservation(${r.id})">Delete</button>` : ""}
+      <button class="ghost" onclick="this.closest('dialog').close()">Close</button>
+    </div>`;
+  dialog.showModal();
+}
+async function saveReservation(id) {
+  try {
+    await api("/reservations/" + id, { method: "PUT", json: {
+      title: document.getElementById("rd-title").value,
+      description: document.getElementById("rd-desc").value,
+      start: fromLocalInput(document.getElementById("rd-start").value),
+      end: fromLocalInput(document.getElementById("rd-end").value) } });
+    document.getElementById("res-dialog").close();
+    toast("reservation updated"); drawCalendar();
+  } catch (e) { toast(e.message, true); }
+}
+async function deleteReservation(id) {
+  try {
+    await api("/reservations/" + id, { method: "DELETE" });
+    document.getElementById("res-dialog").close(); drawCalendar();
+  } catch (e) { toast(e.message, true); }
+}

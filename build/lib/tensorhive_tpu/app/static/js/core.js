@@ -1,0 +1,186 @@
+"use strict";
+/* core: api client + session + router.
+   Reference: src/api/index.js (axios wrapper), store/state.js (token store),
+   TheLogin.vue (login + ssh signup). */
+
+let API = location.protocol + "//" + location.hostname + ":1111/api";
+const state = { user: null, access: null, refresh: null, view: "nodes",
+                timers: [] };
+
+async function loadConfig() {
+  try {
+    const cfg = await (await fetch("/config.json")).json();
+    API = cfg.apiUrl.replace("{host}", location.hostname);
+  } catch (e) { /* defaults */ }
+}
+
+async function api(path, options = {}) {
+  options.headers = Object.assign(
+    { "Content-Type": "application/json" },
+    state.access ? { Authorization: "Bearer " + state.access } : {},
+    options.headers || {});
+  if (options.json !== undefined) {
+    options.body = JSON.stringify(options.json); options.method = options.method || "POST";
+  }
+  let resp = await fetch(API + path, options);
+  if (resp.status === 401 && state.refresh && path !== "/user/refresh") {
+    if (await tryRefresh()) {
+      options.headers.Authorization = "Bearer " + state.access;
+      resp = await fetch(API + path, options);
+    } else { logout(); throw new Error("session expired"); }
+  }
+  const body = await resp.json().catch(() => ({}));
+  if (!resp.ok) throw new Error(body.msg || resp.statusText);
+  return body;
+}
+
+async function tryRefresh() {
+  try {
+    const body = await (await fetch(API + "/user/refresh", {
+      method: "POST", headers: { Authorization: "Bearer " + state.refresh }})).json();
+    if (body.accessToken) { state.access = body.accessToken; persist(); return true; }
+  } catch (e) {}
+  return false;
+}
+
+function persist() {
+  localStorage.setItem("tpuhive", JSON.stringify(
+    { user: state.user, access: state.access, refresh: state.refresh }));
+}
+function restore() {
+  try { Object.assign(state, JSON.parse(localStorage.getItem("tpuhive") || "{}")); }
+  catch (e) {}
+}
+function logout() {
+  // revoke both tokens server-side (reference logout + logout/refresh)
+  if (state.access) api("/user/logout", { method: "POST" }).catch(() => {});
+  if (state.refresh) {
+    fetch(API + "/user/logout/refresh", { method: "POST",
+      headers: { Authorization: "Bearer " + state.refresh } }).catch(() => {});
+  }
+  state.user = state.access = state.refresh = null;
+  localStorage.removeItem("tpuhive");
+  render();
+}
+function toast(msg, isError) {
+  const el = document.getElementById("toast");
+  el.textContent = msg; el.style.display = "block";
+  el.style.borderColor = isError ? "var(--err)" : "var(--border)";
+  clearTimeout(el._t); el._t = setTimeout(() => el.style.display = "none", 4000);
+}
+const esc = s => String(s ?? "").replace(/[&<>"]/g,
+  c => ({ "&": "&amp;", "<": "&lt;", ">": "&gt;", '"': "&quot;" }[c]));
+// for server-provided strings inside single-quoted args of inline handlers:
+// JS-escape first (backslash, quote), THEN html-escape — the browser decodes
+// entities before the JS engine parses the handler, so esc() alone would
+// still let an apostrophe terminate the string literal
+const jsArg = s => esc(String(s ?? "")
+  .replace(/\\/g, "\\\\").replace(/'/g, "\\'"));
+const isAdmin = () => state.user && (state.user.roles || []).includes("admin");
+const fmtDt = iso => iso ? new Date(iso).toLocaleString(undefined,
+  { dateStyle: "short", timeStyle: "short" }) : "—";
+// <input type=datetime-local> value for a Date (local tz)
+const toLocalInput = d =>
+  new Date(d - d.getTimezoneOffset() * 6e4).toISOString().slice(0, 16);
+const fromLocalInput = v => new Date(v).toISOString();
+
+/* ---------- shell -------------------------------------------------------- */
+const VIEWS = {
+  nodes: { label: "Nodes", render: () => renderNodes(mainEl()) },
+  calendar: { label: "Reservations", render: () => renderCalendar(mainEl()) },
+  jobs: { label: "Jobs", render: () => renderJobs(mainEl()) },
+  users: { label: "Users", render: () => renderUsers(mainEl()), admin: true },
+  groups: { label: "Groups", render: () => renderGroups(mainEl()), admin: true },
+  access: { label: "Access", render: () => renderAccess(mainEl()) },
+};
+const mainEl = () => document.getElementById("main");
+
+function render() {
+  state.timers.forEach(clearInterval); state.timers = [];
+  const main = mainEl();
+  const topbar = document.getElementById("topbar");
+  if (!state.access) { topbar.style.display = "none"; return renderLogin(main); }
+  topbar.style.display = "flex";
+  document.getElementById("user-box").textContent =
+    state.user.username + (isAdmin() ? " (admin)" : "");
+  const nav = document.getElementById("nav");
+  nav.innerHTML = Object.entries(VIEWS)
+    .filter(([, v]) => !v.admin || isAdmin())
+    .map(([k, v]) =>
+      `<button class="${state.view === k ? "active" : ""}"
+               onclick="go('${k}')">${v.label}</button>`).join("");
+  (VIEWS[state.view] || VIEWS.nodes).render();
+}
+function go(view) { state.view = view; render(); }
+
+/* ---------- login + ssh signup ------------------------------------------- */
+function renderLogin(main, tab = "login") {
+  main.innerHTML = `
+    <div id="login-view" class="card">
+      <h2>tpuhive</h2>
+      <div class="tabs">
+        <button class="${tab === "login" ? "primary" : "ghost"}"
+                onclick="renderLogin(document.getElementById('main'),'login')">Log in</button>
+        <button class="${tab === "signup" ? "primary" : "ghost"}"
+                onclick="renderLogin(document.getElementById('main'),'signup')">SSH sign up</button>
+      </div>
+      <div id="login-body"></div>
+      <p class="muted" id="li-err"></p>
+    </div>`;
+  const body = main.querySelector("#login-body");
+  if (tab === "login") {
+    body.innerHTML = `
+      <input id="li-user" placeholder="username" autocomplete="username">
+      <input id="li-pass" type="password" placeholder="password"
+             autocomplete="current-password">
+      <button class="primary" style="width:100%" onclick="doLogin()">Log in</button>`;
+    body.querySelector("#li-pass").addEventListener("keydown",
+      e => e.key === "Enter" && doLogin());
+  } else {
+    body.innerHTML = `
+      <p class="muted">Prove you own a unix account on a managed host: install
+      the manager key below in that account's <code>~/.ssh/authorized_keys</code>,
+      then sign up with the same username.</p>
+      <pre class="keyline" id="su-key">loading key…</pre>
+      <input id="su-user" placeholder="unix username">
+      <input id="su-email" placeholder="email">
+      <input id="su-pass" type="password" placeholder="password"
+             autocomplete="new-password">
+      <button class="primary" style="width:100%" onclick="doSshSignup()">Sign up</button>`;
+    api("/user/authorized_keys_entry")
+      .then(b => body.querySelector("#su-key").textContent = b.authorizedKeysEntry)
+      .catch(e => body.querySelector("#su-key").textContent = e.message);
+  }
+}
+async function doLogin() {
+  try {
+    const body = await api("/user/login", { json: {
+      username: document.getElementById("li-user").value,
+      password: document.getElementById("li-pass").value } });
+    state.user = body.user; state.access = body.accessToken;
+    state.refresh = body.refreshToken; persist(); render();
+  } catch (e) {
+    document.getElementById("li-err").textContent = e.message;
+    document.getElementById("li-err").className = "err";
+  }
+}
+async function doSshSignup() {
+  try {
+    await api("/user/ssh_signup", { json: {
+      username: document.getElementById("su-user").value,
+      email: document.getElementById("su-email").value,
+      password: document.getElementById("su-pass").value } });
+    toast("account created — log in now");
+    renderLogin(mainEl(), "login");
+  } catch (e) {
+    document.getElementById("li-err").textContent = e.message;
+    document.getElementById("li-err").className = "err";
+  }
+}
+
+/* ---------- boot --------------------------------------------------------- */
+async function boot() {
+  await loadConfig();
+  restore();
+  render();
+}

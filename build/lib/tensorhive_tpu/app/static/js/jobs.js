@@ -1,0 +1,389 @@
+"use strict";
+/* jobs: list + schedule + queue + per-task editor.
+   Reference: JobsOverview.vue + JobDetailsView.vue + job_tasks/TaskCreate.vue
+   (861 LoC: per-process distributed parameter auto-fill, env/param segment
+   rows, GPU->CUDA_VISIBLE_DEVICES selection). The template auto-fill engine
+   lives server-side here (core/templates.py, POST /jobs/{id}/tasks_from_template);
+   the editor exposes it plus raw per-task segment editing. */
+
+let jobsSelectedId = null;                    // open details drawer
+let jobsHostnames = [];
+
+function renderJobs(main) {
+  main.innerHTML = `<div class="card">
+    <div class="row">
+      <h3 style="margin:0">Jobs</h3><span style="flex:1"></span>
+      <button class="primary" onclick="openJobDialog()">New job</button>
+    </div>
+    <div id="job-list" style="margin-top:.8rem"></div>
+  </div>
+  <div id="job-details"></div>
+  <dialog id="job-dialog"></dialog>`;
+  api("/nodes/hostnames").then(h => jobsHostnames = h).catch(() => {});
+  const refresh = () => loadJobs().catch(e => toast(e.message, true));
+  refresh();
+  state.timers.push(setInterval(refresh, 5000));
+}
+
+async function loadJobs() {
+  const jobs = await api("/jobs");
+  const el = document.getElementById("job-list");
+  if (!el) return;
+  el.innerHTML = jobs.length ? `
+    <table><tr><th>id</th><th>name</th><th>status</th><th>queue</th>
+      <th>schedule</th><th>tasks</th><th></th></tr>
+    ${jobs.map(j => `<tr>
+      <td>${j.id}</td><td>${esc(j.name)}</td>
+      <td><span class="badge ${esc(j.status)}">${esc(j.status)}</span></td>
+      <td>${j.isQueued ? '<span class="badge on">queued</span>' : ""}</td>
+      <td class="muted">${j.startAt ? "▶ " + fmtDt(j.startAt) : ""}
+          ${j.stopAt ? "■ " + fmtDt(j.stopAt) : ""}</td>
+      <td>${(j.tasks || []).length}</td>
+      <td class="row">
+        <button class="ghost small" onclick="openJobDetails(${j.id})">details</button>
+        <button class="ghost small" onclick="jobAction(${j.id},'execute')">run</button>
+        <button class="ghost small" onclick="jobStop(${j.id})">stop</button>
+        <button class="ghost small" onclick="jobQueue(${j.id}, ${j.isQueued})">
+          ${j.isQueued ? "dequeue" : "enqueue"}</button>
+        <button class="ghost small danger" onclick="deleteJob(${j.id})">✕</button>
+      </td></tr>`).join("")}</table>` :
+    `<p class="muted">No jobs yet.</p>`;
+  if (jobsSelectedId !== null) {
+    const open = jobs.find(j => j.id === jobsSelectedId);
+    if (open) drawJobDetails(); else { jobsSelectedId = null; jobDetailsEl().innerHTML = ""; }
+  }
+}
+const jobDetailsEl = () => document.getElementById("job-details");
+
+async function jobAction(id, action) {
+  try { await api(`/jobs/${id}/${action}`, { json: {} }); loadJobs(); }
+  catch (e) { toast(e.message, true); }
+}
+async function jobStop(id, gracefully = true) {
+  try { await api(`/jobs/${id}/stop`, { json: { gracefully } }); loadJobs(); }
+  catch (e) { toast(e.message, true); }
+}
+async function jobQueue(id, queued) {
+  try {
+    await api(`/jobs/${id}/${queued ? "dequeue" : "enqueue"}`, { method: "PUT" });
+    loadJobs();
+  } catch (e) { toast(e.message, true); }
+}
+async function deleteJob(id) {
+  try {
+    await api("/jobs/" + id, { method: "DELETE" });
+    if (jobsSelectedId === id) { jobsSelectedId = null; jobDetailsEl().innerHTML = ""; }
+    loadJobs();
+  } catch (e) { toast(e.message, true); }
+}
+
+/* -- new job ------------------------------------------------------------- */
+function openJobDialog() {
+  const dialog = document.getElementById("job-dialog");
+  dialog.innerHTML = `<h3>New job</h3>
+    <label>Name</label><input id="jd-name" value="my training">
+    <label>Description</label><input id="jd-desc" value="">
+    <label>Start at <span class="muted">(optional timed start)</span></label>
+    <input id="jd-start" type="datetime-local">
+    <label>Stop at <span class="muted">(optional timed stop)</span></label>
+    <input id="jd-stop" type="datetime-local">
+    <div class="row" style="margin-top:1rem">
+      <button class="primary" onclick="createJob()">Create</button>
+      <button class="ghost" onclick="this.closest('dialog').close()">Cancel</button>
+    </div>`;
+  dialog.showModal();
+}
+async function createJob() {
+  try {
+    const body = { name: document.getElementById("jd-name").value,
+                   description: document.getElementById("jd-desc").value };
+    const start = document.getElementById("jd-start").value;
+    const stop = document.getElementById("jd-stop").value;
+    if (start) body.startAt = fromLocalInput(start);
+    if (stop) body.stopAt = fromLocalInput(stop);
+    const job = await api("/jobs", { json: body });
+    document.getElementById("job-dialog").close();
+    toast("job created"); jobsSelectedId = job.id; loadJobs();
+  } catch (e) { toast(e.message, true); }
+}
+
+/* -- details drawer ------------------------------------------------------ */
+function openJobDetails(id) { jobsSelectedId = id; drawJobDetails(); }
+
+async function drawJobDetails() {
+  const el = jobDetailsEl();
+  if (!el || jobsSelectedId === null) return;
+  let job, tasks;
+  try {
+    [job, tasks] = await Promise.all([
+      api("/jobs/" + jobsSelectedId),
+      api("/tasks?job_id=" + jobsSelectedId)]);
+  } catch (e) { return toast(e.message, true); }
+  job.tasks = tasks;
+  // the 5s poll rebuilds this drawer; keep an open log visible across it
+  const prevLog = document.getElementById("task-log");
+  const logState = prevLog && prevLog.style.display !== "none"
+    ? { text: prevLog.textContent, scroll: prevLog.scrollTop } : null;
+  el.innerHTML = `<div class="card">
+    <div class="row">
+      <h3 style="margin:0">${esc(job.name)} <span class="muted">#${job.id}</span></h3>
+      <span class="badge ${esc(job.status)}">${esc(job.status)}</span>
+      ${job.isQueued ? '<span class="badge on">queued</span>' : ""}
+      <span style="flex:1"></span>
+      <button class="ghost small" onclick="openJobEditDialog(${job.id})">edit job</button>
+      <button class="ghost small"
+        onclick="jobsSelectedId=null;jobDetailsEl().innerHTML=''">close</button>
+    </div>
+    <p class="muted" style="margin:.3rem 0">${esc(job.description || "")}
+      ${job.startAt ? `· starts ${fmtDt(job.startAt)}` : ""}
+      ${job.stopAt ? `· stops ${fmtDt(job.stopAt)}` : ""}</p>
+    <table><tr><th>task</th><th>host</th><th>pid</th><th>status</th>
+      <th>command</th><th></th></tr>
+    ${(job.tasks || []).map(t => `<tr>
+      <td>${t.id}</td><td>${esc(t.hostname)}</td><td>${t.pid ?? ""}</td>
+      <td><span class="badge ${esc(t.status)}">${esc(t.status)}</span></td>
+      <td class="kv" title="${esc(t.fullCommand)}">${esc((t.fullCommand || t.command).slice(0, 48))}</td>
+      <td class="row">
+        <button class="ghost small" onclick="taskSpawn(${t.id})">spawn</button>
+        <button class="ghost small" onclick="taskTerminate(${t.id}, true)"
+          title="SIGINT — lets the training checkpoint">int</button>
+        <button class="ghost small" onclick="taskTerminate(${t.id}, null)"
+          title="SIGTERM">term</button>
+        <button class="ghost small danger" onclick="taskTerminate(${t.id}, false)"
+          title="SIGKILL">kill</button>
+        <button class="ghost small" onclick="showTaskLog(${t.id})">log</button>
+        <button class="ghost small" onclick="openTaskEditDialog(${t.id})">edit</button>
+        <button class="ghost small danger" onclick="taskDelete(${t.id})">✕</button>
+      </td></tr>`).join("")}
+    </table>
+    <pre class="log" id="task-log" style="display:none;margin-top:.8rem"></pre>
+    <div class="row" style="margin-top:.8rem">
+      <button class="ghost" onclick="openTaskCreateDialog(${job.id})">+ Add task</button>
+      <button class="ghost" onclick="openTemplateDialog(${job.id})">
+        + Tasks from template</button>
+    </div>
+  </div>`;
+  if (logState) {
+    const logEl = document.getElementById("task-log");
+    logEl.style.display = "block";
+    logEl.textContent = logState.text;
+    logEl.scrollTop = logState.scroll;
+  }
+}
+
+function openJobEditDialog(id) {
+  api("/jobs/" + id).then(job => {
+    const dialog = document.getElementById("job-dialog");
+    dialog.innerHTML = `<h3>Edit job #${job.id}</h3>
+      <label>Name</label><input id="jd-name" value="${esc(job.name)}">
+      <label>Description</label><input id="jd-desc" value="${esc(job.description || "")}">
+      <label>Start at</label><input id="jd-start" type="datetime-local"
+        value="${job.startAt ? toLocalInput(new Date(job.startAt)) : ""}">
+      <label>Stop at</label><input id="jd-stop" type="datetime-local"
+        value="${job.stopAt ? toLocalInput(new Date(job.stopAt)) : ""}">
+      <div class="row" style="margin-top:1rem">
+        <button class="primary" onclick="saveJob(${job.id})">Save</button>
+        <button class="ghost" onclick="this.closest('dialog').close()">Cancel</button>
+      </div>`;
+    dialog.showModal();
+  }).catch(e => toast(e.message, true));
+}
+async function saveJob(id) {
+  try {
+    const start = document.getElementById("jd-start").value;
+    const stop = document.getElementById("jd-stop").value;
+    await api("/jobs/" + id, { method: "PUT", json: {
+      name: document.getElementById("jd-name").value,
+      description: document.getElementById("jd-desc").value,
+      startAt: start ? fromLocalInput(start) : null,
+      stopAt: stop ? fromLocalInput(stop) : null } });
+    document.getElementById("job-dialog").close();
+    loadJobs();
+  } catch (e) { toast(e.message, true); }
+}
+
+/* -- per-task operations ------------------------------------------------- */
+async function taskSpawn(id) {
+  try { await api(`/tasks/${id}/spawn`, { json: {} }); drawJobDetails(); }
+  catch (e) { toast(e.message, true); }
+}
+async function taskTerminate(id, gracefully) {
+  try {
+    await api(`/tasks/${id}/terminate`, { json: { gracefully } });
+    drawJobDetails();
+  } catch (e) { toast(e.message, true); }
+}
+async function taskDelete(id) {
+  try {
+    await api("/tasks/" + id, { method: "DELETE" }); drawJobDetails();
+  } catch (e) { toast(e.message, true); }
+}
+async function showTaskLog(taskId) {
+  const el = document.getElementById("task-log");
+  el.style.display = "block"; el.textContent = "loading…";
+  try {
+    el.textContent = (await api(`/tasks/${taskId}/log?tail=200`)).log || "(empty)";
+  } catch (e) { el.textContent = e.message; }
+}
+
+/* -- segment editor rows (reference TaskCreate.vue env/param rows) ------- */
+function segRowsHtml(kind, items) {
+  return `<div id="seg-${kind}">` + items.map((seg, i) => `
+    <div class="seg-row">
+      <input placeholder="name" class="kv" data-kind="${kind}" data-field="name"
+        value="${esc(seg.name || "")}">
+      <input placeholder="value" class="kv" data-kind="${kind}" data-field="value"
+        value="${esc(seg.value || "")}">
+      <button class="ghost small danger" onclick="this.parentElement.remove()">✕</button>
+    </div>`).join("") + `</div>
+    <button class="ghost small" onclick="addSegRow('${kind}')">+ ${
+      kind === "env" ? "env var" : "parameter"}</button>`;
+}
+function addSegRow(kind) {
+  const div = document.createElement("div");
+  div.className = "seg-row";
+  div.innerHTML = `
+    <input placeholder="name" class="kv" data-kind="${kind}" data-field="name">
+    <input placeholder="value" class="kv" data-kind="${kind}" data-field="value">
+    <button class="ghost small danger" onclick="this.parentElement.remove()">✕</button>`;
+  document.getElementById("seg-" + kind).appendChild(div);
+}
+function collectSegRows(kind) {
+  return [...document.querySelectorAll(`#seg-${kind} .seg-row`)].map(row => ({
+    name: row.querySelector('[data-field="name"]').value.trim(),
+    value: row.querySelector('[data-field="value"]').value,
+  })).filter(seg => seg.name);
+}
+function hostnameOptions(current) {
+  const known = jobsHostnames.includes(current) || !current;
+  return jobsHostnames.map(h =>
+    `<option ${h === current ? "selected" : ""}>${esc(h)}</option>`).join("") +
+    (known ? "" : `<option selected>${esc(current)}</option>`);
+}
+
+/* -- add one task -------------------------------------------------------- */
+function openTaskCreateDialog(jobId) {
+  const dialog = document.getElementById("job-dialog");
+  dialog.innerHTML = `<h3>Add task</h3>
+    <label>Host</label><select id="td-host">${hostnameOptions()}</select>
+    <label>Command</label><input id="td-cmd" class="kv" value="python3 train.py">
+    <label>Chips <span class="muted">(comma-separated indices, sets the chip
+      visibility env for the process)</span></label>
+    <input id="td-chips" class="kv" placeholder="0,1,2,3">
+    <label>Environment variables</label>
+    ${segRowsHtml("env", [])}
+    <label>Parameters <span class="muted">(appended as --name=value)</span></label>
+    ${segRowsHtml("param", [])}
+    <div class="row" style="margin-top:1rem">
+      <button class="primary" onclick="createTask(${jobId})">Add</button>
+      <button class="ghost" onclick="this.closest('dialog').close()">Cancel</button>
+    </div>`;
+  dialog.showModal();
+}
+async function createTask(jobId) {
+  try {
+    const body = {
+      jobId,
+      hostname: document.getElementById("td-host").value,
+      command: document.getElementById("td-cmd").value,
+      envVariables: collectSegRows("env"),
+      parameters: collectSegRows("param"),
+    };
+    const chips = document.getElementById("td-chips").value.trim();
+    if (chips) body.chips = chips.split(",").map(s => parseInt(s.trim(), 10));
+    await api("/tasks", { json: body });
+    document.getElementById("job-dialog").close();
+    drawJobDetails();
+  } catch (e) { toast(e.message, true); }
+}
+
+/* -- edit task (segments add/remove) ------------------------------------- */
+async function openTaskEditDialog(taskId) {
+  let task;
+  try { task = await api("/tasks/" + taskId); }
+  catch (e) { return toast(e.message, true); }
+  const dialog = document.getElementById("job-dialog");
+  dialog.innerHTML = `<h3>Edit task #${task.id}</h3>
+    <label>Host</label><select id="td-host">${hostnameOptions(task.hostname)}</select>
+    <label>Command</label><input id="td-cmd" class="kv" value="${esc(task.command)}">
+    <label>Current segments <span class="muted">(✓ keep, ✕ remove on save)</span></label>
+    <div class="assign-list">${(task.cmdSegments || []).map(seg => `
+      <div class="tagrow"><span class="kv">
+        ${seg.type === "env_variable" ? "env" : "param"} <b>${esc(seg.name)}</b>
+        = ${esc(seg.value ?? "")}</span>
+        <label class="inline" style="margin:0"><input type="checkbox"
+          class="td-rm" value="${esc(seg.name)}"> ✕</label></div>`).join("")
+      || '<span class="muted">none</span>'}</div>
+    <label>Add environment variables</label>
+    ${segRowsHtml("env", [])}
+    <label>Add parameters</label>
+    ${segRowsHtml("param", [])}
+    <div class="row" style="margin-top:1rem">
+      <button class="primary" onclick="saveTask(${task.id})">Save</button>
+      <button class="ghost" onclick="this.closest('dialog').close()">Cancel</button>
+    </div>`;
+  dialog.showModal();
+}
+async function saveTask(taskId) {
+  try {
+    await api("/tasks/" + taskId, { method: "PUT", json: {
+      hostname: document.getElementById("td-host").value,
+      command: document.getElementById("td-cmd").value,
+      envVariables: collectSegRows("env"),
+      parameters: collectSegRows("param"),
+      removeSegments: [...document.querySelectorAll(".td-rm:checked")]
+        .map(el => el.value) } });
+    document.getElementById("job-dialog").close();
+    drawJobDetails();
+  } catch (e) { toast(e.message, true); }
+}
+
+/* -- tasks from template (reference TaskTemplateChooser + auto-fill) ----- */
+async function openTemplateDialog(jobId) {
+  const templates = await api("/templates").catch(() => []);
+  const dialog = document.getElementById("job-dialog");
+  dialog.innerHTML = `<h3>Tasks from template</h3>
+    <p class="muted">One process per placement line; the server auto-fills the
+    distributed wiring (coordinator address, process ids, chip visibility) for
+    the chosen template.</p>
+    <label>Template</label>
+    <select id="tt-template">${templates.map(t =>
+      `<option ${t === "jax" ? "selected" : ""}>${esc(t)}</option>`).join("")}</select>
+    <label>Command</label><input id="tt-cmd" class="kv" value="python3 train.py">
+    <label>Placements <span class="muted">(one per line:
+      hostname[:chip,chip][@address])</span></label>
+    <textarea id="tt-placements" rows="4" class="kv">${
+      jobsHostnames.map(h => h + ":0,1,2,3").join("\n")}</textarea>
+    <label>Options <span class="muted">(JSON, template-specific — e.g.
+      {"coordinator_port": 8476})</span></label>
+    <input id="tt-options" class="kv" placeholder="{}">
+    <div class="row" style="margin-top:1rem">
+      <button class="primary" onclick="createTasksFromTemplate(${jobId})">Generate</button>
+      <button class="ghost" onclick="this.closest('dialog').close()">Cancel</button>
+    </div>`;
+  dialog.showModal();
+}
+async function createTasksFromTemplate(jobId) {
+  try {
+    const placements = document.getElementById("tt-placements").value
+      .split("\n").map(s => s.trim()).filter(Boolean).map(line => {
+        let address = "";
+        const at = line.indexOf("@");
+        if (at !== -1) { address = line.slice(at + 1); line = line.slice(0, at); }
+        const [hostname, chips] = line.split(":");
+        const p = { hostname: hostname.trim() };
+        if (address) p.address = address;
+        if (chips) p.chips = chips.split(",").map(s => parseInt(s.trim(), 10));
+        return p;
+      });
+    const optionsRaw = document.getElementById("tt-options").value.trim();
+    const body = {
+      template: document.getElementById("tt-template").value,
+      command: document.getElementById("tt-cmd").value,
+      placements };
+    if (optionsRaw) body.options = JSON.parse(optionsRaw);
+    await api(`/jobs/${jobId}/tasks_from_template`, { json: body });
+    document.getElementById("job-dialog").close();
+    toast("tasks generated"); drawJobDetails();
+  } catch (e) { toast(e.message, true); }
+}

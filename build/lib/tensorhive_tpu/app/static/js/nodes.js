@@ -1,0 +1,211 @@
+"use strict";
+/* nodes dashboard: live telemetry + rolling utilization history charts.
+   Reference: NodesOverview + WatchBox.vue (setInterval poll, :192,236) +
+   LineChart.vue (vue-chartjs). History is a client-side ring buffer per chip
+   — the API serves snapshots, the reference charts the same way. */
+
+const NODES_POLL_MS = 3000;
+const HISTORY_MAX = 200;                      // ~10 min at 3 s/sample
+const chipHistory = {};                       // uid -> {duty:[], hbm:[]}
+
+function recordChipSample(uid, duty, hbmPct) {
+  const h = chipHistory[uid] || (chipHistory[uid] = { duty: [], hbm: [] });
+  h.duty.push(duty ?? 0); h.hbm.push(hbmPct ?? 0);
+  if (h.duty.length > HISTORY_MAX) { h.duty.shift(); h.hbm.shift(); }
+}
+
+function sparkline(values, cls) {
+  const w = 100, h = 36;
+  if (!values.length) return `<svg class="spark ${cls}" viewBox="0 0 ${w} ${h}"></svg>`;
+  const pts = values.map((v, i) => {
+    const x = values.length === 1 ? w : (i / (values.length - 1)) * w;
+    const y = h - 2 - (Math.min(100, Math.max(0, v)) / 100) * (h - 4);
+    return `${x.toFixed(1)},${y.toFixed(1)}`;
+  });
+  const fill = `0,${h} ${pts.join(" ")} ${w},${h}`;
+  return `<svg class="spark ${cls}" viewBox="0 0 ${w} ${h}" preserveAspectRatio="none">
+    <polygon class="fill" points="${fill}"></polygon>
+    <polyline points="${pts.join(" ")}"></polyline></svg>`;
+}
+
+function renderNodes(main) {
+  main.innerHTML = `<div id="svc-health"></div>
+    <div id="nodes"></div><dialog id="chip-dialog"></dialog>`;
+  const refresh = async () => {
+    try {
+      if (isAdmin()) refreshServiceHealth();
+      const infra = await api("/nodes/metrics");
+      for (const node of Object.values(infra)) {
+        for (const [uid, chip] of Object.entries(node.TPU || {})) {
+          recordChipSample(uid, chip.duty_cycle_pct, chip.hbm_util_pct);
+        }
+      }
+      const el = document.getElementById("nodes");
+      if (!el) return;                        // view switched mid-flight
+      el.innerHTML =
+        Object.keys(infra).sort().map(host => nodeCard(host, infra[host])).join("")
+        || `<p class="muted">No telemetry yet — are hosts configured?</p>`;
+      const open = document.querySelector("#chip-dialog[open]");
+      if (open && open.dataset.uid) drawChipChart(open.dataset.uid);
+    } catch (e) { toast(e.message, true); }
+  };
+  refresh();
+  state.timers.push(setInterval(refresh, NODES_POLL_MS));
+}
+
+/* daemon service health strip (admin): tick p50 + liveness per service */
+async function refreshServiceHealth() {
+  const el = document.getElementById("svc-health");
+  if (!el) return;
+  let services;
+  try { services = await api("/admin/services"); }
+  catch (e) {
+    // a health display must never keep asserting "alive" when the probe
+    // itself fails — mark the whole strip unknown instead
+    el.innerHTML = `<div class="card"><div class="row">
+      <h3 style="margin:0">Services</h3>
+      <span class="badge unsynchronized">health unavailable: ${esc(e.message)}</span>
+    </div></div>`;
+    return;
+  }
+  if (!services.length) { el.innerHTML = ""; return; }
+  el.innerHTML = `<div class="card"><div class="row">
+    <h3 style="margin:0">Services</h3>
+    ${services.map(svc => `<span class="badge ${svc.alive ? "on" : "unsynchronized"}"
+      title="every ${svc.intervalS}s · ${svc.ticksCompleted} ticks">
+      ${esc(svc.name)} ${svc.alive ? "✓" : "DOWN"}
+      ${svc.tickP50Ms != null ? `· ${svc.tickP50Ms}ms` : ""}</span>`).join("")}
+  </div></div>`;
+}
+
+function nodeCard(host, node) {
+  const cpu = Object.values(node.CPU || {})[0];
+  const chips = Object.entries(node.TPU || {});
+  return `<div class="card">
+    <div class="row">
+      <h3 style="margin:.1rem 0;cursor:pointer" title="node details"
+          onclick="openHostDialog('${jsArg(host)}')">${esc(host)}</h3>
+      <span class="muted">${cpu ? `CPU ${cpu.util_pct ?? "?"}% ·
+        RAM ${cpu.mem_used_mib ?? "?"}/${cpu.mem_total_mib ?? "?"} MiB` : "no CPU data"}</span>
+    </div>
+    <div class="grid" style="margin-top:.6rem">${chips.map(([uid, c]) => chipCard(uid, c, host)).join("")
+      || '<span class="muted">no TPU chips visible</span>'}</div>
+  </div>`;
+}
+
+/* single-node drilldown: GET /nodes/<host>/metrics + /nodes/<host>/cpu/metrics */
+async function openHostDialog(host) {
+  const dialog = document.getElementById("chip-dialog");
+  delete dialog.dataset.uid;
+  let node = {}, cpuMap = {};
+  try {
+    [node, cpuMap] = await Promise.all([
+      api(`/nodes/${encodeURIComponent(host)}/metrics`),
+      api(`/nodes/${encodeURIComponent(host)}/cpu/metrics`)]);
+  } catch (e) { return toast(e.message, true); }
+  const cpu = Object.values(cpuMap || {})[0] || {};
+  const chips = Object.entries(node.TPU || {});
+  dialog.innerHTML = `<h3 style="margin-top:0">${esc(host)}</h3>
+    <p class="muted">CPU ${cpu.util_pct ?? "?"}% ·
+      RAM ${cpu.mem_used_mib ?? "?"}/${cpu.mem_total_mib ?? "?"} MiB</p>
+    <table><tr><th>chip</th><th>HBM MiB</th><th>duty %</th><th>procs</th></tr>
+      ${chips.map(([uid, c]) => `<tr><td>${esc(uid)}</td>
+        <td>${c.hbm_used_mib ?? "?"} / ${c.hbm_total_mib ?? "?"}</td>
+        <td>${c.duty_cycle_pct ?? "–"}</td>
+        <td>${(c.processes || []).length}</td></tr>`).join("")}
+    </table>
+    <div class="row" style="margin-top:.8rem">
+      <button class="ghost" onclick="this.closest('dialog').close()">Close</button>
+    </div>`;
+  dialog.showModal();
+}
+
+function chipCard(uid, chip, host) {
+  const hbmPct = chip.hbm_util_pct, duty = chip.duty_cycle_pct;
+  const procs = (chip.processes || []);
+  const hist = chipHistory[uid] || { duty: [], hbm: [] };
+  return `<div class="chip-card" onclick="openChipDialog('${jsArg(uid)}','${jsArg(host)}')"
+               title="click for history">
+    <b>${esc(chip.name || uid)}</b> <span class="muted">${esc(uid)}</span>
+    <div class="muted">HBM ${chip.hbm_used_mib ?? "?"} / ${chip.hbm_total_mib ?? "?"} MiB</div>
+    <div class="bar ${hbmPct > 85 ? "hot" : ""}"><i style="width:${hbmPct || 0}%"></i></div>
+    ${sparkline(hist.hbm, "hbm")}
+    <div class="muted">duty ${duty != null ? duty + "%" : "–"}</div>
+    <div class="bar"><i style="width:${duty || 0}%"></i></div>
+    ${sparkline(hist.duty, "")}
+    ${procs.map(p => `<div class="muted" title="${esc(p.command)}">
+        ${p.pid} <b>${esc(p.user)}</b> ${esc((p.command || "").slice(0, 28))}</div>`).join("")
+      || '<div class="ok">idle</div>'}
+  </div>`;
+}
+
+/* large history chart dialog (reference WatchBox chart popout); also pulls
+   the chip inventory + live process list for this node and the persisted
+   Resource row so acceleratorType / slice metadata show up */
+function openChipDialog(uid, host) {
+  const dialog = document.getElementById("chip-dialog");
+  dialog.dataset.uid = uid;
+  dialog.innerHTML = `<h3 style="margin-top:0">${esc(uid)}</h3>
+    <p class="muted" id="chip-meta">loading…</p>
+    <p class="muted">
+      <span class="legend-dot" style="background:var(--accent)"></span>duty cycle %
+      <span class="legend-dot" style="background:var(--ok);margin-left:1rem"></span>HBM %
+    </p>
+    <svg class="chart-lg" id="chip-chart" viewBox="0 0 600 180"
+         preserveAspectRatio="none"></svg>
+    <div id="chip-procs"></div>
+    <div class="row" style="margin-top:.8rem">
+      <button class="ghost" onclick="this.closest('dialog').close()">Close</button>
+    </div>`;
+  dialog.showModal();
+  drawChipChart(uid);
+  Promise.all([
+    api("/resources/" + encodeURIComponent(uid)).catch(() => null),
+    api(`/nodes/${encodeURIComponent(host)}/tpu/info`).catch(() => ({})),
+    api(`/nodes/${encodeURIComponent(host)}/tpu/processes`).catch(() => ({})),
+  ]).then(([resource, info, processes]) => {
+    const meta = document.getElementById("chip-meta");
+    if (meta) {
+      const inv = (Array.isArray(info) ? info : [])
+        .find(c => c.uid === uid || c.name === uid) || {};
+      meta.textContent = [
+        resource && resource.acceleratorType,
+        resource && resource.sliceName && `slice ${resource.sliceName}`,
+        inv.name,
+      ].filter(Boolean).join(" · ") || "no inventory metadata";
+    }
+    const procsEl = document.getElementById("chip-procs");
+    if (procsEl) {
+      const procs = (processes || {})[uid] || [];
+      procsEl.innerHTML = procs.length ? `<table style="margin-top:.6rem">
+        <tr><th>pid</th><th>user</th><th>command</th></tr>
+        ${procs.map(p => `<tr><td>${p.pid}</td><td>${esc(p.user)}</td>
+          <td class="kv">${esc((p.command || "").slice(0, 60))}</td></tr>`).join("")}
+        </table>` : `<p class="ok" style="margin:.5rem 0 0">idle</p>`;
+    }
+  });
+}
+
+function drawChipChart(uid) {
+  const svg = document.getElementById("chip-chart");
+  if (!svg) return;
+  const h = chipHistory[uid] || { duty: [], hbm: [] };
+  const w = 600, ht = 180;
+  const line = (values, color) => {
+    if (!values.length) return "";
+    const pts = values.map((v, i) => {
+      const x = values.length === 1 ? w : (i / (values.length - 1)) * w;
+      const y = ht - 4 - (Math.min(100, Math.max(0, v)) / 100) * (ht - 8);
+      return `${x.toFixed(1)},${y.toFixed(1)}`;
+    }).join(" ");
+    return `<polyline points="${pts}" fill="none" stroke="${color}" stroke-width="1.5"/>`;
+  };
+  const gridlines = [25, 50, 75].map(pct => {
+    const y = ht - 4 - (pct / 100) * (ht - 8);
+    return `<line x1="0" y1="${y}" x2="${w}" y2="${y}" stroke="#2e3943"
+      stroke-dasharray="4 5"/><text x="4" y="${y - 3}" fill="#8b98a5"
+      font-size="9">${pct}%</text>`;
+  }).join("");
+  svg.innerHTML = gridlines +
+    line(h.duty, "var(--accent)") + line(h.hbm, "var(--ok)");
+}

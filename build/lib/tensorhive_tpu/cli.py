@@ -1,0 +1,181 @@
+"""Command-line interface.
+
+Reference: tensorhive/cli.py (268 LoC) — click group where the bare command
+boots everything (DB ensure → TensorHiveManager → webapp Process → API
+blocking, cli.py:111-148), plus ``test`` (SSH connectivity :157-166),
+``init`` (interactive config+DB+first account :170-214), ``key`` (print
+pubkey :218-243), ``create user`` (:247-257).
+"""
+from __future__ import annotations
+
+import logging
+import secrets
+import sys
+
+import click
+
+log = logging.getLogger(__name__)
+
+
+def setup_logging(verbose: bool = False) -> None:
+    logging.basicConfig(
+        level=logging.DEBUG if verbose else logging.INFO,
+        format="%(asctime)s %(levelname)-7s %(name)s: %(message)s",
+        datefmt="%H:%M:%S",
+    )
+    logging.getLogger("werkzeug").setLevel(logging.WARNING)
+
+
+@click.group(invoke_without_command=True)
+@click.option("--verbose", "-v", is_flag=True, help="debug logging")
+@click.pass_context
+def main(ctx: click.Context, verbose: bool) -> None:
+    """tpuhive — TPU cluster reservations, monitoring and job execution."""
+    setup_logging(verbose)
+    if ctx.invoked_subcommand is None:
+        run_everything()
+
+
+def run_everything() -> None:
+    """The daemon path (reference cli.main:111-148): DB, manager (services),
+    app server process, API server blocking on the main thread."""
+    from .api.server import APIServer
+    from .app.server import AppServer
+    from .config import get_config
+    from .core.managers.manager import TpuHiveManager, set_manager
+    from .db.engine import get_engine
+    from .db.migrations import ensure_schema
+
+    config = get_config()
+    if not config.api.secret_key:
+        click.echo("api.secret_key is not configured — run `tpuhive init` first",
+                   err=True)
+        sys.exit(1)
+    ensure_schema(get_engine())
+
+    manager = TpuHiveManager(config=config)
+    set_manager(manager)
+    if config.hosts:
+        statuses = manager.test_connectivity()
+        for hostname, ok in statuses.items():
+            click.echo(f"  {hostname}: {'ok' if ok else 'UNREACHABLE'}")
+    else:
+        click.echo("no hosts configured yet — edit hosts.toml "
+                   f"in {config.config_dir}")
+    manager.configure_services_from_config()
+    manager.init()
+
+    app_server = AppServer(config)
+    app_server.start()
+
+    api_server = APIServer(config)
+    click.echo(f"API:    http://{config.api.url_hostname}:{config.api.url_port}"
+               f"/{config.api.url_prefix}/ui/")
+    click.echo(f"Web UI: http://{config.app_server.host}:{config.app_server.port}/")
+    try:
+        api_server.run_forever()
+    finally:
+        app_server.stop()
+        manager.shutdown()
+
+
+@main.command()
+def test() -> None:
+    """Probe connectivity to every managed host (reference cli.py:157-166)."""
+    from .config import get_config
+    from .core.managers.manager import TpuHiveManager
+
+    config = get_config()
+    if not config.hosts:
+        click.echo("no hosts configured")
+        return
+    statuses = TpuHiveManager(config=config, services=[]).test_connectivity()
+    failed = [h for h, ok in statuses.items() if not ok]
+    for hostname, ok in statuses.items():
+        click.echo(f"{hostname}: {'ok' if ok else 'FAILED'}")
+    sys.exit(1 if failed else 0)
+
+
+@main.command()
+@click.option("--username", prompt=True)
+@click.option("--email", prompt=True)
+@click.option("--password", prompt=True, hide_input=True, confirmation_prompt=True)
+def init(username: str, email: str, password: str) -> None:
+    """Write default configs, create the database and the first admin
+    account (reference cli.py:170-214 + AccountCreator)."""
+    from .config import get_config, write_default_configs
+    from .core.account_creator import AccountCreator, ensure_default_group_bootstrap
+    from .db.engine import get_engine
+    from .db.migrations import ensure_schema
+
+    config = get_config()
+    write_default_configs(config.config_dir, secret_key=secrets.token_hex(32))
+    click.echo(f"configs in {config.config_dir}")
+    ensure_schema(get_engine())
+
+    # bootstrap: default group + global everything-allowed restriction
+    # (reference AccountCreator._check_restrictions:113-139)
+    ensure_default_group_bootstrap(click.echo)
+    AccountCreator.create_account(username, email, password, admin=True)
+    click.echo(f"admin account {username!r} created")
+
+
+@main.command()
+def key() -> None:
+    """Print the manager public key users must add to authorized_keys
+    (reference cli.py:218-243)."""
+    from .config import get_config
+    from .core.transport.ssh import generate_keypair
+    from .utils.exceptions import TpuHiveError
+
+    try:
+        click.echo(generate_keypair(get_config().ssh_key_path))
+    except TpuHiveError as exc:
+        click.echo(f"error: {exc}", err=True)
+        sys.exit(1)
+
+
+@main.group()
+def create() -> None:
+    """Create entities."""
+
+
+@create.command("user")
+@click.option("--username", default=None, help="omit to be prompted")
+@click.option("--email", default=None)
+@click.option("--password", default=None)
+@click.option("--admin", is_flag=True)
+@click.option("--multiple", is_flag=True,
+              help="loop, creating several accounts in one sitting")
+def create_user(username, email, password, admin: bool, multiple: bool) -> None:
+    """Create account(s) (reference cli.py:247-257 + AccountCreator.run_prompt).
+
+    With all of --username/--email/--password given, creates one account
+    non-interactively; otherwise enters the interactive prompt loop, which
+    re-asks on invalid fields and (with --multiple) keeps creating accounts
+    until you stop."""
+    from .core.account_creator import AccountCreator, ensure_default_group_bootstrap
+    from .db.engine import get_engine
+    from .db.migrations import ensure_schema
+    from .utils.exceptions import ValidationError
+
+    ensure_schema(get_engine())
+    if username and email and password and not multiple:
+        ensure_default_group_bootstrap(click.echo)
+        try:
+            AccountCreator.create_account(username, email, password, admin)
+        except ValidationError as exc:
+            click.echo(f"error: {exc}", err=True)
+            sys.exit(1)
+        click.echo(f"user {username!r} created{' (admin)' if admin else ''}")
+        return
+    creator = AccountCreator(prompt=click.prompt, confirm=click.confirm, echo=click.echo)
+    created = creator.run_prompt(multiple=multiple, username=username, email=email,
+                                 password=password, admin=True if admin else None)
+    click.echo(f"created {len(created)} account(s)")
+    if not created:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

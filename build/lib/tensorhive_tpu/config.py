@@ -1,0 +1,382 @@
+"""Configuration system: TOML files + env overrides + typed sections.
+
+Reference: tensorhive/config.py (298 LoC) reads three INI files copied into
+``~/.config/TensorHive/`` at import time into UPPERCASE class namespaces
+(config.py:12-68, :113-262). That design has two gotchas SURVEY.md §5 calls
+out: import-time side effects, and a silently-ignored section-name mismatch
+between the shipped template and the reader. This rebuild therefore:
+
+* parses lazily via an explicit :func:`get_config` singleton (reloadable in
+  tests),
+* validates section/key names strictly — unknown keys raise
+  :class:`ConfigurationError` instead of falling back to defaults,
+* uses TOML (stdlib ``tomllib``) with the same three-file split:
+  ``config.toml`` (main), ``hosts.toml`` (inventory), ``mailbot.toml``.
+
+The host inventory is TPU-native: each host is a TPU VM (or worker of a pod
+slice) carrying accelerator type/topology metadata the scheduler and the
+template engine need (reference hosts are bare ``[hostname] user/port``
+sections, tensorhive/config.py:121-153 — topology awareness is the main
+addition, per SURVEY.md §7 "chip vs slice granularity" risk).
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import tomllib
+from pathlib import Path
+from typing import Any, Dict, List, Mapping, Optional
+
+from .utils.exceptions import ConfigurationError
+
+ENV_CONFIG_DIR = "TPUHIVE_CONFIG_DIR"
+ENV_PYTEST = "TPUHIVE_PYTEST"
+DEFAULT_CONFIG_DIR = "~/.config/tpuhive"
+
+MAIN_CONFIG_NAME = "config.toml"
+HOSTS_CONFIG_NAME = "hosts.toml"
+MAILBOT_CONFIG_NAME = "mailbot.toml"
+
+
+def _apply(section: Any, data: Mapping[str, Any], where: str) -> None:
+    """Assign TOML keys onto a dataclass instance, strictly."""
+    valid = {f.name for f in dataclasses.fields(section)}
+    for key, value in data.items():
+        if key not in valid:
+            raise ConfigurationError(f"unknown key '{key}' in [{where}]")
+        setattr(section, key, value)
+
+
+@dataclasses.dataclass
+class DbConfig:
+    """Reference: tensorhive/config.py:156-164 (SQLite path; PYTEST → memory)."""
+    path: str = "{config_dir}/db.sqlite3"
+
+    def resolved_path(self, config_dir: Path) -> str:
+        if os.environ.get(ENV_PYTEST) or os.environ.get("PYTEST"):
+            return ":memory:"
+        return self.path.format(config_dir=str(config_dir))
+
+
+@dataclasses.dataclass
+class ApiConfig:
+    """Reference: tensorhive/config.py:167-198 (API + API_SERVER sections)."""
+    title: str = "tpuhive API"
+    url_schema: str = "http"
+    url_hostname: str = "0.0.0.0"
+    url_port: int = 1111
+    url_prefix: str = "api"
+    secret_key: str = ""            # JWT HMAC key; generated into config on init
+    access_token_minutes: int = 60
+    refresh_token_days: int = 30
+
+
+@dataclasses.dataclass
+class AppServerConfig:
+    """Static web app server (reference: tensorhive/config.py:183-190)."""
+    host: str = "0.0.0.0"
+    port: int = 5000
+
+
+@dataclasses.dataclass
+class MonitoringConfig:
+    """Reference: tensorhive/config.py:200-205 (enable flags + 2.0s interval)."""
+    enabled: bool = True
+    enable_tpu_monitor: bool = True
+    enable_cpu_monitor: bool = True
+    interval_s: float = 2.0
+    # build + push the native probe binary to managed hosts at boot; hosts
+    # where this fails use the inline python fallback automatically
+    deploy_native_probe: bool = True
+
+
+@dataclasses.dataclass
+class ProtectionConfig:
+    """Reference: tensorhive/config.py:207-214.
+
+    ``level`` mirrors the reference's strictness ladder
+    (TensorHiveManager.py:105): 1 = protect reservations, 2 = additionally
+    flag unreserved use ("strict"). ``kill_mode``: 0 = never kill,
+    1 = kill over the intruder's own account, 2 = sudo kill
+    (config.py:213 kill_processes).
+    """
+    enabled: bool = True
+    interval_s: float = 2.0
+    level: int = 1
+    notify_on_pty: bool = True
+    notify_via_email: bool = False
+    kill_mode: int = 0
+
+
+@dataclasses.dataclass
+class MailbotConfig:
+    """Reference: tensorhive/config.py:216-239 + core/utils/mailer.py."""
+    smtp_server: str = ""
+    smtp_port: int = 587
+    smtp_login: str = ""
+    smtp_password: str = ""
+    notify_intruder: bool = True
+    notify_admin: bool = False
+    admin_email: str = ""
+    interval_between_notifications_s: float = 900.0
+    max_emails_per_interval: int = 50
+
+
+@dataclasses.dataclass
+class UsageLoggingConfig:
+    """Reference: tensorhive/config.py:241-252."""
+    enabled: bool = True
+    interval_s: float = 2.0
+    log_dir: str = "{config_dir}/usage_logs"
+    log_cleanup_action: int = 2  # 1=remove, 2=hide(dot-prefix), 3=keep (UsageLoggingService.py:18)
+
+
+@dataclasses.dataclass
+class JobSchedulingConfig:
+    """Reference: tensorhive/config.py:254-259."""
+    enabled: bool = True
+    interval_s: float = 30.0
+    stop_attempts_after_mins: float = 5.0
+    schedule_queued_when_free_mins: float = 30.0
+
+
+@dataclasses.dataclass
+class SshConfig:
+    """Control-plane transport settings (reference: tensorhive/config.py:113-120)."""
+    timeout_s: float = 10.0
+    num_retries: int = 1
+    key_path: str = "{config_dir}/ssh_key"
+    # name of transport backend: 'ssh' (openssh binary), 'local' (subprocess on
+    # this machine — useful for single-VM installs and the localhost example)
+    default_backend: str = "ssh"
+    proxy_host: str = ""
+    proxy_user: str = ""
+    proxy_port: int = 22
+
+
+@dataclasses.dataclass
+class HostConfig:
+    """One managed TPU VM / pod-slice worker.
+
+    Reference hosts carry only user+port (tensorhive/config.py:121-136); the
+    TPU rebuild adds accelerator metadata so reservations and launch templates
+    can reason about slice shapes (SURVEY.md §7 risk "chip vs slice
+    granularity"): e.g. a v5e-16 slice = 4 workers x 4 chips each.
+    """
+    name: str = ""
+    address: str = ""            # hostname/IP for the transport
+    user: str = ""
+    port: int = 22
+    backend: str = ""            # override SshConfig.default_backend per host
+    accelerator_type: str = ""   # e.g. "v5litepod-16", "v5p-32", "" = CPU-only
+    topology: str = ""           # e.g. "4x4"
+    chips: int = 0               # chips attached to THIS worker VM
+    slice_name: str = ""         # shared label grouping workers of one slice
+    worker_index: int = 0        # index of this worker within its slice
+
+    def __post_init__(self) -> None:
+        if not self.address:
+            self.address = self.name
+
+
+@dataclasses.dataclass
+class Config:
+    config_dir: Path = Path(os.path.expanduser(DEFAULT_CONFIG_DIR))
+    db: DbConfig = dataclasses.field(default_factory=DbConfig)
+    api: ApiConfig = dataclasses.field(default_factory=ApiConfig)
+    app_server: AppServerConfig = dataclasses.field(default_factory=AppServerConfig)
+    monitoring: MonitoringConfig = dataclasses.field(default_factory=MonitoringConfig)
+    protection: ProtectionConfig = dataclasses.field(default_factory=ProtectionConfig)
+    mailbot: MailbotConfig = dataclasses.field(default_factory=MailbotConfig)
+    usage_logging: UsageLoggingConfig = dataclasses.field(default_factory=UsageLoggingConfig)
+    job_scheduling: JobSchedulingConfig = dataclasses.field(default_factory=JobSchedulingConfig)
+    ssh: SshConfig = dataclasses.field(default_factory=SshConfig)
+    hosts: Dict[str, HostConfig] = dataclasses.field(default_factory=dict)
+
+    # -- derived paths -----------------------------------------------------
+    @property
+    def db_path(self) -> str:
+        return self.db.resolved_path(self.config_dir)
+
+    @property
+    def usage_log_dir(self) -> Path:
+        return Path(self.usage_logging.log_dir.format(config_dir=str(self.config_dir)))
+
+    @property
+    def ssh_key_path(self) -> Path:
+        return Path(self.ssh.key_path.format(config_dir=str(self.config_dir)))
+
+    @property
+    def slices(self) -> Dict[str, List[HostConfig]]:
+        """Group hosts by slice label, ordered by worker_index."""
+        groups: Dict[str, List[HostConfig]] = {}
+        for host in self.hosts.values():
+            label = host.slice_name or host.name
+            groups.setdefault(label, []).append(host)
+        for members in groups.values():
+            members.sort(key=lambda h: h.worker_index)
+        return groups
+
+
+_SECTION_MAP = {
+    "db": "db",
+    "api": "api",
+    "app_server": "app_server",
+    "monitoring_service": "monitoring",
+    "protection_service": "protection",
+    "usage_logging_service": "usage_logging",
+    "job_scheduling_service": "job_scheduling",
+    "ssh": "ssh",
+}
+
+
+def load_config(config_dir: Optional[os.PathLike] = None) -> Config:
+    """Build a Config from TOML files under ``config_dir`` (all optional)."""
+    directory = Path(
+        config_dir
+        or os.environ.get(ENV_CONFIG_DIR)
+        or os.path.expanduser(DEFAULT_CONFIG_DIR)
+    )
+    cfg = Config(config_dir=directory)
+
+    main_path = directory / MAIN_CONFIG_NAME
+    if main_path.exists():
+        data = _read_toml(main_path)
+        for section_name, section_data in data.items():
+            attr = _SECTION_MAP.get(section_name)
+            if attr is None:
+                raise ConfigurationError(
+                    f"unknown section [{section_name}] in {main_path}"
+                )
+            if not isinstance(section_data, Mapping):
+                raise ConfigurationError(f"[{section_name}] must be a table")
+            _apply(getattr(cfg, attr), section_data, section_name)
+
+    mailbot_path = directory / MAILBOT_CONFIG_NAME
+    if mailbot_path.exists():
+        data = _read_toml(mailbot_path)
+        for section_name, section_data in data.items():
+            if section_name != "mailbot":
+                raise ConfigurationError(
+                    f"unknown section [{section_name}] in {mailbot_path}"
+                )
+            if not isinstance(section_data, Mapping):
+                raise ConfigurationError("[mailbot] must be a table")
+            _apply(cfg.mailbot, section_data, "mailbot")
+
+    hosts_path = directory / HOSTS_CONFIG_NAME
+    if hosts_path.exists():
+        data = _read_toml(hosts_path)
+        hosts_table = data.get("hosts", {})
+        if not isinstance(hosts_table, Mapping):
+            raise ConfigurationError("[hosts] must be a table of tables")
+        for name, host_data in hosts_table.items():
+            host = HostConfig(name=name)
+            _apply(host, host_data, f"hosts.{name}")
+            host.__post_init__()
+            cfg.hosts[name] = host
+
+    return cfg
+
+
+def _read_toml(path: Path) -> Dict[str, Any]:
+    try:
+        with open(path, "rb") as fh:
+            return tomllib.load(fh)
+    except tomllib.TOMLDecodeError as exc:
+        raise ConfigurationError(f"{path}: {exc}") from exc
+
+
+def write_default_configs(directory: Path, secret_key: str) -> None:
+    """Materialize commented template configs (reference: config.py:12-68
+    copies in-package templates with 0600 perms on first run)."""
+    directory.mkdir(parents=True, exist_ok=True)
+    main_path = directory / MAIN_CONFIG_NAME
+    if not main_path.exists():
+        main_path.write_text(_MAIN_TEMPLATE.format(secret_key=secret_key))
+        main_path.chmod(0o600)
+    hosts_path = directory / HOSTS_CONFIG_NAME
+    if not hosts_path.exists():
+        hosts_path.write_text(_HOSTS_TEMPLATE)
+        hosts_path.chmod(0o600)
+    mailbot_path = directory / MAILBOT_CONFIG_NAME
+    if not mailbot_path.exists():
+        mailbot_path.write_text(_MAILBOT_TEMPLATE)
+        mailbot_path.chmod(0o600)
+
+
+_MAIN_TEMPLATE = """\
+# tpuhive main configuration
+[api]
+url_port = 1111
+secret_key = "{secret_key}"
+
+[monitoring_service]
+enabled = true
+interval_s = 2.0
+
+[protection_service]
+enabled = true
+interval_s = 2.0
+level = 1
+notify_on_pty = true
+notify_via_email = false
+kill_mode = 0
+
+[usage_logging_service]
+enabled = true
+interval_s = 2.0
+
+[job_scheduling_service]
+enabled = true
+interval_s = 30.0
+schedule_queued_when_free_mins = 30.0
+
+[ssh]
+timeout_s = 10.0
+default_backend = "ssh"
+"""
+
+_HOSTS_TEMPLATE = """\
+# tpuhive managed host inventory — one table per TPU VM worker.
+# [hosts.my-v5e]
+# address = "10.0.0.2"
+# user = "tpuhive"
+# accelerator_type = "v5litepod-8"
+# topology = "2x4"
+# chips = 8
+# slice_name = "my-v5e"
+# worker_index = 0
+"""
+
+_MAILBOT_TEMPLATE = """\
+[mailbot]
+smtp_server = ""
+smtp_port = 587
+smtp_login = ""
+smtp_password = ""
+notify_intruder = true
+notify_admin = false
+admin_email = ""
+"""
+
+# ---------------------------------------------------------------------------
+_config: Optional[Config] = None
+
+
+def get_config() -> Config:
+    """Lazily-loaded process-wide config; reload with :func:`reset_config`."""
+    global _config
+    if _config is None:
+        _config = load_config()
+    return _config
+
+
+def set_config(cfg: Config) -> None:
+    global _config
+    _config = cfg
+
+
+def reset_config() -> None:
+    global _config
+    _config = None

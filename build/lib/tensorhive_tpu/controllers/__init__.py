@@ -1,0 +1,20 @@
+"""API controllers (reference: tensorhive/controllers/).
+
+Each module declares its routes with :func:`tensorhive_tpu.api.app.route`;
+importing this package registers everything (the rebuild's analog of the
+reference's RestyResolver scan, api/APIServer.py:31).
+"""
+from . import (
+    group,
+    job,
+    nodes,
+    reservation,
+    resource,
+    restriction,
+    schedule,
+    task,
+    user,
+)
+
+ALL_MODULES = (user, group, resource, nodes, reservation, restriction, schedule,
+               job, task)

@@ -1,0 +1,75 @@
+"""Group controller (reference: tensorhive/controllers/group.py, 175 LoC):
+CRUD + member add/remove + the ``is_default`` flag that auto-attaches new
+users."""
+from __future__ import annotations
+
+from ..api import schemas as S
+from ..api.app import RequestContext, route
+from ..api.schema import arr, obj, s
+from ..db.models.user import Group, User
+from ..utils.exceptions import ValidationError
+
+
+_get_or_404 = Group.get  # Model.get raises NotFoundError (→ 404) itself
+
+
+@route("/groups", ["GET"], summary="List groups", tag="groups",
+       responses={200: arr(S.GROUP)})
+def list_groups(context: RequestContext):
+    return [group.as_dict() for group in Group.all()]
+
+
+@route("/groups/<int:group_id>", ["GET"], summary="Get one group", tag="groups",
+       responses={200: S.GROUP})
+def get_group(context: RequestContext, group_id: int):
+    return _get_or_404(group_id).as_dict()
+
+
+@route("/groups", ["POST"], auth="admin", summary="Create a group", tag="groups",
+       body=obj(required=["name"], name=s("string", minLength=1),
+                isDefault=s("boolean")),
+       responses={201: S.GROUP})
+def create_group(context: RequestContext):
+    data = context.json()  # required fields enforced by the route schema
+    if Group.first_by(name=data["name"]) is not None:
+        raise ValidationError(f"group {data['name']!r} already exists")
+    group = Group(name=data["name"], is_default=bool(data.get("isDefault"))).save()
+    return group.as_dict(), 201
+
+
+@route("/groups/<int:group_id>", ["PUT"], auth="admin", summary="Update a group",
+       tag="groups",
+       body=obj(name=s("string", minLength=1), isDefault=s("boolean")),
+       responses={200: S.GROUP})
+def update_group(context: RequestContext, group_id: int):
+    group = _get_or_404(group_id)
+    data = context.json()
+    if "name" in data:
+        group.name = data["name"]
+    if "isDefault" in data:
+        group.is_default = bool(data["isDefault"])
+    group.save()
+    return group.as_dict()
+
+
+@route("/groups/<int:group_id>", ["DELETE"], auth="admin", summary="Delete a group",
+       tag="groups", responses={200: S.MSG})
+def delete_group(context: RequestContext, group_id: int):
+    _get_or_404(group_id).destroy()
+    return {"msg": "group deleted"}
+
+
+@route("/groups/<int:group_id>/users/<int:user_id>", ["PUT"], auth="admin",
+       summary="Add a user to a group", tag="groups", responses={200: S.GROUP})
+def add_member(context: RequestContext, group_id: int, user_id: int):
+    group = _get_or_404(group_id)
+    group.add_user(User.get(user_id))
+    return group.as_dict()
+
+
+@route("/groups/<int:group_id>/users/<int:user_id>", ["DELETE"], auth="admin",
+       summary="Remove a user from a group", tag="groups", responses={200: S.GROUP})
+def remove_member(context: RequestContext, group_id: int, user_id: int):
+    group = _get_or_404(group_id)
+    group.remove_user(User.get(user_id))
+    return group.as_dict()

@@ -1,0 +1,237 @@
+"""Job controller: CRUD + execute/stop + queue management.
+
+Reference: tensorhive/controllers/job.py (421 LoC) — ``business_execute`` /
+``business_stop`` (:267-310, :374-417) spawn/terminate every task of a job
+and are reused verbatim by the scheduler service; enqueue/dequeue
+(:313-350) feed the queue the GreedyScheduler drains.
+"""
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+from ..api import schemas as S
+from ..api.app import RequestContext, int_arg, route
+from ..api.schema import arr, obj, s
+from ..core.templates import Placement, render_template, template_names
+from ..db.models.job import Job, JobStatus
+from ..db.models.task import SegmentType, Task, TaskStatus
+from ..db.models.user import User
+from ..utils.exceptions import ConflictError, ForbiddenError, TransportError, ValidationError
+from ..utils.timeutils import parse_datetime
+from . import task as task_controller
+
+log = logging.getLogger(__name__)
+
+_get_or_404 = Job.get  # raises NotFoundError (→ 404) itself
+
+
+def _assert_owner_or_admin(context: RequestContext, job: Job) -> None:
+    if not context.is_admin and job.user_id != context.user_id:
+        raise ForbiddenError("only the job owner or an admin may do this")
+
+
+# -- business operations (shared with JobSchedulingService) ------------------
+
+def business_execute(job_id: int) -> Job:
+    """Spawn all tasks; tasks that fail to spawn are reported but don't
+    roll back the ones already started (reference job.py:267-310)."""
+    job = Job.get(job_id)
+    if not job.tasks:
+        raise ConflictError(f"job {job_id} has no tasks")
+    errors: List[str] = []
+    for task in job.tasks:
+        try:
+            task_controller.business_spawn(task.id)
+        except (TransportError, ConflictError) as exc:
+            # TransportError covers SpawnError AND unreachable-host failures:
+            # one bad host must not abort the remaining tasks
+            errors.append(f"task {task.id}: {exc}")
+    job = Job.get(job_id)
+    job.synchronize_status()
+    if errors:
+        log.warning("job %d partially spawned: %s", job_id, "; ".join(errors))
+    return job
+
+
+def business_stop(job_id: int, gracefully: Optional[bool] = True) -> Job:
+    """Terminate all running tasks (reference job.py:374-417)."""
+    job = Job.get(job_id)
+    for task in job.tasks:
+        if task.status is TaskStatus.running:
+            try:
+                task_controller.business_terminate(task.id, gracefully)
+            except (ConflictError, TransportError) as exc:
+                log.warning("job %d: stopping task %d failed: %s", job_id, task.id, exc)
+    job = Job.get(job_id)
+    job.synchronize_status()
+    return job
+
+
+# -- HTTP endpoints ----------------------------------------------------------
+
+@route("/jobs", ["GET"], summary="List jobs (optionally ?user_id=)", tag="jobs",
+       responses={200: arr(S.JOB)}, query={"user_id": s("integer")})
+def list_jobs(context: RequestContext):
+    # Listing everyone's jobs is admin-only; non-admins may only list their
+    # own (fullCommand embeds env segments, which commonly hold secrets).
+    # Reference gates this the same way (reference job.py:48-60).
+    user_id = int_arg(context, "user_id")
+    if not context.is_admin:
+        if user_id is not None and user_id != context.user_id:
+            raise ForbiddenError("only admins may list other users' jobs")
+        user_id = context.user_id
+    jobs = Job.filter_by(user_id=user_id) if user_id is not None else Job.all()
+    return [job.as_dict() for job in jobs]
+
+
+@route("/jobs/<int:job_id>", ["GET"], summary="Get one job with tasks", tag="jobs",
+       responses={200: S.JOB})
+def get_job(context: RequestContext, job_id: int):
+    job = _get_or_404(job_id)
+    _assert_owner_or_admin(context, job)
+    return job.as_dict()  # as_dict embeds task list
+
+
+@route("/jobs", ["POST"], summary="Create a job", tag="jobs",
+       body=obj(required=["name"],
+                name=s("string", minLength=1),
+                description=s("string"),
+                userId=s("integer", description="admin-only: create for another user"),
+                startAt=s("string", format="date-time", nullable=True),
+                stopAt=s("string", format="date-time", nullable=True)),
+       responses={201: S.JOB})
+def create_job(context: RequestContext):
+    data = context.json()  # required fields enforced by the route schema
+    user_id = context.user_id
+    if context.is_admin and "userId" in data:
+        user_id = User.get(int(data["userId"])).id
+    job = Job(
+        name=data["name"],
+        description=data.get("description", ""),
+        user_id=user_id,
+        start_at=parse_datetime(data["startAt"]) if data.get("startAt") else None,
+        stop_at=parse_datetime(data["stopAt"]) if data.get("stopAt") else None,
+    ).save()
+    return job.as_dict(), 201
+
+
+@route("/jobs/<int:job_id>", ["PUT"], summary="Update a job", tag="jobs",
+       body=obj(name=s("string", minLength=1), description=s("string"),
+                startAt=s("string", format="date-time", nullable=True),
+                stopAt=s("string", format="date-time", nullable=True)),
+       responses={200: S.JOB})
+def update_job(context: RequestContext, job_id: int):
+    job = _get_or_404(job_id)
+    _assert_owner_or_admin(context, job)
+    data = context.json()
+    if "name" in data:
+        job.name = data["name"]
+    if "description" in data:
+        job.description = data["description"]
+    if "startAt" in data:
+        job.start_at = parse_datetime(data["startAt"]) if data["startAt"] else None
+    if "stopAt" in data:
+        job.stop_at = parse_datetime(data["stopAt"]) if data["stopAt"] else None
+    job.save()
+    return job.as_dict()
+
+
+@route("/jobs/<int:job_id>", ["DELETE"], summary="Delete a job", tag="jobs",
+       responses={200: S.MSG})
+def delete_job(context: RequestContext, job_id: int):
+    job = _get_or_404(job_id)
+    _assert_owner_or_admin(context, job)
+    job.synchronize_status()
+    job = Job.get(job_id)
+    if job.status is JobStatus.running:
+        raise ConflictError("stop the job before deleting it")
+    job.destroy()
+    return {"msg": "job deleted"}
+
+
+@route("/jobs/<int:job_id>/execute", ["POST"], summary="Spawn all tasks of a job",
+       tag="jobs", responses={200: S.JOB})
+def execute(context: RequestContext, job_id: int):
+    job = _get_or_404(job_id)
+    _assert_owner_or_admin(context, job)
+    return business_execute(job_id).as_dict()
+
+
+@route("/jobs/<int:job_id>/stop", ["POST"], summary="Stop all tasks of a job",
+       tag="jobs", body=S.GRACEFULLY_BODY, responses={200: S.JOB})
+def stop(context: RequestContext, job_id: int):
+    job = _get_or_404(job_id)
+    _assert_owner_or_admin(context, job)
+    gracefully = context.json().get("gracefully", True)
+    if gracefully not in (True, False, None):
+        raise ValidationError("gracefully must be true, false or null")
+    return business_stop(job_id, gracefully).as_dict()
+
+
+@route("/templates", ["GET"], summary="Available launch-topology templates",
+       tag="jobs", responses={200: arr(s("string"))})
+def list_templates(context: RequestContext):
+    return template_names()
+
+
+@route("/jobs/<int:job_id>/tasks_from_template", ["POST"],
+       summary="Generate the job's tasks from a distributed-launch template",
+       tag="jobs",
+       body=obj(required=["template", "command", "placements"],
+                template=s("string"),
+                command=s("string", minLength=1),
+                placements=arr(obj(required=["hostname"],
+                                   hostname=s("string"),
+                                   address=s("string"),
+                                   chips=arr(s("integer")))),
+                options=obj(extra=True)),
+       responses={201: arr(S.TASK)})
+def tasks_from_template(context: RequestContext, job_id: int):
+    """Body: ``{template, command, placements: [{hostname, address?, chips?}],
+    options?}`` — renders one task per process with auto-filled distributed
+    wiring (the server-side TaskCreate.vue engine, core/templates.py)."""
+    job = _get_or_404(job_id)
+    _assert_owner_or_admin(context, job)
+    data = context.json()  # required fields enforced by the route schema
+    if not isinstance(data["placements"], list):
+        raise ValidationError("placements must be a list of objects")
+    placements = []
+    for i, p in enumerate(data["placements"]):
+        if not isinstance(p, dict) or not p.get("hostname"):
+            raise ValidationError(f"placements[{i}] needs a 'hostname'")
+        placements.append(Placement(
+            hostname=p["hostname"],
+            address=p.get("address", ""),
+            chips=p.get("chips"),
+        ))
+    specs = render_template(
+        data["template"], data["command"], placements, data.get("options")
+    )
+    tasks = []
+    for spec in specs:
+        task = Task(job_id=job.id, hostname=spec.hostname, command=spec.command).save()
+        for name, value in spec.env.items():
+            task.add_cmd_segment(name, value, SegmentType.env_variable)
+        for name, value in spec.params.items():
+            task.add_cmd_segment(name, value, SegmentType.parameter)
+        tasks.append(task)
+    return [task.as_dict() for task in tasks], 201
+
+
+@route("/jobs/<int:job_id>/enqueue", ["PUT"], summary="Place job in the scheduler queue",
+       tag="jobs", responses={200: S.JOB})
+def enqueue(context: RequestContext, job_id: int):
+    job = _get_or_404(job_id)
+    _assert_owner_or_admin(context, job)
+    job.enqueue()
+    return job.as_dict()
+
+
+@route("/jobs/<int:job_id>/dequeue", ["PUT"], summary="Remove job from the queue",
+       tag="jobs", responses={200: S.JOB})
+def dequeue(context: RequestContext, job_id: int):
+    job = _get_or_404(job_id)
+    _assert_owner_or_admin(context, job)
+    job.dequeue()
+    return job.as_dict()

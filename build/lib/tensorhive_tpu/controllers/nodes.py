@@ -1,0 +1,128 @@
+"""Nodes controller — the monitoring read path.
+
+Reference: tensorhive/controllers/nodes.py (164 LoC): ``get_infrastructure``
+snapshots the live infra dict, persists newly-seen accelerators as Resource
+rows, and prunes the view to the requester's restrictions (nodes.py:13-50);
+plus endpoints for hostnames, metrics, per-chip info, processes and CPU
+metrics (:53-160).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional
+
+from ..api import schemas as S
+from ..api.app import RequestContext, route
+from ..api.schema import arr, obj, s
+from ..core.managers.manager import get_manager
+from ..db.models.resource import Resource
+from ..utils.exceptions import NotFoundError
+
+log = logging.getLogger(__name__)
+
+
+def sync_resources_from_infrastructure(snapshot: Optional[Dict] = None) -> None:
+    """Persist chips seen in live telemetry as Resource rows (reference
+    nodes.py:17-40 auto-registration)."""
+    if snapshot is None:
+        snapshot = get_manager().infrastructure_manager.infrastructure
+    for hostname, node in snapshot.items():
+        for uid, chip in node.get("TPU", {}).items():
+            existing = Resource.get_by_uid(uid)
+            if existing is None:
+                Resource(
+                    uid=uid,
+                    name=chip.get("name", uid),
+                    hostname=hostname,
+                    chip_index=chip.get("index", 0),
+                    accelerator_type=chip.get("accelerator_type", ""),
+                ).save()
+
+
+def get_infrastructure(context: RequestContext) -> Dict:
+    """Snapshot + auto-register + restriction filtering (reference
+    nodes.py:13-50). Admins see everything."""
+    snapshot = get_manager().infrastructure_manager.infrastructure
+    sync_resources_from_infrastructure(snapshot)
+    if context.is_admin:
+        return snapshot
+    return context.current_user().filter_infrastructure_by_user_restrictions(snapshot)
+
+
+@route("/nodes/metrics", ["GET"], summary="Full telemetry snapshot", tag="nodes",
+       responses={200: S.INFRASTRUCTURE})
+def get_all_data(context: RequestContext):
+    return get_infrastructure(context)
+
+
+@route("/nodes/hostnames", ["GET"], summary="Managed hostnames", tag="nodes",
+       responses={200: arr(s("string"))})
+def get_hostnames(context: RequestContext):
+    return get_manager().infrastructure_manager.hostnames
+
+
+@route("/nodes/<hostname>/metrics", ["GET"], summary="One node's telemetry",
+       tag="nodes", responses={200: S.NODE})
+def get_node_metrics(context: RequestContext, hostname: str):
+    infrastructure = get_infrastructure(context)
+    if hostname not in infrastructure:
+        raise NotFoundError(f"unknown node {hostname!r}")
+    return infrastructure[hostname]
+
+
+@route("/nodes/<hostname>/tpu/info", ["GET"], summary="Chip inventory on a node",
+       tag="nodes", responses={200: arr(S.CHIP_METRICS)})
+def get_tpu_info(context: RequestContext, hostname: str):
+    node = get_node_metrics(context, hostname)
+    return [
+        {key: value for key, value in chip.items() if key != "processes"}
+        for chip in node.get("TPU", {}).values()
+    ]
+
+
+@route("/nodes/<hostname>/tpu/processes", ["GET"],
+       summary="Per-chip processes on a node", tag="nodes",
+       responses={200: {"type": "object",
+                        "additionalProperties": {"type": "array",
+                                                 "items": {"type": "object",
+                                                           "additionalProperties": True}}}})
+def get_tpu_processes(context: RequestContext, hostname: str):
+    node = get_node_metrics(context, hostname)
+    return {
+        uid: chip.get("processes", []) for uid, chip in node.get("TPU", {}).items()
+    }
+
+
+@route("/nodes/<hostname>/cpu/metrics", ["GET"], summary="CPU/RAM metrics",
+       tag="nodes",
+       responses={200: {"type": "object", "additionalProperties": True}})
+def get_cpu_metrics(context: RequestContext, hostname: str):
+    node = get_node_metrics(context, hostname)
+    return node.get("CPU", {})
+
+
+@route("/admin/services", ["GET"], auth="admin",
+       summary="Daemon service health (tick latency, liveness)", tag="nodes",
+       responses={200: arr(obj(
+           required=["name", "alive", "intervalS", "ticksCompleted"],
+           name=s("string"),
+           alive=s("boolean"),
+           intervalS=s("number"),
+           ticksCompleted=s("integer"),
+           tickP50Ms=s("number", nullable=True)))})
+def get_service_health(context: RequestContext):
+    """Per-service tick stats — the loop-timing observability the reference
+    only wrote to debug logs (MonitoringService.py:38-54; SURVEY.md §5
+    tracing), surfaced as API so the UI can show daemon health."""
+    service_manager = get_manager().service_manager
+    health = []
+    for service in (service_manager.services if service_manager else []):
+        p50 = service.tick_latency_p50()
+        health.append({
+            "name": service.name,
+            "alive": service.is_alive(),
+            "intervalS": service.interval_s,
+            "ticksCompleted": service.ticks_completed,
+            "tickP50Ms": round(p50 * 1000, 2) if p50 is not None else None,
+        })
+    return health

@@ -1,0 +1,117 @@
+"""Reservation controller.
+
+Reference: tensorhive/controllers/reservation.py (188 LoC): list/filter by
+resource uids + time range, create with a ReservationVerifier permission
+check (reservation.py:93-96), update with a field whitelist (owner/admin
+only), delete (owners may only delete future reservations; admins any).
+"""
+from __future__ import annotations
+
+from ..api import schemas as S
+from ..api.app import RequestContext, route
+from ..api.schema import arr, obj, s
+from ..core import verifier
+from ..db.models.reservation import Reservation
+from ..utils.exceptions import ForbiddenError, ValidationError
+from ..utils.timeutils import parse_datetime, utcnow
+
+
+_get_or_404 = Reservation.get  # raises NotFoundError (→ 404) itself
+
+
+@route("/reservations", ["GET"], summary="List reservations (filterable)",
+       tag="reservations", responses={200: arr(S.RESERVATION)},
+       query={"resources_ids": s("string", description="comma-separated chip uids"),
+              "start": s("string", format="date-time"),
+              "end": s("string", format="date-time")})
+def list_reservations(context: RequestContext):
+    """Query params: ``resources_ids`` (comma-separated uids), ``start``,
+    ``end`` (ISO datetimes) — reference filter_by_uuids_and_time_range."""
+    args = context.request.args
+    uids = [u for u in (args.get("resources_ids") or "").split(",") if u]
+    start = parse_datetime(args["start"]) if "start" in args else None
+    end = parse_datetime(args["end"]) if "end" in args else None
+    reservations = Reservation.filter_by_uids_and_time_range(uids or None, start, end)
+    return [r.as_dict() for r in reservations]
+
+
+@route("/reservations/<int:reservation_id>", ["GET"], summary="Get one reservation",
+       tag="reservations", responses={200: S.RESERVATION})
+def get_reservation(context: RequestContext, reservation_id: int):
+    return _get_or_404(reservation_id).as_dict()
+
+
+@route("/reservations", ["POST"], summary="Create a reservation", tag="reservations",
+       body=obj(required=["title", "resourceId", "start", "end"],
+                title=s("string", minLength=1),
+                description=s("string"),
+                resourceId=s("string"),
+                start=s("string", format="date-time"),
+                end=s("string", format="date-time")),
+       responses={201: S.RESERVATION})
+def create_reservation(context: RequestContext):
+    data = context.json()  # required fields enforced by the route schema
+    user = context.current_user()
+    reservation = Reservation(
+        title=data["title"],
+        description=data.get("description", ""),
+        resource_id=data["resourceId"],
+        user_id=user.id,
+        start=parse_datetime(data["start"]),
+        end=parse_datetime(data["end"]),
+    )
+    if not verifier.is_reservation_allowed(user, reservation):
+        raise ForbiddenError(
+            "no active restriction grants you this resource for that window"
+        )
+    reservation.save()  # overlap check runs inside save (would_interfere)
+    return reservation.as_dict(), 201
+
+
+#: fields an owner/admin may change after creation (reference whitelist,
+#: controllers/reservation.py update)
+_MUTABLE = ("title", "description", "start", "end")
+
+
+@route("/reservations/<int:reservation_id>", ["PUT"], summary="Update a reservation",
+       tag="reservations",
+       body=obj(title=s("string", minLength=1), description=s("string"),
+                start=s("string", format="date-time"),
+                end=s("string", format="date-time")),
+       responses={200: S.RESERVATION})
+def update_reservation(context: RequestContext, reservation_id: int):
+    reservation = _get_or_404(reservation_id)
+    if not context.is_admin and reservation.user_id != context.user_id:
+        raise ForbiddenError("only the owner or an admin may modify a reservation")
+    data = context.json()
+    unknown = set(data) - set(_MUTABLE)
+    if unknown:
+        raise ValidationError(f"immutable or unknown fields: {sorted(unknown)}")
+    if "title" in data:
+        reservation.title = data["title"]
+    if "description" in data:
+        reservation.description = data["description"]
+    if "start" in data:
+        reservation.start = parse_datetime(data["start"])
+    if "end" in data:
+        reservation.end = parse_datetime(data["end"])
+    if not context.is_admin:
+        user = context.current_user()
+        if not verifier.is_reservation_allowed(user, reservation):
+            raise ForbiddenError("your permissions do not cover the new window")
+    reservation.save()
+    return reservation.as_dict()
+
+
+@route("/reservations/<int:reservation_id>", ["DELETE"], summary="Delete a reservation",
+       tag="reservations", responses={200: S.MSG})
+def delete_reservation(context: RequestContext, reservation_id: int):
+    reservation = _get_or_404(reservation_id)
+    if not context.is_admin:
+        if reservation.user_id != context.user_id:
+            raise ForbiddenError("only the owner or an admin may delete a reservation")
+        if reservation.start <= utcnow():
+            # owners may only delete future reservations (reference rule)
+            raise ForbiddenError("cannot delete a reservation that already started")
+    reservation.destroy()
+    return {"msg": "reservation deleted"}

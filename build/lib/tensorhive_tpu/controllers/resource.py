@@ -1,0 +1,28 @@
+"""Resource controller (reference: tensorhive/controllers/resource.py, 42
+LoC): list/get TPU-chip Resource rows, auto-synced from live telemetry
+first (resource.py:22-28)."""
+from __future__ import annotations
+
+from ..api import schemas as S
+from ..api.app import RequestContext, route
+from ..api.schema import arr
+from ..db.models.resource import Resource
+from ..utils.exceptions import NotFoundError
+from .nodes import sync_resources_from_infrastructure
+
+
+@route("/resources", ["GET"], summary="List TPU chip resources", tag="resources",
+       responses={200: arr(S.RESOURCE)})
+def list_resources(context: RequestContext):
+    sync_resources_from_infrastructure()
+    return [resource.as_dict() for resource in Resource.all()]
+
+
+@route("/resources/<uid>", ["GET"], summary="Get one resource by chip uid",
+       tag="resources", responses={200: S.RESOURCE})
+def get_resource(context: RequestContext, uid: str):
+    sync_resources_from_infrastructure()
+    resource = Resource.get_by_uid(uid)
+    if resource is None:
+        raise NotFoundError(f"resource {uid!r} not found")
+    return resource.as_dict()

@@ -1,0 +1,225 @@
+"""Restriction controller.
+
+Reference: tensorhive/controllers/restriction.py (478 LoC) — CRUD plus
+apply/remove against users, groups, resources, whole hostnames, and
+schedules; **every permission mutation re-verifies the affected users'
+reservations** (restriction.py:139,164,184,214,244,275,306,335 all call
+ReservationVerifier.update_user_reservations_statuses).
+"""
+from __future__ import annotations
+
+from typing import Iterable, List
+
+from ..api import schemas as S
+from ..api.app import RequestContext, route
+from ..api.schema import arr, obj, s
+from ..core import verifier
+from ..db.models.resource import Resource
+from ..db.models.restriction import Restriction
+from ..db.models.schedule import RestrictionSchedule
+from ..db.models.user import Group, User
+from ..utils.exceptions import NotFoundError
+from ..utils.timeutils import parse_datetime
+
+
+def _get_or_404(restriction_id: int) -> Restriction:
+    return Restriction.get(restriction_id)
+
+
+def _reverify(users: Iterable[User], increased: bool) -> None:
+    for user in users:
+        verifier.update_user_reservations_statuses(user, increased)
+
+
+def _reverify_both(users: Iterable[User]) -> None:
+    """One sweep per user covering both grant and revoke directions (window
+    edits can do either)."""
+    for user in users:
+        verifier.reverify_user(user)
+
+
+def _affected_users(restriction: Restriction) -> List[User]:
+    users = {user.id: user for user in restriction.users}
+    for group in restriction.groups:
+        for user in group.users:
+            users.setdefault(user.id, user)
+    return list(users.values())
+
+
+@route("/restrictions", ["GET"], summary="List restrictions", tag="restrictions",
+       responses={200: arr(S.RESTRICTION)})
+def list_restrictions(context: RequestContext):
+    return [r.as_dict() for r in Restriction.all()]
+
+
+@route("/restrictions/<int:restriction_id>", ["GET"], summary="Get one restriction",
+       tag="restrictions", responses={200: S.RESTRICTION})
+def get_restriction(context: RequestContext, restriction_id: int):
+    return _get_or_404(restriction_id).as_dict()
+
+
+@route("/restrictions", ["POST"], auth="admin", summary="Create a restriction",
+       tag="restrictions",
+       body=obj(required=["name", "startsAt"],
+                name=s("string", minLength=1),
+                startsAt=s("string", format="date-time"),
+                endsAt=s("string", format="date-time", nullable=True),
+                isGlobal=s("boolean")),
+       responses={201: S.RESTRICTION})
+def create_restriction(context: RequestContext):
+    data = context.json()  # required fields enforced by the route schema
+    restriction = Restriction(
+        name=data["name"],
+        starts_at=parse_datetime(data["startsAt"]),
+        ends_at=parse_datetime(data["endsAt"]) if data.get("endsAt") else None,
+        is_global=bool(data.get("isGlobal")),
+    ).save()
+    if restriction.is_global:
+        _reverify(User.all(), increased=True)
+    return restriction.as_dict(), 201
+
+
+@route("/restrictions/<int:restriction_id>", ["PUT"], auth="admin",
+       summary="Update a restriction", tag="restrictions",
+       body=obj(name=s("string", minLength=1),
+                startsAt=s("string", format="date-time"),
+                endsAt=s("string", format="date-time", nullable=True),
+                isGlobal=s("boolean")),
+       responses={200: S.RESTRICTION})
+def update_restriction(context: RequestContext, restriction_id: int):
+    restriction = _get_or_404(restriction_id)
+    data = context.json()
+    if "name" in data:
+        restriction.name = data["name"]
+    if "startsAt" in data:
+        restriction.starts_at = parse_datetime(data["startsAt"])
+    if "endsAt" in data:
+        restriction.ends_at = parse_datetime(data["endsAt"]) if data["endsAt"] else None
+    if "isGlobal" in data:
+        restriction.is_global = bool(data["isGlobal"])
+    restriction.save()
+    # window changes can both grant and revoke
+    affected = User.all() if restriction.is_global else _affected_users(restriction)
+    _reverify_both(affected)
+    return restriction.as_dict()
+
+
+@route("/restrictions/<int:restriction_id>", ["DELETE"], auth="admin",
+       summary="Delete a restriction", tag="restrictions", responses={200: S.MSG})
+def delete_restriction(context: RequestContext, restriction_id: int):
+    restriction = _get_or_404(restriction_id)
+    affected = User.all() if restriction.is_global else _affected_users(restriction)
+    restriction.destroy()
+    _reverify(affected, increased=False)
+    return {"msg": "restriction deleted"}
+
+
+# -- assignment endpoints ---------------------------------------------------
+
+_user_or_404 = User.get
+_group_or_404 = Group.get
+
+
+def _resource_or_404(uid: str) -> Resource:
+    resource = Resource.get_by_uid(uid)
+    if resource is None:
+        raise NotFoundError(f"resource {uid!r} not found")
+    return resource
+
+
+_schedule_or_404 = RestrictionSchedule.get
+
+
+@route("/restrictions/<int:restriction_id>/users/<int:user_id>", ["PUT"], auth="admin",
+       summary="Apply restriction to a user", tag="restrictions",
+       responses={200: S.RESTRICTION})
+def apply_to_user(context: RequestContext, restriction_id: int, user_id: int):
+    restriction, user = _get_or_404(restriction_id), _user_or_404(user_id)
+    restriction.apply_to_user(user)
+    _reverify([user], increased=True)
+    return restriction.as_dict()
+
+
+@route("/restrictions/<int:restriction_id>/users/<int:user_id>", ["DELETE"], auth="admin",
+       summary="Remove restriction from a user", tag="restrictions",
+       responses={200: S.RESTRICTION})
+def remove_from_user(context: RequestContext, restriction_id: int, user_id: int):
+    restriction, user = _get_or_404(restriction_id), _user_or_404(user_id)
+    restriction.remove_from_user(user)
+    _reverify([user], increased=False)
+    return restriction.as_dict()
+
+
+@route("/restrictions/<int:restriction_id>/groups/<int:group_id>", ["PUT"], auth="admin",
+       summary="Apply restriction to a group", tag="restrictions",
+       responses={200: S.RESTRICTION})
+def apply_to_group(context: RequestContext, restriction_id: int, group_id: int):
+    restriction, group = _get_or_404(restriction_id), _group_or_404(group_id)
+    restriction.apply_to_group(group)
+    _reverify(group.users, increased=True)
+    return restriction.as_dict()
+
+
+@route("/restrictions/<int:restriction_id>/groups/<int:group_id>", ["DELETE"], auth="admin",
+       summary="Remove restriction from a group", tag="restrictions",
+       responses={200: S.RESTRICTION})
+def remove_from_group(context: RequestContext, restriction_id: int, group_id: int):
+    restriction, group = _get_or_404(restriction_id), _group_or_404(group_id)
+    restriction.remove_from_group(group)
+    _reverify(group.users, increased=False)
+    return restriction.as_dict()
+
+
+@route("/restrictions/<int:restriction_id>/resources/<uid>", ["PUT"], auth="admin",
+       summary="Apply restriction to a resource", tag="restrictions",
+       responses={200: S.RESTRICTION})
+def apply_to_resource(context: RequestContext, restriction_id: int, uid: str):
+    restriction, resource = _get_or_404(restriction_id), _resource_or_404(uid)
+    restriction.apply_to_resource(resource)
+    _reverify(_affected_users(restriction), increased=True)
+    return restriction.as_dict()
+
+
+@route("/restrictions/<int:restriction_id>/resources/<uid>", ["DELETE"], auth="admin",
+       summary="Remove restriction from a resource", tag="restrictions",
+       responses={200: S.RESTRICTION})
+def remove_from_resource(context: RequestContext, restriction_id: int, uid: str):
+    restriction, resource = _get_or_404(restriction_id), _resource_or_404(uid)
+    restriction.remove_from_resource(resource)
+    _reverify(_affected_users(restriction), increased=False)
+    return restriction.as_dict()
+
+
+@route("/restrictions/<int:restriction_id>/hosts/<hostname>", ["PUT"], auth="admin",
+       summary="Apply restriction to every chip of a host", tag="restrictions",
+       responses={200: S.RESTRICTION})
+def apply_to_hostname(context: RequestContext, restriction_id: int, hostname: str):
+    restriction = _get_or_404(restriction_id)
+    count = restriction.apply_to_resources_by_hostname(hostname)
+    if count == 0:
+        raise NotFoundError(f"no resources registered for host {hostname!r}")
+    _reverify(_affected_users(restriction), increased=True)
+    return restriction.as_dict()
+
+
+@route("/restrictions/<int:restriction_id>/schedules/<int:schedule_id>", ["PUT"],
+       auth="admin", summary="Attach a schedule", tag="restrictions",
+       responses={200: S.RESTRICTION})
+def add_schedule(context: RequestContext, restriction_id: int, schedule_id: int):
+    restriction, schedule = _get_or_404(restriction_id), _schedule_or_404(schedule_id)
+    restriction.add_schedule(schedule)
+    # attaching a schedule narrows the window: permissions decreased
+    affected = User.all() if restriction.is_global else _affected_users(restriction)
+    _reverify(affected, increased=False)
+    return restriction.as_dict()
+
+
+@route("/restrictions/<int:restriction_id>/schedules/<int:schedule_id>", ["DELETE"],
+       auth="admin", summary="Detach a schedule", tag="restrictions",
+       responses={200: S.RESTRICTION})
+def remove_schedule(context: RequestContext, restriction_id: int, schedule_id: int):
+    restriction, schedule = _get_or_404(restriction_id), _schedule_or_404(schedule_id)
+    restriction.remove_schedule(schedule)
+    affected = User.all() if restriction.is_global else _affected_users(restriction)
+    _reverify(affected, increased=True)
+    return restriction.as_dict()

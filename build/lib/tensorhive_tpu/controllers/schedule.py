@@ -1,0 +1,99 @@
+"""Schedule controller (reference: tensorhive/controllers/schedule.py, 135
+LoC): RestrictionSchedule CRUD. Editing or deleting a schedule changes the
+effective windows of every restriction it is attached to, so both paths
+re-verify affected users' reservations (reference schedule.py:97-98, :125)."""
+from __future__ import annotations
+
+from ..api import schemas as S
+from ..api.app import RequestContext, route
+from ..api.schema import arr, obj, s
+from ..core import verifier
+from ..db.models.schedule import RestrictionSchedule
+from ..db.models.user import User
+
+
+_get_or_404 = RestrictionSchedule.get  # raises NotFoundError (→ 404) itself
+
+
+def _reverify_attached(schedule: RestrictionSchedule) -> None:
+    users = {}
+    needs_all = False
+    for restriction in schedule.restrictions:
+        if restriction.is_global:
+            needs_all = True
+            break
+        for user in restriction.users:
+            users.setdefault(user.id, user)
+        for group in restriction.groups:
+            for user in group.users:
+                users.setdefault(user.id, user)
+    affected = User.all() if needs_all else users.values()
+    for user in affected:
+        verifier.reverify_user(user)
+
+
+@route("/schedules", ["GET"], summary="List schedules", tag="schedules",
+       responses={200: arr(S.SCHEDULE)})
+def list_schedules(context: RequestContext):
+    return [s.as_dict() for s in RestrictionSchedule.all()]
+
+
+@route("/schedules/<int:schedule_id>", ["GET"], summary="Get one schedule",
+       tag="schedules", responses={200: S.SCHEDULE})
+def get_schedule(context: RequestContext, schedule_id: int):
+    return _get_or_404(schedule_id).as_dict()
+
+
+@route("/schedules", ["POST"], auth="admin", summary="Create a schedule",
+       tag="schedules",
+       body=obj(required=["scheduleDays", "hourStart", "hourEnd"],
+                scheduleDays=s("string", minLength=1,
+                               description="weekday mask, e.g. '12345'"),
+                hourStart=s("string", example="08:00"),
+                hourEnd=s("string", example="20:00")),
+       responses={201: S.SCHEDULE})
+def create_schedule(context: RequestContext):
+    data = context.json()  # required fields enforced by the route schema
+    schedule = RestrictionSchedule(
+        schedule_days=data["scheduleDays"],
+        hour_start=data["hourStart"],
+        hour_end=data["hourEnd"],
+    ).save()
+    return schedule.as_dict(), 201
+
+
+@route("/schedules/<int:schedule_id>", ["PUT"], auth="admin",
+       summary="Update a schedule", tag="schedules",
+       body=obj(scheduleDays=s("string", minLength=1),
+                hourStart=s("string"), hourEnd=s("string")),
+       responses={200: S.SCHEDULE})
+def update_schedule(context: RequestContext, schedule_id: int):
+    schedule = _get_or_404(schedule_id)
+    data = context.json()
+    if "scheduleDays" in data:
+        schedule.schedule_days = data["scheduleDays"]
+    if "hourStart" in data:
+        schedule.hour_start = data["hourStart"]
+    if "hourEnd" in data:
+        schedule.hour_end = data["hourEnd"]
+    schedule.save()
+    _reverify_attached(schedule)
+    return schedule.as_dict()
+
+
+@route("/schedules/<int:schedule_id>", ["DELETE"], auth="admin",
+       summary="Delete a schedule", tag="schedules", responses={200: S.MSG})
+def delete_schedule(context: RequestContext, schedule_id: int):
+    schedule = _get_or_404(schedule_id)
+    # collect the attached restrictions BEFORE the row (and its links) go away
+    attached = schedule.restrictions
+    schedule.destroy()
+    for restriction in attached:
+        users = {u.id: u for u in restriction.users}
+        for group in restriction.groups:
+            for user in group.users:
+                users.setdefault(user.id, user)
+        affected = User.all() if restriction.is_global else users.values()
+        for user in affected:
+            verifier.reverify_user(user)
+    return {"msg": "schedule deleted"}

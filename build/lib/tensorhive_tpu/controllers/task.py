@@ -1,0 +1,247 @@
+"""Task controller: CRUD + spawn/terminate/log + status reconciliation.
+
+Reference: tensorhive/controllers/task.py (527 LoC) — the heart is
+``synchronize(task_id)`` (:44-94), which reconciles the DB status against
+live remote state: a stored ``running`` task whose PID no longer exists
+becomes ``terminated``; an unreachable host makes it ``unsynchronized``
+(later re-adopted by PID match when the host returns). The
+``@synchronize_task_record`` decorator (:97-118) runs it before every
+state-dependent operation; ``business_*`` functions are shared with the
+scheduler service (job.py:267-310).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ..api import schemas as S
+from ..api.app import RequestContext, int_arg, route
+from ..api.schema import arr, obj, s
+from ..core.nursery import Termination, get_ops_factory
+from ..db.models.job import Job
+from ..db.models.task import CHIP_ENV_VAR, SegmentType, Task, TaskStatus
+from ..db.models.user import User
+from ..utils.exceptions import (
+    ConflictError,
+    ForbiddenError,
+    SpawnError,
+    TransportError,
+    ValidationError,
+)
+
+log = logging.getLogger(__name__)
+
+_get_or_404 = Task.get  # raises NotFoundError (→ 404) itself
+
+
+def _task_owner(task: Task) -> User:
+    return User.get(Job.get(task.job_id).user_id)
+
+
+def _assert_owner_or_admin(context: RequestContext, task: Task) -> None:
+    job = Job.get(task.job_id)
+    if not context.is_admin and job.user_id != context.user_id:
+        raise ForbiddenError("only the job owner or an admin may do this")
+
+
+# -- reconciliation (reference task.py:44-118) ------------------------------
+
+def synchronize(task_id: int) -> Task:
+    """Reconcile one task's DB record against live remote state."""
+    task = Task.get(task_id)
+    if task.status not in (TaskStatus.running, TaskStatus.unsynchronized):
+        return task
+    owner = _task_owner(task)
+    ops = get_ops_factory().ops_for(task.hostname, user=owner.username)
+    try:
+        alive = ops.running_tasks()
+    except TransportError as exc:
+        log.warning("cannot synchronize task %d: %s", task_id, exc)
+        if task.status is TaskStatus.running:
+            task.set_status(TaskStatus.unsynchronized)
+        return task
+    if task.id in alive:
+        # re-adopt (host came back, or daemon restarted while task survived)
+        live_pid = alive[task.id]
+        if task.pid != live_pid or task.status is not TaskStatus.running:
+            task.pid = live_pid
+            task.set_status(TaskStatus.running)
+    else:
+        task.pid = None
+        task.set_status(TaskStatus.terminated)
+    return task
+
+
+# -- business operations (shared with the scheduler) ------------------------
+
+def business_spawn(task_id: int) -> Task:
+    """Reference task.py:406-441."""
+    task = synchronize(task_id)
+    if task.status is TaskStatus.running:
+        raise ConflictError(f"task {task_id} is already running (pid {task.pid})")
+    owner = _task_owner(task)
+    ops = get_ops_factory().ops_for(task.hostname, user=owner.username)
+    pid = ops.spawn(task.full_command, task.id)
+    task.pid = pid
+    task.set_status(TaskStatus.running)
+    return task
+
+
+_GRACEFULLY_TO_MODE = {
+    True: Termination.interrupt,    # SIGINT: let the training checkpoint
+    None: Termination.terminate,    # SIGTERM
+    False: Termination.kill,        # SIGKILL
+}
+
+
+def business_terminate(task_id: int, gracefully: Optional[bool] = True) -> Task:
+    """Reference task.py:444-489 (gracefully True→SIGINT via ^C, None→screen
+    quit, False→kill -9)."""
+    task = synchronize(task_id)
+    if task.status is not TaskStatus.running or task.pid is None:
+        raise ConflictError(f"task {task_id} is not running")
+    owner = _task_owner(task)
+    ops = get_ops_factory().ops_for(task.hostname, user=owner.username)
+    ops.terminate(task.pid, _GRACEFULLY_TO_MODE[gracefully])
+    if gracefully is False:
+        # SIGKILL is not survivable: record the terminal state immediately
+        task.pid = None
+        task.set_status(TaskStatus.terminated)
+    else:
+        # graceful paths let the process wind down; next synchronize()
+        # observes the actual exit
+        synchronize(task.id)
+        task = Task.get(task.id)
+    return task
+
+
+def business_get_log(task_id: int, tail: Optional[int] = None) -> str:
+    """Reference task.py:492-523."""
+    task = Task.get(task_id)
+    owner = _task_owner(task)
+    ops = get_ops_factory().ops_for(task.hostname, user=owner.username)
+    return ops.fetch_log(task.id, tail=tail)
+
+
+# -- HTTP endpoints ----------------------------------------------------------
+
+@route("/tasks", ["GET"], summary="List tasks (optionally ?job_id=)", tag="tasks",
+       responses={200: arr(S.TASK)}, query={"job_id": s("integer")})
+def list_tasks(context: RequestContext):
+    # Listing all tasks is admin-only; non-admins may only list tasks of a
+    # job they own (fullCommand embeds env-segment values — often secrets).
+    # Reference gates per-record reads to owner-or-admin (task.py:141-147).
+    job_id = int_arg(context, "job_id")
+    if not context.is_admin:
+        if job_id is None:
+            raise ForbiddenError("only admins may list all tasks; pass ?job_id=")
+        job = Job.get(job_id)
+        if job.user_id != context.user_id:
+            raise ForbiddenError("only the job owner or an admin may list its tasks")
+    tasks = Task.filter_by(job_id=job_id) if job_id is not None else Task.all()
+    return [task.as_dict() for task in tasks]
+
+
+@route("/tasks/<int:task_id>", ["GET"], summary="Get one task (synchronized)",
+       tag="tasks", responses={200: S.TASK})
+def get_task(context: RequestContext, task_id: int):
+    _assert_owner_or_admin(context, _get_or_404(task_id))
+    return synchronize(task_id).as_dict()
+
+
+@route("/tasks", ["POST"], summary="Create a task under a job", tag="tasks",
+       body=obj(required=["jobId", "hostname", "command"],
+                jobId=s("integer"),
+                hostname=s("string", minLength=1),
+                command=s("string", minLength=1),
+                envVariables=arr(obj(required=["name"], name=s("string", minLength=1), value=s("string"))),
+                parameters=arr(obj(required=["name"], name=s("string", minLength=1), value=s("string"))),
+                chips=arr(s("integer"))),
+       responses={201: S.TASK})
+def create_task(context: RequestContext):
+    data = context.json()  # required fields enforced by the route schema
+    job = Job.get(int(data["jobId"]))
+    if not context.is_admin and job.user_id != context.user_id:
+        raise ForbiddenError("only the job owner or an admin may add tasks")
+    task = Task(job_id=job.id, hostname=data["hostname"], command=data["command"]).save()
+    for env in data.get("envVariables", []):
+        task.add_cmd_segment(env["name"], env.get("value", ""), SegmentType.env_variable)
+    for param in data.get("parameters", []):
+        task.add_cmd_segment(param["name"], param.get("value", ""), SegmentType.parameter)
+    if "chips" in data:
+        task.add_cmd_segment(
+            CHIP_ENV_VAR,
+            ",".join(str(c) for c in data["chips"]),
+            SegmentType.env_variable,
+        )
+    return task.as_dict(), 201
+
+
+@route("/tasks/<int:task_id>", ["PUT"], summary="Update a task", tag="tasks",
+       body=obj(hostname=s("string", minLength=1),
+                command=s("string", minLength=1),
+                envVariables=arr(obj(required=["name"], name=s("string", minLength=1), value=s("string"))),
+                parameters=arr(obj(required=["name"], name=s("string", minLength=1), value=s("string"))),
+                removeSegments=arr(s("string"))),
+       responses={200: S.TASK})
+def update_task(context: RequestContext, task_id: int):
+    task = _get_or_404(task_id)
+    _assert_owner_or_admin(context, task)
+    if task.status is TaskStatus.running:
+        raise ConflictError("cannot edit a running task")
+    data = context.json()
+    if "hostname" in data:
+        task.hostname = data["hostname"]
+    if "command" in data:
+        task.command = data["command"]
+    task.save()
+    for env in data.get("envVariables", []):
+        task.add_cmd_segment(env["name"], env.get("value", ""), SegmentType.env_variable)
+    for param in data.get("parameters", []):
+        task.add_cmd_segment(param["name"], param.get("value", ""), SegmentType.parameter)
+    for name in data.get("removeSegments", []):
+        task.remove_cmd_segment(name)
+    return task.as_dict()
+
+
+@route("/tasks/<int:task_id>", ["DELETE"], summary="Delete a task", tag="tasks",
+       responses={200: S.MSG})
+def delete_task(context: RequestContext, task_id: int):
+    task = _get_or_404(task_id)
+    _assert_owner_or_admin(context, task)
+    task = synchronize(task_id)
+    if task.status is TaskStatus.running:
+        raise ConflictError("terminate the task before deleting it")
+    task.destroy()
+    return {"msg": "task deleted"}
+
+
+@route("/tasks/<int:task_id>/spawn", ["POST"], summary="Spawn the task's process",
+       tag="tasks", responses={200: S.TASK})
+def spawn(context: RequestContext, task_id: int):
+    task = _get_or_404(task_id)
+    _assert_owner_or_admin(context, task)
+    try:
+        return business_spawn(task_id).as_dict()
+    except SpawnError as exc:
+        raise ConflictError(str(exc))
+
+
+@route("/tasks/<int:task_id>/terminate", ["POST"], summary="Signal the task's process",
+       tag="tasks", body=S.GRACEFULLY_BODY, responses={200: S.TASK})
+def terminate(context: RequestContext, task_id: int):
+    task = _get_or_404(task_id)
+    _assert_owner_or_admin(context, task)
+    body = context.json()
+    gracefully = body.get("gracefully", True)
+    if gracefully not in (True, False, None):
+        raise ValidationError("gracefully must be true, false or null")
+    return business_terminate(task_id, gracefully).as_dict()
+
+
+@route("/tasks/<int:task_id>/log", ["GET"], summary="Fetch the task's output log",
+       tag="tasks", responses={200: S.TASK_LOG}, query={"tail": s("integer")})
+def get_log(context: RequestContext, task_id: int):
+    task = _get_or_404(task_id)
+    _assert_owner_or_admin(context, task)
+    return {"log": business_get_log(task_id, tail=int_arg(context, "tail"))}

@@ -1,0 +1,181 @@
+"""User controller: CRUD + auth session endpoints.
+
+Reference: tensorhive/controllers/user.py (240 LoC) — CRUD (admin-gated),
+login issuing access+refresh JWTs (:182-207), logout blacklisting by jti
+(:207-230), refresh (:233-240), and ``ssh_signup`` which authenticates a
+signup by proving SSH access to the first configured node with the manager's
+key (:99-117); ``authorized_keys_entry`` returns the public key users must
+install (:120-123).
+"""
+from __future__ import annotations
+
+import logging
+
+from ..api import jwt as jwt_module
+from ..api import schemas as S
+from ..api.app import RequestContext, route
+from ..api.schema import arr, obj, s
+from ..db.models.user import Group, User
+from ..utils.exceptions import ForbiddenError, ValidationError
+from ..utils.timeutils import utcnow
+
+log = logging.getLogger(__name__)
+
+
+def _attach_default_groups(user: User) -> None:
+    for group in Group.get_default_groups():
+        group.add_user(user)
+
+
+_get_or_404 = User.get  # Model.get raises NotFoundError (→ 404) itself
+
+
+# -- CRUD -------------------------------------------------------------------
+
+@route("/users", ["GET"], auth="admin", summary="List all users", tag="users",
+       responses={200: arr(S.USER)})
+def list_users(context: RequestContext):
+    return [user.as_dict() for user in User.all()]
+
+
+@route("/users/<int:user_id>", ["GET"], summary="Get one user", tag="users",
+       responses={200: S.USER})
+def get_user(context: RequestContext, user_id: int):
+    if not context.is_admin and context.user_id != user_id:
+        raise ForbiddenError("only admins may view other accounts")
+    return _get_or_404(user_id).as_dict()
+
+
+@route("/users", ["POST"], auth="admin", summary="Create a user", tag="users",
+       body=S.CREATE_USER_BODY, responses={201: S.USER})
+def create_user(context: RequestContext):
+    data = context.json()  # required fields enforced by the route schema
+    if User.find_by_username(data["username"]) is not None:
+        raise ValidationError(f"username {data['username']!r} already taken")
+    user = User(
+        username=data["username"], email=data["email"], password=data["password"]
+    ).save()
+    user.add_role("user")
+    if data.get("admin"):
+        user.add_role("admin")
+    _attach_default_groups(user)
+    return user.as_dict(), 201
+
+
+@route("/users/<int:user_id>", ["PUT"], summary="Update a user", tag="users",
+       body=S.UPDATE_USER_BODY, responses={200: S.USER})
+def update_user(context: RequestContext, user_id: int):
+    if not context.is_admin and context.user_id != user_id:
+        raise ForbiddenError("only admins may modify other accounts")
+    user = _get_or_404(user_id)
+    data = context.json()
+    # field whitelist; role changes are admin-only
+    if "email" in data:
+        user.email = data["email"]
+    if "password" in data:
+        user.password = data["password"]
+    if "roles" in data:
+        if not context.is_admin:
+            raise ForbiddenError("only admins may change roles")
+        desired = set(data["roles"])
+        for name in desired - set(user.roles):
+            user.add_role(name)
+        for name in set(user.roles) - desired:
+            user.remove_role(name)
+    user.save()
+    return user.as_dict()
+
+
+@route("/users/<int:user_id>", ["DELETE"], auth="admin", summary="Delete a user",
+       tag="users", responses={200: S.MSG})
+def delete_user(context: RequestContext, user_id: int):
+    _get_or_404(user_id).destroy()
+    return {"msg": "user deleted"}
+
+
+# -- session ---------------------------------------------------------------
+
+@route("/user/login", ["POST"], auth=None, summary="Log in, returns JWT pair",
+       tag="auth", body=S.LOGIN_BODY, responses={200: S.TOKEN_PAIR})
+def login(context: RequestContext):
+    data = context.json()  # required fields enforced by the route schema
+    user = User.find_by_username(data["username"])
+    if user is None or not user.check_password(data["password"]):
+        raise jwt_module.AuthError("invalid credentials")
+    user.last_login_at = utcnow()
+    user.save()
+    return {
+        "user": user.as_dict(),
+        "accessToken": jwt_module.create_access_token(user.id, user.roles),
+        "refreshToken": jwt_module.create_refresh_token(user.id),
+    }
+
+
+@route("/user/logout", ["POST"], auth="logout",
+       summary="Revoke the presented access token", tag="auth",
+       responses={200: S.MSG})
+def logout(context: RequestContext):
+    # _authenticate already signature-verified the token (auth="logout")
+    jwt_module.revoke_claims(context.claims)
+    return {"msg": "access token revoked"}
+
+
+@route("/user/logout/refresh", ["POST"], auth="logout-refresh",
+       summary="Revoke the presented refresh token", tag="auth",
+       responses={200: S.MSG})
+def logout_refresh(context: RequestContext):
+    jwt_module.revoke_claims(context.claims)
+    return {"msg": "refresh token revoked"}
+
+
+@route("/user/refresh", ["POST"], auth="refresh",
+       summary="Mint a new access token from a refresh token", tag="auth",
+       responses={200: obj(required=["accessToken"], accessToken=s("string"))})
+def refresh(context: RequestContext):
+    user = context.current_user()
+    return {"accessToken": jwt_module.create_access_token(user.id, user.roles)}
+
+
+# -- ssh signup (reference user.py:99-123) ----------------------------------
+
+@route("/user/ssh_signup", ["POST"], auth=None,
+       summary="Sign up by proving SSH access to a managed host", tag="auth",
+       body=S.SIGNUP_BODY, responses={201: S.USER})
+def ssh_signup(context: RequestContext):
+    """The reference verifies the claimed unix account by connecting to the
+    first configured node as that user with the manager's key — same here,
+    over the transport layer."""
+    from ..config import get_config
+    from ..core.transport.base import get_transport_manager
+
+    data = context.json()  # required fields enforced by the route schema
+    config = get_config()
+    if not config.hosts:
+        raise ValidationError("no managed hosts configured; signup unavailable")
+    if User.find_by_username(data["username"]) is not None:
+        raise ValidationError(f"username {data['username']!r} already taken")
+    first_host = next(iter(config.hosts))
+    transport = get_transport_manager().for_host(first_host, user=data["username"])
+    if not transport.test():
+        raise ForbiddenError(
+            f"could not authenticate as {data['username']!r} on {first_host}; "
+            "install the manager key (GET /user/authorized_keys_entry) first"
+        )
+    user = User(
+        username=data["username"], email=data["email"], password=data["password"]
+    ).save()
+    user.add_role("user")
+    _attach_default_groups(user)
+    return user.as_dict(), 201
+
+
+@route("/user/authorized_keys_entry", ["GET"], auth=None,
+       summary="Manager public key for ~/.ssh/authorized_keys", tag="auth",
+       responses={200: obj(required=["authorizedKeysEntry"],
+                           authorizedKeysEntry=s("string"))})
+def authorized_keys_entry(context: RequestContext):
+    from ..config import get_config
+    from ..core.transport.ssh import generate_keypair
+
+    pubkey = generate_keypair(get_config().ssh_key_path)
+    return {"authorizedKeysEntry": pubkey}

@@ -1,0 +1,2 @@
+"""Core runtime: transports, process nursery, telemetry, daemon services
+(reference: tensorhive/core/)."""

@@ -1,0 +1,156 @@
+"""Interactive account creation (CLI helper).
+
+Reference: tensorhive/core/utils/AccountCreator.py:25-139 — ``run_prompt``
+loops prompting for username/email/password/role, re-asks on validation
+errors instead of aborting, supports creating several accounts in one
+sitting (``create user --multiple``), and on first use bootstraps the
+default group plus the global "can always use everything" restriction
+(``_check_restrictions`` :113-139).
+
+The prompt/confirm/echo callables are injected so the loop is unit-testable
+without a TTY (the reference's interactive path was untested, SURVEY.md §4).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Callable, List, Optional
+
+from ..db.models.restriction import Restriction
+from ..db.models.user import Group, User
+from ..utils.exceptions import ValidationError
+from ..utils.timeutils import utcnow
+
+log = logging.getLogger(__name__)
+
+
+def ensure_default_group_bootstrap(echo: Callable[[str], None] = log.info) -> Optional[Group]:
+    """First-run bootstrap: a default group every new user auto-joins, tied
+    to a global permissive restriction (reference
+    AccountCreator._check_restrictions:113-139). Idempotent."""
+    defaults = Group.get_default_groups()
+    if defaults:
+        return defaults[0]
+    group = Group(name="users", is_default=True).save()
+    restriction = Restriction(
+        name="default: everything allowed", starts_at=utcnow(), is_global=True
+    ).save()
+    restriction.apply_to_group(group)
+    echo("created default group with a permissive global restriction")
+    return group
+
+
+class AccountCreator:
+    """Looped interactive account setup with per-field validation retry."""
+
+    def __init__(
+        self,
+        prompt: Callable[..., str],
+        confirm: Callable[..., bool],
+        echo: Callable[[str], None],
+        max_attempts_per_field: int = 5,
+    ) -> None:
+        self.prompt = prompt
+        self.confirm = confirm
+        self.echo = echo
+        self.max_attempts = max_attempts_per_field
+
+    # -- single-account creation (shared with `init` / non-interactive path) --
+    @staticmethod
+    def create_account(username: str, email: str, password: str, admin: bool = False) -> User:
+        import sqlite3
+
+        try:
+            user = User(username=username, email=email, password=password).save()
+        except sqlite3.IntegrityError as exc:
+            # duplicate username racing past the prompt-time check — surface
+            # it as the same error type the validators use, so both the CLI
+            # and the interactive loop show a message instead of a traceback
+            raise ValidationError(f"username {username!r} is already taken") from exc
+        user.add_role("user")
+        if admin:
+            user.add_role("admin")
+        for group in Group.get_default_groups():
+            group.add_user(user)
+        return user
+
+    # -- interactive loop (reference run_prompt :25-111) ----------------------
+    def run_prompt(
+        self,
+        multiple: bool = False,
+        username: Optional[str] = None,
+        email: Optional[str] = None,
+        password: Optional[str] = None,
+        admin: Optional[bool] = None,
+    ) -> List[User]:
+        """Prompt for one account (or several with ``multiple``); invalid
+        field values re-prompt instead of aborting the whole flow.
+        Pre-supplied ``username``/``email``/``password`` values are tried
+        before prompting (partial CLI flags); ``admin=True`` skips the role
+        question (``--admin`` on the interactive path). Presets apply to
+        the first account only when looping."""
+        ensure_default_group_bootstrap(self.echo)
+        created: List[User] = []
+        while True:
+            user = self._prompt_one(username, email, password, admin)
+            username = email = password = None  # presets are single-use
+            if user is not None:
+                created.append(user)
+                self.echo(f"user {user.username!r} created")
+            if not multiple or not self.confirm("create another account?", default=False):
+                return created
+
+    def _prompt_one(
+        self,
+        preset_username: Optional[str] = None,
+        preset_email: Optional[str] = None,
+        preset_password: Optional[str] = None,
+        admin: Optional[bool] = None,
+    ) -> Optional[User]:
+        username = self._prompt_valid("username", User.validate_username,
+                                      preset=preset_username)
+        if username is None:
+            return None
+        email = self._prompt_valid("email", User.validate_email, preset=preset_email)
+        if email is None:
+            return None
+        password = self._prompt_valid(
+            "password",
+            User.validate_password,
+            preset=preset_password,
+            hide_input=True,
+            confirmation_prompt=True,
+        )
+        if password is None:
+            return None
+        if admin is None:
+            admin = self.confirm("grant admin role?", default=False)
+        try:
+            return self.create_account(username, email, password, admin)
+        except ValidationError as exc:
+            # e.g. username/email raced into existence since the field check
+            self.echo(f"cannot create account: {exc}")
+            return None
+
+    def _prompt_valid(
+        self,
+        field: str,
+        validator: Callable[[str], None],
+        preset: Optional[str] = None,
+        **prompt_kwargs,
+    ) -> Optional[str]:
+        """Ask until the validator passes (reference re-asks per field rather
+        than restarting, AccountCreator.py:45-78); give up after
+        ``max_attempts`` so a scripted stdin can't loop forever. A ``preset``
+        (CLI flag value) is validated first without consuming a prompt."""
+        for attempt in range(self.max_attempts):
+            if attempt == 0 and preset is not None:
+                value = preset
+            else:
+                value = self.prompt(field, **prompt_kwargs)
+            try:
+                validator(value)
+                return value
+            except ValidationError as exc:
+                self.echo(f"invalid {field}: {exc}")
+        self.echo(f"too many invalid attempts for {field}; aborting this account")
+        return None

@@ -1,0 +1,13 @@
+"""Violation handlers (reference: tensorhive/core/violation_handlers/)."""
+from .base import ProtectionHandler, Violation
+from .email import EmailSendingBehaviour
+from .kill import ProcessKillingBehaviour
+from .message import MessageSendingBehaviour
+
+__all__ = [
+    "ProtectionHandler",
+    "Violation",
+    "MessageSendingBehaviour",
+    "EmailSendingBehaviour",
+    "ProcessKillingBehaviour",
+]

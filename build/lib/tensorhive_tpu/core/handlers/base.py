@@ -1,0 +1,48 @@
+"""Violation record + handler interface.
+
+Reference: tensorhive/core/violation_handlers/ProtectionHandler.py:1-8 (an
+indirection wrapping ``trigger_action``) and the per-intruder violation dict
+ProtectionService aggregates (GPUS / OWNERS / SSH_CONNECTIONS /
+VIOLATION_PIDS, ProtectionService.py:55-78). The dict becomes a typed
+dataclass here.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List
+
+
+@dataclasses.dataclass
+class Violation:
+    """Everything known about one intruder's trespass, aggregated across
+    hosts/chips for a single protection tick."""
+
+    intruder_username: str
+    #: chip uids the intruder's processes occupy
+    chip_uids: List[str] = dataclasses.field(default_factory=list)
+    #: usernames of the reservation owners being violated (empty when the
+    #: violation is "unreserved use" in strict mode)
+    owner_usernames: List[str] = dataclasses.field(default_factory=list)
+    #: hostname -> intruding PIDs on that host
+    pids_by_host: Dict[str, List[int]] = dataclasses.field(default_factory=dict)
+    #: True when no reservation exists at all (strict-mode violation)
+    unreserved: bool = False
+
+    @property
+    def hostnames(self) -> List[str]:
+        return list(self.pids_by_host)
+
+    @property
+    def all_pids(self) -> List[int]:
+        return [pid for pids in self.pids_by_host.values() for pid in pids]
+
+
+class ProtectionHandler:
+    """Strategy interface (reference ProtectionHandler.trigger_action)."""
+
+    def begin_tick(self) -> None:
+        """Called once per protection tick before any trigger_action —
+        the boundary for per-tick budgets (e.g. the email cap)."""
+
+    def trigger_action(self, violation: Violation) -> None:
+        raise NotImplementedError

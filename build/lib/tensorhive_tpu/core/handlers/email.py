@@ -1,0 +1,101 @@
+"""Email notification handler with per-recipient rate limiting.
+
+Reference: tensorhive/core/violation_handlers/EmailSendingBehaviour.py:27-156
+— emails the intruder and/or admin using HTML templates, keeps a
+``LastEmailTime`` timer per recipient so one trespass doesn't flood a
+mailbox, bounds the per-tick send count (MAX_EMAILS_PER_PROTECTION_INTERVAL),
+and re-tests the SMTP configuration on every trigger.
+"""
+from __future__ import annotations
+
+import logging
+import time as time_module
+from typing import Dict, Optional
+
+from ...config import MailbotConfig, get_config
+from ..mailer import (
+    ADMIN_EMAIL_TEMPLATE,
+    INTRUDER_EMAIL_TEMPLATE,
+    Mailer,
+    Message,
+    MessageBodyTemplater,
+)
+from .base import ProtectionHandler, Violation
+
+log = logging.getLogger(__name__)
+
+
+class EmailSendingBehaviour(ProtectionHandler):
+    def __init__(self, config: Optional[MailbotConfig] = None,
+                 mailer: Optional[Mailer] = None) -> None:
+        self.config = config or get_config().mailbot
+        self.mailer = mailer or Mailer(self.config)
+        #: recipient email -> monotonic time of last send
+        self._last_sent: Dict[str, float] = {}
+        #: emails sent since the last begin_tick (cap boundary: one
+        #: protection tick spans MANY trigger_action calls — one per intruder)
+        self._sent_this_tick = 0
+
+    def begin_tick(self) -> None:
+        self._sent_this_tick = 0
+
+    # -- rate limiting (reference LastEmailTime timers) ---------------------
+    def _may_send(self, recipient: str) -> bool:
+        if self._sent_this_tick >= self.config.max_emails_per_interval:
+            return False
+        last = self._last_sent.get(recipient)
+        return last is None or (
+            time_module.monotonic() - last >= self.config.interval_between_notifications_s
+        )
+
+    def _mark_sent(self, recipient: str) -> None:
+        self._last_sent[recipient] = time_module.monotonic()
+        self._sent_this_tick += 1
+
+    # ----------------------------------------------------------------------
+    def trigger_action(self, violation: Violation) -> None:
+        pending = self._gather_notifications(violation)
+        if not pending:
+            return
+        try:
+            self.mailer.connect()
+            for message in pending:
+                self.mailer.send(message)
+                for recipient in message.to:
+                    self._mark_sent(recipient)
+                log.info("violation email sent to %s", message.to)
+        except Exception as exc:  # smtplib raises many types; never kill the tick
+            log.error("sending violation emails failed: %s", exc)
+        finally:
+            self.mailer.disconnect()
+
+    def _gather_notifications(self, violation: Violation):
+        from ...db.models.user import User
+
+        slots = {
+            "intruder_username": violation.intruder_username,
+            "pids": ", ".join(str(p) for p in violation.all_pids),
+            "chips": ", ".join(violation.chip_uids),
+            "owners": ", ".join(violation.owner_usernames) or "(unreserved)",
+        }
+        author = self.config.smtp_login or "tpuhive@localhost"
+        messages = []
+        if self.config.notify_intruder:
+            intruder = User.find_by_username(violation.intruder_username)
+            if intruder is not None and intruder.email and self._may_send(intruder.email):
+                messages.append(Message(
+                    author, [intruder.email],
+                    "TPU reservation violation",
+                    MessageBodyTemplater(INTRUDER_EMAIL_TEMPLATE).fill_in(slots),
+                ))
+            elif intruder is None:
+                log.info("intruder %s has no account; cannot email them",
+                         violation.intruder_username)
+        if self.config.notify_admin and self.config.admin_email:
+            if self._may_send(self.config.admin_email):
+                messages.append(Message(
+                    author, [self.config.admin_email],
+                    f"TPU violation by {violation.intruder_username}",
+                    MessageBodyTemplater(ADMIN_EMAIL_TEMPLATE).fill_in(slots),
+                ))
+        return messages

@@ -1,0 +1,46 @@
+"""Process-killing handlers.
+
+Reference: UserProcessKillingBehaviour.py:8-31 (SSH **as the intruder** —
+their authorized_keys must contain the manager key — then plain ``kill``)
+and SudoProcessKillingBehaviour.py:9-30 (manager account + ``sudo kill``,
+config kill_processes=2).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ...utils.exceptions import TransportError
+from ..nursery import OpsFactory, get_ops_factory
+from .base import ProtectionHandler, Violation
+
+log = logging.getLogger(__name__)
+
+
+class ProcessKillingBehaviour(ProtectionHandler):
+    """``sudo=False``: connect as the intruder and kill their PIDs (works
+    only for accounts that installed the manager key). ``sudo=True``:
+    connect as the manager account and ``sudo kill``."""
+
+    def __init__(self, sudo: bool = False, ops_factory: Optional[OpsFactory] = None) -> None:
+        self.sudo = sudo
+        self._factory = ops_factory
+
+    @property
+    def factory(self) -> OpsFactory:
+        return self._factory or get_ops_factory()
+
+    def trigger_action(self, violation: Violation) -> None:
+        for hostname, pids in violation.pids_by_host.items():
+            user = None if self.sudo else violation.intruder_username
+            try:
+                ops = self.factory.ops_for(hostname, user=user)
+                for pid in pids:
+                    killed = ops.kill_pid(pid, sig=9, sudo=self.sudo)
+                    log.info(
+                        "%s pid %d of %s on %s",
+                        "killed" if killed else "failed to kill",
+                        pid, violation.intruder_username, hostname,
+                    )
+            except TransportError as exc:
+                log.warning("kill handler failed on %s: %s", hostname, exc)

@@ -1,0 +1,58 @@
+"""PTY warning handler.
+
+Reference: tensorhive/core/violation_handlers/MessageSendingBehaviour.py:10-89
+— list the host's interactive sessions via ``who``, filter to the intruder's
+TTYs, and write one warning onto each (merged into a single remote command).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from ...utils.exceptions import TransportError
+from ..nursery import OpsFactory, get_ops_factory
+from .base import ProtectionHandler, Violation
+
+log = logging.getLogger(__name__)
+
+WARNING_TEMPLATE = (
+    "[tpuhive] Your processes (PIDs: {pids}) violate a TPU reservation "
+    "held by {owners} on chips {chips}. Please terminate them — they may "
+    "be killed automatically."
+)
+UNRESERVED_TEMPLATE = (
+    "[tpuhive] Your processes (PIDs: {pids}) occupy TPU chips {chips} "
+    "without a reservation. Reserve the chips or stop the processes."
+)
+
+
+class MessageSendingBehaviour(ProtectionHandler):
+    def __init__(self, ops_factory: Optional[OpsFactory] = None) -> None:
+        self._factory = ops_factory
+
+    @property
+    def factory(self) -> OpsFactory:
+        return self._factory or get_ops_factory()
+
+    def get_warning_message(self, violation: Violation) -> str:
+        template = UNRESERVED_TEMPLATE if violation.unreserved else WARNING_TEMPLATE
+        return template.format(
+            pids=", ".join(str(p) for p in violation.all_pids),
+            owners=", ".join(violation.owner_usernames) or "another user",
+            chips=", ".join(violation.chip_uids),
+        )
+
+    def trigger_action(self, violation: Violation) -> None:
+        message = self.get_warning_message(violation)
+        for hostname in violation.hostnames:
+            try:
+                ops = self.factory.ops_for(hostname)
+                ttys = [
+                    tty for user, tty in ops.pty_sessions()
+                    if user == violation.intruder_username
+                ]
+                if ttys:
+                    ops.write_to_ptys(ttys, message)
+            except TransportError as exc:
+                log.warning("could not warn %s on %s: %s",
+                            violation.intruder_username, hostname, exc)

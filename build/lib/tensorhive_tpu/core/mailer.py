@@ -1,0 +1,112 @@
+"""SMTP mailer + message templating.
+
+Reference: tensorhive/core/utils/mailer.py — ``Message`` MIME builder (:11),
+``MessageBodyTemplater.fill_in`` with {gpus}/{intruder_username}/... slots
+(:51), ``Mailer`` SMTP(+STARTTLS) wrapper (:64). Same shape, TPU-flavored
+template variables ({chips} instead of {gpus}).
+"""
+from __future__ import annotations
+
+import logging
+import smtplib
+from email.mime.multipart import MIMEMultipart
+from email.mime.text import MIMEText
+from typing import Dict, List, Optional
+
+from ..config import MailbotConfig
+
+log = logging.getLogger(__name__)
+
+
+class Message:
+    """One MIME email (reference mailer.py:11-48)."""
+
+    def __init__(self, author: str, to: List[str], subject: str, body: str) -> None:
+        self.author = author
+        self.to = list(to)
+        self.subject = subject
+        self.body = body
+
+    def as_mime(self) -> MIMEMultipart:
+        mime = MIMEMultipart("alternative")
+        mime["From"] = self.author
+        mime["To"] = ", ".join(self.to)
+        mime["Subject"] = self.subject
+        mime.attach(MIMEText(self.body, "html"))
+        return mime
+
+
+class MessageBodyTemplater:
+    """Fill named slots in an HTML template (reference mailer.py:51-61)."""
+
+    def __init__(self, template: str) -> None:
+        self.template = template
+
+    def fill_in(self, values: Dict[str, str]) -> str:
+        body = self.template
+        for key, value in values.items():
+            body = body.replace("{%s}" % key, str(value))
+        return body
+
+
+INTRUDER_EMAIL_TEMPLATE = """\
+<html><body>
+<p>Hello {intruder_username},</p>
+<p>Your processes (PIDs: {pids}) are running on TPU chips <b>{chips}</b>
+which are currently reserved by <b>{owners}</b>.</p>
+<p>Please terminate them or move to unreserved chips — otherwise they may be
+killed by the protection service.</p>
+<p>— tpuhive</p>
+</body></html>
+"""
+
+ADMIN_EMAIL_TEMPLATE = """\
+<html><body>
+<p>Reservation violation detected:</p>
+<ul>
+<li>intruder: <b>{intruder_username}</b></li>
+<li>chips: {chips}</li>
+<li>PIDs: {pids}</li>
+<li>reservation owners: {owners}</li>
+</ul>
+</body></html>
+"""
+
+
+class Mailer:
+    """Thin SMTP client (reference mailer.py:64-86)."""
+
+    def __init__(self, config: MailbotConfig) -> None:
+        self.config = config
+        self._server: Optional[smtplib.SMTP] = None
+
+    def connect(self) -> None:
+        cfg = self.config
+        self._server = smtplib.SMTP(cfg.smtp_server, cfg.smtp_port, timeout=15)
+        self._server.starttls()
+        if cfg.smtp_login:
+            self._server.login(cfg.smtp_login, cfg.smtp_password)
+
+    def send(self, message: Message) -> None:
+        assert self._server is not None, "connect() first"
+        self._server.sendmail(message.author, message.to, message.as_mime().as_string())
+
+    def disconnect(self) -> None:
+        if self._server is not None:
+            try:
+                self._server.quit()
+            except smtplib.SMTPException:
+                pass
+            self._server = None
+
+    def test_configuration(self) -> bool:
+        """Connectivity self-test run before each batch (reference
+        EmailSendingBehaviour tests SMTP config every trigger)."""
+        try:
+            self.connect()
+            return True
+        except (smtplib.SMTPException, OSError) as exc:
+            log.error("SMTP configuration test failed: %s", exc)
+            return False
+        finally:
+            self.disconnect()

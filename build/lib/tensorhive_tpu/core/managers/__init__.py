@@ -1,0 +1,4 @@
+"""Core runtime managers (reference: tensorhive/core/managers/)."""
+from .infrastructure import InfrastructureManager
+
+__all__ = ["InfrastructureManager"]

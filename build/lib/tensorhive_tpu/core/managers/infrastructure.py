@@ -1,0 +1,129 @@
+"""In-memory latest-telemetry store shared across services and the API.
+
+Reference: tensorhive/core/managers/InfrastructureManager.py:8-78 — a plain
+dict ``{host: {'GPU': {uuid: {...}}, 'CPU': {...}}}`` written by the monitor
+thread and read by the API/protection/scheduler threads *without locks*,
+relying on ``deepcopy`` on the read path (controllers/nodes.py:15). SURVEY.md
+§7 flags that implicit contract as a thing to re-implement deliberately: here
+every access goes through an RW lock and readers get deep copies, so torn
+reads are impossible by construction rather than by CPython luck.
+
+Node shape (TPU-flavored)::
+
+    {host: {"TPU": {chip_uid: {"uid", "index", "hostname",
+                               "accelerator_type", "hbm_used_mib",
+                               "hbm_total_mib", "hbm_util_pct",
+                               "duty_cycle_pct", "processes": [
+                                   {"pid", "user", "command"}]}},
+            "CPU": {f"CPU_{host}": {"util_pct", "mem_total_mib",
+                                     "mem_used_mib", "mem_util_pct"}}}}
+
+Chip UIDs are ``{hostname}:tpu:{index}`` — globally unique and stable across
+reboots, playing the role the 40-char GPU UUID plays in the reference
+(models/Reservation.py:54 asserts on it; here Resource rows store this uid).
+"""
+from __future__ import annotations
+
+import copy
+from typing import Dict, List, Optional
+
+from ...utils.threading import RWLock
+
+#: executable basenames never treated as foreign/intruding (reference
+#: InfrastructureManager.ignored_processes: Xorg and friends; the TPU
+#: equivalents are the platform daemons that idle-hold devices). Matching is
+#: on the exact basename of argv[0] — substring matching over the command
+#: line would let any user process exempt itself from intruder detection by
+#: putting an ignored name in its arguments.
+DEFAULT_IGNORED_PROCESSES = (
+    "tpu-runtime",
+    "tpuhive-probe",
+)
+
+
+def chip_uid(hostname: str, index: int) -> str:
+    return f"{hostname}:tpu:{index}"
+
+
+class InfrastructureManager:
+    """Thread-safe latest-metrics store; monitors replace whole per-host
+    subtrees, readers receive snapshots."""
+
+    def __init__(self, hostnames: Optional[List[str]] = None) -> None:
+        self._lock = RWLock()
+        self._infra: Dict[str, Dict] = {name: {} for name in (hostnames or [])}
+        self.ignored_processes: List[str] = list(DEFAULT_IGNORED_PROCESSES)
+
+    # -- write path (monitors) ---------------------------------------------
+    def update_subtree(self, hostname: str, key: str, subtree: Dict) -> None:
+        """Atomically replace one monitor's subtree for one host (reference
+        monitors assign whole ``['GPU']`` dicts, GPUMonitor.py:92)."""
+        with self._lock.write():
+            self._infra.setdefault(hostname, {})[key] = subtree
+
+    def mark_unreachable(self, hostname: str, key: str) -> None:
+        """Drop a host's subtree when it stops responding so stale telemetry
+        is never mistaken for live (the reference leaves the last values in
+        place indefinitely — a known sharp edge)."""
+        with self._lock.write():
+            node = self._infra.get(hostname)
+            if node is not None:
+                node.pop(key, None)
+
+    # -- read path ----------------------------------------------------------
+    @property
+    def infrastructure(self) -> Dict[str, Dict]:
+        """Deep-copied snapshot of everything."""
+        with self._lock.read():
+            return copy.deepcopy(self._infra)
+
+    def node(self, hostname: str) -> Dict:
+        with self._lock.read():
+            return copy.deepcopy(self._infra.get(hostname, {}))
+
+    @property
+    def hostnames(self) -> List[str]:
+        with self._lock.read():
+            return list(self._infra)
+
+    # -- process queries (reference InfrastructureManager.py:34-78) ---------
+    def node_tpu_processes(self, hostname: str) -> Dict[str, List[Dict]]:
+        """``{chip_uid: [process, ...]}`` for one host, ignored processes
+        filtered out (reference node_gpu_processes)."""
+        with self._lock.read():
+            chips = self._infra.get(hostname, {}).get("TPU", {})
+            result: Dict[str, List[Dict]] = {}
+            for uid, chip in chips.items():
+                procs = [
+                    copy.deepcopy(p)
+                    for p in chip.get("processes", [])
+                    if not self._ignored(p.get("command", ""))
+                ]
+                result[uid] = procs
+            return result
+
+    def all_nodes_with_tpu_processes(self) -> Dict[str, Dict[str, List[Dict]]]:
+        """Reference InfrastructureManager.all_nodes_with_gpu_processes:63."""
+        return {host: self.node_tpu_processes(host) for host in self.hostnames}
+
+    def find_chip(self, uid: str) -> Optional[Dict]:
+        """Locate a chip's metrics dict by uid across all hosts."""
+        with self._lock.read():
+            for node in self._infra.values():
+                chip = node.get("TPU", {}).get(uid)
+                if chip is not None:
+                    return copy.deepcopy(chip)
+        return None
+
+    def find_chip_hostname(self, uid: str) -> Optional[str]:
+        """Reference InfrastructureManager.get_gpu_uid inverse lookup."""
+        with self._lock.read():
+            for hostname, node in self._infra.items():
+                if uid in node.get("TPU", {}):
+                    return hostname
+        return None
+
+    def _ignored(self, command: str) -> bool:
+        argv0 = command.split()[0] if command.split() else ""
+        basename = argv0.rsplit("/", 1)[-1]
+        return basename in self.ignored_processes

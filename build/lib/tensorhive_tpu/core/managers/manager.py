@@ -1,0 +1,117 @@
+"""TpuHiveManager: the composition root.
+
+Reference: tensorhive/core/managers/TensorHiveManager.py:33-125 — a Singleton
+that builds the infrastructure + SSH managers, instantiates enabled services
+from config, and starts/stops them (wired from cli.py:111-148). Here the
+singleton is an explicit module-level accessor (set in one place at boot,
+resettable in tests) rather than a metaclass, and service construction is a
+plain factory function so tests can compose managers with fakes directly.
+"""
+from __future__ import annotations
+
+import logging
+import threading
+from typing import List, Optional
+
+from ...config import Config, get_config
+from ..services.base import Service
+from ..services.monitoring import MonitoringService
+from ..transport.base import TransportManager
+from .infrastructure import InfrastructureManager
+from .service_manager import ServiceManager
+
+log = logging.getLogger(__name__)
+
+
+class TpuHiveManager:
+    def __init__(
+        self,
+        config: Optional[Config] = None,
+        transport_manager: Optional[TransportManager] = None,
+        services: Optional[List[Service]] = None,
+    ) -> None:
+        self.config = config or get_config()
+        self.infrastructure_manager = InfrastructureManager(list(self.config.hosts))
+        self.transport_manager = transport_manager or TransportManager(self.config)
+        self.service_manager: Optional[ServiceManager] = None
+        self._services_override = services
+        self._started = False
+
+    # -- boot sequence (reference TensorHiveManager.__init__ + cli.main) ----
+    def test_connectivity(self) -> dict:
+        """Probe every managed host (reference test_ssh, :47-69)."""
+        return self.transport_manager.test_all_connections()
+
+    def configure_services_from_config(self) -> None:
+        services = (
+            self._services_override
+            if self._services_override is not None
+            else instantiate_services_from_config(self.config)
+        )
+        self.service_manager = ServiceManager(
+            services, self.infrastructure_manager, self.transport_manager
+        )
+        self.service_manager.configure_all_services()
+
+    def init(self) -> None:
+        if self.service_manager is None:
+            self.configure_services_from_config()
+        assert self.service_manager is not None
+        if self.config.monitoring.deploy_native_probe and self.config.hosts:
+            from ..monitors.deploy import deploy_probe
+
+            statuses = deploy_probe(self.transport_manager)
+            deployed = sum(statuses.values())
+            log.info("native probe deployed to %d/%d hosts", deployed, len(statuses))
+        self.service_manager.start_all_services()
+        self._started = True
+
+    def shutdown(self) -> None:
+        if self.service_manager is not None and self._started:
+            self.service_manager.shutdown_all_services()
+        self.transport_manager.close()
+        self._started = False
+
+
+def instantiate_services_from_config(config: Config) -> List[Service]:
+    """Build enabled services (reference
+    TensorHiveManager.instantiate_services_from_config:71-110). Imports are
+    local so optional subsystems don't pay import costs when disabled."""
+    services: List[Service] = []
+    if config.monitoring.enabled:
+        services.append(MonitoringService(config=config))
+    if config.protection.enabled:
+        from ..services.protection import ProtectionService
+
+        services.append(ProtectionService(config=config))
+    if config.usage_logging.enabled:
+        from ..services.usage_logging import UsageLoggingService
+
+        services.append(UsageLoggingService(config=config))
+    if config.job_scheduling.enabled:
+        from ..services.job_scheduling import JobSchedulingService
+
+        services.append(JobSchedulingService(config=config))
+    return services
+
+
+
+# ---------------------------------------------------------------------------
+_instance: Optional[TpuHiveManager] = None
+_instance_lock = threading.Lock()
+
+
+def get_manager() -> TpuHiveManager:
+    """Process-wide manager (reference Singleton metaclass,
+    core/utils/Singleton.py:4-11); built lazily, replaceable in tests."""
+    global _instance
+    with _instance_lock:
+        if _instance is None:
+            _instance = TpuHiveManager()
+        return _instance
+
+
+def set_manager(manager: Optional[TpuHiveManager]) -> None:
+    global _instance
+    with _instance_lock:
+        _instance = manager

@@ -1,0 +1,51 @@
+"""ServiceManager: owns the daemon service threads.
+
+Reference: tensorhive/core/managers/ServiceManager.py (29 LoC) — holds the
+services, injects the shared managers into each, starts/stops all.
+"""
+from __future__ import annotations
+
+import logging
+from typing import TYPE_CHECKING, List, Optional
+
+if TYPE_CHECKING:
+    from ..services.base import Service
+    from ..transport.base import TransportManager
+    from .infrastructure import InfrastructureManager
+
+log = logging.getLogger(__name__)
+
+
+class ServiceManager:
+    def __init__(
+        self,
+        services: List["Service"],
+        infrastructure_manager: "InfrastructureManager",
+        transport_manager: "TransportManager",
+    ) -> None:
+        self.services = services
+        self.infrastructure_manager = infrastructure_manager
+        self.transport_manager = transport_manager
+
+    def configure_all_services(self) -> None:
+        for service in self.services:
+            service.inject(self.infrastructure_manager, self.transport_manager)
+
+    def start_all_services(self) -> None:
+        for service in self.services:
+            log.info("starting %s (interval %.1fs)", service.name, service.interval_s)
+            service.start()
+
+    def shutdown_all_services(self, join_timeout_s: float = 5.0) -> None:
+        for service in self.services:
+            service.shutdown()
+        for service in self.services:
+            service.join(timeout=join_timeout_s)
+            if service.is_alive():
+                log.warning("%s did not stop within %.1fs", service.name, join_timeout_s)
+
+    def service(self, cls: type) -> Optional["Service"]:
+        for service in self.services:
+            if isinstance(service, cls):
+                return service
+        return None

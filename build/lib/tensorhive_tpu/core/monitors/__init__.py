@@ -1,0 +1,6 @@
+"""Telemetry monitors (reference: tensorhive/core/monitors/)."""
+from .base import Monitor
+from .cpu import CpuMonitor
+from .tpu import TpuMonitor
+
+__all__ = ["Monitor", "CpuMonitor", "TpuMonitor"]

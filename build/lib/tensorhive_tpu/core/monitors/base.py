@@ -1,0 +1,30 @@
+"""Monitor strategy interface.
+
+Reference: tensorhive/core/monitors/Monitor.py:5-13 — ``update(connection,
+infrastructure_manager)`` run by MonitoringService against all hosts each
+tick. Same shape here, with the group SSH client generalized to the
+:class:`TransportManager` fan-out.
+"""
+from __future__ import annotations
+
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:
+    from ..managers.infrastructure import InfrastructureManager
+    from ..transport.base import TransportManager
+
+
+class Monitor:
+    """One telemetry dimension (TPU chips, CPU/RAM) polled per tick."""
+
+    #: subtree key this monitor owns inside each node's infra dict
+    key: str = ""
+
+    def update(self, transports: "TransportManager", infra: "InfrastructureManager") -> None:
+        """Poll all reachable hosts and write per-host subtrees into ``infra``.
+
+        Must isolate per-host failures: one unreachable host may not prevent
+        the others from updating (reference ``stop_on_errors=False``,
+        GPUMonitor.py:77).
+        """
+        raise NotImplementedError

@@ -1,0 +1,80 @@
+"""CPU/RAM monitor.
+
+Reference: tensorhive/core/monitors/CPUMonitor.py:7-37 — an ``awk`` over
+``/proc/stat`` plus ``free -m`` per host per tick, stored under a
+``CPU_{host}`` pseudo-UUID. Here the counters arrive for free inside the TPU
+probe's single round-trip (probe.py), so this monitor consumes the
+:class:`TpuMonitor`'s last samples instead of issuing its own commands; when
+running standalone (TPU monitoring disabled) it falls back to fanning the
+probe out itself.
+
+CPU utilization derives from jiffy deltas between consecutive ticks — the
+reference instead burned a 1-second remote ``sleep`` inside awk on every
+tick to sample twice (CPUMonitor.py:10-14); diffing across ticks costs
+nothing and is exact over the tick interval.
+"""
+from __future__ import annotations
+
+import logging
+from typing import TYPE_CHECKING, Dict, Optional, Tuple
+
+from .base import Monitor
+from .probe import ProbeSample, collect_probe_samples, probe_command
+from .tpu import TpuMonitor
+
+if TYPE_CHECKING:
+    from ..managers.infrastructure import InfrastructureManager
+    from ..transport.base import TransportManager
+
+log = logging.getLogger(__name__)
+
+
+class CpuMonitor(Monitor):
+    key = "CPU"
+
+    def __init__(self, tpu_monitor: Optional[TpuMonitor] = None) -> None:
+        self._tpu_monitor = tpu_monitor
+        self._command = probe_command()
+        # hostname -> (total_jiffies, idle_jiffies) from the previous tick
+        self._prev: Dict[str, Tuple[int, int]] = {}
+
+    def update(self, transports: "TransportManager", infra: "InfrastructureManager") -> None:
+        samples = self._collect_samples(transports)
+        for hostname, sample in samples.items():
+            if sample is None:
+                infra.mark_unreachable(hostname, self.key)
+                continue
+            infra.update_subtree(hostname, self.key, self._cpu_subtree(hostname, sample))
+
+    # ------------------------------------------------------------------
+    def _collect_samples(self, transports: "TransportManager") -> Dict[str, Optional[ProbeSample]]:
+        if self._tpu_monitor is not None:
+            samples: Dict[str, Optional[ProbeSample]] = dict(self._tpu_monitor.last_samples)
+            for hostname in transports.hostnames:
+                samples.setdefault(hostname, None)
+            return samples
+        return collect_probe_samples(transports, self._command)
+
+    def _cpu_subtree(self, hostname: str, sample: ProbeSample) -> Dict[str, Dict]:
+        util_pct = None
+        if sample.cpu_total is not None and sample.cpu_idle is not None:
+            prev = self._prev.get(hostname)
+            self._prev[hostname] = (sample.cpu_total, sample.cpu_idle)
+            if prev is not None:
+                d_total = sample.cpu_total - prev[0]
+                d_idle = sample.cpu_idle - prev[1]
+                if d_total > 0:
+                    util_pct = round(100.0 * (d_total - d_idle) / d_total, 1)
+        mem_total_mib = sample.mem_total_kb // 1024
+        mem_used_mib = max(0, (sample.mem_total_kb - sample.mem_avail_kb) // 1024)
+        return {
+            f"CPU_{hostname}": {
+                "name": f"CPU {hostname}",
+                "ncpu": sample.ncpu,
+                "util_pct": util_pct,
+                "mem_total_mib": mem_total_mib,
+                "mem_used_mib": mem_used_mib,
+                "mem_util_pct": round(100.0 * mem_used_mib / mem_total_mib, 1)
+                if mem_total_mib else None,
+            }
+        }

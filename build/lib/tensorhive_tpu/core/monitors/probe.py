@@ -1,0 +1,308 @@
+"""Node telemetry probe: one remote round-trip per host per tick.
+
+This replaces the reference's telemetry shell layer, which cost *many* SSH
+round-trips per host per tick: one ``nvidia-smi --query-gpu`` fan-out, one
+``nvidia-smi pmon`` script per host, then **one ``ps`` call per running
+process** (flagged "hot spot" in SURVEY.md §3.2; GPUMonitor.py:77-107). Here
+a single self-describing probe executes on the managed host and emits one
+JSON line covering everything: accelerator devices, holder PIDs, process
+owners/commands, CPU jiffies, and memory — so a monitoring tick is exactly
+one command per host.
+
+Two interchangeable probe implementations emit the same schema:
+
+* ``tpuhive-probe`` — native C++ binary (native/probe.cpp), preferred; it is
+  the TPU-native analog of the reference's nvidia-smi dependency (SURVEY.md
+  §2: the telemetry reader is where the native-component requirement bites).
+* an inline Python 3 script (below), used automatically when the binary is
+  not installed on the host — TPU VMs always ship python3.
+
+Probe JSON schema (version 1)::
+
+    {"v": 1,
+     "chips":   [{"index": 0, "dev": "/dev/accel0", "pids": [123, ...]}, ...],
+     "procs":   {"123": {"user": "alice", "cmd": "python train.py"}, ...},
+     "cpu":     {"total": <jiffies>, "idle": <jiffies>, "ncpu": 8},
+     "mem":     {"total_kb": N, "avail_kb": N},
+     "metrics": {"0": {"hbm_used_bytes": N, "hbm_total_bytes": N,
+                       "duty_cycle_pct": F, "age_s": F}, ...}}
+
+``chips`` come from accelerator device nodes (``/dev/accel*`` on TPU VMs,
+``/dev/vfio/N`` on older stacks); holder PIDs from a ``/proc/*/fd`` scan —
+the TPU analog of ``nvidia-smi pmon`` given that a TPU chip is held by one
+process via the libtpu lock (SURVEY.md §7 "process adoption" risk).
+``metrics`` are runtime counters (HBM occupancy, duty cycle) read from
+``~/.tpuhive/metrics/*.json`` drop-files refreshed by the workload-side
+telemetry emitter (tensorhive_tpu/telemetry) — the OS exposes no HBM
+counters, so the runtime publishes them; stale files (>120 s) are marked via
+``age_s`` and ignored by the monitor.
+"""
+from __future__ import annotations
+
+import base64
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ...utils.exceptions import TelemetryError
+
+PROBE_VERSION = 1
+#: stable marker present in every probe invocation (fake transports match it)
+PROBE_MARKER = "tpuhive_probe"
+#: where `ensure deployed` installs the native binary on managed hosts
+PROBE_REMOTE_PATH = "$HOME/.tpuhive/bin/tpuhive-probe"
+#: drop-file directory for runtime metrics emitted by workloads
+METRICS_DIR = "$HOME/.tpuhive/metrics"
+#: runtime metric drop-files older than this are reported but flagged stale
+METRICS_MAX_AGE_S = 120.0
+
+
+# The fallback probe. Kept dependency-free, Python 3.6+, single JSON line on
+# stdout. Mirrors native/probe.cpp — change both together (schema v1).
+PYTHON_PROBE_SOURCE = r"""
+import glob, json, os, pwd, time
+out = {"v": 1, "chips": [], "procs": {}, "cpu": {}, "mem": {}, "metrics": {},
+       "restricted": 0}
+devs = sorted(glob.glob("/dev/accel[0-9]*")) + sorted(glob.glob("/dev/vfio/[0-9]*"))
+dev_index = {os.path.realpath(d): i for i, d in enumerate(devs)}
+holders = {}
+for pid in filter(str.isdigit, os.listdir("/proc")):
+    fd_dir = "/proc/%s/fd" % pid
+    try:
+        fds = os.listdir(fd_dir)
+    except PermissionError:
+        out["restricted"] += 1
+        continue
+    except OSError:
+        continue
+    for fd in fds:
+        try:
+            target = os.readlink(os.path.join(fd_dir, fd))
+        except OSError:
+            continue
+        if target in dev_index:
+            holders.setdefault(dev_index[target], set()).add(int(pid))
+for i, dev in enumerate(devs):
+    out["chips"].append({"index": i, "dev": dev, "pids": sorted(holders.get(i, ()))})
+pids = set()
+for chip in out["chips"]:
+    pids.update(chip["pids"])
+for pid in pids:
+    try:
+        with open("/proc/%d/cmdline" % pid, "rb") as fh:
+            cmd = fh.read().replace(b"\0", b" ").decode(errors="replace").strip()
+        uid = os.stat("/proc/%d" % pid).st_uid
+        try:
+            user = pwd.getpwuid(uid).pw_name
+        except KeyError:
+            user = str(uid)
+        out["procs"][str(pid)] = {"user": user, "cmd": cmd}
+    except OSError:
+        continue
+try:
+    with open("/proc/stat") as fh:
+        parts = fh.readline().split()[1:]
+    vals = [int(x) for x in parts]
+    out["cpu"] = {"total": sum(vals), "idle": vals[3] + (vals[4] if len(vals) > 4 else 0),
+                  "ncpu": os.cpu_count() or 1}
+except (OSError, IndexError, ValueError):
+    pass
+try:
+    info = {}
+    with open("/proc/meminfo") as fh:
+        for line in fh:
+            key, _, rest = line.partition(":")
+            info[key] = int(rest.split()[0])
+    out["mem"] = {"total_kb": info.get("MemTotal", 0),
+                  "avail_kb": info.get("MemAvailable", info.get("MemFree", 0))}
+except OSError:
+    pass
+mdir = os.environ.get("TPUHIVE_METRICS_DIR") or os.path.expanduser("~/.tpuhive/metrics")
+now = time.time()
+try:
+    names = sorted(os.listdir(mdir))
+except OSError:
+    names = []
+for name in names:
+    if not name.endswith(".json"):
+        continue
+    path = os.path.join(mdir, name)
+    try:
+        age = now - os.stat(path).st_mtime
+        with open(path) as fh:
+            data = json.load(fh)
+    except (OSError, ValueError):
+        continue
+    if not isinstance(data, dict):
+        continue
+    for chip_index, metrics in data.items():
+        if isinstance(metrics, dict):
+            merged = dict(metrics)
+            merged["age_s"] = round(age, 1)
+            out["metrics"][str(chip_index)] = merged
+print(json.dumps(out, separators=(",", ":")))
+""".strip()
+
+
+def probe_command() -> str:
+    """Shell command: run the native probe if installed — privileged via
+    passwordless sudo when available, because /proc/<pid>/fd of *other
+    users'* processes is unreadable without it and chip-ownership data is
+    exactly what the protection service needs (the probe reports how many
+    processes it could not inspect via ``restricted``). Falls back to the
+    inline Python probe when the binary is absent; the base64 wrapper
+    survives any quoting the transport applies."""
+    encoded = base64.b64encode(PYTHON_PROBE_SOURCE.encode()).decode()
+    fallback = (
+        f'python3 -c "import base64 as b;exec(b.b64decode(\'{encoded}\'))"'
+    )
+    # The metrics dir travels as an argv flag, NOT an env assignment: with
+    # default sudoers (no SETENV tag) `sudo -n VAR=... cmd` is rejected
+    # wholesale, which would silently degrade to the unprivileged probe and
+    # leave chip-ownership incomplete. A plain NOPASSWD rule suffices for
+    # this form. $HOME expands in the invoking user's shell before sudo runs.
+    sudo_flags = '--metrics-dir "$HOME/.tpuhive/metrics"'
+    return (
+        f"sudo -n {PROBE_REMOTE_PATH} {sudo_flags} 2>/dev/null "
+        f"|| {PROBE_REMOTE_PATH} 2>/dev/null "
+        f"|| {fallback}  # {PROBE_MARKER}"
+    )
+
+
+@dataclass
+class ChipSample:
+    index: int
+    dev: str = ""
+    pids: List[int] = field(default_factory=list)
+    hbm_used_bytes: Optional[int] = None
+    hbm_total_bytes: Optional[int] = None
+    duty_cycle_pct: Optional[float] = None
+    metrics_age_s: Optional[float] = None
+
+
+@dataclass
+class ProbeSample:
+    """Parsed, validated probe output for one host."""
+
+    chips: List[ChipSample] = field(default_factory=list)
+    procs: Dict[int, Dict[str, str]] = field(default_factory=dict)
+    cpu_total: Optional[int] = None
+    cpu_idle: Optional[int] = None
+    ncpu: int = 1
+    mem_total_kb: int = 0
+    mem_avail_kb: int = 0
+    #: processes whose /proc/<pid>/fd was unreadable (probe unprivileged);
+    #: >0 means chip-ownership data may be incomplete
+    restricted: int = 0
+
+
+def parse_probe_output(text: str) -> ProbeSample:
+    """Parse one probe JSON line (analog of NvidiaSmiParser.parse_query_gpu_
+    stdout + parse_pmon_stdout, tensorhive/core/utils/NvidiaSmiParser.py:101,
+    :151 — both merged into one document here)."""
+    line = _last_json_line(text)
+    try:
+        doc = json.loads(line)
+    except json.JSONDecodeError as exc:
+        raise TelemetryError(f"probe output is not valid JSON: {exc}: {line[:200]!r}")
+    if not isinstance(doc, dict) or doc.get("v") != PROBE_VERSION:
+        raise TelemetryError(f"unsupported probe schema: {doc if isinstance(doc, dict) else type(doc)}")
+    try:
+        return _build_sample(doc)
+    except (KeyError, ValueError, TypeError) as exc:
+        # structurally malformed documents (version-skewed probe binary) must
+        # surface as TelemetryError so per-host isolation in the monitors holds
+        raise TelemetryError(f"malformed probe document: {exc!r}: {line[:200]!r}")
+
+
+def _build_sample(doc: Dict[str, Any]) -> ProbeSample:
+    sample = ProbeSample()
+    metrics = doc.get("metrics") or {}
+    for raw in doc.get("chips") or []:
+        chip = ChipSample(index=int(raw["index"]), dev=str(raw.get("dev", "")),
+                          pids=[int(p) for p in raw.get("pids", [])])
+        chip_metrics = metrics.get(str(chip.index))
+        if isinstance(chip_metrics, dict):
+            age = chip_metrics.get("age_s")
+            chip.metrics_age_s = float(age) if age is not None else None
+            if chip.metrics_age_s is None or chip.metrics_age_s <= METRICS_MAX_AGE_S:
+                chip.hbm_used_bytes = _opt_int(chip_metrics.get("hbm_used_bytes"))
+                chip.hbm_total_bytes = _opt_int(chip_metrics.get("hbm_total_bytes"))
+                chip.duty_cycle_pct = _opt_float(chip_metrics.get("duty_cycle_pct"))
+        sample.chips.append(chip)
+
+    for pid, info in (doc.get("procs") or {}).items():
+        if isinstance(info, dict):
+            sample.procs[int(pid)] = {
+                "user": str(info.get("user", "")),
+                "cmd": str(info.get("cmd", "")),
+            }
+
+    cpu = doc.get("cpu") or {}
+    if "total" in cpu and "idle" in cpu:
+        sample.cpu_total = int(cpu["total"])
+        sample.cpu_idle = int(cpu["idle"])
+        sample.ncpu = int(cpu.get("ncpu", 1) or 1)
+    mem = doc.get("mem") or {}
+    sample.mem_total_kb = int(mem.get("total_kb", 0) or 0)
+    sample.mem_avail_kb = int(mem.get("avail_kb", 0) or 0)
+    sample.restricted = int(doc.get("restricted", 0) or 0)
+    return sample
+
+
+def collect_probe_samples(
+    transports: Any, command: Optional[str] = None
+) -> Dict[str, Optional[ProbeSample]]:
+    """Fan the probe out to every managed host and parse replies; hosts that
+    fail (unreachable or malformed output) map to None — the shared
+    per-host-isolation path of both TpuMonitor and CpuMonitor."""
+    import logging
+
+    log = logging.getLogger(__name__)
+    samples: Dict[str, Optional[ProbeSample]] = {}
+    for hostname, result in transports.run_on_all(command or probe_command()).items():
+        if not result.ok:
+            log.warning("probe failed on %s: %s", hostname,
+                        result.stderr.strip() or f"exit {result.exit_code}")
+            samples[hostname] = None
+            continue
+        try:
+            samples[hostname] = parse_probe_output(result.stdout)
+        except TelemetryError as exc:
+            log.warning("unparseable probe output from %s: %s", hostname, exc)
+            samples[hostname] = None
+    return samples
+
+
+def render_probe_json(
+    chips: List[Dict[str, Any]],
+    procs: Dict[int, Dict[str, str]],
+    cpu: Optional[Dict[str, int]] = None,
+    mem: Optional[Dict[str, int]] = None,
+    metrics: Optional[Dict[str, Dict[str, Any]]] = None,
+) -> str:
+    """Serialize a schema-v1 probe document (used by the fake cluster so
+    tests exercise the real parser path)."""
+    return json.dumps(
+        {"v": PROBE_VERSION, "chips": chips, "procs": {str(k): v for k, v in procs.items()},
+         "cpu": cpu or {}, "mem": mem or {}, "metrics": metrics or {}},
+        separators=(",", ":"),
+    )
+
+
+def _last_json_line(text: str) -> str:
+    """The probe prints exactly one line, but login shells may prepend noise
+    (motd on forced-command setups); take the last line that looks like JSON."""
+    for line in reversed(text.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return line
+    raise TelemetryError(f"no JSON object in probe output: {text[:200]!r}")
+
+
+def _opt_int(value: Any) -> Optional[int]:
+    return None if value is None else int(value)
+
+
+def _opt_float(value: Any) -> Optional[float]:
+    return None if value is None else float(value)

@@ -1,0 +1,98 @@
+"""TPU chip monitor.
+
+Reference: tensorhive/core/monitors/GPUMonitor.py:10-242 — three SSH layers
+per tick (``--query-gpu`` CSV, per-UUID ``pmon`` scripts, one ``ps`` per
+PID). The TPU rebuild collapses all of it into the single-round-trip probe
+(see probe.py) and maps the results onto the exclusive-ownership model of
+TPU chips: a chip's "processes" list is derived from which PIDs hold the
+accelerator device node open — the libtpu lock analog of CUDA contexts
+(SURVEY.md §7, BASELINE.json north_star "inspecting libtpu PIDs instead of
+CUDA contexts").
+"""
+from __future__ import annotations
+
+import logging
+from typing import TYPE_CHECKING, Dict, Optional
+
+from ...config import Config, get_config
+from ..managers.infrastructure import chip_uid
+from .base import Monitor
+from .probe import ProbeSample, collect_probe_samples, probe_command
+
+if TYPE_CHECKING:
+    from ..managers.infrastructure import InfrastructureManager
+    from ..transport.base import TransportManager
+
+log = logging.getLogger(__name__)
+
+
+class TpuMonitor(Monitor):
+    key = "TPU"
+
+    def __init__(self, config: Optional[Config] = None) -> None:
+        self.config = config or get_config()
+        self._command = probe_command()
+        #: latest parsed samples, shared with CpuMonitor to avoid a second
+        #: round-trip (the probe already carries cpu/mem counters)
+        self.last_samples: Dict[str, ProbeSample] = {}
+        self._restricted_warned: set = set()
+
+    def update(self, transports: "TransportManager", infra: "InfrastructureManager") -> None:
+        samples = collect_probe_samples(transports, self._command)
+        self.last_samples = {h: s for h, s in samples.items() if s is not None}
+        for hostname, sample in samples.items():
+            if sample is None:
+                infra.mark_unreachable(hostname, self.key)
+                continue
+            if sample.restricted > 0 and hostname not in self._restricted_warned:
+                self._restricted_warned.add(hostname)
+                log.warning(
+                    "probe on %s runs unprivileged: %d processes were not "
+                    "inspectable — chip ownership may be incomplete; grant "
+                    "passwordless sudo for the probe to fix this", hostname,
+                    sample.restricted,
+                )
+            infra.update_subtree(hostname, self.key, self._chip_subtree(hostname, sample))
+
+    # ------------------------------------------------------------------
+    def _chip_subtree(self, hostname: str, sample: ProbeSample) -> Dict[str, Dict]:
+        host_cfg = self.config.hosts.get(hostname)
+        accel_type = host_cfg.accelerator_type if host_cfg else ""
+        chips: Dict[str, Dict] = {}
+        for chip in sample.chips:
+            uid = chip_uid(hostname, chip.index)
+            processes = []
+            for pid in chip.pids:
+                proc = sample.procs.get(pid, {})
+                processes.append({
+                    "pid": pid,
+                    "user": proc.get("user", ""),
+                    "command": proc.get("cmd", ""),
+                })
+            hbm_used = chip.hbm_used_bytes
+            hbm_total = chip.hbm_total_bytes
+            chips[uid] = {
+                "uid": uid,
+                "index": chip.index,
+                "hostname": hostname,
+                "name": f"{accel_type or 'TPU'} chip {chip.index}",
+                "accelerator_type": accel_type,
+                "dev": chip.dev,
+                "hbm_used_mib": _to_mib(hbm_used),
+                "hbm_total_mib": _to_mib(hbm_total),
+                "hbm_util_pct": _pct(hbm_used, hbm_total),
+                "duty_cycle_pct": chip.duty_cycle_pct,
+                "metrics_age_s": chip.metrics_age_s,
+                "processes": processes,
+            }
+        return chips
+
+
+def _to_mib(value_bytes: Optional[int]) -> Optional[int]:
+    return None if value_bytes is None else int(value_bytes // 2**20)
+
+
+def _pct(used: Optional[int], total: Optional[int]) -> Optional[float]:
+    if used is None or not total:
+        return None
+    return round(100.0 * used / total, 1)

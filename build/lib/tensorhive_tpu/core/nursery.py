@@ -1,0 +1,265 @@
+"""Remote process lifecycle: spawn / terminate / running / fetch_log.
+
+Reference: tensorhive/core/task_nursery.py (315 LoC) builds GNU ``screen``
+sessions named ``tensorhive_task_<id>`` over SSH: spawn returns the screen
+PID (:50-96,167-190), terminate escalates SIGINT → screen quit → kill -9
+(:132-147), ``running()`` greps ``screen -ls`` (:272-291), ``fetch_log``
+tails ``~/TensorHiveLogs`` (:294-315).
+
+TPU VMs don't ship screen, so this rebuild uses bare POSIX process groups:
+``setsid`` makes the spawned wrapper a session+group leader whose PID is
+written to a pidfile and adopted back after daemon restarts; signals go to
+the whole group (``kill -- -PID``), so multi-process trainings die with
+their wrapper. A task marker embedded in the wrapper's argv guards PID-reuse
+during adoption (the analog of the reference's screen-session-name matching).
+Output is redirected straight to a per-task logfile — equivalent to the
+reference's ``tee --ignore-interrupts`` pipeline without the extra process.
+
+All operations funnel through :class:`HostOps`, the injectable seam that the
+fake cluster re-implements in-process (closing the reference's "not testable
+without a live host" gap, task_nursery.py:34 "TODO Write tests").
+"""
+from __future__ import annotations
+
+import enum
+import logging
+import shlex
+from typing import TYPE_CHECKING, Dict, List, Optional, Tuple
+
+from ..utils.exceptions import SpawnError, TransportError
+
+if TYPE_CHECKING:  # import only for annotations; avoids transport<->nursery cycle
+    from .transport.base import Transport
+
+log = logging.getLogger(__name__)
+
+RUN_DIR = "$HOME/.tpuhive/run"
+LOG_DIR = "$HOME/.tpuhive/logs"
+TASK_MARKER_PREFIX = "tpuhive_task_"
+
+
+class Termination(str, enum.Enum):
+    """Escalation ladder (reference task_nursery.py:250-269: gracefully=True
+    → SIGINT, None → screen quit ≈ SIGTERM, False → kill -9)."""
+
+    interrupt = "INT"
+    terminate = "TERM"
+    kill = "KILL"
+
+
+class HostOps:
+    """Process operations on one (host, user) channel, shell implementation.
+
+    Subclassed by the fake backend; every public method is part of the seam.
+    """
+
+    def __init__(
+        self,
+        transport: "Transport",
+        run_dir: str = RUN_DIR,
+        log_dir: str = LOG_DIR,
+    ) -> None:
+        self.transport = transport
+        self.run_dir = run_dir
+        self.log_dir = log_dir
+
+    @property
+    def hostname(self) -> str:
+        return self.transport.hostname
+
+    # -- task lifecycle ----------------------------------------------------
+    def spawn(self, command: str, task_id: int, timeout: Optional[float] = None) -> int:
+        """Start ``command`` detached; returns the session-leader PID.
+
+        The wrapper script: writes its PID, runs the command with stdout+err
+        appended to the task log, exits with the command's status. The task
+        marker rides in the wrapper's argv for adoption checks.
+        """
+        # trailing ':' bounds the id so task 1's marker never substring-matches
+        # a recycled PID now running task 12
+        marker = f"{TASK_MARKER_PREFIX}{task_id}:"
+        pidfile = f"{self.run_dir}/task_{task_id}.pid"
+        logfile = f"{self.log_dir}/task_{task_id}.log"
+        # NOTE: command is embedded unquoted inside the wrapper's -c script so
+        # user-supplied shell (pipes, &&) keeps working — same contract as the
+        # reference, which passes the raw command line to screen's bash -c.
+        wrapper = (
+            f'echo $$ > "{pidfile}"\n'
+            f"{command}\n"
+            f"rc=$?\n"
+            f"exit $rc # {marker}"
+        )
+        # `setsid --fork` (not `&`) does the detach: the parent returns
+        # immediately while the child starts a fresh session with DEFAULT
+        # signal dispositions — backgrounding with `&` in a non-interactive
+        # shell would leave SIGINT/SIGQUIT at SIG_IGN in every descendant,
+        # making graceful interrupt-termination impossible
+        # `>` not `>>`: each spawn starts a fresh log (the reference gets the
+        # same semantic from a fresh mktemp per spawn, task_nursery.py:90-96)
+        script = (
+            f'mkdir -p "{self.run_dir}" "{self.log_dir}" && rm -f "{pidfile}" && '
+            f'setsid --fork bash -c {shlex.quote(wrapper)} > "{logfile}" 2>&1 < /dev/null; '
+            f'for _ in $(seq 1 100); do [ -s "{pidfile}" ] && break; sleep 0.05; done; '
+            f'cat "{pidfile}"'
+        )
+        result = self.transport.run(script, timeout=timeout)
+        if not result.ok or not result.stdout.strip():
+            raise SpawnError(
+                f"[{self.hostname}] spawn of task {task_id} failed: "
+                f"{result.stderr.strip() or result.stdout.strip() or 'no pid produced'}"
+            )
+        try:
+            pid = int(result.stdout.strip().splitlines()[-1])
+        except ValueError:
+            raise SpawnError(
+                f"[{self.hostname}] could not parse spawned pid from "
+                f"{result.stdout!r}"
+            )
+        log.info("[%s] spawned task %d as pid %d", self.hostname, task_id, pid)
+        return pid
+
+    def terminate(self, pid: int, mode: Termination = Termination.interrupt) -> bool:
+        """Signal the whole process group; True if the signal was delivered."""
+        mode = Termination(mode)
+        result = self.transport.run(f"kill -{mode.value} -- -{int(pid)} 2>&1")
+        return result.ok
+
+    def running_tasks(self) -> Dict[int, int]:
+        """Alive tasks on this host as ``{task_id: pid}``; prunes stale
+        pidfiles. PID-reuse is guarded by requiring the task marker in the
+        process's argv (reference matches screen session names instead,
+        task_nursery.py:272-291)."""
+        script = (
+            f'cd "{self.run_dir}" 2>/dev/null || exit 0\n'
+            "for f in task_*.pid; do\n"
+            "  [ -e \"$f\" ] || continue\n"
+            "  id=${f#task_}; id=${id%.pid}\n"
+            "  pid=$(cat \"$f\" 2>/dev/null)\n"
+            "  if [ -n \"$pid\" ] && kill -0 \"$pid\" 2>/dev/null && "
+            f"grep -qa \"{TASK_MARKER_PREFIX}$id:\" \"/proc/$pid/cmdline\" 2>/dev/null; then\n"
+            "    echo \"$id $pid\"\n"
+            "  else\n"
+            "    rm -f \"$f\"\n"
+            "  fi\n"
+            "done"
+        )
+        result = self.transport.run(script)
+        tasks: Dict[int, int] = {}
+        if result.ok:
+            for line in result.stdout_lines():
+                try:
+                    task_id, pid = line.split()
+                    tasks[int(task_id)] = int(pid)
+                except ValueError:
+                    continue
+        return tasks
+
+    def is_alive(self, task_id: int) -> bool:
+        return task_id in self.running_tasks()
+
+    def fetch_log(self, task_id: int, tail: Optional[int] = None) -> str:
+        """Reference: task_nursery.fetch_log :294-315 (cat or tail the log)."""
+        logfile = f"{self.log_dir}/task_{task_id}.log"
+        cmd = f'tail -n {int(tail)} "{logfile}"' if tail else f'cat "{logfile}"'
+        result = self.transport.run(cmd)
+        if not result.ok:
+            raise TransportError(
+                f"[{self.hostname}] no log for task {task_id}: {result.stderr.strip()}"
+            )
+        return result.stdout
+
+    def remove_log(self, task_id: int) -> None:
+        self.transport.run(f'rm -f "{self.log_dir}/task_{task_id}.log"')
+
+    # -- generic process ops (protection handlers) -------------------------
+    def kill_pid(self, pid: int, sig: int = 9, sudo: bool = False) -> bool:
+        """Reference: User/SudoProcessKillingBehaviour (kill / sudo kill)."""
+        prefix = "sudo " if sudo else ""
+        return self.transport.run(f"{prefix}kill -{int(sig)} {int(pid)} 2>&1").ok
+
+    def process_owner(self, pid: int) -> Optional[str]:
+        """Reference: GPUMonitor._get_process_owner via `ps` (:94-107)."""
+        result = self.transport.run(f"ps --no-headers -o user -p {int(pid)}")
+        owner = result.stdout.strip()
+        return owner if result.ok and owner else None
+
+    def process_owners(self, pids: List[int]) -> Dict[int, str]:
+        """Batched owner lookup — ONE remote command for any number of PIDs
+        (the reference issues one SSH round-trip per PID, flagged as the hot
+        spot in SURVEY.md §3.2)."""
+        if not pids:
+            return {}
+        pid_list = ",".join(str(int(p)) for p in pids)
+        result = self.transport.run(f"ps --no-headers -o pid,user -p {pid_list}")
+        owners: Dict[int, str] = {}
+        for line in result.stdout_lines():
+            try:
+                pid_str, user = line.split()
+                owners[int(pid_str)] = user
+            except ValueError:
+                continue
+        return owners
+
+    # -- PTY ops (MessageSendingBehaviour) ---------------------------------
+    def pty_sessions(self) -> List[Tuple[str, str]]:
+        """(user, tty) pairs of interactive sessions (reference:
+        core/ssh.node_tty_sessions via `who`, ssh.py:148)."""
+        result = self.transport.run("who -s")
+        sessions: List[Tuple[str, str]] = []
+        for line in result.stdout_lines():
+            fields = line.split()
+            if len(fields) >= 2:
+                sessions.append((fields[0], fields[1]))
+        return sessions
+
+    def write_to_ptys(self, ttys: List[str], message: str) -> None:
+        """One merged remote command for all target PTYs (reference merges
+        per-tty `echo | tee /dev/tty` commands, MessageSendingBehaviour.py:51)."""
+        if not ttys:
+            return
+        devices = " ".join(f"/dev/{tty}" for tty in ttys)
+        self.transport.run(f"printf '%s\\n' {shlex.quote(message)} | tee {devices} > /dev/null")
+
+
+class OpsFactory:
+    """Builds HostOps per (host, user) — the seam services depend on.
+
+    The default implementation wraps the TransportManager; tests install a
+    fake that returns FakeHostOps bound to an in-memory cluster.
+    """
+
+    def __init__(self, transport_manager=None) -> None:
+        self._manager = transport_manager
+
+    @property
+    def manager(self):
+        if self._manager is None:
+            from .transport.base import get_transport_manager
+
+            self._manager = get_transport_manager()
+        return self._manager
+
+    def ops_for(self, hostname: str, user: Optional[str] = None) -> HostOps:
+        return HostOps(self.manager.for_host(hostname, user=user))
+
+    @property
+    def hostnames(self) -> List[str]:
+        return self.manager.hostnames
+
+
+# ---------------------------------------------------------------------------
+_factory: Optional[OpsFactory] = None
+
+
+def get_ops_factory() -> OpsFactory:
+    """Process-wide factory used by controllers/services; tests swap in a
+    FakeOpsFactory via :func:`set_ops_factory`."""
+    global _factory
+    if _factory is None:
+        _factory = OpsFactory()
+    return _factory
+
+
+def set_ops_factory(factory: Optional[OpsFactory]) -> None:
+    global _factory
+    _factory = factory

@@ -1,0 +1,118 @@
+"""Queue-scheduling strategies.
+
+Reference: tensorhive/core/scheduling.py:10-62 — ``Scheduler`` strategy
+interface + ``GreedyScheduler``: take a queued job iff every chip its tasks
+claim is free of upcoming reservations for at least
+``schedule_queued_when_free_mins`` and not already taken by an earlier job
+this round; skip a slot when the *owner's own* reservation is upcoming
+(they'll use it themselves, GreedyScheduler.schedule_jobs:30-62).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, List, Optional, Set
+
+from ..db.models.job import Job
+from ..db.models.reservation import Reservation
+from ..db.models.user import User
+from ..utils.timeutils import minutes_between, utcnow
+
+log = logging.getLogger(__name__)
+
+#: per-job eligible-host resolver: returns the set of hostnames the job's
+#: owner may launch on, or None for "unrestricted" (reference
+#: get_hosts_with_gpus_eligible_for_jobs, JobSchedulingService.py:174-195)
+EligibleHostsFn = Callable[[Job], Optional[Set[str]]]
+
+
+class Scheduler:
+    """Strategy: pick queued jobs to launch given per-chip free windows."""
+
+    def schedule_jobs(
+        self,
+        queued_jobs: List[Job],
+        required_free_minutes: float,
+        at=None,
+        eligible_hosts: Optional[EligibleHostsFn] = None,
+    ) -> List[Job]:
+        raise NotImplementedError
+
+
+def chip_free_minutes(
+    uid: str,
+    horizon_mins: float,
+    at=None,
+    for_user_id: Optional[int] = None,
+) -> float:
+    """Minutes until the chip's next active/non-cancelled reservation, capped
+    at ``horizon_mins`` (reference check_current_gpu_slots,
+    JobSchedulingService.py:76-104). A chip under a *current* reservation has
+    0 free minutes. Reservations owned by ``for_user_id`` don't count —
+    a user's queued job may run inside their own reserved window (reference
+    GreedyScheduler treats the owner's own upcoming reservation as free,
+    scheduling.py:48-56)."""
+    at = at or utcnow()
+    current = Reservation.current_for_resource(uid, at=at)
+    if current is not None and current.user_id != for_user_id:
+        return 0.0
+    candidates = [
+        r for r in Reservation.upcoming_events_for_resource(uid, at=at)
+        if r.user_id != for_user_id
+    ]
+    if not candidates:
+        return horizon_mins
+    return max(0.0, min(minutes_between(at, r.start) for r in candidates))
+
+
+class GreedyScheduler(Scheduler):
+    """First-come-first-served over the queue in enqueue order."""
+
+    HORIZON_MINS = 24 * 60.0
+
+    def schedule_jobs(
+        self,
+        queued_jobs: List[Job],
+        required_free_minutes: float,
+        at=None,
+        eligible_hosts: Optional[EligibleHostsFn] = None,
+    ) -> List[Job]:
+        at = at or utcnow()
+        taken: set = set()
+        chosen: List[Job] = []
+        for job in queued_jobs:
+            if not self._hosts_eligible(job, eligible_hosts):
+                continue
+            uids = job.chip_uids
+            if not uids:
+                # no chip claims (CPU-only job): the host-eligibility gate
+                # above is the whole check — reference launches chip-less
+                # jobs only on eligible hosts too (JobSchedulingService.py
+                # :174-195); without it a queued job on an unknown or
+                # restricted host would bypass all gating
+                chosen.append(job)
+                continue
+            ok = True
+            for uid in uids:
+                free = chip_free_minutes(
+                    uid, self.HORIZON_MINS, at=at, for_user_id=job.user_id
+                )
+                if uid in taken or free < required_free_minutes:
+                    ok = False
+                    break
+            if ok:
+                taken.update(uids)
+                chosen.append(job)
+        return chosen
+
+    @staticmethod
+    def _hosts_eligible(job: Job, eligible_hosts: Optional[EligibleHostsFn]) -> bool:
+        """Every task hostname must be eligible for the job's owner."""
+        if eligible_hosts is None:
+            return True
+        hosts = eligible_hosts(job)
+        if hosts is None:  # unrestricted user
+            return True
+        missing = {task.hostname for task in job.tasks} - hosts
+        if missing:
+            log.debug("job %d skipped: hosts %s not eligible", job.id, sorted(missing))
+        return not missing

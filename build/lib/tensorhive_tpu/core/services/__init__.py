@@ -1,0 +1,5 @@
+"""Daemon services (reference: tensorhive/core/services/)."""
+from .base import Service
+from .monitoring import MonitoringService
+
+__all__ = ["Service", "MonitoringService"]

@@ -74,10 +74,6 @@ class Service(StoppableThread):
 
     # -- introspection ------------------------------------------------------
     def tick_latency_p50(self) -> Optional[float]:
-        # snapshot first: the service thread appends concurrently and
-        # iterating a mutating deque raises RuntimeError (this is called
-        # from API threads via /admin/services)
-        durations = tuple(self.tick_durations)
-        if not durations:
+        if not self.tick_durations:
             return None
-        return statistics.median(durations)
+        return statistics.median(self.tick_durations)

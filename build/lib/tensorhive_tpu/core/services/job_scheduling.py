@@ -1,0 +1,194 @@
+"""JobSchedulingService: timed starts/stops + queue draining + preemption.
+
+Reference: tensorhive/core/services/JobSchedulingService.py:23-297 — each
+tick (default 30 s): run user-timed jobs whose ``start_at`` arrived
+(``execute_scheduled`` :134), else drain the queue via the Scheduler
+(``execute_queued`` :197), stop jobs whose ``stop_at`` passed with a
+graceful→SIGKILL escalation tracked in ``stubborn_job_ids`` (:210-252), and
+preempt queue-launched jobs whose chips acquired a reservation or foreign
+process (``sync_running_from_queue`` :254-283).
+"""
+from __future__ import annotations
+
+import logging
+from datetime import timedelta
+from typing import Dict, Optional, Set
+
+from ...config import Config, get_config
+from ...db.models.job import Job, JobStatus
+from ...db.models.reservation import Reservation
+from ...db.models.user import User
+from ...utils.exceptions import NotFoundError, TpuHiveError
+from ...utils.timeutils import minutes_between, utcnow
+from ..scheduling import GreedyScheduler, Scheduler
+from .base import Service
+
+# imported at module scope (not inside tick methods): lazy imports on the
+# service thread race the main thread's own first import of the controller
+# chain (werkzeug) during boot, corrupting the partially-initialized module
+from ...controllers.job import business_execute, business_stop  # noqa: E402
+
+log = logging.getLogger(__name__)
+
+
+class JobSchedulingService(Service):
+    def __init__(self, config: Optional[Config] = None,
+                 scheduler: Optional[Scheduler] = None) -> None:
+        config = config or get_config()
+        super().__init__(interval_s=config.job_scheduling.interval_s)
+        self.stop_attempts_after = timedelta(
+            minutes=config.job_scheduling.stop_attempts_after_mins
+        )
+        self.required_free_minutes = config.job_scheduling.schedule_queued_when_free_mins
+        self.scheduler = scheduler or GreedyScheduler()
+        #: jobs that ignored a graceful stop; next attempt escalates
+        #: (reference stubborn_job_ids, JobSchedulingService.py:32-36)
+        self.stubborn_job_ids: Set[int] = set()
+        #: first stop attempt per job, for the give-up window
+        self._stop_first_attempt: Dict[int, object] = {}
+
+    def do_run(self) -> None:
+        now = utcnow()
+        started_any = self.execute_scheduled(now)
+        if not started_any:
+            self.execute_queued(now)
+        self.stop_scheduled(now)
+        self.sync_running_from_queue(now)
+
+    # -- timed starts (reference :134-171) ----------------------------------
+    def execute_scheduled(self, now) -> bool:
+        started = False
+        for job in Job.find_scheduled_to_start(now):
+            if self._job_would_interfere(job, now):
+                log.info("delaying scheduled job %d: resources busy/reserved", job.id)
+                continue
+            try:
+                log.info("starting scheduled job %d (%s)", job.id, job.name)
+                business_execute(job.id)
+                started = True
+            except TpuHiveError as exc:
+                log.warning("scheduled job %d failed to start: %s", job.id, exc)
+        return started
+
+    # -- queue draining (reference :197-208) --------------------------------
+    def execute_queued(self, now) -> None:
+        queue = [job for job in Job.get_job_queue()
+                 if not self._has_foreign_process(job)]
+        if not queue:
+            return
+        for job in self.scheduler.schedule_jobs(queue, self.required_free_minutes,
+                                                at=now,
+                                                eligible_hosts=self._eligible_hosts_resolver()):
+            try:
+                log.info("starting queued job %d (%s)", job.id, job.name)
+                business_execute(job.id)
+            except TpuHiveError as exc:
+                log.warning("queued job %d failed to start: %s", job.id, exc)
+
+    # -- timed stops with escalation (reference :210-252) -------------------
+    def stop_scheduled(self, now) -> None:
+        for job in Job.find_scheduled_to_stop(now):
+            self.stop_with_grace(job, now)
+
+    def stop_with_grace(self, job: Job, now) -> None:
+        first_attempt = self._stop_first_attempt.setdefault(job.id, now)
+        try:
+            if job.id in self.stubborn_job_ids:
+                log.warning("job %d ignored graceful stop; killing", job.id)
+                business_stop(job.id, gracefully=False)
+            else:
+                business_stop(job.id, gracefully=True)
+        except TpuHiveError as exc:
+            log.warning("stopping job %d failed: %s", job.id, exc)
+        job = Job.get(job.id)
+        if job.status is JobStatus.running:
+            if now - first_attempt >= self.stop_attempts_after:
+                self.stubborn_job_ids.add(job.id)
+        else:
+            self.stubborn_job_ids.discard(job.id)
+            self._stop_first_attempt.pop(job.id, None)
+
+    # -- preemption of queue-launched jobs (reference :254-283) -------------
+    def sync_running_from_queue(self, now) -> None:
+        for job in Job.get_jobs_running_from_queue():
+            job.synchronize_status()
+            job = Job.get(job.id)
+            if job.status is not JobStatus.running:
+                continue
+            if self._reservation_imminent(job, now) or self._has_foreign_process(job):
+                log.info("preempting queued job %d: reservation/foreign process", job.id)
+                self.stop_with_grace(job, now)
+
+    # -- helpers -------------------------------------------------------------
+    def _reservation_imminent(self, job: Job, now) -> bool:
+        """A reservation by someone else is active or starts within the
+        required-free window on any chip the job holds."""
+        for uid in job.chip_uids:
+            current = Reservation.current_for_resource(uid, at=now)
+            if current is not None and current.user_id != job.user_id:
+                return True
+            for upcoming in Reservation.upcoming_events_for_resource(uid, at=now):
+                if (upcoming.user_id != job.user_id
+                        and minutes_between(now, upcoming.start) < self.required_free_minutes):
+                    return True
+        return False
+
+    def _job_would_interfere(self, job: Job, now) -> bool:
+        """Timed-start gate: chips must be unreserved (by others) and free of
+        foreign processes (reference check_if_resources_available_for_job +
+        interferes_with_reservations, :106-132)."""
+        return self._reservation_imminent(job, now) or self._has_foreign_process(job)
+
+    def _eligible_hosts_resolver(self):
+        """Per-tick resolver: hosts a job's owner may launch on — known to
+        the monitoring infrastructure and, after restriction filtering,
+        carrying at least one permitted chip (a host reporting zero chips
+        stays eligible for CPU-only work). Reference
+        get_hosts_with_gpus_eligible_for_jobs →
+        User.filter_infrastructure_by_user_restrictions
+        (JobSchedulingService.py:174-195). Returns None (= unrestricted)
+        when no infrastructure manager is wired, e.g. in bare unit tests.
+
+        The infra snapshot (a deepcopy under the RWLock) is taken once per
+        schedule pass and eligibility is memoized per owner, so N queued
+        jobs don't cost N snapshots + N restriction-query sets."""
+        if self.infrastructure_manager is None:
+            return None
+        host_chips = {
+            hostname: set(node["TPU"])
+            for hostname, node in self.infrastructure_manager.infrastructure.items()
+            if "TPU" in node  # absent = never reported or marked unreachable
+        }
+        by_owner: Dict[int, Set[str]] = {}
+
+        def eligible_hosts(job: Job) -> Set[str]:
+            if job.user_id not in by_owner:
+                try:
+                    allowed = User.get(job.user_id).allowed_resource_uids()
+                except NotFoundError:
+                    allowed = set()  # orphaned job: never eligible
+                by_owner[job.user_id] = {
+                    hostname for hostname, chips in host_chips.items()
+                    if allowed is None or not chips or (chips & allowed)
+                }
+            return by_owner[job.user_id]
+
+        return eligible_hosts
+
+    def _has_foreign_process(self, job: Job) -> bool:
+        if self.infrastructure_manager is None:
+            return False
+        try:
+            owner = User.get(job.user_id).username
+        except NotFoundError:
+            return False
+        for uid in job.chip_uids:
+            hostname = self.infrastructure_manager.find_chip_hostname(uid)
+            if hostname is None:
+                continue
+            for proc_uid, procs in self.infrastructure_manager.node_tpu_processes(hostname).items():
+                if proc_uid != uid:
+                    continue
+                if any(proc.get("user") and proc["user"] != owner for proc in procs):
+                    return True
+        return False

@@ -1,0 +1,54 @@
+"""MonitoringService: poll all monitors against all hosts each tick.
+
+Reference: tensorhive/core/services/MonitoringService.py:13-55 — every
+``interval`` (2.0 s default, config.py:204) run each Monitor over the group
+SSH connection and store results in InfrastructureManager, gevent-sleeping
+the remainder. Identical responsibilities here; the transport fan-out is a
+thread pool and monitors share the single-probe round-trip (monitors/probe.py).
+"""
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+from ...config import Config, get_config
+from ..monitors.base import Monitor
+from ..monitors.cpu import CpuMonitor
+from ..monitors.tpu import TpuMonitor
+from .base import Service
+
+log = logging.getLogger(__name__)
+
+
+class MonitoringService(Service):
+    def __init__(self, monitors: Optional[List[Monitor]] = None,
+                 config: Optional[Config] = None) -> None:
+        config = config or get_config()
+        super().__init__(interval_s=config.monitoring.interval_s)
+        if monitors is None:
+            monitors = default_monitors(config)
+        self.monitors = monitors
+
+    def do_run(self) -> None:
+        assert self.infrastructure_manager is not None, "service not injected"
+        assert self.transport_manager is not None, "service not injected"
+        for monitor in self.monitors:
+            try:
+                monitor.update(self.transport_manager, self.infrastructure_manager)
+            except Exception:
+                # per-monitor isolation: CPU metrics survive a TPU-probe bug
+                log.exception("monitor %s failed", type(monitor).__name__)
+
+
+def default_monitors(config: Config) -> List[Monitor]:
+    """Monitor set per config flags (reference
+    TensorHiveManager.instantiate_services_from_config enables GPU/CPU
+    monitors independently)."""
+    monitors: List[Monitor] = []
+    tpu_monitor = None
+    if config.monitoring.enable_tpu_monitor:
+        tpu_monitor = TpuMonitor(config)
+        monitors.append(tpu_monitor)
+    if config.monitoring.enable_cpu_monitor:
+        monitors.append(CpuMonitor(tpu_monitor=tpu_monitor))
+    return monitors

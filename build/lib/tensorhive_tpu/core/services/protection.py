@@ -1,0 +1,118 @@
+"""ProtectionService: detect and act on reservation violations.
+
+Reference: tensorhive/core/services/ProtectionService.py:17-131 — every tick,
+for each host/chip with processes, look up the current reservation
+(Reservation.current_events); processes owned by someone other than the
+reservation owner are violations; ``strict_reservations`` additionally flags
+processes on chips with *no* reservation (level>1, TensorHiveManager.py:105).
+Violations aggregate per intruder, then every configured handler fires.
+
+The TPU twist (BASELINE.json north star): ownership comes from the telemetry
+probe's device-holder PIDs (libtpu lock inspection) rather than CUDA context
+enumeration — a chip's ``processes`` list in the infra store is exactly the
+set of PIDs holding its device node open.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional
+
+from ...config import Config, get_config
+from ...db.models.reservation import Reservation
+from ...db.models.user import User
+from ..handlers.base import ProtectionHandler, Violation
+from .base import Service
+
+log = logging.getLogger(__name__)
+
+
+class ProtectionService(Service):
+    def __init__(self, config: Optional[Config] = None,
+                 handlers: Optional[List[ProtectionHandler]] = None) -> None:
+        config = config or get_config()
+        super().__init__(interval_s=config.protection.interval_s)
+        self.strict = config.protection.level >= 2
+        self.handlers = handlers if handlers is not None else default_handlers(config)
+        #: most recent violations, keyed by intruder (API/debug introspection)
+        self.last_violations: Dict[str, Violation] = {}
+
+    def do_run(self) -> None:
+        assert self.infrastructure_manager is not None, "service not injected"
+        violations = self.find_violations()
+        self.last_violations = violations
+        for handler in self.handlers:
+            handler.begin_tick()
+        for violation in violations.values():
+            for handler in self.handlers:
+                try:
+                    handler.trigger_action(violation)
+                except Exception:
+                    log.exception("handler %s failed", type(handler).__name__)
+
+    # ------------------------------------------------------------------
+    def find_violations(self) -> Dict[str, Violation]:
+        """Scan the telemetry snapshot against current reservations
+        (reference do_run :80-131). One batched reservation query + one
+        batched owner lookup per tick, not one per occupied chip — this runs
+        every 2 s on the hot path."""
+        violations: Dict[str, Violation] = {}
+        nodes = self.infrastructure_manager.all_nodes_with_tpu_processes()
+        active = {r.resource_id: r for r in Reservation.current_events()}
+        owner_ids = sorted({r.user_id for r in active.values()})
+        owners_by_id = {u.id: u for u in User.get_many(owner_ids)} if owner_ids else {}
+        for hostname, chips in nodes.items():
+            for uid, processes in chips.items():
+                if not processes:
+                    continue
+                reservation = active.get(uid)
+                owner_username = None
+                if reservation is not None:
+                    owner = owners_by_id.get(reservation.user_id)
+                    owner_username = owner.username if owner else None
+                for proc in processes:
+                    proc_user = proc.get("user", "")
+                    if not proc_user:
+                        continue
+                    if reservation is None:
+                        if not self.strict:
+                            continue  # unreserved use tolerated below level 2
+                        unreserved = True
+                    else:
+                        if proc_user == owner_username:
+                            continue
+                        unreserved = False
+                    violation = violations.setdefault(
+                        proc_user, Violation(intruder_username=proc_user)
+                    )
+                    if uid not in violation.chip_uids:
+                        violation.chip_uids.append(uid)
+                    if owner_username and owner_username not in violation.owner_usernames:
+                        violation.owner_usernames.append(owner_username)
+                    violation.pids_by_host.setdefault(hostname, [])
+                    if proc["pid"] not in violation.pids_by_host[hostname]:
+                        violation.pids_by_host[hostname].append(proc["pid"])
+                    violation.unreserved = violation.unreserved or unreserved
+        if violations:
+            log.info("violations detected: %s",
+                     {u: v.all_pids for u, v in violations.items()})
+        return violations
+
+
+def default_handlers(config: Config) -> List[ProtectionHandler]:
+    """Handler set per config (reference
+    TensorHiveManager.instantiate_services_from_config:71-110: PTY warnings
+    always when enabled, email opt-in, kill_processes ∈ {0,1,2})."""
+    from ..handlers.email import EmailSendingBehaviour
+    from ..handlers.kill import ProcessKillingBehaviour
+    from ..handlers.message import MessageSendingBehaviour
+
+    handlers: List[ProtectionHandler] = []
+    if config.protection.notify_on_pty:
+        handlers.append(MessageSendingBehaviour())
+    if config.protection.notify_via_email:
+        handlers.append(EmailSendingBehaviour(config.mailbot))
+    if config.protection.kill_mode == 1:
+        handlers.append(ProcessKillingBehaviour(sudo=False))
+    elif config.protection.kill_mode == 2:
+        handlers.append(ProcessKillingBehaviour(sudo=True))
+    return handlers

@@ -1,0 +1,120 @@
+"""UsageLoggingService: per-reservation utilization accounting.
+
+Reference: tensorhive/core/services/UsageLoggingService.py:18-240 — during an
+active reservation, append utilization samples to a per-reservation log under
+the usage-log dir; when the reservation expires, average the samples into the
+reservation row (``gpu_util_avg``/``mem_util_avg``) and apply the cleanup
+action (1=remove, 2=hide via dot-prefix, 3=keep; ``LogFileCleanupAction``
+:18). TPU metrics: duty-cycle (MXU activity) and HBM utilization.
+
+Format divergence from the reference (which rewrites a whole JSON document
+per sample): logs are **JSON-lines**, one sample object appended per tick —
+O(1) I/O per sample instead of O(n) re-serialization (an 8-day reservation at
+2 s cadence accumulates ~345k samples). ``KEEP``-mode files are renamed to
+``<id>.done.jsonl`` after accounting so they are never re-processed.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from ...config import Config, get_config
+from ...db.models.reservation import Reservation
+from ...utils.timeutils import isoformat, utcnow
+from .base import Service
+
+log = logging.getLogger(__name__)
+
+REMOVE, HIDE, KEEP = 1, 2, 3
+
+
+class UsageLoggingService(Service):
+    def __init__(self, config: Optional[Config] = None) -> None:
+        config = config or get_config()
+        super().__init__(interval_s=config.usage_logging.interval_s)
+        self.log_dir = Path(config.usage_log_dir)
+        self.cleanup_action = config.usage_logging.log_cleanup_action
+
+    def do_run(self) -> None:
+        assert self.infrastructure_manager is not None, "service not injected"
+        self.log_current_usage()
+        self.handle_expired_logs()
+
+    # -- sampling (reference log_current_usage :159) ------------------------
+    def log_current_usage(self) -> None:
+        active = Reservation.current_events()
+        if not active:
+            return
+        self.log_dir.mkdir(parents=True, exist_ok=True)
+        for reservation in active:
+            chip = self.infrastructure_manager.find_chip(reservation.resource_id)
+            if chip is None:
+                continue
+            sample = {
+                "time": isoformat(utcnow()),
+                "duty_cycle_pct": chip.get("duty_cycle_pct"),
+                "hbm_util_pct": chip.get("hbm_util_pct"),
+            }
+            self._append_sample(reservation.id, sample)
+
+    def _path(self, reservation_id: int) -> Path:
+        return self.log_dir / f"{reservation_id}.jsonl"
+
+    def _append_sample(self, reservation_id: int, sample: Dict) -> None:
+        with open(self._path(reservation_id), "a") as fh:
+            fh.write(json.dumps(sample) + "\n")
+
+    @staticmethod
+    def _read_samples(path: Path) -> List[Dict]:
+        samples: List[Dict] = []
+        try:
+            with open(path) as fh:
+                for line in fh:
+                    try:
+                        samples.append(json.loads(line))
+                    except ValueError:
+                        continue  # torn write at crash time
+        except OSError:
+            pass
+        return samples
+
+    # -- expiry accounting (reference handle_expired_logs :196) -------------
+    def handle_expired_logs(self) -> None:
+        if not self.log_dir.is_dir():
+            return
+        now = utcnow()
+        for path in sorted(self.log_dir.glob("[0-9]*.jsonl")):
+            stem = path.name[:-len(".jsonl")]
+            if not stem.isdigit():
+                continue  # excludes <id>.done.jsonl markers
+            reservation = Reservation.get_or_none(int(stem))
+            if reservation is None:
+                path.unlink(missing_ok=True)
+                continue
+            if reservation.end > now:
+                continue  # still active
+            self._persist_averages(reservation, self._read_samples(path))
+            self._cleanup(path)
+
+    @staticmethod
+    def _persist_averages(reservation: Reservation, samples: List[Dict]) -> None:
+        def avg(key: str) -> Optional[float]:
+            values = [s[key] for s in samples if s.get(key) is not None]
+            return round(sum(values) / len(values), 1) if values else None
+
+        reservation.duty_cycle_avg = avg("duty_cycle_pct")
+        reservation.hbm_util_avg = avg("hbm_util_pct")
+        reservation.save()
+        log.info("reservation %d usage: duty=%s%% hbm=%s%%",
+                 reservation.id, reservation.duty_cycle_avg, reservation.hbm_util_avg)
+
+    def _cleanup(self, path: Path) -> None:
+        if self.cleanup_action == REMOVE:
+            path.unlink(missing_ok=True)
+        elif self.cleanup_action == HIDE:
+            path.rename(path.with_name("." + path.name))
+        else:  # KEEP: retain content, mark accounted so it's never re-read
+            path.rename(path.with_name(path.name[:-len(".jsonl")] + ".done.jsonl"))

@@ -1,0 +1,232 @@
+"""Launch-topology templates: auto-filled distributed-run parameters.
+
+This is the server-side rebuild of the reference's "parallelism strategies"
+UI — the Vue task-template engine in
+tensorhive/app/web/dev/src/.../TaskCreate.vue (861 LoC, SURVEY.md §2.5):
+``TaskTemplateChooser`` offered *No template / TF ClusterSpec / TF_CONFIG /
+PyTorch*, and TaskCreate auto-incremented ``--task_index``/``--rank``,
+assigned ports from 2222, and prepended ``CUDA_VISIBLE_DEVICES``. Moving the
+engine server-side makes it API-first (any client gets it) and adds the
+TPU-native templates the north star requires (BASELINE.json: "templates gain
+a jax.distributed.initialize template that wires coordinator/worker roles
+across a pod slice").
+
+A template takes a placement (ordered host/chip assignments) and returns one
+task descriptor per process: command, env vars, params. The job controller
+materializes them as Task rows with command segments.
+
+Templates:
+
+* ``jax``        — jax.distributed.initialize wiring: ``--coordinator_address``
+                   (worker 0, port 8476), ``--num_processes``, ``--process_id``
+                   params + ``TPU_VISIBLE_CHIPS``/``TPU_PROCESS_BOUNDS``-style
+                   env; Cloud TPU autodetection still works when users omit
+                   the params — they are additive.
+* ``multislice`` — megascale env for multi-slice jobs over DCN:
+                   MEGASCALE_COORDINATOR_ADDRESS / NUM_SLICES / SLICE_ID.
+* ``torch-xla``  — PJRT_DEVICE=TPU + torchrun-style MASTER_ADDR/PORT,
+                   NODE_RANK, nnodes (reference's torch.distributed template,
+                   examples/PyTorch/README.md, rebuilt for torch-xla).
+* ``tf-config``  — TF_CONFIG JSON env with smart port assignment starting at
+                   2222 (reference "Smart TF_CONFIG", TaskCreate.vue:404-424).
+* ``tf-cluster`` — TF1 ClusterSpec CLI params --ps_hosts/--worker_hosts/
+                   --job_name/--task_index (TaskCreate.vue:202-206,379-390).
+* ``plain``      — no distributed wiring, just per-task chip binding.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Callable, Dict, List, Optional, Sequence
+
+from ..utils.exceptions import ValidationError
+
+JAX_COORDINATOR_PORT = 8476
+MEGASCALE_PORT = 8477
+TF_BASE_PORT = 2222
+TORCH_MASTER_PORT = 12355
+
+
+@dataclasses.dataclass
+class Placement:
+    """One process slot: a host and the chips the process may use."""
+
+    hostname: str
+    address: str = ""            # routable address; defaults to hostname
+    chips: Optional[List[int]] = None
+
+    def __post_init__(self) -> None:
+        if not self.address:
+            self.address = self.hostname
+
+
+@dataclasses.dataclass
+class TaskSpec:
+    """Renderer output: one process to spawn."""
+
+    hostname: str
+    command: str
+    env: Dict[str, str] = dataclasses.field(default_factory=dict)
+    params: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+Renderer = Callable[[str, Sequence[Placement], Dict], List[TaskSpec]]
+_TEMPLATES: Dict[str, Renderer] = {}
+
+
+def register_template(name: str):
+    def decorate(fn: Renderer) -> Renderer:
+        _TEMPLATES[name] = fn
+        return fn
+    return decorate
+
+
+def template_names() -> List[str]:
+    return sorted(_TEMPLATES)
+
+
+def render_template(
+    name: str,
+    command: str,
+    placements: Sequence[Placement],
+    options: Optional[Dict] = None,
+) -> List[TaskSpec]:
+    try:
+        renderer = _TEMPLATES[name]
+    except KeyError:
+        raise ValidationError(
+            f"unknown template {name!r}; available: {template_names()}"
+        )
+    if not placements:
+        raise ValidationError("template needs at least one placement")
+    return renderer(command, list(placements), dict(options or {}))
+
+
+def _chip_env(placement: Placement) -> Dict[str, str]:
+    """Per-process chip binding (the reference prepends
+    CUDA_VISIBLE_DEVICES=<n>, TaskCreate.vue convertResource :290-301).
+    Uses the same constant chip accounting keys on (db/models/task.py)."""
+    from ..db.models.task import CHIP_ENV_VAR
+
+    if placement.chips is None:
+        return {}
+    return {CHIP_ENV_VAR: ",".join(str(c) for c in placement.chips)}
+
+
+def _assign_ports(placements: Sequence[Placement], base_port: int) -> List[str]:
+    """'addr:port' per placement; processes sharing a host get consecutive
+    ports from base_port (reference smart-port assignment)."""
+    next_port: Dict[str, int] = {}
+    addresses = []
+    for placement in placements:
+        port = next_port.get(placement.address, base_port)
+        next_port[placement.address] = port + 1
+        addresses.append(f"{placement.address}:{port}")
+    return addresses
+
+
+@register_template("plain")
+def _plain(command, placements, options) -> List[TaskSpec]:
+    return [
+        TaskSpec(hostname=p.hostname, command=command, env=_chip_env(p))
+        for p in placements
+    ]
+
+
+@register_template("jax")
+def _jax(command, placements, options) -> List[TaskSpec]:
+    port = int(options.get("coordinator_port", JAX_COORDINATOR_PORT))
+    coordinator = f"{placements[0].address}:{port}"
+    specs = []
+    for index, placement in enumerate(placements):
+        env = _chip_env(placement)
+        params = {
+            "--coordinator_address": coordinator,
+            "--num_processes": str(len(placements)),
+            "--process_id": str(index),
+        }
+        specs.append(TaskSpec(placement.hostname, command, env=env, params=params))
+    return specs
+
+
+@register_template("multislice")
+def _multislice(command, placements, options) -> List[TaskSpec]:
+    """One placement per SLICE (each slice's worker-0); megascale env wires
+    slices together over DCN; within each slice jax autodetects."""
+    port = int(options.get("megascale_port", MEGASCALE_PORT))
+    coordinator = f"{placements[0].address}:{port}"
+    specs = []
+    for slice_id, placement in enumerate(placements):
+        env = {
+            "MEGASCALE_COORDINATOR_ADDRESS": coordinator,
+            "MEGASCALE_NUM_SLICES": str(len(placements)),
+            "MEGASCALE_SLICE_ID": str(slice_id),
+            "MEGASCALE_PORT": str(port),
+            **_chip_env(placement),
+        }
+        specs.append(TaskSpec(placement.hostname, command, env=env))
+    return specs
+
+
+@register_template("torch-xla")
+def _torch_xla(command, placements, options) -> List[TaskSpec]:
+    port = int(options.get("master_port", TORCH_MASTER_PORT))
+    master = placements[0].address
+    specs = []
+    for rank, placement in enumerate(placements):
+        env = {
+            "PJRT_DEVICE": "TPU",
+            "MASTER_ADDR": master,
+            "MASTER_PORT": str(port),
+            "NODE_RANK": str(rank),
+            "WORLD_SIZE": str(len(placements)),
+            **_chip_env(placement),
+        }
+        specs.append(TaskSpec(placement.hostname, command, env=env))
+    return specs
+
+
+@register_template("tf-config")
+def _tf_config(command, placements, options) -> List[TaskSpec]:
+    """Smart TF_CONFIG: ports auto-assigned per host starting at 2222; an
+    all-worker cluster where worker 0 acts as de-facto chief — matching the
+    reference's generated TF_CONFIG (TaskCreate.vue:404-424)."""
+    base_port = int(options.get("base_port", TF_BASE_PORT))
+    addresses = _assign_ports(placements, base_port)
+    cluster = {"worker": addresses}
+    specs = []
+    for index, placement in enumerate(placements):
+        tf_config = json.dumps({
+            "cluster": cluster,
+            "task": {"type": "worker", "index": index},
+        })
+        specs.append(TaskSpec(
+            placement.hostname, command,
+            env={"TF_CONFIG": tf_config, **_chip_env(placement)},
+        ))
+    return specs
+
+
+@register_template("tf-cluster")
+def _tf_cluster(command, placements, options) -> List[TaskSpec]:
+    """TF1 ClusterSpec params; options['num_ps'] placements become parameter
+    servers (reference template tf1, TaskCreate.vue:202-206)."""
+    num_ps = int(options.get("num_ps", 0))
+    if num_ps >= len(placements):
+        raise ValidationError("num_ps must leave at least one worker")
+    base_port = int(options.get("base_port", TF_BASE_PORT))
+    addresses = _assign_ports(placements, base_port)
+    ps_hosts = ",".join(addresses[:num_ps])
+    worker_hosts = ",".join(addresses[num_ps:])
+    specs = []
+    for index, placement in enumerate(placements):
+        is_ps = index < num_ps
+        params = {
+            "--ps_hosts": ps_hosts,
+            "--worker_hosts": worker_hosts,
+            "--job_name": "ps" if is_ps else "worker",
+            "--task_index": str(index if is_ps else index - num_ps),
+        }
+        specs.append(TaskSpec(placement.hostname, command,
+                              env=_chip_env(placement), params=params))
+    return specs

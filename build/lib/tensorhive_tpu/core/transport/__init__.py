@@ -1,0 +1,15 @@
+"""Remote-execution transports (reference: tensorhive/core/ssh.py +
+core/managers/SSHConnectionManager.py).
+
+The reference hardwires parallel-ssh/libssh2; this rebuild defines a narrow
+:class:`Transport` interface with three interchangeable backends:
+
+* ``ssh``   — OpenSSH client subprocess fan-out (control plane to TPU VMs),
+* ``local`` — subprocess on this machine (single-VM installs, localhost jobs),
+* ``fake``  — in-process simulated cluster, closing the reference's test gap
+  (SURVEY.md §4: "There is no fake SSH backend and no multi-node simulation").
+"""
+from .base import CommandResult, Transport, TransportManager, get_transport_manager, set_transport_manager  # noqa: F401
+from .local import LocalTransport  # noqa: F401
+from .ssh import SshTransport  # noqa: F401
+from .fake import FakeCluster, FakeTransport  # noqa: F401

@@ -1,0 +1,227 @@
+"""Transport interface + manager (reference: core/ssh.py:53-128 stateless API,
+core/managers/SSHConnectionManager.py:11-121 stateful cache + group fan-out).
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import dataclasses
+import logging
+import threading
+from shlex import quote as shlex_quote
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ...config import Config, HostConfig, get_config
+from ...utils.exceptions import TransportError
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class CommandResult:
+    host: str
+    command: str
+    exit_code: int
+    stdout: str
+    stderr: str = ""
+
+    @property
+    def ok(self) -> bool:
+        return self.exit_code == 0
+
+    def stdout_lines(self) -> List[str]:
+        return [line for line in self.stdout.splitlines() if line.strip()]
+
+
+class Transport:
+    """One (host, user) command channel."""
+
+    def __init__(self, host: HostConfig, user: Optional[str] = None) -> None:
+        self.host = host
+        self.user = user or host.user
+
+    @property
+    def hostname(self) -> str:
+        return self.host.name
+
+    def run(self, command: str, timeout: Optional[float] = None) -> CommandResult:
+        """Execute a shell command; returns CommandResult (non-zero exit codes
+        are returned, not raised). Raises TransportError only when the channel
+        itself fails (unreachable host, auth failure, timeout)."""
+        raise NotImplementedError
+
+    def check_output(self, command: str, timeout: Optional[float] = None) -> str:
+        """run + raise TransportError on non-zero exit (reference
+        ssh.get_stdout unwrap, core/ssh.py:98)."""
+        result = self.run(command, timeout=timeout)
+        if not result.ok:
+            raise TransportError(
+                f"[{self.hostname}] command failed (exit {result.exit_code}): "
+                f"{command!r}: {result.stderr.strip() or result.stdout.strip()}"
+            )
+        return result.stdout
+
+    def test(self) -> bool:
+        """Connectivity probe (reference runs `uname` on every node,
+        SSHConnectionManager.test_all_connections:76-121)."""
+        try:
+            return self.run("uname", timeout=10).ok
+        except TransportError:
+            return False
+
+    def expand_remote_path(self, remote_path: str) -> str:
+        """Resolve ``$HOME``/``~`` in a remote path against the host's actual
+        home directory, so later uses can be safely shell-quoted (quoting a
+        path that still contains ``$HOME`` would create a literal '$HOME'
+        directory)."""
+        if "$HOME" in remote_path or remote_path.startswith("~"):
+            home = self.check_output('printf %s "$HOME"').strip()
+            if not home:
+                raise TransportError(f"[{self.hostname}] cannot resolve $HOME")
+            remote_path = remote_path.replace("$HOME", home)
+            if remote_path.startswith("~"):
+                remote_path = home + remote_path[1:]
+        return remote_path
+
+    def put_file(self, local_path: str, remote_path: str, mode: int = 0o755) -> None:
+        """Copy a local file onto the host. Default implementation streams
+        base64 chunks through ``run`` (works over any command channel);
+        backends with a real copy path (scp, cp) override it."""
+        import base64
+
+        with open(local_path, "rb") as fh:
+            data = fh.read()
+        encoded = base64.b64encode(data).decode()
+        quoted = shlex_quote(self.expand_remote_path(remote_path))
+        self.check_output(f'mkdir -p "$(dirname {quoted})" && : > {quoted}.b64')
+        chunk_size = 64 * 1024  # keep each command line well under ARG_MAX
+        try:
+            for offset in range(0, len(encoded), chunk_size):
+                chunk = encoded[offset:offset + chunk_size]
+                self.check_output(f"printf %s {chunk} >> {quoted}.b64")
+            self.check_output(
+                f"base64 -d {quoted}.b64 > {quoted} && chmod {mode:o} {quoted}"
+            )
+        finally:
+            self.run(f"rm -f {quoted}.b64")
+
+
+_BACKENDS: Dict[str, Callable[..., Transport]] = {}
+
+
+def register_backend(name: str, factory: Callable[..., Transport]) -> None:
+    _BACKENDS[name] = factory
+
+
+def make_transport(host: HostConfig, user: Optional[str] = None, config: Optional[Config] = None) -> Transport:
+    config = config or get_config()
+    backend = host.backend or config.ssh.default_backend
+    try:
+        factory = _BACKENDS[backend]
+    except KeyError:
+        raise TransportError(
+            f"unknown transport backend {backend!r} for host {host.name} "
+            f"(registered: {sorted(_BACKENDS)})"
+        )
+    return factory(host, user=user, config=config)
+
+
+class TransportManager:
+    """Caches per-(host, user) transports and fans commands out to many hosts
+    in parallel (reference: SSHConnectionManager group client :21-46 +
+    memoized per-user clients ssh.py:52-69; parallelism was gevent, here a
+    thread pool with ``stop_on_errors=False`` semantics — per-host failures
+    are isolated into the result map)."""
+
+    def __init__(self, config: Optional[Config] = None, max_workers: int = 32) -> None:
+        self.config = config or get_config()
+        self._cache: Dict[Tuple[str, Optional[str]], Transport] = {}
+        self._cache_lock = threading.Lock()
+        # persistent pool: run_on_all fires once per monitor per ~2s tick, so
+        # per-call executor construction would churn threads on the hot path
+        self._pool = concurrent.futures.ThreadPoolExecutor(
+            max_workers=max_workers, thread_name_prefix="transport"
+        )
+
+    @property
+    def hostnames(self) -> List[str]:
+        return list(self.config.hosts)
+
+    def add_host(self, host: HostConfig) -> None:
+        """Dynamic host registration (reference SSHConnectionManager.add_host)."""
+        self.config.hosts[host.name] = host
+
+    def for_host(self, hostname: str, user: Optional[str] = None) -> Transport:
+        key = (hostname, user)
+        with self._cache_lock:
+            if key not in self._cache:
+                try:
+                    host = self.config.hosts[hostname]
+                except KeyError:
+                    raise TransportError(f"unknown host {hostname!r}")
+                self._cache[key] = make_transport(host, user=user, config=self.config)
+            return self._cache[key]
+
+    def invalidate(self, hostname: Optional[str] = None) -> None:
+        with self._cache_lock:
+            if hostname is None:
+                self._cache.clear()
+            else:
+                for key in [k for k in self._cache if k[0] == hostname]:
+                    del self._cache[key]
+
+    def run_on_all(
+        self,
+        command: str,
+        hostnames: Optional[List[str]] = None,
+        timeout: Optional[float] = None,
+    ) -> Dict[str, CommandResult]:
+        """Parallel fan-out; failed hosts map to a synthetic non-zero result
+        instead of raising (reference stop_on_errors=False, GPUMonitor.py:77)."""
+        hostnames = hostnames if hostnames is not None else self.hostnames
+        results: Dict[str, CommandResult] = {}
+        if not hostnames:
+            return results
+
+        def _one(name: str) -> CommandResult:
+            try:
+                return self.for_host(name).run(command, timeout=timeout)
+            except TransportError as exc:
+                log.warning("host %s unreachable: %s", name, exc)
+                return CommandResult(
+                    host=name, command=command, exit_code=255, stdout="", stderr=str(exc)
+                )
+
+        for name, result in zip(hostnames, self._pool.map(_one, hostnames)):
+            results[name] = result
+        return results
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False)
+
+    def test_all_connections(self) -> Dict[str, bool]:
+        """Startup connectivity probe (reference TensorHiveManager.test_ssh:47-69)."""
+        statuses = {}
+        for name, result in self.run_on_all("uname").items():
+            statuses[name] = result.ok
+            if not result.ok:
+                log.error("connectivity test failed for %s: %s", name, result.stderr)
+        return statuses
+
+
+# ---------------------------------------------------------------------------
+_manager: Optional[TransportManager] = None
+_manager_lock = threading.Lock()
+
+
+def get_transport_manager() -> TransportManager:
+    global _manager
+    with _manager_lock:
+        if _manager is None:
+            _manager = TransportManager()
+        return _manager
+
+
+def set_transport_manager(manager: Optional[TransportManager]) -> None:
+    global _manager
+    with _manager_lock:
+        _manager = manager

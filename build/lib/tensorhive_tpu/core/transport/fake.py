@@ -1,0 +1,294 @@
+"""In-process fake cluster for tests.
+
+The reference has no fake SSH backend — monitors, services, and the nursery
+are untested against live-host behavior (SURVEY.md §4 "There is no fake SSH
+backend and no multi-node simulation"). This module provides:
+
+* :class:`FakeCluster` — in-memory hosts with processes, PTY sessions, task
+  logs, and per-chip telemetry that tests mutate directly;
+* :class:`FakeTransport` — a Transport whose ``run`` dispatches to canned
+  command handlers (for code that fans raw commands out);
+* :class:`FakeHostOps` — a HostOps implementation backed by the cluster
+  (for the nursery / services seam).
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ...config import HostConfig
+from ...utils.exceptions import SpawnError, TransportError
+from ..nursery import HostOps, OpsFactory, Termination
+from .base import CommandResult, Transport
+
+
+@dataclass
+class FakeProcess:
+    pid: int
+    user: str
+    command: str
+    task_id: Optional[int] = None
+    chip_ids: List[int] = field(default_factory=list)
+    alive: bool = True
+    received_signals: List[str] = field(default_factory=list)
+    # how many signals of each kind it takes before the process dies
+    dies_on: Tuple[str, ...] = ("INT", "TERM", "KILL")
+
+
+@dataclass
+class FakeHost:
+    name: str
+    processes: Dict[int, FakeProcess] = field(default_factory=dict)
+    ptys: List[Tuple[str, str]] = field(default_factory=list)  # (user, tty)
+    pty_messages: Dict[str, List[str]] = field(default_factory=dict)
+    task_logs: Dict[int, str] = field(default_factory=dict)
+    reachable: bool = True
+    # chip telemetry: chip_index -> metrics dict (mutated by tests)
+    chips: Dict[int, Dict] = field(default_factory=dict)
+    # cumulative cpu jiffies + memory, advanced by tests for util deltas
+    cpu_total_jiffies: int = 0
+    cpu_idle_jiffies: int = 0
+    ncpu: int = 8
+    mem_total_kb: int = 16 * 2**20
+    mem_avail_kb: int = 12 * 2**20
+
+
+class FakeCluster:
+    def __init__(self) -> None:
+        self.hosts: Dict[str, FakeHost] = {}
+        self._pid_counter = itertools.count(1000)
+        self._lock = threading.RLock()
+        self.spawn_failures: Dict[str, str] = {}  # hostname -> error message
+
+    def add_host(self, name: str, chips: int = 0, accel: str = "v5litepod-8") -> FakeHost:
+        host = FakeHost(name=name)
+        for index in range(chips):
+            host.chips[index] = {
+                "uid": f"{name}:tpu:{index}",
+                "index": index,
+                "accelerator_type": accel,
+                "hbm_used_bytes": 0,
+                "hbm_total_bytes": 16 * 2**30,
+                "duty_cycle_pct": 0.0,
+                "pid": None,
+                "user": None,
+            }
+        self.hosts[name] = host
+        return host
+
+    def host(self, name: str) -> FakeHost:
+        try:
+            return self.hosts[name]
+        except KeyError:
+            raise TransportError(f"fake cluster has no host {name!r}")
+
+    def next_pid(self) -> int:
+        return next(self._pid_counter)
+
+    def start_process(
+        self,
+        hostname: str,
+        user: str,
+        command: str = "python burn.py",
+        chip_ids: Optional[List[int]] = None,
+        **kwargs,
+    ) -> FakeProcess:
+        """Simulate a user process occupying chips (for protection tests)."""
+        with self._lock:
+            host = self.host(hostname)
+            proc = FakeProcess(
+                pid=self.next_pid(), user=user, command=command,
+                chip_ids=chip_ids or [], **kwargs,
+            )
+            host.processes[proc.pid] = proc
+            for chip in proc.chip_ids:
+                if chip in host.chips:
+                    host.chips[chip]["pid"] = proc.pid
+                    host.chips[chip]["user"] = user
+            return proc
+
+    def kill_process(self, hostname: str, pid: int) -> None:
+        with self._lock:
+            host = self.host(hostname)
+            proc = host.processes.get(pid)
+            if proc is not None:
+                proc.alive = False
+                for chip in proc.chip_ids:
+                    if chip in host.chips and host.chips[chip].get("pid") == pid:
+                        host.chips[chip]["pid"] = None
+                        host.chips[chip]["user"] = None
+
+    def probe_json(self, hostname: str) -> str:
+        """Render this host's state in the probe's schema-v1 JSON, so fake
+        monitoring traverses the exact same parse path as production."""
+        from ..monitors.probe import render_probe_json
+
+        with self._lock:
+            host = self.host(hostname)
+            chips, metrics = [], {}
+            for index, chip in sorted(host.chips.items()):
+                pids = sorted({
+                    pid for pid, proc in host.processes.items()
+                    if proc.alive and index in proc.chip_ids
+                } | ({chip["pid"]} if chip.get("pid") else set()))
+                chips.append({"index": index, "dev": f"/dev/accel{index}", "pids": pids})
+                metrics[str(index)] = {
+                    "hbm_used_bytes": chip.get("hbm_used_bytes"),
+                    "hbm_total_bytes": chip.get("hbm_total_bytes"),
+                    "duty_cycle_pct": chip.get("duty_cycle_pct"),
+                    "age_s": chip.get("metrics_age_s", 0.0),
+                }
+            procs = {
+                pid: {"user": proc.user, "cmd": proc.command}
+                for pid, proc in host.processes.items()
+                if proc.alive
+            }
+            return render_probe_json(
+                chips, procs,
+                cpu={"total": host.cpu_total_jiffies, "idle": host.cpu_idle_jiffies,
+                     "ncpu": host.ncpu},
+                mem={"total_kb": host.mem_total_kb, "avail_kb": host.mem_avail_kb},
+                metrics=metrics,
+            )
+
+
+class FakeTransport(Transport):
+    """Transport running canned handlers instead of a shell. Tests register
+    handlers via ``cluster.command_handlers`` or per-instance ``on()``."""
+
+    def __init__(self, host: HostConfig, cluster: FakeCluster, user: Optional[str] = None, config=None) -> None:
+        super().__init__(host, user)
+        self.cluster = cluster
+        self._handlers: List[Tuple[Callable[[str], bool], Callable[[str], str]]] = []
+
+    def on(self, predicate: Callable[[str], bool], respond: Callable[[str], str]) -> None:
+        self._handlers.append((predicate, respond))
+
+    def run(self, command: str, timeout: Optional[float] = None) -> CommandResult:
+        fake_host = self.cluster.host(self.hostname)
+        if not fake_host.reachable:
+            raise TransportError(f"[{self.hostname}] unreachable (fake)")
+        for predicate, respond in self._handlers:
+            if predicate(command):
+                return CommandResult(self.hostname, command, 0, respond(command))
+        if command.strip() == "uname":
+            return CommandResult(self.hostname, command, 0, "Linux\n")
+        from ..monitors.probe import PROBE_MARKER
+
+        if PROBE_MARKER in command:
+            return CommandResult(
+                self.hostname, command, 0, self.cluster.probe_json(self.hostname) + "\n"
+            )
+        return CommandResult(self.hostname, command, 127, "", f"fake: unhandled command {command!r}")
+
+
+class FakeHostOps(HostOps):
+    """HostOps semantics against the in-memory cluster (no shell)."""
+
+    def __init__(self, cluster: FakeCluster, hostname: str, user: Optional[str] = None) -> None:
+        self.cluster = cluster
+        self._hostname = hostname
+        self.user = user
+        self.transport = None  # type: ignore[assignment]
+
+    @property
+    def hostname(self) -> str:
+        return self._hostname
+
+    def _host(self) -> FakeHost:
+        host = self.cluster.host(self._hostname)
+        if not host.reachable:
+            raise TransportError(f"[{self._hostname}] unreachable (fake)")
+        return host
+
+    # -- task lifecycle ----------------------------------------------------
+    def spawn(self, command: str, task_id: int, timeout: Optional[float] = None) -> int:
+        host = self._host()
+        if self._hostname in self.cluster.spawn_failures:
+            raise SpawnError(self.cluster.spawn_failures[self._hostname])
+        proc = FakeProcess(
+            pid=self.cluster.next_pid(),
+            user=self.user or "tpuhive",
+            command=command,
+            task_id=task_id,
+        )
+        host.processes[proc.pid] = proc
+        host.task_logs[task_id] = f"[fake] started: {command}\n"
+        return proc.pid
+
+    def terminate(self, pid: int, mode: Termination = Termination.interrupt) -> bool:
+        mode = Termination(mode)
+        host = self._host()
+        proc = host.processes.get(pid)
+        if proc is None or not proc.alive:
+            return False
+        proc.received_signals.append(mode.value)
+        if mode.value in proc.dies_on:
+            proc.alive = False
+            if proc.task_id is not None and proc.task_id in host.task_logs:
+                host.task_logs[proc.task_id] += f"[fake] terminated by SIG{mode.value}\n"
+        return True
+
+    def running_tasks(self) -> Dict[int, int]:
+        host = self._host()
+        return {
+            proc.task_id: pid
+            for pid, proc in host.processes.items()
+            if proc.alive and proc.task_id is not None
+        }
+
+    def fetch_log(self, task_id: int, tail: Optional[int] = None) -> str:
+        host = self._host()
+        if task_id not in host.task_logs:
+            raise TransportError(f"[{self._hostname}] no log for task {task_id}")
+        text = host.task_logs[task_id]
+        if tail:
+            return "\n".join(text.splitlines()[-tail:]) + "\n"
+        return text
+
+    def remove_log(self, task_id: int) -> None:
+        self._host().task_logs.pop(task_id, None)
+
+    # -- generic process ops -----------------------------------------------
+    def kill_pid(self, pid: int, sig: int = 9, sudo: bool = False) -> bool:
+        host = self._host()
+        proc = host.processes.get(pid)
+        if proc is None or not proc.alive:
+            return False
+        if not sudo and self.user is not None and proc.user != self.user:
+            return False  # no permission, mirrors kill(1) EPERM
+        proc.received_signals.append(str(sig))
+        if sig in (9, 15):
+            self.cluster.kill_process(self._hostname, pid)
+        return True
+
+    def process_owner(self, pid: int) -> Optional[str]:
+        proc = self._host().processes.get(pid)
+        return proc.user if proc is not None and proc.alive else None
+
+    def process_owners(self, pids: List[int]) -> Dict[int, str]:
+        return {p: owner for p in pids if (owner := self.process_owner(p)) is not None}
+
+    # -- PTY ops -----------------------------------------------------------
+    def pty_sessions(self) -> List[Tuple[str, str]]:
+        return list(self._host().ptys)
+
+    def write_to_ptys(self, ttys: List[str], message: str) -> None:
+        host = self._host()
+        for tty in ttys:
+            host.pty_messages.setdefault(tty, []).append(message)
+
+
+class FakeOpsFactory(OpsFactory):
+    def __init__(self, cluster: FakeCluster) -> None:
+        super().__init__(transport_manager=None)
+        self.cluster = cluster
+
+    def ops_for(self, hostname: str, user: Optional[str] = None) -> FakeHostOps:
+        return FakeHostOps(self.cluster, hostname, user=user)
+
+    @property
+    def hostnames(self) -> List[str]:
+        return list(self.cluster.hosts)

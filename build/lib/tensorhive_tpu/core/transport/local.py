@@ -1,0 +1,54 @@
+"""Local subprocess transport.
+
+Runs commands directly on this machine — the degenerate "cluster of one"
+case: single-VM installs where the manager daemon runs on the TPU VM itself,
+and the localhost CPU example (BASELINE.json config 1). Also the transport
+that makes the nursery's real shell path exercisable in CI without SSH.
+"""
+from __future__ import annotations
+
+import subprocess
+from typing import Optional
+
+from ...config import Config, HostConfig
+from ...utils.exceptions import TransportError
+from .base import CommandResult, Transport, register_backend
+
+
+class LocalTransport(Transport):
+    def __init__(self, host: HostConfig, user: Optional[str] = None, config: Optional[Config] = None) -> None:
+        super().__init__(host, user)
+        self.timeout_s = (config.ssh.timeout_s if config else 10.0)
+
+    def run(self, command: str, timeout: Optional[float] = None) -> CommandResult:
+        try:
+            proc = subprocess.run(
+                ["bash", "-c", command],
+                capture_output=True,
+                text=True,
+                timeout=timeout or self.timeout_s,
+            )
+        except subprocess.TimeoutExpired as exc:
+            raise TransportError(f"[{self.hostname}] local command timed out: {command!r}") from exc
+        except OSError as exc:
+            raise TransportError(f"[{self.hostname}] local exec failed: {exc}") from exc
+        return CommandResult(
+            host=self.hostname,
+            command=command,
+            exit_code=proc.returncode,
+            stdout=proc.stdout,
+            stderr=proc.stderr,
+        )
+
+
+    def put_file(self, local_path: str, remote_path: str, mode: int = 0o755) -> None:
+        import os
+        import shutil
+
+        expanded = os.path.expandvars(os.path.expanduser(remote_path))
+        os.makedirs(os.path.dirname(expanded) or ".", exist_ok=True)
+        shutil.copyfile(local_path, expanded)
+        os.chmod(expanded, mode)
+
+
+register_backend("local", LocalTransport)

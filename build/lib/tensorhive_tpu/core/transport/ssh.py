@@ -1,0 +1,147 @@
+"""OpenSSH-client transport (control plane to TPU VMs).
+
+Reference: tensorhive/core/ssh.py wraps parallel-ssh (libssh2 C bindings,
+setup.py:57,71). This rebuild shells out to the system ``ssh`` binary in
+BatchMode with connection multiplexing (ControlMaster), which gives
+libssh2-class amortized latency (one TCP/auth handshake per host, reused by
+every subsequent command) with zero Python-level dependencies. Proxy-jump
+support mirrors the reference's ``[proxy_tunneling]`` section
+(config.py:137-150).
+"""
+from __future__ import annotations
+
+import shlex
+import shutil
+import subprocess
+from typing import List, Optional
+
+from ...config import Config, HostConfig
+from ...utils.exceptions import TransportError
+from .base import CommandResult, Transport, register_backend
+
+
+class SshTransport(Transport):
+    def __init__(self, host: HostConfig, user: Optional[str] = None, config: Optional[Config] = None) -> None:
+        super().__init__(host, user)
+        if shutil.which("ssh") is None:
+            raise TransportError(
+                "openssh client not found on PATH; use backend='local' or 'fake'"
+            )
+        self._config = config
+        self.timeout_s = config.ssh.timeout_s if config else 10.0
+
+    def _common_options(self) -> List[str]:
+        """Options shared by ssh and scp invocations (port excluded: ssh
+        spells it -p, scp spells it -P)."""
+        cfg = self._config
+        args = [
+            "-o", "BatchMode=yes",
+            "-o", "StrictHostKeyChecking=accept-new",
+            "-o", f"ConnectTimeout={int(self.timeout_s)}",
+            # multiplex: reuse one authenticated connection per (host,user)
+            "-o", "ControlMaster=auto",
+            "-o", "ControlPersist=60s",
+            "-o", "ControlPath=~/.ssh/tpuhive-%r@%h:%p",
+        ]
+        if cfg is not None:
+            key_path = cfg.ssh_key_path
+            if key_path.exists():
+                args += ["-i", str(key_path)]
+            if cfg.ssh.proxy_host:
+                proxy_user = cfg.ssh.proxy_user or self.user
+                args += [
+                    "-J", f"{proxy_user}@{cfg.ssh.proxy_host}:{cfg.ssh.proxy_port}"
+                ]
+        return args
+
+    def _base_args(self) -> List[str]:
+        return ["ssh"] + self._common_options() + ["-p", str(self.host.port)]
+
+    def run(self, command: str, timeout: Optional[float] = None) -> CommandResult:
+        target = f"{self.user}@{self.host.address}" if self.user else self.host.address
+        argv = self._base_args() + [target, command]
+        try:
+            proc = subprocess.run(
+                argv,
+                capture_output=True,
+                text=True,
+                timeout=(timeout or self.timeout_s) + self.timeout_s,
+            )
+        except subprocess.TimeoutExpired as exc:
+            raise TransportError(
+                f"[{self.hostname}] ssh timed out running {command!r}"
+            ) from exc
+        except OSError as exc:
+            raise TransportError(f"[{self.hostname}] ssh exec failed: {exc}") from exc
+        if proc.returncode == 255 and _looks_like_ssh_failure(proc.stderr):
+            # 255 is ssh's own "connection/auth failed" exit code, but a
+            # remote command may legitimately exit 255 too — only treat it as
+            # a channel failure when stderr carries ssh's own diagnostics
+            raise TransportError(
+                f"[{self.hostname}] ssh connection failed: {proc.stderr.strip()}"
+            )
+        return CommandResult(
+            host=self.hostname,
+            command=command,
+            exit_code=proc.returncode,
+            stdout=proc.stdout,
+            stderr=proc.stderr,
+        )
+
+
+    def put_file(self, local_path: str, remote_path: str, mode: int = 0o755) -> None:
+        """scp with the same multiplexed connection options as run()."""
+        target = f"{self.user}@{self.host.address}" if self.user else self.host.address
+        remote_path = self.expand_remote_path(remote_path)
+        self.check_output(f'mkdir -p "$(dirname {shlex.quote(remote_path)})"')
+        argv = ["scp"] + self._common_options() + ["-P", str(self.host.port),
+                local_path, f"{target}:{remote_path}"]
+        try:
+            proc = subprocess.run(argv, capture_output=True, text=True,
+                                  timeout=self.timeout_s * 6)
+        except (subprocess.TimeoutExpired, OSError) as exc:
+            raise TransportError(f"[{self.hostname}] scp failed: {exc}") from exc
+        if proc.returncode != 0:
+            raise TransportError(
+                f"[{self.hostname}] scp failed: {proc.stderr.strip()}"
+            )
+        self.check_output(f"chmod {mode:o} {shlex.quote(remote_path)}")
+
+
+_SSH_FAILURE_MARKERS = (
+    "ssh:",                    # "ssh: connect to host ... "
+    "Permission denied",
+    "Host key verification failed",
+    "Connection timed out",
+    "Connection refused",
+    "Connection closed",
+    "kex_exchange",
+    "Could not resolve hostname",
+    "No route to host",
+)
+
+
+def _looks_like_ssh_failure(stderr: str) -> bool:
+    return any(marker in stderr for marker in _SSH_FAILURE_MARKERS)
+
+
+def generate_keypair(key_path) -> str:
+    """Create the manager's RSA keypair if absent; return the public key
+    (reference: core/ssh.py:131-146 generate_cert/init_ssh_key)."""
+    import os
+
+    key_path = str(key_path)
+    if not os.path.exists(key_path):
+        os.makedirs(os.path.dirname(key_path), exist_ok=True)
+        if shutil.which("ssh-keygen") is None:
+            raise TransportError("ssh-keygen not available to create key")
+        subprocess.run(
+            ["ssh-keygen", "-t", "rsa", "-b", "3072", "-N", "", "-f", key_path, "-q"],
+            check=True,
+        )
+        os.chmod(key_path, 0o600)
+    with open(key_path + ".pub") as fh:
+        return fh.read().strip()
+
+
+register_backend("ssh", SshTransport)

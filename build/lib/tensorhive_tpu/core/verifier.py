@@ -1,0 +1,132 @@
+"""ReservationVerifier: may this user hold this chip for this window?
+
+Reference: tensorhive/core/utils/ReservationVerifier.py:6-115 — a reservation
+is allowed iff its [start, end) interval is fully covered by the union of the
+user's active restrictions that include the reserved resource, where each
+restriction contributes its [starts_at, ends_at) window intersected with its
+weekly schedules (interval-sweeping algorithm :7-44,46-89). When permissions
+change, existing reservations are (un)cancelled to match
+(update_user_reservations_statuses :91-115).
+"""
+from __future__ import annotations
+
+from datetime import datetime, time, timedelta
+from typing import List, Optional, Tuple
+
+from ..db.models.reservation import Reservation
+from ..db.models.resource import Resource
+from ..db.models.user import User
+from ..utils.timeutils import iso_utc, utcnow
+
+Interval = Tuple[datetime, datetime]
+
+
+def _merge(intervals: List[Interval]) -> List[Interval]:
+    """Sort + coalesce overlapping/touching intervals."""
+    merged: List[Interval] = []
+    for start, end in sorted(intervals):
+        if merged and start <= merged[-1][1]:
+            merged[-1] = (merged[-1][0], max(merged[-1][1], end))
+        else:
+            merged.append((start, end))
+    return merged
+
+
+def _covers(intervals: List[Interval], start: datetime, end: datetime) -> bool:
+    cursor = start
+    for iv_start, iv_end in _merge(intervals):
+        if iv_start > cursor:
+            return False
+        cursor = max(cursor, iv_end)
+        if cursor >= end:
+            return True
+    return cursor >= end
+
+
+def _schedule_windows(schedule, lo: datetime, hi: datetime) -> List[Interval]:
+    """Expand one weekly schedule into concrete intervals inside [lo, hi]
+    (reference sweep over days, ReservationVerifier.py:46-89). Overnight
+    windows (hour_end < hour_start) roll past midnight."""
+    windows: List[Interval] = []
+    hour_start: time = schedule.parsed_hour_start
+    hour_end: time = schedule.parsed_hour_end
+    schedule_days = schedule.days
+    day = (lo - timedelta(days=1)).date()  # back one day for overnight spill
+    last = hi.date()
+    while day <= last:
+        if day.isoweekday() in schedule_days:
+            start = datetime.combine(day, hour_start)
+            end = datetime.combine(day, hour_end)
+            if end <= start:
+                end += timedelta(days=1)
+            windows.append((start, end))
+        day += timedelta(days=1)
+    return windows
+
+
+def restriction_intervals(restriction, lo: datetime, hi: datetime) -> List[Interval]:
+    """Concrete allowed intervals a restriction contributes within [lo, hi]."""
+    start = max(restriction.starts_at, lo)
+    end = min(restriction.ends_at, hi) if restriction.ends_at is not None else hi
+    if end <= start:
+        return []
+    schedules = restriction.schedules
+    if not schedules:
+        return [(start, end)]
+    out: List[Interval] = []
+    for schedule in schedules:
+        for win_start, win_end in _schedule_windows(schedule, start, end):
+            clipped = (max(win_start, start), min(win_end, end))
+            if clipped[1] > clipped[0]:
+                out.append(clipped)
+    return out
+
+
+def is_reservation_allowed(user: User, reservation: Reservation) -> bool:
+    """Reference ReservationVerifier.is_reservation_allowed."""
+    if user.has_role("admin"):
+        return True
+    resource = Resource.get_by_uid(reservation.resource_id)
+    intervals: List[Interval] = []
+    for restriction in user.get_restrictions():
+        if not restriction.is_global:
+            if resource is None:
+                continue
+            if resource.id not in [r.id for r in restriction.resources]:
+                continue
+        intervals.extend(
+            restriction_intervals(restriction, reservation.start, reservation.end)
+        )
+    return _covers(intervals, reservation.start, reservation.end)
+
+
+def reverify_user(user: User, allow_grant: bool = True, allow_revoke: bool = True) -> None:
+    """Single sweep over the user's future reservations: cancel those no
+    longer permitted (``allow_revoke``), un-cancel auto-cancelled ones that
+    became permitted again (``allow_grant``). An un-cancel is skipped when
+    the slot was re-booked meanwhile — re-activating it would raise a
+    conflict mid-sweep and abort re-verification of the remaining rows."""
+    now = utcnow()
+    future = Reservation.where(
+        "user_id = ? AND end > ?", [user.id, iso_utc(now)]
+    )
+    for reservation in future:
+        allowed = is_reservation_allowed(user, reservation)
+        if allow_grant and reservation.is_cancelled and allowed:
+            if reservation.would_interfere():
+                continue
+            reservation.is_cancelled = False
+            reservation.save()
+        elif allow_revoke and not reservation.is_cancelled and not allowed:
+            reservation.is_cancelled = True
+            reservation.save()
+
+
+def update_user_reservations_statuses(user: User, have_users_permissions_increased: bool) -> None:
+    """Directional wrapper matching the reference's API
+    (ReservationVerifier.update_user_reservations_statuses :91-115)."""
+    reverify_user(
+        user,
+        allow_grant=have_users_permissions_increased,
+        allow_revoke=not have_users_permissions_increased,
+    )

@@ -1,0 +1,207 @@
+"""Token data pipeline: memmapped shards → deterministic batches → device.
+
+The reference leaves data entirely to the launched user program (its job
+module just spawns commands, SURVEY.md §0); a complete training framework
+needs the input path too. Design goals, TPU-first:
+
+* **Stateless, step-addressable sampling** — ``batch_at(step)`` derives the
+  batch purely from (seed, step), so preemption/resume (the queued-workload
+  path, examples/queued_training) needs no iterator state in checkpoints:
+  restoring the step count restores the data position exactly.
+* **Multihost sharding** — each host materializes only its slice of the
+  global batch (``host_batch_at``), matching ``parallel/mesh.batch_sharding``
+  row order, so a jax.distributed run feeds per-host shards that concatenate
+  to the same global batch every single-host run would see.
+* **Host→device prefetch** — double-buffered ``jax.device_put`` so the next
+  batch's transfer overlaps the current step (HBM stays the bottleneck, not
+  PCIe/host).
+
+Shard format: raw little-endian token files (uint16 for vocab ≤ 65536,
+uint32 otherwise), concatenated logically in sorted filename order — the
+format produced by the common GPT tokenizer dump scripts.
+"""
+from __future__ import annotations
+
+import dataclasses
+import glob as globlib
+import threading
+from typing import Iterator, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class DataConfig:
+    pattern: str                 # glob for token shard files
+    seq_len: int = 1024          # model sequence length (batches are +1 wide)
+    batch_size: int = 8          # GLOBAL batch size
+    seed: int = 0
+    dtype: str = "uint16"
+    #: model vocabulary size; when set, every produced batch is validated —
+    #: jax's gather silently CLAMPS out-of-range ids, so a tokenizer/model
+    #: vocab mismatch would otherwise train on corrupted data with healthy-
+    #: looking metrics
+    vocab_size: Optional[int] = None
+
+
+class TokenDataset:
+    """Logically concatenated memmapped token shards with deterministic,
+    step-addressable window sampling."""
+
+    def __init__(self, config: DataConfig) -> None:
+        self.config = config
+        paths = sorted(globlib.glob(config.pattern))
+        if not paths:
+            raise FileNotFoundError(f"no token shards match {config.pattern!r}")
+        self._shards: List[np.memmap] = [
+            np.memmap(path, dtype=np.dtype(config.dtype), mode="r")
+            for path in paths
+        ]
+        lengths = [len(shard) for shard in self._shards]
+        #: exclusive prefix sums: shard i covers [starts[i], starts[i+1])
+        self._starts = np.concatenate([[0], np.cumsum(lengths)])
+        self.total_tokens = int(self._starts[-1])
+        self.window = config.seq_len + 1          # inputs + shifted targets
+        if self.total_tokens < self.window:
+            raise ValueError(
+                f"dataset has {self.total_tokens} tokens < one "
+                f"window of {self.window}")
+
+    # -- addressing ---------------------------------------------------------
+
+    def _read_window(self, offset: int) -> np.ndarray:
+        """Window [offset, offset+window) across shard boundaries."""
+        out = np.empty(self.window, np.int32)
+        filled = 0
+        while filled < self.window:
+            pos = offset + filled
+            shard_index = int(np.searchsorted(self._starts, pos, side="right")) - 1
+            shard = self._shards[shard_index]
+            local = pos - int(self._starts[shard_index])
+            take = min(self.window - filled, len(shard) - local)
+            out[filled:filled + take] = shard[local:local + take]
+            filled += take
+        return out
+
+    def _offsets_at(self, step: int) -> np.ndarray:
+        """All window offsets for ``step``, from a counter-based RNG keyed
+        on (seed, step) — any process computes the identical offsets for a
+        given step, across restarts, hosts, and topology changes."""
+        config = self.config
+        rng = np.random.Generator(np.random.Philox(
+            key=np.uint64(config.seed), counter=[0, 0, 0, np.uint64(step)]))
+        return rng.integers(
+            0, self.total_tokens - self.window + 1, size=config.batch_size)
+
+    def _check_vocab(self, batch: np.ndarray) -> np.ndarray:
+        vocab = self.config.vocab_size
+        if vocab is not None:
+            top = int(batch.max())
+            if top >= vocab:
+                raise ValueError(
+                    f"shard token id {top} >= model vocab_size {vocab} — "
+                    f"tokenizer/model mismatch (jax would silently clamp)")
+        return batch
+
+    def batch_at(self, step: int) -> np.ndarray:
+        """Global batch for ``step``: [batch_size, seq_len+1] int32."""
+        return self._check_vocab(np.stack(
+            [self._read_window(int(o)) for o in self._offsets_at(step)]))
+
+    def host_batch_at(self, step: int, process_index: Optional[int] = None,
+                      process_count: Optional[int] = None) -> np.ndarray:
+        """This host's contiguous row-slice of the global batch (row order
+        matches batch_sharding). Only this host's rows touch disk — offsets
+        are cheap to generate globally, windows are not."""
+        if process_index is None:
+            process_index = jax.process_index()
+        if process_count is None:
+            process_count = jax.process_count()
+        if self.config.batch_size % process_count:
+            raise ValueError(
+                f"global batch {self.config.batch_size} not divisible by "
+                f"{process_count} processes")
+        rows = self.config.batch_size // process_count
+        offsets = self._offsets_at(step)[process_index * rows:
+                                         (process_index + 1) * rows]
+        return self._check_vocab(
+            np.stack([self._read_window(int(o)) for o in offsets]))
+
+
+def prefetch_to_device(
+    dataset: TokenDataset,
+    start_step: int,
+    num_steps: int,
+    sharding=None,
+    buffer_size: int = 2,
+) -> Iterator[jax.Array]:
+    """Iterate device-resident batches for steps [start_step, start_step +
+    num_steps), reading + transferring ``buffer_size`` batches ahead of the
+    consumer on a background thread."""
+    import queue
+
+    todo = queue.Queue(maxsize=buffer_size)
+    stop = threading.Event()
+    multihost = jax.process_count() > 1
+
+    def to_device(host_rows):
+        if multihost:
+            # each process contributes only its local rows; jax assembles
+            # the global array matching the sharding's per-process layout
+            return jax.make_array_from_process_local_data(sharding, host_rows)
+        return jax.device_put(host_rows, sharding)
+
+    def enqueue(item) -> bool:
+        """put() that keeps observing stop so an abandoned consumer never
+        leaves this thread parked on a full queue holding device buffers."""
+        while not stop.is_set():
+            try:
+                todo.put(item, timeout=0.1)
+                return True
+            except queue.Full:
+                continue
+        return False
+
+    def producer():
+        try:
+            for step in range(start_step, start_step + num_steps):
+                if stop.is_set():
+                    return
+                host = dataset.host_batch_at(step) if multihost \
+                    else dataset.batch_at(step)
+                if not enqueue(to_device(host)):
+                    return
+            enqueue(None)
+        except BaseException as exc:  # surfaces in the consumer, not lost
+            enqueue(exc)
+
+    thread = threading.Thread(target=producer, daemon=True,
+                              name="data-prefetch")
+    thread.start()
+    try:
+        while True:
+            item = todo.get()
+            if item is None:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
+
+
+def fake_shards(directory, num_shards: int = 2, tokens_per_shard: int = 4096,
+                vocab_size: int = 32_000, seed: int = 0,
+                dtype: str = "uint16") -> str:
+    """Write synthetic token shards; returns the glob pattern. Test/demo
+    helper so examples are runnable without a corpus."""
+    rng = np.random.default_rng(seed)
+    from pathlib import Path
+
+    directory = Path(directory)
+    directory.mkdir(parents=True, exist_ok=True)
+    for index in range(num_shards):
+        tokens = rng.integers(0, vocab_size, size=tokens_per_shard)
+        tokens.astype(np.dtype(dtype)).tofile(directory / f"shard_{index:04d}.bin")
+    return str(directory / "shard_*.bin")

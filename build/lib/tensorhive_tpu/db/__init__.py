@@ -1,0 +1,13 @@
+"""Persistence: sqlite3 engine, declarative ORM-lite, schema migrations.
+
+Reference: tensorhive/database.py + tensorhive/models/ built on SQLAlchemy +
+Alembic. Neither is assumed available here; the rebuild uses a small
+stdlib-``sqlite3`` declarative layer with the same capabilities the reference
+actually exercises: CRUD base with save-time validation hooks
+(models/CRUDModel.py:11-94), scoped per-process access, in-memory DB under
+pytest (database.py:15-18), foreign keys ON (database.py:90-94), and
+sequential schema migrations (``PRAGMA user_version`` standing in for Alembic
+revisions, database.py:72-87).
+"""
+from .engine import Engine, get_engine, reset_engine, set_engine  # noqa: F401
+from .orm import Column, Model, create_all  # noqa: F401

@@ -1,0 +1,134 @@
+"""SQLite engine: one serialized connection per process.
+
+Reference: tensorhive/database.py:15-23 (engine + scoped session; in-memory
+SQLite when ``PYTEST`` env set, config.py:164). The reference shares one
+scoped session across API threads and service threads (SURVEY.md §3.5
+boundary notes); here all access goes through a single connection guarded by
+an RLock — writes in a cluster manager are rare and tiny, so serialization is
+simpler and race-free. File databases get WAL mode for concurrent readers.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import sqlite3
+import threading
+from typing import Any, Iterable, Optional
+
+from ..config import get_config
+
+log = logging.getLogger(__name__)
+
+
+class Engine:
+    def __init__(self, path: str) -> None:
+        self.path = path
+        self._lock = threading.RLock()
+        self._txn_depth = 0  # >0 while inside an explicit transaction()
+        if path != ":memory:":
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn.row_factory = sqlite3.Row
+        with self._lock:
+            self._conn.execute("PRAGMA foreign_keys = ON")
+            if path != ":memory:":
+                self._conn.execute("PRAGMA journal_mode = WAL")
+
+    # -- statement API -----------------------------------------------------
+    def execute(self, sql: str, params: Iterable[Any] = ()) -> sqlite3.Cursor:
+        with self._lock:
+            cursor = self._conn.execute(sql, tuple(params))
+            if self._txn_depth == 0:
+                self._conn.commit()
+            return cursor
+
+    def executemany(self, sql: str, rows: Iterable[Iterable[Any]]) -> None:
+        with self._lock:
+            self._conn.executemany(sql, [tuple(r) for r in rows])
+            if self._txn_depth == 0:
+                self._conn.commit()
+
+    def query(self, sql: str, params: Iterable[Any] = ()) -> list:
+        with self._lock:
+            return self._conn.execute(sql, tuple(params)).fetchall()
+
+    def scalar(self, sql: str, params: Iterable[Any] = ()) -> Any:
+        rows = self.query(sql, params)
+        return rows[0][0] if rows else None
+
+    def transaction(self) -> "_Transaction":
+        """Explicit multi-statement transaction (scheduler state flips)."""
+        return _Transaction(self)
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+    @property
+    def user_version(self) -> int:
+        return int(self.scalar("PRAGMA user_version"))
+
+    @user_version.setter
+    def user_version(self, value: int) -> None:
+        self.execute(f"PRAGMA user_version = {int(value)}")
+
+
+class _Transaction:
+    """Holds the engine lock for its whole extent and defers commit to exit,
+    so multi-statement sequences are atomic (vs other threads) AND
+    all-or-nothing (rollback undoes every statement issued inside)."""
+
+    def __init__(self, engine: Engine) -> None:
+        self._engine = engine
+
+    def __enter__(self) -> sqlite3.Connection:
+        self._engine._lock.acquire()
+        self._engine._txn_depth += 1
+        return self._engine._conn
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        engine = self._engine
+        try:
+            engine._txn_depth -= 1
+            if engine._txn_depth == 0:
+                if exc_type is None:
+                    engine._conn.commit()
+                else:
+                    engine._conn.rollback()
+        finally:
+            engine._lock.release()
+        return False
+
+
+# ---------------------------------------------------------------------------
+_engine: Optional[Engine] = None
+_engine_lock = threading.Lock()
+
+
+def get_engine() -> Engine:
+    """Process-wide engine, created on first use against the configured DB
+    path (in-memory under pytest). Schema is ensured on creation — the
+    equivalent of the reference's ``ensure_db_with_current_schema``
+    (database.py:72-87)."""
+    global _engine
+    with _engine_lock:
+        if _engine is None:
+            from .migrations import ensure_schema
+
+            _engine = Engine(get_config().db_path)
+            ensure_schema(_engine)
+        return _engine
+
+
+def set_engine(engine: Engine) -> None:
+    global _engine
+    with _engine_lock:
+        _engine = engine
+
+
+def reset_engine() -> None:
+    global _engine
+    with _engine_lock:
+        if _engine is not None:
+            _engine.close()
+        _engine = None

@@ -1,0 +1,61 @@
+"""Sequential schema migrations via ``PRAGMA user_version``.
+
+Reference: tensorhive/database.py:72-87 creates the schema then
+Alembic-stamps/upgrades on boot (18 revisions under tensorhive/migrations/).
+Here each migration is a ``(version, fn)`` pair applied in order; a fresh DB
+gets ``create_all`` and is stamped at the latest version directly.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Callable, List, Tuple
+
+from .engine import Engine
+from .orm import create_all
+
+log = logging.getLogger(__name__)
+
+
+def _column_names(engine: Engine, table: str) -> List[str]:
+    return [row[1] for row in engine.execute(f"PRAGMA table_info({table})")]
+
+
+def _add_column(engine: Engine, table: str, column: str, ddl_type: str) -> None:
+    """Idempotent ADD COLUMN: safe to re-run after a crash mid-upgrade."""
+    if column not in _column_names(engine, table):
+        engine.execute(f"ALTER TABLE {table} ADD COLUMN {column} {ddl_type}")
+
+
+def _migration_2_user_last_login(engine: Engine) -> None:
+    """v1 → v2: ``users.last_login_at`` (ISO-8601 TEXT, set by the login
+    controller; shown in the users admin view)."""
+    _add_column(engine, "users", "last_login_at", "TEXT")
+
+
+# append (version, fn) pairs as the schema evolves; fn(engine) must be
+# idempotent enough to re-run after a crash mid-upgrade.
+MIGRATIONS: List[Tuple[int, Callable[[Engine], None]]] = [
+    (2, _migration_2_user_last_login),
+]
+
+SCHEMA_VERSION = 2
+
+
+def ensure_schema(engine: Engine) -> None:
+    from . import models  # noqa: F401  (register all tables)
+
+    current = engine.user_version
+    if current == 0:
+        create_all(engine)
+        engine.user_version = SCHEMA_VERSION
+        log.info("database schema created at version %d", SCHEMA_VERSION)
+        return
+    for version, migrate in MIGRATIONS:
+        if version > current:
+            log.info("applying migration %d", version)
+            migrate(engine)
+            engine.user_version = version
+    # create any tables added since the stamped version (additive changes)
+    create_all(engine)
+    if engine.user_version < SCHEMA_VERSION:
+        engine.user_version = SCHEMA_VERSION

@@ -1,0 +1,20 @@
+"""Domain entities (reference: tensorhive/models/).
+
+Importing this package registers every table with the ORM so
+:func:`tensorhive_tpu.db.create_all` sees the full schema (the same role as
+the reference's migrations/env.py:10-22 importing all models).
+"""
+from .user import User, Role, Group, User2Group  # noqa: F401
+from .resource import Resource  # noqa: F401
+from .reservation import Reservation  # noqa: F401
+from .restriction import (  # noqa: F401
+    Restriction,
+    Restriction2User,
+    Restriction2Group,
+    Restriction2Resource,
+    Restriction2Schedule,
+)
+from .schedule import RestrictionSchedule  # noqa: F401
+from .job import Job, JobStatus  # noqa: F401
+from .task import Task, TaskStatus, CommandSegment, CommandSegment2Task, SegmentType  # noqa: F401
+from .token import RevokedToken  # noqa: F401

@@ -1,0 +1,157 @@
+"""Jobs: containers of distributed tasks (reference: tensorhive/models/Job.py:24-158).
+
+A job owns an ordered set of :class:`~.task.Task` rows — one process per
+host/worker of a distributed training run. Status is derived from task
+statuses (``synchronize_status``, reference Job.py:81-99). Jobs can be
+scheduled for timed start/stop (``start_at``/``stop_at``) or placed in the
+queue, from which :class:`JobSchedulingService` launches them when their
+chips are free of reservations (reference Job.py:101-157).
+"""
+from __future__ import annotations
+
+import enum
+from datetime import datetime
+from typing import Any, Dict, List, Optional
+
+from ...utils.exceptions import ValidationError
+from ...utils.timeutils import iso_utc, utcnow
+from ..orm import Column, Model
+
+
+class JobStatus(str, enum.Enum):
+    """Reference: models/Job.py:16-22 status enum."""
+
+    not_running = "not_running"
+    running = "running"
+    pending = "pending"
+    terminated = "terminated"
+    unsynchronized = "unsynchronized"
+
+
+class Job(Model):
+    __tablename__ = "jobs"
+    __public__ = ("id", "name", "description", "user_id", "status", "start_at", "stop_at", "is_queued")
+
+    id = Column(int, primary_key=True)
+    name = Column(str, nullable=False)
+    description = Column(str, default="")
+    user_id = Column(int, nullable=False, foreign_key="users(id)", index=True)
+    _status = Column(str, default=JobStatus.not_running.value)
+    start_at = Column(datetime)      # timed start (reference _start_at)
+    stop_at = Column(datetime)       # timed stop (reference _stop_at)
+    is_queued = Column(bool, default=False)
+    queued_at = Column(datetime)
+
+    def check_assertions(self) -> None:
+        if not self.name:
+            raise ValidationError("job name must not be empty")
+        if self._status not in JobStatus.__members__:
+            raise ValidationError(f"invalid job status {self._status!r}")
+        if self.start_at and self.stop_at and self.stop_at <= self.start_at:
+            raise ValidationError("job stop_at must be after start_at")
+
+    # -- status ------------------------------------------------------------
+    @property
+    def status(self) -> JobStatus:
+        return JobStatus(self._status)
+
+    @status.setter
+    def status(self, value) -> None:
+        self._status = JobStatus(value).value
+
+    def synchronize_status(self) -> None:
+        """Derive job status from its tasks (reference Job.py:81-99): any
+        task running → running; any unsynchronized → unsynchronized; all
+        terminated → terminated; otherwise not_running."""
+        statuses = {t.status for t in self.tasks}
+        from .task import TaskStatus
+
+        if TaskStatus.running in statuses:
+            self.status = JobStatus.running
+        elif TaskStatus.unsynchronized in statuses:
+            self.status = JobStatus.unsynchronized
+        elif statuses and statuses <= {TaskStatus.terminated}:
+            self.status = JobStatus.terminated
+        else:
+            self.status = JobStatus.not_running
+        self.save()
+
+    # -- tasks -------------------------------------------------------------
+    @property
+    def tasks(self) -> List:
+        from .task import Task
+
+        return Task.filter_by(job_id=self.id)
+
+    @property
+    def hostnames(self) -> List[str]:
+        seen: List[str] = []
+        for task in self.tasks:
+            if task.hostname not in seen:
+                seen.append(task.hostname)
+        return seen
+
+    @property
+    def chip_uids(self) -> List[str]:
+        """All chips this job's tasks claim (for reservation checks)."""
+        uids: List[str] = []
+        for task in self.tasks:
+            uids.extend(task.chip_uids)
+        return uids
+
+    # -- queue (reference Job.py:101-157) ----------------------------------
+    def enqueue(self) -> None:
+        if self.status == JobStatus.running:
+            raise ValidationError("cannot enqueue a running job")
+        self.is_queued = True
+        self.queued_at = utcnow()
+        self.status = JobStatus.pending
+        self.save()
+
+    def dequeue(self) -> None:
+        self.is_queued = False
+        self.queued_at = None
+        if self.status == JobStatus.pending:
+            self.status = JobStatus.not_running
+        self.save()
+
+    @classmethod
+    def get_job_queue(cls) -> List["Job"]:
+        """Queued jobs awaiting execution, FIFO (reference Job.py:153)."""
+        jobs = cls.where("is_queued = 1 AND _status = ?", [JobStatus.pending.value])
+        jobs.sort(key=lambda j: (j.queued_at or utcnow(), j.id))
+        return jobs
+
+    @classmethod
+    def get_jobs_running_from_queue(cls) -> List["Job"]:
+        """Running jobs that were started by the queue scheduler
+        (reference Job.py:157) — candidates for preemption."""
+        return cls.where("is_queued = 1 AND _status = ?", [JobStatus.running.value])
+
+    @classmethod
+    def find_scheduled_to_start(cls, at: Optional[datetime] = None) -> List["Job"]:
+        """Timed jobs due to start — and not already past their stop time
+        (reference can_execute_now requires start_at < now < stop_at,
+        JobSchedulingService.py:54-61); an expired window must not trigger a
+        late spawn/kill cycle after downtime."""
+        at = at or utcnow()
+        return cls.where(
+            "start_at IS NOT NULL AND start_at <= ? "
+            "AND (stop_at IS NULL OR stop_at > ?) AND _status IN (?, ?)",
+            [iso_utc(at), iso_utc(at),
+             JobStatus.not_running.value, JobStatus.pending.value],
+        )
+
+    @classmethod
+    def find_scheduled_to_stop(cls, at: Optional[datetime] = None) -> List["Job"]:
+        at = at or utcnow()
+        return cls.where(
+            "stop_at IS NOT NULL AND stop_at <= ? AND _status = ?",
+            [iso_utc(at), JobStatus.running.value],
+        )
+
+    def as_dict(self, include_private: bool = False) -> Dict[str, Any]:
+        out = super().as_dict(include_private)
+        out["status"] = self.status.value
+        out["tasks"] = [t.as_dict() for t in self.tasks]
+        return out

@@ -1,0 +1,142 @@
+"""Reservation calendar events (reference: tensorhive/models/Reservation.py:14-168).
+
+One reservation grants a user exclusive use of one chip (Resource uid) for a
+UTC time window. Invariants enforced at save time mirror the reference's
+(Reservation.py:38-56): duration within [30 min, 8 days], end after start,
+and no overlap with other non-cancelled reservations for the same resource
+(``would_interfere``, Reservation.py:120-131). Usage-average columns are the
+TPU analogs of the reference's ``gpu_util_avg``/``mem_util_avg``: duty-cycle
+(MXU activity) and HBM utilization, filled by the usage-logging service.
+"""
+from __future__ import annotations
+
+from datetime import datetime, timedelta
+from typing import Any, Dict, Iterable, List, Optional
+
+from ...utils.exceptions import ConflictError, ValidationError
+from ...utils.timeutils import iso_utc, utcnow
+from ..orm import Column, Model
+
+
+class Reservation(Model):
+    __tablename__ = "reservations"
+    __public__ = (
+        "id", "title", "description", "resource_id", "user_id",
+        "start", "end", "is_cancelled", "duty_cycle_avg", "hbm_util_avg",
+    )
+
+    id = Column(int, primary_key=True)
+    title = Column(str, nullable=False)
+    description = Column(str, default="")
+    resource_id = Column(str, nullable=False, index=True)  # Resource.uid
+    user_id = Column(int, nullable=False, foreign_key="users(id)", index=True)
+    start = Column(datetime, nullable=False, index=True)
+    end = Column(datetime, nullable=False, index=True)
+    is_cancelled = Column(bool, default=False)
+    created_at = Column(datetime)
+    duty_cycle_avg = Column(float)
+    hbm_util_avg = Column(float)
+
+    MIN_DURATION = timedelta(minutes=30)
+    MAX_DURATION = timedelta(days=8)
+    MAX_RESOURCE_ID_LEN = 64
+
+    def __init__(self, **kwargs: Any) -> None:
+        kwargs.setdefault("created_at", utcnow())
+        super().__init__(**kwargs)
+
+    # -- validation (reference Reservation.py:38-56) -----------------------
+    def check_assertions(self) -> None:
+        if not self.title:
+            raise ValidationError("reservation title must not be empty")
+        if not self.resource_id or len(self.resource_id) > self.MAX_RESOURCE_ID_LEN:
+            raise ValidationError(f"invalid resource_id: {self.resource_id!r}")
+        if self.start is None or self.end is None:
+            raise ValidationError("start and end are required")
+        if self.end <= self.start:
+            raise ValidationError("reservation end must be after start")
+        duration = self.end - self.start
+        if duration < self.MIN_DURATION:
+            raise ValidationError(
+                f"reservation must last at least {self.MIN_DURATION}"
+            )
+        if duration > self.MAX_DURATION:
+            raise ValidationError(f"reservation must not exceed {self.MAX_DURATION}")
+        if self.would_interfere():
+            raise ConflictError(
+                "reservation would overlap an existing reservation for "
+                f"resource {self.resource_id}"
+            )
+
+    # -- overlap (reference Reservation.py:120-131) ------------------------
+    def would_interfere(self) -> bool:
+        clauses = "resource_id = ? AND is_cancelled = 0 AND start < ? AND end > ?"
+        params: List[Any] = [self.resource_id, iso_utc(self.end), iso_utc(self.start)]
+        if self.id is not None:
+            clauses += " AND id != ?"
+            params.append(self.id)
+        return bool(Reservation.where(clauses, params))
+
+    # -- time-window queries (reference Reservation.py:90-133) -------------
+    @classmethod
+    def current_events(cls, at: Optional[datetime] = None) -> List["Reservation"]:
+        at = at or utcnow()
+        iso = iso_utc(at)
+        return cls.where("is_cancelled = 0 AND start <= ? AND end > ?", [iso, iso])
+
+    @classmethod
+    def current_for_resource(cls, resource_id: str, at: Optional[datetime] = None) -> Optional["Reservation"]:
+        at = at or utcnow()
+        iso = iso_utc(at)
+        rows = cls.where(
+            "is_cancelled = 0 AND resource_id = ? AND start <= ? AND end > ?",
+            [resource_id, iso, iso],
+        )
+        return rows[0] if rows else None
+
+    @classmethod
+    def upcoming_events_for_resource(
+        cls, resource_id: str, at: Optional[datetime] = None
+    ) -> List["Reservation"]:
+        """Active-or-future events, soonest first (Reservation.py:107)."""
+        at = at or utcnow()
+        rows = cls.where(
+            "is_cancelled = 0 AND resource_id = ? AND end > ?",
+            [resource_id, iso_utc(at)],
+        )
+        rows.sort(key=lambda r: r.start)
+        return rows
+
+    @classmethod
+    def filter_by_uids_and_time_range(
+        cls,
+        uids: Optional[Iterable[str]] = None,
+        start: Optional[datetime] = None,
+        end: Optional[datetime] = None,
+    ) -> List["Reservation"]:
+        """Calendar read path (reference Reservation.py:133). Each filter is
+        optional: uids only, time range only, or both."""
+        clauses: List[str] = []
+        params: List[Any] = []
+        if uids is not None:
+            uids = list(uids)
+            if not uids:
+                return []
+            clauses.append(f"resource_id IN ({', '.join('?' * len(uids))})")
+            params.extend(uids)
+        if end is not None:
+            clauses.append("start < ?")
+            params.append(iso_utc(end))
+        if start is not None:
+            clauses.append("end > ?")
+            params.append(iso_utc(start))
+        if not clauses:
+            return cls.all()
+        return cls.where(" AND ".join(clauses), params)
+
+    def is_active(self, at: Optional[datetime] = None) -> bool:
+        at = at or utcnow()
+        return not self.is_cancelled and self.start <= at < self.end
+
+    def as_dict(self, include_private: bool = False) -> Dict[str, Any]:
+        return super().as_dict(include_private)

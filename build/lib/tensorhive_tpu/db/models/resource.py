@@ -1,0 +1,70 @@
+"""Physical accelerator chips (reference: tensorhive/models/Resource.py:8-61).
+
+A Resource row is one TPU chip, keyed by a stable chip UID
+(``<hostname>:tpu:<index>`` as emitted by the telemetry layer — the analog of
+the reference's 40-char GPU UUID). TPU-specific additions: slice metadata so
+the scheduler can reason about whole-slice reservations (SURVEY.md §7 risk
+"chip vs slice granularity": a v5e-16 slice = 4 VMs x 4 chips; the reference
+only ever matched single UUIDs).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ...utils.exceptions import ValidationError
+from ..orm import Column, Model
+
+
+class Resource(Model):
+    __tablename__ = "resources"
+    __public__ = ("id", "uid", "name", "hostname", "accelerator_type", "slice_name", "chip_index")
+
+    id = Column(int, primary_key=True)
+    uid = Column(str, nullable=False, unique=True)
+    name = Column(str)            # display name, e.g. "TPU v5e chip 0"
+    hostname = Column(str, index=True)
+    accelerator_type = Column(str, default="")   # "v5litepod-16", "" for CPU hosts
+    slice_name = Column(str, default="", index=True)
+    chip_index = Column(int, default=0)
+
+    MAX_UID_LEN = 64
+
+    def check_assertions(self) -> None:
+        if not self.uid or len(self.uid) > self.MAX_UID_LEN:
+            raise ValidationError(
+                f"resource uid must be 1..{self.MAX_UID_LEN} chars, got {self.uid!r}"
+            )
+
+    # -- lookups (reference Resource.py:56-61) -----------------------------
+    @classmethod
+    def get_by_uid(cls, uid: str) -> Optional["Resource"]:
+        return cls.first_by(uid=uid)
+
+    @classmethod
+    def get_by_name(cls, name: str) -> List["Resource"]:
+        return cls.filter_by(name=name)
+
+    @classmethod
+    def get_by_hostname(cls, hostname: str) -> List["Resource"]:
+        return cls.filter_by(hostname=hostname)
+
+    @classmethod
+    def get_by_slice(cls, slice_name: str) -> List["Resource"]:
+        members = cls.filter_by(slice_name=slice_name)
+        members.sort(key=lambda r: (r.hostname, r.chip_index))
+        return members
+
+    # -- restrictions (reference Resource.py:29-41, incl. global) ----------
+    def get_restrictions(self, include_global: bool = True):
+        from .restriction import Restriction
+
+        restrictions = Restriction.for_resource(self.id)
+        if include_global:
+            seen = {r.id for r in restrictions}
+            restrictions += [
+                r for r in Restriction.get_global_restrictions() if r.id not in seen
+            ]
+        return restrictions
+
+    def as_dict(self, include_private: bool = False) -> Dict[str, Any]:
+        return super().as_dict(include_private)

@@ -1,0 +1,211 @@
+"""Access restrictions (reference: tensorhive/models/Restriction.py:20-238).
+
+A restriction is a *permission grant*: "these users/groups may use these
+resources between ``starts_at`` and ``ends_at`` (None = forever), optionally
+only within attached weekly schedules". ``is_global`` restrictions apply to
+every resource (Restriction.py:187 get_global_restrictions). A user with no
+active restriction covering a chip cannot reserve it — enforced by
+:class:`~tensorhive_tpu.core.verifier.ReservationVerifier`.
+"""
+from __future__ import annotations
+
+from datetime import datetime
+from typing import Any, Dict, List, Optional
+
+from ...utils.exceptions import ValidationError
+from ...utils.timeutils import utcnow
+from ..orm import Column, Model
+
+
+class Restriction(Model):
+    __tablename__ = "restrictions"
+    __public__ = ("id", "name", "starts_at", "ends_at", "is_global", "created_at")
+
+    id = Column(int, primary_key=True)
+    name = Column(str, default="")
+    starts_at = Column(datetime, nullable=False)
+    ends_at = Column(datetime)       # None = no expiry
+    is_global = Column(bool, default=False)
+    created_at = Column(datetime)
+
+    def __init__(self, **kwargs: Any) -> None:
+        kwargs.setdefault("created_at", utcnow())
+        super().__init__(**kwargs)
+
+    def check_assertions(self) -> None:
+        if self.starts_at is None:
+            raise ValidationError("restriction starts_at is required")
+        if self.ends_at is not None and self.ends_at <= self.starts_at:
+            raise ValidationError("restriction ends_at must be after starts_at")
+
+    # -- activity (reference Restriction.py:195-204) -----------------------
+    def is_active(self, at: Optional[datetime] = None) -> bool:
+        at = at or utcnow()
+        if at < self.starts_at:
+            return False
+        if self.ends_at is not None and at >= self.ends_at:
+            return False
+        schedules = self.schedules
+        if not schedules:
+            return True
+        return any(s.is_active(at) for s in schedules)
+
+    # -- linked entities ---------------------------------------------------
+    @property
+    def users(self) -> List:
+        from .user import User
+
+        return User.get_many(
+            [l.user_id for l in Restriction2User.filter_by(restriction_id=self.id)]
+        )
+
+    @property
+    def groups(self) -> List:
+        from .user import Group
+
+        return Group.get_many(
+            [l.group_id for l in Restriction2Group.filter_by(restriction_id=self.id)]
+        )
+
+    @property
+    def resources(self) -> List:
+        from .resource import Resource
+
+        return Resource.get_many(
+            [l.resource_id for l in Restriction2Resource.filter_by(restriction_id=self.id)]
+        )
+
+    @property
+    def schedules(self) -> List:
+        from .schedule import RestrictionSchedule
+
+        return RestrictionSchedule.get_many(
+            [l.schedule_id for l in Restriction2Schedule.filter_by(restriction_id=self.id)]
+        )
+
+    # -- apply/remove (reference Restriction.py:108-178) -------------------
+    def apply_to_user(self, user) -> None:
+        with Restriction2User.atomically():
+            if not Restriction2User.filter_by(restriction_id=self.id, user_id=user.id):
+                Restriction2User(restriction_id=self.id, user_id=user.id).save()
+
+    def remove_from_user(self, user) -> None:
+        for link in Restriction2User.filter_by(restriction_id=self.id, user_id=user.id):
+            link.destroy()
+
+    def apply_to_group(self, group) -> None:
+        with Restriction2Group.atomically():
+            if not Restriction2Group.filter_by(restriction_id=self.id, group_id=group.id):
+                Restriction2Group(restriction_id=self.id, group_id=group.id).save()
+
+    def remove_from_group(self, group) -> None:
+        for link in Restriction2Group.filter_by(restriction_id=self.id, group_id=group.id):
+            link.destroy()
+
+    def apply_to_resource(self, resource) -> None:
+        with Restriction2Resource.atomically():
+            if not Restriction2Resource.filter_by(restriction_id=self.id, resource_id=resource.id):
+                Restriction2Resource(restriction_id=self.id, resource_id=resource.id).save()
+
+    def remove_from_resource(self, resource) -> None:
+        for link in Restriction2Resource.filter_by(
+            restriction_id=self.id, resource_id=resource.id
+        ):
+            link.destroy()
+
+    def apply_to_resources_by_hostname(self, hostname: str) -> int:
+        """Attach every chip of a host (reference restriction controller's
+        apply-to-hostname path, controllers/restriction.py)."""
+        from .resource import Resource
+
+        count = 0
+        for resource in Resource.get_by_hostname(hostname):
+            self.apply_to_resource(resource)
+            count += 1
+        return count
+
+    def add_schedule(self, schedule) -> None:
+        with Restriction2Schedule.atomically():
+            if not Restriction2Schedule.filter_by(
+                restriction_id=self.id, schedule_id=schedule.id
+            ):
+                Restriction2Schedule(restriction_id=self.id, schedule_id=schedule.id).save()
+
+    def remove_schedule(self, schedule) -> None:
+        for link in Restriction2Schedule.filter_by(
+            restriction_id=self.id, schedule_id=schedule.id
+        ):
+            link.destroy()
+
+    # -- queries (reference Restriction.py:180-193, RestrictionAssignee) ---
+    @classmethod
+    def get_global_restrictions(cls, include_expired: bool = False) -> List["Restriction"]:
+        rows = cls.filter_by(is_global=True)
+        if include_expired:
+            return rows
+        now = utcnow()
+        return [r for r in rows if r.ends_at is None or r.ends_at > now]
+
+    @classmethod
+    def for_user(cls, user_id: int) -> List["Restriction"]:
+        return cls.get_many(
+            [l.restriction_id for l in Restriction2User.filter_by(user_id=user_id)]
+        )
+
+    @classmethod
+    def for_group(cls, group_id: int) -> List["Restriction"]:
+        return cls.get_many(
+            [l.restriction_id for l in Restriction2Group.filter_by(group_id=group_id)]
+        )
+
+    @classmethod
+    def for_resource(cls, resource_id: int) -> List["Restriction"]:
+        return cls.get_many(
+            [l.restriction_id for l in Restriction2Resource.filter_by(resource_id=resource_id)]
+        )
+
+    def as_dict(self, include_private: bool = False) -> Dict[str, Any]:
+        out = super().as_dict(include_private)
+        out["schedules"] = [s.as_dict() for s in self.schedules]
+        out["resources"] = [r.as_dict() for r in self.resources]
+        out["users"] = [u.id for u in self.users]
+        out["groups"] = [g.id for g in self.groups]
+        return out
+
+
+class Restriction2User(Model):
+    __tablename__ = "restriction2user"
+    __table_constraints__ = ("UNIQUE(restriction_id, user_id)",)
+
+    id = Column(int, primary_key=True)
+    restriction_id = Column(int, nullable=False, foreign_key="restrictions(id)", index=True)
+    user_id = Column(int, nullable=False, foreign_key="users(id)", index=True)
+
+
+class Restriction2Group(Model):
+    __tablename__ = "restriction2group"
+    __table_constraints__ = ("UNIQUE(restriction_id, group_id)",)
+
+    id = Column(int, primary_key=True)
+    restriction_id = Column(int, nullable=False, foreign_key="restrictions(id)", index=True)
+    group_id = Column(int, nullable=False, foreign_key="groups(id)", index=True)
+
+
+class Restriction2Resource(Model):
+    __tablename__ = "restriction2resource"
+    __table_constraints__ = ("UNIQUE(restriction_id, resource_id)",)
+
+    id = Column(int, primary_key=True)
+    restriction_id = Column(int, nullable=False, foreign_key="restrictions(id)", index=True)
+    resource_id = Column(int, nullable=False, foreign_key="resources(id)", index=True)
+
+
+class Restriction2Schedule(Model):
+    """Reference: tensorhive/models/RestrictionSchedule.py:103."""
+
+    __tablename__ = "restriction2schedule"
+    __table_constraints__ = ("UNIQUE(restriction_id, schedule_id)",)
+
+    id = Column(int, primary_key=True)
+    restriction_id = Column(int, nullable=False, foreign_key="restrictions(id)", index=True)
+    schedule_id = Column(int, nullable=False, foreign_key="restriction_schedules(id)", index=True)

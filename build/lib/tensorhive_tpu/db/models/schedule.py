@@ -1,0 +1,77 @@
+"""Weekly time-window schedules attached to restrictions
+(reference: tensorhive/models/RestrictionSchedule.py:16-107).
+
+``schedule_days`` is the reference's weekday-mask string: characters '1'-'7'
+(Mon..Sun, ISO weekday numbers). ``hour_start``/``hour_end`` bound the active
+window within each listed day; windows with hour_end <= hour_start are
+rejected (the reference stores times as TIME columns with the same rule).
+"""
+from __future__ import annotations
+
+from datetime import datetime, time
+from typing import Any, Dict, List, Optional, Set
+
+from ...utils.exceptions import ValidationError
+from ...utils.timeutils import utcnow
+from ..orm import Column, Model
+
+_VALID_DAYS = set("1234567")
+
+
+class RestrictionSchedule(Model):
+    __tablename__ = "restriction_schedules"
+    __public__ = ("id", "schedule_days", "hour_start", "hour_end")
+
+    id = Column(int, primary_key=True)
+    schedule_days = Column(str, nullable=False)  # e.g. "12345"
+    hour_start = Column(str, nullable=False)     # "HH:MM"
+    hour_end = Column(str, nullable=False)       # "HH:MM"
+
+    def check_assertions(self) -> None:
+        days = set(self.schedule_days or "")
+        if not days or not days <= _VALID_DAYS:
+            raise ValidationError(
+                f"schedule_days must be a non-empty subset of '1234567', got {self.schedule_days!r}"
+            )
+        start, end = self.parsed_hour_start, self.parsed_hour_end
+        if end <= start:
+            raise ValidationError("hour_end must be after hour_start")
+
+    # -- parsing (reference RestrictionSchedule.py:95-101) -----------------
+    @staticmethod
+    def _parse_hour(value: str) -> time:
+        try:
+            hours, minutes = value.split(":")
+            return time(int(hours), int(minutes))
+        except (ValueError, AttributeError) as exc:
+            raise ValidationError(f"invalid HH:MM time: {value!r}") from exc
+
+    @property
+    def parsed_hour_start(self) -> time:
+        return self._parse_hour(self.hour_start)
+
+    @property
+    def parsed_hour_end(self) -> time:
+        return self._parse_hour(self.hour_end)
+
+    @property
+    def days(self) -> Set[int]:
+        return {int(c) for c in self.schedule_days}
+
+    # -- activity (reference RestrictionSchedule.py:77-81) -----------------
+    def is_active(self, at: Optional[datetime] = None) -> bool:
+        at = at or utcnow()
+        if at.isoweekday() not in self.days:
+            return False
+        return self.parsed_hour_start <= at.time() < self.parsed_hour_end
+
+    # -- linked restrictions ----------------------------------------------
+    @property
+    def restrictions(self) -> List:
+        from .restriction import Restriction, Restriction2Schedule
+
+        links = Restriction2Schedule.filter_by(schedule_id=self.id)
+        return [Restriction.get(link.restriction_id) for link in links]
+
+    def as_dict(self, include_private: bool = False) -> Dict[str, Any]:
+        return super().as_dict(include_private)

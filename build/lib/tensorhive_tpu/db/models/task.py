@@ -1,0 +1,225 @@
+"""Tasks: one command on one host (reference: tensorhive/models/Task.py:19-164)
+and their command segments (reference: tensorhive/models/CommandSegment.py:18-75).
+
+``full_command`` re-assembles ``ENV=val ... command --param=val ...`` from
+ordered segments exactly like the reference (Task.py:78-101), except segment
+kind is an explicit column instead of the reference's signed-index encoding
+(negative=env / positive=param, CommandSegment.py:62-75) — same information,
+no sign tricks. The chip-binding env var is ``TPU_VISIBLE_CHIPS`` (the
+reference greps ``CUDA_VISIBLE_DEVICES=`` prefixes, controllers/task.py:322-328).
+"""
+from __future__ import annotations
+
+import enum
+import shlex
+from typing import Any, Dict, List, Optional
+
+from ...utils.exceptions import ValidationError
+from ..orm import Column, Model
+
+CHIP_ENV_VAR = "TPU_VISIBLE_CHIPS"
+
+
+class TaskStatus(str, enum.Enum):
+    """Reference: task status values used by controllers/task.py:44-94."""
+
+    not_running = "not_running"
+    running = "running"
+    terminated = "terminated"
+    unsynchronized = "unsynchronized"
+
+
+class SegmentType(str, enum.Enum):
+    env_variable = "env_variable"
+    parameter = "parameter"
+
+
+class Task(Model):
+    __tablename__ = "tasks"
+    __public__ = ("id", "job_id", "hostname", "pid", "status", "command")
+
+    id = Column(int, primary_key=True)
+    job_id = Column(int, nullable=False, foreign_key="jobs(id)", index=True)
+    hostname = Column(str, nullable=False)
+    command = Column(str, nullable=False)     # base executable + built-in args
+    pid = Column(int)
+    _status = Column(str, default=TaskStatus.not_running.value)
+
+    def check_assertions(self) -> None:
+        if not self.hostname:
+            raise ValidationError("task hostname must not be empty")
+        if not self.command:
+            raise ValidationError("task command must not be empty")
+        if self._status not in TaskStatus.__members__:
+            raise ValidationError(f"invalid task status {self._status!r}")
+
+    # -- status (propagates to job, reference Task.py:50-55) ---------------
+    @property
+    def status(self) -> TaskStatus:
+        return TaskStatus(self._status)
+
+    @status.setter
+    def status(self, value) -> None:
+        self._status = TaskStatus(value).value
+
+    def set_status(self, value, synchronize_job: bool = True) -> None:
+        self.status = value
+        self.save()
+        if synchronize_job:
+            from .job import Job
+
+            job = Job.get_or_none(self.job_id)
+            if job is not None:
+                job.synchronize_status()
+
+    # -- segments (reference Task.py:109-139) ------------------------------
+    @property
+    def segment_links(self) -> List["CommandSegment2Task"]:
+        links = CommandSegment2Task.filter_by(task_id=self.id)
+        links.sort(key=lambda l: l.position)
+        return links
+
+    def _links_with_segments(self) -> List[tuple]:
+        """One link-table scan + one batched segment fetch (avoids the N+1
+        of calling ``link.segment`` per entry)."""
+        links = self.segment_links
+        if not links:
+            return []
+        ids = sorted({l.segment_id for l in links})
+        placeholders = ", ".join("?" * len(ids))
+        segments = {
+            s.id: s for s in CommandSegment.where(f"id IN ({placeholders})", ids)
+        }
+        return [(link, segments[link.segment_id]) for link in links]
+
+    def add_cmd_segment(self, name: str, value: str = "", segment_type=SegmentType.parameter) -> "CommandSegment":
+        segment_type = SegmentType(segment_type)
+        with CommandSegment.atomically():
+            segment = CommandSegment.first_by(name=name, _segment_type=segment_type.value)
+            if segment is None:
+                segment = CommandSegment(name=name, _segment_type=segment_type.value).save()
+            existing = CommandSegment2Task.filter_by(task_id=self.id, segment_id=segment.id)
+            if existing:
+                link = existing[0]
+                link.value = value
+                link.save()
+            else:
+                links = self.segment_links
+                next_position = max((l.position for l in links), default=0) + 1
+                CommandSegment2Task(
+                    task_id=self.id, segment_id=segment.id, value=value, position=next_position
+                ).save()
+        return segment
+
+    def remove_cmd_segment(self, name: str) -> bool:
+        for link, segment in self._links_with_segments():
+            if segment.name == name:
+                link.destroy()
+                return True
+        return False
+
+    def get_segment_value(self, name: str) -> Optional[str]:
+        for link, segment in self._links_with_segments():
+            if segment.name == name:
+                return link.value
+        return None
+
+    # -- command assembly (reference Task.py:78-101) -----------------------
+    @property
+    def env_segments(self) -> List["CommandSegment2Task"]:
+        return [
+            link for link, seg in self._links_with_segments()
+            if seg.segment_type is SegmentType.env_variable
+        ]
+
+    @property
+    def param_segments(self) -> List["CommandSegment2Task"]:
+        return [
+            link for link, seg in self._links_with_segments()
+            if seg.segment_type is SegmentType.parameter
+        ]
+
+    @property
+    def full_command(self) -> str:
+        envs: List[str] = []
+        params: List[str] = []
+        for link, segment in self._links_with_segments():
+            if segment.segment_type is SegmentType.env_variable:
+                envs.append(f"{segment.name}={shlex.quote(link.value or '')}")
+            elif link.value:
+                params.append(f"{segment.name}={shlex.quote(link.value)}")
+            else:
+                params.append(segment.name)
+        return " ".join(envs + [self.command] + params)
+
+    # -- chip binding ------------------------------------------------------
+    @property
+    def chip_ids(self) -> List[int]:
+        """Local chip indices bound via TPU_VISIBLE_CHIPS (reference parses
+        CUDA_VISIBLE_DEVICES=N, controllers/task.py:322-328)."""
+        raw = self.get_segment_value(CHIP_ENV_VAR)
+        if not raw:
+            return []
+        try:
+            return [int(x) for x in raw.split(",") if x.strip() != ""]
+        except ValueError:
+            return []
+
+    @property
+    def chip_uids(self) -> List[str]:
+        """Global chip UIDs = '<hostname>:tpu:<index>' (Resource.uid scheme)."""
+        return [f"{self.hostname}:tpu:{i}" for i in self.chip_ids]
+
+    def as_dict(self, include_private: bool = False) -> Dict[str, Any]:
+        out = super().as_dict(include_private)
+        out["status"] = self.status.value
+        out["fullCommand"] = self.full_command
+        out["cmdSegments"] = [
+            {
+                "name": segment.name,
+                "value": link.value,
+                "type": segment.segment_type.value,
+                "index": link.position,
+            }
+            for link, segment in self._links_with_segments()
+        ]
+        return out
+
+
+class CommandSegment(Model):
+    """Reference: tensorhive/models/CommandSegment.py:18-60."""
+
+    __tablename__ = "command_segments"
+    __table_constraints__ = ("UNIQUE(name, _segment_type)",)
+
+    id = Column(int, primary_key=True)
+    name = Column(str, nullable=False)
+    _segment_type = Column(str, nullable=False, default=SegmentType.parameter.value)
+
+    @property
+    def segment_type(self) -> SegmentType:
+        return SegmentType(self._segment_type)
+
+    def check_assertions(self) -> None:
+        if not self.name:
+            raise ValidationError("segment name must not be empty")
+        if self._segment_type not in SegmentType.__members__:
+            raise ValidationError(f"invalid segment type {self._segment_type!r}")
+
+
+class CommandSegment2Task(Model):
+    """Link table carrying per-task value and ordering
+    (reference: CommandSegment.py:62-75 `_value`, signed `_index`)."""
+
+    __tablename__ = "command_segment2task"
+    __table_constraints__ = ("UNIQUE(task_id, segment_id)",)
+
+    id = Column(int, primary_key=True)
+    task_id = Column(int, nullable=False, foreign_key="tasks(id)", index=True)
+    segment_id = Column(int, nullable=False, foreign_key="command_segments(id)", index=True)
+    value = Column(str, default="")
+    position = Column(int, default=0)
+
+    @property
+    def segment(self) -> CommandSegment:
+        return CommandSegment.get(self.segment_id)

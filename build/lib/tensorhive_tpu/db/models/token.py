@@ -1,0 +1,30 @@
+"""JWT revocation list (reference: tensorhive/models/RevokedToken.py:10-26)."""
+from __future__ import annotations
+
+from datetime import datetime
+from typing import Any
+
+from ...utils.timeutils import utcnow
+from ..orm import Column, Model
+
+
+class RevokedToken(Model):
+    __tablename__ = "revoked_tokens"
+
+    id = Column(int, primary_key=True)
+    jti = Column(str, nullable=False, unique=True)
+    revoked_at = Column(datetime)
+
+    def __init__(self, **kwargs: Any) -> None:
+        kwargs.setdefault("revoked_at", utcnow())
+        super().__init__(**kwargs)
+
+    @classmethod
+    def is_jti_blacklisted(cls, jti: str) -> bool:
+        return bool(cls.filter_by(jti=jti))
+
+    @classmethod
+    def add(cls, jti: str) -> None:
+        with cls.atomically():
+            if not cls.is_jti_blacklisted(jti):
+                cls(jti=jti).save()

@@ -1,0 +1,276 @@
+"""Users, roles, groups (reference: tensorhive/models/{User,Role,Group}.py).
+
+Password hashing uses stdlib ``hashlib.pbkdf2_hmac`` (sha256, 29000 rounds,
+random salt) — functionally equivalent to the reference's passlib
+``pbkdf2_sha256`` (models/User.py:92-96) without the passlib dependency.
+"""
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import os
+import re
+from datetime import datetime
+from typing import Any, Dict, List, Optional
+
+from ...utils.exceptions import ValidationError
+from ...utils.timeutils import utcnow
+from ..orm import Column, Model
+
+_PBKDF2_ROUNDS = 29000
+_EMAIL_RE = re.compile(r"^[^@\s]+@[^@\s]+\.[^@\s]+$")
+
+
+def hash_password(plain: str) -> str:
+    salt = os.urandom(16)
+    digest = hashlib.pbkdf2_hmac("sha256", plain.encode(), salt, _PBKDF2_ROUNDS)
+    return "pbkdf2-sha256$%d$%s$%s" % (
+        _PBKDF2_ROUNDS,
+        base64.b64encode(salt).decode(),
+        base64.b64encode(digest).decode(),
+    )
+
+
+def verify_password(plain: str, hashed: str) -> bool:
+    try:
+        _scheme, rounds, salt_b64, digest_b64 = hashed.split("$")
+        digest = hashlib.pbkdf2_hmac(
+            "sha256", plain.encode(), base64.b64decode(salt_b64), int(rounds)
+        )
+        return hmac.compare_digest(digest, base64.b64decode(digest_b64))
+    except (ValueError, TypeError):
+        return False
+
+
+class User(Model):
+    """Reference: tensorhive/models/User.py:31-186."""
+
+    __tablename__ = "users"
+    __public__ = ("id", "username", "email", "created_at", "last_login_at")
+
+    id = Column(int, primary_key=True)
+    username = Column(str, nullable=False, unique=True)
+    email = Column(str, nullable=False)
+    _hashed_password = Column(str, nullable=False)
+    created_at = Column(datetime)
+    # schema v2 (db/migrations.py): stamped on successful login, surfaced in
+    # the users admin view
+    last_login_at = Column(datetime)
+
+    MIN_USERNAME_LEN = 3
+    MIN_PASSWORD_LEN = 8
+
+    def __init__(self, password: Optional[str] = None, **kwargs: Any) -> None:
+        kwargs.setdefault("created_at", utcnow())
+        super().__init__(**kwargs)
+        if password is not None:
+            self.password = password
+
+    # -- per-field validators (reference User.py:98-108; used by the
+    # interactive AccountCreator to re-prompt on a single bad field) -------
+    @classmethod
+    def validate_username_format(cls, username: str) -> None:
+        if not username or len(username) < cls.MIN_USERNAME_LEN:
+            raise ValidationError(
+                f"username must have at least {cls.MIN_USERNAME_LEN} characters"
+            )
+
+    @classmethod
+    def validate_username(cls, username: str) -> None:
+        """Format + uniqueness (for NEW accounts; re-saving an existing row
+        must use validate_username_format to avoid self-collision)."""
+        cls.validate_username_format(username)
+        if cls.find_by_username(username) is not None:
+            raise ValidationError(f"username {username!r} is already taken")
+
+    @classmethod
+    def validate_email(cls, email: str) -> None:
+        if not email or not _EMAIL_RE.match(email):
+            raise ValidationError(f"invalid email: {email!r}")
+
+    @classmethod
+    def validate_password(cls, password: str) -> None:
+        if len(password or "") < cls.MIN_PASSWORD_LEN:
+            raise ValidationError(
+                f"password must have at least {cls.MIN_PASSWORD_LEN} characters"
+            )
+
+    # -- validation (reference User.py:98-108 validators) ------------------
+    def check_assertions(self) -> None:
+        # uniqueness is NOT re-checked here (validate_username does): an
+        # existing row re-saving itself would collide with its own username
+        self.validate_username_format(self.username)
+        self.validate_email(self.email)
+        if not self._hashed_password:
+            raise ValidationError("password must be set")
+
+    # -- password ----------------------------------------------------------
+    @property
+    def password(self) -> str:
+        raise AttributeError("password is write-only")
+
+    @password.setter
+    def password(self, plain: str) -> None:
+        if len(plain) < self.MIN_PASSWORD_LEN:
+            raise ValidationError(
+                f"password must have at least {self.MIN_PASSWORD_LEN} characters"
+            )
+        self._hashed_password = hash_password(plain)
+
+    def check_password(self, plain: str) -> bool:
+        return verify_password(plain, self._hashed_password)
+
+    # -- lookups -----------------------------------------------------------
+    @classmethod
+    def find_by_username(cls, username: str) -> Optional["User"]:
+        return cls.first_by(username=username)
+
+    # -- roles (reference models/Role.py per-user rows) --------------------
+    @property
+    def roles(self) -> List[str]:
+        return [r.name for r in Role.filter_by(user_id=self.id)]
+
+    def has_role(self, name: str) -> bool:
+        return name in self.roles
+
+    def add_role(self, name: str) -> None:
+        with Role.atomically():
+            if not self.has_role(name):
+                Role(name=name, user_id=self.id).save()
+
+    def remove_role(self, name: str) -> None:
+        for role in Role.filter_by(user_id=self.id, name=name):
+            role.destroy()
+
+    # -- groups ------------------------------------------------------------
+    @property
+    def groups(self) -> List["Group"]:
+        links = User2Group.filter_by(user_id=self.id)
+        return Group.get_many([link.group_id for link in links])
+
+    # -- restrictions (reference User.py:149-164) --------------------------
+    def get_restrictions(self, include_group: bool = True, include_global: bool = True):
+        from .restriction import Restriction
+
+        restrictions = Restriction.for_user(self.id)
+        seen = {r.id for r in restrictions}
+        if include_group:
+            for group in self.groups:
+                for r in Restriction.for_group(group.id):
+                    if r.id not in seen:
+                        seen.add(r.id)
+                        restrictions.append(r)
+        if include_global:
+            for r in Restriction.get_global_restrictions():
+                if r.id not in seen:
+                    seen.add(r.id)
+                    restrictions.append(r)
+        return restrictions
+
+    def get_active_restrictions(self):
+        return [r for r in self.get_restrictions() if r.is_active()]
+
+    def allowed_resource_uids(self) -> Optional[set]:
+        """UIDs this user may currently use; None means unrestricted (some
+        active restriction is global, i.e. applies to all resources)."""
+        uids: set = set()
+        for restriction in self.get_active_restrictions():
+            if restriction.is_global:
+                return None
+            uids.update(res.uid for res in restriction.resources)
+        return uids
+
+    def filter_infrastructure_by_user_restrictions(
+        self, infrastructure: Dict[str, Dict]
+    ) -> Dict[str, Dict]:
+        """Prune an infrastructure dict to accelerators this user may access
+        (reference: User.py:166-186). CPU metrics are always visible."""
+        allowed = self.allowed_resource_uids()
+        if allowed is None:
+            return infrastructure
+        filtered: Dict[str, Dict] = {}
+        for hostname, node in infrastructure.items():
+            kept = dict(node)
+            devices = node.get("TPU", {})
+            kept["TPU"] = {uid: m for uid, m in devices.items() if uid in allowed}
+            filtered[hostname] = kept
+        return filtered
+
+    def as_dict(self, include_private: bool = False) -> Dict[str, Any]:
+        out = super().as_dict(include_private)
+        out["roles"] = self.roles
+        return out
+
+
+class Role(Model):
+    """Reference: tensorhive/models/Role.py (rows 'user'/'admin' per user)."""
+
+    __tablename__ = "roles"
+    __table_constraints__ = ("UNIQUE(user_id, name)",)
+
+    id = Column(int, primary_key=True)
+    name = Column(str, nullable=False)
+    user_id = Column(int, nullable=False, foreign_key="users(id)", index=True)
+
+    VALID = ("user", "admin")
+
+    def check_assertions(self) -> None:
+        if self.name not in self.VALID:
+            raise ValidationError(f"invalid role {self.name!r}; valid: {self.VALID}")
+
+
+class Group(Model):
+    """Reference: tensorhive/models/Group.py:16-87; ``is_default`` groups
+    auto-attach newly created users (Group.py:77)."""
+
+    __tablename__ = "groups"
+    __public__ = ("id", "name", "is_default", "created_at")
+
+    id = Column(int, primary_key=True)
+    name = Column(str, nullable=False, unique=True)
+    is_default = Column(bool, default=False)
+    created_at = Column(datetime)
+
+    def __init__(self, **kwargs: Any) -> None:
+        kwargs.setdefault("created_at", utcnow())
+        super().__init__(**kwargs)
+
+    def check_assertions(self) -> None:
+        if not self.name:
+            raise ValidationError("group name must not be empty")
+
+    @property
+    def users(self) -> List[User]:
+        return User.get_many(
+            [link.user_id for link in User2Group.filter_by(group_id=self.id)]
+        )
+
+    def add_user(self, user: User) -> None:
+        with User2Group.atomically():
+            if not User2Group.filter_by(group_id=self.id, user_id=user.id):
+                User2Group(group_id=self.id, user_id=user.id).save()
+
+    def remove_user(self, user: User) -> None:
+        for link in User2Group.filter_by(group_id=self.id, user_id=user.id):
+            link.destroy()
+
+    @classmethod
+    def get_default_groups(cls) -> List["Group"]:
+        return cls.filter_by(is_default=True)
+
+    def as_dict(self, include_private: bool = False) -> Dict[str, Any]:
+        out = super().as_dict(include_private)
+        out["users"] = [u.as_dict() for u in self.users]
+        return out
+
+
+class User2Group(Model):
+    """Reference: tensorhive/models/Group.py:84 (link table)."""
+
+    __tablename__ = "user2group"
+    __table_constraints__ = ("UNIQUE(user_id, group_id)",)
+
+    id = Column(int, primary_key=True)
+    user_id = Column(int, nullable=False, foreign_key="users(id)", index=True)
+    group_id = Column(int, nullable=False, foreign_key="groups(id)", index=True)

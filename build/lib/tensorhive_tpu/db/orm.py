@@ -1,0 +1,345 @@
+"""Declarative ORM-lite over sqlite3.
+
+Provides the capabilities the reference gets from SQLAlchemy + its CRUDModel
+mixin (tensorhive/models/CRUDModel.py:11-94): declarative column definitions,
+``save``/``destroy``/``get``/``all``/``filter_by`` CRUD, a
+``check_assertions`` validation hook invoked before every save (CRUDModel.py
+save :21), and camelCase ``as_dict`` serialization driven by per-model
+``__public__`` attribute lists (CRUDModel.py:78-94). Datetimes round-trip as
+ISO-8601 naive-UTC TEXT; bools as INTEGER.
+"""
+from __future__ import annotations
+
+from datetime import datetime
+from typing import Any, Callable, Dict, List, Optional, Sequence, Type, TypeVar
+
+from ..utils.exceptions import NotFoundError, ValidationError
+from ..utils.timeutils import isoformat, parse_datetime, to_utc_naive
+from .engine import Engine, get_engine
+
+T = TypeVar("T", bound="Model")
+
+_SQL_TYPES = {int: "INTEGER", str: "TEXT", float: "REAL", bool: "INTEGER", datetime: "TEXT", bytes: "BLOB"}
+
+
+class Column:
+    """Declarative column descriptor."""
+
+    def __init__(
+        self,
+        py_type: type,
+        *,
+        primary_key: bool = False,
+        nullable: bool = True,
+        unique: bool = False,
+        default: Any = None,
+        foreign_key: Optional[str] = None,   # "table(column)" target
+        on_delete: str = "CASCADE",
+        index: bool = False,
+    ) -> None:
+        if py_type not in _SQL_TYPES:
+            raise TypeError(f"unsupported column type {py_type}")
+        self.py_type = py_type
+        self.primary_key = primary_key
+        self.nullable = nullable and not primary_key
+        self.unique = unique
+        self.default = default
+        self.foreign_key = foreign_key
+        self.on_delete = on_delete
+        self.index = index
+        self.name: str = ""  # set by metaclass
+
+    # -- python <-> sqlite value conversion --------------------------------
+    def to_sql(self, value: Any) -> Any:
+        if value is None:
+            return None
+        if self.py_type is datetime:
+            if isinstance(value, datetime):
+                return to_utc_naive(value).isoformat()
+            return str(value)
+        if self.py_type is bool:
+            return int(bool(value))
+        return value
+
+    def from_sql(self, value: Any) -> Any:
+        if value is None:
+            return None
+        if self.py_type is datetime:
+            return parse_datetime(value)
+        if self.py_type is bool:
+            return bool(value)
+        return value
+
+    def ddl(self) -> str:
+        parts = [self.name, _SQL_TYPES[self.py_type]]
+        if self.primary_key:
+            parts.append("PRIMARY KEY")
+            if self.py_type is int:
+                parts.append("AUTOINCREMENT")
+        if not self.nullable and not self.primary_key:
+            parts.append("NOT NULL")
+        if self.unique:
+            parts.append("UNIQUE")
+        return " ".join(parts)
+
+
+class ModelMeta(type):
+    registry: List[Type["Model"]] = []
+
+    def __new__(mcls, name, bases, namespace):
+        cls = super().__new__(mcls, name, bases, namespace)
+        columns: Dict[str, Column] = {}
+        for base in bases:
+            columns.update(getattr(base, "__columns__", {}))
+        for key, value in namespace.items():
+            if isinstance(value, Column):
+                value.name = key
+                columns[key] = value
+        cls.__columns__ = columns
+        if namespace.get("__tablename__"):
+            mcls.registry.append(cls)
+        return cls
+
+
+class Model(metaclass=ModelMeta):
+    """Base entity. Subclasses set ``__tablename__`` and Column attributes."""
+
+    __tablename__: str = ""
+    __columns__: Dict[str, Column] = {}
+    # attribute names exposed by as_dict (camelCased); None = all columns
+    __public__: Optional[Sequence[str]] = None
+
+    def __init__(self, **kwargs: Any) -> None:
+        for name, col in self.__columns__.items():
+            setattr(self, name, kwargs.pop(name, col.default))
+        if kwargs:
+            raise TypeError(f"unknown fields for {type(self).__name__}: {sorted(kwargs)}")
+
+    # -- schema ------------------------------------------------------------
+    @classmethod
+    def pk_column(cls) -> Column:
+        for col in cls.__columns__.values():
+            if col.primary_key:
+                return col
+        raise TypeError(f"{cls.__name__} has no primary key")
+
+    @classmethod
+    def create_table_sql(cls) -> str:
+        defs = [col.ddl() for col in cls.__columns__.values()]
+        for col in cls.__columns__.values():
+            if col.foreign_key:
+                defs.append(
+                    f"FOREIGN KEY({col.name}) REFERENCES {col.foreign_key} "
+                    f"ON DELETE {col.on_delete}"
+                )
+        uniques = getattr(cls, "__table_constraints__", ())
+        defs.extend(uniques)
+        return f"CREATE TABLE IF NOT EXISTS {cls.__tablename__} ({', '.join(defs)})"
+
+    @classmethod
+    def index_sql(cls) -> List[str]:
+        return [
+            f"CREATE INDEX IF NOT EXISTS idx_{cls.__tablename__}_{col.name} "
+            f"ON {cls.__tablename__}({col.name})"
+            for col in cls.__columns__.values()
+            if col.index
+        ]
+
+    # -- hydration ---------------------------------------------------------
+    @classmethod
+    def _from_row(cls: Type[T], row) -> T:
+        obj = cls.__new__(cls)
+        for name, col in cls.__columns__.items():
+            obj.__dict__[name] = col.from_sql(row[name])
+        return obj
+
+    # -- validation hook ---------------------------------------------------
+    def check_assertions(self) -> None:
+        """Override to validate invariants; raise ValidationError on failure
+        (reference: CRUDModel save-time assertion hook, CRUDModel.py:21)."""
+
+    # -- CRUD --------------------------------------------------------------
+    def save(self: T) -> T:
+        # always the process-wide engine: check_assertions runs arbitrary
+        # model queries which resolve via get_engine(), so accepting a
+        # different engine here would validate against the wrong database
+        engine = get_engine()
+        # run validation and the write under one engine lock so
+        # check-then-insert invariants (e.g. reservation overlap,
+        # Reservation.would_interfere) are atomic across threads
+        with engine.transaction():
+            self.check_assertions()
+            return self._write(engine)
+
+    def _write(self: T, engine: Engine) -> T:
+        pk = self.pk_column()
+        cols = self.__columns__
+        pk_value = getattr(self, pk.name)
+        if pk_value is None:
+            names = [c.name for c in cols.values() if c.name != pk.name]
+            values = [cols[n].to_sql(getattr(self, n)) for n in names]
+            sql = (
+                f"INSERT INTO {self.__tablename__} ({', '.join(names)}) "
+                f"VALUES ({', '.join('?' * len(names))})"
+            )
+            cursor = engine.execute(sql, values)
+            setattr(self, pk.name, cursor.lastrowid)
+        else:
+            names = [c.name for c in cols.values() if c.name != pk.name]
+            assignments = ", ".join(f"{n} = ?" for n in names)
+            values = [cols[n].to_sql(getattr(self, n)) for n in names]
+            exists = engine.scalar(
+                f"SELECT COUNT(*) FROM {self.__tablename__} WHERE {pk.name} = ?",
+                [pk.to_sql(pk_value)],
+            )
+            if exists:
+                engine.execute(
+                    f"UPDATE {self.__tablename__} SET {assignments} WHERE {pk.name} = ?",
+                    values + [pk.to_sql(pk_value)],
+                )
+            else:
+                all_names = [pk.name] + names
+                engine.execute(
+                    f"INSERT INTO {self.__tablename__} ({', '.join(all_names)}) "
+                    f"VALUES ({', '.join('?' * len(all_names))})",
+                    [pk.to_sql(pk_value)] + values,
+                )
+        return self
+
+    def destroy(self) -> None:
+        engine = get_engine()
+        pk = self.pk_column()
+        engine.execute(
+            f"DELETE FROM {self.__tablename__} WHERE {pk.name} = ?",
+            [pk.to_sql(getattr(self, pk.name))],
+        )
+
+    @classmethod
+    def get(cls: Type[T], pk_value: Any, engine: Optional[Engine] = None) -> T:
+        engine = engine or get_engine()
+        pk = cls.pk_column()
+        rows = engine.query(
+            f"SELECT * FROM {cls.__tablename__} WHERE {pk.name} = ?",
+            [pk.to_sql(pk_value)],
+        )
+        if not rows:
+            raise NotFoundError(f"{cls.__name__} id={pk_value!r} not found")
+        return cls._from_row(rows[0])
+
+    @classmethod
+    def get_or_none(cls: Type[T], pk_value: Any, engine: Optional[Engine] = None) -> Optional[T]:
+        try:
+            return cls.get(pk_value, engine)
+        except NotFoundError:
+            return None
+
+    @classmethod
+    def all(cls: Type[T], engine: Optional[Engine] = None) -> List[T]:
+        engine = engine or get_engine()
+        return [cls._from_row(r) for r in engine.query(f"SELECT * FROM {cls.__tablename__}")]
+
+    @classmethod
+    def _eq_clause(cls, eq: Dict[str, Any]):
+        clauses, params = [], []
+        for key, value in eq.items():
+            col = cls.__columns__[key]
+            if value is None:
+                clauses.append(f"{key} IS NULL")
+            else:
+                clauses.append(f"{key} = ?")
+                params.append(col.to_sql(value))
+        return " AND ".join(clauses), params
+
+    @classmethod
+    def filter_by(cls: Type[T], engine: Optional[Engine] = None, **eq: Any) -> List[T]:
+        engine = engine or get_engine()
+        if not eq:
+            return cls.all(engine)
+        clause, params = cls._eq_clause(eq)
+        rows = engine.query(f"SELECT * FROM {cls.__tablename__} WHERE {clause}", params)
+        return [cls._from_row(r) for r in rows]
+
+    @classmethod
+    def first_by(cls: Type[T], engine: Optional[Engine] = None, **eq: Any) -> Optional[T]:
+        results = cls.filter_by(engine, **eq)
+        return results[0] if results else None
+
+    @classmethod
+    def where(cls: Type[T], sql: str, params: Sequence[Any] = (), engine: Optional[Engine] = None) -> List[T]:
+        """Raw-WHERE escape hatch for range/overlap queries."""
+        engine = engine or get_engine()
+        rows = engine.query(f"SELECT * FROM {cls.__tablename__} WHERE {sql}", params)
+        return [cls._from_row(r) for r in rows]
+
+    @classmethod
+    def get_many(cls: Type[T], pk_values: Sequence[Any], engine: Optional[Engine] = None) -> List[T]:
+        """Batched ``get`` preserving input order — one ``IN ()`` query
+        instead of N point lookups (link-table traversal helper)."""
+        pk_values = list(pk_values)
+        if not pk_values:
+            return []
+        pk = cls.pk_column()
+        unique = list(dict.fromkeys(pk_values))
+        placeholders = ", ".join("?" * len(unique))
+        rows = cls.where(
+            f"{pk.name} IN ({placeholders})",
+            [pk.to_sql(v) for v in unique],
+            engine=engine,
+        )
+        by_pk = {getattr(obj, pk.name): obj for obj in rows}
+        missing = [v for v in unique if v not in by_pk]
+        if missing:
+            raise NotFoundError(f"{cls.__name__} ids not found: {missing}")
+        return [by_pk[v] for v in pk_values]
+
+    @classmethod
+    def atomically(cls):
+        """Engine-lock context for caller-level check-then-write sequences
+        (e.g. link-table 'insert if absent' helpers)."""
+        return get_engine().transaction()
+
+    @classmethod
+    def count(cls, engine: Optional[Engine] = None, **eq: Any) -> int:
+        engine = engine or get_engine()
+        if not eq:
+            return int(engine.scalar(f"SELECT COUNT(*) FROM {cls.__tablename__}"))
+        clause, params = cls._eq_clause(eq)
+        return int(
+            engine.scalar(f"SELECT COUNT(*) FROM {cls.__tablename__} WHERE {clause}", params)
+        )
+
+    # -- serialization -----------------------------------------------------
+    def as_dict(self, include_private: bool = False) -> Dict[str, Any]:
+        """camelCase dict of public attributes (reference CRUDModel.py:78-94).
+
+        Attribute names may be column names or zero-arg
+        properties/methods declared in ``__public__``.
+        """
+        names = list(self.__public__) if self.__public__ is not None else list(self.__columns__)
+        if include_private:
+            names += list(getattr(self, "__private__", ()))
+        out: Dict[str, Any] = {}
+        for name in names:
+            value = getattr(self, name)
+            if callable(value):
+                value = value()
+            if isinstance(value, datetime):
+                value = isoformat(value)
+            out[_camel(name)] = value
+        return out
+
+    def __repr__(self) -> str:  # pragma: no cover
+        pk = self.pk_column().name
+        return f"<{type(self).__name__} {pk}={getattr(self, pk)!r}>"
+
+
+def _camel(name: str) -> str:
+    head, *rest = name.lstrip("_").split("_")
+    return head + "".join(part.title() for part in rest)
+
+
+def create_all(engine: Engine) -> None:
+    for model in ModelMeta.registry:
+        engine.execute(model.create_table_sql())
+        for sql in model.index_sql():
+            engine.execute(sql)

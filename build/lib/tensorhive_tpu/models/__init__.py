@@ -1,0 +1,15 @@
+"""Model zoo: the workloads the cluster manager schedules onto slices.
+
+The flagship is the decoder-only transformer LM (models/transformer.py) —
+the TPU-native analog of the reference's ``t2t_transformer`` acceptance
+workload (examples/t2t_transformer/README.md points at an external
+tensor2tensor benchmark; BASELINE.json config 3 makes it the headline
+benchmark of this rebuild).
+"""
+from .transformer import (
+    TransformerConfig,
+    TransformerLM,
+    PRESETS,
+)
+
+__all__ = ["TransformerConfig", "TransformerLM", "PRESETS"]

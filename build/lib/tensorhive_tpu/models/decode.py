@@ -1,0 +1,177 @@
+"""Autoregressive decoding with a KV cache, and held-out evaluation.
+
+The inference side of the training stack (no reference counterpart — the
+reference manages clusters, it has no model code at all). TPU-first design:
+
+* **one jitted step, static shapes** — the cache is a fixed
+  [layers, B, max_len, H, D] buffer updated with ``dynamic_update_slice``;
+  the position is a traced scalar, so the whole generation loop reuses a
+  single compiled executable (no per-step retrace, XLA's requirement).
+* **decode attention is a masked dot over the cache** — single-token decode
+  is HBM-bandwidth-bound (reading K/V), not FLOP-bound, so a pallas kernel
+  buys nothing here; the flash kernels stay on the training path.
+* **cache donation** — the step donates the cache buffers, so decoding is
+  in-place in HBM.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .transformer import (
+    Params,
+    TransformerConfig,
+    TransformerLM,
+    _rmsnorm,
+)
+
+
+class KVCache(NamedTuple):
+    k: jax.Array          # [layers, B, max_len, H, Dh]
+    v: jax.Array          # [layers, B, max_len, H, Dh]
+
+
+def init_cache(config: TransformerConfig, batch: int,
+               max_len: Optional[int] = None) -> KVCache:
+    max_len = max_len or config.max_seq_len
+    shape = (config.n_layers, batch, max_len, config.n_heads, config.d_head)
+    return KVCache(k=jnp.zeros(shape, config.dtype),
+                   v=jnp.zeros(shape, config.dtype))
+
+
+def _decode_attend(q, k_cache, v_cache, position):
+    """q: [B,1,H,Dh]; caches [B,S,H,Dh]; attend to positions <= position."""
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache,
+                        preferred_element_type=jnp.float32) * scale
+    key_positions = jax.lax.iota(jnp.int32, k_cache.shape[1])
+    mask = key_positions[None, None, None, :] <= position
+    scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v_cache.dtype), v_cache,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+def apply_step(
+    params: Params,
+    token: jax.Array,               # [B] int32 — the token AT `position`
+    cache: KVCache,
+    position: jax.Array,            # scalar int32
+    config: TransformerConfig,
+) -> Tuple[jax.Array, KVCache]:
+    """One decode step: logits for the NEXT position + updated cache.
+
+    Routes through TransformerLM.block_forward (the single copy of the
+    block math) with a cache-updating attend strategy, so training and
+    decoding cannot architecturally drift."""
+    dtype = config.dtype
+    x = params["tok_embed"].astype(dtype)[token][:, None, :]   # [B,1,D]
+    positions = jnp.full((token.shape[0], 1), position, jnp.int32)
+    new_k, new_v = [], []
+    for layer_index, block in enumerate(params["blocks"]):
+        def attend(q, k, v, _layer=layer_index):
+            k_cache = jax.lax.dynamic_update_slice(
+                cache.k[_layer], k.astype(cache.k.dtype), (0, position, 0, 0))
+            v_cache = jax.lax.dynamic_update_slice(
+                cache.v[_layer], v.astype(cache.v.dtype), (0, position, 0, 0))
+            new_k.append(k_cache)
+            new_v.append(v_cache)
+            return _decode_attend(q, k_cache, v_cache, position)
+
+        x = TransformerLM.block_forward(x, block, config, positions, attend)
+    x = _rmsnorm(x, params["final_norm"]["scale"])
+    logits = jnp.dot(x[:, 0].astype(dtype), params["w_lm_head"].astype(dtype),
+                     preferred_element_type=jnp.float32)
+    cache = KVCache(k=jnp.stack(new_k), v=jnp.stack(new_v))
+    return logits, cache
+
+
+@functools.partial(jax.jit, static_argnames=("config",), donate_argnums=(2,))
+def _decode_step(params, token, cache, position, config):
+    return apply_step(params, token, cache, position, config)
+
+
+def generate(
+    params: Params,
+    config: TransformerConfig,
+    prompt: jax.Array,              # [B, P] int32
+    max_new_tokens: int,
+    temperature: float = 0.0,       # 0 = greedy
+    top_k: Optional[int] = None,
+    seed: int = 0,
+) -> jax.Array:
+    """Generate ``max_new_tokens`` continuations: returns [B, P+N] int32.
+
+    The prompt is prefilled through the same single-token step (correctness
+    over prefill speed — batch prefill via apply() is a future optimization;
+    the step executable is compiled once and reused for every position)."""
+    batch, prompt_len = prompt.shape
+    total = prompt_len + max_new_tokens
+    if total > config.max_seq_len:
+        raise ValueError(
+            f"prompt+new = {total} exceeds max_seq_len {config.max_seq_len}")
+    if top_k is not None and not 0 < top_k <= config.vocab_size:
+        # checked up-front (not only on sampling steps): jnp's index
+        # clamping would otherwise silently disable the filter
+        raise ValueError(
+            f"top_k must be in (0, {config.vocab_size}], got {top_k}")
+    cache = init_cache(config, batch, max_len=total)
+    key = jax.random.PRNGKey(seed)
+    tokens = jnp.concatenate(
+        [prompt, jnp.zeros((batch, max_new_tokens), prompt.dtype)], axis=1)
+    logits = None
+    for position in range(total - 1):
+        current = tokens[:, position]
+        logits, cache = _decode_step(params, current, cache,
+                                     jnp.int32(position), config=config)
+        if position < prompt_len - 1:
+            continue                                 # prefill: keep prompt
+        if temperature <= 0.0:
+            next_token = jnp.argmax(logits, axis=-1)
+        else:
+            scaled = logits / temperature
+            if top_k is not None:
+                kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
+                scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+            key, sample_key = jax.random.split(key)
+            next_token = jax.random.categorical(sample_key, scaled, axis=-1)
+        tokens = tokens.at[:, position + 1].set(
+            next_token.astype(tokens.dtype))
+    return tokens
+
+
+@functools.lru_cache(maxsize=8)
+def _eval_loss_fn(config: TransformerConfig, mesh):
+    """Jitted loss per (config, mesh) — a fresh jit per evaluate() call
+    would recompile the whole model on every periodic eval."""
+    return jax.jit(functools.partial(TransformerLM.loss, config=config,
+                                     mesh=mesh))
+
+
+def evaluate(
+    params: Params,
+    config: TransformerConfig,
+    batches,
+    num_batches: int,
+    mesh=None,
+) -> Dict[str, float]:
+    """Mean held-out loss/perplexity over ``num_batches`` from an iterator
+    of [B, L+1] token arrays (e.g. data.prefetch_to_device)."""
+    if num_batches < 1:
+        raise ValueError(f"num_batches must be >= 1, got {num_batches}")
+    loss_fn = _eval_loss_fn(config, mesh)
+    total = 0.0
+    for index in range(num_batches):
+        try:
+            tokens = next(batches)
+        except StopIteration:
+            raise ValueError(
+                f"batches iterator exhausted at batch {index} of "
+                f"{num_batches}") from None
+        total += float(loss_fn(params, tokens))
+    mean = total / num_batches
+    return {"loss": mean, "perplexity": float(jnp.exp(mean)),
+            "batches": num_batches}

@@ -1,0 +1,335 @@
+"""Decoder-only transformer LM, TPU-first.
+
+Design choices map straight onto the hardware (task brief + scaling-book
+recipe), not onto any reference code (the reference has no model code at
+all — it launches external t2t/DeepSpeech trainings):
+
+* **bf16 everywhere the MXU is involved**: params are kept in f32 master
+  copies, cast to bf16 for matmuls; logits/loss/softmax in f32.
+* **Static shapes, no data-dependent control flow** — one jit trace.
+* **RoPE** positions (no learned position table to shard), pre-RMSNorm,
+  SwiGLU MLP — the standard modern decoder block, all MXU-dense.
+* **Parallelism-aware**: every weight carries logical axes (see
+  parallel/mesh.py _PARAM_LOGICAL) so the same model runs pure-dp, fsdp,
+  megatron-tp, and ring-attention sp by choosing a mesh; attention runs
+  through the pallas flash kernel on single-shard sequences and through
+  ring attention when the sequence is sharded over ``sp``.
+* **jax.checkpoint** on each block so activation memory trades against
+  HBM bandwidth (remat is the TPU-default tradeoff for long sequences).
+
+Pure-functional: params are a plain dict pytree; ``TransformerLM`` is a
+namespace of ``init`` / ``apply`` / ``loss`` staticmethods.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.flash_attention import flash_attention
+from ..parallel.ring import ring_attention
+
+Params = Dict[str, Any]
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32_000
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 6
+    d_ff: int = 1408            # ~8/3 * d_model, SwiGLU sizing
+    max_seq_len: int = 2048
+    dtype: Any = jnp.bfloat16   # activation/matmul dtype
+    rope_theta: float = 10_000.0
+    remat: bool = True
+    #: use the pallas flash kernel for non-sp attention
+    use_flash: bool = True
+    #: token-chunk size for the memory-efficient CE loss (0 disables); only
+    #: engaged when the per-device logits shard would exceed the device
+    #: threshold (_chunk_threshold_bytes: ~0.7× HBM on TPU, 2 GiB where the
+    #: device can't report memory), so fitting runs keep the fused fast path
+    loss_chunk_tokens: int = 16_384
+
+    @property
+    def d_head(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+
+#: named sizes; "t2t-base" mirrors tensor2tensor transformer_base
+#: (6 layers / d512 / 8 heads / ff2048 — the reference's benchmark config)
+PRESETS: Dict[str, TransformerConfig] = {
+    "tiny": TransformerConfig(vocab_size=512, d_model=64, n_heads=4, n_layers=2,
+                              d_ff=176, max_seq_len=256),
+    "t2t-base": TransformerConfig(vocab_size=32_000, d_model=512, n_heads=8,
+                                  n_layers=6, d_ff=2048, max_seq_len=2048),
+    "t2t-big": TransformerConfig(vocab_size=32_000, d_model=1024, n_heads=16,
+                                 n_layers=6, d_ff=4096, max_seq_len=2048),
+    "1b": TransformerConfig(vocab_size=32_000, d_model=2048, n_heads=16,
+                            n_layers=16, d_ff=5632, max_seq_len=4096),
+}
+
+
+#: fallback threshold for the chunked CE path when the device can't report
+#: its memory (CPU/interpret): engage once full logits would exceed 2 GiB
+CHUNKED_LOSS_THRESHOLD_BYTES = 2 << 30
+
+
+@functools.lru_cache(maxsize=1)
+def _chunk_threshold_bytes() -> int:
+    """Engage chunking only when the full-logits path would genuinely
+    pressure HBM: measured on v5e, the full path at 8.6 GB logits (b64×s1024
+    ×32k vocab) is ~6% faster than chunked recompute, so chunking must not
+    trigger while the fused path still fits: b64's 8.6 GB logits run fine on
+    a 16 GB v5e (~0.62 of bytes_limit) while b128's 17 GB cannot, so 0.7
+    keeps the measured-good config on the fast path with the flip safely
+    below the OOM point."""
+    device = jax.devices()[0]
+    try:
+        return int(device.memory_stats()["bytes_limit"] * 0.7)
+    except Exception:
+        pass
+    if device.platform == "tpu":
+        # some TPU runtimes don't expose memory_stats; assume the smallest
+        # current-generation HBM (16 GiB, v5e) — underestimating on larger
+        # chips merely engages chunking earlier than strictly needed
+        return int((16 << 30) * 0.7)
+    return CHUNKED_LOSS_THRESHOLD_BYTES
+
+
+def _chunked_ce(x_flat: jax.Array, targets_flat: jax.Array, w_head: jax.Array,
+                dtype: Any, chunk_tokens: int) -> jax.Array:
+    """Sum of (logsumexp − target_logit) over all tokens, computed one
+    token-chunk at a time. ``jax.checkpoint`` on the chunk body means the
+    backward pass recomputes each chunk's logits instead of storing them —
+    peak memory is one [chunk, vocab] f32 buffer either direction."""
+    num_chunks = x_flat.shape[0] // chunk_tokens
+    x_chunks = x_flat.reshape(num_chunks, chunk_tokens, -1)
+    t_chunks = targets_flat.reshape(num_chunks, chunk_tokens)
+
+    @jax.checkpoint
+    def one_chunk(args):
+        x_blk, t_blk = args
+        logits = jnp.dot(x_blk.astype(dtype), w_head.astype(dtype),
+                         preferred_element_type=jnp.float32)
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        target_logit = jnp.take_along_axis(logits, t_blk[:, None], axis=-1)[:, 0]
+        return jnp.sum(lse - target_logit)
+
+    return jnp.sum(jax.lax.map(one_chunk, (x_chunks, t_chunks)))
+
+
+def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
+    """Rotary embedding over the last dim of [B, L, H, D]."""
+    d = x.shape[-1]
+    freqs = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)
+    angles = positions[:, :, None, None].astype(jnp.float32) * freqs  # [B,L,1,D/2]
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = x[..., 0::2], x[..., 1::2]
+    rotated = jnp.stack([x1 * cos - x2 * sin, x1 * sin + x2 * cos], axis=-1)
+    return rotated.reshape(x.shape).astype(x.dtype)
+
+
+def _rmsnorm(x: jax.Array, scale: jax.Array) -> jax.Array:
+    norm = jnp.asarray(x, jnp.float32)
+    norm = norm * jax.lax.rsqrt(jnp.mean(norm * norm, axis=-1, keepdims=True) + 1e-6)
+    return (norm * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+class TransformerLM:
+    """init / apply / loss over a plain param pytree."""
+
+    # -- init ---------------------------------------------------------------
+    @staticmethod
+    def init(key: jax.Array, config: TransformerConfig) -> Params:
+        keys = iter(jax.random.split(key, 4 + 7 * config.n_layers))
+
+        def dense(key, fan_in, *shape):
+            return (jax.random.normal(key, shape, jnp.float32)
+                    * (1.0 / math.sqrt(fan_in)))
+
+        d, h, dh, f = (config.d_model, config.n_heads, config.d_head, config.d_ff)
+        params: Params = {
+            "tok_embed": jax.random.normal(next(keys), (config.vocab_size, d),
+                                           jnp.float32) * 0.02,
+            "final_norm": {"scale": jnp.ones((d,), jnp.float32)},
+            "w_lm_head": dense(next(keys), d, d, config.vocab_size),
+            "blocks": [],
+        }
+        for _ in range(config.n_layers):
+            params["blocks"].append({
+                "attn_norm": {"scale": jnp.ones((d,), jnp.float32)},
+                "mlp_norm": {"scale": jnp.ones((d,), jnp.float32)},
+                "wq": dense(next(keys), d, d, h * dh),
+                "wk": dense(next(keys), d, d, h * dh),
+                "wv": dense(next(keys), d, d, h * dh),
+                "wo": dense(next(keys), h * dh, h * dh, d),
+                "w_in": dense(next(keys), d, d, f),
+                "w_gate": dense(next(keys), d, d, f),
+                "w_out": dense(next(keys), f, f, d),
+            })
+        return params
+
+    # -- forward ------------------------------------------------------------
+    @staticmethod
+    def block_forward(x, block, config: TransformerConfig, positions,
+                      attend) -> jax.Array:
+        """One transformer block (pre-norm attention + SwiGLU MLP). The
+        SINGLE copy of the block math — training (apply_trunk) and cached
+        decoding (models/decode.py apply_step) both route through it with
+        their own ``attend(q, k, v) -> [B, L, H, Dh]`` strategy, so the
+        architectures cannot drift apart."""
+        dtype = config.dtype
+        h = _rmsnorm(x, block["attn_norm"]["scale"])
+        b, l, d = h.shape
+        q = (h @ block["wq"].astype(dtype)).reshape(b, l, config.n_heads,
+                                                    config.d_head)
+        k = (h @ block["wk"].astype(dtype)).reshape(b, l, config.n_heads,
+                                                    config.d_head)
+        v = (h @ block["wv"].astype(dtype)).reshape(b, l, config.n_heads,
+                                                    config.d_head)
+        q = _rope(q, positions, config.rope_theta)
+        k = _rope(k, positions, config.rope_theta)
+        attn = attend(q, k, v).reshape(b, l, config.n_heads * config.d_head)
+        x = x + attn @ block["wo"].astype(dtype)
+        h = _rmsnorm(x, block["mlp_norm"]["scale"])
+        gated = jax.nn.silu(h @ block["w_gate"].astype(dtype)) * (
+            h @ block["w_in"].astype(dtype)
+        )
+        return x + gated @ block["w_out"].astype(dtype)
+
+    @staticmethod
+    def apply_trunk(
+        params: Params,
+        tokens: jax.Array,                  # [B, L] int32
+        config: TransformerConfig,
+        mesh=None,
+        positions: Optional[jax.Array] = None,
+    ) -> jax.Array:
+        """Everything before the LM head: returns normed activations
+        [B, L, d_model] (activation dtype, post final rmsnorm)."""
+        dtype = config.dtype
+        if positions is None:
+            positions = jnp.broadcast_to(
+                jnp.arange(tokens.shape[1], dtype=jnp.int32), tokens.shape
+            )
+        x = params["tok_embed"].astype(dtype)[tokens]
+
+        sp_sharded = mesh is not None and "sp" in getattr(mesh, "axis_names", ()) \
+            and mesh.shape["sp"] > 1
+
+        def attend(q, k, v):
+            if sp_sharded:
+                return ring_attention(q, k, v, mesh=mesh, causal=True)
+            if config.use_flash:
+                return flash_attention(q, k, v, causal=True)
+            from ..ops.flash_attention import reference_attention
+
+            return reference_attention(q, k, v, causal=True)
+
+        def block_fn(x, block):
+            return TransformerLM.block_forward(x, block, config, positions,
+                                               attend)
+
+        if config.remat:
+            block_fn = jax.checkpoint(block_fn)
+        for block in params["blocks"]:
+            x = block_fn(x, block)
+
+        return _rmsnorm(x, params["final_norm"]["scale"])
+
+    @staticmethod
+    def apply(
+        params: Params,
+        tokens: jax.Array,                  # [B, L] int32
+        config: TransformerConfig,
+        mesh=None,
+        positions: Optional[jax.Array] = None,
+    ) -> jax.Array:
+        """Returns logits [B, L, vocab] (f32)."""
+        x = TransformerLM.apply_trunk(params, tokens, config, mesh=mesh,
+                                      positions=positions)
+        # LM head: bf16 operands, f32 MXU accumulation. A full-f32 matmul
+        # here runs at ~1/4 MXU throughput and this [*, d]x[d, vocab] matmul
+        # is the single largest in the model (~40% of forward FLOPs for
+        # t2t-base); bf16-in/f32-out is the standard LM-head precision.
+        return jnp.dot(x.astype(config.dtype),
+                       params["w_lm_head"].astype(config.dtype),
+                       preferred_element_type=jnp.float32)
+
+    # -- loss ---------------------------------------------------------------
+    @staticmethod
+    def loss(
+        params: Params,
+        tokens: jax.Array,                  # [B, L+1] int32 (inputs+shifted)
+        config: TransformerConfig,
+        mesh=None,
+    ) -> jax.Array:
+        """Next-token cross-entropy, mean over tokens (f32)."""
+        inputs, targets = tokens[:, :-1], tokens[:, 1:]
+        n_tokens = targets.shape[0] * targets.shape[1]
+        # the batch dim shards over dp×fsdp and the vocab dim of the LM head
+        # (hence of the logits) over tp (parallel/mesh.py batch_sharding +
+        # _PARAM_LOGICAL), so what pressures HBM is each device's logits
+        # shard — compare per-device bytes against the per-device threshold
+        logits_shards = 1
+        if mesh is not None:
+            logits_shards = (mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+                             * mesh.shape.get("tp", 1))
+        logits_bytes = n_tokens * config.vocab_size * 4 // logits_shards
+        # shrink the chunk to a divisor of n_tokens (gcd) so awkward batch
+        # sizes still chunk instead of silently falling back to the
+        # full-logits path and OOMing — the exact sizes chunking exists
+        # for. A tiny gcd (odd n_tokens) means tiny matmuls, but this
+        # branch only engages where the full path would not fit at all:
+        # slow-but-runs beats OOM.
+        chunk = math.gcd(n_tokens, config.loss_chunk_tokens) \
+            if config.loss_chunk_tokens else 0
+        if chunk and logits_bytes > _chunk_threshold_bytes():
+            # chunked head+loss: the [N, vocab] f32 logits tensor is the
+            # largest buffer of a training step (17 GB at b128×s1024×32k —
+            # past a v5e's whole HBM). Computing lse/target-logit one token
+            # chunk at a time with per-chunk recompute in the backward keeps
+            # peak memory at one chunk's logits, unlocking batch sizes the
+            # full-logits path cannot hold. Costs one extra head matmul in
+            # the backward (~+2/6 of head FLOPs).
+            x = TransformerLM.apply_trunk(params, inputs, config, mesh=mesh)
+            total = _chunked_ce(
+                x.reshape(n_tokens, -1), targets.reshape(n_tokens),
+                params["w_lm_head"], config.dtype, chunk)
+            return total / n_tokens
+        logits = TransformerLM.apply(params, inputs, config, mesh=mesh)
+        # logsumexp − target_logit form: never materializes the full [B, L,
+        # vocab] log-probability tensor (2 GB at b16×s1024×32k vocab) — the
+        # gather and the reduction fuse into the logits consumer
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        target_logit = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+        return jnp.mean(lse - target_logit)
+
+    @staticmethod
+    def param_count(params: Params) -> int:
+        return sum(leaf.size for leaf in jax.tree_util.tree_leaves(params))
+
+
+def train_flops_per_token(config: TransformerConfig, seq_len: int,
+                          remat: bool = False) -> float:
+    """Analytic model FLOPs per trained token (matmuls only — norms/rope/
+    softmax are bandwidth, not MXU FLOPs). Used for MFU reporting.
+
+    Per token, forward: QKVO projections 8·D², SwiGLU 6·D·F, causal
+    attention core 2·S·D (QKᵀ + PV at 2·2·S·D halved by causality), LM head
+    2·D·V. Training ≈ 3× forward (one forward + two backward matmuls per
+    forward matmul); remat re-runs each block's forward once more."""
+    d, f, v = config.d_model, config.d_ff, config.vocab_size
+    per_layer = 8 * d * d + 6 * d * f + 2 * seq_len * d
+    fwd = config.n_layers * per_layer + 2 * d * v
+    factor = 4.0 if remat else 3.0
+    # remat does not recompute the LM head (it is outside the blocks)
+    if remat:
+        return factor * config.n_layers * per_layer + 3.0 * 2 * d * v
+    return factor * fwd

@@ -1,0 +1,4 @@
+"""TPU compute kernels (pallas) with portable XLA fallbacks."""
+from .flash_attention import flash_attention, reference_attention
+
+__all__ = ["flash_attention", "reference_attention"]

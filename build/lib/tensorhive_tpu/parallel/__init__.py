@@ -1,0 +1,28 @@
+"""SPMD parallelism layer: device meshes, sharding rules, ring attention.
+
+This subsystem has no counterpart in the reference — TensorHive only
+*launches* distributed trainings and leaves intra-job parallelism to the user
+program (SURVEY.md §2.6: "TP / PP / EP / CP / SP: NO — the launched user
+program owns intra-job parallelism"). The TPU rebuild ships that missing
+layer as a first-class library so the workloads it schedules (the
+t2t_transformer / Llama acceptance configs in BASELINE.json) are themselves
+TPU-native: shardings over a ``jax.sharding.Mesh``, XLA collectives over
+ICI, ring attention for sequence parallelism.
+"""
+from .mesh import (
+    MeshRules,
+    batch_sharding,
+    best_mesh_shape,
+    make_mesh,
+    param_sharding,
+)
+from .ring import ring_attention
+
+__all__ = [
+    "MeshRules",
+    "make_mesh",
+    "best_mesh_shape",
+    "param_sharding",
+    "batch_sharding",
+    "ring_attention",
+]

@@ -1,0 +1,152 @@
+"""Device mesh construction + sharding rules.
+
+The canonical 4-axis mesh for transformer training on TPU pods:
+
+* ``dp``   — pure data parallelism (params replicated) across slices/DCN,
+* ``fsdp`` — data parallelism with parameter sharding (ZeRO-3 style) —
+  the default scaling axis within a slice,
+* ``tp``   — tensor (megatron) parallelism over heads/ffn columns; keep
+  within a chip's nearest ICI neighbors,
+* ``sp``   — sequence/context parallelism (ring attention over shard_map).
+
+Axis order is outermost→innermost = slowest→fastest collectives: dp rides
+DCN, fsdp/tp/sp ride ICI (the "How to Scale Your Model" recipe: pick a mesh,
+annotate shardings, let XLA insert the collectives).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AXES = ("dp", "fsdp", "tp", "sp")
+
+
+def make_mesh(
+    dp: int = 1,
+    fsdp: int = -1,
+    tp: int = 1,
+    sp: int = 1,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a Mesh over ``devices`` (default: all). One axis may be -1 to
+    absorb the remaining device count (like a reshape)."""
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = {"dp": dp, "fsdp": fsdp, "tp": tp, "sp": sp}
+    unknown = [axis for axis, size in sizes.items() if size == -1]
+    known = math.prod(size for size in sizes.values() if size != -1)
+    if len(unknown) > 1:
+        raise ValueError("at most one mesh axis may be -1")
+    if unknown:
+        if len(devices) % known:
+            raise ValueError(
+                f"cannot infer {unknown[0]}: {len(devices)} devices not divisible "
+                f"by {known}"
+            )
+        sizes[unknown[0]] = len(devices) // known
+    if math.prod(sizes.values()) != len(devices):
+        raise ValueError(
+            f"mesh {sizes} needs {math.prod(sizes.values())} devices, "
+            f"have {len(devices)}"
+        )
+    shape = tuple(sizes[a] for a in AXES)
+    return Mesh(np.asarray(devices).reshape(shape), AXES)
+
+
+def best_mesh_shape(n_devices: int, seq_parallel: bool = False) -> Dict[str, int]:
+    """Heuristic default mesh for n devices: fsdp-dominant (the within-slice
+    scaling axis), with a modest tp factor once the slice is large, and an
+    sp factor when long-context is requested. Factors are only taken when
+    they divide n, so the product always equals n_devices."""
+    sizes = {"dp": 1, "fsdp": n_devices, "tp": 1, "sp": 1}
+    if seq_parallel:
+        sp = 4 if n_devices >= 16 and n_devices % 4 == 0 else \
+            2 if n_devices % 2 == 0 else 1
+        sizes["sp"] = sp
+        sizes["fsdp"] = n_devices // sp
+    else:
+        tp = 4 if n_devices >= 16 and n_devices % 4 == 0 else \
+            2 if n_devices >= 4 and n_devices % 2 == 0 else 1
+        sizes["tp"] = tp
+        sizes["fsdp"] = n_devices // tp
+    return sizes
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshRules:
+    """Logical-axis → mesh-axis mapping (flax-style rules, explicit here).
+
+    Parameters carry logical axis names; these rules translate them into
+    PartitionSpecs. ``embed`` (the d_model axis) shards over fsdp so ZeRO-3
+    gathers ride ICI; ``heads``/``ffn``/``vocab`` shard over tp (megatron
+    splits); sequence activations shard over sp.
+    """
+
+    embed: Optional[str] = "fsdp"
+    heads: Optional[str] = "tp"
+    ffn: Optional[str] = "tp"
+    vocab: Optional[str] = "tp"
+    batch: Tuple[str, ...] = ("dp", "fsdp")
+    seq: Optional[str] = "sp"
+
+    def spec(self, *logical: Optional[str]) -> P:
+        return P(*(getattr(self, name) if name else None for name in logical))
+
+
+DEFAULT_RULES = MeshRules()
+
+#: logical axes per parameter leaf path-suffix of the transformer LM
+#: (models/transformer.py param tree); order matches the weight's shape
+_PARAM_LOGICAL: Dict[str, Tuple[Optional[str], ...]] = {
+    "tok_embed": ("vocab", "embed"),
+    "pos_embed": (None, "embed"),
+    "wq": ("embed", "heads"),
+    "wk": ("embed", "heads"),
+    "wv": ("embed", "heads"),
+    "wo": ("heads", "embed"),
+    "w_in": ("embed", "ffn"),
+    "w_gate": ("embed", "ffn"),
+    "w_out": ("ffn", "embed"),
+    "w_lm_head": ("embed", "vocab"),
+    "scale": ("embed",),
+    "bias": ("embed",),
+}
+
+
+def param_sharding(mesh: Mesh, path: str, ndim: int,
+                   rules: MeshRules = DEFAULT_RULES) -> NamedSharding:
+    """Sharding for one parameter identified by its tree path."""
+    leaf = path.rsplit("/", 1)[-1]
+    logical = _PARAM_LOGICAL.get(leaf)
+    if logical is None or len(logical) != ndim:
+        return NamedSharding(mesh, P())  # replicate unknowns
+    return NamedSharding(mesh, rules.spec(*logical))
+
+
+def batch_sharding(mesh: Mesh, rules: MeshRules = DEFAULT_RULES) -> NamedSharding:
+    """[batch, seq+1] token arrays: batch over dp+fsdp. The sequence dim
+    stays unsharded here — raw token batches are tiny int32 and carry the
+    odd +1 target shift; sp-sharding happens on activations inside the model
+    (ring attention's shard_map), where lengths are clean."""
+    return NamedSharding(mesh, P(rules.batch, None))
+
+
+def tree_shardings(mesh: Mesh, params, rules: MeshRules = DEFAULT_RULES):
+    """Map a param pytree to a matching tree of NamedShardings."""
+    flat = jax.tree_util.tree_flatten_with_path(params)[0]
+
+    def path_str(key_path) -> str:
+        return "/".join(
+            str(getattr(k, "key", getattr(k, "idx", k))) for k in key_path
+        )
+
+    shardings = {path_str(kp): param_sharding(mesh, path_str(kp), leaf.ndim, rules)
+                 for kp, leaf in flat}
+    treedef = jax.tree_util.tree_structure(params)
+    return jax.tree_util.tree_unflatten(
+        treedef, [shardings[path_str(kp)] for kp, _ in flat]
+    )

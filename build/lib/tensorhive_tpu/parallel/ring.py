@@ -1,0 +1,297 @@
+"""Ring attention: exact attention over sequence shards.
+
+Long-context strategy (SURVEY.md §5 "long-context: absent in reference;
+TPU build provides it"): the sequence is sharded over the ``sp`` mesh axis;
+each device holds a Q/K/V block, computes blockwise attention against the
+KV block it currently holds, and passes KV around the ring with
+``jax.lax.ppermute`` — after ``sp`` steps every Q block has attended to the
+full sequence. Online-softmax (flash-style running max/denominator)
+accumulation keeps it exact in one pass; communication overlaps compute on
+ICI because each ppermute is independent of the running accumulation.
+
+Reference pattern: Ring Attention (Liu et al., 2023) — re-derived here over
+``shard_map`` + XLA collectives, the idiomatic TPU formulation.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.experimental.shard_map import shard_map
+
+from ..ops.flash_attention import (
+    DEFAULT_BLOCK_K,
+    DEFAULT_BLOCK_Q,
+    _flash_bwd_bhsd,
+    _flash_fwd_bhsd,
+    _from_bhsd,
+    _to_bhsd,
+)
+
+NEG_INF = -1e30
+
+
+def _block_attend(q, k, v, acc, row_max, row_sum, q_offset, k_offset, causal, scale):
+    """One Q-block × KV-block step of streaming-softmax attention.
+
+    q: [B, Lq, H, D]; k, v: [B, Lk, H, D]; acc: [B, Lq, H, D];
+    row_max/row_sum: [B, Lq, H]. Matmuls run in the input dtype (bf16 keeps
+    the MXU on its native path — see ops/flash_attention.py) with f32
+    accumulation; stats and the accumulator are f32.
+    """
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+    scores = scores * scale
+    if causal:
+        q_pos = q_offset + jax.lax.iota(jnp.int32, q.shape[1])
+        k_pos = k_offset + jax.lax.iota(jnp.int32, k.shape[1])
+        mask = q_pos[:, None] >= k_pos[None, :]
+        scores = jnp.where(mask[None, None, :, :], scores, NEG_INF)
+    block_max = jnp.max(scores, axis=-1)                       # [B, H, Lq]
+    new_max = jnp.maximum(row_max, block_max.transpose(0, 2, 1))
+    correction = jnp.exp(row_max - new_max)                    # [B, Lq, H]
+    probs = jnp.exp(scores - new_max.transpose(0, 2, 1)[:, :, :, None])
+    if causal:
+        # rows with no visible keys yet: exp(NEG_INF - NEG_INF) = 1, kill them
+        probs = jnp.where(mask[None, None, :, :], probs, 0.0)
+    block_sum = jnp.sum(probs, axis=-1).transpose(0, 2, 1)     # [B, Lq, H]
+    block_out = jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v.dtype), v,
+                           preferred_element_type=jnp.float32)
+    acc = acc * correction[:, :, :, None] + block_out
+    row_sum = row_sum * correction + block_sum
+    return acc, new_max, row_sum
+
+
+def _ring_attention_local(q, k, v, axis_name: str, causal: bool, scale: float):
+    """Body run per sp-shard inside shard_map. Shapes: [B, L_local, H, D]."""
+    axis_size = jax.lax.psum(1, axis_name)
+    my_index = jax.lax.axis_index(axis_name)
+    seq_len = q.shape[1]
+
+    acc = jnp.zeros(q.shape[:3] + (v.shape[-1],), jnp.float32)
+    row_max = jnp.full(q.shape[:3], NEG_INF, jnp.float32)
+    row_sum = jnp.zeros(q.shape[:3], jnp.float32)
+    q_offset = my_index * seq_len
+
+    def step(carry, _):
+        k_cur, v_cur, k_index, acc, row_max, row_sum = carry
+        k_offset = k_index * seq_len
+        acc, row_max, row_sum = _block_attend(
+            q, k_cur, v_cur, acc, row_max, row_sum,
+            q_offset, k_offset, causal, scale,
+        )
+        perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+        k_next = jax.lax.ppermute(k_cur, axis_name, perm)
+        v_next = jax.lax.ppermute(v_cur, axis_name, perm)
+        k_index = (k_index - 1) % axis_size
+        return (k_next, v_next, k_index, acc, row_max, row_sum), None
+
+    carry = (k, v, my_index, acc, row_max, row_sum)
+    carry, _ = jax.lax.scan(step, carry, None, length=axis_size)
+    _, _, _, acc, row_max, row_sum = carry
+    # rows with zero visible keys (never happens for causal with self block)
+    denom = jnp.where(row_sum == 0.0, 1.0, row_sum)
+    return (acc / denom[:, :, :, None]).astype(q.dtype)
+
+
+# --------------------------------------------------------------------------
+# flash-ring: the pallas kernels inside the ring
+# --------------------------------------------------------------------------
+#
+# The dense blockwise path above materializes [B, H, Lq, Lk] score blocks per
+# ring step — O(local_seq²) HBM per pair. The flash-ring path instead runs
+# the fused pallas kernels per ring step and merges the per-step normalized
+# outputs via their LSEs, so per-shard memory stays O(local_seq·d):
+#
+#   forward  : out = Σ_j softmax-weighted out_j, combined online with
+#              new_lse = logaddexp(lse_run, lse_j)  (exact, order-free)
+#   backward : the flash backward per (q-shard, kv-shard) pair only needs the
+#              MERGED lse and delta = rowsum(dO·O), so each ring step calls
+#              the pallas dq/dkv kernels; dk/dv contributions accumulate in
+#              f32 buffers that rotate with the kv blocks and arrive back at
+#              the owner after a full revolution (Ring Attention backward,
+#              Liu et al. 2023).
+#
+# Mask mode per step relative to my q shard: the kv block currently held is
+# the diagonal (local causal), strictly past (full attention) or strictly
+# future (contributes nothing). The mode depends on axis_index, so all three
+# branches live in a lax.switch — XLA compiles each kernel once.
+
+def _ring_step_fwd(mode, qb, kb, vb, block_q, block_k, interpret):
+    bh, lq, d = qb.shape
+
+    def diag(qb, kb, vb):
+        return _flash_fwd_bhsd(qb, kb, vb, True, block_q, block_k, interpret)
+
+    def past(qb, kb, vb):
+        return _flash_fwd_bhsd(qb, kb, vb, False, block_q, block_k, interpret)
+
+    def future(qb, kb, vb):
+        return (jnp.zeros((bh, lq, d), qb.dtype),
+                jnp.full((bh, 1, lq), NEG_INF, jnp.float32))
+
+    return jax.lax.switch(mode, (diag, past, future), qb, kb, vb)
+
+
+def _ring_step_bwd(mode, qb, kb, vb, outb, lse, dob, block_q, block_k,
+                   interpret):
+    def diag(qb, kb, vb, outb, dob):
+        return _flash_bwd_bhsd(qb, kb, vb, outb, lse, dob, True,
+                               block_q, block_k, interpret)
+
+    def past(qb, kb, vb, outb, dob):
+        return _flash_bwd_bhsd(qb, kb, vb, outb, lse, dob, False,
+                               block_q, block_k, interpret)
+
+    def future(qb, kb, vb, outb, dob):
+        return (jnp.zeros_like(qb), jnp.zeros_like(kb), jnp.zeros_like(vb))
+
+    return jax.lax.switch(mode, (diag, past, future), qb, kb, vb, outb, dob)
+
+
+def _rotate(arrays, axis_name: str, axis_size: int):
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+    return [jax.lax.ppermute(a, axis_name, perm) for a in arrays]
+
+
+def _bhsd(x):
+    """[B,S,H,D] → [BH,S,D] via the flash module's shared transform."""
+    batch, seq, heads, d = x.shape
+    return _to_bhsd(x, batch, seq, heads, d)
+
+
+def _unbhsd(x, batch, heads):
+    bh, seq, d = x.shape
+    return _from_bhsd(x, batch, seq, heads, d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash_ring_local(q, k, v, axis_name, axis_size, causal, block_q, block_k,
+                      interpret):
+    out, _ = _flash_ring_fwd(q, k, v, axis_name, axis_size, causal, block_q,
+                             block_k, interpret)
+    return out
+
+
+def _ring_mode(my_index, step, axis_size, causal):
+    """0=diagonal(local causal) 1=full 2=masked-out, per ring step."""
+    if not causal:
+        return jnp.int32(1)
+    k_index = (my_index - step) % axis_size
+    return jnp.where(k_index == my_index, 0,
+                     jnp.where(k_index < my_index, 1, 2))
+
+
+def _flash_ring_fwd(q, k, v, axis_name, axis_size, causal, block_q, block_k,
+                    interpret):
+    batch, seq_local, heads, d = q.shape
+    my_index = jax.lax.axis_index(axis_name)
+    qb = _bhsd(q)
+    out_run = jnp.zeros(qb.shape, jnp.float32)
+    lse_run = jnp.full((qb.shape[0], 1, seq_local), NEG_INF, jnp.float32)
+    k_cur, v_cur = k, v
+    for s in range(axis_size):                  # static unroll: sp is small
+        mode = _ring_mode(my_index, s, axis_size, causal)
+        out_i, lse_i = _ring_step_fwd(mode, qb, _bhsd(k_cur), _bhsd(v_cur),
+                                      block_q, block_k, interpret)
+        new_lse = jnp.logaddexp(lse_run, lse_i)
+        w_run = jnp.exp(lse_run - new_lse).transpose(0, 2, 1)   # [BH, L, 1]
+        w_i = jnp.exp(lse_i - new_lse).transpose(0, 2, 1)
+        out_run = out_run * w_run + out_i.astype(jnp.float32) * w_i
+        lse_run = new_lse
+        if s < axis_size - 1:
+            k_cur, v_cur = _rotate([k_cur, v_cur], axis_name, axis_size)
+    out = _unbhsd(out_run, batch, heads).astype(q.dtype)
+    return out, (q, k, v, out, lse_run)
+
+
+def _flash_ring_bwd(axis_name, axis_size, causal, block_q, block_k, interpret,
+                    residuals, grad_out):
+    q, k, v, out, lse = residuals
+    batch, seq_local, heads, d = q.shape
+    my_index = jax.lax.axis_index(axis_name)
+    qb, outb, dob = _bhsd(q), _bhsd(out), _bhsd(grad_out)
+    dq_acc = jnp.zeros(qb.shape, jnp.float32)
+    # dk/dv accumulators rotate WITH the kv blocks; after axis_size rotations
+    # (one per step) they land back on the kv owner
+    k_cur, v_cur = k, v
+    dk_cur = jnp.zeros(_bhsd(k).shape, jnp.float32)
+    dv_cur = jnp.zeros(_bhsd(v).shape, jnp.float32)
+    for s in range(axis_size):
+        mode = _ring_mode(my_index, s, axis_size, causal)
+        dq_i, dk_i, dv_i = _ring_step_bwd(
+            mode, qb, _bhsd(k_cur), _bhsd(v_cur), outb, lse, dob,
+            block_q, block_k, interpret)
+        dq_acc = dq_acc + dq_i.astype(jnp.float32)
+        dk_cur = dk_cur + dk_i.astype(jnp.float32)
+        dv_cur = dv_cur + dv_i.astype(jnp.float32)
+        if s < axis_size - 1:
+            k_cur, v_cur, dk_cur, dv_cur = _rotate(
+                [k_cur, v_cur, dk_cur, dv_cur], axis_name, axis_size)
+        else:
+            # only the accumulators must finish the revolution home; the
+            # rotated kv blocks would be dead weight on ICI
+            dk_cur, dv_cur = _rotate([dk_cur, dv_cur], axis_name, axis_size)
+    dq = _unbhsd(dq_acc, batch, heads).astype(q.dtype)
+    dk = _unbhsd(dk_cur, batch, heads).astype(k.dtype)
+    dv = _unbhsd(dv_cur, batch, heads).astype(v.dtype)
+    return dq, dk, dv
+
+
+_flash_ring_local.defvjp(_flash_ring_fwd, _flash_ring_bwd)
+
+
+def _flash_ring_usable(seq_local: int, block_q: int, block_k: int) -> bool:
+    return seq_local % block_q == 0 and seq_local % block_k == 0
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh: Optional[Mesh] = None,
+    axis_name: str = "sp",
+    causal: bool = True,
+    batch_axes=("dp", "fsdp"),
+    head_axis: Optional[str] = "tp",
+) -> jax.Array:
+    """Exact attention with the sequence sharded over ``axis_name``.
+
+    Inputs are [batch, seq, heads, d_head] global arrays; internally each
+    sp-shard sees [batch, seq/sp, heads, d_head]. Works under an outer jit
+    with a mesh in context, or standalone given ``mesh``.
+    """
+    scale = q.shape[-1] ** -0.5
+    if mesh is None or axis_name not in mesh.axis_names or mesh.shape[axis_name] == 1:
+        # no sequence sharding: delegate to the shared dense oracle rather
+        # than keeping a second copy of the same math
+        from ..ops.flash_attention import reference_attention
+
+        return reference_attention(q, k, v, causal=causal)
+
+    axis_size = mesh.shape[axis_name]
+    seq_local = q.shape[1] // axis_size
+    spec = P(batch_axes, axis_name, head_axis, None)
+    if (_flash_ring_usable(seq_local, DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
+            and k.shape == q.shape and v.shape == q.shape):
+        interpret = jax.default_backend() != "tpu"
+
+        def body(q, k, v):
+            # nondiff args passed positionally (custom_vjp nondiff_argnums)
+            return _flash_ring_local(q, k, v, axis_name, axis_size, causal,
+                                     DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K,
+                                     interpret)
+    else:
+        # short per-shard sequences: the dense blockwise body (still exact)
+        body = functools.partial(
+            _ring_attention_local, axis_name=axis_name, causal=causal,
+            scale=scale,
+        )
+    return shard_map(
+        body, mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        check_rep=False,
+    )(q, k, v)

@@ -1,0 +1,119 @@
+"""Workload-side telemetry emitter: the probe's runtime-metrics source.
+
+The OS exposes no HBM-occupancy or utilization counters for TPU chips, so
+the monitoring probe (core/monitors/probe.py) reads drop-files under
+``~/.tpuhive/metrics/`` that the *workload runtime* refreshes. This module
+is that publisher: training loops construct a :class:`TelemetryEmitter` and
+call :meth:`sample` once per step. HBM numbers come from
+``device.memory_stats()`` (PJRT's bytes_in_use / bytes_limit); duty cycle is
+estimated from the device-busy fraction of the step wall time.
+
+Together with the probe this closes the loop the reference gets for free
+from ``nvidia-smi``: dashboard HBM/utilization per chip with no daemon on
+the accelerator path.
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+from pathlib import Path
+from typing import Dict, Optional
+
+DEFAULT_METRICS_DIR = "~/.tpuhive/metrics"
+
+
+class TelemetryEmitter:
+    def __init__(
+        self,
+        name: str = "workload",
+        metrics_dir: Optional[str] = None,
+        min_write_interval_s: float = 1.0,
+    ) -> None:
+        directory = metrics_dir or os.environ.get("TPUHIVE_METRICS_DIR") \
+            or DEFAULT_METRICS_DIR
+        self.path = Path(os.path.expanduser(directory)) / f"{name}-{os.getpid()}.json"
+        self.min_write_interval_s = min_write_interval_s
+        self._last_write = 0.0
+        self._window_start: Optional[float] = None
+        self._busy_accum_s = 0.0
+
+    def sample(self, step_time_s: Optional[float] = None,
+               device_busy_s: Optional[float] = None) -> Optional[Dict]:
+        """Accumulate busy time and (rate-limited) publish metrics.
+
+        ``step_time_s``/``device_busy_s`` feed the duty-cycle estimate: a
+        synchronous training loop is assumed fully busy between dispatch and
+        block_until_ready. Busy time accumulates across EVERY call so that
+        steps shorter than the write interval still sum to the true busy
+        fraction of the window (one step over the whole window would
+        undercount a ~100%-busy device to a few percent).
+        """
+        now = time.monotonic()
+        if self._window_start is None:
+            self._window_start = now - (step_time_s or 0.0)
+        busy = device_busy_s if device_busy_s is not None else step_time_s
+        if busy is not None:
+            self._busy_accum_s += busy
+        if now - self._last_write < self.min_write_interval_s:
+            return None
+
+        duty = None
+        window = now - self._window_start
+        if step_time_s is not None and window > 0:
+            duty = max(0.0, min(100.0, 100.0 * self._busy_accum_s / window))
+        self._window_start = now
+        self._busy_accum_s = 0.0
+
+        metrics = self.collect(duty_cycle_pct=duty)
+        if metrics:
+            self._write(metrics)
+            self._last_write = now
+        return metrics
+
+    @staticmethod
+    def collect(duty_cycle_pct: Optional[float] = None) -> Dict[str, Dict]:
+        """One entry per local device index, probe drop-file schema."""
+        import jax
+
+        metrics: Dict[str, Dict] = {}
+        try:
+            devices = jax.local_devices()
+        except RuntimeError:
+            return metrics
+        for device in devices:
+            stats = {}
+            try:
+                stats = device.memory_stats() or {}
+            except Exception:
+                pass  # backends without memory_stats (CPU) report None fields
+            metrics[str(device.local_hardware_id
+                        if hasattr(device, "local_hardware_id") else device.id)] = {
+                "hbm_used_bytes": stats.get("bytes_in_use"),
+                "hbm_total_bytes": stats.get("bytes_limit"),
+                "duty_cycle_pct": duty_cycle_pct,
+            }
+        return metrics
+
+    def _write(self, metrics: Dict) -> None:
+        """Atomic publish: the probe may read concurrently; a rename never
+        exposes a torn file (the probe additionally validates JSON)."""
+        self.path.parent.mkdir(parents=True, exist_ok=True)
+        fd, tmp = tempfile.mkstemp(dir=str(self.path.parent), suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w") as fh:
+                json.dump(metrics, fh)
+            os.replace(tmp, self.path)
+        except OSError:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        """Remove the drop-file (job teardown)."""
+        try:
+            self.path.unlink()
+        except OSError:
+            pass

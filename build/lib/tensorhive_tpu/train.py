@@ -1,0 +1,282 @@
+"""Sharded training: optimizer, jitted step, checkpointing, data.
+
+The training loop the acceptance workloads run (BASELINE.json configs 3-5).
+One ``make_train_step`` builds a donated, fully-sharded jit:
+
+* params/opt-state sharded by the mesh rules (fsdp/tp),
+* batches sharded dp+fsdp over batch and sp over sequence,
+* loss/grad in f32 with bf16 matmuls (models/transformer.py),
+* gradient sync is implicit — XLA inserts psum/reduce-scatter from the
+  shardings (the scaling-book recipe; no hand-written collectives).
+
+Checkpoint/resume via orbax (the reference has no training checkpoints —
+SURVEY.md §5 "checkpoint/resume: user program's concern"; here the user
+program is part of the framework, so it IS our concern).
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .models.transformer import Params, TransformerConfig, TransformerLM
+from .parallel.mesh import batch_sharding, tree_shardings
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class TrainConfig:
+    learning_rate: float = 3e-4
+    warmup_steps: int = 100
+    total_steps: int = 10_000
+    weight_decay: float = 0.01
+    max_grad_norm: float = 1.0
+    batch_size: int = 8          # GLOBAL tokens-batch per optimizer step
+    seq_len: int = 512
+    #: microbatches per optimizer step (1 = none). The [batch_size, L+1]
+    #: step input is split into grad_accum_steps microbatches scanned
+    #: sequentially with f32 gradient accumulation — big effective batches
+    #: on small slices at 1/grad_accum_steps the activation memory
+    grad_accum_steps: int = 1
+
+
+def make_optimizer(config: TrainConfig) -> optax.GradientTransformation:
+    schedule = optax.warmup_cosine_decay_schedule(
+        init_value=0.0,
+        peak_value=config.learning_rate,
+        warmup_steps=config.warmup_steps,
+        decay_steps=config.total_steps,
+    )
+    return optax.chain(
+        optax.clip_by_global_norm(config.max_grad_norm),
+        optax.adamw(schedule, weight_decay=config.weight_decay),
+    )
+
+
+def init_train_state(
+    key: jax.Array,
+    model_config: TransformerConfig,
+    train_config: TrainConfig,
+    mesh: Optional[Mesh] = None,
+) -> Tuple[Params, Any]:
+    """Initialize params + opt state, placed according to the mesh rules
+    (init runs through jit with out_shardings so large models materialize
+    directly sharded, never replicated on one device)."""
+    if mesh is None:
+        params = TransformerLM.init(key, model_config)
+        opt_state = make_optimizer(train_config).init(params)
+        return params, opt_state
+
+    param_shape = jax.eval_shape(lambda k: TransformerLM.init(k, model_config), key)
+    shardings = tree_shardings(mesh, param_shape)
+    params = jax.jit(
+        lambda k: TransformerLM.init(k, model_config), out_shardings=shardings
+    )(key)
+    optimizer = make_optimizer(train_config)
+    opt_shape = jax.eval_shape(optimizer.init, param_shape)
+    opt_shardings = _opt_state_shardings(mesh, opt_shape, shardings)
+    opt_state = jax.jit(optimizer.init, out_shardings=opt_shardings)(params)
+    return params, opt_state
+
+
+def _opt_state_shardings(mesh: Mesh, opt_shape, param_shardings):
+    """Shardings for the optimizer state: any subtree structurally identical
+    to the param tree (Adam's mu/nu moments) mirrors the param shardings;
+    everything else (step counts, schedule state) replicates."""
+    param_flat, param_def = jax.tree_util.tree_flatten(param_shardings)
+    replicated = NamedSharding(mesh, P())
+
+    def walk(node):
+        flat, treedef = jax.tree_util.tree_flatten(node)
+        if treedef == param_def:
+            return jax.tree_util.tree_unflatten(treedef, param_flat)
+        if isinstance(node, dict):
+            return {key: walk(child) for key, child in node.items()}
+        if hasattr(node, "_fields"):  # NamedTuple state records
+            return type(node)(*(walk(child) for child in node))
+        if isinstance(node, tuple):
+            return tuple(walk(child) for child in node)
+        if isinstance(node, list):
+            return [walk(child) for child in node]
+        return replicated
+
+    return walk(opt_shape)
+
+
+def make_train_step(
+    model_config: TransformerConfig,
+    train_config: TrainConfig,
+    mesh: Optional[Mesh] = None,
+) -> Callable:
+    """Build the jitted train step: (params, opt_state, tokens) ->
+    (params, opt_state, metrics). Params/opt-state buffers are donated."""
+    optimizer = make_optimizer(train_config)
+    accum = train_config.grad_accum_steps
+    if accum < 1:
+        raise ValueError(f"grad_accum_steps must be >= 1, got {accum}")
+    if accum > 1 and train_config.batch_size % accum:
+        raise ValueError(
+            f"batch_size {train_config.batch_size} not divisible by "
+            f"grad_accum_steps {accum}")
+
+    def loss_and_grads(params, tokens):
+        if accum <= 1:
+            return jax.value_and_grad(TransformerLM.loss)(
+                params, tokens, model_config, mesh)
+        micro = train_config.batch_size // accum
+        micro_tokens = tokens.reshape(accum, micro, tokens.shape[-1])
+
+        def one_micro(carry, batch_slice):
+            loss_sum, grads_sum = carry
+            loss, grads = jax.value_and_grad(TransformerLM.loss)(
+                params, batch_slice, model_config, mesh)
+            grads = jax.tree_util.tree_map(
+                lambda acc, g: acc + g.astype(acc.dtype), grads_sum, grads)
+            return (loss_sum + loss, grads), None
+
+        zeros = jax.tree_util.tree_map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), params)
+        (loss_sum, grads), _ = jax.lax.scan(
+            one_micro, (jnp.float32(0.0), zeros), micro_tokens)
+        scale = 1.0 / accum
+        return loss_sum * scale, jax.tree_util.tree_map(
+            lambda g: (g * scale).astype(jnp.float32), grads)
+
+    def step(params, opt_state, tokens):
+        loss, grads = loss_and_grads(params, tokens)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        grad_norm = optax.global_norm(grads)
+        return params, opt_state, {"loss": loss, "grad_norm": grad_norm}
+
+    if mesh is None:
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    data_sharding = batch_sharding(mesh)
+    return jax.jit(
+        step,
+        in_shardings=(None, None, data_sharding),  # params keep their placement
+        donate_argnums=(0, 1),
+    )
+
+
+def synthetic_batch(key: jax.Array, train_config: TrainConfig,
+                    vocab_size: int) -> jax.Array:
+    """Deterministic synthetic LM batch [B, L+1] (benchmarks + tests)."""
+    return jax.random.randint(
+        key, (train_config.batch_size, train_config.seq_len + 1), 0, vocab_size,
+        dtype=jnp.int32,
+    )
+
+
+# -- checkpointing (orbax) ---------------------------------------------------
+
+def save_checkpoint(path: str, step: int, params: Params, opt_state) -> None:
+    import orbax.checkpoint as ocp
+
+    with ocp.CheckpointManager(path) as manager:
+        manager.save(step, args=ocp.args.PyTreeSave({"params": params,
+                                                     "opt_state": opt_state}))
+
+
+def restore_checkpoint(path: str, params_like, opt_state_like) -> Tuple[int, Params, Any]:
+    """Restore the latest step; shapes AND shardings follow the *_like trees.
+
+    The templates are converted to abstract arrays carrying their shardings
+    so orbax RESHARDS onto the current topology — passing concrete arrays
+    would restore with the sharding recorded at save time, which breaks the
+    elastic-resume path (re-launch on a different slice shape after
+    preemption) the moment the saved mesh's devices no longer exist."""
+    import orbax.checkpoint as ocp
+
+    template = {"params": params_like, "opt_state": opt_state_like}
+    restore_args = ocp.checkpoint_utils.construct_restore_args(template)
+    with ocp.CheckpointManager(path) as manager:
+        step = manager.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint under {path}")
+        restored = manager.restore(
+            step,
+            args=ocp.args.PyTreeRestore(template, restore_args=restore_args),
+        )
+    return step, restored["params"], restored["opt_state"]
+
+
+def train_loop(
+    model_config: TransformerConfig,
+    train_config: TrainConfig,
+    mesh: Optional[Mesh] = None,
+    num_steps: int = 10,
+    seed: int = 0,
+    log_every: int = 10,
+    telemetry=None,
+    sync_every: int = 1,
+    batches=None,
+) -> Dict[str, float]:
+    """Minimal complete loop; returns final metrics. Batches come from the
+    ``batches`` iterator when given (e.g. data.prefetch_to_device over token
+    shards) and synthetic data otherwise — the self-contained path bench.py
+    and the examples' smoke modes use.
+
+    ``sync_every``: block on the device only every N steps. Per-step blocking
+    costs the host→device dispatch gap every step (~25% on a tunneled v5e);
+    real training loops enqueue steps back-to-back, which N>1 reproduces —
+    the reported step time is then wall-clock over each N-step window."""
+    key = jax.random.PRNGKey(seed)
+    params, opt_state = init_train_state(key, model_config, train_config, mesh)
+    step_fn = make_train_step(model_config, train_config, mesh)
+    window_times = []           # (per-step seconds, is_full_window)
+    metrics_dev = None
+    window_start = time.perf_counter()
+    window_len = 0
+    last_logged = 0
+    for step_index in range(num_steps):
+        if batches is not None:
+            try:
+                tokens = next(batches)
+            except StopIteration:
+                raise ValueError(
+                    f"batches iterator exhausted at step {step_index} of "
+                    f"{num_steps}") from None
+        else:
+            key, data_key = jax.random.split(key)
+            tokens = synthetic_batch(data_key, train_config,
+                                     model_config.vocab_size)
+        params, opt_state, metrics_dev = step_fn(params, opt_state, tokens)
+        window_len += 1
+        if window_len >= sync_every or step_index == num_steps - 1:
+            # sync via an actual device→host read: block_until_ready has
+            # been observed returning early on tunneled TPU runtimes, which
+            # silently turns timings into dispatch-only measurements — a
+            # 4-byte loss transfer cannot complete before the step has
+            loss_value = float(metrics_dev["loss"])
+            now = time.perf_counter()
+            per_step = (now - window_start) / window_len
+            window_times.append((per_step, window_len >= sync_every))
+            if telemetry is not None:
+                telemetry.sample(step_time_s=per_step)
+            # "log roughly every log_every steps", honored at sync points
+            # (sync_every need not divide log_every)
+            if log_every and (step_index + 1) - last_logged >= log_every:
+                log.info("step %d loss=%.4f (%.1f ms)", step_index + 1,
+                         loss_value, per_step * 1e3)
+                last_logged = step_index + 1
+            window_start = now
+            window_len = 0
+    metrics = {k: float(v) for k, v in metrics_dev.items()}
+    # steady-state step time: drop the compile-laden first window and any
+    # trailing partial window (a short window re-pays the per-sync host gap
+    # the windowing exists to amortize)
+    steady = [t for t, full in window_times[1:] if full] \
+        or [t for t, _ in window_times[1:]] \
+        or [t for t, _ in window_times]
+    metrics["step_time_s"] = sorted(steady)[len(steady) // 2]
+    metrics["steps_per_sec"] = 1.0 / metrics["step_time_s"]
+    return metrics

@@ -1,0 +1,46 @@
+"""Memoization and timing decorators (reference: tensorhive/core/utils/decorators.py).
+
+The reference memoizes on ``str(args)`` (decorators.py:26-53) which silently
+collides for distinct objects with equal reprs; here the cache is keyed on the
+hashable argument tuple and is explicitly clearable (needed by tests and by
+transport reconnects).
+"""
+from __future__ import annotations
+
+import functools
+import logging
+import time
+from typing import Any, Callable, Dict, Tuple, TypeVar
+
+F = TypeVar("F", bound=Callable[..., Any])
+log = logging.getLogger(__name__)
+
+
+def memoize(fn: F) -> F:
+    """Cache results per hashable ``(args, kwargs)``; exposes ``cache_clear``."""
+    cache: Dict[Tuple, Any] = {}
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        key = (args, tuple(sorted(kwargs.items())))
+        if key not in cache:
+            cache[key] = fn(*args, **kwargs)
+        return cache[key]
+
+    wrapper.cache = cache  # type: ignore[attr-defined]
+    wrapper.cache_clear = cache.clear  # type: ignore[attr-defined]
+    return wrapper  # type: ignore[return-value]
+
+
+def timeit(fn: F) -> F:
+    """Debug-log wall time of a call (reference: decorators.py:14-23)."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        start = time.perf_counter()
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            log.debug("%s took %.4fs", fn.__qualname__, time.perf_counter() - start)
+
+    return wrapper  # type: ignore[return-value]

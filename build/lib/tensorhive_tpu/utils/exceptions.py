@@ -1,0 +1,39 @@
+"""Framework exception hierarchy (reference: tensorhive/core/utils/exceptions.py)."""
+
+
+class TpuHiveError(Exception):
+    """Base class for all framework errors."""
+
+
+class ConfigurationError(TpuHiveError):
+    """Raised when a config file or section is invalid/unreadable."""
+
+
+class TransportError(TpuHiveError):
+    """Raised when a remote-execution transport fails (connect/exec)."""
+
+
+class SpawnError(TransportError):
+    """Raised when spawning a detached task process fails."""
+
+
+class ValidationError(TpuHiveError, ValueError):
+    """Raised by entity ``check_assertions`` hooks before persisting
+    (reference: tensorhive/models/CRUDModel.py:21 save-time validation)."""
+
+
+class NotFoundError(TpuHiveError, LookupError):
+    """Raised when an entity id does not exist."""
+
+
+class ForbiddenError(TpuHiveError):
+    """Raised when the acting user lacks permission for an operation."""
+
+
+class ConflictError(TpuHiveError):
+    """Raised on uniqueness/overlap conflicts (e.g. reservation overlap,
+    reference: tensorhive/models/Reservation.py:120-131 would_interfere)."""
+
+
+class TelemetryError(TpuHiveError):
+    """Raised when the native telemetry collector fails."""

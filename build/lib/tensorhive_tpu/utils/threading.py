@@ -1,0 +1,101 @@
+"""Stoppable service threads and a read/write-locked snapshot store.
+
+Reference: tensorhive/core/utils/StoppableThread.py:8-32 provides a bare
+``do_run`` loop with a shutdown flag. The reference shares its infrastructure
+dict across threads *without* locks and relies on ``deepcopy`` on the read
+path (tensorhive/controllers/nodes.py:15, flagged in SURVEY.md §3.5/§7 as an
+implicit concurrency contract to re-implement deliberately). Here the loop
+supports interruptible sleeps and the shared state gets an explicit RW lock.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional
+
+
+class StoppableThread(threading.Thread):
+    """Thread running ``do_run()`` repeatedly until ``shutdown()`` is called.
+
+    Unlike the reference (a plain ``while not stopped: do_run()`` loop with
+    blocking ``gevent.sleep``, MonitoringService.py:48-54), sleeping goes
+    through an :class:`threading.Event` so ``shutdown()`` interrupts a sleep
+    immediately instead of waiting out the interval.
+    """
+
+    def __init__(self, name: Optional[str] = None) -> None:
+        super().__init__(name=name, daemon=True)
+        self._stop_event = threading.Event()
+
+    # -- lifecycle ---------------------------------------------------------
+    def run(self) -> None:  # pragma: no cover - exercised via services tests
+        while not self._stop_event.is_set():
+            self.do_run()
+
+    def do_run(self) -> None:
+        raise NotImplementedError
+
+    def shutdown(self) -> None:
+        self._stop_event.set()
+
+    @property
+    def stopped(self) -> bool:
+        return self._stop_event.is_set()
+
+    def wait(self, seconds: float) -> bool:
+        """Sleep up to ``seconds``; returns True if shutdown was requested."""
+        return self._stop_event.wait(seconds)
+
+
+class RWLock:
+    """Writer-preferring readers/writer lock for shared in-memory state."""
+
+    def __init__(self) -> None:
+        self._cond = threading.Condition()
+        self._readers = 0
+        self._writer = False
+        self._writers_waiting = 0
+
+    def acquire_read(self) -> None:
+        with self._cond:
+            while self._writer or self._writers_waiting:
+                self._cond.wait()
+            self._readers += 1
+
+    def release_read(self) -> None:
+        with self._cond:
+            self._readers -= 1
+            if self._readers == 0:
+                self._cond.notify_all()
+
+    def acquire_write(self) -> None:
+        with self._cond:
+            self._writers_waiting += 1
+            try:
+                while self._writer or self._readers:
+                    self._cond.wait()
+            finally:
+                self._writers_waiting -= 1
+            self._writer = True
+
+    def release_write(self) -> None:
+        with self._cond:
+            self._writer = False
+            self._cond.notify_all()
+
+    class _Guard:
+        def __init__(self, acquire: Callable[[], None], release: Callable[[], None]):
+            self._acquire, self._release = acquire, release
+
+        def __enter__(self):
+            self._acquire()
+            return self
+
+        def __exit__(self, *exc):
+            self._release()
+            return False
+
+    def read(self) -> "RWLock._Guard":
+        return self._Guard(self.acquire_read, self.release_read)
+
+    def write(self) -> "RWLock._Guard":
+        return self._Guard(self.acquire_write, self.release_write)

@@ -1,0 +1,75 @@
+"""UTC-centric time helpers (reference: tensorhive/core/utils/time.py:5-9).
+
+All persisted timestamps are timezone-naive UTC datetimes, matching the
+reference's convention (Reservation start/end stored UTC, models/Reservation.py).
+"""
+from __future__ import annotations
+
+from datetime import datetime, timedelta, timezone
+from typing import Optional, Union
+
+# ISO-8601 with 'T' separator; seconds precision is enough for reservations.
+_FORMATS = (
+    "%Y-%m-%dT%H:%M:%S.%f",
+    "%Y-%m-%dT%H:%M:%S",
+    "%Y-%m-%dT%H:%M",
+    "%Y-%m-%d %H:%M:%S.%f",
+    "%Y-%m-%d %H:%M:%S",
+)
+
+
+def utcnow() -> datetime:
+    """Naive UTC now (single source of truth for the whole framework)."""
+    return datetime.now(timezone.utc).replace(tzinfo=None)
+
+
+def to_utc_naive(dt: datetime) -> datetime:
+    """Normalize any datetime to naive UTC."""
+    if dt.tzinfo is not None:
+        dt = dt.astimezone(timezone.utc).replace(tzinfo=None)
+    return dt
+
+
+def parse_datetime(value: Union[str, datetime, None]) -> Optional[datetime]:
+    """Parse ISO-ish strings (incl. trailing 'Z') into naive UTC datetimes."""
+    if value is None or isinstance(value, datetime):
+        return to_utc_naive(value) if isinstance(value, datetime) else None
+    text = value.strip()
+    try:
+        # handles naive and offset-aware ISO forms, incl. trailing 'Z' and
+        # negative offsets like '-05:00'
+        return to_utc_naive(datetime.fromisoformat(text.replace("Z", "+00:00")))
+    except ValueError:
+        pass
+    for fmt in _FORMATS:
+        try:
+            return datetime.strptime(text, fmt)
+        except ValueError:
+            continue
+    # ValidationError (a ValueError subclass) so API inputs map to 422
+    from .exceptions import ValidationError
+
+    raise ValidationError(f"unparseable datetime: {value!r}")
+
+
+def iso_utc(dt: datetime) -> str:
+    """Canonical naive-UTC ISO text for SQL comparison parameters — matches
+    exactly how Column.to_sql stores datetimes."""
+    return to_utc_naive(dt).isoformat()
+
+
+def isoformat(dt: Optional[datetime]) -> Optional[str]:
+    """Serialize naive-UTC datetime to API form with trailing Z."""
+    if dt is None:
+        return None
+    return dt.replace(microsecond=0).isoformat() + "Z"
+
+
+def overlaps(a_start: datetime, a_end: datetime, b_start: datetime, b_end: datetime) -> bool:
+    """Half-open interval overlap test used by reservation conflict checks
+    (reference: tensorhive/models/Reservation.py:120-131)."""
+    return a_start < b_end and b_start < a_end
+
+
+def minutes_between(a: datetime, b: datetime) -> float:
+    return (b - a) / timedelta(minutes=1)
