@@ -101,6 +101,26 @@ def _online_softmax_block(q, k_blk, v_blk, acc, row_max, row_sum,
     return acc, new_max, row_sum
 
 
+def _causal_kv_sweep(make_body, carry, q_start, block_q, block_k):
+    """Causal KV sweep for a fixed q block: unmasked fori_loop over blocks
+    strictly below the diagonal band, then a masked loop over the band —
+    the iota/where mask work only pays on band blocks. Shared by the
+    resident forward and dq kernels (identical boundary math)."""
+    num_full = jax.lax.div(q_start, block_k)
+    num_kv = jax.lax.div(q_start + block_q - 1, block_k) + 1
+    carry = jax.lax.fori_loop(0, num_full, make_body(False), carry)
+    return jax.lax.fori_loop(num_full, num_kv, make_body(True), carry)
+
+
+def _causal_q_sweep(make_body, carry, k_start, block_q, block_k, num_q):
+    """Causal Q sweep for a fixed kv block (dkv kernel): the masked diagonal
+    band comes first in the sweep, fully-visible q blocks after it."""
+    start_q = jax.lax.div(k_start, block_q)
+    band_end = jax.lax.div(k_start + block_k - 1, block_q) + 1
+    carry = jax.lax.fori_loop(start_q, band_end, make_body(True), carry)
+    return jax.lax.fori_loop(band_end, num_q, make_body(False), carry)
+
+
 def _kv_resident(seq_len: int, d: int, dtype) -> bool:
     """True when one batch*head's K+V (equivalently Q+dO) fit the resident
     VMEM budget."""
@@ -118,22 +138,26 @@ def _fwd_kernel_resident(q_ref, k_ref, v_ref, out_ref, lse_ref, *,
     q = q_ref[0]
     d = q_ref.shape[-1]
 
-    def body(kv_idx, carry):
-        acc, row_max, row_sum = carry
-        k_start = kv_idx * block_k
-        k_blk = k_ref[0, pl.ds(k_start, block_k), :]
-        v_blk = v_ref[0, pl.ds(k_start, block_k), :]
-        return _online_softmax_block(q, k_blk, v_blk, acc, row_max, row_sum,
-                                     q_start, k_start, causal, scale)
+    def make_body(masked: bool):
+        def body(kv_idx, carry):
+            acc, row_max, row_sum = carry
+            k_start = kv_idx * block_k
+            k_blk = k_ref[0, pl.ds(k_start, block_k), :]
+            v_blk = v_ref[0, pl.ds(k_start, block_k), :]
+            return _online_softmax_block(q, k_blk, v_blk, acc, row_max,
+                                         row_sum, q_start, k_start, masked,
+                                         scale)
+        return body
 
-    num_kv = seq_len // block_k
+    carry = (jnp.zeros((block_q, d), jnp.float32),
+             jnp.full((block_q,), NEG_INF, jnp.float32),
+             jnp.zeros((block_q,), jnp.float32))
     if causal:
-        num_kv = jax.lax.div(q_start + block_q - 1, block_k) + 1
-    acc = jnp.zeros((block_q, d), jnp.float32)
-    row_max = jnp.full((block_q,), NEG_INF, jnp.float32)
-    row_sum = jnp.zeros((block_q,), jnp.float32)
-    acc, row_max, row_sum = jax.lax.fori_loop(0, num_kv, body,
-                                              (acc, row_max, row_sum))
+        carry = _causal_kv_sweep(make_body, carry, q_start, block_q, block_k)
+    else:
+        carry = jax.lax.fori_loop(0, seq_len // block_k, make_body(False),
+                                  carry)
+    acc, row_max, row_sum = carry
     denom = jnp.where(row_sum == 0.0, 1.0, row_sum)
     out_ref[0] = (acc / denom[:, None]).astype(out_ref.dtype)
     lse_ref[0, 0, pl.ds(q_start, block_q)] = (
@@ -265,20 +289,23 @@ def _dq_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     delta = delta_ref[0, 0, pl.ds(q_start, block_q)]
     d = q_ref.shape[-1]
 
-    def body(kv_idx, dq_acc):
-        k_start = kv_idx * block_k
-        k_blk = k_ref[0, pl.ds(k_start, block_k), :]
-        v_blk = v_ref[0, pl.ds(k_start, block_k), :]
-        _, ds = _bwd_probs_ds(q, k_blk, v_blk, do, lse, delta,
-                              q_start, k_start, causal, scale)
-        return dq_acc + jnp.dot(ds.astype(k_blk.dtype), k_blk,
-                                preferred_element_type=jnp.float32)
+    def make_body(masked: bool):
+        def body(kv_idx, dq_acc):
+            k_start = kv_idx * block_k
+            k_blk = k_ref[0, pl.ds(k_start, block_k), :]
+            v_blk = v_ref[0, pl.ds(k_start, block_k), :]
+            _, ds = _bwd_probs_ds(q, k_blk, v_blk, do, lse, delta,
+                                  q_start, k_start, masked, scale)
+            return dq_acc + jnp.dot(ds.astype(k_blk.dtype), k_blk,
+                                    preferred_element_type=jnp.float32)
+        return body
 
-    num_kv = seq_len // block_k
+    dq_acc = jnp.zeros((block_q, d), jnp.float32)
     if causal:
-        num_kv = jax.lax.div(q_start + block_q - 1, block_k) + 1
-    dq_acc = jax.lax.fori_loop(0, num_kv, body,
-                               jnp.zeros((block_q, d), jnp.float32))
+        dq_acc = _causal_kv_sweep(make_body, dq_acc, q_start, block_q, block_k)
+    else:
+        dq_acc = jax.lax.fori_loop(0, seq_len // block_k, make_body(False),
+                                   dq_acc)
     dq_ref[0] = (scale * dq_acc).astype(dq_ref.dtype)
 
 
@@ -293,28 +320,32 @@ def _dkv_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     v_blk = v_ref[0]
     d = k_ref.shape[-1]
 
-    def body(q_idx, carry):
-        dk_acc, dv_acc = carry
-        q_start = q_idx * block_q
-        q = q_ref[0, pl.ds(q_start, block_q), :]
-        do = do_ref[0, pl.ds(q_start, block_q), :]
-        lse = lse_ref[0, 0, pl.ds(q_start, block_q)]
-        delta = delta_ref[0, 0, pl.ds(q_start, block_q)]
-        probs, ds = _bwd_probs_ds(q, k_blk, v_blk, do, lse, delta,
-                                  q_start, k_start, causal, scale)
-        dv_acc = dv_acc + jnp.dot(probs.T.astype(do.dtype), do,
-                                  preferred_element_type=jnp.float32)
-        dk_acc = dk_acc + jnp.dot(ds.T.astype(q.dtype), q,
-                                  preferred_element_type=jnp.float32)
-        return dk_acc, dv_acc
+    def make_body(masked: bool):
+        def body(q_idx, carry):
+            dk_acc, dv_acc = carry
+            q_start = q_idx * block_q
+            q = q_ref[0, pl.ds(q_start, block_q), :]
+            do = do_ref[0, pl.ds(q_start, block_q), :]
+            lse = lse_ref[0, 0, pl.ds(q_start, block_q)]
+            delta = delta_ref[0, 0, pl.ds(q_start, block_q)]
+            probs, ds = _bwd_probs_ds(q, k_blk, v_blk, do, lse, delta,
+                                      q_start, k_start, masked, scale)
+            dv_acc = dv_acc + jnp.dot(probs.T.astype(do.dtype), do,
+                                      preferred_element_type=jnp.float32)
+            dk_acc = dk_acc + jnp.dot(ds.T.astype(q.dtype), q,
+                                      preferred_element_type=jnp.float32)
+            return dk_acc, dv_acc
+        return body
 
     num_q = seq_len // block_q
-    start_q = jax.lax.div(k_start, block_q) if causal else 0
-    dk_acc, dv_acc = jax.lax.fori_loop(
-        start_q, num_q, body,
-        (jnp.zeros((block_k, d), jnp.float32),
-         jnp.zeros((block_k, d), jnp.float32)),
-    )
+    carry = (jnp.zeros((block_k, d), jnp.float32),
+             jnp.zeros((block_k, d), jnp.float32))
+    if causal:
+        carry = _causal_q_sweep(make_body, carry, k_start, block_q, block_k,
+                                num_q)
+    else:
+        carry = jax.lax.fori_loop(0, num_q, make_body(False), carry)
+    dk_acc, dv_acc = carry
     dk_ref[0] = (scale * dk_acc).astype(dk_ref.dtype)
     dv_ref[0] = dv_acc.astype(dv_ref.dtype)
 
