@@ -33,6 +33,8 @@ def _merge(intervals: List[Interval]) -> List[Interval]:
 
 
 def _covers(intervals: List[Interval], start: datetime, end: datetime) -> bool:
+    if start >= end:
+        return True    # empty window: vacuously covered
     cursor = start
     for iv_start, iv_end in _merge(intervals):
         if iv_start > cursor:
