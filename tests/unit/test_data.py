@@ -117,3 +117,26 @@ def test_vocab_mismatch_is_caught(tmp_path):
         # enough draws that some window contains an id >= 32000
         for step in range(20):
             dataset.batch_at(step)
+
+
+def test_read_window_property_random_shards(tmp_path):
+    """Brute-force oracle: any window at any offset equals the slice of the
+    logically concatenated stream, across random shard size splits."""
+    import numpy as np
+
+    rng = np.random.default_rng(11)
+    sizes = [int(s) for s in rng.integers(40, 200, size=5)]
+    stream = rng.integers(0, 500, size=sum(sizes)).astype(np.uint16)
+    directory = tmp_path / "prop"
+    directory.mkdir()
+    offset = 0
+    for index, size in enumerate(sizes):
+        stream[offset:offset + size].tofile(directory / f"shard_{index:04d}.bin")
+        offset += size
+    dataset = TokenDataset(DataConfig(pattern=str(directory / "shard_*.bin"),
+                                      seq_len=63, batch_size=1))
+    window = dataset.window
+    for probe in rng.integers(0, len(stream) - window + 1, size=40):
+        np.testing.assert_array_equal(
+            dataset._read_window(int(probe)),
+            stream[probe:probe + window].astype(np.int32))
