@@ -36,23 +36,36 @@ class KVCache(NamedTuple):
 
 def init_cache(config: TransformerConfig, batch: int,
                max_len: Optional[int] = None) -> KVCache:
+    """Cache is [layers, B, max_len, KV_HEADS, Dh] — with GQA the cache is
+    n_heads/n_kv_heads times smaller, the point of grouped-query decode."""
     max_len = max_len or config.max_seq_len
-    shape = (config.n_layers, batch, max_len, config.n_heads, config.d_head)
+    shape = (config.n_layers, batch, max_len, config.kv_heads, config.d_head)
     return KVCache(k=jnp.zeros(shape, config.dtype),
                    v=jnp.zeros(shape, config.dtype))
 
 
 def _decode_attend(q, k_cache, v_cache, position):
-    """q: [B,1,H,Dh]; caches [B,S,H,Dh]; attend to positions <= position."""
-    scale = q.shape[-1] ** -0.5
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache,
+    """q: [B,1,H,Dh]; caches [B,S,Hkv,Dh]; attend to positions <= position.
+
+    GQA attends DIRECTLY against the unexpanded cache via a grouped einsum
+    (q reshaped to [B,1,Hkv,G,Dh]) — materializing an expanded K/V copy per
+    step would restore the MHA-sized HBM read this cache layout exists to
+    avoid. Head convention matches the training expand (jnp.repeat): full
+    head i shares kv head i // group."""
+    batch, _, heads, d_head = q.shape
+    kv_heads = k_cache.shape[2]
+    group = heads // kv_heads
+    scale = d_head ** -0.5
+    q_grouped = q.reshape(batch, 1, kv_heads, group, d_head)
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", q_grouped, k_cache,
                         preferred_element_type=jnp.float32) * scale
     key_positions = jax.lax.iota(jnp.int32, k_cache.shape[1])
-    mask = key_positions[None, None, None, :] <= position
+    mask = key_positions[None, None, None, None, :] <= position
     scores = jnp.where(mask, scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", probs.astype(v_cache.dtype), v_cache,
-                      preferred_element_type=jnp.float32).astype(q.dtype)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs.astype(v_cache.dtype),
+                     v_cache, preferred_element_type=jnp.float32)
+    return out.reshape(batch, 1, heads, d_head).astype(q.dtype)
 
 
 def apply_step(

@@ -55,10 +55,21 @@ class TransformerConfig:
     #: device can't report memory), so fitting runs keep the fused fast path
     loss_chunk_tokens: int = 16_384
 
+    #: grouped-query attention: number of K/V heads (None = n_heads, MHA).
+    #: Shrinks wk/wv and — the real win — the decode KV cache by
+    #: n_heads/n_kv_heads; Q heads share K/V heads in groups.
+    n_kv_heads: Optional[int] = None
+
     @property
     def d_head(self) -> int:
         assert self.d_model % self.n_heads == 0
         return self.d_model // self.n_heads
+
+    @property
+    def kv_heads(self) -> int:
+        kv = self.n_kv_heads or self.n_heads
+        assert self.n_heads % kv == 0, "n_heads must be a multiple of n_kv_heads"
+        return kv
 
 
 #: named sizes; "t2t-base" mirrors tensor2tensor transformer_base
@@ -154,6 +165,7 @@ class TransformerLM:
                     * (1.0 / math.sqrt(fan_in)))
 
         d, h, dh, f = (config.d_model, config.n_heads, config.d_head, config.d_ff)
+        kv = config.kv_heads
         params: Params = {
             "tok_embed": jax.random.normal(next(keys), (config.vocab_size, d),
                                            jnp.float32) * 0.02,
@@ -166,8 +178,8 @@ class TransformerLM:
                 "attn_norm": {"scale": jnp.ones((d,), jnp.float32)},
                 "mlp_norm": {"scale": jnp.ones((d,), jnp.float32)},
                 "wq": dense(next(keys), d, d, h * dh),
-                "wk": dense(next(keys), d, d, h * dh),
-                "wv": dense(next(keys), d, d, h * dh),
+                "wk": dense(next(keys), d, d, kv * dh),
+                "wv": dense(next(keys), d, d, kv * dh),
                 "wo": dense(next(keys), h * dh, h * dh, d),
                 "w_in": dense(next(keys), d, d, f),
                 "w_gate": dense(next(keys), d, d, f),
@@ -189,9 +201,9 @@ class TransformerLM:
         b, l, d = h.shape
         q = (h @ block["wq"].astype(dtype)).reshape(b, l, config.n_heads,
                                                     config.d_head)
-        k = (h @ block["wk"].astype(dtype)).reshape(b, l, config.n_heads,
+        k = (h @ block["wk"].astype(dtype)).reshape(b, l, config.kv_heads,
                                                     config.d_head)
-        v = (h @ block["wv"].astype(dtype)).reshape(b, l, config.n_heads,
+        v = (h @ block["wv"].astype(dtype)).reshape(b, l, config.kv_heads,
                                                     config.d_head)
         q = _rope(q, positions, config.rope_theta)
         k = _rope(k, positions, config.rope_theta)
@@ -224,6 +236,13 @@ class TransformerLM:
             and mesh.shape["sp"] > 1
 
         def attend(q, k, v):
+            if k.shape[2] != q.shape[2]:
+                # GQA: expand K/V head groups for the full-sequence kernels
+                # (training holds full activations anyway; the cache saving
+                # is decode's, models/decode.py keeps kv_heads unexpanded)
+                group = q.shape[2] // k.shape[2]
+                k = jnp.repeat(k, group, axis=2)
+                v = jnp.repeat(v, group, axis=2)
             if sp_sharded:
                 return ring_attention(q, k, v, mesh=mesh, causal=True)
             if config.use_flash:
@@ -321,12 +340,14 @@ def train_flops_per_token(config: TransformerConfig, seq_len: int,
     """Analytic model FLOPs per trained token (matmuls only — norms/rope/
     softmax are bandwidth, not MXU FLOPs). Used for MFU reporting.
 
-    Per token, forward: QKVO projections 8·D², SwiGLU 6·D·F, causal
-    attention core 2·S·D (QKᵀ + PV at 2·2·S·D halved by causality), LM head
-    2·D·V. Training ≈ 3× forward (one forward + two backward matmuls per
-    forward matmul); remat re-runs each block's forward once more."""
+    Per token, forward: Q+O projections 4·D², K+V projections 4·D·Hkv·Dh
+    (GQA-shrunk when n_kv_heads < n_heads), SwiGLU 6·D·F, causal attention
+    core 2·S·D (QKᵀ + PV at 2·2·S·D halved by causality), LM head 2·D·V.
+    Training ≈ 3× forward (one forward + two backward matmuls per forward
+    matmul); remat re-runs each block's forward once more."""
     d, f, v = config.d_model, config.d_ff, config.vocab_size
-    per_layer = 8 * d * d + 6 * d * f + 2 * seq_len * d
+    kv_dim = config.kv_heads * config.d_head
+    per_layer = 4 * d * d + 4 * d * kv_dim + 6 * d * f + 2 * seq_len * d
     fwd = config.n_layers * per_layer + 2 * d * v
     factor = 4.0 if remat else 3.0
     # remat does not recompute the LM head (it is outside the blocks)
