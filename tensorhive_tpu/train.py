@@ -178,10 +178,15 @@ def synthetic_batch(key: jax.Array, train_config: TrainConfig,
 
 # -- checkpointing (orbax) ---------------------------------------------------
 
-def save_checkpoint(path: str, step: int, params: Params, opt_state) -> None:
+def save_checkpoint(path: str, step: int, params: Params, opt_state,
+                    max_to_keep: int = 3) -> None:
+    """Save one step, retaining only the newest ``max_to_keep`` steps — a
+    preemption-resumable long run (examples/queued_training) checkpoints
+    every few hundred steps and must not grow the disk without bound."""
     import orbax.checkpoint as ocp
 
-    with ocp.CheckpointManager(path) as manager:
+    options = ocp.CheckpointManagerOptions(max_to_keep=max_to_keep)
+    with ocp.CheckpointManager(path, options=options) as manager:
         manager.save(step, args=ocp.args.PyTreeSave({"params": params,
                                                      "opt_state": opt_state}))
 

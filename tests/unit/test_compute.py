@@ -650,3 +650,22 @@ def test_gqa_trains_sharded_and_decodes_cache_exact():
         outs.append(logits)
     np.testing.assert_allclose(np.asarray(jnp.stack(outs, axis=1)),
                                np.asarray(full), atol=2e-4, rtol=2e-4)
+
+
+def test_checkpoint_retention_keeps_only_newest(tmp_path):
+    """save_checkpoint prunes old steps (max_to_keep) so long preemptible
+    runs don't grow the disk without bound; the latest step still restores."""
+    from tensorhive_tpu.train import restore_checkpoint, save_checkpoint
+
+    config = TINY
+    train_config = TrainConfig(batch_size=2, seq_len=16)
+    params, opt_state = init_train_state(jax.random.PRNGKey(0), config,
+                                         train_config)
+    path = str(tmp_path / "ckpt")
+    for step in (1, 2, 3, 4, 5):
+        save_checkpoint(path, step, params, opt_state, max_to_keep=2)
+    step_dirs = sorted(int(p.name) for p in (tmp_path / "ckpt").iterdir()
+                       if p.name.isdigit())
+    assert len(step_dirs) <= 2 and max(step_dirs) == 5
+    step, _, _ = restore_checkpoint(path, params, opt_state)
+    assert step == 5
