@@ -49,8 +49,40 @@ function renderCalendar(main) {
     </div>
     <div id="cal" class="tgrid-wrap" style="margin-top:1rem"></div>
   </div>
+  <div id="usage-card"></div>
   <dialog id="res-dialog"></dialog>`;
   drawCalendar();
+  drawUsage();
+}
+
+/* usage accounting (reference: UsageLoggingService averages persisted onto
+   the reservation row): recently finished reservations + their recorded
+   utilization */
+async function drawUsage() {
+  const el = document.getElementById("usage-card");
+  if (!el) return;
+  const now = new Date();
+  const weekAgo = new Date(now - 7 * 864e5);
+  let past;
+  try {
+    past = await api(`/reservations?start=${weekAgo.toISOString()}&end=${now.toISOString()}`);
+  } catch (e) { return; }
+  const finished = past.filter(r => new Date(r.end) <= now && !r.isCancelled);
+  if (!finished.length) { el.innerHTML = ""; return; }
+  finished.sort((a, b) => new Date(b.end) - new Date(a.end));
+  el.innerHTML = `<div class="card">
+    <h3 style="margin:0 0 .5rem">Usage — last 7 days</h3>
+    <table><tr><th>reservation</th><th>chip</th><th>ended</th>
+      <th>avg duty</th><th>avg HBM</th></tr>
+    ${finished.slice(0, 12).map(r => `<tr>
+      <td>${esc(r.title)} <span class="muted">#${r.id}</span></td>
+      <td class="muted">${esc(r.resourceId)}</td>
+      <td class="muted">${fmtDt(r.end)}</td>
+      <td>${r.dutyCycleAvg != null ? r.dutyCycleAvg + "%" : "—"}</td>
+      <td>${r.hbmUtilAvg != null ? r.hbmUtilAvg + "%" : "—"}</td>
+    </tr>`).join("")}</table>
+    ${finished.length > 12 ? `<p class="muted">…and ${finished.length - 12} more</p>` : ""}
+  </div>`;
 }
 function calShift(days) { calStart.setDate(calStart.getDate() + days); drawCalendar(); }
 function calToday() { calStart = startOfWeek(new Date()); drawCalendar(); }
