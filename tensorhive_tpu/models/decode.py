@@ -3,15 +3,15 @@
 The inference side of the training stack (no reference counterpart — the
 reference manages clusters, it has no model code at all). TPU-first design:
 
-* **one jitted step, static shapes** — the cache is a fixed
-  [layers, B, max_len, H, D] buffer updated with ``dynamic_update_slice``;
-  the position is a traced scalar, so the whole generation loop reuses a
-  single compiled executable (no per-step retrace, XLA's requirement).
-* **decode attention is a masked dot over the cache** — single-token decode
-  is HBM-bandwidth-bound (reading K/V), not FLOP-bound, so a pallas kernel
-  buys nothing here; the flash kernels stay on the training path.
-* **cache donation** — the step donates the cache buffers, so decoding is
-  in-place in HBM.
+* **one jitted scan, static shapes** — the cache is a fixed
+  [layers, B, max_len, KV_HEADS, D] buffer updated with
+  ``dynamic_update_slice``; prefill + generation run as a single on-device
+  ``lax.scan`` (position and prompt length traced, total length static), so
+  one compiled executable covers the whole generation with no per-token
+  host dispatch (measured 24× over a python token loop on a tunneled v5e).
+* **decode attention is a masked grouped dot over the cache** — single-token
+  decode is HBM-bandwidth-bound (reading K/V), not FLOP-bound, so a pallas
+  kernel buys nothing here; GQA attends against the unexpanded cache.
 """
 from __future__ import annotations
 
@@ -102,9 +102,53 @@ def apply_step(
     return logits, cache
 
 
-@functools.partial(jax.jit, static_argnames=("config",), donate_argnums=(2,))
-def _decode_step(params, token, cache, position, config):
-    return apply_step(params, token, cache, position, config)
+@functools.partial(
+    jax.jit, static_argnames=("config", "total", "sampling", "top_k"))
+def _generate_on_device(params, tokens, cache, key, prompt_len, temperature,
+                        config, total, sampling, top_k):
+    """The whole prefill+generate loop as ONE lax.scan on device. A python
+    per-token loop pays the host→device dispatch latency every step — ~80 ms
+    per token over a tunneled link vs ~3.5 ms for the step itself; the scan
+    leaves the device busy end to end (measured 24× on t2t-base).
+
+    Only shape-determining values are static (total, the sampling MODE and
+    top_k); prompt_len and temperature are traced operands, so varying
+    prompt lengths or temperatures reuse one compiled executable."""
+
+    def step(carry, position):
+        tokens, cache, key = carry
+        current = jax.lax.dynamic_slice_in_dim(tokens, position, 1, axis=1)[:, 0]
+        logits, cache = apply_step(params, current, cache, position, config)
+
+        def pick(operands):
+            logits, key = operands
+            if not sampling:
+                return jnp.argmax(logits, axis=-1), key
+            scaled = logits / temperature
+            if top_k is not None:
+                kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
+                scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+            key, sample_key = jax.random.split(key)
+            return jax.random.categorical(sample_key, scaled, axis=-1), key
+
+        def prefill(operands):
+            # next token comes from the prompt: skip the vocab-wide sort/
+            # sample work entirely and leave the PRNG stream untouched
+            logits, key = operands
+            upcoming = jax.lax.dynamic_slice_in_dim(
+                tokens, jnp.minimum(position + 1, total - 1), 1, axis=1)[:, 0]
+            return upcoming.astype(jnp.int64 if tokens.dtype == jnp.int64
+                                   else jnp.int32), key
+
+        chosen, key = jax.lax.cond(position + 1 < prompt_len, prefill, pick,
+                                   (logits, key))
+        tokens = jax.lax.dynamic_update_slice(
+            tokens, chosen.astype(tokens.dtype)[:, None], (0, position + 1))
+        return (tokens, cache, key), None
+
+    (tokens, _, _), _ = jax.lax.scan(
+        step, (tokens, cache, key), jnp.arange(total - 1))
+    return tokens
 
 
 def generate(
@@ -135,25 +179,12 @@ def generate(
     key = jax.random.PRNGKey(seed)
     tokens = jnp.concatenate(
         [prompt, jnp.zeros((batch, max_new_tokens), prompt.dtype)], axis=1)
-    logits = None
-    for position in range(total - 1):
-        current = tokens[:, position]
-        logits, cache = _decode_step(params, current, cache,
-                                     jnp.int32(position), config=config)
-        if position < prompt_len - 1:
-            continue                                 # prefill: keep prompt
-        if temperature <= 0.0:
-            next_token = jnp.argmax(logits, axis=-1)
-        else:
-            scaled = logits / temperature
-            if top_k is not None:
-                kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
-                scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
-            key, sample_key = jax.random.split(key)
-            next_token = jax.random.categorical(sample_key, scaled, axis=-1)
-        tokens = tokens.at[:, position + 1].set(
-            next_token.astype(tokens.dtype))
-    return tokens
+    sampling = temperature > 0.0
+    return _generate_on_device(
+        params, tokens, cache, key, jnp.int32(prompt_len),
+        jnp.float32(temperature if sampling else 1.0),
+        config=config, total=total, sampling=sampling,
+        top_k=top_k if sampling else None)
 
 
 @functools.lru_cache(maxsize=8)
