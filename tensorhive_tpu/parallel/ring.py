@@ -19,16 +19,16 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 from jax.experimental.shard_map import shard_map
 
 from ..ops.flash_attention import (
-    DEFAULT_BLOCK_K,
-    DEFAULT_BLOCK_Q,
     _flash_bwd_bhsd,
     _flash_fwd_bhsd,
     _from_bhsd,
     _to_bhsd,
+    default_blocks,
+    flash_bwd_delta,
 )
 
 NEG_INF = -1e30
@@ -119,14 +119,16 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool, scale: float):
 # future (contributes nothing). The mode depends on axis_index, so all three
 # branches live in a lax.switch — XLA compiles each kernel once.
 
-def _ring_step_fwd(mode, qb, kb, vb, block_q, block_k, interpret):
+def _ring_step_fwd(mode, qb, kb, vb, block_q, block_k, interpret, scale):
     bh, lq, d = qb.shape
 
     def diag(qb, kb, vb):
-        return _flash_fwd_bhsd(qb, kb, vb, True, block_q, block_k, interpret)
+        return _flash_fwd_bhsd(qb, kb, vb, True, block_q, block_k, interpret,
+                               scale=scale)
 
     def past(qb, kb, vb):
-        return _flash_fwd_bhsd(qb, kb, vb, False, block_q, block_k, interpret)
+        return _flash_fwd_bhsd(qb, kb, vb, False, block_q, block_k, interpret,
+                               scale=scale)
 
     def future(qb, kb, vb):
         return (jnp.zeros((bh, lq, d), qb.dtype),
@@ -135,20 +137,23 @@ def _ring_step_fwd(mode, qb, kb, vb, block_q, block_k, interpret):
     return jax.lax.switch(mode, (diag, past, future), qb, kb, vb)
 
 
-def _ring_step_bwd(mode, qb, kb, vb, outb, lse, dob, block_q, block_k,
-                   interpret):
-    def diag(qb, kb, vb, outb, dob):
+def _ring_step_bwd(mode, qb, kb, vb, outb, lse, dob, delta, block_q, block_k,
+                   interpret, scale):
+    def diag(qb, kb, vb, outb, dob, delta):
         return _flash_bwd_bhsd(qb, kb, vb, outb, lse, dob, True,
-                               block_q, block_k, interpret)
+                               block_q, block_k, interpret, scale=scale,
+                               delta=delta)
 
-    def past(qb, kb, vb, outb, dob):
+    def past(qb, kb, vb, outb, dob, delta):
         return _flash_bwd_bhsd(qb, kb, vb, outb, lse, dob, False,
-                               block_q, block_k, interpret)
+                               block_q, block_k, interpret, scale=scale,
+                               delta=delta)
 
-    def future(qb, kb, vb, outb, dob):
+    def future(qb, kb, vb, outb, dob, delta):
         return (jnp.zeros_like(qb), jnp.zeros_like(kb), jnp.zeros_like(vb))
 
-    return jax.lax.switch(mode, (diag, past, future), qb, kb, vb, outb, dob)
+    return jax.lax.switch(mode, (diag, past, future), qb, kb, vb, outb, dob,
+                          delta)
 
 
 def _rotate(arrays, axis_name: str, axis_size: int):
@@ -167,11 +172,11 @@ def _unbhsd(x, batch, heads):
     return _from_bhsd(x, batch, seq, heads, d)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def _flash_ring_local(q, k, v, axis_name, axis_size, causal, block_q, block_k,
-                      interpret):
+                      interpret, scale):
     out, _ = _flash_ring_fwd(q, k, v, axis_name, axis_size, causal, block_q,
-                             block_k, interpret)
+                             block_k, interpret, scale)
     return out
 
 
@@ -185,7 +190,7 @@ def _ring_mode(my_index, step, axis_size, causal):
 
 
 def _flash_ring_fwd(q, k, v, axis_name, axis_size, causal, block_q, block_k,
-                    interpret):
+                    interpret, scale):
     batch, seq_local, heads, d = q.shape
     my_index = jax.lax.axis_index(axis_name)
     qb = _bhsd(q)
@@ -195,7 +200,7 @@ def _flash_ring_fwd(q, k, v, axis_name, axis_size, causal, block_q, block_k,
     for s in range(axis_size):                  # static unroll: sp is small
         mode = _ring_mode(my_index, s, axis_size, causal)
         out_i, lse_i = _ring_step_fwd(mode, qb, _bhsd(k_cur), _bhsd(v_cur),
-                                      block_q, block_k, interpret)
+                                      block_q, block_k, interpret, scale)
         new_lse = jnp.logaddexp(lse_run, lse_i)
         w_run = jnp.exp(lse_run - new_lse).transpose(0, 2, 1)   # [BH, L, 1]
         w_i = jnp.exp(lse_i - new_lse).transpose(0, 2, 1)
@@ -208,11 +213,14 @@ def _flash_ring_fwd(q, k, v, axis_name, axis_size, causal, block_q, block_k,
 
 
 def _flash_ring_bwd(axis_name, axis_size, causal, block_q, block_k, interpret,
-                    residuals, grad_out):
+                    scale, residuals, grad_out):
     q, k, v, out, lse = residuals
     batch, seq_local, heads, d = q.shape
     my_index = jax.lax.axis_index(axis_name)
     qb, outb, dob = _bhsd(q), _bhsd(out), _bhsd(grad_out)
+    # delta = rowsum(dO∘O) depends only on the local q shard: compute it
+    # ONCE here instead of per ring step (axis_size× redundant reductions)
+    delta = flash_bwd_delta(dob, outb)
     dq_acc = jnp.zeros(qb.shape, jnp.float32)
     # dk/dv accumulators rotate WITH the kv blocks; after axis_size rotations
     # (one per step) they land back on the kv owner
@@ -222,8 +230,8 @@ def _flash_ring_bwd(axis_name, axis_size, causal, block_q, block_k, interpret,
     for s in range(axis_size):
         mode = _ring_mode(my_index, s, axis_size, causal)
         dq_i, dk_i, dv_i = _ring_step_bwd(
-            mode, qb, _bhsd(k_cur), _bhsd(v_cur), outb, lse, dob,
-            block_q, block_k, interpret)
+            mode, qb, _bhsd(k_cur), _bhsd(v_cur), outb, lse, dob, delta,
+            block_q, block_k, interpret, scale)
         dq_acc = dq_acc + dq_i.astype(jnp.float32)
         dk_cur = dk_cur + dk_i.astype(jnp.float32)
         dv_cur = dv_cur + dv_i.astype(jnp.float32)
@@ -274,15 +282,18 @@ def ring_attention(
     axis_size = mesh.shape[axis_name]
     seq_local = q.shape[1] // axis_size
     spec = P(batch_axes, axis_name, head_axis, None)
-    if (_flash_ring_usable(seq_local, DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K)
+    block_q, block_k = default_blocks(seq_local)
+    if (_flash_ring_usable(seq_local, block_q, block_k)
             and k.shape == q.shape and v.shape == q.shape):
         interpret = jax.default_backend() != "tpu"
 
         def body(q, k, v):
-            # nondiff args passed positionally (custom_vjp nondiff_argnums)
+            # nondiff args passed positionally (custom_vjp nondiff_argnums);
+            # the SAME scale feeds both ring bodies so the flash and dense
+            # paths cannot diverge on it
             return _flash_ring_local(q, k, v, axis_name, axis_size, causal,
-                                     DEFAULT_BLOCK_Q, DEFAULT_BLOCK_K,
-                                     interpret)
+                                     block_q, block_k,
+                                     interpret, scale)
     else:
         # short per-shard sequences: the dense blockwise body (still exact)
         body = functools.partial(

@@ -1,0 +1,137 @@
+"""Perf lab: measure train_loop step time under controlled variants.
+
+Usage: python tools/perf_lab.py VARIANT [preset] [batch] [seq] [steps]
+
+Variants:
+  baseline          — the code as committed
+  noattn            — attention replaced by identity (v passthrough): lower
+                      bound on step time with zero attention cost
+  dense             — XLA reference attention instead of pallas kernels
+  blocks:BQ:BK      — override flash block sizes
+  noremat/remat     — force remat off/on
+
+All timings via tensorhive_tpu.train.train_loop (the only trustworthy
+timing path on the tunneled chip — kernel micros are garbage there).
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+
+def main() -> None:
+    variant = sys.argv[1] if len(sys.argv) > 1 else "baseline"
+    preset = sys.argv[2] if len(sys.argv) > 2 else "t2t-base"
+    batch = int(sys.argv[3]) if len(sys.argv) > 3 else 64
+    seq = int(sys.argv[4]) if len(sys.argv) > 4 else 1024
+    steps = int(sys.argv[5]) if len(sys.argv) > 5 else 24
+
+    import tensorhive_tpu.models.transformer as T
+    from tensorhive_tpu.models.transformer import PRESETS, train_flops_per_token
+    import importlib
+
+    # ops/__init__ re-exports the flash_attention FUNCTION, shadowing the
+    # submodule attribute — go through sys.modules for the module itself
+    FA = importlib.import_module("tensorhive_tpu.ops.flash_attention")
+    from tensorhive_tpu.train import TrainConfig, train_loop
+
+    remat = False
+    if variant == "noattn":
+        T.flash_attention = lambda q, k, v, causal=True: v
+    elif variant == "dense":
+        mc0 = PRESETS[preset]
+        T.flash_attention = functools.partial(FA.reference_attention)
+    elif variant.startswith("blocks:"):
+        _, bq, bk = variant.split(":")
+        T.flash_attention = functools.partial(
+            FA.flash_attention, block_q=int(bq), block_k=int(bk))
+    elif variant.startswith("streaming:"):
+        # force the 3D streaming kernels (BlockSpec-pipelined K/V) instead
+        # of the resident fori_loop kernels
+        _, bq, bk = variant.split(":")
+        FA.RESIDENT_KV_MAX_BYTES = 0
+        T.flash_attention = functools.partial(
+            FA.flash_attention, block_q=int(bq), block_k=int(bk))
+    elif variant == "jaxflash":
+        # canonical jax pallas TPU flash kernel as a comparison point:
+        # isolates "our kernels are slow" from "pallas-on-this-chip is slow"
+        from jax.experimental.pallas.ops.tpu.flash_attention import (
+            flash_attention as jax_flash)
+
+        def call(q, k, v, causal=True):
+            # jax kernel wants [B, H, S, D]
+            out = jax_flash(q.transpose(0, 2, 1, 3), k.transpose(0, 2, 1, 3),
+                            v.transpose(0, 2, 1, 3), causal=causal)
+            return out.transpose(0, 2, 1, 3)
+
+        T.flash_attention = call
+    elif variant == "pallascopy":
+        # trivial pallas kernel as attention: isolates fixed per-custom-call
+        # cost from kernel compute (2 calls/layer: fwd copy + bwd copy)
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def _copy_kernel(v_ref, o_ref):
+            o_ref[...] = v_ref[...]
+
+        def _pallas_copy(x):
+            bh = x.shape[0]
+            return pl.pallas_call(
+                _copy_kernel,
+                grid=(bh,),
+                in_specs=[pl.BlockSpec((1,) + x.shape[1:], lambda b: (b, 0, 0))],
+                out_specs=pl.BlockSpec((1,) + x.shape[1:], lambda b: (b, 0, 0)),
+                out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+            )(x)
+
+        @jax.custom_vjp
+        def copy_attn(q, k, v):
+            b, s, h, d = v.shape
+            return _pallas_copy(v.reshape(b * h, s, d).copy()
+                                if False else
+                                v.transpose(0, 2, 1, 3).reshape(b * h, s, d)
+                                ).reshape(b, h, s, d).transpose(0, 2, 1, 3)
+
+        def copy_fwd(q, k, v):
+            return copy_attn(q, k, v), None
+
+        def copy_bwd(_, g):
+            b, s, h, d = g.shape
+            gc = _pallas_copy(g.transpose(0, 2, 1, 3).reshape(b * h, s, d))
+            gc = gc.reshape(b, h, s, d).transpose(0, 2, 1, 3)
+            return (jnp.zeros_like(gc), jnp.zeros_like(gc), gc)
+
+        copy_attn.defvjp(copy_fwd, copy_bwd)
+        T.flash_attention = lambda q, k, v, causal=True: copy_attn(q, k, v)
+    elif variant == "remat":
+        remat = True
+    elif variant == "remat-mlp":
+        remat = "mlp"
+    elif variant != "baseline" and variant != "noremat":
+        raise SystemExit(f"unknown variant {variant}")
+
+    model_config = dataclasses.replace(
+        PRESETS[preset], remat=bool(remat),
+        remat_policy="mlp" if remat == "mlp" else "block")
+    train_config = TrainConfig(batch_size=batch, seq_len=seq,
+                               warmup_steps=2, total_steps=100)
+    metrics = train_loop(model_config, train_config, mesh=None,
+                         num_steps=steps, log_every=0,
+                         sync_every=max(1, steps // 3))
+    step_ms = metrics["step_time_s"] * 1e3
+    toks = batch * seq * metrics["steps_per_sec"]
+    flops_per_token = train_flops_per_token(model_config, seq, remat=False)
+    mfu = toks * flops_per_token / (197.0e12)
+    print(f"{variant} {preset} b{batch} s{seq} remat={remat}: "
+          f"{step_ms:.2f} ms/step, {toks:,.0f} tok/s, mfu={mfu:.4f}, "
+          f"loss={metrics['loss']:.4f}")
+
+
+if __name__ == "__main__":
+    main()
