@@ -10,11 +10,11 @@ this round; skip a slot when the *owner's own* reservation is upcoming
 from __future__ import annotations
 
 import logging
+from datetime import timedelta
 from typing import Callable, Dict, List, Optional, Set
 
 from ..db.models.job import Job
 from ..db.models.reservation import Reservation
-from ..db.models.user import User
 from ..utils.timeutils import minutes_between, utcnow
 
 log = logging.getLogger(__name__)
@@ -38,6 +38,42 @@ class Scheduler:
         raise NotImplementedError
 
 
+def _free_minutes_from_events(
+    events: List[Reservation],
+    horizon_mins: float,
+    at,
+    for_user_id: Optional[int] = None,
+) -> float:
+    """Free minutes until the first event in ``events`` not owned by
+    ``for_user_id``, capped at ``horizon_mins``. ``events`` are this chip's
+    non-cancelled reservations overlapping [at, at+horizon). An already-
+    running foreign reservation (start <= at) yields 0."""
+    foreign = [r for r in events if r.user_id != for_user_id]
+    if not foreign:
+        return horizon_mins
+    free = min(minutes_between(at, r.start) for r in foreign)
+    return min(horizon_mins, max(0.0, free))
+
+
+def upcoming_events_by_chip(
+    uids: Set[str],
+    horizon_mins: float,
+    at=None,
+) -> Dict[str, List[Reservation]]:
+    """ONE time-range query for every chip a scheduling round cares about
+    (reference batches the same way: filter_by_uuids_and_time_range,
+    JobSchedulingService.py:76-104). Round-2 issued two queries per chip per
+    queued job per tick — O(jobs × chips) round-trips; this is O(1)."""
+    at = at or utcnow()
+    rows = Reservation.filter_by_uids_and_time_range(
+        uids, start=at, end=at + timedelta(minutes=horizon_mins))
+    by_chip: Dict[str, List[Reservation]] = {uid: [] for uid in uids}
+    for row in rows:
+        if not row.is_cancelled:
+            by_chip[row.resource_id].append(row)
+    return by_chip
+
+
 def chip_free_minutes(
     uid: str,
     horizon_mins: float,
@@ -52,16 +88,8 @@ def chip_free_minutes(
     GreedyScheduler treats the owner's own upcoming reservation as free,
     scheduling.py:48-56)."""
     at = at or utcnow()
-    current = Reservation.current_for_resource(uid, at=at)
-    if current is not None and current.user_id != for_user_id:
-        return 0.0
-    candidates = [
-        r for r in Reservation.upcoming_events_for_resource(uid, at=at)
-        if r.user_id != for_user_id
-    ]
-    if not candidates:
-        return horizon_mins
-    return max(0.0, min(minutes_between(at, r.start) for r in candidates))
+    events = upcoming_events_by_chip({uid}, horizon_mins, at=at)[uid]
+    return _free_minutes_from_events(events, horizon_mins, at, for_user_id)
 
 
 class GreedyScheduler(Scheduler):
@@ -79,6 +107,10 @@ class GreedyScheduler(Scheduler):
         at = at or utcnow()
         taken: set = set()
         chosen: List[Job] = []
+        all_uids = {uid for job in queued_jobs for uid in job.chip_uids}
+        # one reservation query for the whole round, however many jobs/chips
+        events = upcoming_events_by_chip(all_uids, self.HORIZON_MINS, at=at) \
+            if all_uids else {}
         for job in queued_jobs:
             if not self._hosts_eligible(job, eligible_hosts):
                 continue
@@ -93,9 +125,8 @@ class GreedyScheduler(Scheduler):
                 continue
             ok = True
             for uid in uids:
-                free = chip_free_minutes(
-                    uid, self.HORIZON_MINS, at=at, for_user_id=job.user_id
-                )
+                free = _free_minutes_from_events(
+                    events[uid], self.HORIZON_MINS, at, job.user_id)
                 if uid in taken or free < required_free_minutes:
                     ok = False
                     break

@@ -15,7 +15,6 @@ from tensorhive_tpu.core.scheduling import GreedyScheduler
 from tensorhive_tpu.core.services.job_scheduling import JobSchedulingService
 from tensorhive_tpu.core.transport.fake import FakeCluster, FakeOpsFactory
 from tensorhive_tpu.db.models.job import Job, JobStatus
-from tensorhive_tpu.db.models.task import TaskStatus
 from tensorhive_tpu.utils.timeutils import utcnow
 from tests.fixtures import (
     make_job,
@@ -132,6 +131,33 @@ def test_greedy_scheduler_no_double_booking(db, owner):
         job.enqueue()
     chosen = GreedyScheduler().schedule_jobs(Job.get_job_queue(), 30.0)
     assert [j.id for j in chosen] == [job_a.id, job_c.id]
+
+
+def test_scheduler_round_issues_one_reservation_query(db, owner, monkeypatch):
+    """The scheduling round batches all chips into ONE reservation time-range
+    query (reference JobSchedulingService.py:76-104 does the same); round-2
+    issued two queries per chip per queued job per tick."""
+    from tensorhive_tpu.db import engine as engine_mod
+
+    _chip_resources(db, count=4)
+    for chips in ([0], [1, 2], [3]):
+        job = make_job(owner)
+        make_task(job, hostname="vm-0", chips=chips)
+        job.enqueue()
+    queue = Job.get_job_queue()
+
+    counted = []
+    real_query = engine_mod.Engine.query
+
+    def counting_query(self, sql, params=()):
+        if "FROM reservations" in sql:
+            counted.append(sql)
+        return real_query(self, sql, params)
+
+    monkeypatch.setattr(engine_mod.Engine, "query", counting_query)
+    chosen = GreedyScheduler().schedule_jobs(queue, 30.0)
+    assert len(chosen) == 3
+    assert len(counted) == 1, counted
 
 
 def test_queue_runs_inside_owners_own_reservation(service, owner, cluster, db):
