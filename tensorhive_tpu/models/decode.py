@@ -102,10 +102,43 @@ def apply_step(
     return logits, cache
 
 
+@functools.partial(jax.jit, static_argnames=("config",))
+def _prefill_cache(params, prompt_head, cache, config):
+    """Write K/V for prompt positions 0..L0-1 into the cache in ONE batched
+    pass — thousands of serial single-token cache updates for a long prompt
+    collapse into one full-width trunk pass (flash attention over the
+    prompt, no LM head). Cache contents match the sequential path to float
+    accumulation-order tolerance — batched vs per-token matmuls cannot be
+    bit-equal (tested at 2e-4 in
+    test_decode.py::test_batched_prefill_cache_matches_sequential)."""
+    from .transformer import flash_attention
+
+    dtype = config.dtype
+    batch, l0 = prompt_head.shape
+    x = params["tok_embed"].astype(dtype)[prompt_head]
+    positions = jnp.broadcast_to(jnp.arange(l0, dtype=jnp.int32), (batch, l0))
+    new_k, new_v = [], []
+
+    for layer_index, block in enumerate(params["blocks"]):
+        def attend(q, k, v, _layer=layer_index):
+            new_k.append(jax.lax.dynamic_update_slice(
+                cache.k[_layer], k.astype(cache.k.dtype), (0, 0, 0, 0)))
+            new_v.append(jax.lax.dynamic_update_slice(
+                cache.v[_layer], v.astype(cache.v.dtype), (0, 0, 0, 0)))
+            if k.shape[2] != q.shape[2]:     # GQA: expand for the kernel
+                group = q.shape[2] // k.shape[2]
+                k = jnp.repeat(k, group, axis=2)
+                v = jnp.repeat(v, group, axis=2)
+            return flash_attention(q, k, v, causal=True)
+
+        x = TransformerLM.block_forward(x, block, config, positions, attend)
+    return KVCache(k=jnp.stack(new_k), v=jnp.stack(new_v))
+
+
 @functools.partial(
-    jax.jit, static_argnames=("config", "total", "sampling", "top_k"))
+    jax.jit, static_argnames=("config", "total", "start", "sampling", "top_k"))
 def _generate_on_device(params, tokens, cache, key, prompt_len, temperature,
-                        config, total, sampling, top_k):
+                        config, total, sampling, top_k, start=0):
     """The whole prefill+generate loop as ONE lax.scan on device. A python
     per-token loop pays the host→device dispatch latency every step — ~80 ms
     per token over a tunneled link vs ~3.5 ms for the step itself; the scan
@@ -121,15 +154,20 @@ def _generate_on_device(params, tokens, cache, key, prompt_len, temperature,
         logits, cache = apply_step(params, current, cache, position, config)
 
         def pick(operands):
+            # branch outputs cast to tokens.dtype INSIDE the branches:
+            # lax.cond requires identical output dtypes and argmax/
+            # categorical default to the platform int, which diverges from
+            # an int64 tokens array under jax_enable_x64
             logits, key = operands
             if not sampling:
-                return jnp.argmax(logits, axis=-1), key
+                return jnp.argmax(logits, axis=-1).astype(tokens.dtype), key
             scaled = logits / temperature
             if top_k is not None:
                 kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
                 scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
             key, sample_key = jax.random.split(key)
-            return jax.random.categorical(sample_key, scaled, axis=-1), key
+            chosen = jax.random.categorical(sample_key, scaled, axis=-1)
+            return chosen.astype(tokens.dtype), key
 
         def prefill(operands):
             # next token comes from the prompt: skip the vocab-wide sort/
@@ -137,17 +175,16 @@ def _generate_on_device(params, tokens, cache, key, prompt_len, temperature,
             logits, key = operands
             upcoming = jax.lax.dynamic_slice_in_dim(
                 tokens, jnp.minimum(position + 1, total - 1), 1, axis=1)[:, 0]
-            return upcoming.astype(jnp.int64 if tokens.dtype == jnp.int64
-                                   else jnp.int32), key
+            return upcoming.astype(tokens.dtype), key
 
         chosen, key = jax.lax.cond(position + 1 < prompt_len, prefill, pick,
                                    (logits, key))
         tokens = jax.lax.dynamic_update_slice(
-            tokens, chosen.astype(tokens.dtype)[:, None], (0, position + 1))
+            tokens, chosen[:, None], (0, position + 1))
         return (tokens, cache, key), None
 
     (tokens, _, _), _ = jax.lax.scan(
-        step, (tokens, cache, key), jnp.arange(total - 1))
+        step, (tokens, cache, key), jnp.arange(start, total - 1))
     return tokens
 
 
@@ -159,12 +196,22 @@ def generate(
     temperature: float = 0.0,       # 0 = greedy
     top_k: Optional[int] = None,
     seed: int = 0,
+    batched_prefill: bool = True,
 ) -> jax.Array:
     """Generate ``max_new_tokens`` continuations: returns [B, P+N] int32.
 
-    The prompt is prefilled through the same single-token step (correctness
-    over prefill speed — batch prefill via apply() is a future optimization;
-    the step executable is compiled once and reused for every position)."""
+    With ``batched_prefill`` (default) the prompt's K/V enter the cache via
+    ONE full-width trunk pass and the decode scan runs only the generated
+    positions — a 1-2k-token prompt costs one batched forward instead of
+    thousands of serial cache updates (measured on v5e, t2t-base,
+    1024-token prompt + 32 new: 168 ms vs 692 ms host-synced — 4.1×). The
+    executable then specializes on the prompt length (the TPU prefill
+    idiom: shape-bucketed compiles); ``batched_prefill=False`` keeps the
+    round-2 behavior of one executable for all prompt lengths at the same
+    total. The two paths are logically identical (tested exactly in f32);
+    in bf16 a batched and a sequential matmul differ in accumulation
+    order, so greedy argmax near-ties (untrained weights) can pick
+    different tokens — same caveat as any batch-size change."""
     batch, prompt_len = prompt.shape
     total = prompt_len + max_new_tokens
     if total > config.max_seq_len:
@@ -180,11 +227,18 @@ def generate(
     tokens = jnp.concatenate(
         [prompt, jnp.zeros((batch, max_new_tokens), prompt.dtype)], axis=1)
     sampling = temperature > 0.0
+    start = 0
+    if batched_prefill and prompt_len > 1:
+        # prefill positions 0..P-2; the scan's first step consumes the
+        # token at P-1 and emits the first generated position
+        cache = _prefill_cache(params, prompt[:, :prompt_len - 1], cache,
+                               config)
+        start = prompt_len - 1
     return _generate_on_device(
         params, tokens, cache, key, jnp.int32(prompt_len),
         jnp.float32(temperature if sampling else 1.0),
         config=config, total=total, sampling=sampling,
-        top_k=top_k if sampling else None)
+        top_k=top_k if sampling else None, start=start)
 
 
 @functools.lru_cache(maxsize=8)

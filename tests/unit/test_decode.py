@@ -15,7 +15,6 @@ from tensorhive_tpu.models.decode import (
 )
 from tensorhive_tpu.models.transformer import (
     PRESETS,
-    TransformerConfig,
     TransformerLM,
 )
 from tensorhive_tpu.train import (
@@ -109,3 +108,61 @@ def test_evaluate_perplexity():
     assert np.isfinite(metrics["loss"])
     np.testing.assert_allclose(metrics["perplexity"], np.exp(metrics["loss"]),
                                rtol=1e-5)
+
+
+def test_batched_prefill_cache_matches_sequential():
+    """_prefill_cache must write the same K/V as chaining apply_step over
+    the same prompt positions (VERDICT r2 item 5). Tolerances as in
+    test_cached_decode_matches_full_forward: a [B,L,D] matmul and L
+    single-token matmuls differ in accumulation order, so exact bit
+    equality is not a property any batched prefill can have."""
+    from tensorhive_tpu.models.decode import _prefill_cache
+
+    params = TransformerLM.init(jax.random.PRNGKey(4), F32_TINY)
+    batch, plen, total = 2, 11, 16
+    prompt = jax.random.randint(jax.random.PRNGKey(5), (batch, plen), 0,
+                                F32_TINY.vocab_size)
+
+    seq_cache = init_cache(F32_TINY, batch, max_len=total)
+    for position in range(plen):
+        _, seq_cache = apply_step(params, prompt[:, position], seq_cache,
+                                  jnp.int32(position), F32_TINY)
+
+    batched = _prefill_cache(params, prompt,
+                             init_cache(F32_TINY, batch, max_len=total),
+                             F32_TINY)
+    np.testing.assert_allclose(np.asarray(batched.k[:, :, :plen]),
+                               np.asarray(seq_cache.k[:, :, :plen]),
+                               atol=2e-4, rtol=2e-4)
+    np.testing.assert_allclose(np.asarray(batched.v[:, :, :plen]),
+                               np.asarray(seq_cache.v[:, :, :plen]),
+                               atol=2e-4, rtol=2e-4)
+    # positions past the prompt must remain untouched (zeros)
+    np.testing.assert_array_equal(np.asarray(batched.k[:, :, plen:]), 0.0)
+
+
+def test_batched_prefill_generation_matches_sequential():
+    """generate() must produce identical tokens with and without batched
+    prefill (greedy and top-k sampling paths both route through one scan)."""
+    params = TransformerLM.init(jax.random.PRNGKey(6), F32_TINY)
+    prompt = jax.random.randint(jax.random.PRNGKey(7), (2, 9), 0,
+                                F32_TINY.vocab_size)
+    for kwargs in ({"temperature": 0.0},
+                   {"temperature": 0.7, "top_k": 8, "seed": 11}):
+        fast = generate(params, F32_TINY, prompt, max_new_tokens=6,
+                        batched_prefill=True, **kwargs)
+        slow = generate(params, F32_TINY, prompt, max_new_tokens=6,
+                        batched_prefill=False, **kwargs)
+        np.testing.assert_array_equal(np.asarray(fast), np.asarray(slow))
+
+
+def test_gqa_batched_prefill_matches_sequential():
+    config = dataclasses.replace(F32_TINY, n_kv_heads=2)
+    params = TransformerLM.init(jax.random.PRNGKey(8), config)
+    prompt = jax.random.randint(jax.random.PRNGKey(9), (1, 7), 0,
+                                config.vocab_size)
+    fast = generate(params, config, prompt, max_new_tokens=4,
+                    batched_prefill=True)
+    slow = generate(params, config, prompt, max_new_tokens=4,
+                    batched_prefill=False)
+    np.testing.assert_array_equal(np.asarray(fast), np.asarray(slow))
