@@ -36,6 +36,7 @@ from tensorhive_tpu.models.transformer import PRESETS, TransformerLM
 from tensorhive_tpu.parallel.mesh import best_mesh_shape, make_mesh
 from tensorhive_tpu.train import (
     TrainConfig,
+    abstract_train_state,
     init_train_state,
     make_train_step,
     restore_checkpoint,
@@ -82,13 +83,19 @@ def main() -> None:
                                total_steps=args.steps)
     mesh = make_mesh(**best_mesh_shape(len(jax.devices())))
     key = jax.random.PRNGKey(0)
-    params, opt_state = init_train_state(key, model_config, train_config, mesh)
     start_step = 0
     try:
+        # resume restores into ABSTRACT templates: no throwaway initialized
+        # state alive next to the restored copy (2× peak would OOM large
+        # presets exactly on the preemption-resume path)
+        abstract_params, abstract_opt = abstract_train_state(
+            model_config, train_config, mesh)
         start_step, params, opt_state = restore_checkpoint(
-            checkpoint_dir, params, opt_state)
+            checkpoint_dir, abstract_params, abstract_opt)
         print(f"resumed from step {start_step} ({checkpoint_dir})", flush=True)
     except FileNotFoundError:
+        params, opt_state = init_train_state(key, model_config, train_config,
+                                             mesh)
         print(f"fresh run ({args.preset}: "
               f"{TransformerLM.param_count(params) / 1e6:.1f}M params)", flush=True)
 

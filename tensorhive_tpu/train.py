@@ -191,17 +191,62 @@ def save_checkpoint(path: str, step: int, params: Params, opt_state,
                                                      "opt_state": opt_state}))
 
 
+def abstract_train_state(
+    model_config: TransformerConfig,
+    train_config: TrainConfig,
+    mesh: Optional[Mesh] = None,
+) -> Tuple[Any, Any]:
+    """(params, opt_state) as ShapeDtypeStructs carrying shardings — zero
+    device allocation. Feed these to ``restore_checkpoint`` on the resume
+    path so restore never holds a throwaway initialized copy next to the
+    restored one (at ~2× model+optimizer memory, large presets OOM exactly
+    on the preemption-resume path the checkpoints exist for)."""
+    key = jax.random.PRNGKey(0)      # shapes only — never materialized
+    param_shape = jax.eval_shape(
+        lambda k: TransformerLM.init(k, model_config), key)
+    optimizer = make_optimizer(train_config)
+    opt_shape = jax.eval_shape(optimizer.init, param_shape)
+    if mesh is None:
+        placement = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+        shardings = jax.tree_util.tree_map(lambda _: placement, param_shape)
+        opt_shardings = jax.tree_util.tree_map(lambda _: placement, opt_shape)
+    else:
+        shardings = tree_shardings(mesh, param_shape)
+        opt_shardings = _opt_state_shardings(mesh, opt_shape, shardings)
+
+    def as_abstract(leaf, sharding):
+        return jax.ShapeDtypeStruct(leaf.shape, leaf.dtype, sharding=sharding)
+
+    return (jax.tree_util.tree_map(as_abstract, param_shape, shardings),
+            jax.tree_util.tree_map(as_abstract, opt_shape, opt_shardings))
+
+
+def _abstract_like(tree):
+    """Concrete arrays → ShapeDtypeStructs (keeping shardings); abstract
+    leaves pass through. Restore templates must not pin device buffers."""
+    return jax.tree_util.tree_map(
+        lambda x: x if isinstance(x, jax.ShapeDtypeStruct)
+        else jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                  sharding=getattr(x, "sharding", None)),
+        tree)
+
+
 def restore_checkpoint(path: str, params_like, opt_state_like) -> Tuple[int, Params, Any]:
     """Restore the latest step; shapes AND shardings follow the *_like trees.
 
-    The templates are converted to abstract arrays carrying their shardings
-    so orbax RESHARDS onto the current topology — passing concrete arrays
-    would restore with the sharding recorded at save time, which breaks the
-    elastic-resume path (re-launch on a different slice shape after
-    preemption) the moment the saved mesh's devices no longer exist."""
+    The templates may be concrete arrays or ShapeDtypeStructs (see
+    ``abstract_train_state``); either way they are reduced to abstract
+    arrays carrying their shardings before orbax runs, so orbax RESHARDS
+    onto the current topology — restoring with the sharding recorded at
+    save time would break the elastic-resume path (re-launch on a different
+    slice shape after preemption) the moment the saved mesh's devices no
+    longer exist. Prefer abstract templates on the resume path: a concrete
+    template keeps its device buffers alive while orbax materializes the
+    restored copy (~2× peak memory)."""
     import orbax.checkpoint as ocp
 
-    template = {"params": params_like, "opt_state": opt_state_like}
+    template = {"params": _abstract_like(params_like),
+                "opt_state": _abstract_like(opt_state_like)}
     restore_args = ocp.checkpoint_utils.construct_restore_args(template)
     with ocp.CheckpointManager(path) as manager:
         step = manager.latest_step()

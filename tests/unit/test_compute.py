@@ -544,6 +544,67 @@ def test_checkpoint_restores_across_mesh_topologies(tmp_path):
     assert np.isfinite(float(metrics["loss"]))
 
 
+def test_remat_policies_match_no_remat_gradients():
+    """remat=True with either policy ("block" full-block, "mlp" selective)
+    must produce the same loss AND gradients as remat=False — remat is a
+    memory/computation tradeoff, never a numerics change."""
+    base = dataclasses.replace(PRESETS["tiny"], dtype=jnp.float32,
+                               use_flash=False, remat=False)
+    params = TransformerLM.init(jax.random.PRNGKey(0), base)
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 33), 0,
+                                base.vocab_size)
+    want_loss, want_grad = jax.value_and_grad(TransformerLM.loss)(
+        params, tokens, base)
+    for policy in ("block", "mlp"):
+        config = dataclasses.replace(base, remat=True, remat_policy=policy)
+        loss, grad = jax.value_and_grad(TransformerLM.loss)(
+            params, tokens, config)
+        np.testing.assert_allclose(loss, want_loss, rtol=1e-6,
+                                   err_msg=policy)
+        for a, b in zip(jax.tree_util.tree_leaves(want_grad),
+                        jax.tree_util.tree_leaves(grad)):
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6,
+                                       err_msg=policy)
+
+
+def test_checkpoint_restores_into_abstract_templates(tmp_path):
+    """The resume path restores into abstract_train_state templates —
+    ZERO pre-allocated device state (a concrete template holds a throwaway
+    initialized copy alive during restore: ~2× peak memory, ADVICE r2)."""
+    from tensorhive_tpu.train import (
+        abstract_train_state,
+        restore_checkpoint,
+        save_checkpoint,
+    )
+
+    config = TINY
+    train_config = TrainConfig(batch_size=8, seq_len=16)
+    mesh_a = make_mesh(dp=2, fsdp=4)
+    params, opt_state = init_train_state(jax.random.PRNGKey(0), config,
+                                         train_config, mesh_a)
+    save_checkpoint(str(tmp_path / "ckpt"), 11, params, opt_state)
+
+    mesh_b = make_mesh(fsdp=4, tp=2)
+    abstract_params, abstract_opt = abstract_train_state(
+        config, train_config, mesh_b)
+    assert all(isinstance(leaf, jax.ShapeDtypeStruct)
+               for leaf in jax.tree_util.tree_leaves(abstract_params))
+    step, params_r, opt_r = restore_checkpoint(
+        str(tmp_path / "ckpt"), abstract_params, abstract_opt)
+    assert step == 11
+    np.testing.assert_array_equal(np.asarray(params["tok_embed"]),
+                                  np.asarray(params_r["tok_embed"]))
+    big = params_r["blocks"][0]["w_in"]
+    assert big.sharding == abstract_params["blocks"][0]["w_in"].sharding
+    assert big.sharding.mesh.shape == mesh_b.shape
+    # and the restored state trains under mesh_b
+    step_fn = make_train_step(config, train_config, mesh_b)
+    tokens = synthetic_batch(jax.random.PRNGKey(2), train_config,
+                             config.vocab_size)
+    _, _, metrics = step_fn(params_r, opt_r, tokens)
+    assert np.isfinite(float(metrics["loss"]))
+
+
 def test_grad_accumulation_matches_full_batch():
     """grad_accum_steps=4 over microbatches must produce the same update as
     one full-batch step (mean-of-means equals full mean when microbatches
