@@ -25,17 +25,28 @@ Probe JSON schema (version 1)::
      "cpu":     {"total": <jiffies>, "idle": <jiffies>, "ncpu": 8},
      "mem":     {"total_kb": N, "avail_kb": N},
      "metrics": {"0": {"hbm_used_bytes": N, "hbm_total_bytes": N,
-                       "duty_cycle_pct": F, "age_s": F}, ...}}
+                       "duty_cycle_pct": F, "age_s": F}, ...},
+     "sysfs_metrics": {"0": {"hbm_used_bytes": N, "hbm_total_bytes": N,
+                             "duty_cycle_pct": F}, ...}}
 
 ``chips`` come from accelerator device nodes (``/dev/accel*`` on TPU VMs,
 ``/dev/vfio/N`` on older stacks); holder PIDs from a ``/proc/*/fd`` scan —
 the TPU analog of ``nvidia-smi pmon`` given that a TPU chip is held by one
 process via the libtpu lock (SURVEY.md §7 "process adoption" risk).
-``metrics`` are runtime counters (HBM occupancy, duty cycle) read from
-``~/.tpuhive/metrics/*.json`` drop-files refreshed by the workload-side
-telemetry emitter (tensorhive_tpu/telemetry) — the OS exposes no HBM
-counters, so the runtime publishes them; stale files (>120 s) are marked via
-``age_s`` and ignored by the monitor.
+
+Utilization comes from two sources, strongest first:
+
+* ``sysfs_metrics`` — per-accel kernel/runtime counters under
+  ``/sys/class/accel/accel<N>/device/`` (tpu-info-style), read directly by
+  the probe. These see ANY workload — including intruders and jobs that
+  never import this framework — matching the reference's ability to read
+  any process's utilization from the driver (GPUMonitor.py:20-48). Hosts
+  whose platform does not export the counters simply omit the key.
+* ``metrics`` — runtime counters (HBM occupancy, duty cycle) read from
+  ``~/.tpuhive/metrics/*.json`` drop-files refreshed by the workload-side
+  telemetry emitter (tensorhive_tpu/telemetry); the fallback when the OS
+  exposes nothing. Stale files (>120 s) are marked via ``age_s`` and
+  ignored by the monitor.
 """
 from __future__ import annotations
 
@@ -62,7 +73,7 @@ METRICS_MAX_AGE_S = 120.0
 PYTHON_PROBE_SOURCE = r"""
 import glob, json, os, pwd, time
 out = {"v": 1, "chips": [], "procs": {}, "cpu": {}, "mem": {}, "metrics": {},
-       "restricted": 0}
+       "sysfs_metrics": {}, "restricted": 0}
 devs = sorted(glob.glob("/dev/accel[0-9]*")) + sorted(glob.glob("/dev/vfio/[0-9]*"))
 dev_index = {os.path.realpath(d): i for i, d in enumerate(devs)}
 holders = {}
@@ -140,6 +151,23 @@ for name in names:
             merged = dict(metrics)
             merged["age_s"] = round(age, 1)
             out["metrics"][str(chip_index)] = merged
+sysdir = os.environ.get("TPUHIVE_SYSFS_DIR") or "/sys/class/accel"
+try:
+    accels = sorted(os.listdir(sysdir))
+except OSError:
+    accels = []
+for name in accels:
+    if not (name.startswith("accel") and name[5:].isdigit()):
+        continue
+    counters = {}
+    for field in ("duty_cycle_pct", "hbm_used_bytes", "hbm_total_bytes"):
+        try:
+            with open(os.path.join(sysdir, name, "device", field)) as fh:
+                counters[field] = float(fh.read().split()[0])
+        except (OSError, ValueError, IndexError):
+            continue
+    if counters:
+        out["sysfs_metrics"][name[5:]] = counters
 print(json.dumps(out, separators=(",", ":")))
 """.strip()
 
@@ -178,6 +206,10 @@ class ChipSample:
     hbm_total_bytes: Optional[int] = None
     duty_cycle_pct: Optional[float] = None
     metrics_age_s: Optional[float] = None
+    #: where the utilization numbers came from: "sysfs" (kernel/runtime
+    #: counters — sees ANY workload, cooperating or not), "dropfile"
+    #: (self-reported telemetry), or None (no utilization available)
+    metrics_source: Optional[str] = None
 
 
 @dataclass
@@ -218,9 +250,16 @@ def parse_probe_output(text: str) -> ProbeSample:
 def _build_sample(doc: Dict[str, Any]) -> ProbeSample:
     sample = ProbeSample()
     metrics = doc.get("metrics") or {}
+    sysfs = doc.get("sysfs_metrics") or {}
     for raw in doc.get("chips") or []:
         chip = ChipSample(index=int(raw["index"]), dev=str(raw.get("dev", "")),
                           pids=[int(p) for p in raw.get("pids", [])])
+        # utilization merges per FIELD, sysfs over drop-files: kernel
+        # counters cover workloads that never import the telemetry emitter
+        # (intruders, external jobs — reference parity: GPUMonitor reads
+        # ANY process via the driver), but a platform exporting only
+        # duty_cycle must not null out HBM occupancy a fresh drop-file
+        # still carries.
         chip_metrics = metrics.get(str(chip.index))
         if isinstance(chip_metrics, dict):
             age = chip_metrics.get("age_s")
@@ -229,6 +268,17 @@ def _build_sample(doc: Dict[str, Any]) -> ProbeSample:
                 chip.hbm_used_bytes = _opt_int(chip_metrics.get("hbm_used_bytes"))
                 chip.hbm_total_bytes = _opt_int(chip_metrics.get("hbm_total_bytes"))
                 chip.duty_cycle_pct = _opt_float(chip_metrics.get("duty_cycle_pct"))
+                if chip_metrics:
+                    chip.metrics_source = "dropfile"
+        chip_sysfs = sysfs.get(str(chip.index))
+        if isinstance(chip_sysfs, dict) and chip_sysfs:
+            for field, convert in (("hbm_used_bytes", _opt_int),
+                                   ("hbm_total_bytes", _opt_int),
+                                   ("duty_cycle_pct", _opt_float)):
+                value = convert(chip_sysfs.get(field))
+                if value is not None:
+                    setattr(chip, field, value)
+            chip.metrics_source = "sysfs"
         sample.chips.append(chip)
 
     for pid, info in (doc.get("procs") or {}).items():

@@ -387,11 +387,72 @@ std::map<std::string, std::string> runtime_metrics() {
   return merged;
 }
 
+// --- kernel/runtime utilization counters (sysfs) ---------------------------
+// Per-chip utilization for workloads that never import the framework's
+// telemetry emitter (the reference reads ANY process's utilization from the
+// driver via nvidia-smi, GPUMonitor.py:20-48): when the platform's TPU
+// kernel driver / runtime exports per-accel counters under
+// /sys/class/accel/accel<N>/device/ (tpu-info-style runtime metrics), read
+// them directly. These are authoritative over drop-files — a chip-level
+// counter sees intruders and external jobs that self-reporting never will.
+// Graceful absence: hosts without the sysfs tree just omit the key.
+std::string g_sysfs_dir_override;
+
+double read_numeric_file(const std::string& path, bool* ok) {
+  std::ifstream fh(path);
+  double value = 0.0;
+  *ok = static_cast<bool>(fh >> value);
+  return value;
+}
+
+std::map<std::string, std::string> sysfs_metrics() {
+  std::map<std::string, std::string> per_chip;
+  std::string dir;
+  if (!g_sysfs_dir_override.empty()) {
+    dir = g_sysfs_dir_override;
+  } else if (const char* override_dir = std::getenv("TPUHIVE_SYSFS_DIR")) {
+    dir = override_dir;
+  } else {
+    dir = "/sys/class/accel";
+  }
+  static const char* kFields[] = {"duty_cycle_pct", "hbm_used_bytes",
+                                  "hbm_total_bytes"};
+  for (const auto& name : list_dir(dir)) {
+    if (name.rfind("accel", 0) != 0) continue;
+    const std::string index = name.substr(5);
+    if (index.empty() ||
+        !std::all_of(index.begin(), index.end(),
+                     [](unsigned char c) { return std::isdigit(c); }))
+      continue;
+    std::ostringstream obj;
+    bool any = false;
+    for (const char* field : kFields) {
+      bool ok = false;
+      const double value =
+          read_numeric_file(dir + "/" + name + "/device/" + field, &ok);
+      if (!ok) continue;
+      if (any) obj << ',';
+      char buf[64];
+      // byte counters must round-trip exactly (%.6g would truncate 2^34)
+      if (value == static_cast<long long>(value))
+        std::snprintf(buf, sizeof buf, "\"%s\":%lld", field,
+                      static_cast<long long>(value));
+      else
+        std::snprintf(buf, sizeof buf, "\"%s\":%.10g", field, value);
+      obj << buf;
+      any = true;
+    }
+    if (any) per_chip[index] = "{" + obj.str() + "}";
+  }
+  return per_chip;
+}
+
 }  // namespace
 
 int main(int argc, char** argv) {
   for (int i = 1; i < argc - 1; ++i) {
     if (std::string(argv[i]) == "--metrics-dir") g_metrics_dir_override = argv[i + 1];
+    if (std::string(argv[i]) == "--sysfs-dir") g_sysfs_dir_override = argv[i + 1];
   }
   const auto devs = accelerator_devices();
   int restricted = 0;
@@ -442,6 +503,13 @@ int main(int argc, char** argv) {
   out << ",\"metrics\":{";
   first = true;
   for (const auto& [key, value] : runtime_metrics()) {
+    if (!first) out << ',';
+    first = false;
+    out << "\"" << json_escape(key) << "\":" << value;
+  }
+  out << "},\"sysfs_metrics\":{";
+  first = true;
+  for (const auto& [key, value] : sysfs_metrics()) {
     if (!first) out << ',';
     first = false;
     out << "\"" << json_escape(key) << "\":" << value;

@@ -98,6 +98,41 @@ def test_parse_probe_ignores_stale_runtime_metrics():
     assert sample.chips[0].metrics_age_s == 999.0
 
 
+def test_parse_probe_prefers_sysfs_over_dropfiles():
+    """Kernel counters beat self-reported drop-files: a NON-COOPERATING
+    workload (holder PID, no telemetry emitter) still gets utilization —
+    the reference's any-process driver read (GPUMonitor.py:20-48)."""
+    text = (
+        '{"v":1,"chips":[{"index":0,"dev":"d","pids":[9]},'
+        '{"index":1,"dev":"e","pids":[]},{"index":2,"dev":"f","pids":[]}],'
+        '"procs":{},"cpu":{},"mem":{},'
+        '"metrics":{"0":{"hbm_used_bytes":5,"duty_cycle_pct":50.0,"age_s":1.0},'
+        '"1":{"hbm_used_bytes":7,"age_s":1.0},'
+        '"2":{"hbm_used_bytes":11,"hbm_total_bytes":100,"age_s":1.0}},'
+        '"sysfs_metrics":{"0":{"hbm_used_bytes":999,"hbm_total_bytes":1000,'
+        '"duty_cycle_pct":88.0},"2":{"duty_cycle_pct":60.0}}}'
+    )
+    sample = parse_probe_output(text)
+    chip0, chip1, chip2 = sample.chips
+    assert chip0.metrics_source == "sysfs"
+    assert chip0.hbm_used_bytes == 999 and chip0.duty_cycle_pct == 88.0
+    # chip 1 has no sysfs counters → drop-file values still apply
+    assert chip1.metrics_source == "dropfile"
+    assert chip1.hbm_used_bytes == 7
+    # chip 2: PARTIAL sysfs (duty only) must not null the drop-file's HBM
+    # numbers — merge is per field, sysfs winning where present
+    assert chip2.metrics_source == "sysfs"
+    assert chip2.duty_cycle_pct == 60.0
+    assert chip2.hbm_used_bytes == 11 and chip2.hbm_total_bytes == 100
+
+
+def test_parse_probe_without_any_metrics_source():
+    text = ('{"v":1,"chips":[{"index":0,"dev":"d","pids":[3]}],"procs":{},'
+            '"cpu":{},"mem":{},"metrics":{}}')
+    chip = parse_probe_output(text).chips[0]
+    assert chip.metrics_source is None and chip.duty_cycle_pct is None
+
+
 # -- TpuMonitor over the fake cluster ----------------------------------------
 
 def test_tpu_monitor_populates_infrastructure(cluster, transports):
