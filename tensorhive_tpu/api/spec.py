@@ -114,11 +114,170 @@ def spec_rules(url_prefix: str, endpoints: Dict[str, "Endpoint"]) -> List[Rule]:
         html = _UI_TEMPLATE.format(version=doc["info"]["version"], rows="\n".join(rows))
         return Response(html, content_type="text/html")
 
+    def serve_docs(request: Request) -> Response:
+        # interactive console (reference serves Swagger UI at /{prefix}/ui/,
+        # APIServer.py:31). Self-contained single page — no vendored bundle,
+        # same dependency-free stance as the SPA: operations render from the
+        # live /openapi.json, each with an editable try-it form.
+        return Response(_DOCS_PAGE, content_type="text/html")
+
     return [
         Rule(f"{prefix}/openapi.json", methods=["GET"], endpoint=serve_spec),
         Rule(f"{prefix}/ui/", methods=["GET"], endpoint=serve_ui),
+        Rule(f"{prefix}/docs", methods=["GET"], endpoint=serve_docs),
     ]
 
+
+_DOCS_PAGE = r"""<!doctype html>
+<html><head><meta charset="utf-8"><title>tpuhive API console</title>
+<style>
+ body { font-family: system-ui, sans-serif; margin: 1.5rem auto; max-width: 62rem;
+        color: #1c2733; }
+ h1 { font-size: 1.4rem; }
+ .op { border: 1px solid #d5dde5; border-radius: 6px; margin: .5rem 0; }
+ .op > summary { padding: .45rem .7rem; cursor: pointer; display: flex;
+                 gap: .7rem; align-items: baseline; }
+ .op[open] > summary { border-bottom: 1px solid #e3e9ef; }
+ .method { font-weight: 700; font-family: monospace; min-width: 4.2rem; }
+ .m-get { color: #1469b3; } .m-post { color: #11805b; }
+ .m-put { color: #9636c2; } .m-delete { color: #c22929; }
+ .path { font-family: monospace; }
+ .summary { color: #5a6b7b; margin-left: auto; font-size: .85rem; }
+ .body-panel { padding: .6rem .8rem; }
+ label { display: block; font-size: .8rem; margin-top: .45rem; color: #44525f; }
+ input, textarea { width: 100%; box-sizing: border-box; font-family: monospace;
+   font-size: .85rem; padding: .3rem; border: 1px solid #c3ccd4; border-radius: 4px; }
+ textarea { min-height: 6rem; }
+ button { margin-top: .6rem; padding: .35rem .9rem; border: 0; background: #1469b3;
+          color: #fff; border-radius: 4px; cursor: pointer; }
+ pre.result { background: #10151a; color: #cfe3f5; padding: .6rem; border-radius: 4px;
+              overflow: auto; max-height: 22rem; white-space: pre-wrap; }
+ .status-ok { color: #4fd98f; } .status-err { color: #ff8d8d; }
+ #token { font-family: monospace; }
+ .topbar { display: flex; gap: 1rem; align-items: end; }
+ .topbar > div { flex: 1; }
+ .lock { font-size: .8rem; }
+</style></head>
+<body>
+<h1>tpuhive API console</h1>
+<div class="topbar">
+  <div><label>Bearer token (from <code>POST /user/login</code>)
+    <input id="token" placeholder="paste access token — auto-filled after login here"></label></div>
+  <div style="flex:0"><span id="opcount"></span></div>
+</div>
+<div id="ops">loading spec…</div>
+<script>
+"use strict";
+function el(tag, attrs, children) {
+  const node = document.createElement(tag);
+  for (const key in (attrs || {})) {
+    if (key === "text") node.textContent = attrs[key];
+    else if (key === "html") node.innerHTML = attrs[key];
+    else node.setAttribute(key, attrs[key]);
+  }
+  (children || []).forEach(function (c) { node.appendChild(c); });
+  return node;
+}
+function sampleFromSchema(schema, spec) {
+  if (!schema) return null;
+  if (schema.$ref) {
+    const name = schema.$ref.split("/").pop();
+    return sampleFromSchema(((spec.components || {}).schemas || {})[name], spec);
+  }
+  if (schema.example !== undefined) return schema.example;
+  if (schema.type === "object" || schema.properties) {
+    const out = {};
+    const props = schema.properties || {};
+    for (const key in props) out[key] = sampleFromSchema(props[key], spec);
+    return out;
+  }
+  if (schema.type === "array") return [sampleFromSchema(schema.items, spec)];
+  if (schema.type === "integer" || schema.type === "number") return 0;
+  if (schema.type === "boolean") return false;
+  return "";
+}
+function buildOp(path, method, op, spec) {
+  const params = (op.parameters || []).filter(function (p) { return p.in === "path" || p.in === "query"; });
+  const reqSchema = (((op.requestBody || {}).content || {})["application/json"] || {}).schema;
+  const panel = el("div", { "class": "body-panel" });
+  const inputs = {};
+  params.forEach(function (p) {
+    const input = el("input", { placeholder: p.schema && p.schema.type || "string" });
+    inputs[p.name] = { input: input, where: p.in };
+    panel.appendChild(el("label", { text: p.name + " (" + p.in + (p.required ? ", required" : "") + ")" }, [input]));
+  });
+  let bodyArea = null;
+  if (reqSchema) {
+    bodyArea = el("textarea", {});
+    bodyArea.value = JSON.stringify(sampleFromSchema(reqSchema, spec), null, 1);
+    panel.appendChild(el("label", { text: "request body (JSON)" }, [bodyArea]));
+  }
+  const result = el("pre", { "class": "result", text: "" });
+  result.style.display = "none";
+  const run = el("button", { text: "Send " + method.toUpperCase() });
+  run.addEventListener("click", function () {
+    let target = path;
+    const query = [];
+    for (const name in inputs) {
+      const value = inputs[name].input.value;
+      if (inputs[name].where === "path") target = target.replace("{" + name + "}", encodeURIComponent(value));
+      else if (value) query.push(encodeURIComponent(name) + "=" + encodeURIComponent(value));
+    }
+    if (query.length) target += "?" + query.join("&");
+    const headers = { "Content-Type": "application/json" };
+    const token = document.getElementById("token").value.trim();
+    if (token) headers["Authorization"] = "Bearer " + token;
+    const options = { method: method.toUpperCase(), headers: headers };
+    if (bodyArea && options.method !== "GET") options.body = bodyArea.value;
+    result.style.display = "block";
+    result.textContent = "…";
+    fetch(target, options).then(function (resp) {
+      return resp.text().then(function (text) {
+        let shown = text;
+        try { shown = JSON.stringify(JSON.parse(text), null, 1); } catch (err) { /* not JSON */ }
+        result.innerHTML = "";
+        const cls = resp.ok ? "status-ok" : "status-err";
+        result.appendChild(el("span", { "class": cls, text: "HTTP " + resp.status + "\n" }));
+        result.appendChild(document.createTextNode(shown));
+        if (resp.ok && path.endsWith("/login")) {
+          try {
+            const doc = JSON.parse(text);
+            if (doc.access_token) document.getElementById("token").value = doc.access_token;
+          } catch (err) { /* ignore */ }
+        }
+      });
+    }).catch(function (err) { result.textContent = String(err); });
+  });
+  panel.appendChild(run);
+  panel.appendChild(result);
+  return el("details", { "class": "op" }, [
+    el("summary", {}, [
+      el("span", { "class": "method m-" + method, text: method.toUpperCase() }),
+      el("span", { "class": "path", text: path }),
+      el("span", { "class": "lock", text: op.security ? "🔒" : "" }),
+      el("span", { "class": "summary", text: op.summary || "" }),
+    ]),
+    panel,
+  ]);
+}
+fetch("openapi.json").then(function (r) { return r.json(); }).then(function (spec) {
+  const host = document.getElementById("ops");
+  host.textContent = "";
+  let count = 0;
+  Object.keys(spec.paths).sort().forEach(function (path) {
+    const item = spec.paths[path];
+    Object.keys(item).forEach(function (method) {
+      host.appendChild(buildOp(path, method, item[method], spec));
+      count += 1;
+    });
+  });
+  document.getElementById("opcount").textContent = count + " operations";
+}).catch(function (err) {
+  document.getElementById("ops").textContent = "failed to load openapi.json: " + err;
+});
+</script>
+</body></html>
+"""
 
 _UI_TEMPLATE = """<!doctype html>
 <html><head><meta charset="utf-8"><title>tpuhive API</title>

@@ -292,8 +292,6 @@ def test_scheduled_restriction_gates_reservations(api, user, user_headers, db):
     }, headers=user_headers)
     assert ok.status_code == 201, ok.get_data(as_text=True)
     # narrow schedule (one minute a week) → a normal reservation is denied
-    from tensorhive_tpu.db.models.schedule import RestrictionSchedule
-
     schedule.hour_start, schedule.hour_end = "03:00", "03:30"
     schedule.schedule_days = "1"
     schedule.save()
@@ -410,6 +408,20 @@ def test_openapi_document(api):
     assert "403" in doc["paths"]["/users"]["post"]["responses"]
     ui = api.get("/api/ui/")
     assert ui.status_code == 200 and b"tpuhive API" in ui.data
+
+
+def test_interactive_docs_console(api):
+    """The /docs interactive console (reference: Swagger UI at /{prefix}/ui/,
+    APIServer.py:31): self-contained page that renders the live spec with
+    try-it forms — fetch of openapi.json, auth header wiring, and the
+    login token auto-fill must all be present in the shipped page."""
+    response = api.get("/api/docs")
+    assert response.status_code == 200
+    page = response.data.decode()
+    assert "openapi.json" in page            # renders the live spec
+    assert "Authorization" in page           # sends bearer tokens
+    assert "access_token" in page            # auto-fills token on login
+    assert "requestBody" in page or "request body" in page
 
 
 def test_malformed_json_body_is_422(api, admin_headers):
