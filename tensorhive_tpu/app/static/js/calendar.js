@@ -12,6 +12,14 @@ let calResources = [];                        // cached /resources
 let calSelected = null;                       // Set of selected uids
 let calEvents = [];                           // cached reservations for week
 let calDrag = null;                           // {dayIdx, fromSlot, toSlot}
+let calView = localStorage.getItem("tpuhive-cal-view") || "week";
+if (calView === "month") {
+  // a persisted month view must anchor to the 1st of the CURRENT month —
+  // startOfWeek(today) lands in the previous month during the first
+  // partial week, skewing the header and the 42-day matrix
+  const now = new Date();
+  calStart = new Date(now.getFullYear(), now.getMonth(), 1);
+}
 
 function startOfWeek(d) {
   d = new Date(d); d.setHours(0, 0, 0, 0);
@@ -39,10 +47,11 @@ function renderCalendar(main) {
           <span id="respick-count"></span> ▾</button>
         <div class="panel" id="respick-panel" style="display:none"></div>
       </div>
-      <button class="ghost" onclick="calShift(-7)">‹ prev</button>
+      <button class="ghost" onclick="calShift(-1)">‹ prev</button>
       <b id="cal-range"></b>
-      <button class="ghost" onclick="calShift(7)">next ›</button>
+      <button class="ghost" onclick="calShift(1)">next ›</button>
       <button class="ghost" onclick="calToday()">today</button>
+      <button class="ghost" id="cal-view-btn" onclick="calToggleView()"></button>
       <span style="flex:1"></span>
       <span class="muted">drag on the grid to reserve</span>
       <button class="primary" onclick="openReservationDialog()">New reservation</button>
@@ -84,8 +93,33 @@ async function drawUsage() {
     ${finished.length > 12 ? `<p class="muted">…and ${finished.length - 12} more</p>` : ""}
   </div>`;
 }
-function calShift(days) { calStart.setDate(calStart.getDate() + days); drawCalendar(); }
-function calToday() { calStart = startOfWeek(new Date()); drawCalendar(); }
+function calShift(direction) {
+  if (calView === "month") calStart.setMonth(calStart.getMonth() + direction);
+  else calStart.setDate(calStart.getDate() + direction * 7);
+  drawCalendar();
+}
+function calToday() {
+  calStart = calView === "month"
+    ? new Date(new Date().getFullYear(), new Date().getMonth(), 1)
+    : startOfWeek(new Date());
+  drawCalendar();
+}
+/* month view (reference FullCalendar month mode — round-2 shipped only the
+   week grid): compact month matrix, a day click drills into its week */
+function calToggleView() {
+  calView = calView === "month" ? "week" : "month";
+  localStorage.setItem("tpuhive-cal-view", calView);
+  calStart = calView === "month"
+    ? new Date(calStart.getFullYear(), calStart.getMonth(), 1)
+    : startOfWeek(calStart);
+  drawCalendar();
+}
+function calGotoWeek(iso) {
+  calView = "week";
+  localStorage.setItem("tpuhive-cal-view", calView);
+  calStart = startOfWeek(new Date(iso));
+  drawCalendar();
+}
 
 function toggleResPicker() {
   const panel = document.getElementById("respick-panel");
@@ -106,19 +140,25 @@ function calSelectHost(hostname, on) {
 }
 
 async function drawCalendar() {
-  const end = new Date(calStart); end.setDate(end.getDate() + 7);
-  document.getElementById("cal-range").textContent =
-    calStart.toDateString() + " – " + new Date(end - 1).toDateString();
+  const viewButton = document.getElementById("cal-view-btn");
+  if (viewButton) viewButton.textContent = calView === "month" ? "week view" : "month view";
+  const gridStart = calView === "month" ? startOfWeek(calStart) : calStart;
+  const end = new Date(gridStart);
+  end.setDate(end.getDate() + (calView === "month" ? 42 : 7));
+  document.getElementById("cal-range").textContent = calView === "month"
+    ? calStart.toLocaleDateString(undefined, { month: "long", year: "numeric" })
+    : calStart.toDateString() + " – " + new Date(end - 1).toDateString();
   try {
     [calResources, calEvents] = await Promise.all([
       api("/resources"),
-      api(`/reservations?start=${calStart.toISOString()}&end=${end.toISOString()}`)]);
+      api(`/reservations?start=${gridStart.toISOString()}&end=${end.toISOString()}`)]);
   } catch (e) { return toast(e.message, true); }
   if (calSelected === null) {
     calSelected = loadSelected() || new Set(calResources.map(r => r.uid));
   }
   drawResPicker();
   const shown = calEvents.filter(r => calSelected.has(r.resourceId));
+  if (calView === "month") return drawMonth(gridStart, shown);
 
   const days = [...Array(7)].map((_, i) => {
     const d = new Date(calStart); d.setDate(d.getDate() + i); return d; });
@@ -147,6 +187,36 @@ async function drawCalendar() {
   const cal = document.getElementById("cal");
   cal.innerHTML = html;
   attachDragHandlers(cal, days);
+}
+
+function drawMonth(gridStart, shown) {
+  const today = new Date(); today.setHours(0, 0, 0, 0);
+  const month = calStart.getMonth();
+  let html = `<div class="mgrid">` +
+    ["Mon", "Tue", "Wed", "Thu", "Fri", "Sat", "Sun"]
+      .map(n => `<div class="dayhead">${n}</div>`).join("");
+  for (let i = 0; i < 42; i++) {
+    const day = new Date(gridStart); day.setDate(day.getDate() + i);
+    const dayEnd = new Date(day); dayEnd.setDate(dayEnd.getDate() + 1);
+    const events = shown.filter(r =>
+      new Date(r.start) < dayEnd && new Date(r.end) > day && !r.isCancelled);
+    const classes = ["mday"];
+    if (+day === +today) classes.push("today");
+    if (day.getMonth() !== month) classes.push("other-month");
+    html += `<div class="${classes.join(" ")}"
+        onclick="calGotoWeek('${day.toISOString()}')">
+      <div class="mday-num">${day.getDate()}</div>` +
+      events.slice(0, 3).map(r => `<span class="mev"
+        style="background:hsl(${resourceHue(r.resourceId)},65%,${
+          state.user && r.userId === state.user.id ? 70 : 55}%)"
+        title="${esc(r.title)} — ${esc(r.resourceId)}"
+        onclick="openReservationDetails(${r.id});event.stopPropagation()">
+        ${esc(r.title)}</span>`).join("") +
+      (events.length > 3
+        ? `<span class="muted">+${events.length - 3} more</span>` : "") +
+      `</div>`;
+  }
+  document.getElementById("cal").innerHTML = html + `</div>`;
 }
 
 function drawResPicker() {

@@ -28,8 +28,35 @@ function sparkline(values, cls) {
     <polyline points="${pts.join(" ")}"></polyline></svg>`;
 }
 
+/* user-configurable watches (reference WatchBox.vue:192-236: each watch box
+   picks its metric): which panels every chip card shows, persisted */
+function loadWatches() {
+  try {
+    const saved = JSON.parse(localStorage.getItem("tpuhive-watches") || "null");
+    if (saved && typeof saved === "object") {
+      return { hbm: !!saved.hbm, duty: !!saved.duty, procs: !!saved.procs };
+    }
+  } catch (e) {}
+  return { hbm: true, duty: true, procs: true };
+}
+let nodesWatch = loadWatches();
+const WATCH_LABELS = { hbm: "HBM", duty: "duty cycle", procs: "processes" };
+
+function toggleWatch(name, on) {
+  nodesWatch[name] = on;
+  localStorage.setItem("tpuhive-watches", JSON.stringify(nodesWatch));
+}
+
 function renderNodes(main) {
   main.innerHTML = `<div id="svc-health"></div>
+    <div class="card"><div class="row">
+      <h3 style="margin:0">Watches</h3>
+      ${["hbm", "duty", "procs"].map(name => `<label class="inline">
+        <input type="checkbox" ${nodesWatch[name] ? "checked" : ""}
+          onchange="toggleWatch('${name}', this.checked)">
+        ${WATCH_LABELS[name]}
+      </label>`).join("")}
+    </div></div>
     <div id="nodes"></div><dialog id="chip-dialog"></dialog>`;
   const refresh = async () => {
     try {
@@ -127,15 +154,17 @@ function chipCard(uid, chip, host) {
   return `<div class="chip-card" onclick="openChipDialog('${jsArg(uid)}','${jsArg(host)}')"
                title="click for history">
     <b>${esc(chip.name || uid)}</b> <span class="muted">${esc(uid)}</span>
-    <div class="muted">HBM ${chip.hbm_used_mib ?? "?"} / ${chip.hbm_total_mib ?? "?"} MiB</div>
-    <div class="bar ${hbmPct > 85 ? "hot" : ""}"><i style="width:${hbmPct || 0}%"></i></div>
-    ${sparkline(hist.hbm, "hbm")}
-    <div class="muted">duty ${duty != null ? duty + "%" : "–"}</div>
-    <div class="bar"><i style="width:${duty || 0}%"></i></div>
-    ${sparkline(hist.duty, "")}
-    ${procs.map(p => `<div class="muted" title="${esc(p.command)}">
+    ${nodesWatch.hbm ? `
+      <div class="muted">HBM ${chip.hbm_used_mib ?? "?"} / ${chip.hbm_total_mib ?? "?"} MiB</div>
+      <div class="bar ${hbmPct > 85 ? "hot" : ""}"><i style="width:${hbmPct || 0}%"></i></div>
+      ${sparkline(hist.hbm, "hbm")}` : ""}
+    ${nodesWatch.duty ? `
+      <div class="muted">duty ${duty != null ? duty + "%" : "–"}</div>
+      <div class="bar"><i style="width:${duty || 0}%"></i></div>
+      ${sparkline(hist.duty, "")}` : ""}
+    ${nodesWatch.procs ? procs.map(p => `<div class="muted" title="${esc(p.command)}">
         ${p.pid} <b>${esc(p.user)}</b> ${esc((p.command || "").slice(0, 28))}</div>`).join("")
-      || '<div class="ok">idle</div>'}
+      || '<div class="ok">idle</div>' : ""}
   </div>`;
 }
 
