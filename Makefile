@@ -1,0 +1,20 @@
+# Developer entry points. `check` is the static gate (reference CI parity:
+# mypy + flake8 per .circleci/config.yml:33-38): the dependency-free AST
+# lint always runs; mypy/ruff run when installed (absent from this image).
+.PHONY: check lint test bench probe
+
+check: lint
+	@command -v ruff >/dev/null 2>&1 && ruff check . || echo "ruff not installed; skipped (tools/lint.py covered the always-on subset)"
+	@command -v mypy >/dev/null 2>&1 && mypy || echo "mypy not installed; skipped (tools/lint.py covered the always-on subset)"
+
+lint:
+	python tools/lint.py
+
+test:
+	python -m pytest tests/ -q
+
+bench:
+	python bench.py
+
+probe:
+	$(MAKE) -C tensorhive_tpu/native

@@ -19,7 +19,7 @@ import dataclasses
 import json
 import logging
 import threading
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional
 
 from werkzeug.exceptions import HTTPException
 from werkzeug.routing import Map, Rule

@@ -11,7 +11,7 @@ change, existing reservations are (un)cancelled to match
 from __future__ import annotations
 
 from datetime import datetime, time, timedelta
-from typing import List, Optional, Tuple
+from typing import List, Tuple
 
 from ..db.models.reservation import Reservation
 from ..db.models.resource import Resource

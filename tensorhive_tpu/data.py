@@ -25,7 +25,7 @@ from __future__ import annotations
 import dataclasses
 import glob as globlib
 import threading
-from typing import Iterator, List, Optional, Sequence
+from typing import Iterator, List, Optional
 
 import jax
 import numpy as np

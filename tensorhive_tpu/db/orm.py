@@ -11,7 +11,7 @@ ISO-8601 naive-UTC TEXT; bools as INTEGER.
 from __future__ import annotations
 
 from datetime import datetime
-from typing import Any, Callable, Dict, List, Optional, Sequence, Type, TypeVar
+from typing import Any, Dict, List, Optional, Sequence, Type, TypeVar
 
 from ..utils.exceptions import NotFoundError, ValidationError
 from ..utils.timeutils import isoformat, parse_datetime, to_utc_naive

@@ -4,7 +4,6 @@ from __future__ import annotations
 from datetime import timedelta
 
 from tensorhive_tpu.db.models import (
-    Group,
     Job,
     Reservation,
     Resource,

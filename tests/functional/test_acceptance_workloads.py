@@ -12,7 +12,6 @@ configs 2/4/5 had no runnable demonstration).
      queue wait → launch when free → preemption when a reservation nears
   5. multi-slice across 2×v5p-32 via DCN  (examples/multislice)
 """
-from datetime import timedelta
 
 import pytest
 from werkzeug.test import Client
@@ -24,8 +23,6 @@ from tensorhive_tpu.core.nursery import set_ops_factory
 from tensorhive_tpu.core.services.job_scheduling import JobSchedulingService
 from tensorhive_tpu.core.transport.fake import FakeCluster, FakeOpsFactory
 from tensorhive_tpu.db.models.job import Job, JobStatus
-from tensorhive_tpu.db.models.task import Task, TaskStatus
-from tensorhive_tpu.utils.timeutils import utcnow
 from tests.fixtures import (
     make_permissive_restriction,
     make_reservation,

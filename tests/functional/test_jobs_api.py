@@ -8,11 +8,10 @@ import pytest
 from werkzeug.test import Client
 
 from tensorhive_tpu.api.server import ApiApp
-from tensorhive_tpu.controllers import task as task_controller
 from tensorhive_tpu.core.managers.manager import TpuHiveManager, set_manager
 from tensorhive_tpu.core.nursery import set_ops_factory
 from tensorhive_tpu.core.transport.fake import FakeCluster, FakeOpsFactory
-from tensorhive_tpu.db.models.task import Task, TaskStatus
+from tensorhive_tpu.db.models.task import Task
 from tests.fixtures import make_user
 
 

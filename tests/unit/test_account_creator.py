@@ -1,6 +1,5 @@
 """AccountCreator interactive-loop tests (reference AccountCreator.py:25-139
 was untested; here scripted prompt/confirm callables drive the loop)."""
-import pytest
 
 from tensorhive_tpu.core.account_creator import AccountCreator, ensure_default_group_bootstrap
 from tensorhive_tpu.db.models.restriction import Restriction

@@ -4,7 +4,6 @@ programs own it, SURVEY.md §0) — this subsystem is new surface."""
 import numpy as np
 import pytest
 
-import jax
 
 from tensorhive_tpu.data import (
     DataConfig,

@@ -1,0 +1,17 @@
+"""The static gate (tools/lint.py) must stay clean — reference CI parity
+(mypy + flake8 on every push, .circleci/config.yml:33-38 via SURVEY.md §4).
+Running it inside pytest makes the gate part of every `pytest tests/` run,
+exactly as the reference's CI couples lint to its test job."""
+import subprocess
+import sys
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent.parent
+
+
+def test_lint_gate_is_clean():
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "lint.py")],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, f"\n{proc.stdout}{proc.stderr}"

@@ -1,6 +1,6 @@
 """ProtectionService + violation handler tests (reference ships none —
 SURVEY.md §4 lists violation handlers among the untested components)."""
-from unittest.mock import MagicMock, patch
+from unittest.mock import patch
 
 import pytest
 
@@ -9,7 +9,7 @@ from tensorhive_tpu.core.handlers.email import EmailSendingBehaviour
 from tensorhive_tpu.core.handlers.kill import ProcessKillingBehaviour
 from tensorhive_tpu.core.handlers.message import MessageSendingBehaviour
 from tensorhive_tpu.core.managers.infrastructure import InfrastructureManager, chip_uid
-from tensorhive_tpu.core.mailer import Mailer, MessageBodyTemplater
+from tensorhive_tpu.core.mailer import MessageBodyTemplater
 from tensorhive_tpu.core.nursery import set_ops_factory
 from tensorhive_tpu.core.services.protection import ProtectionService, default_handlers
 from tensorhive_tpu.core.transport.fake import FakeCluster, FakeOpsFactory
