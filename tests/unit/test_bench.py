@@ -1,0 +1,125 @@
+"""The bench harness itself must be flake-proof.
+
+BENCH_r03.json recorded rc=1/parsed=null because ONE transient
+``remote_compile`` RPC failure mid-sweep crashed the whole run, and the one
+deep point it did print was poisoned by a single flake-stalled timing window
+(4269 ms recorded for a step the judge reproduced at 274 ms). These tests pin
+the two defenses: per-config fault isolation in bench.py and stall-window
+rejection in train._steady_step_time.
+"""
+import json
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+import bench
+from tensorhive_tpu.train import _steady_step_time
+
+
+# -- timing-window rejection (train.py) --------------------------------------
+
+def test_steady_time_drops_compile_window():
+    step, rejected = _steady_step_time([(5.0, True), (0.1, True), (0.11, True)])
+    assert step == 0.11
+    assert rejected == 0
+
+
+def test_steady_time_rejects_stalled_window():
+    # BENCH_r03 shape: few windows, one inflated ~15x by a runtime stall.
+    # The old median-of-2 picked the stalled window.
+    windows = [(2.0, True), (0.27, True), (4.27, True)]
+    step, rejected = _steady_step_time(windows)
+    assert step == 0.27
+    assert rejected == 1
+
+
+def test_steady_time_keeps_normal_spread():
+    windows = [(1.0, True), (0.25, True), (0.27, True), (0.30, True)]
+    step, rejected = _steady_step_time(windows)
+    assert rejected == 0
+    assert step == 0.27
+
+
+def test_steady_time_falls_back_to_partial_windows():
+    # only the first (compile) window is full: partial windows still yield
+    # a number rather than an IndexError
+    step, _ = _steady_step_time([(5.0, True), (0.4, False)])
+    assert step == 0.4
+
+
+# -- per-config fault isolation (bench.py) -----------------------------------
+
+def _fake_result(preset, batch, seq_len, remat, *_, **__):
+    return {
+        "preset": preset, "batch": batch, "seq_len": seq_len, "remat": remat,
+        "step_time_ms": 100.0, "tokens_per_sec_per_chip": 1000.0 * batch,
+        "steps_per_sec_per_chip": 10.0, "mfu": 0.3, "loss": 10.0,
+        "rejected_windows": 0,
+    }
+
+
+def test_try_config_retries_then_gives_up(monkeypatch):
+    calls = []
+
+    def always_fails(*args, **kwargs):
+        calls.append(args)
+        raise RuntimeError("read body: response body closed")
+
+    monkeypatch.setattr(bench, "_run_config", always_fails)
+    assert bench._try_config("t2t-big", 32, 1024, False, 9) is None
+    assert len(calls) == 3
+
+
+def test_try_config_recovers_from_transient_failure(monkeypatch):
+    attempts = []
+
+    def flaky(*args, **kwargs):
+        attempts.append(args)
+        if len(attempts) == 1:
+            raise RuntimeError("remote_compile: connection reset")
+        return _fake_result(*args, **kwargs)
+
+    monkeypatch.setattr(bench, "_run_config", flaky)
+    result = bench._try_config("t2t-base", 64, 1024, False, 45)
+    assert result is not None and result["batch"] == 64
+    assert len(attempts) == 2
+
+
+def test_main_emits_valid_json_despite_midsweep_failure(monkeypatch, capsys):
+    """A config that fails every retry (the BENCH_r03 scenario: t2t-big's
+    compile RPC dies) must not take down the JSON line — the surviving
+    configs are recorded and the failure is noted."""
+    import jax
+
+    def run_config(preset, batch, seq_len, remat, steps, **kwargs):
+        if preset == "t2t-big" and seq_len == 1024:
+            raise RuntimeError("http://127.0.0.1:8103/remote_compile: "
+                               "read body: response body closed")
+        return _fake_result(preset, batch, seq_len, remat)
+
+    monkeypatch.setattr(bench, "_run_config", run_config)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(bench, "bench_generate", lambda: {"decode_tokens_per_sec": 1.0})
+    monkeypatch.setattr(bench, "bench_telemetry_poll", lambda: 2.5)
+    bench.main()
+    out = capsys.readouterr().out.strip().splitlines()
+    assert len(out) == 1, "driver contract: exactly one stdout line"
+    doc = json.loads(out[0])
+    assert doc["value"] == 64_000.0          # best surviving config (b64)
+    assert doc["t2t_big"] is None            # the failed config is absent,
+    assert doc["long_seq_4096"] is not None  # later configs still ran
+    assert doc["vs_baseline"] > 0
+
+
+def test_main_emits_valid_json_when_everything_burns(monkeypatch, capsys):
+    monkeypatch.setattr(bench, "bench_train",
+                        lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+    monkeypatch.setattr(bench, "bench_generate",
+                        lambda: (_ for _ in ()).throw(RuntimeError("boom")))
+    monkeypatch.setattr(bench, "bench_telemetry_poll", lambda: None)
+    bench.main()
+    doc = json.loads(capsys.readouterr().out.strip())
+    assert doc["metric"] == "t2t_transformer tokens/sec/chip"
+    assert doc["value"] == 0.0
+    assert any("train" in e for e in doc["errors"])
