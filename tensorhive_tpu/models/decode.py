@@ -125,10 +125,8 @@ def _prefill_cache(params, prompt_head, cache, config):
                 cache.k[_layer], k.astype(cache.k.dtype), (0, 0, 0, 0)))
             new_v.append(jax.lax.dynamic_update_slice(
                 cache.v[_layer], v.astype(cache.v.dtype), (0, 0, 0, 0)))
-            if k.shape[2] != q.shape[2]:     # GQA: expand for the kernel
-                group = q.shape[2] // k.shape[2]
-                k = jnp.repeat(k, group, axis=2)
-                v = jnp.repeat(v, group, axis=2)
+            # GQA runs natively in the kernel (KV head h // group via the
+            # BlockSpec index maps) — no expanded K/V copy
             return flash_attention(q, k, v, causal=True)
 
         x = TransformerLM.block_forward(x, block, config, positions, attend)

