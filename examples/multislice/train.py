@@ -38,7 +38,20 @@ def main() -> None:
     args = parser.parse_args()
 
     num_slices = int(os.environ.get("MEGASCALE_NUM_SLICES", "1"))
-    if "MEGASCALE_COORDINATOR_ADDRESS" in os.environ:
+    coordinator = os.environ.get("MEGASCALE_COORDINATOR_ADDRESS")
+    if coordinator and os.environ.get("JAX_PLATFORMS") == "cpu":
+        # CPU dryrun (one "slice" per process over virtual devices): there
+        # is no TPU runtime to autodetect topology from, so parse the
+        # megascale env the template generated (core/templates.py
+        # _multislice) into explicit jax.distributed wiring. The config
+        # API, not just the env var, pins the platform: plugin backends
+        # (axon) override JAX_PLATFORMS
+        jax.config.update("jax_platforms", "cpu")
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_slices,
+            process_id=int(os.environ.get("MEGASCALE_SLICE_ID", "0")))
+    elif coordinator:
         # megascale env is read by the TPU runtime itself; jax.distributed
         # autodetects coordinator/process topology on Cloud TPU
         jax.distributed.initialize()
@@ -51,23 +64,58 @@ def main() -> None:
         print(f"mesh over {n_devices} devices: dp={dp} (DCN axis) "
               f"tp={args.tp}, fsdp=rest (ICI)", flush=True)
 
+    train_config = TrainConfig(batch_size=args.batch_size,
+                               seq_len=args.seq_len,
+                               warmup_steps=min(100, max(1, args.steps // 10)),
+                               total_steps=args.steps)
+    batches = None
+    if jax.process_count() > 1:
+        # multi-controller: every process must feed GLOBAL arrays — a
+        # host-local synthetic batch cannot enter a jit sharded over
+        # non-addressable devices
+        batches = _global_synthetic_batches(
+            mesh, train_config, PRESETS[args.preset].vocab_size)
+
     telemetry = TelemetryEmitter(name="multislice")
     try:
         metrics = train_loop(
             PRESETS[args.preset],
-            TrainConfig(batch_size=args.batch_size, seq_len=args.seq_len,
-                        warmup_steps=min(100, max(1, args.steps // 10)),
-                        total_steps=args.steps),
+            train_config,
             mesh=mesh,
             num_steps=args.steps,
             telemetry=telemetry,
             sync_every=10,      # pipeline step dispatch; sync per telemetry window
+            batches=batches,
         )
+        # every slice reports: cross-slice agreement on the final loss is
+        # the dryrun's proof that one global step ran (not N local ones)
+        print(f"slice {jax.process_index()}: final "
+              f"loss={metrics['loss']:.6f}", flush=True)
         if jax.process_index() == 0:
             print(f"final: loss={metrics['loss']:.4f} "
                   f"steps/s={metrics['steps_per_sec']:.3f}", flush=True)
     finally:
         telemetry.close()
+
+
+def _global_synthetic_batches(mesh, train_config, vocab_size):
+    """Seeded synthetic batches as GLOBAL jax.Arrays: every process computes
+    the same per-step numpy batch and contributes only its addressable
+    shards (make_array_from_callback) — the multi-process analog of
+    train.synthetic_batch."""
+    import numpy as np
+
+    from tensorhive_tpu.parallel.mesh import batch_sharding
+
+    sharding = batch_sharding(mesh)
+    shape = (train_config.batch_size, train_config.seq_len + 1)
+    step = 0
+    while True:
+        batch = np.random.default_rng(step).integers(
+            0, vocab_size, shape, dtype=np.int32)
+        yield jax.make_array_from_callback(
+            shape, sharding, lambda index: batch[index])
+        step += 1
 
 
 if __name__ == "__main__":
