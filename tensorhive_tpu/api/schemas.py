@@ -58,6 +58,10 @@ RESOURCE = component("Resource", obj(
     acceleratorType=s("string", nullable=True, example="v5litepod-8"),
     sliceName=s("string", nullable=True),
     chipIndex=s("integer", nullable=True),
+    topology=s("string", nullable=True, example="2x4",
+               description="chip-grid shape of the chip's slice (schema v3)"),
+    numChips=s("integer", nullable=True,
+               description="total chips in the slice (schema v3)"),
 ))
 
 RESTRICTION = component("Restriction", obj(

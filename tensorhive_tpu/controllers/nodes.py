@@ -28,6 +28,18 @@ def sync_resources_from_infrastructure(snapshot: Optional[Dict] = None) -> None:
         snapshot = get_manager().infrastructure_manager.infrastructure
     for hostname, node in snapshot.items():
         for uid, chip in node.get("TPU", {}).items():
+            from ..db.models.resource import (
+                ACCELERATOR_TOPOLOGIES,
+                topology_chip_count,
+            )
+
+            accel_type = chip.get("accelerator_type", "")
+            topology = (chip.get("topology")
+                        or ACCELERATOR_TOPOLOGIES.get(accel_type, ""))
+            # single-chip floor matches the v3 migration backfill ("never
+            # 0/NULL"): a chip with unknown topology is still one chip
+            num_chips = max(1, topology_chip_count(topology))
+            slice_name = chip.get("slice_name", "")
             existing = Resource.get_by_uid(uid)
             if existing is None:
                 Resource(
@@ -35,8 +47,22 @@ def sync_resources_from_infrastructure(snapshot: Optional[Dict] = None) -> None:
                     name=chip.get("name", uid),
                     hostname=hostname,
                     chip_index=chip.get("index", 0),
-                    accelerator_type=chip.get("accelerator_type", ""),
+                    accelerator_type=accel_type,
+                    slice_name=slice_name,
+                    topology=topology,
+                    num_chips=num_chips,
                 ).save()
+            elif (existing.slice_name, existing.topology,
+                  existing.num_chips, existing.accelerator_type) != (
+                      slice_name, topology, num_chips, accel_type):
+                # refresh slice metadata on known chips: rows registered
+                # before the host inventory carried topology/slice labels
+                # (or before schema v3) would otherwise stay stale forever
+                existing.slice_name = slice_name
+                existing.topology = topology
+                existing.num_chips = num_chips
+                existing.accelerator_type = accel_type
+                existing.save()
 
 
 def get_infrastructure(context: RequestContext) -> Dict:

@@ -58,6 +58,8 @@ class TpuMonitor(Monitor):
     def _chip_subtree(self, hostname: str, sample: ProbeSample) -> Dict[str, Dict]:
         host_cfg = self.config.hosts.get(hostname)
         accel_type = host_cfg.accelerator_type if host_cfg else ""
+        slice_name = host_cfg.slice_name if host_cfg else ""
+        topology = (host_cfg.topology if host_cfg else "") or ""
         chips: Dict[str, Dict] = {}
         for chip in sample.chips:
             uid = chip_uid(hostname, chip.index)
@@ -77,6 +79,8 @@ class TpuMonitor(Monitor):
                 "hostname": hostname,
                 "name": f"{accel_type or 'TPU'} chip {chip.index}",
                 "accelerator_type": accel_type,
+                "slice_name": slice_name,
+                "topology": topology,
                 "dev": chip.dev,
                 "hbm_used_mib": _to_mib(hbm_used),
                 "hbm_total_mib": _to_mib(hbm_total),

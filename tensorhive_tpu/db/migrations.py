@@ -32,13 +32,63 @@ def _migration_2_user_last_login(engine: Engine) -> None:
     _add_column(engine, "users", "last_login_at", "TEXT")
 
 
+#: frozen copy of the accelerator→topology map as of schema v3. Migrations
+#: must not import live code (db/models/resource.py's map will keep
+#: evolving; replaying this migration years later must produce the v3
+#: backfill, not whatever the map says then) — the Alembic lesson the
+#: reference's 18 revisions encode by inlining everything
+#: (/root/reference/tensorhive/migrations/versions/).
+_V3_TOPOLOGIES = {
+    "v5litepod-1": "1x1", "v5litepod-4": "2x2", "v5litepod-8": "2x4",
+    "v5litepod-16": "4x4", "v5litepod-32": "4x8", "v5litepod-64": "8x8",
+    "v5litepod-128": "8x16", "v5litepod-256": "16x16",
+    "v4-8": "2x2x1", "v5p-8": "2x2x1", "v5p-16": "2x2x2",
+    "v5p-32": "2x2x4", "v5p-64": "2x4x4", "v5p-128": "4x4x4",
+}
+
+
+def _migration_3_slice_topology(engine: Engine) -> None:
+    """v2 → v3: ``resources.topology`` + ``resources.num_chips``, backfilled.
+
+    Schema change plus DATA migration: topology comes from the accelerator
+    type (frozen map above); num_chips from the topology where known, else
+    from counting the slice's registered chips — rows that predate slice
+    grouping degrade to a per-row count of 1, never NULL."""
+    if not _column_names(engine, "resources"):
+        # a DB stamped v1/v2 before ever registering a chip: the table does
+        # not exist; ensure_schema's trailing create_all builds it with the
+        # v3 columns already in place
+        return
+    _add_column(engine, "resources", "topology", "TEXT DEFAULT ''")
+    _add_column(engine, "resources", "num_chips", "INTEGER DEFAULT 0")
+    rows = engine.execute(
+        "SELECT id, accelerator_type, slice_name FROM resources").fetchall()
+    slice_counts: dict = {}
+    for _, _, slice_name in rows:
+        if slice_name:
+            slice_counts[slice_name] = slice_counts.get(slice_name, 0) + 1
+    for row_id, accel_type, slice_name in rows:
+        topology = _V3_TOPOLOGIES.get(accel_type or "", "")
+        num_chips = 1
+        if topology:
+            num_chips = 1
+            for dim in topology.split("x"):
+                num_chips *= int(dim)
+        elif slice_name:
+            num_chips = slice_counts[slice_name]
+        engine.execute(
+            "UPDATE resources SET topology = ?, num_chips = ? WHERE id = ?",
+            (topology, num_chips, row_id))
+
+
 # append (version, fn) pairs as the schema evolves; fn(engine) must be
 # idempotent enough to re-run after a crash mid-upgrade.
 MIGRATIONS: List[Tuple[int, Callable[[Engine], None]]] = [
     (2, _migration_2_user_last_login),
+    (3, _migration_3_slice_topology),
 ]
 
-SCHEMA_VERSION = 2
+SCHEMA_VERSION = 3
 
 
 def ensure_schema(engine: Engine) -> None:

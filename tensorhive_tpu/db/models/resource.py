@@ -15,9 +15,46 @@ from ...utils.exceptions import ValidationError
 from ..orm import Column, Model
 
 
+#: physical chip-grid shapes of the Cloud TPU accelerator types the host
+#: inventory knows (config.py HostConfig.topology documents the format);
+#: used to default Resource.topology/num_chips when config omits them
+ACCELERATOR_TOPOLOGIES = {
+    "v5litepod-1": "1x1",
+    "v5litepod-4": "2x2",
+    "v5litepod-8": "2x4",
+    "v5litepod-16": "4x4",
+    "v5litepod-32": "4x8",
+    "v5litepod-64": "8x8",
+    "v5litepod-128": "8x16",
+    "v5litepod-256": "16x16",
+    "v4-8": "2x2x1",
+    "v5p-8": "2x2x1",
+    "v5p-16": "2x2x2",
+    "v5p-32": "2x2x4",
+    "v5p-64": "2x4x4",
+    "v5p-128": "4x4x4",
+}
+
+
+def topology_chip_count(topology: str) -> int:
+    """Chips in a topology string ("4x4" → 16, "2x2x4" → 16); 0 if unknown
+    or malformed."""
+    try:
+        dims = [int(part) for part in topology.split("x")]
+    except ValueError:
+        return 0
+    if not dims or any(dim < 1 for dim in dims):
+        return 0
+    count = 1
+    for dim in dims:
+        count *= dim
+    return count
+
+
 class Resource(Model):
     __tablename__ = "resources"
-    __public__ = ("id", "uid", "name", "hostname", "accelerator_type", "slice_name", "chip_index")
+    __public__ = ("id", "uid", "name", "hostname", "accelerator_type",
+                  "slice_name", "chip_index", "topology", "num_chips")
 
     id = Column(int, primary_key=True)
     uid = Column(str, nullable=False, unique=True)
@@ -26,6 +63,12 @@ class Resource(Model):
     accelerator_type = Column(str, default="")   # "v5litepod-16", "" for CPU hosts
     slice_name = Column(str, default="", index=True)
     chip_index = Column(int, default=0)
+    #: chip-grid shape of the slice this chip belongs to ("4x4"; schema v3 —
+    #: the scheduler's whole-slice reasoning needs the grid, not just a count)
+    topology = Column(str, default="")
+    #: total chips in the slice (denormalized from topology for SQL-side
+    #: eligibility filters; schema v3 backfills it)
+    num_chips = Column(int, default=0)
 
     MAX_UID_LEN = 64
 

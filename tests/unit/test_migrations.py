@@ -6,12 +6,41 @@ from tensorhive_tpu.db.migrations import MIGRATIONS, SCHEMA_VERSION, ensure_sche
 from tensorhive_tpu.db.models.user import User
 
 
-# the users-table DDL as it shipped at schema version 1 (before
-# last_login_at) — a frozen fixture, NOT derived from the live model
+# the schema as it shipped at version 1 (before last_login_at and the
+# slice-topology columns) — frozen fixtures, NOT derived from the live models
 V1_USERS_DDL = (
     "CREATE TABLE users (id INTEGER PRIMARY KEY AUTOINCREMENT, "
     "username TEXT NOT NULL UNIQUE, email TEXT NOT NULL, "
     "_hashed_password TEXT NOT NULL, created_at TEXT)"
+)
+
+V1_RESOURCES_DDL = (
+    "CREATE TABLE resources (id INTEGER PRIMARY KEY AUTOINCREMENT, "
+    "uid TEXT NOT NULL UNIQUE, name TEXT, hostname TEXT, "
+    "accelerator_type TEXT DEFAULT '', slice_name TEXT DEFAULT '', "
+    "chip_index INTEGER DEFAULT 0)"
+)
+
+V1_RESERVATIONS_DDL = (
+    "CREATE TABLE reservations (id INTEGER PRIMARY KEY AUTOINCREMENT, "
+    "title TEXT NOT NULL, description TEXT DEFAULT '', "
+    "resource_id TEXT NOT NULL, user_id INTEGER NOT NULL, "
+    "start TEXT NOT NULL, end TEXT NOT NULL, is_cancelled INTEGER DEFAULT 0, "
+    "created_at TEXT, duty_cycle_avg REAL, hbm_util_avg REAL, "
+    "FOREIGN KEY(user_id) REFERENCES users(id))"
+)
+
+V1_RESTRICTIONS_DDL = (
+    "CREATE TABLE restrictions (id INTEGER PRIMARY KEY AUTOINCREMENT, "
+    "name TEXT DEFAULT '', starts_at TEXT NOT NULL, ends_at TEXT, "
+    "is_global INTEGER DEFAULT 0, created_at TEXT)"
+)
+
+V1_RESTRICTION2RESOURCE_DDL = (
+    "CREATE TABLE restriction2resource (id INTEGER PRIMARY KEY AUTOINCREMENT, "
+    "restriction_id INTEGER NOT NULL, resource_id INTEGER NOT NULL, "
+    "FOREIGN KEY(restriction_id) REFERENCES restrictions(id), "
+    "FOREIGN KEY(resource_id) REFERENCES resources(id))"
 )
 
 
@@ -24,6 +53,37 @@ def make_v1_db(path) -> Engine:
         "'2025-01-01T00:00:00')"
     )
     engine.user_version = 1
+    return engine
+
+
+def make_populated_v1_db(path) -> Engine:
+    """A v1 database with real operational state: a user, a 4-chip v5e
+    slice plus a legacy chip with no slice label, a reservation, and a
+    restriction attached to a chip — every FK the upgrade must preserve."""
+    engine = make_v1_db(path)
+    engine.execute(V1_RESOURCES_DDL)
+    engine.execute(V1_RESERVATIONS_DDL)
+    engine.execute(V1_RESTRICTIONS_DDL)
+    engine.execute(V1_RESTRICTION2RESOURCE_DDL)
+    for index in range(4):
+        engine.execute(
+            "INSERT INTO resources (uid, name, hostname, accelerator_type, "
+            "slice_name, chip_index) VALUES (?, ?, 'v5e4-w0', 'v5litepod-4', "
+            "'team-slice', ?)",
+            (f"v5e4-w0:tpu:{index}", f"v5e chip {index}", index))
+    engine.execute(
+        "INSERT INTO resources (uid, name, hostname) "
+        "VALUES ('legacy:tpu:0', 'legacy chip', 'legacy')")
+    engine.execute(
+        "INSERT INTO reservations (title, resource_id, user_id, start, end) "
+        "VALUES ('train run', 'v5e4-w0:tpu:0', 1, "
+        "'2025-06-01T08:00:00', '2025-06-01T12:00:00')")
+    engine.execute(
+        "INSERT INTO restrictions (name, starts_at) "
+        "VALUES ('team only', '2025-01-01T00:00:00')")
+    engine.execute(
+        "INSERT INTO restriction2resource (restriction_id, resource_id) "
+        "VALUES (1, 2)")
     return engine
 
 
@@ -58,6 +118,61 @@ def test_upgrade_is_idempotent_after_crash(tmp_path, config):
     ensure_schema(engine)  # re-applies everything
     assert engine.user_version == SCHEMA_VERSION
     assert engine.execute("SELECT COUNT(*) FROM users").fetchone()[0] == 1
+
+
+def test_upgrade_populated_v1_through_v3(tmp_path, config):
+    """The real upgrade scenario: a populated v1 deployment (users,
+    resources in a slice, reservations, restriction links) walks v1→v2→v3.
+    Data survives, FKs stay intact, and the v3 backfill derives topology
+    from the accelerator type and num_chips from it (slice members) or
+    degrades to 1 (legacy rows)."""
+    engine = make_populated_v1_db(tmp_path)
+
+    ensure_schema(engine)
+
+    assert engine.user_version == SCHEMA_VERSION
+    # v2 applied on the way
+    assert "last_login_at" in [
+        row[1] for row in engine.execute("PRAGMA table_info(users)")]
+    # v3 backfill: slice members get the v5litepod-4 topology
+    rows = engine.execute(
+        "SELECT uid, topology, num_chips FROM resources ORDER BY id"
+    ).fetchall()
+    assert len(rows) == 5
+    for uid, topology, num_chips in rows[:4]:
+        assert topology == "2x2" and num_chips == 4, (uid, topology, num_chips)
+    assert rows[4][1] == "" and rows[4][2] == 1     # legacy chip
+    # every pre-existing row survived with FKs intact
+    assert engine.execute("SELECT COUNT(*) FROM reservations").fetchone()[0] == 1
+    assert engine.execute(
+        "SELECT COUNT(*) FROM restriction2resource").fetchone()[0] == 1
+    assert engine.execute("PRAGMA foreign_key_check").fetchall() == []
+    # and the upgraded rows read back through the live ORM
+    from tensorhive_tpu.db.engine import set_engine, reset_engine
+    from tensorhive_tpu.db.models.resource import Resource
+
+    set_engine(engine)
+    try:
+        chip = Resource.get_by_uid("v5e4-w0:tpu:1")
+        assert chip.topology == "2x2" and chip.num_chips == 4
+        assert Resource.get_by_slice("team-slice")[0].hostname == "v5e4-w0"
+    finally:
+        reset_engine()
+
+
+def test_upgrade_populated_v1_idempotent_after_crash(tmp_path, config):
+    """Crash between the v3 backfill and the stamp: the rerun must not
+    double-apply (num_chips recomputed, not incremented) and must converge
+    to the same terminal state."""
+    engine = make_populated_v1_db(tmp_path)
+    for _, migrate in MIGRATIONS:
+        migrate(engine)          # ran, never stamped
+    assert engine.user_version == 1
+    ensure_schema(engine)        # re-applies everything
+    assert engine.user_version == SCHEMA_VERSION
+    rows = [tuple(row) for row in engine.execute(
+        "SELECT topology, num_chips FROM resources ORDER BY id")]
+    assert rows[:4] == [("2x2", 4)] * 4 and rows[4] == ("", 1)
 
 
 def test_fresh_db_is_stamped_at_latest(tmp_path, config):
