@@ -108,6 +108,7 @@ async function refreshServiceHealth() {
 function nodeCard(host, node) {
   const cpu = Object.values(node.CPU || {})[0];
   const chips = Object.entries(node.TPU || {});
+  const warnings = node.WARNINGS || [];
   return `<div class="card">
     <div class="row">
       <h3 style="margin:.1rem 0;cursor:pointer" title="node details"
@@ -115,6 +116,8 @@ function nodeCard(host, node) {
       <span class="muted">${cpu ? `CPU ${cpu.util_pct ?? "?"}% ·
         RAM ${cpu.mem_used_mib ?? "?"}/${cpu.mem_total_mib ?? "?"} MiB` : "no CPU data"}</span>
     </div>
+    ${warnings.map(w => `<div class="badge unsynchronized" style="margin-top:.3rem"
+      title="${esc(w.message || "")}">⚠ ${esc(w.key || "warning")}: ${esc(w.message || "")}</div>`).join("")}
     <div class="grid" style="margin-top:.6rem">${chips.map(([uid, c]) => chipCard(uid, c, host)).join("")
       || '<span class="muted">no TPU chips visible</span>'}</div>
   </div>`;

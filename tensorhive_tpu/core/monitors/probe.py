@@ -168,6 +168,7 @@ for name in accels:
             continue
     if counters:
         out["sysfs_metrics"][name[5:]] = counters
+out["sysfs_status"] = "ok" if out["sysfs_metrics"] else "absent"
 print(json.dumps(out, separators=(",", ":")))
 """.strip()
 
@@ -226,6 +227,11 @@ class ProbeSample:
     #: processes whose /proc/<pid>/fd was unreadable (probe unprivileged);
     #: >0 means chip-ownership data may be incomplete
     restricted: int = 0
+    #: "ok" when the probe read per-chip kernel/runtime counters, "absent"
+    #: when the sysfs tree yielded nothing — absent means utilization for
+    #: non-cooperating workloads is BLIND on this host, which the monitor
+    #: surfaces as a warning instead of letting it look like idle chips
+    sysfs_status: str = "absent"
 
 
 def parse_probe_output(text: str) -> ProbeSample:
@@ -297,6 +303,10 @@ def _build_sample(doc: Dict[str, Any]) -> ProbeSample:
     sample.mem_total_kb = int(mem.get("total_kb", 0) or 0)
     sample.mem_avail_kb = int(mem.get("avail_kb", 0) or 0)
     sample.restricted = int(doc.get("restricted", 0) or 0)
+    # docs from older probe binaries lack the key: derive it from whether
+    # any counters arrived, so absence stays loud across version skew
+    sample.sysfs_status = str(
+        doc.get("sysfs_status") or ("ok" if sysfs else "absent"))
     return sample
 
 
@@ -330,12 +340,14 @@ def render_probe_json(
     cpu: Optional[Dict[str, int]] = None,
     mem: Optional[Dict[str, int]] = None,
     metrics: Optional[Dict[str, Dict[str, Any]]] = None,
+    sysfs_status: str = "ok",
 ) -> str:
     """Serialize a schema-v1 probe document (used by the fake cluster so
     tests exercise the real parser path)."""
     return json.dumps(
         {"v": PROBE_VERSION, "chips": chips, "procs": {str(k): v for k, v in procs.items()},
-         "cpu": cpu or {}, "mem": mem or {}, "metrics": metrics or {}},
+         "cpu": cpu or {}, "mem": mem or {}, "metrics": metrics or {},
+         "sysfs_status": sysfs_status},
         separators=(",", ":"),
     )
 

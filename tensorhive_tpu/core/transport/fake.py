@@ -53,6 +53,10 @@ class FakeHost:
     ncpu: int = 8
     mem_total_kb: int = 16 * 2**20
     mem_avail_kb: int = 12 * 2**20
+    # what the probe reports for per-chip kernel counters: "ok" (healthy
+    # default) or "absent" (tests flip it to exercise the blind-telemetry
+    # warning path)
+    sysfs_status: str = "ok"
 
 
 class FakeCluster:
@@ -151,6 +155,7 @@ class FakeCluster:
                      "ncpu": host.ncpu},
                 mem={"total_kb": host.mem_total_kb, "avail_kb": host.mem_avail_kb},
                 metrics=metrics,
+                sysfs_status=host.sysfs_status,
             )
 
 

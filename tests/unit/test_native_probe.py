@@ -110,6 +110,23 @@ def test_native_probe_reads_sysfs_counters(probe_binary, tmp_path):
         "hbm_total_bytes": 17179869184.0}
     assert doc["sysfs_metrics"]["1"] == {"duty_cycle_pct": 3.0}
     assert "2" not in doc["sysfs_metrics"]
+    assert doc["sysfs_status"] == "ok"
+
+
+def test_native_probe_reports_sysfs_absence(probe_binary, tmp_path):
+    """Absence is loud: no sysfs tree → an explicit 'absent' marker, so a
+    misconfigured driver is distinguishable from an idle fleet."""
+    doc = json.loads(_run([str(probe_binary), "--sysfs-dir",
+                           str(tmp_path / "nonexistent")]))
+    assert doc["sysfs_metrics"] == {}
+    assert doc["sysfs_status"] == "absent"
+
+
+def test_python_probe_reports_sysfs_absence(tmp_path):
+    env = dict(os.environ, TPUHIVE_SYSFS_DIR=str(tmp_path / "nonexistent"))
+    doc = json.loads(_run([sys.executable, "-c", PYTHON_PROBE_SOURCE], env=env))
+    assert doc["sysfs_metrics"] == {}
+    assert doc["sysfs_status"] == "absent"
 
 
 def test_python_probe_reads_sysfs_counters(tmp_path):
