@@ -15,3 +15,16 @@ def test_lint_gate_is_clean():
         capture_output=True, text=True, timeout=120,
     )
     assert proc.returncode == 0, f"\n{proc.stdout}{proc.stderr}"
+
+
+def test_ci_manifest_pins_gate_order():
+    """The committed CI workflow must run the same gates as `make check`
+    plus the suite, in the pinned order lint → style/type → native probe →
+    tests (reference parity: .circleci/config.yml:6-41)."""
+    manifest = (REPO / ".github" / "workflows" / "ci.yml").read_text()
+    order = ["name: lint", "name: ruff", "name: mypy",
+             "name: native probe", "name: tests"]
+    positions = [manifest.index(marker) for marker in order]
+    assert positions == sorted(positions), "CI gate order drifted"
+    assert "tools/lint.py" in manifest
+    assert "pytest tests/" in manifest
