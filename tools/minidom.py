@@ -1,0 +1,609 @@
+"""Browser environment for tools/minijs.py: DOM tree, selectors, fetch.
+
+Enough of the DOM for the in-repo UI (innerHTML parse/serialize,
+getElementById/querySelectorAll with the selector subset the UI uses,
+input value/checked, dataset, dialogs, event listeners + inline on*
+handlers) plus localStorage, location and a fetch() whose transport is a
+python callback — the UI tests plug in werkzeug's test client so UI flows
+hit the REAL WSGI app. Strict like the interpreter: unsupported selectors
+or DOM APIs raise instead of pretending.
+"""
+from __future__ import annotations
+
+import re
+from html.parser import HTMLParser
+from typing import Any, Callable, Dict, List, Optional
+
+from tools.minijs import (
+    UNDEFINED,
+    Interpreter,
+    JSArray,
+    JSError,
+    JSException,
+    JSObject,
+    js_str,
+    js_truthy,
+)
+
+VOID_TAGS = {"br", "hr", "img", "input", "meta", "link"}
+
+
+class Node:
+    def __init__(self, tag: str, attrs: Optional[Dict[str, str]] = None):
+        self.tag = tag.lower()
+        self.attrs: Dict[str, str] = dict(attrs or {})
+        self.children: List["Node"] = []
+        self.text_parts: List[Any] = []   # interleaved str | Node (in order)
+        self.parent: Optional["Node"] = None
+        self.listeners: Dict[str, List[Any]] = {}
+        self.expando: Dict[str, Any] = {}  # el._t and friends
+        self.value_override: Optional[str] = None
+        self.checked_override: Optional[bool] = None
+        self.dialog_open = False
+
+    # -- tree ---------------------------------------------------------------
+    def append(self, child):
+        child.parent = self
+        self.children.append(child)
+        self.text_parts.append(child)
+
+    def remove_child(self, child):
+        child.parent = None
+        self.children = [c for c in self.children if c is not child]
+        self.text_parts = [p for p in self.text_parts if p is not child]
+
+    def walk(self):
+        for child in self.children:
+            yield child
+            yield from child.walk()
+
+    # -- text / html --------------------------------------------------------
+    @property
+    def text_content(self) -> str:
+        out = []
+        for part in self.text_parts:
+            out.append(part.text_content if isinstance(part, Node) else part)
+        return "".join(out)
+
+    def set_text(self, text: str):
+        self.children = []
+        self.text_parts = [text]
+
+    def inner_html(self) -> str:
+        out = []
+        for part in self.text_parts:
+            out.append(part.outer_html() if isinstance(part, Node) else part)
+        return "".join(out)
+
+    def outer_html(self) -> str:
+        attrs = "".join(f' {k}="{v}"' for k, v in self.attrs.items())
+        if self.tag in VOID_TAGS:
+            return f"<{self.tag}{attrs}>"
+        return f"<{self.tag}{attrs}>{self.inner_html()}</{self.tag}>"
+
+    def set_inner_html(self, html: str):
+        self.children = []
+        self.text_parts = []
+        _parse_into(self, html)
+
+    # -- classes / matching ---------------------------------------------------
+    @property
+    def class_list(self) -> List[str]:
+        return self.attrs.get("class", "").split()
+
+    def matches(self, compound: "_Compound") -> bool:
+        if compound.tag and self.tag != compound.tag:
+            return False
+        if compound.id and self.attrs.get("id") != compound.id:
+            return False
+        for cls in compound.classes:
+            if cls not in self.class_list:
+                return False
+        for pseudo in compound.pseudos:
+            if pseudo == "checked":
+                if not self.checked:
+                    return False
+            else:
+                raise JSError(f"unsupported pseudo-class :{pseudo}")
+        for name, expected in compound.attr_tests:
+            if name == "open" and self.tag == "dialog":
+                actual = "" if self.dialog_open else None
+            else:
+                actual = self.attrs.get(name)
+            if actual is None:
+                return False
+            if expected is not None and actual != expected:
+                return False
+        return True
+
+    # -- form state -----------------------------------------------------------
+    @property
+    def value(self) -> str:
+        if self.value_override is not None:
+            return self.value_override
+        if self.tag == "textarea":
+            return self.text_content
+        if self.tag == "select":
+            options = [n for n in self.walk() if n.tag == "option"]
+            for option in options:
+                if "selected" in option.attrs:
+                    return option.attrs.get("value", option.text_content.strip())
+            if options:
+                return options[0].attrs.get("value",
+                                            options[0].text_content.strip())
+            return ""
+        return self.attrs.get("value", "")
+
+    @value.setter
+    def value(self, text: str):
+        self.value_override = text
+
+    @property
+    def checked(self) -> bool:
+        if self.checked_override is not None:
+            return self.checked_override
+        return "checked" in self.attrs
+
+    def closest(self, selector_text: str):
+        chain = _parse_selector(selector_text)
+        node = self
+        while node is not None:
+            if _matches_chain(node, chain):
+                return node
+            node = node.parent
+        return None
+
+    def __repr__(self):
+        ident = f"#{self.attrs['id']}" if "id" in self.attrs else ""
+        return f"<{self.tag}{ident}>"
+
+
+class _Builder(HTMLParser):
+    def __init__(self, root: Node):
+        super().__init__(convert_charrefs=True)
+        self.stack = [root]
+
+    def handle_starttag(self, tag, attrs):
+        node = Node(tag, {k: (v if v is not None else "") for k, v in attrs})
+        self.stack[-1].append(node)
+        if tag.lower() not in VOID_TAGS:
+            self.stack.append(node)
+
+    def handle_endtag(self, tag):
+        for index in range(len(self.stack) - 1, 0, -1):
+            if self.stack[index].tag == tag.lower():
+                del self.stack[index:]
+                return
+
+    def handle_data(self, data):
+        top = self.stack[-1]
+        top.text_parts.append(data)
+
+
+def _parse_into(root: Node, html: str):
+    builder = _Builder(root)
+    builder.feed(html)
+    builder.close()
+
+
+# -- selectors ---------------------------------------------------------------
+
+_ATTR_RE = r'\[([\w-]+)(?:="([^"]*)")?\]'
+
+
+class _Compound:
+    def __init__(self, text: str):
+        self.tag = ""
+        self.id = ""
+        self.classes: List[str] = []
+        self.pseudos: List[str] = []
+        #: [(name, value|None)] — value None = presence test ([open])
+        self.attr_tests: List[tuple] = []
+        for name, value in re.findall(_ATTR_RE, text):
+            self.attr_tests.append((name, value if value != "" else None))
+        text = re.sub(_ATTR_RE, "", text)
+        for kind, name in re.findall(r"([.#:]?)([\w-]+)", text):
+            if kind == ".":
+                self.classes.append(name)
+            elif kind == "#":
+                self.id = name
+            elif kind == ":":
+                self.pseudos.append(name)
+            else:
+                self.tag = name.lower()
+        stripped = re.sub(r"([.#:]?)([\w-]+)", "", text).strip()
+        if stripped:
+            raise JSError(f"unsupported selector piece {text!r}")
+
+
+def _parse_selector(text: str) -> List[List[_Compound]]:
+    """selector list → [chain]; chain = [compound, ...] (descendant only)."""
+    chains = []
+    for alternative in text.split(","):
+        alternative = alternative.strip()
+        if not alternative:
+            continue
+        if ">" in alternative or "+" in alternative or "~" in alternative:
+            raise JSError(f"unsupported selector {alternative!r}")
+        chains.append([_Compound(part) for part in alternative.split()])
+    return chains
+
+
+def _matches_chain(node: Node, chains) -> bool:
+    for chain in chains:
+        if not node.matches(chain[-1]):
+            continue
+        current, remaining = node.parent, list(chain[:-1])
+        while remaining and current is not None:
+            if current.matches(remaining[-1]):
+                remaining.pop()
+            current = current.parent
+        if not remaining:
+            return True
+    return False
+
+
+def query_all(root: Node, selector_text: str) -> List[Node]:
+    chains = _parse_selector(selector_text)
+    return [node for node in root.walk() if _matches_chain(node, chains)]
+
+
+# ---------------------------------------------------------------------------
+# JS-visible wrappers
+# ---------------------------------------------------------------------------
+
+
+class Element:
+    """js_get/js_set protocol adapter over a Node. One Element per Node
+    (stored on the node) so JS identity checks like `calDrag.col !== col`
+    behave across repeated querySelectorAll calls."""
+
+    def __new__(cls, node: Node, page: "Page"):
+        cached = getattr(node, "_element", None)
+        if cached is not None:
+            return cached
+        element = super().__new__(cls)
+        node._element = element
+        return element
+
+    def __init__(self, node: Node, page: "Page"):
+        self.node = node
+        self.page = page
+
+    # -- interpreter protocol -------------------------------------------------
+    def js_get(self, prop):
+        node, page = self.node, self.page
+        wrap = page.wrap
+        simple = {
+            "innerHTML": lambda: node.inner_html(),
+            "outerHTML": lambda: node.outer_html(),
+            "textContent": lambda: node.text_content,
+            "tagName": lambda: node.tag.upper(),
+            "id": lambda: node.attrs.get("id", ""),
+            "className": lambda: node.attrs.get("class", ""),
+            "value": lambda: node.value,
+            "checked": lambda: node.checked,
+            "parentElement": lambda: wrap(node.parent) if node.parent else None,
+            "children": lambda: JSArray([wrap(c) for c in node.children]),
+            "open": lambda: node.dialog_open,
+        }
+        if prop in simple:
+            return simple[prop]()
+        if prop == "style":
+            return _StyleProxy(node)
+        if prop == "dataset":
+            return _DatasetProxy(node)
+        if prop == "classList":
+            return _class_list_api(node)
+        methods = {
+            "getElementById": lambda ident="": page.by_id(js_str(ident)),
+            "querySelector": lambda sel="": (
+                [wrap(n) for n in query_all(node, js_str(sel))[:1]] or [None])[0],
+            "querySelectorAll": lambda sel="": JSArray(
+                [wrap(n) for n in query_all(node, js_str(sel))]),
+            "addEventListener": lambda kind="", fn=None, *_:
+                node.listeners.setdefault(js_str(kind), []).append(fn),
+            "removeEventListener": lambda kind="", fn=None, *_:
+                node.listeners.get(js_str(kind), []) and
+                node.listeners[js_str(kind)].remove(fn),
+            "appendChild": lambda child=None: (node.append(child.node),
+                                               child)[1],
+            "remove": lambda: node.parent and node.parent.remove_child(node),
+            "closest": lambda sel="": wrap(node.closest(js_str(sel))),
+            "getBoundingClientRect": lambda: JSObject(
+                {"top": 0.0, "left": 0.0, "bottom": 1056.0, "right": 200.0,
+                 "width": 200.0, "height": 1056.0}),
+            "getAttribute": lambda name="": node.attrs.get(js_str(name), None),
+            "setAttribute": lambda name="", value="":
+                node.attrs.__setitem__(js_str(name), js_str(value)),
+            "showModal": lambda: setattr(node, "dialog_open", True),
+            "close": lambda: setattr(node, "dialog_open", False),
+            "focus": lambda: UNDEFINED,
+            "click": lambda: page.fire(self, "click"),
+            "dispatchEvent": lambda event=None: page.dispatch(self, event),
+            "contains": lambda other=None: other is not None and (
+                other.node is node or any(c is other.node for c in node.walk())),
+        }
+        if prop in methods:
+            return _as_native(methods[prop])
+        if prop in node.expando:
+            return node.expando[prop]
+        if prop.startswith("on") or prop in ("_t",):
+            return node.expando.get(prop, UNDEFINED)
+        return UNDEFINED
+
+    def js_set(self, prop, value):
+        node = self.node
+        if prop == "innerHTML":
+            node.set_inner_html(js_str(value))
+            return
+        if prop == "textContent":
+            node.set_text(js_str(value))
+            return
+        if prop == "value":
+            node.value = js_str(value)
+            return
+        if prop == "checked":
+            node.checked_override = js_truthy(value)
+            return
+        if prop == "className":
+            node.attrs["class"] = js_str(value)
+            return
+        if prop == "id":
+            node.attrs["id"] = js_str(value)
+            return
+        node.expando[prop] = value
+
+    def js_delete(self, prop):
+        self.node.expando.pop(prop, None)
+
+    def __repr__(self):
+        return repr(self.node)
+
+
+class _StyleProxy:
+    def __init__(self, node: Node):
+        self.node = node
+
+    def _styles(self) -> Dict[str, str]:
+        out = {}
+        for piece in self.node.attrs.get("style", "").split(";"):
+            if ":" in piece:
+                key, _, value = piece.partition(":")
+                out[key.strip()] = value.strip()
+        return out
+
+    def js_get(self, prop):
+        return self._styles().get(_css_name(prop), "")
+
+    def js_set(self, prop, value):
+        styles = self._styles()
+        styles[_css_name(prop)] = js_str(value)
+        self.node.attrs["style"] = ";".join(f"{k}:{v}" for k, v in styles.items())
+
+
+def _css_name(prop: str) -> str:
+    return re.sub(r"([A-Z])", lambda m: "-" + m.group(1).lower(), prop)
+
+
+class _DatasetProxy:
+    def __init__(self, node: Node):
+        self.node = node
+
+    def js_get(self, prop):
+        value = self.node.attrs.get("data-" + _css_name(prop))
+        return value if value is not None else UNDEFINED
+
+    def js_set(self, prop, value):
+        self.node.attrs["data-" + _css_name(prop)] = js_str(value)
+
+    def js_delete(self, prop):
+        self.node.attrs.pop("data-" + _css_name(prop), None)
+
+
+def _class_list_api(node: Node):
+    def mutate(fn):
+        def runner(name=""):
+            classes = node.class_list
+            fn(classes, js_str(name))
+            node.attrs["class"] = " ".join(classes)
+        return _as_native(runner)
+
+    return JSObject({
+        "add": mutate(lambda cl, n: cl.append(n) if n not in cl else None),
+        "remove": mutate(lambda cl, n: cl.remove(n) if n in cl else None),
+        "toggle": mutate(lambda cl, n: cl.remove(n) if n in cl else cl.append(n)),
+        "contains": _as_native(lambda name="": js_str(name) in node.class_list),
+    })
+
+
+def _as_native(fn):
+    fn._js_native = True
+
+    def wrapper(*args):
+        result = fn(*args)
+        if result is None:
+            return UNDEFINED
+        if isinstance(result, bool):
+            return result
+        if isinstance(result, int):
+            return float(result)
+        return result
+    wrapper._js_native = True
+    return wrapper
+
+
+# ---------------------------------------------------------------------------
+# page: document + window plumbing
+# ---------------------------------------------------------------------------
+
+
+class Page:
+    """One loaded page: DOM root + document/window globals wired into an
+    Interpreter. `transport(method, url, headers, body) -> (status, json)`
+    backs fetch()."""
+
+    def __init__(self, interp: Interpreter,
+                 transport: Callable[[str, str, Dict[str, str], Optional[str]],
+                                     Any],
+                 hostname: str = "testhost"):
+        self.interp = interp
+        self.transport = transport
+        self.root = Node("html")
+        self.storage: Dict[str, str] = {}
+        self.document_listeners: Dict[str, List[Any]] = {}
+        self._install(hostname)
+
+    # -- DOM plumbing ---------------------------------------------------------
+    def wrap(self, node):
+        if node is None:
+            return None
+        if isinstance(node, Element):
+            return node
+        return Element(node, self)
+
+    def by_id(self, ident: str):
+        for node in self.root.walk():
+            if node.attrs.get("id") == ident:
+                return self.wrap(node)
+        return None
+
+    def load_html(self, html: str):
+        self.root.set_inner_html(html)
+
+    # -- events ---------------------------------------------------------------
+    def make_event(self, target: Element, kind: str, props=None):
+        event = JSObject({
+            "type": kind,
+            "target": target,
+            "clientY": 0.0,
+            "clientX": 0.0,
+            "button": 0.0,
+            "key": "",
+            "preventDefault": _as_native(lambda: UNDEFINED),
+            "stopPropagation": _as_native(lambda: UNDEFINED),
+        })
+        for key, value in (props or {}).items():
+            event.set(key, value)
+        return event
+
+    def dispatch(self, target: Element, event):
+        kind = js_str(self.interp.get_property(event, "type"))
+        node = target.node
+        while node is not None:
+            for listener in list(node.listeners.get(kind, [])):
+                self.interp.call_any(listener, [event], this=self.wrap(node))
+            handler_src = node.attrs.get("on" + kind)
+            if handler_src:
+                self.run_inline(handler_src, self.wrap(node), event)
+            node = node.parent
+        for listener in list(self.document_listeners.get(kind, [])):
+            self.interp.call_any(listener, [event])
+        return True
+
+    def fire(self, target: Element, kind: str, **props):
+        converted = {k: (float(v) if isinstance(v, (int, float)) and
+                         not isinstance(v, bool) else v)
+                     for k, v in props.items()}
+        event = self.make_event(target, kind, converted)
+        return self.dispatch(target, event)
+
+    def run_inline(self, source: str, this_el, event):
+        self.interp.eval_expr(source, {"this": this_el, "event": event})
+
+    # -- globals --------------------------------------------------------------
+    def _install(self, hostname: str):
+        interp = self.interp
+        page = self
+
+        class DocumentHost:
+            def js_get(self, prop):
+                methods = {
+                    "getElementById": lambda ident="": page.by_id(js_str(ident)),
+                    "querySelector": lambda sel="": (
+                        [page.wrap(n) for n in
+                         query_all(page.root, js_str(sel))[:1]] or [None])[0],
+                    "querySelectorAll": lambda sel="": JSArray(
+                        [page.wrap(n) for n in query_all(page.root, js_str(sel))]),
+                    "createElement": lambda tag="div": page.wrap(
+                        Node(js_str(tag))),
+                    "addEventListener": lambda kind="", fn=None, *_:
+                        page.document_listeners.setdefault(
+                            js_str(kind), []).append(fn),
+                    "removeEventListener": lambda kind="", fn=None, *_: UNDEFINED,
+                }
+                if prop in methods:
+                    return _as_native(methods[prop])
+                if prop == "body":
+                    return page.wrap(page.root)
+                return UNDEFINED
+
+            def js_set(self, prop, value):
+                raise JSError(f"document.{prop} assignment unsupported")
+
+        storage = self.storage
+
+        class StorageHost:
+            def js_get(self, prop):
+                methods = {
+                    "getItem": lambda key="": storage.get(js_str(key), None),
+                    "setItem": lambda key="", value="":
+                        storage.__setitem__(js_str(key), js_str(value)),
+                    "removeItem": lambda key="": storage.pop(js_str(key), None)
+                        and UNDEFINED,
+                    "clear": lambda: storage.clear(),
+                }
+                if prop in methods:
+                    return _as_native(methods[prop])
+                return UNDEFINED
+
+            def js_set(self, prop, value):
+                storage[prop] = js_str(value)
+
+        def fetch(url="", options=UNDEFINED):
+            from tools.minijs import JSPromise, _make_error
+
+            method = "GET"
+            headers: Dict[str, str] = {}
+            body = None
+            if isinstance(options, JSObject):
+                if options.get("method") is not UNDEFINED:
+                    method = js_str(options.get("method"))
+                header_obj = options.get("headers")
+                if isinstance(header_obj, JSObject):
+                    headers = {k: js_str(v) for k, v in header_obj.props.items()}
+                if options.get("body") is not UNDEFINED:
+                    body = js_str(options.get("body"))
+            try:
+                status, payload = self.transport(method, js_str(url), headers,
+                                                 body)
+            except Exception as exc:   # network-level failure → rejected fetch
+                return JSPromise.reject(JSException(_make_error(str(exc))))
+            from tools.minijs import _json_parse
+
+            response = JSObject({
+                "status": float(status),
+                "ok": 200 <= status < 300,
+                "statusText": _STATUS_TEXT.get(status, str(status)),
+                "json": _as_native(lambda: JSPromise.resolve(
+                    _json_parse(payload if payload else "null"))),
+                "text": _as_native(lambda: JSPromise.resolve(payload or "")),
+            })
+            return JSPromise.resolve(response)
+        fetch._js_native = True
+
+        interp.define("document", DocumentHost())
+        interp.define("localStorage", StorageHost())
+        interp.define("location", JSObject({
+            "protocol": "http:", "hostname": hostname, "href": f"http://{hostname}/",
+        }))
+        interp.define("window", interp.global_env.vars.setdefault(
+            "window", JSObject()))
+        interp.define("fetch", fetch)
+        interp.define("navigator", JSObject({"clipboard": JSObject()}))
+
+
+_STATUS_TEXT = {200: "OK", 201: "Created", 204: "No Content",
+                400: "Bad Request", 401: "Unauthorized", 403: "Forbidden",
+                404: "Not Found", 409: "Conflict", 422: "Unprocessable Entity",
+                500: "Internal Server Error"}

@@ -1,0 +1,2363 @@
+"""A small, strict interpreter for the ES subset the in-repo web UI uses.
+
+Why this exists: the UI is ~1.8k LoC of hand-rolled JS (calendar date math,
+month-view anchoring, the job template dialog) and the reference's Vue app
+was exercised by a browser; this image ships NO JavaScript engine (no node,
+no quickjs, no embeddable libv8 — verified), so the only way to *execute*
+the UI in CI is to interpret it. This module does exactly that: a
+tokenizer, a recursive-descent parser and a tree-walking evaluator for the
+constructs the UI actually uses, with JS semantics where they matter
+(number formatting, truthiness, Date month-overflow normalization, ==/===,
+template literals, sync-resolved promises for the UI's await/then chains).
+
+Deliberately STRICT: any construct outside the subset raises JSError with
+a position instead of approximating — a misleading pass would be worse
+than no test. The DOM/browser environment lives in tools/minidom.py; the
+UI tests (tests/unit/test_ui_dom.py) wire fetch to the real WSGI app.
+
+This is a dev/test tool like tools/lint.py, not part of the served
+product.
+"""
+from __future__ import annotations
+
+import json
+import math
+import re as _re
+from datetime import datetime, timedelta, timezone
+from typing import Any, Dict, List, Optional, Tuple
+
+__all__ = ["Interpreter", "JSError", "JSException", "UNDEFINED", "JSObject",
+           "JSArray", "JSFunction", "JSDate", "js_truthy", "js_str"]
+
+
+class JSError(Exception):
+    """Tokenizer/parser/interpreter-level failure (unsupported construct,
+    syntax error, internal limit). NOT a JS-level thrown value."""
+
+
+class JSException(Exception):
+    """A JS-level `throw`; .value is the thrown JS value."""
+
+    def __init__(self, value):
+        super().__init__(js_str(value))
+        self.value = value
+
+
+class _Undefined:
+    _instance = None
+
+    def __new__(cls):
+        if cls._instance is None:
+            cls._instance = super().__new__(cls)
+        return cls._instance
+
+    def __repr__(self):
+        return "undefined"
+
+    def __bool__(self):
+        return False
+
+
+UNDEFINED = _Undefined()
+
+NULL = None
+
+
+# ---------------------------------------------------------------------------
+# tokenizer
+# ---------------------------------------------------------------------------
+
+KEYWORDS = {
+    "var", "let", "const", "function", "return", "if", "else", "for", "of",
+    "in", "while", "do", "break", "continue", "new", "typeof", "delete",
+    "try", "catch", "finally", "throw", "true", "false", "null", "undefined",
+    "async", "await", "instanceof", "this", "switch", "case", "default",
+    "class", "yield", "void",
+}
+
+PUNCT = sorted([
+    "===", "!==", "**=", "...", "=>", "==", "!=", "<=", ">=", "&&", "||",
+    "??", "++", "--", "+=", "-=", "*=", "/=", "%=", "&=", "|=", "^=",
+    "&", "|", "^", "~", "<<", ">>", ">>>",
+    "{", "}", "(", ")", "[", "]", ";", ",", "<", ">", "+", "-", "*", "/",
+    "%", "=", "!", "?", ":", ".",
+], key=len, reverse=True)
+
+
+class Token:
+    __slots__ = ("kind", "value", "pos", "line")
+
+    def __init__(self, kind, value, pos, line):
+        self.kind = kind          # num str template regex ident keyword punct eof
+        self.value = value
+        self.pos = pos
+        self.line = line
+
+    def __repr__(self):
+        return f"Token({self.kind},{self.value!r},l{self.line})"
+
+
+def tokenize(source: str, filename: str = "<js>") -> List[Token]:
+    tokens: List[Token] = []
+    i, n, line = 0, len(source), 1
+
+    def error(msg):
+        raise JSError(f"{filename}:{line}: {msg}")
+
+    def prev_significant():
+        return tokens[-1] if tokens else None
+
+    while i < n:
+        ch = source[i]
+        if ch == "\n":
+            line += 1
+            i += 1
+            continue
+        if ch in " \t\r":
+            i += 1
+            continue
+        if source.startswith("//", i):
+            j = source.find("\n", i)
+            i = n if j < 0 else j
+            continue
+        if source.startswith("/*", i):
+            j = source.find("*/", i)
+            if j < 0:
+                error("unterminated block comment")
+            line += source.count("\n", i, j)
+            i = j + 2
+            continue
+        if ch.isdigit() or (ch == "." and i + 1 < n and source[i + 1].isdigit()):
+            m = _re.match(r"0[xX][0-9a-fA-F]+|\d*\.?\d+(?:[eE][+-]?\d+)?", source[i:])
+            text = m.group(0)
+            value = float(int(text, 16)) if text[:2].lower() == "0x" else float(text)
+            tokens.append(Token("num", value, i, line))
+            i += len(text)
+            continue
+        if ch in "'\"":
+            j, buf = i + 1, []
+            while j < n and source[j] != ch:
+                if source[j] == "\\":
+                    buf.append(_unescape(source[j + 1], error))
+                    j += 2
+                else:
+                    if source[j] == "\n":
+                        error("unterminated string")
+                    buf.append(source[j])
+                    j += 1
+            if j >= n:
+                error("unterminated string")
+            tokens.append(Token("str", "".join(buf), i, line))
+            i = j + 1
+            continue
+        if ch == "`":
+            parts, exprs, j, buf = [], [], i + 1, []
+            while True:
+                if j >= n:
+                    error("unterminated template literal")
+                c = source[j]
+                if c == "`":
+                    parts.append("".join(buf))
+                    j += 1
+                    break
+                if c == "\\":
+                    buf.append(_unescape(source[j + 1], error))
+                    j += 2
+                    continue
+                if c == "$" and j + 1 < n and source[j + 1] == "{":
+                    parts.append("".join(buf))
+                    buf = []
+                    depth, k = 1, j + 2
+                    while k < n and depth:
+                        if source[k] == "`":       # nested template: skip it
+                            k = _skip_template(source, k, error)
+                            continue
+                        if source[k] == "{":
+                            depth += 1
+                        elif source[k] == "}":
+                            depth -= 1
+                            if not depth:
+                                break
+                        elif source[k] in "'\"":
+                            k = _skip_string(source, k, error)
+                            continue
+                        k += 1
+                    if depth:
+                        error("unterminated ${ in template")
+                    exprs.append(source[j + 2:k])
+                    j = k + 1
+                    continue
+                if c == "\n":
+                    line += 1
+                buf.append(c)
+                j += 1
+            tokens.append(Token("template", (parts, exprs), i, line))
+            i = j
+            continue
+        if ch.isalpha() or ch in "_$":
+            m = _re.match(r"[A-Za-z_$][A-Za-z0-9_$]*", source[i:])
+            word = m.group(0)
+            kind = "keyword" if word in KEYWORDS else "ident"
+            tokens.append(Token(kind, word, i, line))
+            i += len(word)
+            continue
+        if ch == "/":
+            prev = prev_significant()
+            is_regex = prev is None or (
+                prev.kind == "punct" and prev.value not in (")", "]")
+            ) or (prev.kind == "keyword" and prev.value not in
+                  ("this", "true", "false", "null", "undefined"))
+            if is_regex:
+                j, in_class = i + 1, False
+                while j < n:
+                    c = source[j]
+                    if c == "\\":
+                        j += 2
+                        continue
+                    if c == "[":
+                        in_class = True
+                    elif c == "]":
+                        in_class = False
+                    elif c == "/" and not in_class:
+                        break
+                    elif c == "\n":
+                        error("unterminated regex literal")
+                    j += 1
+                if j >= n:
+                    error("unterminated regex literal")
+                pattern = source[i + 1:j]
+                m = _re.match(r"[a-z]*", source[j + 1:])
+                flags = m.group(0)
+                tokens.append(Token("regex", (pattern, flags), i, line))
+                i = j + 1 + len(flags)
+                continue
+        for punct in PUNCT:
+            if source.startswith(punct, i):
+                tokens.append(Token("punct", punct, i, line))
+                i += len(punct)
+                break
+        else:
+            error(f"unexpected character {ch!r}")
+    tokens.append(Token("eof", None, i, line))
+    return tokens
+
+
+def _unescape(ch, error):
+    table = {"n": "\n", "t": "\t", "r": "\r", "b": "\b", "f": "\f",
+             "0": "\0", "\n": ""}
+    return table.get(ch, ch)
+
+
+def _skip_string(source, i, error):
+    quote, j = source[i], i + 1
+    while j < len(source) and source[j] != quote:
+        j += 2 if source[j] == "\\" else 1
+    if j >= len(source):
+        error("unterminated string in template expression")
+    return j + 1
+
+
+def _skip_template(source, i, error):
+    j = i + 1
+    while j < len(source):
+        c = source[j]
+        if c == "\\":
+            j += 2
+            continue
+        if c == "`":
+            return j + 1
+        if c == "$" and j + 1 < len(source) and source[j + 1] == "{":
+            depth, j = 1, j + 2
+            while j < len(source) and depth:
+                if source[j] == "`":
+                    j = _skip_template(source, j, error)
+                    continue
+                if source[j] == "{":
+                    depth += 1
+                elif source[j] == "}":
+                    depth -= 1
+                elif source[j] in "'\"":
+                    j = _skip_string(source, j, error)
+                    continue
+                j += 1
+            continue
+        j += 1
+    error("unterminated nested template literal")
+
+
+# ---------------------------------------------------------------------------
+# parser — AST nodes are ("kind", ...) tuples
+# ---------------------------------------------------------------------------
+
+ASSIGN_OPS = {"=", "+=", "-=", "*=", "/=", "%="}
+
+
+class Parser:
+    def __init__(self, tokens: List[Token], filename: str = "<js>"):
+        self.tokens = tokens
+        self.pos = 0
+        self.filename = filename
+
+    # -- helpers ------------------------------------------------------------
+    def peek(self, offset=0) -> Token:
+        return self.tokens[min(self.pos + offset, len(self.tokens) - 1)]
+
+    def next(self) -> Token:
+        token = self.peek()
+        self.pos += 1
+        return token
+
+    def at(self, kind, value=None) -> bool:
+        token = self.peek()
+        return token.kind == kind and (value is None or token.value == value)
+
+    def eat(self, kind, value=None) -> Optional[Token]:
+        if self.at(kind, value):
+            return self.next()
+        return None
+
+    def expect(self, kind, value=None) -> Token:
+        token = self.peek()
+        if not self.at(kind, value):
+            self.error(f"expected {value or kind}, got {token.kind} {token.value!r}")
+        return self.next()
+
+    def error(self, msg):
+        token = self.peek()
+        raise JSError(f"{self.filename}:{token.line}: parse error: {msg}")
+
+    # -- program ------------------------------------------------------------
+    def parse_program(self):
+        body = []
+        while not self.at("eof"):
+            body.append(self.statement())
+        return ("program", body)
+
+    # -- statements ---------------------------------------------------------
+    def statement(self):
+        token = self.peek()
+        if token.kind == "punct" and token.value == ";":
+            self.next()
+            return ("empty",)
+        if token.kind == "punct" and token.value == "{":
+            return self.block()
+        if token.kind == "keyword":
+            word = token.value
+            if word in ("var", "let", "const"):
+                decl = self.var_decl()
+                self.eat("punct", ";")
+                return decl
+            if word == "function":
+                return self.function_decl(is_async=False)
+            if word == "async" and self.peek(1).kind == "keyword" \
+                    and self.peek(1).value == "function":
+                self.next()
+                return self.function_decl(is_async=True)
+            if word == "if":
+                return self.if_stmt()
+            if word == "for":
+                return self.for_stmt()
+            if word == "while":
+                self.next()
+                self.expect("punct", "(")
+                test = self.expression()
+                self.expect("punct", ")")
+                return ("while", test, self.statement())
+            if word == "return":
+                self.next()
+                if self.at("punct", ";") or self.at("punct", "}") or self.at("eof"):
+                    self.eat("punct", ";")
+                    return ("return", None)
+                value = self.expression()
+                self.eat("punct", ";")
+                return ("return", value)
+            if word == "throw":
+                self.next()
+                value = self.expression()
+                self.eat("punct", ";")
+                return ("throw", value)
+            if word == "break":
+                self.next()
+                self.eat("punct", ";")
+                return ("break",)
+            if word == "continue":
+                self.next()
+                self.eat("punct", ";")
+                return ("continue",)
+            if word == "try":
+                return self.try_stmt()
+            if word in ("class", "switch", "do", "yield"):
+                self.error(f"unsupported construct '{word}' — extend tools/minijs.py")
+        expr = self.expression()
+        self.eat("punct", ";")
+        return ("exprstmt", expr)
+
+    def block(self):
+        self.expect("punct", "{")
+        body = []
+        while not self.at("punct", "}"):
+            body.append(self.statement())
+        self.expect("punct", "}")
+        return ("block", body)
+
+    def var_decl(self):
+        kind = self.next().value
+        declarators = []
+        while True:
+            target = self.binding_target()
+            init = None
+            if self.eat("punct", "="):
+                init = self.assignment()
+            declarators.append((target, init))
+            if not self.eat("punct", ","):
+                break
+        return ("vardecl", kind, declarators)
+
+    def binding_target(self):
+        if self.at("punct", "{"):
+            return self.object_pattern()
+        if self.at("punct", "["):
+            return self.array_pattern()
+        return ("bind_ident", self.expect("ident").value)
+
+    def object_pattern(self):
+        self.expect("punct", "{")
+        props = []
+        while not self.at("punct", "}"):
+            name = self.expect("ident").value
+            alias = name
+            if self.eat("punct", ":"):
+                alias = self.expect("ident").value
+            default = None
+            if self.eat("punct", "="):
+                default = self.assignment()
+            props.append((name, alias, default))
+            if not self.eat("punct", ","):
+                break
+        self.expect("punct", "}")
+        return ("bind_object", props)
+
+    def array_pattern(self):
+        self.expect("punct", "[")
+        elements = []
+        while not self.at("punct", "]"):
+            if self.at("punct", ","):
+                elements.append(None)      # hole: ([, v]) =>
+            else:
+                elements.append(self.binding_target())
+            if not self.eat("punct", ","):
+                break
+        self.expect("punct", "]")
+        return ("bind_array", elements)
+
+    def function_decl(self, is_async):
+        self.expect("keyword", "function")
+        name = self.expect("ident").value
+        params = self.param_list()
+        body = self.block()
+        return ("funcdecl", name, params, body, is_async)
+
+    def param_list(self):
+        self.expect("punct", "(")
+        params = []
+        while not self.at("punct", ")"):
+            if self.eat("punct", "..."):
+                params.append(("rest", self.expect("ident").value))
+            else:
+                target = self.binding_target()
+                default = None
+                if self.eat("punct", "="):
+                    default = self.assignment()
+                params.append(("param", target, default))
+            if not self.eat("punct", ","):
+                break
+        self.expect("punct", ")")
+        return params
+
+    def if_stmt(self):
+        self.expect("keyword", "if")
+        self.expect("punct", "(")
+        test = self.expression()
+        self.expect("punct", ")")
+        then = self.statement()
+        alt = None
+        if self.eat("keyword", "else"):
+            alt = self.statement()
+        return ("if", test, then, alt)
+
+    def for_stmt(self):
+        self.expect("keyword", "for")
+        self.expect("punct", "(")
+        init = None
+        if self.at("keyword") and self.peek().value in ("var", "let", "const"):
+            decl_kind = self.peek().value
+            save = self.pos
+            decl = self.var_decl()
+            if self.at("keyword", "of"):
+                self.next()
+                iterable = self.expression()
+                self.expect("punct", ")")
+                target = decl[2][0][0]
+                return ("forof", decl_kind, target, iterable, self.statement())
+            if self.at("keyword", "in"):
+                self.error("for-in is unsupported — use Object.keys()")
+            init = decl
+            del save
+        elif not self.at("punct", ";"):
+            init = ("exprstmt", self.expression())
+        self.expect("punct", ";")
+        test = None if self.at("punct", ";") else self.expression()
+        self.expect("punct", ";")
+        update = None if self.at("punct", ")") else self.expression()
+        self.expect("punct", ")")
+        return ("for", init, test, update, self.statement())
+
+    def try_stmt(self):
+        self.expect("keyword", "try")
+        block = self.block()
+        handler = None
+        finalizer = None
+        if self.eat("keyword", "catch"):
+            param = None
+            if self.eat("punct", "("):
+                param = self.expect("ident").value
+                self.expect("punct", ")")
+            handler = (param, self.block())
+        if self.eat("keyword", "finally"):
+            finalizer = self.block()
+        return ("try", block, handler, finalizer)
+
+    # -- expressions --------------------------------------------------------
+    def expression(self):
+        expr = self.assignment()
+        while self.at("punct", ","):
+            self.next()
+            right = self.assignment()
+            expr = ("comma", expr, right)
+        return expr
+
+    def assignment(self):
+        arrow = self.try_arrow()
+        if arrow is not None:
+            return arrow
+        left = self.conditional()
+        if self.peek().kind == "punct" and self.peek().value in ASSIGN_OPS:
+            op = self.next().value
+            right = self.assignment()
+            return ("assign", op, left, right)
+        return left
+
+    def try_arrow(self):
+        """Detect `ident =>`, `async ident =>`, `(params) =>`."""
+        start = self.pos
+        is_async = False
+        if self.at("keyword", "async") and self.peek(1).kind in ("ident", "punct"):
+            if (self.peek(1).kind == "ident" and self.peek(2).kind == "punct"
+                    and self.peek(2).value == "=>") or \
+               (self.peek(1).kind == "punct" and self.peek(1).value == "("):
+                probe = self.pos + 1
+                if self.tokens[probe].value == "(":
+                    close = self._matching_paren(probe)
+                    if close is None or self.tokens[close + 1].value != "=>":
+                        probe = None
+                if probe is not None:
+                    is_async = True
+                    self.next()
+        token = self.peek()
+        if token.kind == "ident" and self.peek(1).kind == "punct" \
+                and self.peek(1).value == "=>":
+            name = self.next().value
+            self.next()
+            return self.arrow_body([("param", ("bind_ident", name), None)], is_async)
+        if token.kind == "punct" and token.value == "(":
+            close = self._matching_paren(self.pos)
+            if close is not None and self.tokens[close + 1].kind == "punct" \
+                    and self.tokens[close + 1].value == "=>":
+                params = self.param_list()
+                self.expect("punct", "=>")
+                return self.arrow_body(params, is_async)
+        self.pos = start
+        return None
+
+    def _matching_paren(self, open_pos):
+        depth = 0
+        for index in range(open_pos, len(self.tokens)):
+            value = self.tokens[index].value
+            if value in ("(", "[", "{"):
+                depth += 1
+            elif value in (")", "]", "}"):
+                depth -= 1
+                if depth == 0:
+                    return index
+        return None
+
+    def arrow_body(self, params, is_async):
+        if self.at("punct", "{"):
+            return ("arrow", params, self.block(), is_async)
+        expr = self.assignment()
+        return ("arrow", params, ("return", expr), is_async)
+
+    def conditional(self):
+        test = self.nullish()
+        if self.eat("punct", "?"):
+            consequent = self.assignment()
+            self.expect("punct", ":")
+            alternate = self.assignment()
+            return ("ternary", test, consequent, alternate)
+        return test
+
+    def nullish(self):
+        left = self.logical_or()
+        while self.at("punct", "??"):
+            self.next()
+            left = ("nullish", left, self.logical_or())
+        return left
+
+    def logical_or(self):
+        left = self.logical_and()
+        while self.at("punct", "||"):
+            self.next()
+            left = ("or", left, self.logical_and())
+        return left
+
+    def logical_and(self):
+        left = self.equality()
+        while self.at("punct", "&&"):
+            self.next()
+            left = ("and", left, self.equality())
+        return left
+
+    def equality(self):
+        left = self.relational()
+        while self.peek().kind == "punct" and self.peek().value in \
+                ("==", "!=", "===", "!=="):
+            op = self.next().value
+            left = ("binary", op, left, self.relational())
+        return left
+
+    def relational(self):
+        left = self.additive()
+        while (self.peek().kind == "punct" and self.peek().value in
+               ("<", ">", "<=", ">=")) or self.at("keyword", "instanceof"):
+            if self.at("keyword", "instanceof"):
+                self.next()
+                left = ("instanceof", left, self.additive())
+            else:
+                op = self.next().value
+                left = ("binary", op, left, self.additive())
+        return left
+
+    def additive(self):
+        left = self.multiplicative()
+        while self.peek().kind == "punct" and self.peek().value in ("+", "-"):
+            op = self.next().value
+            left = ("binary", op, left, self.multiplicative())
+        return left
+
+    def multiplicative(self):
+        left = self.unary()
+        while self.peek().kind == "punct" and self.peek().value in ("*", "/", "%"):
+            op = self.next().value
+            left = ("binary", op, left, self.unary())
+        return left
+
+    def unary(self):
+        token = self.peek()
+        if token.kind == "punct" and token.value in ("!", "-", "+", "~"):
+            self.next()
+            return ("unary", token.value, self.unary())
+        if token.kind == "punct" and token.value in ("++", "--"):
+            self.next()
+            return ("update", token.value, self.unary(), True)
+        if token.kind == "keyword" and token.value in ("typeof", "delete", "void"):
+            self.next()
+            return ("unary", token.value, self.unary())
+        if token.kind == "keyword" and token.value == "await":
+            self.next()
+            return ("await", self.unary())
+        if token.kind == "keyword" and token.value == "new":
+            self.next()
+            callee = self.member_chain(self.primary(), allow_calls=False)
+            args = self.arguments() if self.at("punct", "(") else []
+            return self.postfix(self.member_chain(("new", callee, args)))
+        return self.postfix(self.member_chain(self.primary()))
+
+    def postfix(self, expr):
+        if self.peek().kind == "punct" and self.peek().value in ("++", "--"):
+            op = self.next().value
+            return ("update", op, expr, False)
+        return expr
+
+    def member_chain(self, expr, allow_calls=True):
+        while True:
+            if self.at("punct", "."):
+                self.next()
+                name = self.next()
+                if name.kind not in ("ident", "keyword"):
+                    self.error("expected property name")
+                expr = ("member", expr, ("lit", name.value))
+            elif self.at("punct", "["):
+                self.next()
+                prop = self.expression()
+                self.expect("punct", "]")
+                expr = ("member", expr, prop)
+            elif allow_calls and self.at("punct", "("):
+                expr = ("call", expr, self.arguments())
+            elif self.at("template"):
+                self.error("tagged templates are unsupported")
+            else:
+                return expr
+
+    def arguments(self):
+        self.expect("punct", "(")
+        args = []
+        while not self.at("punct", ")"):
+            if self.eat("punct", "..."):
+                args.append(("spread", self.assignment()))
+            else:
+                args.append(self.assignment())
+            if not self.eat("punct", ","):
+                break
+        self.expect("punct", ")")
+        return args
+
+    def primary(self):
+        token = self.peek()
+        if token.kind == "num":
+            self.next()
+            return ("lit", token.value)
+        if token.kind == "str":
+            self.next()
+            return ("lit", token.value)
+        if token.kind == "regex":
+            self.next()
+            return ("regexlit", token.value[0], token.value[1])
+        if token.kind == "template":
+            self.next()
+            parts, raw_exprs = token.value
+            exprs = []
+            for raw in raw_exprs:
+                sub = Parser(tokenize(raw, self.filename), self.filename)
+                exprs.append(sub.expression())
+                if not sub.at("eof"):
+                    sub.error("trailing tokens in template expression")
+            return ("template", parts, exprs)
+        if token.kind == "ident":
+            self.next()
+            return ("ident", token.value)
+        if token.kind == "keyword":
+            word = token.value
+            if word in ("true", "false"):
+                self.next()
+                return ("lit", word == "true")
+            if word == "null":
+                self.next()
+                return ("lit", NULL)
+            if word == "undefined":
+                self.next()
+                return ("lit", UNDEFINED)
+            if word == "this":
+                self.next()
+                return ("this",)
+            if word == "function":
+                self.next()
+                name = self.eat("ident")
+                params = self.param_list()
+                body = self.block()
+                return ("funcexpr", name.value if name else None, params, body, False)
+            if word == "async" and self.peek(1).kind == "keyword" \
+                    and self.peek(1).value == "function":
+                self.next()
+                self.next()
+                name = self.eat("ident")
+                params = self.param_list()
+                body = self.block()
+                return ("funcexpr", name.value if name else None, params, body, True)
+            self.error(f"unexpected keyword {word!r}")
+        if token.kind == "punct":
+            if token.value == "(":
+                self.next()
+                expr = self.expression()
+                self.expect("punct", ")")
+                return expr
+            if token.value == "[":
+                self.next()
+                elements = []
+                while not self.at("punct", "]"):
+                    if self.eat("punct", "..."):
+                        elements.append(("spread", self.assignment()))
+                    else:
+                        elements.append(self.assignment())
+                    if not self.eat("punct", ","):
+                        break
+                self.expect("punct", "]")
+                return ("array", elements)
+            if token.value == "{":
+                return self.object_literal()
+        self.error(f"unexpected token {token.value!r}")
+
+    def object_literal(self):
+        self.expect("punct", "{")
+        props = []
+        while not self.at("punct", "}"):
+            key_token = self.next()
+            if key_token.kind in ("ident", "keyword"):
+                key = ("lit", key_token.value)
+            elif key_token.kind == "str":
+                key = ("lit", key_token.value)
+            elif key_token.kind == "num":
+                key = ("lit", js_str(key_token.value))
+            elif key_token.kind == "punct" and key_token.value == "[":
+                key = self.assignment()
+                self.expect("punct", "]")
+            else:
+                self.error(f"unsupported object key {key_token.value!r}")
+            if self.eat("punct", ":"):
+                props.append((key, self.assignment()))
+            elif self.at("punct", "(") and key_token.kind in ("ident", "keyword"):
+                params = self.param_list()
+                body = self.block()
+                props.append((key, ("funcexpr", key_token.value, params, body, False)))
+            else:
+                if key_token.kind not in ("ident", "keyword"):
+                    self.error("shorthand property must be an identifier")
+                props.append((key, ("ident", key_token.value)))
+            if not self.eat("punct", ","):
+                break
+        self.expect("punct", "}")
+        return ("object", props)
+
+
+# ---------------------------------------------------------------------------
+# runtime values
+# ---------------------------------------------------------------------------
+
+
+class JSObject:
+    """Plain object: insertion-ordered property dict."""
+
+    def __init__(self, props: Optional[Dict[str, Any]] = None):
+        self.props: Dict[str, Any] = dict(props or {})
+
+    def get(self, name):
+        return self.props.get(name, UNDEFINED)
+
+    def set(self, name, value):
+        self.props[name] = value
+
+    def __repr__(self):
+        return "[object Object]"
+
+
+class JSArray:
+    def __init__(self, items: Optional[List[Any]] = None):
+        self.items: List[Any] = list(items or [])
+
+    def __repr__(self):
+        return js_str(self)
+
+
+class JSFunction:
+    def __init__(self, name, params, body, closure, interpreter, is_async,
+                 this=UNDEFINED):
+        self.name = name or "<anonymous>"
+        self.params = params
+        self.body = body
+        self.closure = closure
+        self.interpreter = interpreter
+        self.is_async = is_async
+        self.this = this
+
+    def __call__(self, *args, this=None):
+        return self.interpreter.call_function(
+            self, list(args), this if this is not None else self.this)
+
+
+class JSPromise:
+    """Synchronous promise: settled at construction (the UI has no real
+    concurrency — fetch resolves inline through the WSGI bridge)."""
+
+    def __init__(self, value=UNDEFINED, error=None):
+        self.value = value
+        self.error = error      # JSException or None
+
+    @classmethod
+    def resolve(cls, value):
+        return value if isinstance(value, JSPromise) else cls(value=value)
+
+    @classmethod
+    def reject(cls, exc: JSException):
+        return cls(error=exc)
+
+
+class JSRegex:
+    def __init__(self, pattern: str, flags: str):
+        self.source = pattern
+        self.flags = flags
+        py_flags = _re.IGNORECASE if "i" in flags else 0
+        self.compiled = _re.compile(_js_regex_to_python(pattern), py_flags)
+        self.global_ = "g" in flags
+
+
+def _js_regex_to_python(pattern: str) -> str:
+    # the UI's patterns are simple char classes / escapes; python re accepts
+    # them as-is except JS-only escapes we don't use
+    return pattern
+
+
+EPOCH = datetime(1970, 1, 1, tzinfo=timezone.utc)
+
+
+class JSDate:
+    """JS Date over UTC (the test environment pins UTC: getTimezoneOffset
+    is 0, so local == UTC and toLocaleString is deterministic). Month/day
+    overflow normalizes exactly like JS MakeDay (setMonth(12) → January of
+    the next year; day 32 rolls into the next month)."""
+
+    def __init__(self, ms: float):
+        self.ms = float(ms)
+
+    #: tests pin "now" so date-boundary behavior (month-view anchoring in a
+    #: partial first week, year rollover) is reproducible on any day
+    fixed_now_ms: Optional[float] = None
+
+    # -- construction -------------------------------------------------------
+    @classmethod
+    def now(cls):
+        if cls.fixed_now_ms is not None:
+            return cls(cls.fixed_now_ms)
+        return cls((datetime.now(timezone.utc) - EPOCH).total_seconds() * 1000)
+
+    @classmethod
+    def from_parts(cls, year, month, day=1, hours=0, minutes=0, seconds=0, ms=0):
+        year_extra, month = divmod(int(month), 12)
+        base = datetime(int(year) + year_extra, month + 1, 1, tzinfo=timezone.utc)
+        delta = timedelta(days=int(day) - 1, hours=int(hours),
+                          minutes=int(minutes), seconds=int(seconds),
+                          milliseconds=int(ms))
+        return cls(((base - EPOCH) + delta).total_seconds() * 1000)
+
+    @classmethod
+    def parse(cls, text: str):
+        text = text.strip()
+        for fmt in ("%Y-%m-%dT%H:%M:%S.%f%z", "%Y-%m-%dT%H:%M:%S%z"):
+            try:
+                return cls((datetime.strptime(text.replace("Z", "+0000"), fmt)
+                            - EPOCH).total_seconds() * 1000)
+            except ValueError:
+                pass
+        for fmt in ("%Y-%m-%dT%H:%M:%S.%f", "%Y-%m-%dT%H:%M:%S",
+                    "%Y-%m-%dT%H:%M", "%Y-%m-%d"):
+            try:
+                value = datetime.strptime(text, fmt).replace(tzinfo=timezone.utc)
+                return cls((value - EPOCH).total_seconds() * 1000)
+            except ValueError:
+                pass
+        raise JSError(f"unsupported Date string {text!r}")
+
+    # -- accessors ----------------------------------------------------------
+    def _dt(self) -> datetime:
+        return EPOCH + timedelta(milliseconds=self.ms)
+
+    def getFullYear(self):
+        return float(self._dt().year)
+
+    def getMonth(self):
+        return float(self._dt().month - 1)
+
+    def getDate(self):
+        return float(self._dt().day)
+
+    def getDay(self):
+        return float((self._dt().weekday() + 1) % 7)   # JS: Sunday = 0
+
+    def getHours(self):
+        return float(self._dt().hour)
+
+    def getMinutes(self):
+        return float(self._dt().minute)
+
+    def getTime(self):
+        return self.ms
+
+    def getTimezoneOffset(self):
+        return 0.0
+
+    # -- mutators (JS-normalizing) -----------------------------------------
+    def _rebuild(self, **overrides):
+        current = self._dt()
+        parts = dict(year=current.year, month=current.month - 1,
+                     day=current.day, hours=current.hour,
+                     minutes=current.minute, seconds=current.second,
+                     ms=current.microsecond // 1000)
+        parts.update(overrides)
+        self.ms = JSDate.from_parts(**parts).ms
+        return self.ms
+
+    def setHours(self, hours, minutes=None, seconds=None, ms=None):
+        overrides = {"hours": hours}
+        if minutes is not None:
+            overrides["minutes"] = minutes
+        if seconds is not None:
+            overrides["seconds"] = seconds
+        if ms is not None:
+            overrides["ms"] = ms
+        return self._rebuild(**overrides)
+
+    def setMinutes(self, minutes, seconds=None, ms=None):
+        overrides = {"minutes": minutes}
+        if seconds is not None:
+            overrides["seconds"] = seconds
+        if ms is not None:
+            overrides["ms"] = ms
+        return self._rebuild(**overrides)
+
+    def setDate(self, day):
+        return self._rebuild(day=day)
+
+    def setMonth(self, month):
+        return self._rebuild(month=month)
+
+    def setFullYear(self, year):
+        return self._rebuild(year=year)
+
+    # -- formatting ---------------------------------------------------------
+    def toISOString(self):
+        dt = self._dt()
+        return dt.strftime("%Y-%m-%dT%H:%M:%S.") + f"{dt.microsecond // 1000:03d}Z"
+
+    def toDateString(self):
+        dt = self._dt()
+        days = ["Sun", "Mon", "Tue", "Wed", "Thu", "Fri", "Sat"]
+        months = ["Jan", "Feb", "Mar", "Apr", "May", "Jun", "Jul", "Aug",
+                  "Sep", "Oct", "Nov", "Dec"]
+        return (f"{days[int(self.getDay())]} {months[dt.month - 1]} "
+                f"{dt.day:02d} {dt.year}")
+
+    def toLocaleDateString(self, _locale=UNDEFINED, options=None):
+        dt = self._dt()
+        months = ["January", "February", "March", "April", "May", "June",
+                  "July", "August", "September", "October", "November",
+                  "December"]
+        if options is not None and isinstance(options, JSObject) and \
+                options.get("month") == "long":
+            return f"{months[dt.month - 1]} {dt.year}"
+        return f"{dt.month}/{dt.day}/{dt.year}"
+
+    def toLocaleString(self, _locale=UNDEFINED, _options=None):
+        dt = self._dt()
+        return f"{dt.month}/{dt.day}/{dt.year[-2:] if False else dt.year % 100:02d}, {dt.hour:02d}:{dt.minute:02d}"
+
+    def __repr__(self):
+        return self.toISOString()
+
+
+class JSSet:
+    def __init__(self, items=None):
+        self._items: Dict[Any, None] = {}
+        for item in items or []:
+            self._items[item] = None
+
+    def add(self, value):
+        self._items[value] = None
+        return self
+
+    def delete(self, value):
+        return self._items.pop(value, "__missing__") != "__missing__"
+
+    def has(self, value):
+        return value in self._items
+
+    @property
+    def size(self):
+        return float(len(self._items))
+
+    def __iter__(self):
+        return iter(self._items)
+
+
+# ---------------------------------------------------------------------------
+# coercions
+# ---------------------------------------------------------------------------
+
+
+def js_truthy(value) -> bool:
+    if value is UNDEFINED or value is NULL:
+        return False
+    if isinstance(value, bool):
+        return value
+    if isinstance(value, float):
+        return not (value == 0 or math.isnan(value))
+    if isinstance(value, str):
+        return value != ""
+    return True
+
+
+def js_number(value) -> float:
+    if isinstance(value, bool):
+        return 1.0 if value else 0.0
+    if isinstance(value, float):
+        return value
+    if value is NULL:
+        return 0.0
+    if value is UNDEFINED:
+        return math.nan
+    if isinstance(value, str):
+        text = value.strip()
+        if text == "":
+            return 0.0
+        try:
+            return float(text)
+        except ValueError:
+            return math.nan
+    if isinstance(value, JSDate):
+        return value.ms
+    if isinstance(value, JSArray):
+        if not value.items:
+            return 0.0
+        if len(value.items) == 1:
+            return js_number(value.items[0])
+    return math.nan
+
+
+def js_str(value) -> str:
+    if value is UNDEFINED:
+        return "undefined"
+    if value is NULL:
+        return "null"
+    if isinstance(value, bool):
+        return "true" if value else "false"
+    if isinstance(value, float):
+        if math.isnan(value):
+            return "NaN"
+        if math.isinf(value):
+            return "Infinity" if value > 0 else "-Infinity"
+        if value == int(value) and abs(value) < 1e21:
+            return str(int(value))
+        return repr(value)
+    if isinstance(value, str):
+        return value
+    if isinstance(value, JSArray):
+        return ",".join("" if item in (UNDEFINED, NULL) else js_str(item)
+                        for item in value.items)
+    if isinstance(value, JSDate):
+        return value.toISOString()
+    if isinstance(value, JSObject):
+        return "[object Object]"
+    if isinstance(value, (JSFunction,)) or callable(value):
+        return f"function {getattr(value, 'name', '')}() {{ [code] }}"
+    return str(value)
+
+
+def js_equals_loose(a, b) -> bool:
+    if (a is NULL or a is UNDEFINED) and (b is NULL or b is UNDEFINED):
+        return True
+    if a is NULL or a is UNDEFINED or b is NULL or b is UNDEFINED:
+        return False
+    if type(a) is type(b) or (isinstance(a, (float, bool)) and
+                              isinstance(b, (float, bool))):
+        return js_equals_strict(a, b)
+    if isinstance(a, str) and isinstance(b, float):
+        return js_number(a) == b
+    if isinstance(a, float) and isinstance(b, str):
+        return a == js_number(b)
+    if isinstance(a, (JSDate,)) or isinstance(b, (JSDate,)):
+        return js_number(a) == js_number(b)
+    return js_equals_strict(a, b)
+
+
+def js_equals_strict(a, b) -> bool:
+    if isinstance(a, bool) != isinstance(b, bool):
+        return False
+    if isinstance(a, float) and isinstance(b, float):
+        return a == b
+    if type(a) is not type(b):
+        return False
+    if isinstance(a, (str, bool, float)):
+        return a == b
+    return a is b
+
+
+# ---------------------------------------------------------------------------
+# environment
+# ---------------------------------------------------------------------------
+
+
+class Environment:
+    def __init__(self, parent: Optional["Environment"] = None):
+        self.vars: Dict[str, Any] = {}
+        self.parent = parent
+
+    def declare(self, name, value):
+        self.vars[name] = value
+
+    def get(self, name):
+        env = self
+        while env is not None:
+            if name in env.vars:
+                return env.vars[name]
+            env = env.parent
+        raise JSException(_make_error(f"{name} is not defined",
+                                      kind="ReferenceError"))
+
+    def set(self, name, value):
+        env = self
+        while env is not None:
+            if name in env.vars:
+                env.vars[name] = value
+                return
+            env = env.parent
+        # sloppy-mode implicit global (the UI runs "use strict" but never
+        # assigns undeclared names; still, fail loud)
+        raise JSException(_make_error(f"{name} is not defined",
+                                      kind="ReferenceError"))
+
+    def has(self, name):
+        env = self
+        while env is not None:
+            if name in env.vars:
+                return True
+            env = env.parent
+        return False
+
+
+def _make_error(message, kind="Error"):
+    obj = JSObject({"name": kind, "message": message})
+    obj.is_error = True
+    return obj
+
+
+# control-flow signals
+class _Return(Exception):
+    def __init__(self, value):
+        self.value = value
+
+
+class _Break(Exception):
+    pass
+
+
+class _Continue(Exception):
+    pass
+
+
+# ---------------------------------------------------------------------------
+# interpreter
+# ---------------------------------------------------------------------------
+
+
+class Interpreter:
+    def __init__(self):
+        self.global_env = Environment()
+        self._setup_globals()
+        self._call_depth = 0
+
+    # -- public API ---------------------------------------------------------
+    def run(self, source: str, filename: str = "<js>"):
+        program = Parser(tokenize(source, filename), filename).parse_program()
+        self._hoist(program[1], self.global_env)
+        result = UNDEFINED
+        for stmt in program[1]:
+            result = self.exec_stmt(stmt, self.global_env)
+        return result
+
+    def eval_expr(self, source: str, extra_env: Optional[Dict[str, Any]] = None):
+        parser = Parser(tokenize(source, "<eval>"), "<eval>")
+        env = Environment(self.global_env)
+        for key, value in (extra_env or {}).items():
+            env.declare(key, value)
+        result = UNDEFINED
+        while not parser.at("eof"):
+            stmt = parser.statement()
+            result = self.exec_stmt(stmt, env)
+        return result
+
+    def define(self, name, value):
+        self.global_env.declare(name, value)
+
+    # -- statements ---------------------------------------------------------
+    def _hoist(self, body, env):
+        for stmt in body:
+            if stmt[0] == "funcdecl":
+                _, name, params, fbody, is_async = stmt
+                env.declare(name, JSFunction(name, params, fbody, env, self,
+                                             is_async))
+
+    def exec_stmt(self, node, env):
+        kind = node[0]
+        if kind == "exprstmt":
+            return self.eval(node[1], env)
+        if kind == "vardecl":
+            for target, init in node[2]:
+                value = self.eval(init, env) if init is not None else UNDEFINED
+                self._bind(target, value, env, declare=True)
+            return UNDEFINED
+        if kind == "funcdecl":
+            _, name, params, body, is_async = node
+            env.declare(name, JSFunction(name, params, body, env, self, is_async))
+            return UNDEFINED
+        if kind == "if":
+            _, test, then, alt = node
+            if js_truthy(self.eval(test, env)):
+                return self.exec_stmt(then, Environment(env))
+            if alt is not None:
+                return self.exec_stmt(alt, Environment(env))
+            return UNDEFINED
+        if kind == "block":
+            inner = Environment(env)
+            self._hoist(node[1], inner)
+            for stmt in node[1]:
+                self.exec_stmt(stmt, inner)
+            return UNDEFINED
+        if kind == "for":
+            _, init, test, update, body = node
+            loop_env = Environment(env)
+            if init is not None:
+                self.exec_stmt(init, loop_env)
+            while test is None or js_truthy(self.eval(test, loop_env)):
+                try:
+                    self.exec_stmt(body, Environment(loop_env))
+                except _Break:
+                    break
+                except _Continue:
+                    pass
+                if update is not None:
+                    self.eval(update, loop_env)
+            return UNDEFINED
+        if kind == "forof":
+            _, _, target, iterable, body = node
+            for item in self._iterate(self.eval(iterable, env)):
+                inner = Environment(env)
+                self._bind(target, item, inner, declare=True)
+                try:
+                    self.exec_stmt(body, inner)
+                except _Break:
+                    break
+                except _Continue:
+                    continue
+            return UNDEFINED
+        if kind == "while":
+            _, test, body = node
+            while js_truthy(self.eval(test, env)):
+                try:
+                    self.exec_stmt(body, Environment(env))
+                except _Break:
+                    break
+                except _Continue:
+                    continue
+            return UNDEFINED
+        if kind == "return":
+            raise _Return(self.eval(node[1], env) if node[1] is not None
+                          else UNDEFINED)
+        if kind == "throw":
+            raise JSException(self.eval(node[1], env))
+        if kind == "break":
+            raise _Break()
+        if kind == "continue":
+            raise _Continue()
+        if kind == "try":
+            _, block, handler, finalizer = node
+            try:
+                self.exec_stmt(block, Environment(env))
+            except JSException as exc:
+                if handler is not None:
+                    param, hblock = handler
+                    inner = Environment(env)
+                    if param:
+                        inner.declare(param, exc.value)
+                    self.exec_stmt(hblock, inner)
+                elif finalizer is None:
+                    raise
+            finally:
+                if finalizer is not None:
+                    self.exec_stmt(finalizer, Environment(env))
+            return UNDEFINED
+        if kind == "empty":
+            return UNDEFINED
+        raise JSError(f"unsupported statement {kind}")
+
+    # -- expressions --------------------------------------------------------
+    def eval(self, node, env):
+        kind = node[0]
+        if kind == "lit":
+            return node[1]
+        if kind == "ident":
+            return env.get(node[1])
+        if kind == "this":
+            return env.get("this") if env.has("this") else UNDEFINED
+        if kind == "template":
+            _, parts, exprs = node
+            out = [parts[0]]
+            for expr, part in zip(exprs, parts[1:]):
+                out.append(js_str(self.eval(expr, env)))
+                out.append(part)
+            return "".join(out)
+        if kind == "array":
+            items = []
+            for element in node[1]:
+                if element[0] == "spread":
+                    items.extend(self._iterate(self.eval(element[1], env)))
+                else:
+                    items.append(self.eval(element, env))
+            return JSArray(items)
+        if kind == "object":
+            obj = JSObject()
+            for key_node, value_node in node[1]:
+                key = js_str(self.eval(key_node, env))
+                obj.set(key, self.eval(value_node, env))
+            return obj
+        if kind == "regexlit":
+            return JSRegex(node[1], node[2])
+        if kind == "arrow":
+            _, params, body, is_async = node
+            return JSFunction(None, params, body, env, self, is_async)
+        if kind == "funcexpr":
+            _, name, params, body, is_async = node
+            return JSFunction(name, params, body, env, self, is_async)
+        if kind == "assign":
+            return self._assign(node, env)
+        if kind == "update":
+            return self._update(node, env)
+        if kind == "ternary":
+            _, test, cons, alt = node
+            return self.eval(cons if js_truthy(self.eval(test, env)) else alt, env)
+        if kind == "and":
+            left = self.eval(node[1], env)
+            return self.eval(node[2], env) if js_truthy(left) else left
+        if kind == "or":
+            left = self.eval(node[1], env)
+            return left if js_truthy(left) else self.eval(node[2], env)
+        if kind == "nullish":
+            left = self.eval(node[1], env)
+            return self.eval(node[2], env) if left is NULL or left is UNDEFINED \
+                else left
+        if kind == "binary":
+            return self._binary(node[1], self.eval(node[2], env),
+                                self.eval(node[3], env))
+        if kind == "instanceof":
+            left = self.eval(node[1], env)
+            right = self.eval(node[2], env)
+            ctor_map = {"Date": JSDate, "Set": JSSet, "Array": JSArray,
+                        "Error": JSObject}
+            for name, pytype in ctor_map.items():
+                if right is self.global_env.vars.get(name):
+                    return isinstance(left, pytype)
+            return False
+        if kind == "unary":
+            op = node[1]
+            if op == "typeof":
+                try:
+                    value = self.eval(node[2], env)
+                except JSException:
+                    return "undefined"
+                return _typeof(value)
+            if op == "delete":
+                target = node[2]
+                if target[0] == "member":
+                    obj = self.eval(target[1], env)
+                    prop = js_str(self.eval(target[2], env))
+                    self._delete_prop(obj, prop)
+                    return True
+                return True
+            value = self.eval(node[2], env)
+            if op == "!":
+                return not js_truthy(value)
+            if op == "-":
+                return -js_number(value)
+            if op == "+":
+                return js_number(value)
+            if op == "void":
+                return UNDEFINED
+            if op == "~":
+                return float(~_to_int32(value))
+            raise JSError(f"unsupported unary {op}")
+        if kind == "member":
+            obj = self.eval(node[1], env)
+            prop = js_str(self.eval(node[2], env))
+            return self.get_property(obj, prop)
+        if kind == "call":
+            return self._call(node, env)
+        if kind == "new":
+            _, callee_node, arg_nodes = node
+            callee = self.eval(callee_node, env)
+            args = self._eval_args(arg_nodes, env)
+            return self._construct(callee, args)
+        if kind == "await":
+            value = self.eval(node[1], env)
+            if isinstance(value, JSPromise):
+                if value.error is not None:
+                    raise value.error
+                return value.value
+            return value
+        if kind == "comma":
+            self.eval(node[1], env)
+            return self.eval(node[2], env)
+        if kind == "spread":
+            raise JSError("spread outside call/array")
+        raise JSError(f"unsupported expression {kind}")
+
+    # -- helpers ------------------------------------------------------------
+    def _iterate(self, value):
+        if isinstance(value, JSArray):
+            return list(value.items)
+        if isinstance(value, JSSet):
+            return list(value)
+        if isinstance(value, str):
+            return list(value)
+        if isinstance(value, JSObject) and "length" in value.props:
+            length = int(js_number(value.get("length")))
+            return [value.get(js_str(float(i))) for i in range(length)]
+        if hasattr(value, "js_iterate"):
+            return list(value.js_iterate())
+        raise JSException(_make_error(
+            f"{js_str(value)} is not iterable", kind="TypeError"))
+
+    def _bind(self, target, value, env, declare):
+        kind = target[0]
+        if kind == "bind_ident":
+            if declare:
+                env.declare(target[1], value)
+            else:
+                env.set(target[1], value)
+            return
+        if kind == "bind_object":
+            for name, alias, default in target[1]:
+                item = self.get_property(value, name)
+                if item is UNDEFINED and default is not None:
+                    item = self.eval(default, env)
+                if declare:
+                    env.declare(alias, item)
+                else:
+                    env.set(alias, item)
+            return
+        if kind == "bind_array":
+            items = self._iterate(value)
+            for index, element in enumerate(target[1]):
+                if element is None:
+                    continue
+                item = items[index] if index < len(items) else UNDEFINED
+                self._bind(element, item, env, declare)
+            return
+        raise JSError(f"unsupported binding target {kind}")
+
+    def _assign(self, node, env):
+        _, op, left, right = node
+        if op != "=":
+            current = self.eval(left, env)
+            value = self._binary(op[0], current, self.eval(right, env))
+        else:
+            value = self.eval(right, env)
+        self._store(left, value, env)
+        return value
+
+    def _store(self, target, value, env):
+        kind = target[0]
+        if kind == "ident":
+            env.set(target[1], value)
+            return
+        if kind == "member":
+            obj = self.eval(target[1], env)
+            prop = js_str(self.eval(target[2], env))
+            self.set_property(obj, prop, value)
+            return
+        if kind == "array":
+            items = self._iterate(value)
+            for index, element in enumerate(target[1]):
+                item = items[index] if index < len(items) else UNDEFINED
+                self._store(element, item, env)
+            return
+        raise JSError(f"invalid assignment target {kind}")
+
+    def _update(self, node, env):
+        _, op, target, prefix = node
+        current = js_number(self.eval(target, env))
+        updated = current + (1 if op == "++" else -1)
+        self._store(target, updated, env)
+        return updated if prefix else current
+
+    def _binary(self, op, left, right):
+        if op == "+":
+            lprim = _to_primitive(left)
+            rprim = _to_primitive(right)
+            if isinstance(lprim, str) or isinstance(rprim, str):
+                return js_str(lprim) + js_str(rprim)
+            return js_number(lprim) + js_number(rprim)
+        if op == "-":
+            return js_number(left) - js_number(right)
+        if op == "*":
+            return js_number(left) * js_number(right)
+        if op == "/":
+            rnum = js_number(right)
+            lnum = js_number(left)
+            if rnum == 0:
+                if lnum == 0 or math.isnan(lnum):
+                    return math.nan
+                return math.inf if (lnum > 0) == (rnum >= 0) else -math.inf
+            return lnum / rnum
+        if op == "%":
+            rnum = js_number(right)
+            lnum = js_number(left)
+            if rnum == 0 or math.isnan(lnum) or math.isnan(rnum):
+                return math.nan
+            return math.fmod(lnum, rnum)
+        if op == "==":
+            return js_equals_loose(left, right)
+        if op == "!=":
+            return not js_equals_loose(left, right)
+        if op == "===":
+            return js_equals_strict(left, right)
+        if op == "!==":
+            return not js_equals_strict(left, right)
+        if op in ("<", ">", "<=", ">="):
+            lprim = _to_primitive(left, hint="number")
+            rprim = _to_primitive(right, hint="number")
+            if isinstance(lprim, str) and isinstance(rprim, str):
+                pass
+            else:
+                lprim, rprim = js_number(lprim), js_number(rprim)
+                if math.isnan(lprim) or math.isnan(rprim):
+                    return False
+            if op == "<":
+                return lprim < rprim
+            if op == ">":
+                return lprim > rprim
+            if op == "<=":
+                return lprim <= rprim
+            return lprim >= rprim
+        raise JSError(f"unsupported operator {op}")
+
+    def _eval_args(self, arg_nodes, env):
+        args = []
+        for arg in arg_nodes:
+            if arg[0] == "spread":
+                args.extend(self._iterate(self.eval(arg[1], env)))
+            else:
+                args.append(self.eval(arg, env))
+        return args
+
+    def _call(self, node, env):
+        _, callee_node, arg_nodes = node
+        this = UNDEFINED
+        if callee_node[0] == "member":
+            obj = self.eval(callee_node[1], env)
+            prop = js_str(self.eval(callee_node[2], env))
+            func = self.get_property(obj, prop)
+            this = obj
+        else:
+            func = self.eval(callee_node, env)
+        args = self._eval_args(arg_nodes, env)
+        return self.call_any(func, args, this,
+                             name=_callee_name(callee_node))
+
+    def call_any(self, func, args, this=UNDEFINED, name="<expr>"):
+        if isinstance(func, JSFunction):
+            return self.call_function(func, args, this)
+        if callable(func):
+            args = _trim_args(func, args)
+            return func(*args) if not _wants_this(func) else func(this, *args)
+        raise JSException(_make_error(f"{name} is not a function",
+                                      kind="TypeError"))
+
+    def call_function(self, func: JSFunction, args: List[Any], this):
+        self._call_depth += 1
+        if self._call_depth > 400:
+            self._call_depth -= 1
+            raise JSError("call depth limit exceeded (runaway recursion?)")
+        try:
+            env = Environment(func.closure)
+            env.declare("this", this)
+            index = 0
+            for param in func.params:
+                if param[0] == "rest":
+                    env.declare(param[1], JSArray(args[index:]))
+                    break
+                _, target, default = param
+                value = args[index] if index < len(args) else UNDEFINED
+                if value is UNDEFINED and default is not None:
+                    value = self.eval(default, env)
+                self._bind(target, value, env, declare=True)
+                index += 1
+            try:
+                body = func.body
+                if body[0] == "block":
+                    self._hoist(body[1], env)
+                    for stmt in body[1]:
+                        self.exec_stmt(stmt, env)
+                    result = UNDEFINED
+                else:
+                    self.exec_stmt(body, env)
+                    result = UNDEFINED
+            except _Return as ret:
+                result = ret.value
+            if func.is_async:
+                return JSPromise.resolve(result)
+            return result
+        except JSException as exc:
+            if func.is_async:
+                return JSPromise.reject(exc)
+            raise
+        finally:
+            self._call_depth -= 1
+
+    def _construct(self, callee, args):
+        ctor = getattr(callee, "js_construct", None)
+        if ctor is not None:
+            return ctor(*args)
+        if isinstance(callee, JSFunction):
+            this = JSObject()
+            result = self.call_function(callee, args, this)
+            return result if isinstance(result, JSObject) else this
+        raise JSError(f"cannot construct {js_str(callee)}")
+
+    # -- property access ----------------------------------------------------
+    def get_property(self, obj, prop):
+        if obj is UNDEFINED or obj is NULL:
+            raise JSException(_make_error(
+                f"cannot read properties of {js_str(obj)} (reading '{prop}')",
+                kind="TypeError"))
+        # host objects (DOM nodes etc.) implement their own protocol
+        getter = getattr(obj, "js_get", None)
+        if getter is not None:
+            return getter(prop)
+        if isinstance(obj, JSObject):
+            if prop in obj.props:
+                return obj.props[prop]
+            return UNDEFINED
+        if isinstance(obj, JSArray):
+            return self._array_property(obj, prop)
+        if isinstance(obj, str):
+            return self._string_property(obj, prop)
+        if isinstance(obj, float):
+            return self._number_property(obj, prop)
+        if isinstance(obj, JSDate):
+            method = getattr(obj, prop, None)
+            if method is None:
+                raise JSError(f"Date.{prop} is unsupported — extend tools/minijs.py")
+            return _native(lambda *a: _jsnum(method(*a)))
+        if isinstance(obj, JSSet):
+            if prop == "size":
+                return obj.size
+            if prop in ("add", "delete", "has"):
+                return _native(getattr(obj, prop))
+            raise JSError(f"Set.{prop} is unsupported")
+        if isinstance(obj, JSPromise):
+            if prop == "then":
+                return _native(lambda fn=None, *_: self._promise_then(obj, fn))
+            if prop == "catch":
+                return _native(lambda fn=None, *_: self._promise_catch(obj, fn))
+            if prop == "finally":
+                return _native(lambda fn=None, *_:
+                               (fn and self.call_any(fn, []), obj)[1])
+            raise JSError(f"Promise.{prop} is unsupported")
+        if isinstance(obj, JSRegex):
+            if prop == "test":
+                return _native(lambda s="": obj.compiled.search(js_str(s)) is not None)
+            if prop == "source":
+                return obj.source
+            raise JSError(f"RegExp.{prop} is unsupported")
+        if isinstance(obj, JSFunction) or callable(obj):
+            if prop == "name":
+                return getattr(obj, "name", "")
+            if prop == "call":
+                return _native(lambda this=UNDEFINED, *args:
+                               self.call_any(obj, list(args), this))
+            if prop == "apply":
+                return _native(lambda this=UNDEFINED, args=None:
+                               self.call_any(obj, list(args.items) if
+                                             isinstance(args, JSArray) else [],
+                                             this))
+            if prop == "bind":
+                return _native(lambda this=UNDEFINED, *pre: _native(
+                    lambda *args: self.call_any(obj, list(pre) + list(args), this)))
+            extra = getattr(obj, "js_props", None)
+            if extra is not None and prop in extra:
+                return extra[prop]
+            return UNDEFINED
+        if isinstance(obj, bool):
+            raise JSError(f"boolean has no property {prop!r}")
+        raise JSError(f"cannot read {prop!r} of {type(obj).__name__}")
+
+    def set_property(self, obj, prop, value):
+        setter = getattr(obj, "js_set", None)
+        if setter is not None:
+            setter(prop, value)
+            return
+        if isinstance(obj, JSObject):
+            obj.props[prop] = value
+            return
+        if isinstance(obj, JSArray):
+            if prop == "length":
+                length = int(js_number(value))
+                del obj.items[length:]
+                obj.items.extend([UNDEFINED] * (length - len(obj.items)))
+                return
+            try:
+                index = int(prop)
+            except ValueError:
+                raise JSError(f"cannot set array property {prop!r}")
+            while len(obj.items) <= index:
+                obj.items.append(UNDEFINED)
+            obj.items[index] = value
+            return
+        if isinstance(obj, (JSFunction,)) or callable(obj):
+            props = getattr(obj, "js_props", None)
+            if props is None:
+                try:
+                    obj.js_props = props = {}
+                except AttributeError:
+                    raise JSError("cannot set properties on this native function")
+            props[prop] = value
+            return
+        raise JSError(f"cannot set {prop!r} on {type(obj).__name__}")
+
+    def _delete_prop(self, obj, prop):
+        deleter = getattr(obj, "js_delete", None)
+        if deleter is not None:
+            deleter(prop)
+            return
+        if isinstance(obj, JSObject):
+            obj.props.pop(prop, None)
+            return
+        raise JSError(f"cannot delete {prop!r} on {type(obj).__name__}")
+
+    # -- promises -----------------------------------------------------------
+    def _promise_then(self, promise, on_fulfilled):
+        if promise.error is not None:
+            return promise
+        if on_fulfilled in (None, UNDEFINED, NULL):
+            return promise
+        try:
+            return JSPromise.resolve(self.call_any(on_fulfilled, [promise.value]))
+        except JSException as exc:
+            return JSPromise.reject(exc)
+
+    def _promise_catch(self, promise, on_rejected):
+        if promise.error is None:
+            return promise
+        if on_rejected in (None, UNDEFINED, NULL):
+            return promise
+        try:
+            return JSPromise.resolve(
+                self.call_any(on_rejected, [promise.error.value]))
+        except JSException as exc:
+            return JSPromise.reject(exc)
+
+    # -- array / string / number methods -------------------------------------
+    def _array_property(self, arr: JSArray, prop):
+        items = arr.items
+        if prop == "length":
+            return float(len(items))
+        try:
+            index = int(prop)
+            if 0 <= index < len(items):
+                return items[index]
+            if str(index) == prop:
+                return UNDEFINED
+        except ValueError:
+            pass
+        call = self.call_any
+
+        def method_map(fn, with_index=True):
+            def runner(callback, *_):
+                out = []
+                for i, item in enumerate(items):
+                    args = [item, float(i)] if with_index else [item]
+                    out.append(call(callback, args))
+                return fn(out)
+            return _native(runner)
+
+        table = {
+            "map": method_map(JSArray),
+            "forEach": method_map(lambda out: UNDEFINED),
+            "filter": _native(lambda cb, *_: JSArray(
+                [item for i, item in enumerate(items)
+                 if js_truthy(call(cb, [item, float(i)]))])),
+            "every": _native(lambda cb, *_: all(
+                js_truthy(call(cb, [item, float(i)]))
+                for i, item in enumerate(items))),
+            "some": _native(lambda cb, *_: any(
+                js_truthy(call(cb, [item, float(i)]))
+                for i, item in enumerate(items))),
+            "find": _native(lambda cb, *_: next(
+                (item for i, item in enumerate(items)
+                 if js_truthy(call(cb, [item, float(i)]))), UNDEFINED)),
+            "findIndex": _native(lambda cb, *_: float(next(
+                (i for i, item in enumerate(items)
+                 if js_truthy(call(cb, [item, float(i)]))), -1))),
+            "includes": _native(lambda target=UNDEFINED, *_: any(
+                js_equals_strict(item, target) for item in items)),
+            "indexOf": _native(lambda target=UNDEFINED, *_: float(next(
+                (i for i, item in enumerate(items)
+                 if js_equals_strict(item, target)), -1))),
+            "join": _native(lambda sep=",", *_: js_str(sep).join(
+                "" if item in (UNDEFINED, NULL) else js_str(item)
+                for item in items)),
+            "push": _native(lambda *args: (items.extend(args),
+                                           float(len(items)))[1]),
+            "pop": _native(lambda: items.pop() if items else UNDEFINED),
+            "shift": _native(lambda: items.pop(0) if items else UNDEFINED),
+            "unshift": _native(lambda *args: (items.__setitem__(
+                slice(0, 0), list(args)), float(len(items)))[1]),
+            "slice": _native(lambda start=0.0, end=None, *_: JSArray(
+                items[_slice_index(start, len(items)):
+                      _slice_index(end, len(items)) if end is not None
+                      else len(items)])),
+            "concat": _native(lambda *args: JSArray(
+                items + [x for arg in args for x in (
+                    arg.items if isinstance(arg, JSArray) else [arg])])),
+            "flat": _native(lambda *_: JSArray(
+                [x for item in items for x in (
+                    item.items if isinstance(item, JSArray) else [item])])),
+            "reverse": _native(lambda: (items.reverse(), arr)[1]),
+            "sort": _native(lambda cmp=None: self._array_sort(arr, cmp)),
+            "reduce": _native(lambda cb, *init: self._array_reduce(arr, cb, init)),
+            "splice": _native(lambda start=0.0, count=None, *new: JSArray(
+                _splice(items, start, count, list(new)))),
+        }
+        if prop in table:
+            return table[prop]
+        raise JSError(f"Array.{prop} is unsupported — extend tools/minijs.py")
+
+    def _array_sort(self, arr, cmp):
+        import functools as _ft
+
+        if cmp in (None, UNDEFINED, NULL):
+            arr.items.sort(key=js_str)
+        else:
+            arr.items.sort(key=_ft.cmp_to_key(
+                lambda a, b: -1 if js_number(self.call_any(cmp, [a, b])) < 0
+                else (1 if js_number(self.call_any(cmp, [a, b])) > 0 else 0)))
+        return arr
+
+    def _array_reduce(self, arr, callback, init):
+        items = list(arr.items)
+        if init:
+            acc = init[0]
+            start = 0
+        else:
+            if not items:
+                raise JSException(_make_error("reduce of empty array"))
+            acc = items[0]
+            start = 1
+        for i in range(start, len(items)):
+            acc = self.call_any(callback, [acc, items[i], float(i)])
+        return acc
+
+    def _string_property(self, text: str, prop):
+        if prop == "length":
+            return float(len(text))
+        try:
+            index = int(prop)
+            return text[index] if 0 <= index < len(text) else UNDEFINED
+        except ValueError:
+            pass
+        table = {
+            "slice": _native(lambda start=0.0, end=None, *_: text[
+                _slice_index(start, len(text)):
+                _slice_index(end, len(text)) if end is not None else len(text)]),
+            "split": _native(lambda sep=UNDEFINED, *_: JSArray(
+                list(text) if sep == "" else text.split(js_str(sep))
+                if sep is not UNDEFINED else [text])),
+            "replace": _native(lambda pat, repl, *_:
+                               self._string_replace(text, pat, repl)),
+            "includes": _native(lambda sub="", *_: js_str(sub) in text),
+            "startsWith": _native(lambda sub="", *_: text.startswith(js_str(sub))),
+            "endsWith": _native(lambda sub="", *_: text.endswith(js_str(sub))),
+            "indexOf": _native(lambda sub="", *_: float(text.find(js_str(sub)))),
+            "padStart": _native(lambda width=0.0, fill=" ", *_:
+                                text.rjust(int(js_number(width)), js_str(fill))),
+            "padEnd": _native(lambda width=0.0, fill=" ", *_:
+                              text.ljust(int(js_number(width)), js_str(fill))),
+            "toLowerCase": _native(lambda: text.lower()),
+            "toUpperCase": _native(lambda: text.upper()),
+            "trim": _native(lambda: text.strip()),
+            "charCodeAt": _native(lambda i=0.0: float(ord(text[int(i)]))
+                                  if 0 <= int(i) < len(text) else math.nan),
+            "charAt": _native(lambda i=0.0: text[int(i)]
+                              if 0 <= int(i) < len(text) else ""),
+            "repeat": _native(lambda count=0.0: text * int(js_number(count))),
+            "concat": _native(lambda *args: text + "".join(js_str(a) for a in args)),
+            "localeCompare": _native(lambda other="":
+                                     float((text > js_str(other)) -
+                                           (text < js_str(other)))),
+            "toString": _native(lambda: text),
+            "match": _native(lambda pat: self._string_match(text, pat)),
+        }
+        if prop in table:
+            return table[prop]
+        raise JSError(f"String.{prop} is unsupported — extend tools/minijs.py")
+
+    def _string_replace(self, text, pattern, replacement):
+        def substitute(match):
+            if callable(replacement) or isinstance(replacement, JSFunction):
+                return js_str(self.call_any(replacement, [match.group(0)]))
+            return js_str(replacement).replace("$&", match.group(0))
+
+        if isinstance(pattern, JSRegex):
+            count = 0 if pattern.global_ else 1
+            return pattern.compiled.sub(substitute, text, count=count)
+        needle = js_str(pattern)
+        if callable(replacement) or isinstance(replacement, JSFunction):
+            index = text.find(needle)
+            if index < 0:
+                return text
+            replaced = js_str(self.call_any(replacement, [needle]))
+            return text[:index] + replaced + text[index + len(needle):]
+        return text.replace(needle, js_str(replacement), 1)
+
+    def _string_match(self, text, pattern):
+        if not isinstance(pattern, JSRegex):
+            pattern = JSRegex(js_str(pattern), "")
+        if pattern.global_:
+            found = pattern.compiled.findall(text)
+            return JSArray(list(found)) if found else NULL
+        match = pattern.compiled.search(text)
+        if match is None:
+            return NULL
+        return JSArray([match.group(0)] + [g if g is not None else UNDEFINED
+                                           for g in match.groups()])
+
+    def _number_property(self, number: float, prop):
+        table = {
+            "toFixed": _native(lambda digits=0.0:
+                               f"{number:.{int(js_number(digits))}f}"),
+            "toString": _native(lambda *_: js_str(number)),
+            "toLocaleString": _native(lambda *_: f"{int(number):,}"
+                                      if number == int(number) else js_str(number)),
+        }
+        if prop in table:
+            return table[prop]
+        raise JSError(f"Number.{prop} is unsupported")
+
+    # -- globals ------------------------------------------------------------
+    def _setup_globals(self):
+        define = self.global_env.declare
+        call = self.call_any
+
+        console = JSObject({
+            "log": _native(lambda *args: print("[js]", *map(js_str, args))),
+            "warn": _native(lambda *args: print("[js:warn]", *map(js_str, args))),
+            "error": _native(lambda *args: print("[js:err]", *map(js_str, args))),
+        })
+        define("console", console)
+
+        define("JSON", JSObject({
+            "stringify": _native(lambda value=UNDEFINED, *_:
+                                 _json_stringify(value)),
+            "parse": _native(lambda text="": _json_parse(js_str(text))),
+        }))
+
+        define("Math", JSObject({
+            "max": _native(lambda *args: max((js_number(a) for a in args),
+                                             default=-math.inf)),
+            "min": _native(lambda *args: min((js_number(a) for a in args),
+                                             default=math.inf)),
+            "round": _native(lambda x=math.nan: float(math.floor(js_number(x) + 0.5))),
+            "floor": _native(lambda x=math.nan: float(math.floor(js_number(x)))),
+            "ceil": _native(lambda x=math.nan: float(math.ceil(js_number(x)))),
+            "abs": _native(lambda x=math.nan: abs(js_number(x))),
+            "random": _native(lambda: 0.42),    # deterministic for tests
+            "trunc": _native(lambda x=math.nan: float(int(js_number(x)))),
+            "pow": _native(lambda a=0.0, b=0.0: js_number(a) ** js_number(b)),
+            "sqrt": _native(lambda x=0.0: math.sqrt(js_number(x))),
+        }))
+
+        object_ctor = _native(lambda value=UNDEFINED: value
+                              if isinstance(value, JSObject) else JSObject())
+        object_ctor.js_props = {
+            "assign": _native(lambda target, *sources: _object_assign(
+                target, sources)),
+            "keys": _native(lambda obj=UNDEFINED: JSArray(
+                list(_own_keys(obj)))),
+            "values": _native(lambda obj=UNDEFINED: JSArray(
+                [self.get_property(obj, key) for key in _own_keys(obj)])),
+            "entries": _native(lambda obj=UNDEFINED: JSArray(
+                [JSArray([key, self.get_property(obj, key)])
+                 for key in _own_keys(obj)])),
+            "fromEntries": _native(lambda pairs=UNDEFINED: JSObject(
+                {js_str(p.items[0]): p.items[1]
+                 for p in self._iterate(pairs)})),
+        }
+        define("Object", object_ctor)
+
+        array_ctor = _native(lambda *args: JSArray(
+            [UNDEFINED] * int(args[0]) if len(args) == 1 and
+            isinstance(args[0], float) else list(args)))
+        array_ctor.js_construct = array_ctor
+        array_ctor.js_props = {
+            "isArray": _native(lambda value=UNDEFINED: isinstance(value, JSArray)),
+            "from": _native(lambda value=UNDEFINED, fn=None, *_: JSArray(
+                [call(fn, [item, float(i)]) for i, item in
+                 enumerate(self._iterate(value))] if fn not in (None, UNDEFINED)
+                else self._iterate(value))),
+        }
+        define("Array", array_ctor)
+
+        def date_ctor(*args):
+            if not args:
+                return JSDate.now()
+            if len(args) == 1:
+                arg = args[0]
+                if isinstance(arg, JSDate):
+                    return JSDate(arg.ms)
+                if isinstance(arg, str):
+                    return JSDate.parse(arg)
+                return JSDate(js_number(arg))
+            return JSDate.from_parts(*[js_number(a) for a in args])
+        date_obj = _native(lambda *args: JSDate.now().toISOString())
+        date_obj.js_construct = date_ctor
+        date_obj.js_props = {"now": _native(lambda: JSDate.now().ms)}
+        define("Date", date_obj)
+
+        set_obj = _native(lambda *_: JSSet())
+        set_obj.js_construct = lambda items=None, *_: JSSet(
+            self._iterate(items) if items not in (None, UNDEFINED, NULL) else [])
+        define("Set", set_obj)
+
+        def promise_all(values=UNDEFINED, *_):
+            out = []
+            for item in self._iterate(values):
+                promise = JSPromise.resolve(item)
+                if promise.error is not None:
+                    return promise
+                out.append(promise.value)
+            return JSPromise(value=JSArray(out))
+        promise_obj = _native(lambda *_: UNDEFINED)
+        promise_obj.js_props = {
+            "all": _native(promise_all),
+            "resolve": _native(lambda value=UNDEFINED: JSPromise.resolve(value)),
+            "reject": _native(lambda value=UNDEFINED: JSPromise.reject(
+                JSException(value))),
+        }
+        define("Promise", promise_obj)
+
+        def error_ctor(message=UNDEFINED):
+            return _make_error(js_str(message) if message is not UNDEFINED else "")
+        error_obj = _native(error_ctor)
+        error_obj.js_construct = error_ctor
+        define("Error", error_obj)
+        define("TypeError", error_obj)
+
+        define("String", _native(lambda value="": js_str(value)))
+        define("Number", _native(lambda value=0.0: js_number(value)))
+        define("Boolean", _native(lambda value=UNDEFINED: js_truthy(value)))
+        define("parseInt", _native(_parse_int))
+        define("parseFloat", _native(_parse_float))
+        define("isNaN", _native(lambda value=UNDEFINED:
+                                math.isnan(js_number(value))))
+        define("NaN", math.nan)
+        define("Infinity", math.inf)
+        define("encodeURIComponent", _native(_encode_uri_component))
+        define("decodeURIComponent", _native(_decode_uri_component))
+
+        # timers: recorded, never fired (the tests drive renders directly)
+        self.timers: List[Tuple[Any, float]] = []
+        define("setTimeout", _native(lambda fn=None, delay=0.0, *_:
+                                     (self.timers.append((fn, delay)),
+                                      float(len(self.timers)))[1]))
+        define("setInterval", _native(lambda fn=None, delay=0.0, *_:
+                                      (self.timers.append((fn, delay)),
+                                       float(len(self.timers)))[1]))
+        define("clearTimeout", _native(lambda *_: UNDEFINED))
+        define("clearInterval", _native(lambda *_: UNDEFINED))
+
+
+def _native(fn):
+    """Wrap a python callable as a JS-callable native function; JS-level
+    `undefined` padding for missing args is the python default values."""
+    try:
+        fn._js_native = True
+    except AttributeError:
+        pass    # bound methods reject attributes; the marker is advisory
+    return fn
+
+
+def _jsnum(value):
+    if isinstance(value, (int,)) and not isinstance(value, bool):
+        return float(value)
+    return value
+
+
+def _own_keys(obj):
+    if isinstance(obj, JSObject):
+        return list(obj.props.keys())
+    if isinstance(obj, JSArray):
+        return [js_str(float(i)) for i in range(len(obj.items))]
+    keys = getattr(obj, "js_keys", None)
+    if keys is not None:
+        return list(keys())
+    raise JSError(f"Object.keys on {type(obj).__name__} is unsupported")
+
+
+def _object_assign(target, sources):
+    for source in sources:
+        if source in (UNDEFINED, NULL):
+            continue
+        if isinstance(source, JSObject):
+            target.props.update(source.props)
+        else:
+            raise JSError("Object.assign source must be a plain object")
+    return target
+
+
+def _slice_index(value, length):
+    if value is None or value is UNDEFINED:
+        return length
+    index = int(js_number(value))
+    if index < 0:
+        index += length
+    return max(0, min(length, index))
+
+
+def _splice(items, start, count, new_items):
+    begin = _slice_index(start, len(items))
+    removal = len(items) - begin if count in (None, UNDEFINED) \
+        else max(0, int(js_number(count)))
+    removed = items[begin:begin + removal]
+    items[begin:begin + removal] = new_items
+    return removed
+
+
+def _parse_int(value="", base=10.0):
+    text = js_str(value).strip()
+    match = _re.match(r"[+-]?\d+", text)
+    if not match:
+        return math.nan
+    return float(int(match.group(0), int(js_number(base)) or 10))
+
+
+def _parse_float(value=""):
+    match = _re.match(r"[+-]?\d*\.?\d+(?:[eE][+-]?\d+)?", js_str(value).strip())
+    return float(match.group(0)) if match else math.nan
+
+
+def _encode_uri_component(value=""):
+    from urllib.parse import quote
+
+    return quote(js_str(value), safe="!'()*-._~")
+
+
+def _decode_uri_component(value=""):
+    from urllib.parse import unquote
+
+    return unquote(js_str(value))
+
+
+def _json_stringify(value):
+    def convert(v):
+        if v is UNDEFINED:
+            return None
+        if v is NULL:
+            return None
+        if isinstance(v, (bool, str)):
+            return v
+        if isinstance(v, float):
+            return int(v) if v == int(v) and abs(v) < 1e15 else v
+        if isinstance(v, JSArray):
+            return [convert(item) for item in v.items]
+        if isinstance(v, JSObject):
+            return {k: convert(val) for k, val in v.props.items()
+                    if val is not UNDEFINED}
+        if isinstance(v, JSSet):
+            return {}
+        if isinstance(v, JSDate):
+            return v.toISOString()
+        if callable(v):
+            return None
+        raise JSError(f"JSON.stringify: unsupported {type(v).__name__}")
+
+    if value is UNDEFINED:
+        return UNDEFINED
+    return json.dumps(convert(value), separators=(",", ":"))
+
+
+def _json_parse(text):
+    try:
+        doc = json.loads(text)
+    except (ValueError, TypeError) as exc:
+        raise JSException(_make_error(f"JSON.parse: {exc}", kind="SyntaxError"))
+
+    def convert(v):
+        if v is None:
+            return NULL
+        if isinstance(v, bool):
+            return v
+        if isinstance(v, (int, float)):
+            return float(v)
+        if isinstance(v, str):
+            return v
+        if isinstance(v, list):
+            return JSArray([convert(item) for item in v])
+        if isinstance(v, dict):
+            return JSObject({k: convert(val) for k, val in v.items()})
+        raise JSError("JSON.parse: unreachable")
+
+    return convert(doc)
+
+
+def _callee_name(node):
+    if node[0] == "ident":
+        return node[1]
+    if node[0] == "member" and node[2][0] == "lit":
+        return str(node[2][1])
+    return "<expr>"
+
+
+def _wants_this(func):
+    return getattr(func, "_js_wants_this", False)
+
+
+_ARITY_CACHE: Dict[Any, Optional[int]] = {}
+
+
+def _trim_args(func, args):
+    """JS ignores surplus arguments; python callables don't — trim to the
+    callable's max positional arity (None = has *args)."""
+    import inspect
+
+    key = getattr(func, "__wrapped__", func)
+    if key not in _ARITY_CACHE:
+        try:
+            params = inspect.signature(func).parameters.values()
+        except (TypeError, ValueError):
+            _ARITY_CACHE[key] = None
+        else:
+            if any(p.kind == p.VAR_POSITIONAL for p in params):
+                _ARITY_CACHE[key] = None
+            else:
+                _ARITY_CACHE[key] = sum(
+                    1 for p in params
+                    if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD))
+    arity = _ARITY_CACHE[key]
+    if arity is None or len(args) <= arity:
+        return args
+    return args[:arity]
+
+
+def _typeof(value):
+    if value is UNDEFINED:
+        return "undefined"
+    if isinstance(value, bool):
+        return "boolean"
+    if isinstance(value, float):
+        return "number"
+    if isinstance(value, str):
+        return "string"
+    if isinstance(value, JSFunction) or callable(value):
+        return "function"
+    return "object"
+
+
+def _to_primitive(value, hint="default"):
+    if isinstance(value, JSDate):
+        return value.toISOString() if hint == "default" else value.ms
+    if isinstance(value, (JSObject, JSArray, JSSet)):
+        return js_str(value)
+    return value
+
+
+def _to_int32(value):
+    number = js_number(value)
+    if math.isnan(number) or math.isinf(number):
+        return 0
+    return int(number) & 0xFFFFFFFF

@@ -109,6 +109,25 @@ def main() -> None:
 
         copy_attn.defvjp(copy_fwd, copy_bwd)
         T.flash_attention = lambda q, k, v, causal=True: copy_attn(q, k, v)
+    elif variant == "nomlp":
+        # MLP half → identity: step time drop = the MLP's share
+        T.TransformerLM.block_mlp_half = staticmethod(
+            lambda x, block, config: x)
+    elif variant == "nohead":
+        # LM head + CE replaced by a trivial trunk loss: the drop = the
+        # head matmul + softmax-CE share (fwd+bwd)
+        import jax.numpy as jnp
+
+        def loss_no_head(params, tokens, config, mesh=None):
+            x = T.TransformerLM.apply_trunk(params, tokens[:, :-1], config,
+                                            mesh=mesh)
+            return jnp.mean(jnp.square(x.astype(jnp.float32)))
+
+        T.TransformerLM.loss = staticmethod(loss_no_head)
+    elif variant.startswith("gqa:"):
+        # grouped-query attention point: n_kv_heads < n_heads through the
+        # native-GQA kernels (no expanded K/V copy)
+        kv_heads = int(variant.split(":")[1])
     elif variant == "remat":
         remat = True
     elif variant == "remat-mlp":
@@ -119,6 +138,8 @@ def main() -> None:
     model_config = dataclasses.replace(
         PRESETS[preset], remat=bool(remat),
         remat_policy="mlp" if remat == "mlp" else "block")
+    if variant.startswith("gqa:"):
+        model_config = dataclasses.replace(model_config, n_kv_heads=kv_heads)
     train_config = TrainConfig(batch_size=batch, seq_len=seq,
                                warmup_steps=2, total_steps=100)
     metrics = train_loop(model_config, train_config, mesh=None,
