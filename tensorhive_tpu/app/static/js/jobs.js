@@ -237,7 +237,7 @@ function segRowsHtml(kind, items) {
       <button class="ghost small danger" onclick="this.parentElement.remove()">✕</button>
     </div>`).join("") + `</div>
     <button class="ghost small" onclick="addSegRow('${kind}')">+ ${
-      kind === "env" ? "env var" : "parameter"}</button>`;
+      kind.startsWith("env") ? "env var" : "parameter"}</button>`;
 }
 function addSegRow(kind) {
   const div = document.createElement("div");
@@ -359,31 +359,108 @@ async function openTemplateDialog(jobId) {
     <input id="tt-options" class="kv" placeholder="{}">
     <div class="row" style="margin-top:1rem">
       <button class="primary" onclick="createTasksFromTemplate(${jobId})">Generate</button>
+      <button class="ghost" onclick="previewTemplateTasks(${jobId})">Preview &amp; edit</button>
       <button class="ghost" onclick="this.closest('dialog').close()">Cancel</button>
     </div>`;
   dialog.showModal();
 }
+function collectTemplateForm() {
+  const placements = document.getElementById("tt-placements").value
+    .split("\n").map(s => s.trim()).filter(Boolean).map(line => {
+      let address = "";
+      const at = line.indexOf("@");
+      if (at !== -1) { address = line.slice(at + 1); line = line.slice(0, at); }
+      const [hostname, chips] = line.split(":");
+      const p = { hostname: hostname.trim() };
+      if (address) p.address = address;
+      if (chips) p.chips = chips.split(",").map(s => parseInt(s.trim(), 10));
+      return p;
+    });
+  const optionsRaw = document.getElementById("tt-options").value.trim();
+  const body = {
+    template: document.getElementById("tt-template").value,
+    command: document.getElementById("tt-cmd").value,
+    placements };
+  if (optionsRaw) body.options = JSON.parse(optionsRaw);
+  return body;
+}
 async function createTasksFromTemplate(jobId) {
   try {
-    const placements = document.getElementById("tt-placements").value
-      .split("\n").map(s => s.trim()).filter(Boolean).map(line => {
-        let address = "";
-        const at = line.indexOf("@");
-        if (at !== -1) { address = line.slice(at + 1); line = line.slice(0, at); }
-        const [hostname, chips] = line.split(":");
-        const p = { hostname: hostname.trim() };
-        if (address) p.address = address;
-        if (chips) p.chips = chips.split(",").map(s => parseInt(s.trim(), 10));
-        return p;
-      });
-    const optionsRaw = document.getElementById("tt-options").value.trim();
-    const body = {
-      template: document.getElementById("tt-template").value,
-      command: document.getElementById("tt-cmd").value,
-      placements };
-    if (optionsRaw) body.options = JSON.parse(optionsRaw);
-    await api(`/jobs/${jobId}/tasks_from_template`, { json: body });
+    await api(`/jobs/${jobId}/tasks_from_template`, { json: collectTemplateForm() });
     document.getElementById("job-dialog").close();
     toast("tasks generated"); drawJobDetails();
   } catch (e) { toast(e.message, true); }
+}
+
+/* per-line interactive editing of generated tasks (reference
+   TaskCreate.vue:202-424: every auto-filled parameter is editable per task
+   line before creation; "static" parameters fan out to all lines) */
+async function previewTemplateTasks(jobId) {
+  try {
+    const specs = await api("/templates/preview", { json: collectTemplateForm() });
+    renderTemplatePreview(jobId, specs);
+  } catch (e) { toast(e.message, true); }
+}
+function renderTemplatePreview(jobId, specs) {
+  const dialog = document.getElementById("job-dialog");
+  const entries = obj => Object.entries(obj || {}).map(
+    ([name, value]) => ({ name, value }));
+  dialog.innerHTML = `<h3>Review generated tasks</h3>
+    <p class="muted">Every generated value is editable per line; nothing is
+      created until you confirm. Static parameters fan out to all lines.</p>
+    ${specs.map((spec, i) => `<div class="card tpl-line" data-line="${i}">
+      <b>line ${i} — ${esc(spec.hostname)}</b>
+      <input type="hidden" id="tp-host-${i}" value="${esc(spec.hostname)}">
+      <label>Command</label>
+      <input id="tp-cmd-${i}" class="kv" value="${esc(spec.command)}">
+      <label>Environment variables</label>
+      ${segRowsHtml(`env-${i}`, entries(spec.env))}
+      <label>Parameters</label>
+      ${segRowsHtml(`param-${i}`, entries(spec.params))}
+    </div>`).join("")}
+    <label>Static parameter <span class="muted">(same --name=value on every
+      line, reference staticParameters)</span></label>
+    <div class="row">
+      <input id="tp-static-name" class="kv" placeholder="name">
+      <input id="tp-static-value" class="kv" placeholder="value">
+      <button class="ghost small" onclick="applyStaticParameter(${specs.length})">
+        Add to all lines</button>
+    </div>
+    <div class="row" style="margin-top:1rem">
+      <button class="primary" onclick="createEditedTasks(${jobId}, ${specs.length})">
+        Create ${specs.length} task${specs.length === 1 ? "" : "s"}</button>
+      <button class="ghost" onclick="this.closest('dialog').close()">Cancel</button>
+    </div>`;
+}
+function applyStaticParameter(lines) {
+  const name = document.getElementById("tp-static-name").value.trim();
+  const value = document.getElementById("tp-static-value").value;
+  if (!name) return toast("static parameter needs a name", true);
+  for (let i = 0; i < lines; i++) {
+    addSegRow(`param-${i}`);
+    const rows = document.querySelectorAll(`#seg-param-${i} .seg-row`);
+    const row = rows[rows.length - 1];
+    row.querySelector('[data-field="name"]').value = name;
+    row.querySelector('[data-field="value"]').value = value;
+  }
+  toast(`added --${name} to ${lines} lines`);
+}
+async function createEditedTasks(jobId, lines) {
+  let created = 0;
+  for (let i = 0; i < lines; i++) {
+    try {
+      await api("/tasks", { json: {
+        jobId,
+        hostname: document.getElementById(`tp-host-${i}`).value,
+        command: document.getElementById(`tp-cmd-${i}`).value,
+        envVariables: collectSegRows(`env-${i}`),
+        parameters: collectSegRows(`param-${i}`) } });
+      created++;
+    } catch (e) { toast(`line ${i}: ${e.message}`, true); }
+  }
+  if (created) {
+    document.getElementById("job-dialog").close();
+    toast(`created ${created} task${created === 1 ? "" : "s"}`);
+    drawJobDetails();
+  }
 }

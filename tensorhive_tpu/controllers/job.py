@@ -175,25 +175,18 @@ def list_templates(context: RequestContext):
     return template_names()
 
 
-@route("/jobs/<int:job_id>/tasks_from_template", ["POST"],
-       summary="Generate the job's tasks from a distributed-launch template",
-       tag="jobs",
-       body=obj(required=["template", "command", "placements"],
-                template=s("string"),
-                command=s("string", minLength=1),
-                placements=arr(obj(required=["hostname"],
-                                   hostname=s("string"),
-                                   address=s("string"),
-                                   chips=arr(s("integer")))),
-                options=obj(extra=True)),
-       responses={201: arr(S.TASK)})
-def tasks_from_template(context: RequestContext, job_id: int):
-    """Body: ``{template, command, placements: [{hostname, address?, chips?}],
-    options?}`` — renders one task per process with auto-filled distributed
-    wiring (the server-side TaskCreate.vue engine, core/templates.py)."""
-    job = _get_or_404(job_id)
-    _assert_owner_or_admin(context, job)
-    data = context.json()  # required fields enforced by the route schema
+_TEMPLATE_BODY = obj(required=["template", "command", "placements"],
+                     template=s("string"),
+                     command=s("string", minLength=1),
+                     placements=arr(obj(required=["hostname"],
+                                        hostname=s("string"),
+                                        address=s("string"),
+                                        chips=arr(s("integer")))),
+                     options=obj(extra=True))
+
+
+def _render_from_request(data):
+    """Shared placement parsing + render for the generate/preview routes."""
     if not isinstance(data["placements"], list):
         raise ValidationError("placements must be a list of objects")
     placements = []
@@ -205,9 +198,38 @@ def tasks_from_template(context: RequestContext, job_id: int):
             address=p.get("address", ""),
             chips=p.get("chips"),
         ))
-    specs = render_template(
-        data["template"], data["command"], placements, data.get("options")
-    )
+    return render_template(
+        data["template"], data["command"], placements, data.get("options"))
+
+
+@route("/templates/preview", ["POST"],
+       summary="Render a template without creating tasks", tag="jobs",
+       body=_TEMPLATE_BODY,
+       responses={200: arr(obj(hostname=s("string"), command=s("string"),
+                               env=obj(extra=True), params=obj(extra=True)))})
+def preview_template(context: RequestContext):
+    """The interactive-editing step the reference's TaskCreate.vue offers
+    client-side (TaskCreate.vue:202-424): render the per-process specs so
+    the UI can show every generated env var/parameter as editable rows
+    before any task exists; the edited lines are then created through the
+    plain POST /tasks path."""
+    specs = _render_from_request(context.json())
+    return [{"hostname": spec.hostname, "command": spec.command,
+             "env": spec.env, "params": spec.params} for spec in specs]
+
+
+@route("/jobs/<int:job_id>/tasks_from_template", ["POST"],
+       summary="Generate the job's tasks from a distributed-launch template",
+       tag="jobs",
+       body=_TEMPLATE_BODY,
+       responses={201: arr(S.TASK)})
+def tasks_from_template(context: RequestContext, job_id: int):
+    """Body: ``{template, command, placements: [{hostname, address?, chips?}],
+    options?}`` — renders one task per process with auto-filled distributed
+    wiring (the server-side TaskCreate.vue engine, core/templates.py)."""
+    job = _get_or_404(job_id)
+    _assert_owner_or_admin(context, job)
+    specs = _render_from_request(context.json())
     tasks = []
     for spec in specs:
         task = Task(job_id=job.id, hostname=spec.hostname, command=spec.command).save()

@@ -90,6 +90,7 @@ UI_CALLS = {
     ("POST", "/jobs/<int:job_id>/execute"): "`/jobs/${id}/${action}`",
     ("POST", "/jobs/<int:job_id>/stop"): "`/jobs/${id}/stop`",
     ("GET", "/templates"): 'api("/templates")',
+    ("POST", "/templates/preview"): '"/templates/preview", { json: collectTemplateForm() }',
     ("POST", "/jobs/<int:job_id>/tasks_from_template"):
         "`/jobs/${jobId}/tasks_from_template`",
     ("PUT", "/jobs/<int:job_id>/enqueue"): '${queued ? "dequeue" : "enqueue"}',
