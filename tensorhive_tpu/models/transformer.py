@@ -91,6 +91,13 @@ PRESETS: Dict[str, TransformerConfig] = {
                                  n_layers=6, d_ff=4096, max_seq_len=2048),
     "1b": TransformerConfig(vocab_size=32_000, d_model=2048, n_heads=16,
                             n_layers=16, d_ff=5632, max_seq_len=4096),
+    # Llama-7B-class dims (BASELINE.json config 5: multi-slice 7B on
+    # 2x v5p-32). GQA-8 and SwiGLU d_ff match the Llama-2 generation; far
+    # too big to materialize on one chip or in CI — exercised at the shape
+    # level (eval_shape + tree_shardings) and by the multichip dryrun path
+    "7b": TransformerConfig(vocab_size=32_000, d_model=4096, n_heads=32,
+                            n_layers=32, d_ff=11_008, max_seq_len=4096,
+                            n_kv_heads=8, remat=True, remat_policy="mlp"),
 }
 
 

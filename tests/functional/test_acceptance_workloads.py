@@ -210,7 +210,7 @@ def test_config5_multislice_2x_v5p32(api, headers, cluster):
     """examples/multislice: one task per slice with megascale DCN wiring."""
     job, tasks = _make_job(
         api, headers, "llama-multislice", "multislice",
-        "python3 examples/multislice/train.py --preset 1b",
+        "python3 examples/multislice/train.py --preset 7b",
         [{"hostname": "v5p32-a0"}, {"hostname": "v5p32-b0"}])
     assert len(tasks) == 2
     for slice_id, task in enumerate(tasks):

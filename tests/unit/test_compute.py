@@ -756,6 +756,39 @@ def test_gqa_matches_manual_kv_expansion():
                                atol=1e-5, rtol=1e-5)
 
 
+def test_7b_preset_shapes_and_sharding_cover_every_param():
+    """The 7b preset (BASELINE config 5's model class) at the SHAPE level:
+    ~6.7B params, GQA-shrunk KV projections, and every parameter gets a
+    non-default sharding rule on a tp×fsdp mesh — nothing silently
+    replicates. No array is materialized (eval_shape only)."""
+    from tensorhive_tpu.parallel.mesh import make_mesh, tree_shardings
+
+    config = PRESETS["7b"]
+    assert config.kv_heads == 8 and config.d_head == 128
+    shapes = jax.eval_shape(
+        lambda key: TransformerLM.init(key, config), jax.random.PRNGKey(0))
+    n_params = sum(
+        int(np.prod(leaf.shape))
+        for leaf in jax.tree_util.tree_leaves(shapes))
+    # Llama-2-7B geometry is 6.74B at MHA; GQA-8 trims the KV projections
+    # by 32·2·4096·3072 ≈ 0.8B → ~5.93B
+    assert 5.8e9 < n_params < 6.1e9, n_params
+    block = shapes["blocks"][0]
+    assert block["wk"].shape == (4096, 8 * 128)     # GQA: 4x smaller than wq
+    assert block["wq"].shape == (4096, 32 * 128)
+
+    mesh = make_mesh(dp=1, fsdp=2, tp=2, sp=2)
+    shardings = tree_shardings(mesh, shapes)
+    flat = jax.tree_util.tree_flatten_with_path(shardings)[0]
+    replicated = [
+        "/".join(str(getattr(k, "key", getattr(k, "idx", k))) for k in path)
+        for path, sharding in flat
+        if sharding.spec == jax.sharding.PartitionSpec()
+        and "norm" not in str(path)       # rmsnorm scales replicate by design
+    ]
+    assert not replicated, f"unsharded 7b params: {replicated}"
+
+
 def test_gqa_flash_path_receives_unexpanded_kv(monkeypatch):
     """The trainer's flash path must hand the kernel KV at kv_heads — an
     expanded copy (jnp.repeat) would forfeit GQA's group× KV bandwidth
