@@ -288,16 +288,12 @@ class TransformerLM:
                 t, NamedSharding(mesh, P(("dp", "fsdp"), "sp", None)))
 
         def attend(q, k, v):
+            # GQA is native everywhere: the single-shard kernels and the
+            # flash-ring body both read KV head h // group through their
+            # BlockSpec index maps (no expanded K/V copy; the ring also
+            # rotates group× smaller KV blocks over ICI). The dense
+            # fallbacks expand internally.
             if sp_sharded:
-                if k.shape[2] != q.shape[2]:
-                    # the ring path still expands K/V head groups (its
-                    # rotating KV blocks assume k.shape == q.shape); the
-                    # single-shard kernels below are GQA-native — they read
-                    # KV head h // group through the BlockSpec index maps,
-                    # keeping the group× KV HBM-traffic saving
-                    group = q.shape[2] // k.shape[2]
-                    k = jnp.repeat(k, group, axis=2)
-                    v = jnp.repeat(v, group, axis=2)
                 return ring_attention(q, k, v, mesh=mesh, causal=True)
             if config.use_flash:
                 return flash_attention(q, k, v, causal=True)

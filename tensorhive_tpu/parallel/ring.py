@@ -243,8 +243,9 @@ def _flash_ring_bwd(axis_name, axis_size, causal, block_q, block_k, interpret,
             # rotated kv blocks would be dead weight on ICI
             dk_cur, dv_cur = _rotate([dk_cur, dv_cur], axis_name, axis_size)
     dq = _unbhsd(dq_acc, batch, heads).astype(q.dtype)
-    dk = _unbhsd(dk_cur, batch, heads).astype(k.dtype)
-    dv = _unbhsd(dv_cur, batch, heads).astype(v.dtype)
+    kv_heads = k.shape[2]               # GQA: dk/dv stay at KV width
+    dk = _unbhsd(dk_cur, batch, kv_heads).astype(k.dtype)
+    dv = _unbhsd(dv_cur, batch, kv_heads).astype(v.dtype)
     return dq, dk, dv
 
 
@@ -283,8 +284,29 @@ def ring_attention(
     seq_local = q.shape[1] // axis_size
     spec = P(batch_axes, axis_name, head_axis, None)
     block_q, block_k = default_blocks(seq_local)
-    if (_flash_ring_usable(seq_local, block_q, block_k)
-            and k.shape == q.shape and v.shape == q.shape):
+    # GQA rides the ring natively when the flash-ring body runs (the inner
+    # kernels read KV head h // group via their index maps), which also
+    # shrinks the rotating K/V blocks — group× less ICI traffic per step.
+    # The KV heads must still divide the head-sharding axis; otherwise (or
+    # on the dense fallback body, whose einsums assume equal head counts)
+    # expand K/V up front.
+    kv_heads = k.shape[2]
+    kv_compatible = (
+        v.shape == k.shape and k.shape[:2] == q.shape[:2]
+        and k.shape[3] == q.shape[3] and q.shape[2] % kv_heads == 0
+    )
+    heads_shardable = (
+        head_axis is None or head_axis not in mesh.axis_names
+        or kv_heads % mesh.shape[head_axis] == 0
+    )
+    use_flash = _flash_ring_usable(seq_local, block_q, block_k) and kv_compatible
+    if kv_heads != q.shape[2] and kv_compatible and (
+            not use_flash or not heads_shardable):
+        group = q.shape[2] // kv_heads
+        k = jnp.repeat(k, group, axis=2)
+        v = jnp.repeat(v, group, axis=2)
+        use_flash = _flash_ring_usable(seq_local, block_q, block_k)
+    if use_flash:
         interpret = jax.default_backend() != "tpu"
 
         def body(q, k, v):
