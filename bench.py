@@ -42,14 +42,16 @@ def _peak_tflops() -> float:
 
 
 def _run_config(preset: str, batch: int, seq_len: int, remat: bool,
-                steps: int, remat_policy: str = "block") -> dict:
+                steps: int, remat_policy: str = "block",
+                n_kv_heads=None) -> dict:
     import jax
 
     from tensorhive_tpu.models.transformer import PRESETS, train_flops_per_token
     from tensorhive_tpu.train import TrainConfig, train_loop
 
     model_config = dataclasses.replace(PRESETS[preset], remat=remat,
-                                       remat_policy=remat_policy)
+                                       remat_policy=remat_policy,
+                                       n_kv_heads=n_kv_heads)
     train_config = TrainConfig(batch_size=batch, seq_len=seq_len,
                                warmup_steps=2, total_steps=100)
     # sync_every>1: enqueue steps back-to-back like a real training loop —
@@ -85,6 +87,8 @@ def _run_config(preset: str, batch: int, seq_len: int, remat: bool,
         "loss": round(metrics["loss"], 4),
         "rejected_windows": int(metrics.get("rejected_windows", 0)),
     }
+    if n_kv_heads is not None:
+        result["n_kv_heads"] = n_kv_heads
     _log(f"  {result}")
     return result
 
@@ -141,7 +145,12 @@ def bench_train() -> dict:
     # The dense path cannot hold the [B,H,4096,4096] score matrix at any
     # batch size; logits at b8×s4096 still fit, so chunked CE is not engaged
     long_seq = _try_config("t2t-big", 8, 4096, True, 6, remat_policy="mlp")
-    return {"best": best, "sweep": sweep, "big": big, "long_seq": long_seq}
+    # grouped-query point: same model with 4x fewer KV heads through the
+    # native-GQA kernels (KV head h // group via the BlockSpec index maps,
+    # no expanded copy) — records the kernel-level GQA win in the artifact
+    gqa = _try_config("t2t-base", 64, 1024, False, 9, n_kv_heads=2)
+    return {"best": best, "sweep": sweep, "big": big, "long_seq": long_seq,
+            "gqa": gqa}
 
 
 def bench_generate():
@@ -290,6 +299,12 @@ def main() -> None:
              for k in ("preset", "batch", "tokens_per_sec_per_chip", "mfu",
                        "step_time_ms")}
             if train.get("long_seq") else None
+        ),
+        "gqa_kv2": (
+            {k: train["gqa"][k]
+             for k in ("batch", "n_kv_heads", "tokens_per_sec_per_chip",
+                       "mfu", "step_time_ms")}
+            if train.get("gqa") else None
         ),
         "generate": generate,
         "telemetry_poll_p50_ms": round(poll_p50_ms, 2) if poll_p50_ms is not None else None,

@@ -50,13 +50,16 @@ def test_steady_time_falls_back_to_partial_windows():
 
 # -- per-config fault isolation (bench.py) -----------------------------------
 
-def _fake_result(preset, batch, seq_len, remat, *_, **__):
-    return {
+def _fake_result(preset, batch, seq_len, remat, *_, **kwargs):
+    result = {
         "preset": preset, "batch": batch, "seq_len": seq_len, "remat": remat,
         "step_time_ms": 100.0, "tokens_per_sec_per_chip": 1000.0 * batch,
         "steps_per_sec_per_chip": 10.0, "mfu": 0.3, "loss": 10.0,
         "rejected_windows": 0,
     }
+    if kwargs.get("n_kv_heads") is not None:
+        result["n_kv_heads"] = kwargs["n_kv_heads"]
+    return result
 
 
 def test_try_config_retries_then_gives_up(monkeypatch):
@@ -96,7 +99,7 @@ def test_main_emits_valid_json_despite_midsweep_failure(monkeypatch, capsys):
         if preset == "t2t-big" and seq_len == 1024:
             raise RuntimeError("http://127.0.0.1:8103/remote_compile: "
                                "read body: response body closed")
-        return _fake_result(preset, batch, seq_len, remat)
+        return _fake_result(preset, batch, seq_len, remat, **kwargs)
 
     monkeypatch.setattr(bench, "_run_config", run_config)
     monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
