@@ -242,6 +242,98 @@ def test_template_preview_per_line_editing(ui):
     assert "--process_id=1" in tasks[1].full_command
 
 
+def test_nodes_dashboard_renders_telemetry_and_sysfs_warning(ui, config):
+    """The dashboard executed against real telemetry: a fake cluster feeds
+    the real probe-parse → monitor → infra → /nodes/metrics path; the
+    rendered cards must show per-chip utilization, the busy process, and
+    the loud sysfs-absent warning badge on the blind host."""
+    from tensorhive_tpu.config import HostConfig
+    from tensorhive_tpu.core.managers.manager import TpuHiveManager, set_manager
+    from tensorhive_tpu.core.monitors.tpu import TpuMonitor
+    from tensorhive_tpu.core.transport.base import TransportManager, register_backend
+    from tensorhive_tpu.core.transport.fake import FakeCluster, FakeTransport
+
+    cluster = FakeCluster()
+    register_backend(
+        "fake", lambda host, user=None, config=None: FakeTransport(host, cluster, user))
+    for name in ("vm-a", "vm-b"):
+        config.hosts[name] = HostConfig(name=name, user="hive", backend="fake",
+                                        accelerator_type="v5litepod-8", chips=2)
+        cluster.add_host(name, chips=2)
+    cluster.host("vm-a").chips[0].update(
+        hbm_used_bytes=8 * 2**30, hbm_total_bytes=16 * 2**30,
+        duty_cycle_pct=87.5)
+    cluster.start_process("vm-a", user="alice", command="python train.py",
+                          chip_ids=[0])
+    cluster.host("vm-b").sysfs_status = "absent"
+
+    manager = TpuHiveManager(config=config, services=[])
+    set_manager(manager)
+    try:
+        transports = TransportManager(config)
+        TpuMonitor().update(transports, manager.infrastructure_manager)
+        transports.close()
+
+        login(ui)
+        ui.interp.eval_expr("go('nodes')")
+        nodes_el = ui.page.by_id("nodes")
+        html = nodes_el.js_get("innerHTML")
+        assert "vm-a" in html and "vm-b" in html
+        assert "87.5" in html, "duty cycle missing from the chip card"
+        assert "alice" in html, "busy process owner missing"
+        # the blind host wears the warning badge; the healthy one does not
+        cards = query_all(ui.page.root, "#nodes .card")
+        by_host = {card.text_content: card for card in cards}
+        a_card = next(c for t, c in by_host.items() if "vm-a" in t)
+        b_card = next(c for t, c in by_host.items() if "vm-b" in t)
+        assert "sysfs_absent" in b_card.text_content
+        assert "sysfs_absent" not in a_card.text_content
+    finally:
+        set_manager(None)
+
+
+def test_access_view_restriction_and_schedule_flow(ui):
+    """The access admin view executed: create a weekday schedule through
+    its dialog (checkbox day mask), create a restriction, attach the
+    schedule and a chip through the apply controls — asserting the DB rows
+    and link tables the reference's restriction admin produces."""
+    from tensorhive_tpu.db.models.restriction import Restriction
+    from tensorhive_tpu.db.models.schedule import RestrictionSchedule as Schedule
+
+    login(ui)
+    ui.interp.eval_expr("go('access')")
+
+    # schedule: weekdays via the day-mask checkboxes, 09:00-17:30
+    ui.interp.eval_expr("openScheduleDialog(null)")
+    for node in query_all(ui.page.root, ".sd-day"):
+        if node.attrs.get("value") in ("6", "7"):
+            node.checked_override = False
+    ui.page.by_id("sd-start").js_set("value", "09:00")
+    ui.page.by_id("sd-end").js_set("value", "17:30")
+    ui.interp.eval_expr("saveSchedule(null)")
+    schedules = Schedule.all()
+    assert len(schedules) == 1
+    assert schedules[0].schedule_days == "12345"
+    assert str(schedules[0].hour_start)[:5] == "09:00"
+
+    # restriction: named, non-global, then attach schedule + chip
+    ui.interp.eval_expr("openRestrictionDialog(null)")
+    ui.page.by_id("rs-name").js_set("value", "weekday crew")
+    ui.interp.eval_expr("saveRestriction(null)")
+    rows = Restriction.all()
+    assert len(rows) == 1 and rows[0].name == "weekday crew"
+    assert not rows[0].is_global
+    rid, sid = rows[0].id, schedules[0].id
+    ui.interp.eval_expr(f"restrictionApply({rid}, 'schedules', {sid})")
+    ui.interp.eval_expr(f"restrictionApply({rid}, 'resources', 'vm-0:tpu:1')")
+    restriction = Restriction.get(rid)
+    assert [s.id for s in restriction.schedules] == [sid]
+    assert [r.uid for r in restriction.resources] == ["vm-0:tpu:1"]
+    # and removal through the same UI path
+    ui.interp.eval_expr(f"restrictionRemove({rid}, 'resources', 'vm-0:tpu:1')")
+    assert Restriction.get(rid).resources == []
+
+
 def _auth_headers(ui):
     token = js_str(ui.interp.eval_expr("state.access"))
     return {"Authorization": f"Bearer {token}"}
