@@ -433,9 +433,13 @@ function renderTemplatePreview(jobId, specs) {
     </div>`;
 }
 function applyStaticParameter(lines) {
-  const name = document.getElementById("tp-static-name").value.trim();
+  let name = document.getElementById("tp-static-name").value.trim();
   const value = document.getElementById("tp-static-value").value;
   if (!name) return toast("static parameter needs a name", true);
+  // parameter names carry their dashes in full_command (the template
+  // engine generates "--coordinator_address" etc.) — normalize bare names
+  // so the fanned-out flag really reaches the command line as --name=value
+  if (!name.startsWith("-")) name = "--" + name;
   for (let i = 0; i < lines; i++) {
     addSegRow(`param-${i}`);
     const rows = document.querySelectorAll(`#seg-param-${i} .seg-row`);
@@ -443,10 +447,11 @@ function applyStaticParameter(lines) {
     row.querySelector('[data-field="name"]').value = name;
     row.querySelector('[data-field="value"]').value = value;
   }
-  toast(`added --${name} to ${lines} lines`);
+  toast(`added ${name} to ${lines} lines`);
 }
 async function createEditedTasks(jobId, lines) {
   let created = 0;
+  const failures = [];
   for (let i = 0; i < lines; i++) {
     try {
       await api("/tasks", { json: {
@@ -456,11 +461,16 @@ async function createEditedTasks(jobId, lines) {
         envVariables: collectSegRows(`env-${i}`),
         parameters: collectSegRows(`param-${i}`) } });
       created++;
-    } catch (e) { toast(`line ${i}: ${e.message}`, true); }
+    } catch (e) { failures.push(`line ${i}: ${e.message}`); }
   }
-  if (created) {
-    document.getElementById("job-dialog").close();
-    toast(`created ${created} task${created === 1 ? "" : "s"}`);
-    drawJobDetails();
+  if (failures.length) {
+    // keep the dialog (and the failed lines' edits) alive; a success toast
+    // here would overwrite the error and silently lose work
+    toast(`created ${created}/${lines} — ${failures.join("; ")} ` +
+          `(failed lines kept for editing)`, true);
+    return;
   }
+  document.getElementById("job-dialog").close();
+  toast(`created ${created} task${created === 1 ? "" : "s"}`);
+  drawJobDetails();
 }

@@ -224,8 +224,9 @@ def test_template_preview_per_line_editing(ui):
     edited_env_name = name_node.value
     value_node.value = "EDITED"
 
-    # static parameter fans out to every line (reference staticParameters)
-    ui.page.by_id("tp-static-name").js_set("value", "--seed")
+    # static parameter fans out to every line (reference staticParameters);
+    # a bare name is normalized to --name so the flag reaches the command
+    ui.page.by_id("tp-static-name").js_set("value", "seed")
     ui.page.by_id("tp-static-value").js_set("value", "42")
     ui.interp.eval_expr("applyStaticParameter(2)")
 
@@ -240,6 +241,32 @@ def test_template_preview_per_line_editing(ui):
     # line 0's untouched wiring still matches the engine
     assert "--process_id=0" in tasks[0].full_command
     assert "--process_id=1" in tasks[1].full_command
+
+
+def test_template_preview_partial_failure_keeps_edits(ui):
+    """A line whose creation fails must not cost the user their edits: the
+    dialog stays open with the rows intact and the toast reports the
+    partial result instead of a false success."""
+    from tensorhive_tpu.db.models.task import Task
+
+    login(ui)
+    job = ui.client.post("/api/jobs", json={"name": "partial"},
+                         headers=_auth_headers(ui)).get_json()
+    job_id = job["id"]
+    ui.interp.eval_expr("go('jobs')")
+    ui.interp.eval_expr(f"openTemplateDialog({job_id})")
+    ui.page.by_id("tt-placements").js_set("value", "vm-0:0\nvm-1:1")
+    ui.interp.eval_expr(f"previewTemplateTasks({job_id})")
+    ui.page.by_id("tp-cmd-1").js_set("value", "python3 edited.py")
+    ui.page.by_id("tp-host-1").js_set("value", "")     # breaks line 1 only
+
+    ui.interp.eval_expr(f"createEditedTasks({job_id}, 2)")
+    assert len(Task.filter_by(job_id=job_id)) == 1     # line 0 created
+    dialog = ui.page.by_id("job-dialog")
+    assert dialog.node.dialog_open, "dialog closed despite a failed line"
+    assert ui.page.by_id("tp-cmd-1").js_get("value") == "python3 edited.py"
+    toast_text = ui.page.by_id("toast").js_get("textContent")
+    assert "1/2" in toast_text and "line 1" in toast_text
 
 
 def test_nodes_dashboard_renders_telemetry_and_sysfs_warning(ui, config):
