@@ -361,6 +361,55 @@ def test_access_view_restriction_and_schedule_flow(ui):
     assert Restriction.get(rid).resources == []
 
 
+def test_job_lifecycle_from_ui_spawns_and_stops_processes(ui, config):
+    """The whole job flow driven from the UI: create a job through its
+    dialog, add a task through the task dialog (host picker fed by
+    /nodes/hostnames), run it — a fake-cluster process must come alive and
+    the redrawn view show it running — then stop it gracefully."""
+    from tensorhive_tpu.config import HostConfig
+    from tensorhive_tpu.core.managers.manager import TpuHiveManager, set_manager
+    from tensorhive_tpu.core.nursery import set_ops_factory
+    from tensorhive_tpu.core.transport.fake import FakeCluster, FakeOpsFactory
+    from tensorhive_tpu.db.models.job import Job, JobStatus
+
+    cluster = FakeCluster()
+    config.hosts["vm-9"] = HostConfig(name="vm-9", user="hive", backend="fake")
+    cluster.add_host("vm-9", chips=4)
+    set_ops_factory(FakeOpsFactory(cluster))
+    manager = TpuHiveManager(config=config, services=[])
+    set_manager(manager)
+    try:
+        login(ui)
+        ui.interp.eval_expr("go('jobs')")
+        ui.interp.eval_expr("openJobDialog()")
+        ui.page.by_id("jd-name").js_set("value", "ui-driven run")
+        ui.interp.eval_expr("createJob()")
+        jobs = Job.all()
+        assert len(jobs) == 1 and jobs[0].name == "ui-driven run"
+        job_id = jobs[0].id
+
+        ui.interp.eval_expr(f"openTaskCreateDialog({job_id})")
+        assert ui.page.by_id("td-host").js_get("value") == "vm-9"
+        ui.page.by_id("td-cmd").js_set("value", "python3 train.py")
+        ui.page.by_id("td-chips").js_set("value", "0,1")
+        ui.interp.eval_expr(f"createTask({job_id})")
+        assert len(Job.get(job_id).tasks) == 1
+
+        ui.interp.eval_expr(f"jobAction({job_id}, 'execute')")
+        host = cluster.host("vm-9")
+        alive = [p for p in host.processes.values() if p.alive]
+        assert len(alive) == 1 and "python3 train.py" in alive[0].command
+        assert Job.get(job_id).status is JobStatus.running
+        assert "running" in ui.page.by_id("job-list").js_get("innerHTML")
+
+        ui.interp.eval_expr(f"jobStop({job_id})")
+        assert not [p for p in host.processes.values() if p.alive]
+        assert Job.get(job_id).status is not JobStatus.running
+    finally:
+        set_manager(None)
+        set_ops_factory(None)
+
+
 def _auth_headers(ui):
     token = js_str(ui.interp.eval_expr("state.access"))
     return {"Authorization": f"Bearer {token}"}
