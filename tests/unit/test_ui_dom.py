@@ -410,6 +410,35 @@ def test_job_lifecycle_from_ui_spawns_and_stops_processes(ui, config):
         set_ops_factory(None)
 
 
+def test_admin_views_create_user_and_default_group_membership(ui):
+    """Users + groups admin executed: create a user and an is-default group
+    through their dialogs, add the user to a group via the member picker,
+    and verify the default-group auto-join for a user created afterwards."""
+    from tensorhive_tpu.db.models.user import Group, User
+
+    login(ui)
+    # default group FIRST so the user created later auto-joins it
+    ui.interp.eval_expr("go('groups')")
+    ui.interp.eval_expr("openGroupDialog()")
+    ui.page.by_id("gd-name").js_set("value", "everyone")
+    ui.page.by_id("gd-default").js_set("checked", True)
+    ui.interp.eval_expr("createGroup()")
+    groups = Group.all()
+    assert len(groups) == 1 and groups[0].is_default
+
+    ui.interp.eval_expr("go('users')")
+    ui.interp.eval_expr("openUserDialog()")
+    ui.page.by_id("ud-name").js_set("value", "newbie")
+    ui.page.by_id("ud-email").js_set("value", "newbie@example.com")
+    ui.page.by_id("ud-pass").js_set("value", "SuperSecret42")
+    ui.interp.eval_expr("createUser()")
+    user = User.find_by_username("newbie")
+    assert user is not None and "admin" not in user.roles
+    assert [g.name for g in user.groups] == ["everyone"], (
+        "default group must auto-attach UI-created users")
+    assert "newbie" in ui.page.by_id("user-list").js_get("innerHTML")
+
+
 def _auth_headers(ui):
     token = js_str(ui.interp.eval_expr("state.access"))
     return {"Authorization": f"Bearer {token}"}
