@@ -439,6 +439,48 @@ def test_admin_views_create_user_and_default_group_membership(ui):
     assert "newbie" in ui.page.by_id("user-list").js_get("innerHTML")
 
 
+def test_reservation_details_edit_and_usage_card(ui):
+    """Event click → details dialog → edit and delete, plus the usage
+    accounting card: a finished reservation with persisted averages must
+    appear in the last-7-days table with its recorded utilization."""
+    from datetime import datetime, timedelta
+
+    from tensorhive_tpu.db.models.reservation import Reservation
+
+    login(ui)
+    now_utc = datetime(2026, 8, 1, 10, 0)          # == the frozen JS clock
+    finished = Reservation(
+        title="yesterday run", resource_id="vm-0:tpu:0", user_id=1,
+        start=now_utc - timedelta(days=1, hours=3),
+        end=now_utc - timedelta(days=1),
+        duty_cycle_avg=77.5, hbm_util_avg=61.0).save()
+    upcoming = Reservation(
+        title="tomorrow run", resource_id="vm-0:tpu:1", user_id=1,
+        start=now_utc + timedelta(days=1),
+        end=now_utc + timedelta(days=1, hours=2)).save()
+
+    ui.interp.eval_expr("go('calendar')")
+    usage_html = ui.page.by_id("usage-card").js_get("innerHTML")
+    assert "yesterday run" in usage_html
+    assert "77.5%" in usage_html and "61" in usage_html
+    assert "tomorrow run" not in usage_html        # not finished
+
+    # details dialog on the upcoming event: edit the title, save, re-check
+    ui.interp.eval_expr(f"openReservationDetails({upcoming.id})")
+    dialog = ui.page.by_id("res-dialog")
+    assert dialog.node.dialog_open
+    assert ui.page.by_id("rd-title").js_get("value") == "tomorrow run"
+    ui.page.by_id("rd-title").js_set("value", "renamed run")
+    ui.interp.eval_expr(f"saveReservation({upcoming.id})")
+    assert Reservation.get(upcoming.id).title == "renamed run"
+
+    # and delete it through the dialog path
+    ui.interp.eval_expr(f"openReservationDetails({upcoming.id})")
+    ui.interp.eval_expr(f"deleteReservation({upcoming.id})")
+    remaining = {r.id for r in Reservation.all()}
+    assert upcoming.id not in remaining and finished.id in remaining
+
+
 def _auth_headers(ui):
     token = js_str(ui.interp.eval_expr("state.access"))
     return {"Authorization": f"Bearer {token}"}
