@@ -25,6 +25,31 @@ log = logging.getLogger(__name__)
 EligibleHostsFn = Callable[[Job], Optional[Set[str]]]
 
 
+def expand_to_slice_uids(uids) -> Set[str]:
+    """Expand claimed chip uids to their WHOLE slices.
+
+    A TPU slice runs one SPMD program across all its chips (SURVEY.md §7
+    "chip vs slice granularity": the reference matched single GPU UUIDs;
+    a v5e-16 slice is 4 VMs × 4 chips acting as one device). A job that
+    claims any chip of a named slice therefore contends with every
+    reservation anywhere on that slice — scheduling it next to a foreign
+    reservation on a sibling chip would wedge both workloads. Slice
+    membership comes from the schema-v3 Resource columns; chips without a
+    slice label behave exactly as before."""
+    from ..db.models.resource import Resource
+
+    expanded: Set[str] = set(uids)
+    seen_slices: Set[str] = set()
+    for uid in uids:
+        row = Resource.get_by_uid(uid)
+        if row is None or not row.slice_name or row.slice_name in seen_slices:
+            continue
+        seen_slices.add(row.slice_name)
+        expanded.update(member.uid for member in
+                        Resource.get_by_slice(row.slice_name))
+    return expanded
+
+
 class Scheduler:
     """Strategy: pick queued jobs to launch given per-chip free windows."""
 
@@ -93,9 +118,21 @@ def chip_free_minutes(
 
 
 class GreedyScheduler(Scheduler):
-    """First-come-first-served over the queue in enqueue order."""
+    """First-come-first-served over the queue in enqueue order.
+
+    ``slice_exclusive`` (default): each job's chip claims are expanded to
+    whole slices before the free-window check and before marking chips
+    taken, so one scheduling round never lands two jobs — or a job and a
+    foreign reservation — on the same slice."""
 
     HORIZON_MINS = 24 * 60.0
+
+    def __init__(self, slice_exclusive: bool = True) -> None:
+        self.slice_exclusive = slice_exclusive
+
+    def _claimed(self, job: Job) -> Set[str]:
+        uids = set(job.chip_uids)
+        return expand_to_slice_uids(uids) if self.slice_exclusive else uids
 
     def schedule_jobs(
         self,
@@ -107,14 +144,15 @@ class GreedyScheduler(Scheduler):
         at = at or utcnow()
         taken: set = set()
         chosen: List[Job] = []
-        all_uids = {uid for job in queued_jobs for uid in job.chip_uids}
+        claims = {job.id: self._claimed(job) for job in queued_jobs}
+        all_uids = {uid for claim in claims.values() for uid in claim}
         # one reservation query for the whole round, however many jobs/chips
         events = upcoming_events_by_chip(all_uids, self.HORIZON_MINS, at=at) \
             if all_uids else {}
         for job in queued_jobs:
             if not self._hosts_eligible(job, eligible_hosts):
                 continue
-            uids = job.chip_uids
+            uids = claims[job.id]
             if not uids:
                 # no chip claims (CPU-only job): the host-eligibility gate
                 # above is the whole check — reference launches chip-less

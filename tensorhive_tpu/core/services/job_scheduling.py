@@ -20,7 +20,7 @@ from ...db.models.reservation import Reservation
 from ...db.models.user import User
 from ...utils.exceptions import NotFoundError, TpuHiveError
 from ...utils.timeutils import minutes_between, utcnow
-from ..scheduling import GreedyScheduler, Scheduler
+from ..scheduling import GreedyScheduler, Scheduler, expand_to_slice_uids
 from .base import Service
 
 # imported at module scope (not inside tick methods): lazy imports on the
@@ -122,8 +122,11 @@ class JobSchedulingService(Service):
     # -- helpers -------------------------------------------------------------
     def _reservation_imminent(self, job: Job, now) -> bool:
         """A reservation by someone else is active or starts within the
-        required-free window on any chip the job holds."""
-        for uid in job.chip_uids:
+        required-free window on any chip the job holds — or on any sibling
+        chip of a slice the job runs on (one SPMD program per slice: a
+        foreign reservation anywhere on it preempts, core/scheduling.py
+        expand_to_slice_uids)."""
+        for uid in expand_to_slice_uids(job.chip_uids):
             current = Reservation.current_for_resource(uid, at=now)
             if current is not None and current.user_id != job.user_id:
                 return True
@@ -182,7 +185,7 @@ class JobSchedulingService(Service):
             owner = User.get(job.user_id).username
         except NotFoundError:
             return False
-        for uid in job.chip_uids:
+        for uid in expand_to_slice_uids(job.chip_uids):
             hostname = self.infrastructure_manager.find_chip_hostname(uid)
             if hostname is None:
                 continue

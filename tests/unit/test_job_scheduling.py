@@ -184,6 +184,76 @@ def test_expired_timed_window_does_not_spawn(service, owner, cluster, db):
     assert cluster.host("vm-0").processes == {}
 
 
+def _slice_resources(count=4, slice_name="team-slice"):
+    return [make_resource(hostname="vm-0", index=i, slice_name=slice_name,
+                          topology="2x2", num_chips=count)
+            for i in range(count)]
+
+
+def test_queue_blocks_job_when_slice_sibling_reserved(service, owner, cluster, db):
+    """Slice-aware scheduling (schema v3 columns): a foreign reservation on
+    ANY chip of a slice blocks queued jobs claiming any OTHER chip of the
+    same slice — a slice runs one SPMD program, co-tenanting would wedge
+    both workloads."""
+    _slice_resources()
+    stranger = make_user(username="strngr", password="SuperSecret42")
+    make_reservation(stranger, chip_uid("vm-0", 3), start_in_h=-0.5, duration_h=2)
+    job = make_job(owner)
+    make_task(job, hostname="vm-0", chips=[0])     # different chip, same slice
+    job.enqueue()
+    service.do_run()
+    assert Job.get(job.id).status is not JobStatus.running
+    assert Job.get(job.id).is_queued
+
+
+def test_queue_unlabeled_chips_not_slice_coupled(service, owner, cluster, db):
+    """Chips without a slice label keep per-chip semantics: a reservation on
+    a sibling chip of the same HOST does not block."""
+    _chip_resources(db, count=4)                   # no slice_name
+    stranger = make_user(username="strngr", password="SuperSecret42")
+    make_reservation(stranger, chip_uid("vm-0", 3), start_in_h=-0.5, duration_h=2)
+    job = make_job(owner)
+    make_task(job, hostname="vm-0", chips=[0])
+    job.enqueue()
+    service.do_run()
+    assert Job.get(job.id).status is JobStatus.running
+
+
+def test_one_slice_one_job_per_round(service, cluster, db):
+    """Two queued jobs claiming DIFFERENT chips of one slice: only the
+    first launches this round (the whole slice is marked taken)."""
+    _slice_resources()
+    make_permissive_restriction()
+    first_owner = make_user(username="first", password="SuperSecret42")
+    second_owner = make_user(username="second", password="SuperSecret42")
+    job_a = make_job(first_owner)
+    make_task(job_a, hostname="vm-0", chips=[0])
+    job_b = make_job(second_owner)
+    make_task(job_b, hostname="vm-0", chips=[2])
+    job_a.enqueue()
+    job_b.enqueue()
+    service.do_run()
+    assert Job.get(job_a.id).status is JobStatus.running
+    assert Job.get(job_b.id).status is not JobStatus.running
+
+
+def test_preemption_when_slice_sibling_reserved(service, owner, cluster, db):
+    """A queue-launched job is preempted when a foreign reservation becomes
+    active on a slice sibling of its chips."""
+    _slice_resources()
+    job = make_job(owner)
+    make_task(job, hostname="vm-0", chips=[0])
+    job.enqueue()
+    service.do_run()
+    assert Job.get(job.id).status is JobStatus.running
+
+    stranger = make_user(username="strngr", password="SuperSecret42")
+    make_reservation(stranger, chip_uid("vm-0", 2), start_in_h=-0.1, duration_h=2)
+    service.do_run()
+    assert Job.get(job.id).status is not JobStatus.running
+    assert all(not p.alive for p in cluster.host("vm-0").processes.values())
+
+
 def test_timed_stop_and_stubborn_escalation(service, owner, cluster, db):
     job = make_job(owner, start_at=utcnow() - timedelta(hours=1),
                    stop_at=utcnow() - timedelta(minutes=1))
