@@ -121,6 +121,69 @@ def init(username: str, email: str, password: str) -> None:
 
 
 @main.command()
+@click.option("--all", "fleet", is_flag=True,
+              help="probe every configured host over its transport")
+def chips(fleet: bool) -> None:
+    """Live chip telemetry table — the ``tpu-info``/``nvidia-smi`` analog
+    (reference operators shell out to nvidia-smi; here the native probe
+    reports chips, holders and utilization in one round-trip)."""
+    from .config import HostConfig, get_config
+    from .core.monitors.probe import parse_probe_output, probe_command
+    from .core.transport.base import TransportManager
+    from .core.transport.local import LocalTransport
+    from .utils.exceptions import TpuHiveError
+
+    command = probe_command()
+    if fleet:
+        config = get_config()
+        if not config.hosts:
+            click.echo("no hosts configured")
+            return
+        results = TransportManager(config).run_on_all(command)
+        outputs = {host: (r.stdout if r.ok else None)
+                   for host, r in results.items()}
+    else:
+        result = LocalTransport(HostConfig(name="localhost", backend="local")).run(
+            command, timeout=30)
+        outputs = {"localhost": result.stdout if result.ok else None}
+
+    header = (f"{'host':<14} {'chip':<5} {'duty%':>6} {'hbm':>14} "
+              f"{'holders':<24} sysfs")
+    click.echo(header)
+    click.echo("-" * len(header))
+    exit_code = 0
+    for host in sorted(outputs):
+        text = outputs[host]
+        if text is None:
+            click.echo(f"{host:<14} UNREACHABLE")
+            exit_code = 1
+            continue
+        try:
+            sample = parse_probe_output(text)
+        except TpuHiveError as exc:
+            click.echo(f"{host:<14} probe error: {exc}")
+            exit_code = 1
+            continue
+        if not sample.chips:
+            click.echo(f"{host:<14} no accelerator devices")
+            continue
+        for chip in sample.chips:
+            duty = ("-" if chip.duty_cycle_pct is None
+                    else f"{chip.duty_cycle_pct:.1f}")
+            if chip.hbm_used_bytes is not None and chip.hbm_total_bytes:
+                hbm = (f"{chip.hbm_used_bytes // 2**20}/"
+                       f"{chip.hbm_total_bytes // 2**20} MiB")
+            else:
+                hbm = "-"
+            holders = ",".join(
+                f"{pid}({sample.procs.get(pid, {}).get('user', '?')})"
+                for pid in chip.pids) or "-"
+            click.echo(f"{host:<14} {chip.index:<5} {duty:>6} {hbm:>14} "
+                       f"{holders:<24} {sample.sysfs_status}")
+    sys.exit(exit_code)
+
+
+@main.command()
 def key() -> None:
     """Print the manager public key users must add to authorized_keys
     (reference cli.py:218-243)."""

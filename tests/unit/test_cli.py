@@ -29,6 +29,41 @@ def test_init_bootstraps_configs_admin_and_global_restriction(db, config):
     assert any(r.is_global for r in Restriction.all())
 
 
+def test_chips_fleet_table(db, config):
+    """`tpuhive chips --all`: probes every configured host and renders the
+    live chip table (duty, HBM, holder pids/users, sysfs status) from the
+    real probe-JSON parse path."""
+    from tensorhive_tpu.config import HostConfig
+    from tensorhive_tpu.core.transport.base import register_backend
+    from tensorhive_tpu.core.transport.fake import FakeCluster, FakeTransport
+
+    cluster = FakeCluster()
+    register_backend(
+        "fake", lambda host, user=None, config=None: FakeTransport(host, cluster, user))
+    config.hosts["vm-0"] = HostConfig(name="vm-0", user="hive", backend="fake",
+                                      accelerator_type="v5litepod-8", chips=2)
+    cluster.add_host("vm-0", chips=2)
+    cluster.host("vm-0").chips[1].update(
+        hbm_used_bytes=2 * 2**30, hbm_total_bytes=16 * 2**30,
+        duty_cycle_pct=42.0)
+    proc = cluster.start_process("vm-0", user="bob", command="python t.py",
+                                 chip_ids=[1])
+    result = CliRunner().invoke(main, ["chips", "--all"])
+    assert result.exit_code == 0, result.output
+    lines = [line for line in result.output.splitlines() if line.startswith("vm-0")]
+    assert len(lines) == 2
+    assert "42.0" in lines[1] and "2048/16384 MiB" in lines[1]
+    assert f"{proc.pid}(bob)" in lines[1]
+    assert lines[1].rstrip().endswith("ok")
+    assert lines[0].rstrip().endswith("ok")     # idle chip, no holders
+
+
+def test_chips_local_without_accelerators(db, config):
+    result = CliRunner().invoke(main, ["chips"])
+    assert result.exit_code == 0, result.output
+    assert "localhost" in result.output
+
+
 def test_create_user_noninteractive(db, config):
     runner = CliRunner()
     result = runner.invoke(main, [
