@@ -203,6 +203,8 @@ function openChipDialog(uid, host) {
       meta.textContent = [
         resource && resource.acceleratorType,
         resource && resource.sliceName && `slice ${resource.sliceName}`,
+        resource && resource.topology &&
+          `${resource.topology} (${resource.numChips} chips)`,
         inv.name,
       ].filter(Boolean).join(" · ") || "no inventory metadata";
     }
