@@ -1,0 +1,142 @@
+"""Semantic pins for tools/minijs.py — the ES-subset interpreter that
+executes the UI in CI. Each case is a place where JS semantics differ from
+python's and a naive interpreter would silently diverge; the UI tests
+depend on these staying exact.
+"""
+import sys
+from pathlib import Path
+
+import pytest
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[2]))
+
+from tools.minijs import Interpreter, JSError, js_str                # noqa: E402
+
+
+@pytest.fixture()
+def run():
+    interp = Interpreter()
+    return lambda src: interp.eval_expr(src)
+
+
+def test_number_formatting_drops_integral_float_suffix(run):
+    assert js_str(run("1 + 2")) == "3"
+    assert js_str(run("0.5 + 0.25")) == "0.75"
+    assert js_str(run("`${48 * 22}px`")) == "1056px"
+
+
+def test_plus_coerces_like_js(run):
+    assert run("'id-' + 7") == "id-7"
+    assert run("1 + '2'") == "12"
+    assert run("true + 1") == 2.0
+
+
+def test_loose_vs_strict_equality(run):
+    assert run("0 == ''") is True
+    assert run("0 === ''") is False
+    assert run("null == undefined") is True
+    assert run("null === undefined") is False
+    assert run("NaN === NaN") is False
+
+
+def test_truthiness_table(run):
+    assert run("!!''") is False
+    assert run("!!0") is False
+    assert run("!!null") is False
+    assert run("!![]") is True          # empty array is truthy in JS
+    assert run("!!({})") is True
+
+
+def test_nullish_vs_or(run):
+    assert run("0 || 5") == 5.0         # || treats 0 as falsy
+    assert run("0 ?? 5") == 0.0         # ?? only replaces null/undefined
+    assert run("null ?? 5") == 5.0
+
+
+def test_short_circuit_returns_operand_value(run):
+    assert run("'a' && 'b'") == "b"
+    assert run("'' || 'fallback'") == "fallback"
+
+
+def test_date_month_overflow_normalizes(run):
+    # the month-view navigation depends on exact MakeDay normalization
+    assert run("new Date(2026, 12, 1).toISOString()").startswith("2027-01-01")
+    assert run("new Date(2026, -1, 1).toISOString()").startswith("2025-12-01")
+    assert run(
+        "(() => { const d = new Date(2027, 0, 1); d.setMonth(d.getMonth() - 1);"
+        " return d.toISOString(); })()").startswith("2026-12-01")
+
+
+def test_date_arithmetic_coerces_to_ms(run):
+    assert run("new Date(2026, 0, 2) - new Date(2026, 0, 1)") == 86400000.0
+    assert run("+new Date(1000)") == 1000.0
+    assert run("new Date(new Date(2026, 0, 1) - -864e5).getDate()") == 2.0
+
+
+def test_getday_is_sunday_zero(run):
+    assert run("new Date(2026, 7, 1).getDay()") == 6.0     # Sat Aug 1 2026
+    assert run("new Date(2026, 7, 2).getDay()") == 0.0     # Sunday
+
+
+def test_template_literals_nest(run):
+    assert run("`a${[1, 2].map(i => `<${i}>`).join('')}b`") == "a<1><2>b"
+
+
+def test_destructuring_with_holes_and_defaults(run):
+    assert run("(([, second]) => second)(['x', 'y'])") == "y"
+    assert run("((value = 9) => value)()") == 9.0
+    assert run("(() => { const {a, b = 4} = {a: 3}; return a + b; })()") == 7.0
+
+
+def test_array_sort_default_is_lexicographic(run):
+    assert js_str(run("[10, 9, 1].sort()")) == "1,10,9"
+    assert js_str(run("[10, 9, 1].sort((a, b) => a - b)")) == "1,9,10"
+
+
+def test_set_preserves_insertion_order(run):
+    assert js_str(run("[...new Set(['b', 'a', 'b', 'c'])]")) == "b,a,c"
+
+
+def test_json_roundtrip_drops_undefined_props(run):
+    assert run("JSON.stringify({a: 1, b: undefined})") == '{"a":1}'
+    assert run("JSON.parse('{\"x\": 2}').x") == 2.0
+
+
+def test_async_await_and_promise_chain_are_sync_resolved(run):
+    assert run(
+        "(async () => { const v = await Promise.resolve(3); return v + 1; })()"
+    ).value == 4.0
+    assert run(
+        "(() => { let seen = null;"
+        " Promise.reject(new Error('boom')).catch(e => seen = e.message);"
+        " return seen; })()") == "boom"
+
+
+def test_regex_replace_with_function(run):
+    assert run(
+        "'a&b<c'.replace(/[&<]/g, ch => ({'&': 'AMP', '<': 'LT'}[ch]))"
+    ) == "aAMPbLTc"
+
+
+def test_surplus_arguments_are_ignored(run):
+    assert run("((a) => a)(1, 2, 3)") == 1.0
+    assert run("parseInt('42', 10, 'extra')") == 42.0
+
+
+def test_unsupported_construct_fails_loudly():
+    interp = Interpreter()
+    with pytest.raises(JSError, match="unsupported construct 'class'"):
+        interp.run("class Foo {}", "<t>")
+    with pytest.raises(JSError, match="for-in"):
+        interp.run("for (const k in obj) {}", "<t>")
+
+
+def test_exceptions_carry_js_error_objects(run):
+    assert run(
+        "(() => { try { null.x; } catch (e) { return e.message; } })()"
+    ).startswith("cannot read properties of null")
+
+
+def test_increment_and_compound_assignment(run):
+    assert run("(() => { let n = 5; n++; n += 2; return n; })()") == 8.0
+    assert run("(() => { let n = 5; return n++; })()") == 5.0   # postfix value
