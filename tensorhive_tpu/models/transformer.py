@@ -68,6 +68,11 @@ class TransformerConfig:
     #: n_heads/n_kv_heads; Q heads share K/V heads in groups.
     n_kv_heads: Optional[int] = None
 
+    #: pipeline microbatches when the mesh has pp > 1 (0 = one per stage);
+    #: more microbatches shrink the (pp-1)/(M+pp-1) bubble at the cost of
+    #: smaller per-step matmuls
+    pp_microbatches: int = 0
+
     @property
     def d_head(self) -> int:
         assert self.d_model % self.n_heads == 0
@@ -273,6 +278,15 @@ class TransformerLM:
 
         sp_sharded = mesh is not None and "sp" in getattr(mesh, "axis_names", ()) \
             and mesh.shape["sp"] > 1
+        from ..parallel.pipeline import pp_enabled
+
+        if pp_enabled(mesh):
+            if sp_sharded:
+                raise NotImplementedError(
+                    "pp and sp cannot both exceed 1 yet: ring attention's "
+                    "shard_map cannot nest inside the pipeline's")
+            return TransformerLM._apply_trunk_pipelined(
+                params, x, positions, config, mesh)
 
         def pin(t):
             # pin activations to their canonical sharding between blocks:
@@ -325,6 +339,35 @@ class TransformerLM:
         for block in params["blocks"]:
             x = block_fn(x, block)
 
+        return _rmsnorm(x, params["final_norm"]["scale"])
+
+    @staticmethod
+    def _apply_trunk_pipelined(params, x, positions,
+                               config: TransformerConfig, mesh) -> jax.Array:
+        """Blocks as a ``pp``-stage GPipe pipeline (parallel/pipeline.py):
+        stage params are the per-layer dicts stacked and sharded over the
+        pp axis; dp/fsdp/tp stay automatic inside each stage, so the flash
+        kernels and megatron splits run exactly as in the unpipelined
+        path. sp is gated off (its shard_map can't nest inside the
+        pipeline's)."""
+        from ..parallel.pipeline import pipeline_apply, stack_blocks
+
+        def attend(q, k, v):
+            if config.use_flash:
+                return flash_attention(q, k, v, causal=True)
+            from ..ops.flash_attention import reference_attention
+
+            return reference_attention(q, k, v, causal=True)
+
+        def apply_layer(block, x_mb, pos_mb):
+            return TransformerLM.block_forward(x_mb, block, config, pos_mb,
+                                               attend)
+
+        if config.remat:
+            apply_layer = jax.checkpoint(apply_layer)
+        x = pipeline_apply(
+            stack_blocks(params["blocks"]), x, positions, mesh, apply_layer,
+            num_microbatches=config.pp_microbatches)
         return _rmsnorm(x, params["final_norm"]["scale"])
 
     @staticmethod

@@ -7,9 +7,12 @@ The canonical 4-axis mesh for transformer training on TPU pods:
   the default scaling axis within a slice,
 * ``tp``   — tensor (megatron) parallelism over heads/ffn columns; keep
   within a chip's nearest ICI neighbors,
-* ``sp``   — sequence/context parallelism (ring attention over shard_map).
+* ``sp``   — sequence/context parallelism (ring attention over shard_map),
+* ``pp``   — pipeline parallelism over layer stages (parallel/pipeline.py);
+  point-to-point activation handoff per microbatch, so it tolerates the
+  slowest links — outermost, like dp.
 
-Axis order is outermost→innermost = slowest→fastest collectives: dp rides
+Axis order is outermost→innermost = slowest→fastest collectives: pp/dp ride
 DCN, fsdp/tp/sp ride ICI (the "How to Scale Your Model" recipe: pick a mesh,
 annotate shardings, let XLA insert the collectives).
 """
@@ -23,7 +26,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-AXES = ("dp", "fsdp", "tp", "sp")
+AXES = ("pp", "dp", "fsdp", "tp", "sp")
 
 
 def make_mesh(
@@ -31,12 +34,13 @@ def make_mesh(
     fsdp: int = -1,
     tp: int = 1,
     sp: int = 1,
+    pp: int = 1,
     devices: Optional[Sequence] = None,
 ) -> Mesh:
     """Build a Mesh over ``devices`` (default: all). One axis may be -1 to
     absorb the remaining device count (like a reshape)."""
     devices = list(devices if devices is not None else jax.devices())
-    sizes = {"dp": dp, "fsdp": fsdp, "tp": tp, "sp": sp}
+    sizes = {"pp": pp, "dp": dp, "fsdp": fsdp, "tp": tp, "sp": sp}
     unknown = [axis for axis, size in sizes.items() if size == -1]
     known = math.prod(size for size in sizes.values() if size != -1)
     if len(unknown) > 1:

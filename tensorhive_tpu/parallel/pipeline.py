@@ -1,0 +1,132 @@
+"""Pipeline parallelism: transformer stages across the ``pp`` mesh axis.
+
+GPipe-style SPMD pipeline, formulated the TPU-idiomatic way (one program,
+no per-stage processes): ``jax.shard_map`` is manual over ONLY the ``pp``
+axis (``axis_names={"pp"}``) — dp/fsdp/tp stay automatic, so the per-stage
+compute (flash-attention pallas kernels included) keeps its GSPMD
+partitioning. Stage parameters are the per-layer block pytree stacked on a
+leading layer dim and sharded ``P("pp", ...)``: each rank holds
+``n_layers / pp`` contiguous layers and scans over them.
+
+Schedule: the batch splits into M microbatches; for ``M + pp - 1`` steps
+every rank applies its stage to the activation it currently holds and
+hands the result to the next rank with ``lax.ppermute``; rank 0 injects
+microbatch ``t`` at step ``t``, the last rank emits finished microbatches
+into an accumulator that a final ``psum`` replicates (every other rank
+contributes zeros). The pipeline bubble is the standard
+``(pp - 1) / (M + pp - 1)`` — raise ``num_microbatches`` to shrink it.
+Autodiff flows straight through ``scan`` + ``ppermute`` (validated against
+the unpipelined model in tests/unit/test_compute.py).
+
+The reference has no counterpart (SURVEY.md §2.6: the reference templates
+launch topology only); this is compute-stack capability beyond it.
+"""
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+#: apply one layer: (one_layer_params, x [mb, L, D], positions [mb, L]) -> x
+LayerFn = Callable
+
+
+def stack_blocks(blocks):
+    """Per-layer list of param dicts → one pytree with leading [n_layers]
+    dim (what the pipeline shards over ``pp``). In-graph stacking keeps the
+    stored checkpoint layout unchanged; XLA lowers it to a reshard onto the
+    stage owners. (A natively layer-stacked param store would skip that
+    gather — noted for when pp goes to real pods.)"""
+    return jax.tree_util.tree_map(lambda *leaves: jnp.stack(leaves), *blocks)
+
+
+def pipeline_microbatches(batch: int, mesh: Mesh,
+                          requested: int = 0) -> int:
+    """Microbatch count: the requested value, else one per stage; must
+    divide the (global) batch."""
+    pp = mesh.shape["pp"]
+    count = requested or pp
+    if batch % count:
+        raise ValueError(
+            f"batch {batch} not divisible by {count} pipeline microbatches")
+    return count
+
+
+def pipeline_apply(
+    stacked_blocks,
+    x: jax.Array,                    # [B, L, D]
+    positions: jax.Array,            # [B, L] int32
+    mesh: Mesh,
+    apply_layer: LayerFn,
+    num_microbatches: int = 0,
+) -> jax.Array:
+    """Run the stacked transformer blocks as a ``pp``-stage pipeline.
+
+    ``apply_layer`` receives ONE layer's params (a pytree slice) and a
+    microbatch; wrap it in ``jax.checkpoint`` on the caller side for remat.
+    Activations AND positions travel the ring together so every stage sees
+    the microbatch's own positions.
+    """
+    pp = mesh.shape["pp"]
+    batch, seq_len, d_model = x.shape
+    n_layers = jax.tree_util.tree_leaves(stacked_blocks)[0].shape[0]
+    if n_layers % pp:
+        raise ValueError(f"{n_layers} layers not divisible by pp={pp}")
+    num_mb = pipeline_microbatches(batch, mesh, num_microbatches)
+    mb = batch // num_mb
+
+    # stage params: leading layer dim sharded over pp — P("pp") splits the
+    # stacked dim so each rank's body sees [n_layers/pp, ...] leaves, with
+    # the remaining dims left to the automatic axes (fsdp/tp)
+    stage_spec = jax.tree_util.tree_map(
+        lambda leaf: P(*(("pp",) + (None,) * (leaf.ndim - 1))), stacked_blocks)
+
+    def body(stage_blocks, x, positions):
+        rank = jax.lax.axis_index("pp")
+        x_mb = x.reshape(num_mb, mb, seq_len, d_model)
+        pos_mb = positions.reshape(num_mb, mb, seq_len)
+        ring = [(i, (i + 1) % pp) for i in range(pp)]
+
+        def apply_stage(x_one, pos_one):
+            def one_layer(carry, layer_params):
+                return apply_layer(layer_params, carry, pos_one), None
+            out, _ = jax.lax.scan(one_layer, x_one, stage_blocks)
+            return out
+
+        def step(carry, t):
+            recv_x, recv_pos, acc = carry
+            index = jnp.minimum(t, num_mb - 1)
+            cur_x = jnp.where(rank == 0, x_mb[index], recv_x)
+            cur_pos = jnp.where(rank == 0, pos_mb[index], recv_pos)
+            out = apply_stage(cur_x, cur_pos)
+            send_x = jax.lax.ppermute(out, "pp", ring)
+            send_pos = jax.lax.ppermute(cur_pos, "pp", ring)
+            emit = t - (pp - 1)
+            acc = jnp.where(
+                (rank == pp - 1) & (emit >= 0),
+                acc.at[jnp.maximum(emit, 0)].set(out), acc)
+            return (send_x, send_pos, acc), None
+
+        varying = lambda v: jax.lax.pcast(v, ("pp",), to="varying")  # noqa: E731
+        carry = (varying(jnp.zeros_like(x_mb[0])),
+                 varying(jnp.zeros_like(pos_mb[0])),
+                 varying(jnp.zeros_like(x_mb)))
+        (_, _, acc), _ = jax.lax.scan(step, carry,
+                                      jnp.arange(num_mb + pp - 1))
+        # only the last rank's accumulator is nonzero; psum replicates it
+        return jax.lax.psum(acc, "pp").reshape(batch, seq_len, d_model)
+
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(stage_spec, P(), P()),
+        out_specs=P(),
+        axis_names={"pp"},
+    )(stacked_blocks, x, positions)
+
+
+def pp_enabled(mesh: Optional[Mesh]) -> bool:
+    return (mesh is not None and "pp" in getattr(mesh, "axis_names", ())
+            and mesh.shape["pp"] > 1)
