@@ -39,7 +39,7 @@ TINY = PRESETS["tiny"]
 
 def test_make_mesh_shapes():
     mesh = make_mesh(dp=1, fsdp=2, tp=2, sp=2)
-    assert dict(mesh.shape) == {"dp": 1, "fsdp": 2, "tp": 2, "sp": 2}
+    assert dict(mesh.shape) == {"pp": 1, "dp": 1, "fsdp": 2, "tp": 2, "sp": 2}
     mesh = make_mesh(fsdp=-1)  # absorb all
     assert mesh.shape["fsdp"] == len(jax.devices())
     with pytest.raises(ValueError):
@@ -865,8 +865,6 @@ def test_pipeline_train_loop_end_to_end():
 
 
 def test_pipeline_validation_errors():
-    from tensorhive_tpu.parallel.pipeline import pipeline_apply
-
     config = dataclasses.replace(PRESETS["tiny"], dtype=jnp.float32,
                                  remat=False, n_layers=3)   # 3 % pp(2) != 0
     params = TransformerLM.init(jax.random.PRNGKey(0), config)
@@ -881,7 +879,6 @@ def test_pipeline_validation_errors():
     with pytest.raises(ValueError, match="microbatches"):
         TransformerLM.loss(params4, tokens, config4, mesh=mesh)
     # pp + sp cannot combine yet — loud, not silently wrong
-    del pipeline_apply
     mesh_sp = make_mesh(pp=2, sp=2, fsdp=2)
     config_sp = dataclasses.replace(PRESETS["tiny"], dtype=jnp.float32,
                                     remat=False)
