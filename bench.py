@@ -229,19 +229,22 @@ def bench_generate():
     prefill_tps = batch * (prompt_len - 1) / prefill_s
 
     # steady-state decode: the generation scan alone, cache pre-filled
-    tokens = jnp.concatenate(
-        [prompt, jnp.zeros((batch, new_tokens), jnp.int32)], axis=1)
-    scan = lambda: decode._generate_on_device(  # noqa: E731
-        params, tokens, filled, jax.random.PRNGKey(0), jnp.int32(prompt_len),
-        jnp.float32(1.0), config=config, total=total, sampling=False,
-        top_k=None, start=prompt_len - 1)
-    scan().block_until_ready()
-    started = time.perf_counter()
-    for _ in range(reps):
-        out = scan()
-    out.block_until_ready()
-    decode_s = (time.perf_counter() - started) / reps
-    decode_tps = batch * new_tokens / decode_s
+    def decode_tps_at(batch_n, filled_cache, prompt_n):
+        tokens = jnp.concatenate(
+            [prompt_n, jnp.zeros((batch_n, new_tokens), jnp.int32)], axis=1)
+        scan = lambda: decode._generate_on_device(  # noqa: E731
+            params, tokens, filled_cache, jax.random.PRNGKey(0),
+            jnp.int32(prompt_len), jnp.float32(1.0), config=config,
+            total=total, sampling=False, top_k=None, start=prompt_len - 1)
+        scan().block_until_ready()
+        started = time.perf_counter()
+        for _ in range(reps):
+            out = scan()
+        out.block_until_ready()
+        decode_s = (time.perf_counter() - started) / reps
+        return batch_n * new_tokens / decode_s, decode_s
+
+    decode_tps, decode_s = decode_tps_at(batch, filled, prompt)
     result = {
         "preset": preset,
         "batch": batch,
@@ -251,6 +254,21 @@ def bench_generate():
         "decode_tokens_per_sec": round(decode_tps, 1),
         "decode_ms_per_token": round(decode_s / new_tokens * 1e3, 3),
     }
+    if jax.default_backend() == "tpu":
+        # batch sweep: decode at b8 runs ~15% of the HBM roofline
+        # (dispatch-bound — docs/PERF.md "Serving roofline"), so a 4x
+        # batch should cost little step time; record the evidence
+        batch4 = batch * 4
+        prompt4 = jax.random.randint(key, (batch4, prompt_len), 0,
+                                     config.vocab_size, dtype=jnp.int32)
+        cache4 = decode.init_cache(config, batch4, max_len=total)
+        filled4 = decode._prefill_cache(params, prompt4[:, :prompt_len - 1],
+                                        cache4, config)
+        jax.block_until_ready(filled4)
+        tps4, s4 = decode_tps_at(batch4, filled4, prompt4)
+        result[f"decode_b{batch4}_tokens_per_sec"] = round(tps4, 1)
+        result[f"decode_b{batch4}_ms_per_token"] = round(
+            s4 / new_tokens * 1e3, 3)
     _log(f"  generate: {result}")
     return result
 
@@ -426,11 +444,15 @@ def _emit_once() -> None:
             return
         payload = json.dumps(_sanitize(_build_result()), allow_nan=False)
         _emitted = True
-        try:
-            os.write(sys.stdout.fileno(), (payload + "\n").encode())
-        except (OSError, ValueError):  # captured/redirected stdout, no fd
-            sys.stdout.write(payload + "\n")
-            sys.stdout.flush()
+        _write_stdout_line(payload)
+
+
+def _write_stdout_line(payload: str) -> None:
+    try:
+        os.write(sys.stdout.fileno(), (payload + "\n").encode())
+    except (OSError, ValueError):  # captured/redirected stdout with no fd
+        sys.stdout.write(payload + "\n")
+        sys.stdout.flush()
 
 
 def _watchdog(deadline_s: float, generation: int) -> None:
@@ -452,17 +474,20 @@ def _watchdog(deadline_s: float, generation: int) -> None:
 
 def _emit_fallback(exc: BaseException) -> None:
     """Last-ditch minimal payload if the real result cannot serialize —
-    the driver must never see zero stdout."""
+    the driver must never see zero stdout (and never two lines: the latch
+    is set here too, so a watchdog waking after a failed main emit cannot
+    print a second copy)."""
+    global _emitted
     payload = json.dumps({
         "metric": "t2t_transformer tokens/sec/chip", "value": 0.0,
         "unit": "tokens/s/chip", "vs_baseline": None,
         "errors": [f"emit: {type(exc).__name__}: {exc}"],
     })
-    try:
-        os.write(sys.stdout.fileno(), (payload + "\n").encode())
-    except (OSError, ValueError):
-        sys.stdout.write(payload + "\n")
-        sys.stdout.flush()
+    with _emit_lock:
+        if _emitted:
+            return
+        _emitted = True
+        _write_stdout_line(payload)
 
 
 def main() -> None:

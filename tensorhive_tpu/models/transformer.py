@@ -281,12 +281,8 @@ class TransformerLM:
         from ..parallel.pipeline import pp_enabled
 
         if pp_enabled(mesh):
-            if sp_sharded:
-                raise NotImplementedError(
-                    "pp and sp cannot both exceed 1 yet: ring attention's "
-                    "shard_map cannot nest inside the pipeline's")
             return TransformerLM._apply_trunk_pipelined(
-                params, x, positions, config, mesh)
+                params, x, positions, config, mesh, sp_sharded=sp_sharded)
 
         def pin(t):
             # pin activations to their canonical sharding between blocks:
@@ -343,17 +339,28 @@ class TransformerLM:
 
     @staticmethod
     def _apply_trunk_pipelined(params, x, positions,
-                               config: TransformerConfig, mesh) -> jax.Array:
+                               config: TransformerConfig, mesh,
+                               sp_sharded: bool = False) -> jax.Array:
         """Blocks as a ``pp``-stage GPipe pipeline (parallel/pipeline.py):
         stage params are the per-layer dicts stacked and sharded over the
         pp axis; dp/fsdp/tp stay automatic inside each stage, so the flash
         kernels and megatron splits run exactly as in the unpipelined
-        path. sp is gated off (its shard_map can't nest inside the
-        pipeline's)."""
+        path. With ``sp_sharded`` the pipeline's shard_map goes manual over
+        {pp, sp} and each stage attends via the manual ring body
+        (ring_attention_local) — sequence parallelism INSIDE pipeline
+        stages, no nested shard_map."""
         from ..parallel.pipeline import pipeline_apply, stack_blocks
 
         def attend(q, k, v):
-            if config.use_flash:
+            if sp_sharded:
+                from ..parallel.ring import ring_attention_local
+
+                return ring_attention_local(q, k, v, "sp",
+                                            mesh.shape["sp"], causal=True)
+            # inside the pipeline's manual region, pallas only on real TPU:
+            # interpret-mode pallas is unsupported under vma tracking (see
+            # parallel/pipeline.py) — CI/CPU takes the XLA oracle
+            if config.use_flash and jax.default_backend() == "tpu":
                 return flash_attention(q, k, v, causal=True)
             from ..ops.flash_attention import reference_attention
 
@@ -367,7 +374,8 @@ class TransformerLM:
             apply_layer = jax.checkpoint(apply_layer)
         x = pipeline_apply(
             stack_blocks(params["blocks"]), x, positions, mesh, apply_layer,
-            num_microbatches=config.pp_microbatches)
+            num_microbatches=config.pp_microbatches,
+            seq_axis="sp" if sp_sharded else None)
         return _rmsnorm(x, params["final_norm"]["scale"])
 
     @staticmethod

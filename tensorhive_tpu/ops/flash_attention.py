@@ -41,6 +41,19 @@ DEFAULT_BLOCK_Q = 128
 DEFAULT_BLOCK_K = 128
 
 
+def _struct(shape, dtype, like) -> jax.ShapeDtypeStruct:
+    """Pallas out_shape that survives NEW-style partial-manual shard_map
+    (check_vma=True): the output inherits ``like``'s varying-manual-axes
+    set — when these kernels run inside the pipeline's manual {pp, sp}
+    region (parallel/pipeline.py) a bare ShapeDtypeStruct has vma=None and
+    pallas_call refuses it. Outside any manual region vma is empty and
+    this is the plain constructor."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def default_blocks(seq_len: int) -> tuple:
     """Per-shape block sizes. Measured on v5e (t2t-base b64×s1024, train_loop
     step timings): 512×512 blocks cut the attention share of the step from
@@ -244,8 +257,8 @@ def _flash_fwd_bhsd(q, k, v, causal: bool, block_q: int, block_k: int,
     if scale is None:
         scale = d ** -0.5
     out_shape = [
-        jax.ShapeDtypeStruct(q.shape, q.dtype),
-        jax.ShapeDtypeStruct((bh, 1, seq_len), jnp.float32),
+        _struct(q.shape, q.dtype, q),
+        _struct((bh, 1, seq_len), jnp.float32, q),
     ]
     if _kv_resident(seq_len, d, q.dtype):
         return pl.pallas_call(
@@ -501,7 +514,7 @@ def _flash_bwd_bhsd(q, k, v, out, lse, do, causal: bool, block_q: int,
                 pl.BlockSpec((1, 1, seq_len), lambda b, i: (b, 0, 0)),   # delta
             ],
             out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+            out_shape=_struct(q.shape, q.dtype, q),
             interpret=interpret,
         )(q, k, v, do, lse, delta)
     else:
@@ -517,7 +530,7 @@ def _flash_bwd_bhsd(q, k, v, out, lse, do, causal: bool, block_q: int,
                 pl.BlockSpec((1, 1, seq_len), lambda b, i, j: (b, 0, 0)),   # delta
             ],
             out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
+            out_shape=_struct(q.shape, q.dtype, q),
             scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
             interpret=interpret,
         )(q, k, v, do, lse, delta)
@@ -541,8 +554,8 @@ def _flash_bwd_bhsd(q, k, v, out, lse, do, causal: bool, block_q: int,
                 pl.BlockSpec((1, block_k, d), lambda b, j: (b, j, 0)),
             ],
             out_shape=[
-                jax.ShapeDtypeStruct(k.shape, k.dtype),
-                jax.ShapeDtypeStruct(v.shape, v.dtype),
+                _struct(k.shape, k.dtype, k),
+                _struct(v.shape, v.dtype, v),
             ],
             interpret=interpret,
         )(q, k, v, do, lse, delta)
@@ -568,8 +581,8 @@ def _flash_bwd_bhsd(q, k, v, out, lse, do, causal: bool, block_q: int,
             pl.BlockSpec((1, block_k, d), lambda b, j, t: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(k.shape, k.dtype),
-            jax.ShapeDtypeStruct(v.shape, v.dtype),
+            _struct(k.shape, k.dtype, k),
+            _struct(v.shape, v.dtype, v),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),

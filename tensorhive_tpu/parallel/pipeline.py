@@ -61,6 +61,7 @@ def pipeline_apply(
     mesh: Mesh,
     apply_layer: LayerFn,
     num_microbatches: int = 0,
+    seq_axis: Optional[str] = None,
 ) -> jax.Array:
     """Run the stacked transformer blocks as a ``pp``-stage pipeline.
 
@@ -68,14 +69,27 @@ def pipeline_apply(
     microbatch; wrap it in ``jax.checkpoint`` on the caller side for remat.
     Activations AND positions travel the ring together so every stage sees
     the microbatch's own positions.
+
+    ``seq_axis``: compose with sequence parallelism — the shard_map goes
+    manual over {pp, seq_axis}, activations and positions enter sharded on
+    their sequence dim, and ``apply_layer`` (whose attention must then run
+    the manual ring body, parallel/ring.py ``ring_attention_local``) sees
+    [mb, L/sp, D] shards. The microbatch ppermute ring over pp carries the
+    sp-sharded activations as-is — pp hops move microbatches between
+    stages, sp hops rotate KV inside a stage; the two never exchange data
+    on the same edge.
     """
     pp = mesh.shape["pp"]
-    batch, seq_len, d_model = x.shape
+    batch = x.shape[0]
     n_layers = jax.tree_util.tree_leaves(stacked_blocks)[0].shape[0]
     if n_layers % pp:
         raise ValueError(f"{n_layers} layers not divisible by pp={pp}")
     num_mb = pipeline_microbatches(batch, mesh, num_microbatches)
     mb = batch // num_mb
+
+    manual = ("pp",) if seq_axis is None else ("pp", seq_axis)
+    data_spec = P() if seq_axis is None else P(None, seq_axis, None)
+    pos_spec = P() if seq_axis is None else P(None, seq_axis)
 
     # stage params: leading layer dim sharded over pp — P("pp") splits the
     # stacked dim so each rank's body sees [n_layers/pp, ...] leaves, with
@@ -84,6 +98,8 @@ def pipeline_apply(
         lambda leaf: P(*(("pp",) + (None,) * (leaf.ndim - 1))), stacked_blocks)
 
     def body(stage_blocks, x, positions):
+        # local shapes: the seq dim arrives pre-sharded when seq_axis is set
+        _, seq_len, d_model = x.shape
         rank = jax.lax.axis_index("pp")
         x_mb = x.reshape(num_mb, mb, seq_len, d_model)
         pos_mb = positions.reshape(num_mb, mb, seq_len)
@@ -109,6 +125,8 @@ def pipeline_apply(
                 acc.at[jnp.maximum(emit, 0)].set(out), acc)
             return (send_x, send_pos, acc), None
 
+        # zeros_like inherits sp-varyingness from the sharded inputs, so
+        # only the pp axis needs the explicit cast
         varying = lambda v: jax.lax.pcast(v, ("pp",), to="varying")  # noqa: E731
         carry = (varying(jnp.zeros_like(x_mb[0])),
                  varying(jnp.zeros_like(pos_mb[0])),
@@ -118,12 +136,20 @@ def pipeline_apply(
         # only the last rank's accumulator is nonzero; psum replicates it
         return jax.lax.psum(acc, "pp").reshape(batch, seq_len, d_model)
 
+    # NOTE this region runs under vma tracking (check_vma defaults True; a
+    # partial-manual shard_map with check_vma=False rejects its own
+    # out_specs in current JAX). Pallas kernels inside the region work on
+    # real TPU — their out_shapes carry the inputs' vma via
+    # ops/flash_attention._struct — but interpret-mode pallas does not
+    # (JAX: "Primitive dynamic_slice requires varying manual axes to
+    # match"), so off-TPU callers must route attention to non-pallas
+    # bodies (see models/transformer._apply_trunk_pipelined).
     return jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(stage_spec, P(), P()),
-        out_specs=P(),
-        axis_names={"pp"},
+        in_specs=(stage_spec, data_spec, pos_spec),
+        out_specs=data_spec,
+        axis_names=set(manual),
     )(stacked_blocks, x, positions)
 
 

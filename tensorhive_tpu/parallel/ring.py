@@ -34,6 +34,23 @@ from ..ops.flash_attention import (
 NEG_INF = -1e30
 
 
+def _match_vma(x, ref):
+    """Give ``x`` the same varying-manual-axes type as ``ref``.
+
+    Inside a NEW-style partial-manual shard_map (the pipeline's, manual
+    over {pp, sp}) every scan carry must carry consistent varying axes;
+    fresh zero accumulators start invarying and must be pcast to match the
+    data they accumulate. Outside such a region (the classic full-manual
+    ``shard_map(check_rep=False)`` wrapper) avals carry no vma info and
+    this is a no-op."""
+    try:
+        missing = tuple(a for a in jax.typeof(ref).vma
+                        if a not in jax.typeof(x).vma)
+    except (AttributeError, TypeError):
+        return x
+    return jax.lax.pcast(x, missing, to="varying") if missing else x
+
+
 def _block_attend(q, k, v, acc, row_max, row_sum, q_offset, k_offset, causal, scale):
     """One Q-block × KV-block step of streaming-softmax attention.
 
@@ -70,9 +87,9 @@ def _ring_attention_local(q, k, v, axis_name: str, causal: bool, scale: float):
     my_index = jax.lax.axis_index(axis_name)
     seq_len = q.shape[1]
 
-    acc = jnp.zeros(q.shape[:3] + (v.shape[-1],), jnp.float32)
-    row_max = jnp.full(q.shape[:3], NEG_INF, jnp.float32)
-    row_sum = jnp.zeros(q.shape[:3], jnp.float32)
+    acc = _match_vma(jnp.zeros(q.shape[:3] + (v.shape[-1],), jnp.float32), q)
+    row_max = _match_vma(jnp.full(q.shape[:3], NEG_INF, jnp.float32), q)
+    row_sum = _match_vma(jnp.zeros(q.shape[:3], jnp.float32), q)
     q_offset = my_index * seq_len
 
     def step(carry, _):
@@ -131,8 +148,10 @@ def _ring_step_fwd(mode, qb, kb, vb, block_q, block_k, interpret, scale):
                                scale=scale)
 
     def future(qb, kb, vb):
-        return (jnp.zeros((bh, lq, d), qb.dtype),
-                jnp.full((bh, 1, lq), NEG_INF, jnp.float32))
+        # must match the pallas branches' varying-axes type exactly, or
+        # lax.switch rejects the branch set inside a check_vma region
+        return (_match_vma(jnp.zeros((bh, lq, d), qb.dtype), qb),
+                _match_vma(jnp.full((bh, 1, lq), NEG_INF, jnp.float32), qb))
 
     return jax.lax.switch(mode, (diag, past, future), qb, kb, vb)
 
@@ -194,8 +213,9 @@ def _flash_ring_fwd(q, k, v, axis_name, axis_size, causal, block_q, block_k,
     batch, seq_local, heads, d = q.shape
     my_index = jax.lax.axis_index(axis_name)
     qb = _bhsd(q)
-    out_run = jnp.zeros(qb.shape, jnp.float32)
-    lse_run = jnp.full((qb.shape[0], 1, seq_local), NEG_INF, jnp.float32)
+    out_run = _match_vma(jnp.zeros(qb.shape, jnp.float32), qb)
+    lse_run = _match_vma(
+        jnp.full((qb.shape[0], 1, seq_local), NEG_INF, jnp.float32), qb)
     k_cur, v_cur = k, v
     for s in range(axis_size):                  # static unroll: sp is small
         mode = _ring_mode(my_index, s, axis_size, causal)
@@ -221,12 +241,12 @@ def _flash_ring_bwd(axis_name, axis_size, causal, block_q, block_k, interpret,
     # delta = rowsum(dO∘O) depends only on the local q shard: compute it
     # ONCE here instead of per ring step (axis_size× redundant reductions)
     delta = flash_bwd_delta(dob, outb)
-    dq_acc = jnp.zeros(qb.shape, jnp.float32)
+    dq_acc = _match_vma(jnp.zeros(qb.shape, jnp.float32), qb)
     # dk/dv accumulators rotate WITH the kv blocks; after axis_size rotations
     # (one per step) they land back on the kv owner
     k_cur, v_cur = k, v
-    dk_cur = jnp.zeros(_bhsd(k).shape, jnp.float32)
-    dv_cur = jnp.zeros(_bhsd(v).shape, jnp.float32)
+    dk_cur = _match_vma(jnp.zeros(_bhsd(k).shape, jnp.float32), qb)
+    dv_cur = _match_vma(jnp.zeros(_bhsd(v).shape, jnp.float32), qb)
     for s in range(axis_size):
         mode = _ring_mode(my_index, s, axis_size, causal)
         dq_i, dk_i, dv_i = _ring_step_bwd(
@@ -256,6 +276,60 @@ def _flash_ring_usable(seq_local: int, block_q: int, block_k: int) -> bool:
     return seq_local % block_q == 0 and seq_local % block_k == 0
 
 
+def _ring_body_plan(q, k, v, seq_local, heads_shardable=True):
+    """Shared flash-vs-dense dispatch for both ring entry points.
+
+    Returns (use_flash, k, v, block_q, block_k) with K/V pre-expanded to
+    full head width when the chosen body can't take GQA-narrow K/V
+    natively: the dense fallback's einsums assume equal head counts, and
+    the flash path needs the KV heads to divide the head-sharding axis
+    (``heads_shardable``; vacuously true for per-shard callers whose head
+    dim stays automatic)."""
+    block_q, block_k = default_blocks(seq_local)
+    kv_heads = k.shape[2]
+    kv_compatible = (
+        v.shape == k.shape and k.shape[:2] == q.shape[:2]
+        and k.shape[3] == q.shape[3] and q.shape[2] % kv_heads == 0
+    )
+    use_flash = _flash_ring_usable(seq_local, block_q, block_k) and kv_compatible
+    if kv_heads != q.shape[2] and kv_compatible and (
+            not use_flash or not heads_shardable):
+        group = q.shape[2] // kv_heads
+        k = jnp.repeat(k, group, axis=2)
+        v = jnp.repeat(v, group, axis=2)
+        use_flash = _flash_ring_usable(seq_local, block_q, block_k)
+    return use_flash, k, v, block_q, block_k
+
+
+def ring_attention_local(q, k, v, axis_name: str, axis_size: int,
+                         causal: bool = True) -> jax.Array:
+    """Per-shard ring attention for callers ALREADY inside a manual region
+    over ``axis_name`` — the pipeline's shard_map (manual over {pp, sp})
+    calls this per stage so pp and sp compose without nesting shard_maps.
+
+    Arrays are LOCAL shards [B, L/axis_size, H, D]; collectives run over
+    the enclosing region's ``axis_name``. Body dispatch is shared with
+    ``ring_attention`` (``_ring_body_plan``) with one extra gate: off-TPU
+    the flash body would need interpret-mode pallas, which JAX's vma
+    tracking does not support inside a partial-manual region ("Primitive
+    dynamic_slice requires varying manual axes to match"), so CPU/CI runs
+    take the dense blockwise body (same math, same ring collectives); the
+    real TPU path runs the pallas flash-ring."""
+    scale = q.shape[-1] ** -0.5
+    use_flash, k, v, block_q, block_k = _ring_body_plan(q, k, v, q.shape[1])
+    if use_flash and jax.default_backend() == "tpu":
+        return _flash_ring_local(q, k, v, axis_name, axis_size, causal,
+                                 block_q, block_k, False, scale)
+    if k.shape[2] != q.shape[2]:
+        # the dense body's einsums need full-width K/V (the flash plan
+        # above may have kept them GQA-narrow)
+        group = q.shape[2] // k.shape[2]
+        k = jnp.repeat(k, group, axis=2)
+        v = jnp.repeat(v, group, axis=2)
+    return _ring_attention_local(q, k, v, axis_name=axis_name, causal=causal,
+                                 scale=scale)
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -283,29 +357,17 @@ def ring_attention(
     axis_size = mesh.shape[axis_name]
     seq_local = q.shape[1] // axis_size
     spec = P(batch_axes, axis_name, head_axis, None)
-    block_q, block_k = default_blocks(seq_local)
     # GQA rides the ring natively when the flash-ring body runs (the inner
     # kernels read KV head h // group via their index maps), which also
     # shrinks the rotating K/V blocks — group× less ICI traffic per step.
-    # The KV heads must still divide the head-sharding axis; otherwise (or
-    # on the dense fallback body, whose einsums assume equal head counts)
-    # expand K/V up front.
-    kv_heads = k.shape[2]
-    kv_compatible = (
-        v.shape == k.shape and k.shape[:2] == q.shape[:2]
-        and k.shape[3] == q.shape[3] and q.shape[2] % kv_heads == 0
-    )
+    # The KV heads must still divide the head-sharding axis (checked here;
+    # this wrapper shards heads manually over head_axis).
     heads_shardable = (
         head_axis is None or head_axis not in mesh.axis_names
-        or kv_heads % mesh.shape[head_axis] == 0
+        or k.shape[2] % mesh.shape[head_axis] == 0
     )
-    use_flash = _flash_ring_usable(seq_local, block_q, block_k) and kv_compatible
-    if kv_heads != q.shape[2] and kv_compatible and (
-            not use_flash or not heads_shardable):
-        group = q.shape[2] // kv_heads
-        k = jnp.repeat(k, group, axis=2)
-        v = jnp.repeat(v, group, axis=2)
-        use_flash = _flash_ring_usable(seq_local, block_q, block_k)
+    use_flash, k, v, block_q, block_k = _ring_body_plan(
+        q, k, v, seq_local, heads_shardable=heads_shardable)
     if use_flash:
         interpret = jax.default_backend() != "tpu"
 
