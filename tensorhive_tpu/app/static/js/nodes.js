@@ -8,6 +8,35 @@ const NODES_POLL_MS = 3000;
 const HISTORY_MAX = 200;                      // ~10 min at 3 s/sample
 const chipHistory = {};                       // uid -> {duty:[], hbm:[]}
 
+/* selectable history window for the popout chart (reference WatchBox.vue
+   charts a fixed rolling window with time labels, :240); persisted like
+   the watch toggles. Sample counts derive from the poll period so the
+   option names can never drift from the charted span. */
+const CHART_WINDOWS = {
+  "2 min": Math.min(HISTORY_MAX, 2 * 60000 / NODES_POLL_MS),
+  "5 min": Math.min(HISTORY_MAX, 5 * 60000 / NODES_POLL_MS),
+  "10 min": Math.min(HISTORY_MAX, 10 * 60000 / NODES_POLL_MS),
+};
+
+let currentChartWindow = null;    // survives even when storage is blocked
+
+function chartWindow() {
+  if (currentChartWindow && CHART_WINDOWS[currentChartWindow]) {
+    return currentChartWindow;
+  }
+  try {
+    const v = localStorage.getItem("tpuhive-chart-window");
+    if (v && CHART_WINDOWS[v]) return v;
+  } catch (e) {}
+  return "10 min";
+}
+
+function setChartWindow(name, uid) {
+  currentChartWindow = name;
+  try { localStorage.setItem("tpuhive-chart-window", name); } catch (e) {}
+  drawChipChart(uid);
+}
+
 function recordChipSample(uid, duty, hbmPct) {
   const h = chipHistory[uid] || (chipHistory[uid] = { duty: [], hbm: [] });
   h.duty.push(duty ?? 0); h.hbm.push(hbmPct ?? 0);
@@ -182,6 +211,11 @@ function openChipDialog(uid, host) {
     <p class="muted">
       <span class="legend-dot" style="background:var(--accent)"></span>duty cycle %
       <span class="legend-dot" style="background:var(--ok);margin-left:1rem"></span>HBM %
+      <label class="inline" style="margin-left:1rem">window
+        <select id="chip-window" onchange="setChartWindow(this.value, '${jsArg(uid)}')">
+          ${Object.keys(CHART_WINDOWS).map(name =>
+            `<option ${name === chartWindow() ? "selected" : ""}>${name}</option>`).join("")}
+        </select></label>
     </p>
     <svg class="chart-lg" id="chip-chart" viewBox="0 0 600 180"
          preserveAspectRatio="none"></svg>
@@ -225,10 +259,17 @@ function drawChipChart(uid) {
   if (!svg) return;
   const h = chipHistory[uid] || { duty: [], hbm: [] };
   const w = 600, ht = 180;
-  const line = (values, color) => {
+  /* fixed timescale: the x axis always spans the selected window ("now"
+     at the right edge); with fewer samples than the window holds, the
+     trace starts partway in rather than stretching (reference
+     WatchBox.vue:240 labels its chart the same seconds-ago way) */
+  const windowSamples = CHART_WINDOWS[chartWindow()];
+  const line = (allValues, color) => {
+    const values = allValues.slice(-windowSamples);
     if (!values.length) return "";
     const pts = values.map((v, i) => {
-      const x = values.length === 1 ? w : (i / (values.length - 1)) * w;
+      const slot = windowSamples - values.length + i;
+      const x = windowSamples === 1 ? w : (slot / (windowSamples - 1)) * w;
       const y = ht - 4 - (Math.min(100, Math.max(0, v)) / 100) * (ht - 8);
       return `${x.toFixed(1)},${y.toFixed(1)}`;
     }).join(" ");
@@ -240,6 +281,11 @@ function drawChipChart(uid) {
       stroke-dasharray="4 5"/><text x="4" y="${y - 3}" fill="#8b98a5"
       font-size="9">${pct}%</text>`;
   }).join("");
-  svg.innerHTML = gridlines +
+  const timeLabels = [0, 0.5].map(frac => {
+    const secsAgo = Math.round((1 - frac) * windowSamples * NODES_POLL_MS / 1000);
+    return `<text x="${(frac * w + 4).toFixed(0)}" y="${ht - 6}" fill="#8b98a5"
+      font-size="9">-${secsAgo}s</text>`;
+  }).join("") + `<text x="${w - 26}" y="${ht - 6}" fill="#8b98a5" font-size="9">now</text>`;
+  svg.innerHTML = gridlines + timeLabels +
     line(h.duty, "var(--accent)") + line(h.hbm, "var(--ok)");
 }

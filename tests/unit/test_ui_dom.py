@@ -315,6 +315,22 @@ def test_nodes_dashboard_renders_telemetry_and_sysfs_warning(ui, config):
         b_card = next(c for t, c in by_host.items() if "vm-b" in t)
         assert "sysfs_absent" in b_card.text_content
         assert "sysfs_absent" not in a_card.text_content
+
+        # chip drilldown chart: selectable history window with a fixed
+        # seconds-ago timescale (reference WatchBox.vue:240)
+        uid = ui.interp.eval_expr("Object.keys(chipHistory)[0]")
+        ui.interp.eval_expr(f"openChipDialog('{uid}', 'vm-a')")
+        assert ui.page.by_id("chip-dialog").js_get("open"), "dialog shown"
+        assert ui.page.by_id("chip-window") is not None, "window selector"
+        chart = ui.page.by_id("chip-chart")
+        html = chart.js_get("innerHTML")
+        assert "now" in html and "-600s" in html, (
+            "default 10-min window must label its timescale: " + html[:200])
+        ui.interp.eval_expr(f"setChartWindow('2 min', '{uid}')")
+        html = ui.page.by_id("chip-chart").js_get("innerHTML")
+        assert "-120s" in html and "-600s" not in html
+        assert ui.interp.eval_expr(
+            "localStorage.getItem('tpuhive-chart-window')") == "2 min"
     finally:
         set_manager(None)
 
