@@ -268,8 +268,11 @@ function drawChipChart(uid) {
     const values = allValues.slice(-windowSamples);
     if (!values.length) return "";
     const pts = values.map((v, i) => {
+      /* slot+1 of windowSamples: the newest sample sits at the right
+         edge and the LEFT edge is exactly windowSamples polls ago, so
+         the seconds-ago labels below are exact */
       const slot = windowSamples - values.length + i;
-      const x = windowSamples === 1 ? w : (slot / (windowSamples - 1)) * w;
+      const x = ((slot + 1) / windowSamples) * w;
       const y = ht - 4 - (Math.min(100, Math.max(0, v)) / 100) * (ht - 8);
       return `${x.toFixed(1)},${y.toFixed(1)}`;
     }).join(" ");

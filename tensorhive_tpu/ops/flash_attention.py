@@ -30,6 +30,7 @@ same math, same f32 accumulation — which is also the oracle in tests.
 from __future__ import annotations
 
 import functools
+import math
 from typing import Optional
 
 import jax
@@ -109,6 +110,23 @@ def _causal_mask(q_start, k_start, block_q, block_k):
 
 
 
+def _fold_scale_into_q(q, scale: float):
+    """Fold the softmax scale into q ONCE per block ([block_q, d] elements)
+    instead of into the scores (a full [block_q, block_k] VPU pass per KV
+    block — the kernels are VPU-bound, docs/PERF.md), returning
+    ``(q', residual)`` with ``q'·Kᵀ·residual == scale·q·Kᵀ``.
+
+    The fold only happens when it is EXACT in the input dtype, i.e. the
+    scale is a power of two (d_head 16/64/256 → d**-0.5 = 2^-k; d_head
+    128 gives 2^-3.5, which would round every bf16 q element, so there the
+    scale stays on the f32 scores as the residual)."""
+    if scale == 1.0:
+        return q, 1.0
+    if math.frexp(abs(scale))[0] == 0.5:    # mantissa 1/2 ⇔ power of two
+        return q * jnp.asarray(scale, q.dtype), 1.0
+    return q, scale
+
+
 def _online_softmax_block(q, k_blk, v_blk, acc, row_max, row_sum,
                           q_start, k_start, causal: bool, scale: float):
     """Shared forward block math (resident + streaming kernels): one online-
@@ -117,16 +135,24 @@ def _online_softmax_block(q, k_blk, v_blk, acc, row_max, row_sum,
     off its native bf16 path (measured ~1 TFLOP/s vs 197 peak on v5e);
     softmax statistics stay f32."""
     block_q, block_k = q.shape[0], k_blk.shape[0]
-    scores = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+    q, residual = _fold_scale_into_q(q, scale)
+    scores = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+    if residual != 1.0:
+        scores = scores * residual
     if causal:
         mask = _causal_mask(q_start, k_start, block_q, block_k)
         scores = jnp.where(mask, scores, NEG_INF)
     block_max = jnp.max(scores, axis=-1)
     new_max = jnp.maximum(row_max, block_max)
     correction = jnp.exp(row_max - new_max)
+    # no re-mask of probs: every sweep this block math serves visits, for
+    # any q row, a block containing at least one visible key FIRST (the
+    # resident causal sweep starts at kv 0; the streaming grid's first
+    # unskipped block is kv 0; the ring's masked-out blocks never reach a
+    # kernel), so new_max is finite from the first update and a masked
+    # score contributes exp(NEG_INF - finite) == 0 by underflow — the
+    # explicit where() was a pure extra VPU pass over S² elements
     probs = jnp.exp(scores - new_max[:, None])
-    if causal:
-        probs = jnp.where(mask, probs, 0.0)
     acc = acc * correction[:, None] + jnp.dot(
         probs.astype(v_blk.dtype), v_blk, preferred_element_type=jnp.float32)
     row_sum = row_sum * correction + jnp.sum(probs, axis=-1)
@@ -169,7 +195,7 @@ def _fwd_kernel_resident(q_ref, k_ref, v_ref, out_ref, lse_ref, *,
     through them (upper-triangle blocks are never visited at all)."""
     block_q = q_ref.shape[1]
     q_start = pl.program_id(1) * block_q
-    q = q_ref[0]
+    q, residual = _fold_scale_into_q(q_ref[0], scale)   # loop-invariant
     d = q_ref.shape[-1]
 
     def make_body(masked: bool):
@@ -180,7 +206,7 @@ def _fwd_kernel_resident(q_ref, k_ref, v_ref, out_ref, lse_ref, *,
             v_blk = v_ref[0, pl.ds(k_start, block_k), :]
             return _online_softmax_block(q, k_blk, v_blk, acc, row_max,
                                          row_sum, q_start, k_start, masked,
-                                         scale)
+                                         residual)
         return body
 
     carry = (jnp.zeros((block_q, d), jnp.float32),
@@ -313,11 +339,17 @@ def _bwd_probs_ds(q, k_blk, v_blk, do, lse, delta, q_start, k_start,
     Matmuls in the input dtype (f32 accumulation), stats in f32 — see
     _online_softmax_block for why."""
     block_q, block_k = q.shape[0], k_blk.shape[0]
-    scores = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
-    probs = jnp.exp(scores - lse[:, None])
+    q, residual = _fold_scale_into_q(q, scale)
+    scores = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+    if residual != 1.0:
+        scores = scores * residual
     if causal:
+        # masking SCORES (not probs) lets exp produce the zeros directly:
+        # exp(NEG_INF - finite lse) underflows to 0 — one where() pass,
+        # same as before, but no separate probs pass
         mask = _causal_mask(q_start, k_start, block_q, block_k)
-        probs = jnp.where(mask, probs, 0.0)
+        scores = jnp.where(mask, scores, NEG_INF)
+    probs = jnp.exp(scores - lse[:, None])
     dp = jnp.dot(do, v_blk.T, preferred_element_type=jnp.float32)
     ds = probs * (dp - delta[:, None])
     return probs, ds
@@ -330,7 +362,7 @@ def _dq_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     causal-pruned trip count, dq accumulated in registers/VMEM values."""
     block_q = q_ref.shape[1]
     q_start = pl.program_id(1) * block_q
-    q = q_ref[0]
+    q, residual = _fold_scale_into_q(q_ref[0], scale)   # loop-invariant
     do = do_ref[0]
     lse = lse_ref[0, 0, pl.ds(q_start, block_q)]
     delta = delta_ref[0, 0, pl.ds(q_start, block_q)]
@@ -342,7 +374,7 @@ def _dq_kernel_resident(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             k_blk = k_ref[0, pl.ds(k_start, block_k), :]
             v_blk = v_ref[0, pl.ds(k_start, block_k), :]
             _, ds = _bwd_probs_ds(q, k_blk, v_blk, do, lse, delta,
-                                  q_start, k_start, masked, scale)
+                                  q_start, k_start, masked, residual)
             return dq_acc + jnp.dot(ds.astype(k_blk.dtype), k_blk,
                                     preferred_element_type=jnp.float32)
         return body
