@@ -31,6 +31,7 @@ from __future__ import annotations
 
 import functools
 import math
+import os
 from typing import Optional
 
 import jax
@@ -261,6 +262,69 @@ def _fwd_kernel(q_ref, k_ref, v_ref, out_ref, lse_ref,
         ).astype(lse_ref.dtype)
 
 
+def _fwd_kernel_resident_bh(q_ref, k_ref, v_ref, out_ref, lse_ref, *,
+                            causal: bool, scale: float, block_k: int,
+                            seq_len: int):
+    """Resident-KV forward over a BLOCK of G heads per program: grid
+    (BH // G, q_blocks). Identical math to _fwd_kernel_resident vmapped
+    over the leading head dim — G× fewer grid programs amortize
+    per-program fixed costs (sequencing + q/out DMA setup) and give the
+    MXU a batched [G, block_q, d] × [G, block_k, d] contraction. MHA only
+    (the caller guarantees group == 1); experimental, selected via
+    TPUHIVE_FLASH_BH_BLOCK (tools/perf_lab.py ``bhblock:G``).
+
+    The carry/epilogue deliberately mirrors _fwd_kernel_resident rather
+    than replacing it: the per-head kernel is the measured default path
+    and stays untouched while this one is being A/B'd on hardware — if
+    bh-blocking graduates to default, collapse the per-head kernel into
+    g=1 of this one (GQA's ``b // group`` index map is the one thing to
+    port)."""
+    g, block_q = q_ref.shape[0], q_ref.shape[1]
+    q_start = pl.program_id(1) * block_q
+    q, residual = _fold_scale_into_q(q_ref[...], scale)
+    d = q_ref.shape[-1]
+
+    def make_body(masked: bool):
+        def body(kv_idx, carry):
+            acc, row_max, row_sum = carry
+            k_start = kv_idx * block_k
+            k_blk = k_ref[:, pl.ds(k_start, block_k), :]
+            v_blk = v_ref[:, pl.ds(k_start, block_k), :]
+            step = jax.vmap(
+                lambda qh, kh, vh, acc_h, m, l: _online_softmax_block(
+                    qh, kh, vh, acc_h, m, l, q_start, k_start, masked,
+                    residual))
+            return step(q, k_blk, v_blk, acc, row_max, row_sum)
+        return body
+
+    carry = (jnp.zeros((g, block_q, d), jnp.float32),
+             jnp.full((g, block_q), NEG_INF, jnp.float32),
+             jnp.zeros((g, block_q), jnp.float32))
+    if causal:
+        carry = _causal_kv_sweep(make_body, carry, q_start, block_q, block_k)
+    else:
+        carry = jax.lax.fori_loop(0, seq_len // block_k, make_body(False),
+                                  carry)
+    acc, row_max, row_sum = carry
+    denom = jnp.where(row_sum == 0.0, 1.0, row_sum)
+    out_ref[...] = (acc / denom[:, :, None]).astype(out_ref.dtype)
+    lse_ref[:, 0, pl.ds(q_start, block_q)] = (
+        row_max + jnp.log(denom)).astype(lse_ref.dtype)
+
+
+def _fwd_bh_block(bh: int, group: int, seq_len: int, d: int, dtype) -> int:
+    """Head-block size for the experimental batched resident forward:
+    TPUHIVE_FLASH_BH_BLOCK (0/unset = off), clamped to divisibility and
+    the resident VMEM budget; MHA only."""
+    want = int(os.environ.get("TPUHIVE_FLASH_BH_BLOCK", "0") or 0)
+    if want <= 1 or group != 1:
+        return 1
+    g = want
+    while g > 1 and (bh % g or not _kv_resident(seq_len, d, dtype, factor=g)):
+        g -= 1
+    return g
+
+
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
                                              "interpret", "scale"))
 def _flash_fwd_bhsd(q, k, v, causal: bool, block_q: int, block_k: int,
@@ -286,6 +350,24 @@ def _flash_fwd_bhsd(q, k, v, causal: bool, block_q: int, block_k: int,
         _struct(q.shape, q.dtype, q),
         _struct((bh, 1, seq_len), jnp.float32, q),
     ]
+    bh_block = _fwd_bh_block(bh, group, seq_len, d, q.dtype)
+    if bh_block > 1:
+        return pl.pallas_call(
+            functools.partial(_fwd_kernel_resident_bh, causal=causal,
+                              scale=scale, block_k=block_k, seq_len=seq_len),
+            grid=(bh // bh_block, seq_len // block_q),
+            in_specs=[
+                pl.BlockSpec((bh_block, block_q, d), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((bh_block, seq_len, d), lambda b, i: (b, 0, 0)),
+                pl.BlockSpec((bh_block, seq_len, d), lambda b, i: (b, 0, 0)),
+            ],
+            out_specs=[
+                pl.BlockSpec((bh_block, block_q, d), lambda b, i: (b, i, 0)),
+                pl.BlockSpec((bh_block, 1, seq_len), lambda b, i: (b, 0, 0)),
+            ],
+            out_shape=out_shape,
+            interpret=interpret,
+        )(q, k, v)
     if _kv_resident(seq_len, d, q.dtype):
         return pl.pallas_call(
             functools.partial(_fwd_kernel_resident, causal=causal, scale=scale,

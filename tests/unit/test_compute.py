@@ -213,6 +213,41 @@ def test_flash_attention_streaming_path_matches_oracle(monkeypatch):
                 err_msg=f"streaming d{name} (causal={causal})")
 
 
+def test_flash_attention_bh_block_forward_matches_oracle(monkeypatch):
+    """Experimental G-heads-per-program resident forward
+    (TPUHIVE_FLASH_BH_BLOCK): same math as the per-head kernel, batched —
+    forward must match the oracle bit-for-tolerance; the env knob is read
+    at trace time so caches are dropped first."""
+    monkeypatch.setenv("TPUHIVE_FLASH_BH_BLOCK", "4")
+    jax.clear_caches()
+    batch, seq, heads, d = 2, 256, 4, 32
+    keys = jax.random.split(jax.random.PRNGKey(17), 3)
+    q = jax.random.normal(keys[0], (batch, seq, heads, d))
+    k = jax.random.normal(keys[1], (batch, seq, heads, d))
+    v = jax.random.normal(keys[2], (batch, seq, heads, d))
+    do = jax.random.normal(jax.random.PRNGKey(18), q.shape)
+    try:
+        for causal in (True, False):
+            out = flash_attention(q, k, v, causal=causal, interpret=True)
+            ref = reference_attention(q, k, v, causal=causal)
+            np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                       atol=2e-5, rtol=2e-5,
+                                       err_msg=f"bh-block causal={causal}")
+        # the batched fwd's lse residual feeds the standard bwd kernels
+        _, vjp = jax.vjp(
+            lambda q, k, v: flash_attention(q, k, v, causal=True,
+                                            interpret=True), q, k, v)
+        _, vjp_ref = jax.vjp(
+            lambda q, k, v: reference_attention(q, k, v, causal=True),
+            q, k, v)
+        for got, want, name in zip(vjp(do), vjp_ref(do), "qkv"):
+            np.testing.assert_allclose(
+                np.asarray(got), np.asarray(want), atol=2e-4, rtol=2e-4,
+                err_msg=f"bh-block d{name}")
+    finally:
+        jax.clear_caches()    # don't leak bh-block executables to others
+
+
 def _gqa_operands(batch=2, seq=256, heads=4, kv_heads=2, d=32, seed=13):
     keys = jax.random.split(jax.random.PRNGKey(seed), 4)
     q = jax.random.normal(keys[0], (batch, seq, heads, d))

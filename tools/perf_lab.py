@@ -24,6 +24,16 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax
 
+if os.environ.get("JAX_PLATFORMS") == "cpu":
+    # the axon TPU plugin overrides the env var; the config API sticks —
+    # same guard as __graft_entry__._ensure_cpu_devices_if_requested, so
+    # sweep subprocesses can run off-TPU (CI smoke) instead of hanging on
+    # a dead tunnel
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except RuntimeError:
+        pass
+
 
 def main() -> None:
     variant = sys.argv[1] if len(sys.argv) > 1 else "baseline"
@@ -124,6 +134,10 @@ def main() -> None:
             return jnp.mean(jnp.square(x.astype(jnp.float32)))
 
         T.TransformerLM.loss = staticmethod(loss_no_head)
+    elif variant.startswith("bhblock:"):
+        # experimental G-heads-per-program resident forward
+        # (ops/flash_attention.py _fwd_kernel_resident_bh)
+        os.environ["TPUHIVE_FLASH_BH_BLOCK"] = variant.split(":")[1]
     elif variant.startswith("gqa:"):
         # grouped-query attention point: n_kv_heads < n_heads through the
         # native-GQA kernels (no expanded K/V copy)
@@ -154,5 +168,79 @@ def main() -> None:
           f"loss={metrics['loss']:.4f}")
 
 
+#: the component-share ablation set PERF.md's step-share table is built
+#: from; each entry is (variant, preset, batch, seq)
+SWEEP = [
+    ("baseline", "t2t-base", 64, 1024),
+    ("noattn", "t2t-base", 64, 1024),
+    ("nomlp", "t2t-base", 64, 1024),
+    ("nohead", "t2t-base", 64, 1024),
+    ("dense", "t2t-base", 64, 1024),
+    ("baseline", "t2t-big", 32, 1024),
+    ("noattn", "t2t-big", 32, 1024),
+    ("nomlp", "t2t-big", 32, 1024),
+    ("nohead", "t2t-big", 32, 1024),
+]
+
+
+def sweep(out_path: str) -> None:
+    """Run the ablation set, each variant in its OWN subprocess with a hard
+    timeout — the r4 attempt died to one compile hanging 10+ minutes on a
+    sick tunnel; a sweep must record every variant that completes and mark
+    the ones that don't. Writes a JSON artifact for docs/bench_runs/."""
+    import json
+    import re
+    import subprocess
+    import time
+
+    # anchor relative paths to the repo root and fail BEFORE the (up to
+    # 90-minute) sweep if the artifact cannot be written
+    if not os.path.isabs(out_path):
+        out_path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            out_path)
+    os.makedirs(os.path.dirname(out_path), exist_ok=True)
+    with open(out_path, "a"):
+        pass
+
+    results = []
+    for variant, preset, batch, seq in SWEEP:
+        argv = [sys.executable, os.path.abspath(__file__), variant, preset,
+                str(batch), str(seq), "24"]
+        started = time.perf_counter()
+        try:
+            proc = subprocess.run(argv, capture_output=True, text=True,
+                                  timeout=600)
+            line = (proc.stdout.strip().splitlines() or [""])[-1]
+            match = re.search(
+                r"([\d.]+) ms/step, ([\d,]+) tok/s, mfu=([\d.]+)", line)
+            entry = {"variant": variant, "preset": preset, "batch": batch,
+                     "seq": seq, "rc": proc.returncode,
+                     "elapsed_s": round(time.perf_counter() - started, 1)}
+            if proc.returncode == 0 and match:
+                entry.update(step_ms=float(match.group(1)),
+                             tokens_per_sec=float(match.group(2).replace(",", "")),
+                             mfu=float(match.group(3)))
+            else:
+                entry["error"] = (proc.stderr.strip()[-300:]
+                                  or "no parsable output")
+        except subprocess.TimeoutExpired:
+            entry = {"variant": variant, "preset": preset, "batch": batch,
+                     "seq": seq, "rc": None, "error": "timeout after 600s"}
+        print(f"sweep: {entry}", file=sys.stderr, flush=True)
+        results.append(entry)
+    doc = {"purpose": "component step-share ablation (PERF.md table)",
+           "method": "train_loop wall-clock, per-variant subprocess, "
+                     "600s timeout each",
+           "results": results}
+    with open(out_path, "w") as fh:
+        json.dump(doc, fh, indent=1)
+    print(f"wrote {out_path}")
+
+
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "sweep":
+        sweep(sys.argv[2] if len(sys.argv) > 2
+              else "docs/bench_runs/r5_ablation.json")
+    else:
+        main()
