@@ -198,6 +198,10 @@ def generate(
 ) -> jax.Array:
     """Generate ``max_new_tokens`` continuations: returns [B, P+N] int32.
 
+    Requires an autoregressive model: a bidirectional encoder config
+    (``causal=False``, models/encoder.py) has no valid left-to-right
+    factorization to sample from.
+
     With ``batched_prefill`` (default) the prompt's K/V enter the cache via
     ONE full-width trunk pass and the decode scan runs only the generated
     positions — a 1-2k-token prompt costs one batched forward instead of
@@ -210,6 +214,9 @@ def generate(
     in bf16 a batched and a sequential matmul differ in accumulation
     order, so greedy argmax near-ties (untrained weights) can pick
     different tokens — same caveat as any batch-size change."""
+    if not config.causal:
+        raise ValueError("generate() needs an autoregressive model; this "
+                         "config is a bidirectional encoder (causal=False)")
     batch, prompt_len = prompt.shape
     total = prompt_len + max_new_tokens
     if total > config.max_seq_len:
@@ -256,6 +263,13 @@ def evaluate(
 ) -> Dict[str, float]:
     """Mean held-out loss/perplexity over ``num_batches`` from an iterator
     of [B, L+1] token arrays (e.g. data.prefetch_to_device)."""
+    if not config.causal:
+        # next-token CE through bidirectional attention would see each
+        # target in its own input — perplexity collapses toward 1,
+        # silently wrong rather than loudly refused
+        raise ValueError("evaluate() scores next-token perplexity, which "
+                         "needs an autoregressive model; this config is a "
+                         "bidirectional encoder (causal=False)")
     if num_batches < 1:
         raise ValueError(f"num_batches must be >= 1, got {num_batches}")
     loss_fn = _eval_loss_fn(config, mesh)

@@ -1,0 +1,120 @@
+"""Bidirectional encoder family: masked-language-model training.
+
+The decoder-only LM (transformer.py) and this encoder share every layer —
+the ONLY architectural difference is ``config.causal=False``, which every
+attention path (single-shard flash kernels, flash-ring sequence
+parallelism, the pipelined trunk) already takes as a flag. What this
+module adds is the MLM objective (BERT-style dynamic masking) and its
+adapter into the sharded train step, so the full parallelism stack
+(pp/dp/fsdp/tp/sp) trains encoders unchanged.
+
+The reference has no model code at all (SURVEY.md §2: it launches
+trainings); this extends the compute stack beyond it with a second model
+family next to the causal LM.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .transformer import PRESETS, Params, TransformerConfig, TransformerLM
+
+#: encoder presets mirror the LM geometries with bidirectional attention;
+#: the top vocab id is reserved as the [MASK] token (mask_token_id below)
+ENCODER_PRESETS = {
+    name: dataclasses.replace(PRESETS[name], causal=False)
+    for name in ("tiny", "t2t-base", "t2t-big")
+}
+
+
+def mask_token_id(config: TransformerConfig) -> int:
+    """[MASK] is the top vocab id — no vocab surgery, the embedding row
+    already exists; data pipelines must simply not emit it as text."""
+    return config.vocab_size - 1
+
+
+def mask_tokens(
+    key: jax.Array,
+    tokens: jax.Array,                  # [B, L] int32
+    config: TransformerConfig,
+    mask_ratio: float = 0.15,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """BERT-style dynamic masking: select ``mask_ratio`` of positions; of
+    those 80% become [MASK], 10% a uniform random token, 10% keep the
+    original (the model must still predict at kept positions — it cannot
+    trust its input). Returns (inputs, targets, mask) with mask [B, L]
+    bool over the SELECTED positions. Fully shape-static (jit/shard-safe):
+    the realized mask count is binomial around the ratio, exactly the
+    original dynamic-masking recipe."""
+    select_key, op_key, rand_key = jax.random.split(key, 3)
+    uniform = jax.random.uniform(select_key, tokens.shape)
+    mask = uniform < mask_ratio
+    op = jax.random.uniform(op_key, tokens.shape)
+    random_tokens = jax.random.randint(rand_key, tokens.shape, 0,
+                                       config.vocab_size, dtype=tokens.dtype)
+    inputs = jnp.where(mask & (op < 0.8), mask_token_id(config), tokens)
+    inputs = jnp.where(mask & (op >= 0.8) & (op < 0.9), random_tokens, inputs)
+    return inputs, tokens, mask
+
+
+def mlm_loss(
+    params: Params,
+    inputs: jax.Array,                  # [B, L] int32 (post-masking)
+    targets: jax.Array,                 # [B, L] int32 (originals)
+    mask: jax.Array,                    # [B, L] bool — selected positions
+    config: TransformerConfig,
+    mesh=None,
+) -> jax.Array:
+    """Cross-entropy over the selected positions only, mean per masked
+    token (f32). Shares the LM loss's memory machinery
+    (transformer._lse_minus_target / _chunked_ce behind the same
+    _loss_chunk threshold), so encoder training holds the batch sizes the
+    causal LM does instead of OOMing on a full [N, vocab] logits buffer."""
+    from .transformer import _chunked_ce, _loss_chunk, _lse_minus_target
+
+    n_tokens = targets.shape[0] * targets.shape[1]
+    count = jnp.maximum(jnp.sum(mask), 1)
+    chunk = _loss_chunk(n_tokens, config, mesh)
+    if chunk:
+        x = TransformerLM.apply_trunk(params, inputs, config, mesh=mesh)
+        total = _chunked_ce(
+            x.reshape(n_tokens, -1), targets.reshape(n_tokens),
+            params["w_lm_head"], config.dtype, chunk,
+            weights_flat=mask.reshape(n_tokens))
+        return total / count
+    logits = TransformerLM.apply(params, inputs, config, mesh=mesh)
+    per_token = _lse_minus_target(logits, targets) * mask.astype(jnp.float32)
+    return jnp.sum(per_token) / count
+
+
+def pack_mlm_batch(key: jax.Array, tokens: jax.Array,
+                   config: TransformerConfig,
+                   mask_ratio: float = 0.15) -> jax.Array:
+    """(inputs, targets, mask) stacked into ONE int32 [B, 3, L] array so
+    the masked batch rides the existing train-step plumbing (donated
+    buffers, batch sharding over dp×fsdp on the leading dim, grad
+    accumulation) without widening its interface."""
+    inputs, targets, mask = mask_tokens(key, tokens, config, mask_ratio)
+    return jnp.stack([inputs, targets, mask.astype(inputs.dtype)], axis=1)
+
+
+def mlm_loss_packed(params: Params, packed: jax.Array,
+                    config: TransformerConfig, mesh=None) -> jax.Array:
+    """``loss_fn`` adapter for train.make_train_step: unpack [B, 3, L] and
+    compute the masked CE."""
+    inputs, targets, mask = packed[:, 0], packed[:, 1], packed[:, 2]
+    return mlm_loss(params, inputs, targets, mask.astype(bool), config,
+                    mesh=mesh)
+
+
+def init_encoder(key: jax.Array, config: Optional[TransformerConfig] = None,
+                 preset: str = "t2t-base") -> Tuple[Params, TransformerConfig]:
+    """Convenience: (params, config) for an encoder preset."""
+    if config is None:
+        config = ENCODER_PRESETS[preset]
+    if config.causal:
+        raise ValueError("encoder config must have causal=False")
+    return TransformerLM.init(key, config), config

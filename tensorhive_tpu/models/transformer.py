@@ -73,6 +73,12 @@ class TransformerConfig:
     #: smaller per-step matmuls
     pp_microbatches: int = 0
 
+    #: attention direction: True = autoregressive LM (next-token loss,
+    #: KV-cache decode); False = bidirectional encoder (models/encoder.py
+    #: MLM family) — every attention path (flash, ring, pipelined) takes
+    #: the flag, decode requires causal=True
+    causal: bool = True
+
     @property
     def d_head(self) -> int:
         assert self.d_model % self.n_heads == 0
@@ -133,26 +139,64 @@ def _chunk_threshold_bytes() -> int:
     return CHUNKED_LOSS_THRESHOLD_BYTES
 
 
+def _loss_chunk(n_tokens: int, config: "TransformerConfig", mesh) -> int:
+    """Token-chunk size for the memory-efficient CE path, or 0 for the
+    fused full-logits path. The batch dim shards over dp×fsdp and the
+    vocab dim of the LM head (hence of the logits) over tp
+    (parallel/mesh.py batch_sharding + _PARAM_LOGICAL), so what pressures
+    HBM is each device's logits SHARD — compared against the per-device
+    threshold. The chunk shrinks to a divisor of n_tokens (gcd) so
+    awkward batch sizes still chunk instead of silently falling back to
+    the full-logits path and OOMing; a tiny gcd means tiny matmuls, but
+    this branch only engages where the full path would not fit at all —
+    slow-but-runs beats OOM. Shared by the LM and MLM losses."""
+    if not config.loss_chunk_tokens:
+        return 0
+    logits_shards = 1
+    if mesh is not None:
+        logits_shards = (mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
+                         * mesh.shape.get("tp", 1))
+    logits_bytes = n_tokens * config.vocab_size * 4 // logits_shards
+    if logits_bytes <= _chunk_threshold_bytes():
+        return 0
+    return math.gcd(n_tokens, config.loss_chunk_tokens)
+
+
+def _lse_minus_target(logits: jax.Array, targets: jax.Array) -> jax.Array:
+    """Per-token cross entropy as logsumexp − target_logit [..., L]: never
+    materializes the log-probability tensor — the gather and reduction
+    fuse into the logits consumer. Shared by the LM loss, the chunked CE
+    and the MLM loss (models/encoder.py)."""
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    target_logit = jnp.take_along_axis(
+        logits, targets[..., None], axis=-1)[..., 0]
+    return lse - target_logit
+
+
 def _chunked_ce(x_flat: jax.Array, targets_flat: jax.Array, w_head: jax.Array,
-                dtype: Any, chunk_tokens: int) -> jax.Array:
-    """Sum of (logsumexp − target_logit) over all tokens, computed one
-    token-chunk at a time. ``jax.checkpoint`` on the chunk body means the
+                dtype: Any, chunk_tokens: int,
+                weights_flat: Optional[jax.Array] = None) -> jax.Array:
+    """Sum of weight·(logsumexp − target_logit) over all tokens, computed
+    one token-chunk at a time (``weights_flat`` None = unweighted; the MLM
+    loss passes its mask). ``jax.checkpoint`` on the chunk body means the
     backward pass recomputes each chunk's logits instead of storing them —
     peak memory is one [chunk, vocab] f32 buffer either direction."""
     num_chunks = x_flat.shape[0] // chunk_tokens
     x_chunks = x_flat.reshape(num_chunks, chunk_tokens, -1)
     t_chunks = targets_flat.reshape(num_chunks, chunk_tokens)
+    if weights_flat is None:
+        weights_flat = jnp.ones((x_flat.shape[0],), jnp.float32)
+    w_chunks = weights_flat.astype(jnp.float32).reshape(
+        num_chunks, chunk_tokens)
 
     @jax.checkpoint
     def one_chunk(args):
-        x_blk, t_blk = args
+        x_blk, t_blk, w_blk = args
         logits = jnp.dot(x_blk.astype(dtype), w_head.astype(dtype),
                          preferred_element_type=jnp.float32)
-        lse = jax.scipy.special.logsumexp(logits, axis=-1)
-        target_logit = jnp.take_along_axis(logits, t_blk[:, None], axis=-1)[:, 0]
-        return jnp.sum(lse - target_logit)
+        return jnp.sum(_lse_minus_target(logits, t_blk) * w_blk)
 
-    return jnp.sum(jax.lax.map(one_chunk, (x_chunks, t_chunks)))
+    return jnp.sum(jax.lax.map(one_chunk, (x_chunks, t_chunks, w_chunks)))
 
 
 def _rope(x: jax.Array, positions: jax.Array, theta: float) -> jax.Array:
@@ -304,12 +348,13 @@ class TransformerLM:
             # rotates group× smaller KV blocks over ICI). The dense
             # fallbacks expand internally.
             if sp_sharded:
-                return ring_attention(q, k, v, mesh=mesh, causal=True)
+                return ring_attention(q, k, v, mesh=mesh,
+                                      causal=config.causal)
             if config.use_flash:
-                return flash_attention(q, k, v, causal=True)
+                return flash_attention(q, k, v, causal=config.causal)
             from ..ops.flash_attention import reference_attention
 
-            return reference_attention(q, k, v, causal=True)
+            return reference_attention(q, k, v, causal=config.causal)
 
         if config.remat and config.remat_policy == "mlp":
             # selective remat: attention activations (incl. the flash
@@ -356,15 +401,16 @@ class TransformerLM:
                 from ..parallel.ring import ring_attention_local
 
                 return ring_attention_local(q, k, v, "sp",
-                                            mesh.shape["sp"], causal=True)
+                                            mesh.shape["sp"],
+                                            causal=config.causal)
             # inside the pipeline's manual region, pallas only on real TPU:
             # interpret-mode pallas is unsupported under vma tracking (see
             # parallel/pipeline.py) — CI/CPU takes the XLA oracle
             if config.use_flash and jax.default_backend() == "tpu":
-                return flash_attention(q, k, v, causal=True)
+                return flash_attention(q, k, v, causal=config.causal)
             from ..ops.flash_attention import reference_attention
 
-            return reference_attention(q, k, v, causal=True)
+            return reference_attention(q, k, v, causal=config.causal)
 
         def apply_layer(block, x_mb, pos_mb):
             return TransformerLM.block_forward(x_mb, block, config, pos_mb,
@@ -406,26 +452,19 @@ class TransformerLM:
         mesh=None,
     ) -> jax.Array:
         """Next-token cross-entropy, mean over tokens (f32)."""
+        if not config.causal:
+            # bidirectional attention lets position p see token p+1 — its
+            # own target; the next-token loss would collapse toward zero
+            # while training a copy-through model. Same loud refusal as
+            # decode.generate/evaluate.
+            raise ValueError(
+                "TransformerLM.loss is the autoregressive objective; this "
+                "config is a bidirectional encoder (causal=False) — train "
+                "it with models/encoder.mlm_loss_packed")
         inputs, targets = tokens[:, :-1], tokens[:, 1:]
         n_tokens = targets.shape[0] * targets.shape[1]
-        # the batch dim shards over dp×fsdp and the vocab dim of the LM head
-        # (hence of the logits) over tp (parallel/mesh.py batch_sharding +
-        # _PARAM_LOGICAL), so what pressures HBM is each device's logits
-        # shard — compare per-device bytes against the per-device threshold
-        logits_shards = 1
-        if mesh is not None:
-            logits_shards = (mesh.shape.get("dp", 1) * mesh.shape.get("fsdp", 1)
-                             * mesh.shape.get("tp", 1))
-        logits_bytes = n_tokens * config.vocab_size * 4 // logits_shards
-        # shrink the chunk to a divisor of n_tokens (gcd) so awkward batch
-        # sizes still chunk instead of silently falling back to the
-        # full-logits path and OOMing — the exact sizes chunking exists
-        # for. A tiny gcd (odd n_tokens) means tiny matmuls, but this
-        # branch only engages where the full path would not fit at all:
-        # slow-but-runs beats OOM.
-        chunk = math.gcd(n_tokens, config.loss_chunk_tokens) \
-            if config.loss_chunk_tokens else 0
-        if chunk and logits_bytes > _chunk_threshold_bytes():
+        chunk = _loss_chunk(n_tokens, config, mesh)
+        if chunk:
             # chunked head+loss: the [N, vocab] f32 logits tensor is the
             # largest buffer of a training step (17 GB at b128×s1024×32k —
             # past a v5e's whole HBM). Computing lse/target-logit one token
@@ -439,12 +478,7 @@ class TransformerLM:
                 params["w_lm_head"], config.dtype, chunk)
             return total / n_tokens
         logits = TransformerLM.apply(params, inputs, config, mesh=mesh)
-        # logsumexp − target_logit form: never materializes the full [B, L,
-        # vocab] log-probability tensor (2 GB at b16×s1024×32k vocab) — the
-        # gather and the reduction fuse into the logits consumer
-        lse = jax.scipy.special.logsumexp(logits, axis=-1)
-        target_logit = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
-        return jnp.mean(lse - target_logit)
+        return jnp.mean(_lse_minus_target(logits, targets))
 
     @staticmethod
     def param_count(params: Params) -> int:
@@ -457,13 +491,15 @@ def train_flops_per_token(config: TransformerConfig, seq_len: int,
     softmax are bandwidth, not MXU FLOPs). Used for MFU reporting.
 
     Per token, forward: Q+O projections 4·D², K+V projections 4·D·Hkv·Dh
-    (GQA-shrunk when n_kv_heads < n_heads), SwiGLU 6·D·F, causal attention
-    core 2·S·D (QKᵀ + PV at 2·2·S·D halved by causality), LM head 2·D·V.
+    (GQA-shrunk when n_kv_heads < n_heads), SwiGLU 6·D·F, attention core
+    QKᵀ + PV at 2·2·S·D — halved by causality for the LM, full-width for
+    bidirectional encoders (config.causal=False) — LM head 2·D·V.
     Training ≈ 3× forward (one forward + two backward matmuls per forward
     matmul); remat re-runs each block's forward once more."""
     d, f, v = config.d_model, config.d_ff, config.vocab_size
     kv_dim = config.kv_heads * config.d_head
-    per_layer = 4 * d * d + 4 * d * kv_dim + 6 * d * f + 2 * seq_len * d
+    attn_core = (2 if config.causal else 4) * seq_len * d
+    per_layer = 4 * d * d + 4 * d * kv_dim + 6 * d * f + attn_core
     fwd = config.n_layers * per_layer + 2 * d * v
     factor = 4.0 if remat else 3.0
     # remat does not recompute the LM head (it is outside the blocks)

@@ -114,9 +114,15 @@ def make_train_step(
     model_config: TransformerConfig,
     train_config: TrainConfig,
     mesh: Optional[Mesh] = None,
+    loss_fn: Callable = TransformerLM.loss,
 ) -> Callable:
     """Build the jitted train step: (params, opt_state, tokens) ->
-    (params, opt_state, metrics). Params/opt-state buffers are donated."""
+    (params, opt_state, metrics). Params/opt-state buffers are donated.
+
+    ``loss_fn(params, batch, model_config, mesh)`` defaults to the causal
+    LM loss; the MLM encoder family passes models/encoder.mlm_loss_packed
+    with its [B, 3, L] packed batches — everything else (sharding,
+    donation, grad accumulation) is objective-agnostic."""
     optimizer = make_optimizer(train_config)
     accum = train_config.grad_accum_steps
     if accum < 1:
@@ -128,14 +134,14 @@ def make_train_step(
 
     def loss_and_grads(params, tokens):
         if accum <= 1:
-            return jax.value_and_grad(TransformerLM.loss)(
+            return jax.value_and_grad(loss_fn)(
                 params, tokens, model_config, mesh)
         micro = train_config.batch_size // accum
-        micro_tokens = tokens.reshape(accum, micro, tokens.shape[-1])
+        micro_tokens = tokens.reshape(accum, micro, *tokens.shape[1:])
 
         def one_micro(carry, batch_slice):
             loss_sum, grads_sum = carry
-            loss, grads = jax.value_and_grad(TransformerLM.loss)(
+            loss, grads = jax.value_and_grad(loss_fn)(
                 params, batch_slice, model_config, mesh)
             grads = jax.tree_util.tree_map(
                 lambda acc, g: acc + g.astype(acc.dtype), grads_sum, grads)
@@ -269,6 +275,7 @@ def train_loop(
     telemetry=None,
     sync_every: int = 1,
     batches=None,
+    loss_fn: Callable = TransformerLM.loss,
 ) -> Dict[str, float]:
     """Minimal complete loop; returns final metrics. Batches come from the
     ``batches`` iterator when given (e.g. data.prefetch_to_device over token
@@ -281,7 +288,8 @@ def train_loop(
     the reported step time is then wall-clock over each N-step window."""
     key = jax.random.PRNGKey(seed)
     params, opt_state = init_train_state(key, model_config, train_config, mesh)
-    step_fn = make_train_step(model_config, train_config, mesh)
+    step_fn = make_train_step(model_config, train_config, mesh,
+                              loss_fn=loss_fn)
     window_times = []           # (per-step seconds, is_full_window)
     metrics_dev = None
     window_start = time.perf_counter()

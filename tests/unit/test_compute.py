@@ -1054,3 +1054,135 @@ def test_checkpoint_retention_keeps_only_newest(tmp_path):
     assert len(step_dirs) <= 2 and max(step_dirs) == 5
     step, _, _ = restore_checkpoint(path, params, opt_state)
     assert step == 5
+
+
+# -- encoder / MLM family ----------------------------------------------------
+
+def test_encoder_attends_to_future_context():
+    """causal=False must make position p's logits depend on LATER tokens
+    (and causal=True must not) — the one architectural switch between the
+    LM and the encoder family."""
+    from tensorhive_tpu.models.encoder import ENCODER_PRESETS
+
+    config = dataclasses.replace(ENCODER_PRESETS["tiny"], dtype=jnp.float32,
+                                 remat=False, use_flash=False)
+    params = TransformerLM.init(jax.random.PRNGKey(40), config)
+    tokens = jax.random.randint(jax.random.PRNGKey(41), (1, 33), 0,
+                                config.vocab_size)
+    flipped = tokens.at[0, 30].set((tokens[0, 30] + 1) % config.vocab_size)
+    probe = 5                                 # well before position 30
+    enc = TransformerLM.apply(params, tokens, config)
+    enc_flipped = TransformerLM.apply(params, flipped, config)
+    assert not np.allclose(np.asarray(enc[0, probe]),
+                           np.asarray(enc_flipped[0, probe])), \
+        "encoder ignored future context"
+    causal_cfg = dataclasses.replace(config, causal=True)
+    lm = TransformerLM.apply(params, tokens, causal_cfg)
+    lm_flipped = TransformerLM.apply(params, flipped, causal_cfg)
+    np.testing.assert_allclose(np.asarray(lm[0, probe]),
+                               np.asarray(lm_flipped[0, probe]),
+                               atol=1e-6, err_msg="causal mask leaked")
+
+
+def test_mlm_masking_recipe_and_loss_locality():
+    """mask_tokens realizes ~15% selections split 80/10/10, and mlm_loss
+    depends ONLY on selected positions' targets."""
+    from tensorhive_tpu.models import encoder
+
+    config = dataclasses.replace(encoder.ENCODER_PRESETS["tiny"],
+                                 dtype=jnp.float32, remat=False)
+    key = jax.random.PRNGKey(7)
+    tokens = jax.random.randint(key, (8, 256), 0, config.vocab_size - 1)
+    inputs, targets, mask = encoder.mask_tokens(key, tokens, config)
+    frac = float(jnp.mean(mask))
+    assert 0.10 < frac < 0.20, frac
+    selected = np.asarray(mask)
+    masked_frac = float(
+        (np.asarray(inputs)[selected] == encoder.mask_token_id(config)).mean())
+    kept_frac = float(
+        (np.asarray(inputs)[selected] == np.asarray(tokens)[selected]).mean())
+    assert 0.7 < masked_frac < 0.9, masked_frac
+    assert 0.03 < kept_frac < 0.25, kept_frac   # 10% keep + random==orig hits
+    np.testing.assert_array_equal(np.asarray(inputs)[~selected],
+                                  np.asarray(tokens)[~selected])
+
+    params = TransformerLM.init(jax.random.PRNGKey(8), config)
+    loss = encoder.mlm_loss(params, inputs, targets, mask, config)
+    # corrupt targets at UNSELECTED positions: loss must not move
+    corrupted = jnp.where(mask, targets, (targets + 3) % config.vocab_size)
+    loss_corrupted = encoder.mlm_loss(params, inputs, corrupted, mask, config)
+    np.testing.assert_allclose(float(loss), float(loss_corrupted), rtol=1e-6)
+    assert float(loss) > 0.0 and np.isfinite(float(loss))
+
+
+def test_mlm_trains_through_sharded_step():
+    """The encoder family rides the SAME sharded train step as the LM:
+    packed [B, 3, L] batches through make_train_step(loss_fn=
+    mlm_loss_packed) on a dp×fsdp×tp mesh — finite decreasing loss."""
+    from tensorhive_tpu.models import encoder
+    from tensorhive_tpu.train import TrainConfig, init_train_state, make_train_step
+
+    config = dataclasses.replace(encoder.ENCODER_PRESETS["tiny"],
+                                 dtype=jnp.float32, remat=False)
+    mesh = make_mesh(dp=2, fsdp=2, tp=2)
+    train_config = TrainConfig(batch_size=8, seq_len=64, warmup_steps=1,
+                               total_steps=6)
+    params, opt_state = init_train_state(jax.random.PRNGKey(0), config,
+                                         train_config, mesh)
+    step = make_train_step(config, train_config, mesh,
+                           loss_fn=encoder.mlm_loss_packed)
+    key = jax.random.PRNGKey(1)
+    tokens = jax.random.randint(key, (8, 64), 0, config.vocab_size - 1)
+    losses = []
+    for i in range(5):
+        packed = encoder.pack_mlm_batch(jax.random.fold_in(key, i), tokens,
+                                        config)
+        params, opt_state, metrics = step(params, opt_state, packed)
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(l) for l in losses), losses
+    assert losses[-1] < losses[0], losses
+
+
+def test_mlm_chunked_path_matches_full():
+    """The MLM loss behind the forced chunk threshold must equal the
+    full-logits MLM path (value and grads) — it shares _chunked_ce with
+    the LM loss, weighted by the mask."""
+    from tensorhive_tpu.models import encoder
+    import tensorhive_tpu.models.transformer as tf_mod
+
+    config = dataclasses.replace(encoder.ENCODER_PRESETS["tiny"],
+                                 dtype=jnp.float32, use_flash=False,
+                                 remat=False)
+    key = jax.random.PRNGKey(9)
+    params = TransformerLM.init(key, config)
+    tokens = jax.random.randint(key, (4, 32), 0, config.vocab_size - 1)
+    packed = encoder.pack_mlm_batch(key, tokens, config)
+
+    full_cfg = dataclasses.replace(config, loss_chunk_tokens=0)
+    chunked_cfg = dataclasses.replace(config, loss_chunk_tokens=32)
+    old = tf_mod._chunk_threshold_bytes
+    tf_mod._chunk_threshold_bytes = lambda: 0
+    try:
+        full_val, full_grad = jax.value_and_grad(encoder.mlm_loss_packed)(
+            params, packed, full_cfg)
+        chunk_val, chunk_grad = jax.value_and_grad(encoder.mlm_loss_packed)(
+            params, packed, chunked_cfg)
+    finally:
+        tf_mod._chunk_threshold_bytes = old
+    np.testing.assert_allclose(full_val, chunk_val, rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(full_grad),
+                    jax.tree_util.tree_leaves(chunk_grad)):
+        np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+def test_decode_refuses_encoder_configs():
+    from tensorhive_tpu.models import decode, encoder
+
+    config = dataclasses.replace(encoder.ENCODER_PRESETS["tiny"],
+                                 dtype=jnp.float32)
+    params = TransformerLM.init(jax.random.PRNGKey(0), config)
+    prompt = jnp.zeros((1, 8), jnp.int32)
+    with pytest.raises(ValueError, match="bidirectional encoder"):
+        decode.generate(params, config, prompt, max_new_tokens=4)
+    with pytest.raises(ValueError, match="bidirectional encoder"):
+        decode.evaluate(params, config, iter([]), num_batches=1)
