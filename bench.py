@@ -307,7 +307,13 @@ def probe_backend(timeout_s: float = None, cmd=None):
         override = os.environ.get("TPUHIVE_BENCH_PROBE_CMD")
         cmd = shlex.split(override) if override else [
             sys.executable, "-c",
-            "import jax; print('BACKEND=' + jax.default_backend())",
+            "import os, jax\n"
+            # honor an explicit CPU request through the config API — the
+            # axon TPU plugin overrides the env var (same pin as
+            # __graft_entry__/perf_lab), enabling full off-TPU smoke runs
+            "if os.environ.get('JAX_PLATFORMS') == 'cpu':\n"
+            "    jax.config.update('jax_platforms', 'cpu')\n"
+            "print('BACKEND=' + jax.default_backend())",
         ]
     _log(f"probing backend (timeout {timeout_s:.0f}s)...")
     started = time.perf_counter()
@@ -528,6 +534,11 @@ def _bounded_default_backend(timeout_s: float):
         try:
             import jax
 
+            if os.environ.get("JAX_PLATFORMS") == "cpu":
+                try:
+                    jax.config.update("jax_platforms", "cpu")
+                except RuntimeError:
+                    pass  # backend already initialized
             box["backend"] = jax.default_backend()
         except Exception as exc:  # noqa: BLE001
             box["error"] = f"failed: {type(exc).__name__}: {exc}"
