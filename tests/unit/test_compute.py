@@ -1186,3 +1186,23 @@ def test_decode_refuses_encoder_configs():
         decode.generate(params, config, prompt, max_new_tokens=4)
     with pytest.raises(ValueError, match="bidirectional encoder"):
         decode.evaluate(params, config, iter([]), num_batches=1)
+
+
+def test_encoder_mlm_under_pp_sp_matches_unpipelined():
+    """Model family × parallelism matrix: the MLM objective through a
+    pp2×sp2×fsdp2 mesh (bidirectional ring attention INSIDE pipeline
+    stages) must equal the unsharded MLM loss — families and mesh axes
+    compose orthogonally."""
+    from tensorhive_tpu.models import encoder
+
+    config = dataclasses.replace(encoder.ENCODER_PRESETS["tiny"],
+                                 dtype=jnp.float32, remat=False,
+                                 max_seq_len=256)
+    key = jax.random.PRNGKey(50)
+    params = TransformerLM.init(key, config)
+    tokens = jax.random.randint(key, (4, 64), 0, config.vocab_size - 1)
+    packed = encoder.pack_mlm_batch(key, tokens, config)
+    mesh = make_mesh(pp=2, sp=2, fsdp=2)
+    loss_mesh = encoder.mlm_loss_packed(params, packed, config, mesh=mesh)
+    loss_ref = encoder.mlm_loss_packed(params, packed, config)
+    np.testing.assert_allclose(float(loss_mesh), float(loss_ref), rtol=1e-5)
