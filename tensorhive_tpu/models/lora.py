@@ -1,0 +1,97 @@
+"""LoRA fine-tuning: low-rank adapters over frozen base weights.
+
+TPU-idiomatic formulation: adapters live in their OWN pytree (the only
+thing the optimizer sees — the base stays frozen bit-for-bit and is
+closed over by the loss), and the forward "merges on the fly":
+``W_eff = W + (alpha/rank)·A@B`` per target matrix before the standard
+``TransformerLM.apply``. That keeps a single copy of the model math (no
+per-layer adapter plumbing), lets XLA fuse the rank-r update into the
+surrounding graph, and makes serving trivial — ``merge`` bakes the
+adapters into a plain param tree that decode.py and the checkpointing
+path treat like any other model.
+
+Rides the existing sharded train step through ``make_train_step``'s
+``loss_fn`` hook exactly like the MLM family (models/encoder.py); the
+reference has no model layer at all (SURVEY.md §2), so this extends the
+compute stack beyond it.
+
+Reference pattern: LoRA (Hu et al., 2021) — re-derived; no code copied.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .transformer import Params, TransformerConfig, TransformerLM
+
+#: which block matrices get adapters by default — q and v projections,
+#: the original LoRA recipe's sweet spot
+DEFAULT_TARGETS = ("wq", "wv")
+
+
+@dataclasses.dataclass(frozen=True)
+class LoraConfig:
+    rank: int = 8
+    alpha: float = 16.0
+    targets: Tuple[str, ...] = DEFAULT_TARGETS
+
+    @property
+    def scale(self) -> float:
+        return self.alpha / self.rank
+
+
+def init_lora(key: jax.Array, params: Params,
+              lora_config: LoraConfig) -> Dict[str, Any]:
+    """Adapter pytree mirroring ``params['blocks']`` at the target
+    matrices: A [in, r] gaussian with std 1/rank, B = 0 [r, out] — zero
+    init on B makes the adapted model EXACTLY the base model at step 0."""
+    blocks = []
+    for block in params["blocks"]:
+        matrices = sorted(name for name, leaf in block.items()
+                          if hasattr(leaf, "ndim") and leaf.ndim == 2)
+        adapters = {}
+        for name in lora_config.targets:
+            if name not in matrices:
+                raise ValueError(f"no matrix {name!r} in block; targets "
+                                 f"must be drawn from {matrices}")
+            fan_in, fan_out = block[name].shape
+            key, a_key = jax.random.split(key)
+            adapters[name] = {
+                "A": (jax.random.normal(a_key, (fan_in, lora_config.rank),
+                                        jnp.float32)
+                      * (1.0 / lora_config.rank)),
+                "B": jnp.zeros((lora_config.rank, fan_out), jnp.float32),
+            }
+        blocks.append(adapters)
+    return {"blocks": blocks}
+
+
+def merge(params: Params, lora_params: Dict[str, Any],
+          lora_config: LoraConfig) -> Params:
+    """Bake adapters into a plain param tree: W + scale·A@B. The result is
+    indistinguishable from a fully-finetuned model to every consumer
+    (apply / decode.generate / checkpointing)."""
+    merged = dict(params)
+    merged["blocks"] = []
+    for block, adapters in zip(params["blocks"], lora_params["blocks"]):
+        new_block = dict(block)
+        for name, ab in adapters.items():
+            delta = (ab["A"] @ ab["B"]) * lora_config.scale
+            new_block[name] = block[name] + delta.astype(block[name].dtype)
+        merged["blocks"].append(new_block)
+    return merged
+
+
+def lora_loss(lora_params: Dict[str, Any], tokens: jax.Array,
+              config: TransformerConfig, mesh=None, *,
+              base_params: Params, lora_config: LoraConfig) -> jax.Array:
+    """``loss_fn`` for make_train_step with the ADAPTERS as the trained
+    pytree: the base is a closed-over constant (frozen — its gradient is
+    never formed), the merge happens in-graph so autodiff reaches A/B
+    through the effective weights. Use
+    ``functools.partial(lora_loss, base_params=..., lora_config=...)``."""
+    merged = merge(base_params, lora_params, lora_config)
+    return TransformerLM.loss(merged, tokens, config, mesh=mesh)
