@@ -15,6 +15,7 @@ family next to the causal LM.
 from __future__ import annotations
 
 import dataclasses
+import functools
 from typing import Optional, Tuple
 
 import jax
@@ -108,6 +109,56 @@ def mlm_loss_packed(params: Params, packed: jax.Array,
     inputs, targets, mask = packed[:, 0], packed[:, 1], packed[:, 2]
     return mlm_loss(params, inputs, targets, mask.astype(bool), config,
                     mesh=mesh)
+
+
+@functools.lru_cache(maxsize=8)
+def _mlm_eval_loss_fn(config: TransformerConfig, mesh):
+    """Jitted masked loss per (config, mesh) — same cache discipline as
+    decode._eval_loss_fn: a fresh jit per evaluate call would recompile
+    the whole model on every periodic eval."""
+    return jax.jit(functools.partial(mlm_loss_packed, config=config,
+                                     mesh=mesh))
+
+
+def mlm_evaluate(
+    params: Params,
+    config: TransformerConfig,
+    batches,
+    num_batches: int,
+    mesh=None,
+    *,
+    seed: int = 0,
+    mask_ratio: float = 0.15,
+):
+    """Held-out MLM evaluation: masks each batch with a seed-deterministic
+    pattern and averages the masked CE — the encoder counterpart of
+    decode.evaluate, with the SAME signature shape and contract (positional
+    mesh 5th, loud error on an exhausted iterator). Returns
+    {'loss', 'pseudo_perplexity', 'batches'}; pseudo-perplexity is
+    exp(masked CE), the standard encoder proxy for held-out fit."""
+    if config.causal:
+        raise ValueError("mlm_evaluate needs an encoder config "
+                         "(causal=False); score causal LMs with "
+                         "decode.evaluate")
+    if num_batches < 1:
+        raise ValueError(f"num_batches must be >= 1, got {num_batches}")
+    loss_fn = _mlm_eval_loss_fn(config, mesh)
+    key = jax.random.PRNGKey(seed)
+    total = 0.0
+    for index in range(num_batches):
+        try:
+            tokens = next(batches)
+        except StopIteration:
+            raise ValueError(
+                f"batches iterator exhausted at batch {index} of "
+                f"{num_batches}") from None
+        packed = pack_mlm_batch(jax.random.fold_in(key, index), tokens,
+                                config, mask_ratio)
+        total += float(loss_fn(params, packed))
+    mean = total / num_batches
+    return {"loss": mean,
+            "pseudo_perplexity": float(jnp.exp(jnp.float32(mean))),
+            "batches": num_batches}
 
 
 def init_encoder(key: jax.Array, config: Optional[TransformerConfig] = None,

@@ -1303,3 +1303,29 @@ def test_lora_merged_model_serves_like_adapted():
     logits_merged = TransformerLM.apply(merged, prompt, config)
     base_logits = TransformerLM.apply(base, prompt, config)
     assert not np.allclose(np.asarray(logits_merged), np.asarray(base_logits))
+
+
+def test_mlm_evaluate_deterministic_and_guarded():
+    from tensorhive_tpu.models import encoder
+
+    config = dataclasses.replace(encoder.ENCODER_PRESETS["tiny"],
+                                 dtype=jnp.float32, remat=False)
+    params = TransformerLM.init(jax.random.PRNGKey(60), config)
+    key = jax.random.PRNGKey(61)
+    batches = [jax.random.randint(jax.random.fold_in(key, i), (4, 64), 0,
+                                  config.vocab_size - 1) for i in range(3)]
+    result = encoder.mlm_evaluate(params, config, iter(batches), 3, seed=5)
+    again = encoder.mlm_evaluate(params, config, iter(batches), 3, seed=5)
+    assert result["batches"] == 3
+    assert np.isfinite(result["loss"]) and result["loss"] > 0
+    assert result["loss"] == again["loss"], "seeded masking must be stable"
+    assert result["pseudo_perplexity"] == pytest.approx(
+        float(np.exp(np.float32(result["loss"]))))
+    other = encoder.mlm_evaluate(params, config, iter(batches), 3, seed=6)
+    assert other["loss"] != result["loss"]
+    with pytest.raises(ValueError, match="encoder config"):
+        encoder.mlm_evaluate(params, dataclasses.replace(config, causal=True),
+                             iter(batches), 1)
+    # same exhaustion contract as decode.evaluate: loud, not silent
+    with pytest.raises(ValueError, match="exhausted at batch 3"):
+        encoder.mlm_evaluate(params, config, iter(batches), 5)
