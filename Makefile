@@ -1,7 +1,7 @@
 # Developer entry points. `check` is the static gate (reference CI parity:
 # mypy + flake8 per .circleci/config.yml:33-38): the dependency-free AST
 # lint always runs; mypy/ruff run when installed (absent from this image).
-.PHONY: check lint test bench probe
+.PHONY: check lint test bench probe metrics-smoke
 
 check: lint
 	@command -v ruff >/dev/null 2>&1 && ruff check . || echo "ruff not installed; skipped (tools/lint.py covered the always-on subset)"
@@ -15,6 +15,11 @@ test:
 
 bench:
 	python bench.py
+
+# boots the WSGI app in-process on an ephemeral port and scrapes
+# /api/metrics over HTTP (Prometheus text-format smoke test)
+metrics-smoke:
+	python tools/metrics_smoke.py
 
 probe:
 	$(MAKE) -C tensorhive_tpu/native

@@ -19,6 +19,7 @@ import dataclasses
 import json
 import logging
 import threading
+import time
 from typing import Any, Callable, Dict, List, Optional
 
 from werkzeug.exceptions import HTTPException
@@ -26,6 +27,7 @@ from werkzeug.routing import Map, Rule
 from werkzeug.wrappers import Request, Response
 
 from ..db.models.user import User
+from ..observability import get_registry, get_tracer
 from ..utils.exceptions import (
     ConflictError,
     ForbiddenError,
@@ -38,6 +40,18 @@ from .jwt import AuthError
 from .schema import validate as schema_validate
 
 log = logging.getLogger(__name__)
+
+# per-endpoint request accounting: labels are the registered route PATTERN
+# (bounded cardinality — path params never leak into labels), the method,
+# and the status class ("2xx"/"4xx"/...)
+_REQUESTS = get_registry().counter(
+    "tpuhive_api_requests_total",
+    "API requests dispatched, by route pattern, method and status class.",
+    labels=("endpoint", "method", "status"))
+_REQUEST_SECONDS = get_registry().histogram(
+    "tpuhive_api_request_seconds",
+    "API request dispatch latency by route pattern and method.",
+    labels=("endpoint", "method"))
 
 
 @dataclasses.dataclass
@@ -176,13 +190,40 @@ class ApiApp:
     def dispatch(self, request: Request) -> Response:
         if request.method == "OPTIONS":
             return self._with_cors(Response(status=204))
+        started = time.perf_counter()
+        tracer = get_tracer()
+        span = tracer.start_span(f"api {request.method} {request.path}",
+                                 kind="api", method=request.method)
+        try:
+            response, endpoint_label = self._dispatch(request)
+        except BaseException:
+            tracer.end_span(span, status="error")
+            raise
+        status_class = f"{response.status_code // 100}xx"
+        _REQUESTS.labels(endpoint=endpoint_label, method=request.method,
+                         status=status_class).inc()
+        _REQUEST_SECONDS.labels(endpoint=endpoint_label,
+                                method=request.method).observe(
+                                    time.perf_counter() - started)
+        tracer.end_span(span,
+                        status="ok" if response.status_code < 500 else "error",
+                        endpoint=endpoint_label,
+                        http_status=response.status_code)
+        return response
+
+    def _dispatch(self, request: Request) -> "tuple[Response, str]":
+        """Route + run one request; returns (response, route-pattern label).
+
+        The label is the REGISTERED pattern (e.g. ``/jobs/<int:job_id>``),
+        never the concrete path, keeping metric cardinality bounded."""
         adapter = self.url_map.bind_to_environ(request.environ)
         try:
             endpoint_name, path_args = adapter.match()
         except HTTPException as exc:
-            return self._with_cors(self._error(exc.code or 500, exc.description))
+            return (self._with_cors(self._error(exc.code or 500, exc.description)),
+                    "<unmatched>")
         if callable(endpoint_name):  # spec/static endpoints
-            return self._with_cors(endpoint_name(request))
+            return self._with_cors(endpoint_name(request)), "<spec>"
         endpoint = self._endpoints[endpoint_name]
         try:
             claims = self._authenticate(request, endpoint)
@@ -192,6 +233,10 @@ class ApiApp:
                 # strict_validation against api_specification.yml schemas)
                 schema_validate(context.json(), endpoint.body)
             result = endpoint.handler(context, **path_args)
+            if isinstance(result, Response):
+                # handlers may produce non-JSON payloads directly (the
+                # Prometheus text exposition at /metrics does)
+                return self._with_cors(result), endpoint.path
             body, status = result if isinstance(result, tuple) else (result, 200)
             response = Response(
                 json.dumps(body, default=str),
@@ -213,7 +258,7 @@ class ApiApp:
         except Exception:
             log.exception("unhandled error on %s %s", request.method, request.path)
             response = self._error(500, "internal server error")
-        return self._with_cors(response)
+        return self._with_cors(response), endpoint.path
 
     def _authenticate(self, request: Request, endpoint: Endpoint) -> Optional[Dict]:
         if endpoint.auth is None:

@@ -109,7 +109,22 @@ function renderNodes(main) {
   state.timers.push(setInterval(refresh, NODES_POLL_MS));
 }
 
-/* daemon service health strip (admin): tick p50 + liveness per service */
+/* daemon service health strip (admin): tick p50/p95/max + liveness per
+   service, plus entry points to the observability layer (Prometheus
+   exposition + recent spans) */
+function svcBadge(svc) {
+  const lat = svc.tickP50Ms != null
+    ? "· " + svc.tickP50Ms + "/" + (svc.tickP95Ms ?? "?") + "ms p50/p95"
+    : "";
+  const over = svc.tickOverruns ? " · " + svc.tickOverruns + " overruns" : "";
+  const detail = "every " + svc.intervalS + "s · " + svc.ticksCompleted +
+    " ticks" + over +
+    (svc.tickMaxMs != null ? " · max " + svc.tickMaxMs + "ms" : "");
+  return `<span class="badge ${svc.alive ? "on" : "unsynchronized"}"
+    title="${esc(detail)}">
+    ${esc(svc.name)} ${svc.alive ? "✓" : "DOWN"} ${lat}</span>`;
+}
+
 async function refreshServiceHealth() {
   const el = document.getElementById("svc-health");
   if (!el) return;
@@ -127,11 +142,35 @@ async function refreshServiceHealth() {
   if (!services.length) { el.innerHTML = ""; return; }
   el.innerHTML = `<div class="card"><div class="row">
     <h3 style="margin:0">Services</h3>
-    ${services.map(svc => `<span class="badge ${svc.alive ? "on" : "unsynchronized"}"
-      title="every ${svc.intervalS}s · ${svc.ticksCompleted} ticks">
-      ${esc(svc.name)} ${svc.alive ? "✓" : "DOWN"}
-      ${svc.tickP50Ms != null ? `· ${svc.tickP50Ms}ms` : ""}</span>`).join("")}
+    ${services.map(svcBadge).join("")}
+    <button class="ghost" onclick="openTracesDialog()">traces</button>
+    <a class="ghost" href="/api/metrics" target="_blank"
+       title="Prometheus text exposition">metrics</a>
   </div></div>`;
+}
+
+/* recent-span dump from the ring-buffer tracer (GET /admin/traces) */
+async function openTracesDialog() {
+  let doc;
+  try { doc = await api("/admin/traces?limit=100"); }
+  catch (e) { return toast(e.message, true); }
+  const dialog = document.getElementById("chip-dialog");
+  if (!dialog) return;
+  delete dialog.dataset.uid;
+  const spans = (doc.spans || []).slice().reverse();   // newest first
+  dialog.innerHTML = `<h3 style="margin-top:0">Recent spans</h3>
+    <p class="muted">${doc.recorded} recorded · ring capacity ${doc.capacity}</p>
+    <table><tr><th>seq</th><th>kind</th><th>span</th><th>ms</th><th>status</th></tr>
+      ${spans.map(sp => `<tr><td>${sp.seq}</td><td>${esc(sp.kind)}</td>
+        <td class="kv" title="${esc(JSON.stringify(sp.attrs))}">
+          ${sp.parentId ? "↳ " : ""}${esc(sp.name)}</td>
+        <td>${sp.durationMs != null ? sp.durationMs : "–"}</td>
+        <td>${sp.status === "ok" ? "✓" : "⚠ " + esc(sp.status)}</td></tr>`).join("")}
+    </table>
+    <div class="row" style="margin-top:.8rem">
+      <button class="ghost" onclick="this.closest('dialog').close()">Close</button>
+    </div>`;
+  dialog.showModal();
 }
 
 function nodeCard(host, node) {

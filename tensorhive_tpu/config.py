@@ -23,7 +23,11 @@ from __future__ import annotations
 
 import dataclasses
 import os
-import tomllib
+
+try:
+    import tomllib
+except ImportError:  # Python < 3.11: stdlib tomllib landed in 3.11
+    import tomli as tomllib  # type: ignore[no-redef]
 from pathlib import Path
 from typing import Any, Dict, List, Mapping, Optional
 

@@ -8,6 +8,7 @@ from . import (
     group,
     job,
     nodes,
+    observability,
     reservation,
     resource,
     restriction,
@@ -17,4 +18,4 @@ from . import (
 )
 
 ALL_MODULES = (user, group, resource, nodes, reservation, restriction, schedule,
-               job, task)
+               job, task, observability)

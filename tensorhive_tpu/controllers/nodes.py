@@ -135,20 +135,30 @@ def get_cpu_metrics(context: RequestContext, hostname: str):
            alive=s("boolean"),
            intervalS=s("number"),
            ticksCompleted=s("integer"),
-           tickP50Ms=s("number", nullable=True)))})
+           tickOverruns=s("integer"),
+           tickP50Ms=s("number", nullable=True),
+           tickP95Ms=s("number", nullable=True),
+           tickMaxMs=s("number", nullable=True)))})
 def get_service_health(context: RequestContext):
     """Per-service tick stats — the loop-timing observability the reference
     only wrote to debug logs (MonitoringService.py:38-54; SURVEY.md §5
-    tracing), surfaced as API so the UI can show daemon health."""
+    tracing), surfaced as API so the UI can show daemon health. Latency is
+    p50/p95/max from the registry-backed tick histogram."""
+    def ms(seconds):
+        return round(seconds * 1000, 2) if seconds is not None else None
+
     service_manager = get_manager().service_manager
     health = []
     for service in (service_manager.services if service_manager else []):
-        p50 = service.tick_latency_p50()
+        stats = service.tick_latency_stats()
         health.append({
             "name": service.name,
             "alive": service.is_alive(),
             "intervalS": service.interval_s,
             "ticksCompleted": service.ticks_completed,
-            "tickP50Ms": round(p50 * 1000, 2) if p50 is not None else None,
+            "tickOverruns": service.tick_overruns,
+            "tickP50Ms": ms(stats["p50"]),
+            "tickP95Ms": ms(stats["p95"]),
+            "tickMaxMs": ms(stats["max"]),
         })
     return health

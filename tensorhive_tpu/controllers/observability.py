@@ -1,0 +1,65 @@
+"""Observability controller: metrics exposition + trace dump.
+
+The two read surfaces of tensorhive_tpu/observability:
+
+* ``GET /metrics`` — Prometheus text format (version 0.0.4), unauthenticated
+  like a conventional scrape target (it carries latency/count aggregates,
+  never user data; JIRIAF-style virtual-kubelet integrations assume exactly
+  this per-resource endpoint).
+* ``GET /admin/traces`` — recent spans from the ring-buffer tracer,
+  admin-auth (span attrs include hostnames and job ids).
+"""
+from __future__ import annotations
+
+from typing import Dict
+
+from werkzeug.wrappers import Response
+
+from ..api.app import RequestContext, int_arg, route
+from ..api.schema import arr, obj, s
+from ..observability import get_registry, get_tracer
+
+#: content type Prometheus scrapers negotiate for the text format
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+SPAN_SCHEMA = obj(
+    required=["spanId", "name", "kind", "startTs", "status", "seq"],
+    spanId=s("string"),
+    parentId=s("string", nullable=True),
+    name=s("string"),
+    kind=s("string"),
+    startTs=s("number"),
+    durationMs=s("number", nullable=True),
+    status=s("string"),
+    attrs={"type": "object", "additionalProperties": True},
+    seq=s("integer"),
+)
+
+
+@route("/metrics", ["GET"], auth=None,
+       summary="Prometheus metrics exposition (text format)",
+       tag="observability", responses={200: s("string")})
+def get_metrics(context: RequestContext) -> Response:
+    return Response(get_registry().render(),
+                    content_type=PROMETHEUS_CONTENT_TYPE)
+
+
+@route("/admin/traces", ["GET"], auth="admin",
+       summary="Recent spans from the ring-buffer tracer",
+       tag="observability",
+       query={"limit": s("integer"), "kind": s("string")},
+       responses={200: obj(required=["capacity", "recorded", "spans"],
+                           capacity=s("integer"),
+                           recorded=s("integer"),
+                           spans=arr(SPAN_SCHEMA))})
+def get_traces(context: RequestContext) -> Dict:
+    """Completed spans oldest-first (monotone ``seq``); ``?limit=`` caps the
+    dump, ``?kind=`` filters (api, tick, monitor, transport, probe, job)."""
+    tracer = get_tracer()
+    limit = int_arg(context, "limit")
+    kind = context.request.args.get("kind")
+    return {
+        "capacity": tracer.capacity,
+        "recorded": len(tracer),
+        "spans": tracer.recent(limit=limit, kind=kind),
+    }

@@ -52,10 +52,28 @@ from __future__ import annotations
 
 import base64
 import json
+import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
+from ...observability import get_registry, get_tracer
 from ...utils.exceptions import TelemetryError
+
+# probe-level accounting on top of the transport layer's per-command
+# histograms: a "round" is one fan-out to every host + parse, the unit the
+# monitoring tick actually waits on
+_ROUND_SECONDS = get_registry().histogram(
+    "tpuhive_probe_round_seconds",
+    "One probe round: fan-out to all hosts plus parsing.")
+_ROUNDS_TOTAL = get_registry().counter(
+    "tpuhive_probe_rounds_total", "Probe rounds executed.")
+_PROBE_FAILURES = get_registry().counter(
+    "tpuhive_probe_failures_total",
+    "Per-host probe failures by reason (unreachable, unparseable).",
+    labels=("host", "reason"))
+_PROBE_HOSTS_OK = get_registry().gauge(
+    "tpuhive_probe_hosts_ok",
+    "Hosts whose last probe round produced a valid sample.")
 
 PROBE_VERSION = 1
 #: stable marker present in every probe invocation (fake transports match it)
@@ -320,17 +338,29 @@ def collect_probe_samples(
 
     log = logging.getLogger(__name__)
     samples: Dict[str, Optional[ProbeSample]] = {}
-    for hostname, result in transports.run_on_all(command or probe_command()).items():
-        if not result.ok:
-            log.warning("probe failed on %s: %s", hostname,
-                        result.stderr.strip() or f"exit {result.exit_code}")
-            samples[hostname] = None
-            continue
-        try:
-            samples[hostname] = parse_probe_output(result.stdout)
-        except TelemetryError as exc:
-            log.warning("unparseable probe output from %s: %s", hostname, exc)
-            samples[hostname] = None
+    started = time.perf_counter()
+    with get_tracer().span("probe.collect", kind="probe") as span:
+        for hostname, result in transports.run_on_all(command or probe_command()).items():
+            if not result.ok:
+                log.warning("probe failed on %s: %s", hostname,
+                            result.stderr.strip() or f"exit {result.exit_code}")
+                _PROBE_FAILURES.labels(host=hostname, reason="unreachable").inc()
+                samples[hostname] = None
+                continue
+            try:
+                samples[hostname] = parse_probe_output(result.stdout)
+            except TelemetryError as exc:
+                log.warning("unparseable probe output from %s: %s", hostname, exc)
+                _PROBE_FAILURES.labels(host=hostname, reason="unparseable").inc()
+                samples[hostname] = None
+        healthy = sum(1 for sample in samples.values() if sample is not None)
+        span.attrs["hosts"] = str(len(samples))
+        span.attrs["ok"] = str(healthy)
+        if healthy < len(samples):
+            span.status = "error"
+    _ROUND_SECONDS.observe(time.perf_counter() - started)
+    _ROUNDS_TOTAL.inc()
+    _PROBE_HOSTS_OK.set(healthy)
     return samples
 
 

@@ -5,18 +5,21 @@ a thread with an abstract ``inject`` hook through which ServiceManager pushes
 the shared managers (ServiceManager.py:configure_all_services). Here the
 injection is explicit and typed, and every service gets uniform tick timing:
 the reference hand-rolled per-loop perf_counter bookkeeping in each service
-(MonitoringService.py:38-54, ProtectionService.py:81) — that bookkeeping is
-the *only* profiling the reference has (SURVEY.md §5 Tracing), so it is kept
-and centralized, feeding the poll-latency metric BASELINE.md asks for.
+(MonitoringService.py:38-54, ProtectionService.py:81) — that bookkeeping was
+the *only* profiling the reference has (SURVEY.md §5 Tracing). It is kept,
+centralized, and now feeds the shared metrics registry
+(tensorhive_tpu/observability): tick durations land in a
+``tpuhive_service_tick_seconds`` histogram, overruns in a counter, and each
+tick records a span, so ``/api/metrics`` and ``/api/admin/traces`` expose
+what used to die in debug logs.
 """
 from __future__ import annotations
 
-import collections
 import logging
-import statistics
 import time
-from typing import TYPE_CHECKING, Deque, Optional
+from typing import TYPE_CHECKING, Dict, Optional
 
+from ...observability import Histogram, get_registry, get_tracer
 from ...utils.threading import StoppableThread
 
 if TYPE_CHECKING:
@@ -25,12 +28,29 @@ if TYPE_CHECKING:
 
 log = logging.getLogger(__name__)
 
+# registered once at import; every Service instance feeds the child for its
+# own service label, so all daemons share one exposition surface
+_TICK_SECONDS = get_registry().histogram(
+    "tpuhive_service_tick_seconds",
+    "Duration of one service do_run() tick.", labels=("service",))
+_TICKS_TOTAL = get_registry().counter(
+    "tpuhive_service_ticks_total",
+    "Completed service ticks (including failed ones).", labels=("service",))
+_TICK_FAILURES = get_registry().counter(
+    "tpuhive_service_tick_failures_total",
+    "Service ticks that raised an exception.", labels=("service",))
+_TICK_OVERRUNS = get_registry().counter(
+    "tpuhive_service_tick_overruns_total",
+    "Ticks that ran longer than the service interval (interval starvation).",
+    labels=("service",))
+
 
 class Service(StoppableThread):
     """Periodic daemon thread: ``do_run()`` every ``interval_s`` seconds.
 
-    Subclasses implement :meth:`do_run`; the run loop measures each tick and
-    sleeps out the interval remainder (interruptible by shutdown).
+    Subclasses implement :meth:`do_run`; the run loop measures each tick,
+    records it into the metrics registry + span tracer, and sleeps out the
+    interval remainder (interruptible by shutdown).
     """
 
     def __init__(self, interval_s: float, name: Optional[str] = None) -> None:
@@ -38,9 +58,13 @@ class Service(StoppableThread):
         self.interval_s = interval_s
         self.infrastructure_manager: Optional["InfrastructureManager"] = None
         self.transport_manager: Optional["TransportManager"] = None
-        #: rolling window of tick durations (seconds) for latency stats
-        self.tick_durations: Deque[float] = collections.deque(maxlen=256)
+        #: per-INSTANCE latency histogram backing the p50/p95/max
+        #: introspection — private so a fresh service never reports another
+        #: instance's history (the registry child is shared per label)
+        self._tick_hist = Histogram()
         self.ticks_completed = 0
+        self.tick_overruns = 0
+        self._overrun_warned = False
 
     def inject(self, infrastructure_manager: "InfrastructureManager",
                transport_manager: "TransportManager") -> None:
@@ -50,8 +74,12 @@ class Service(StoppableThread):
 
     # -- loop ---------------------------------------------------------------
     def run(self) -> None:
+        tracer = get_tracer()
         while not self.stopped:
             started = time.perf_counter()
+            span = tracer.start_span(f"tick.{self.name}", kind="tick",
+                                     service=self.name)
+            status = "ok"
             try:
                 self.do_run()
             except Exception:
@@ -59,25 +87,56 @@ class Service(StoppableThread):
                 # reference would die silently here — its threads have no
                 # guard and a monitor exception stops all monitoring)
                 log.exception("%s tick failed", self.name)
+                _TICK_FAILURES.labels(service=self.name).inc()
+                status = "error"
             elapsed = time.perf_counter() - started
-            self.tick_durations.append(elapsed)
-            self.ticks_completed += 1
+            tracer.end_span(span, status=status)
+            self.record_tick(elapsed)
             remaining = self.interval_s - elapsed
             if remaining > 0:
                 self.wait(remaining)
             else:
-                log.debug("%s tick overran interval: %.3fs > %.3fs",
-                          self.name, elapsed, self.interval_s)
+                self.record_overrun(elapsed)
+
+    def record_tick(self, elapsed_s: float) -> None:
+        """Account one tick (separate from run() so tests and manual tick
+        drivers hit the identical bookkeeping path)."""
+        self._tick_hist.observe(elapsed_s)
+        _TICK_SECONDS.labels(service=self.name).observe(elapsed_s)
+        _TICKS_TOTAL.labels(service=self.name).inc()
+        self.ticks_completed += 1
+
+    def record_overrun(self, elapsed_s: float) -> None:
+        """A tick exceeded the interval: silent starvation of the poll
+        cadence. Counted always; the FIRST overrun per service is a
+        log.warning (the reference only ever debug-logged these, so a
+        misconfigured interval was invisible in production logs)."""
+        self.tick_overruns += 1
+        _TICK_OVERRUNS.labels(service=self.name).inc()
+        if not self._overrun_warned:
+            self._overrun_warned = True
+            log.warning(
+                "%s tick overran its interval: %.3fs > %.3fs — the service "
+                "is running back-to-back; further overruns log at debug",
+                self.name, elapsed_s, self.interval_s)
+        else:
+            log.debug("%s tick overran interval: %.3fs > %.3fs",
+                      self.name, elapsed_s, self.interval_s)
 
     def do_run(self) -> None:
         raise NotImplementedError
 
     # -- introspection ------------------------------------------------------
     def tick_latency_p50(self) -> Optional[float]:
-        # snapshot first: the service thread appends concurrently and
-        # iterating a mutating deque raises RuntimeError (this is called
-        # from API threads via /admin/services)
-        durations = tuple(self.tick_durations)
-        if not durations:
-            return None
-        return statistics.median(durations)
+        """Median tick duration (seconds) — registry-backed shim kept for
+        callers of the original deque-based API."""
+        return self._tick_hist.quantile(0.5)
+
+    def tick_latency_stats(self) -> Dict[str, Optional[float]]:
+        """{p50, p95, max} tick durations in seconds (None before the first
+        tick); quantiles estimated from histogram buckets, max exact."""
+        return {
+            "p50": self._tick_hist.quantile(0.5),
+            "p95": self._tick_hist.quantile(0.95),
+            "max": self._tick_hist.max,
+        }

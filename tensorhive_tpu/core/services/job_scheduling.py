@@ -18,6 +18,7 @@ from ...config import Config, get_config
 from ...db.models.job import Job, JobStatus
 from ...db.models.reservation import Reservation
 from ...db.models.user import User
+from ...observability import get_registry, get_tracer
 from ...utils.exceptions import NotFoundError, TpuHiveError
 from ...utils.timeutils import minutes_between, utcnow
 from ..scheduling import GreedyScheduler, Scheduler, expand_to_slice_uids
@@ -29,6 +30,38 @@ from .base import Service
 from ...controllers.job import business_execute, business_stop  # noqa: E402
 
 log = logging.getLogger(__name__)
+
+_SPAWNS = get_registry().counter(
+    "tpuhive_jobs_spawned_total",
+    "Jobs the scheduling service started, by trigger (scheduled, queued).",
+    labels=("trigger",))
+_SPAWN_FAILURES = get_registry().counter(
+    "tpuhive_job_spawn_failures_total",
+    "Job starts that failed, by trigger.", labels=("trigger",))
+_STOP_ESCALATIONS = get_registry().counter(
+    "tpuhive_job_stop_escalations_total",
+    "Jobs that ignored a graceful stop and were marked for SIGKILL.")
+_PREEMPTIONS = get_registry().counter(
+    "tpuhive_job_preemptions_total",
+    "Queue-launched jobs preempted for a reservation or foreign process.")
+
+
+def _spawn_job(job: Job, trigger: str) -> bool:
+    """Start one job with spawn accounting + a traced span; returns whether
+    the start succeeded (failures are logged, counted, and swallowed so one
+    bad job never stalls the tick — reference behaviour preserved)."""
+    with get_tracer().span(f"job.spawn.{job.id}", kind="job",
+                           job_id=job.id, trigger=trigger) as span:
+        try:
+            log.info("starting %s job %d (%s)", trigger, job.id, job.name)
+            business_execute(job.id)
+        except TpuHiveError as exc:
+            log.warning("%s job %d failed to start: %s", trigger, job.id, exc)
+            _SPAWN_FAILURES.labels(trigger=trigger).inc()
+            span.status = "error"
+            return False
+    _SPAWNS.labels(trigger=trigger).inc()
+    return True
 
 
 class JobSchedulingService(Service):
@@ -62,12 +95,7 @@ class JobSchedulingService(Service):
             if self._job_would_interfere(job, now):
                 log.info("delaying scheduled job %d: resources busy/reserved", job.id)
                 continue
-            try:
-                log.info("starting scheduled job %d (%s)", job.id, job.name)
-                business_execute(job.id)
-                started = True
-            except TpuHiveError as exc:
-                log.warning("scheduled job %d failed to start: %s", job.id, exc)
+            started = _spawn_job(job, "scheduled") or started
         return started
 
     # -- queue draining (reference :197-208) --------------------------------
@@ -79,11 +107,7 @@ class JobSchedulingService(Service):
         for job in self.scheduler.schedule_jobs(queue, self.required_free_minutes,
                                                 at=now,
                                                 eligible_hosts=self._eligible_hosts_resolver()):
-            try:
-                log.info("starting queued job %d (%s)", job.id, job.name)
-                business_execute(job.id)
-            except TpuHiveError as exc:
-                log.warning("queued job %d failed to start: %s", job.id, exc)
+            _spawn_job(job, "queued")
 
     # -- timed stops with escalation (reference :210-252) -------------------
     def stop_scheduled(self, now) -> None:
@@ -102,8 +126,10 @@ class JobSchedulingService(Service):
             log.warning("stopping job %d failed: %s", job.id, exc)
         job = Job.get(job.id)
         if job.status is JobStatus.running:
-            if now - first_attempt >= self.stop_attempts_after:
+            if (now - first_attempt >= self.stop_attempts_after
+                    and job.id not in self.stubborn_job_ids):
                 self.stubborn_job_ids.add(job.id)
+                _STOP_ESCALATIONS.inc()
         else:
             self.stubborn_job_ids.discard(job.id)
             self._stop_first_attempt.pop(job.id, None)
@@ -117,6 +143,7 @@ class JobSchedulingService(Service):
                 continue
             if self._reservation_imminent(job, now) or self._has_foreign_process(job):
                 log.info("preempting queued job %d: reservation/foreign process", job.id)
+                _PREEMPTIONS.inc()
                 self.stop_with_grace(job, now)
 
     # -- helpers -------------------------------------------------------------

@@ -9,15 +9,26 @@ thread pool and monitors share the single-probe round-trip (monitors/probe.py).
 from __future__ import annotations
 
 import logging
+import time
 from typing import List, Optional
 
 from ...config import Config, get_config
+from ...observability import get_registry, get_tracer
 from ..monitors.base import Monitor
 from ..monitors.cpu import CpuMonitor
 from ..monitors.tpu import TpuMonitor
 from .base import Service
 
 log = logging.getLogger(__name__)
+
+_UPDATE_SECONDS = get_registry().histogram(
+    "tpuhive_monitor_update_seconds",
+    "Duration of one monitor.update() pass over all hosts.",
+    labels=("monitor",))
+_UPDATE_FAILURES = get_registry().counter(
+    "tpuhive_monitor_update_failures_total",
+    "Monitor passes that raised (per-monitor isolation kept the tick alive).",
+    labels=("monitor",))
 
 
 class MonitoringService(Service):
@@ -32,12 +43,21 @@ class MonitoringService(Service):
     def do_run(self) -> None:
         assert self.infrastructure_manager is not None, "service not injected"
         assert self.transport_manager is not None, "service not injected"
+        tracer = get_tracer()
         for monitor in self.monitors:
-            try:
-                monitor.update(self.transport_manager, self.infrastructure_manager)
-            except Exception:
-                # per-monitor isolation: CPU metrics survive a TPU-probe bug
-                log.exception("monitor %s failed", type(monitor).__name__)
+            monitor_name = type(monitor).__name__
+            started = time.perf_counter()
+            with tracer.span(f"monitor.{monitor_name}", kind="monitor") as span:
+                try:
+                    monitor.update(self.transport_manager,
+                                   self.infrastructure_manager)
+                except Exception:
+                    # per-monitor isolation: CPU metrics survive a TPU-probe bug
+                    log.exception("monitor %s failed", monitor_name)
+                    _UPDATE_FAILURES.labels(monitor=monitor_name).inc()
+                    span.status = "error"
+            _UPDATE_SECONDS.labels(monitor=monitor_name).observe(
+                time.perf_counter() - started)
 
 
 def default_monitors(config: Config) -> List[Monitor]:

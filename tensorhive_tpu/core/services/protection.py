@@ -20,10 +20,23 @@ from typing import Dict, List, Optional
 from ...config import Config, get_config
 from ...db.models.reservation import Reservation
 from ...db.models.user import User
+from ...observability import get_registry
 from ..handlers.base import ProtectionHandler, Violation
 from .base import Service
 
 log = logging.getLogger(__name__)
+
+_VIOLATIONS = get_registry().counter(
+    "tpuhive_protection_violations_total",
+    "Violation observations (one per intruder per tick while present).",
+    labels=("kind",))
+_ACTIVE_VIOLATIONS = get_registry().gauge(
+    "tpuhive_protection_active_violations",
+    "Intruders detected in the most recent protection tick.")
+_HANDLER_FAILURES = get_registry().counter(
+    "tpuhive_protection_handler_failures_total",
+    "Protection handlers that raised while acting on a violation.",
+    labels=("handler",))
 
 
 class ProtectionService(Service):
@@ -40,6 +53,10 @@ class ProtectionService(Service):
         assert self.infrastructure_manager is not None, "service not injected"
         violations = self.find_violations()
         self.last_violations = violations
+        _ACTIVE_VIOLATIONS.set(len(violations))
+        for violation in violations.values():
+            _VIOLATIONS.labels(
+                kind="unreserved" if violation.unreserved else "reserved").inc()
         for handler in self.handlers:
             handler.begin_tick()
         for violation in violations.values():
@@ -48,6 +65,8 @@ class ProtectionService(Service):
                     handler.trigger_action(violation)
                 except Exception:
                     log.exception("handler %s failed", type(handler).__name__)
+                    _HANDLER_FAILURES.labels(
+                        handler=type(handler).__name__).inc()
 
     # ------------------------------------------------------------------
     def find_violations(self) -> Dict[str, Violation]:

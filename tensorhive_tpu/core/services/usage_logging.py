@@ -22,10 +22,18 @@ from typing import Dict, List, Optional
 
 from ...config import Config, get_config
 from ...db.models.reservation import Reservation
+from ...observability import get_registry
 from ...utils.timeutils import isoformat, utcnow
 from .base import Service
 
 log = logging.getLogger(__name__)
+
+_SAMPLES = get_registry().counter(
+    "tpuhive_usage_samples_total",
+    "Utilization samples appended to per-reservation usage logs.")
+_ACCOUNTED = get_registry().counter(
+    "tpuhive_usage_reservations_accounted_total",
+    "Expired reservations whose usage averages were persisted.")
 
 REMOVE, HIDE, KEEP = 1, 2, 3
 
@@ -65,6 +73,7 @@ class UsageLoggingService(Service):
     def _append_sample(self, reservation_id: int, sample: Dict) -> None:
         with open(self._path(reservation_id), "a") as fh:
             fh.write(json.dumps(sample) + "\n")
+        _SAMPLES.inc()
 
     @staticmethod
     def _read_samples(path: Path) -> List[Dict]:
@@ -97,6 +106,7 @@ class UsageLoggingService(Service):
                 continue  # still active
             self._persist_averages(reservation, self._read_samples(path))
             self._cleanup(path)
+            _ACCOUNTED.inc()
 
     @staticmethod
     def _persist_averages(reservation: Reservation, samples: List[Dict]) -> None:

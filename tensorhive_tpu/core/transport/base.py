@@ -7,13 +7,26 @@ import concurrent.futures
 import dataclasses
 import logging
 import threading
+import time
 from shlex import quote as shlex_quote
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ...config import Config, HostConfig, get_config
+from ...observability import get_registry, get_tracer
 from ...utils.exceptions import TransportError
 
 log = logging.getLogger(__name__)
+
+# per-host command round-trips: the monitoring fan-out drives one of these
+# per host per ~2 s tick, so this histogram IS the cluster's SSH-latency view
+_COMMAND_SECONDS = get_registry().histogram(
+    "tpuhive_transport_command_seconds",
+    "Remote command round-trip latency per host (fan-out path).",
+    labels=("host",))
+_COMMANDS_TOTAL = get_registry().counter(
+    "tpuhive_transport_commands_total",
+    "Remote commands by host and outcome (ok, error, unreachable).",
+    labels=("host", "outcome"))
 
 
 @dataclasses.dataclass
@@ -183,16 +196,29 @@ class TransportManager:
             return results
 
         def _one(name: str) -> CommandResult:
+            started = time.perf_counter()
             try:
-                return self.for_host(name).run(command, timeout=timeout)
+                result = self.for_host(name).run(command, timeout=timeout)
+                outcome = "ok" if result.ok else "error"
             except TransportError as exc:
                 log.warning("host %s unreachable: %s", name, exc)
-                return CommandResult(
+                outcome = "unreachable"
+                result = CommandResult(
                     host=name, command=command, exit_code=255, stdout="", stderr=str(exc)
                 )
+            _COMMAND_SECONDS.labels(host=name).observe(
+                time.perf_counter() - started)
+            _COMMANDS_TOTAL.labels(host=name, outcome=outcome).inc()
+            return result
 
-        for name, result in zip(hostnames, self._pool.map(_one, hostnames)):
-            results[name] = result
+        with get_tracer().span("transport.run_on_all", kind="transport",
+                               hosts=len(hostnames)) as span:
+            for name, result in zip(hostnames, self._pool.map(_one, hostnames)):
+                results[name] = result
+            failed = sum(1 for result in results.values() if not result.ok)
+            span.attrs["failed"] = str(failed)
+            if failed:
+                span.status = "error"
         return results
 
     def close(self) -> None:

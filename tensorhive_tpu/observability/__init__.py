@@ -1,0 +1,66 @@
+"""Unified observability layer: metrics registry + span tracer.
+
+The reference's only runtime profiling was hand-rolled ``perf_counter``
+bookkeeping inside each service loop (MonitoringService.py:38-54; SURVEY.md
+§5 Tracing) — numbers that died in debug logs. This package gives the whole
+control plane one place where hot-path latencies are measured and exported:
+
+* :mod:`.metrics` — a thread-safe in-process registry (counters, gauges,
+  fixed-bucket histograms) rendered in Prometheus text format at
+  ``GET /api/metrics`` (controllers/observability.py).
+* :mod:`.tracing` — a bounded ring-buffer span tracer with parent ids,
+  dumped at ``GET /api/admin/traces`` (admin-auth).
+
+Metric naming scheme: ``tpuhive_<subsystem>_<what>_<unit>`` — documented in
+docs/OBSERVABILITY.md. Everything here is stdlib-only so workload-side code
+(telemetry.py) can import it on the training-loop path without pulling in
+the API stack.
+"""
+from __future__ import annotations
+
+from .metrics import (
+    DEFAULT_BUCKETS,
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+)
+from .tracing import Span, SpanTracer
+
+_registry = MetricsRegistry()
+_tracer = SpanTracer()
+
+
+def get_registry() -> MetricsRegistry:
+    """Process-wide metrics registry (what /api/metrics renders)."""
+    return _registry
+
+
+def get_tracer() -> SpanTracer:
+    """Process-wide span tracer (what /api/admin/traces dumps)."""
+    return _tracer
+
+
+def reset_observability() -> None:
+    """Zero all metric values and drop recorded spans (test isolation).
+
+    Metric families and their child references stay valid — instrumented
+    modules hold family/child handles created at import time, so a reset
+    must clear values in place rather than discard the objects.
+    """
+    _registry.reset_values()
+    _tracer.clear()
+
+
+__all__ = [
+    "Counter",
+    "DEFAULT_BUCKETS",
+    "Gauge",
+    "Histogram",
+    "MetricsRegistry",
+    "Span",
+    "SpanTracer",
+    "get_registry",
+    "get_tracer",
+    "reset_observability",
+]

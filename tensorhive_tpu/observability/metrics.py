@@ -1,0 +1,389 @@
+"""In-process metrics: counters, gauges, histograms + Prometheus exposition.
+
+Design constraints that shaped this (vs. vendoring prometheus_client, which
+the image does not ship):
+
+* **Thread-safe under concurrent writers.** Every service daemon, the
+  transport fan-out pool, and API request threads write concurrently; each
+  metric family serializes its own children behind one lock, so hot paths
+  on different families never contend with each other.
+* **Idempotent registration.** ``registry.counter(name, ...)`` returns the
+  existing family when the name is already registered (services are
+  constructed many times in tests); re-registering with a different type or
+  label set is a programming error and raises.
+* **Fixed bucket boundaries.** Histograms are Prometheus-style cumulative
+  buckets chosen at registration; observation is O(log buckets) via bisect.
+  A quantile estimator (linear interpolation inside the bucket, the same
+  model PromQL's ``histogram_quantile`` uses) backs the p50/p95 service
+  introspection without storing raw samples.
+
+Exposition follows the Prometheus text format (version 0.0.4): HELP/TYPE
+headers, ``_bucket``/``_sum``/``_count`` expansion for histograms, label
+escaping for ``\\``, ``"`` and newlines. Families render sorted by name and
+children by label values, so output is deterministic (golden-testable).
+"""
+from __future__ import annotations
+
+import math
+import threading
+from bisect import bisect_left
+from typing import Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
+
+#: default latency buckets (seconds): 1 ms .. 60 s, roughly log-spaced —
+#: covers API dispatch (~ms) through SSH probe round-trips (~100 ms) and
+#: scheduler ticks that may take tens of seconds on large clusters.
+DEFAULT_BUCKETS: Tuple[float, ...] = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+
+def _format_value(value: float) -> str:
+    """Render a sample value: integral floats collapse to integers (counter
+    increments stay readable), non-finite values use Prometheus spelling."""
+    if math.isinf(value):
+        return "+Inf" if value > 0 else "-Inf"
+    if math.isnan(value):
+        return "NaN"
+    as_float = float(value)
+    if as_float.is_integer() and abs(as_float) < 1e15:
+        return str(int(as_float))
+    return repr(as_float)
+
+
+def _escape_label_value(value: str) -> str:
+    return (value.replace("\\", r"\\").replace("\n", r"\n").replace('"', r'\"'))
+
+
+def _escape_help(value: str) -> str:
+    return value.replace("\\", r"\\").replace("\n", r"\n")
+
+
+def _render_labels(names: Sequence[str], values: Sequence[str],
+                   extra: Optional[Tuple[str, str]] = None) -> str:
+    pairs = [f'{name}="{_escape_label_value(value)}"'
+             for name, value in zip(names, values)]
+    if extra is not None:
+        pairs.append(f'{extra[0]}="{_escape_label_value(extra[1])}"')
+    return "{" + ",".join(pairs) + "}" if pairs else ""
+
+
+class Counter:
+    """Monotonically increasing value (one child of a counter family)."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self, lock: threading.Lock) -> None:
+        self._lock = lock
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError("counters can only increase")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def _reset(self) -> None:
+        self._value = 0.0
+
+
+class Gauge:
+    """Value that can go up and down (one child of a gauge family)."""
+
+    __slots__ = ("_lock", "_value")
+
+    def __init__(self, lock: threading.Lock) -> None:
+        self._lock = lock
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.inc(-amount)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+    def _reset(self) -> None:
+        self._value = 0.0
+
+
+class Histogram:
+    """Fixed-bucket cumulative histogram (one child of a histogram family).
+
+    Standalone use is supported (``Histogram()`` with no arguments) so code
+    can keep a private per-instance histogram — Service latency
+    introspection does this to stay isolated from other instances sharing
+    the same registry label set.
+    """
+
+    __slots__ = ("_lock", "buckets", "_counts", "_sum", "_count", "_max")
+
+    def __init__(self, buckets: Sequence[float] = DEFAULT_BUCKETS,
+                 lock: Optional[threading.Lock] = None) -> None:
+        bounds = tuple(sorted(float(b) for b in buckets))
+        if not bounds:
+            raise ValueError("histogram needs at least one bucket bound")
+        if any(b2 <= b1 for b1, b2 in zip(bounds, bounds[1:])):
+            raise ValueError("bucket bounds must be strictly increasing")
+        self._lock = lock or threading.Lock()
+        self.buckets = bounds
+        self._counts = [0] * (len(bounds) + 1)   # +1 for the +Inf bucket
+        self._sum = 0.0
+        self._count = 0
+        self._max: Optional[float] = None
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        index = bisect_left(self.buckets, value)
+        with self._lock:
+            self._counts[index] += 1
+            self._sum += value
+            self._count += 1
+            if self._max is None or value > self._max:
+                self._max = value
+
+    def snapshot(self) -> Tuple[List[int], float, int, Optional[float]]:
+        """(per-bucket counts incl. +Inf, sum, count, max) — consistent."""
+        with self._lock:
+            return list(self._counts), self._sum, self._count, self._max
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    @property
+    def max(self) -> Optional[float]:
+        with self._lock:
+            return self._max
+
+    def _reset(self) -> None:
+        self._counts = [0] * (len(self.buckets) + 1)
+        self._sum = 0.0
+        self._count = 0
+        self._max = None
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Estimate the q-quantile (0..1) from bucket counts — the same
+        linear-interpolation-within-bucket model as PromQL's
+        ``histogram_quantile``. Returns None with no observations. The
+        estimate is clamped to the observed max so a +Inf-bucket hit cannot
+        report an unbounded latency."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError("quantile must be within [0, 1]")
+        counts, _, total, observed_max = self.snapshot()
+        if total == 0:
+            return None
+        rank = q * total
+        cumulative = 0.0
+        for index, bucket_count in enumerate(counts):
+            if bucket_count == 0:
+                continue
+            if cumulative + bucket_count >= rank:
+                fraction = (rank - cumulative) / bucket_count
+                lower = 0.0 if index == 0 else self.buckets[index - 1]
+                if index < len(self.buckets):
+                    estimate = lower + (self.buckets[index] - lower) * fraction
+                else:               # +Inf bucket: no upper bound to lerp to
+                    estimate = observed_max if observed_max is not None else lower
+                if observed_max is not None:
+                    estimate = min(estimate, observed_max)
+                return estimate
+            cumulative += bucket_count
+        return observed_max
+
+
+_KINDS = ("counter", "gauge", "histogram")
+
+
+class MetricFamily:
+    """A named metric plus its labeled children."""
+
+    def __init__(self, kind: str, name: str, help_text: str,
+                 label_names: Sequence[str],
+                 buckets: Sequence[float] = DEFAULT_BUCKETS) -> None:
+        assert kind in _KINDS
+        self.kind = kind
+        self.name = name
+        self.help_text = help_text
+        self.label_names = tuple(label_names)
+        self.bucket_bounds = tuple(buckets)
+        self._lock = threading.Lock()
+        self._children: Dict[Tuple[str, ...], object] = {}
+
+    def labels(self, **labels: str):
+        """Child for one label-value combination (created on first use)."""
+        if set(labels) != set(self.label_names):
+            raise ValueError(
+                f"{self.name} expects labels {self.label_names}, got "
+                f"{tuple(sorted(labels))}"
+            )
+        key = tuple(str(labels[name]) for name in self.label_names)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._make_child()
+                self._children[key] = child
+            return child
+
+    def _make_child(self):
+        # children share the family lock: one uncontended lock per family
+        # keeps memory per child at two slots and render() consistent
+        if self.kind == "counter":
+            return Counter(self._lock)
+        if self.kind == "gauge":
+            return Gauge(self._lock)
+        return Histogram(self.bucket_bounds, lock=self._lock)
+
+    def _unlabeled(self):
+        """The single child of a label-less family."""
+        if self.label_names:
+            raise ValueError(f"{self.name} requires labels {self.label_names}")
+        return self.labels()
+
+    # label-less convenience: family.inc() / family.set() / family.observe()
+    def inc(self, amount: float = 1.0) -> None:
+        self._unlabeled().inc(amount)
+
+    def set(self, value: float) -> None:
+        self._unlabeled().set(value)
+
+    def dec(self, amount: float = 1.0) -> None:
+        self._unlabeled().dec(amount)
+
+    def observe(self, value: float) -> None:
+        self._unlabeled().observe(value)
+
+    def children(self) -> List[Tuple[Tuple[str, ...], object]]:
+        with self._lock:
+            return sorted(self._children.items())
+
+    def reset_values(self) -> None:
+        """Zero every child's value IN PLACE — instrumented modules hold
+        child references captured at import, so dropping children would
+        silently orphan their writes."""
+        with self._lock:
+            for child in self._children.values():
+                child._reset()
+
+
+class MetricsRegistry:
+    """Thread-safe collection of metric families + Prometheus rendering."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._families: Dict[str, MetricFamily] = {}
+
+    # -- registration (idempotent) ------------------------------------------
+    def _register(self, kind: str, name: str, help_text: str,
+                  label_names: Sequence[str],
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> MetricFamily:
+        with self._lock:
+            existing = self._families.get(name)
+            if existing is not None:
+                if existing.kind != kind or existing.label_names != tuple(label_names):
+                    raise ValueError(
+                        f"metric {name!r} already registered as "
+                        f"{existing.kind}{existing.label_names}"
+                    )
+                return existing
+            family = MetricFamily(kind, name, help_text, label_names, buckets)
+            self._families[name] = family
+            return family
+
+    def counter(self, name: str, help_text: str = "",
+                labels: Sequence[str] = ()) -> MetricFamily:
+        return self._register("counter", name, help_text, labels)
+
+    def gauge(self, name: str, help_text: str = "",
+              labels: Sequence[str] = ()) -> MetricFamily:
+        return self._register("gauge", name, help_text, labels)
+
+    def histogram(self, name: str, help_text: str = "",
+                  labels: Sequence[str] = (),
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> MetricFamily:
+        return self._register("histogram", name, help_text, labels, buckets)
+
+    def get(self, name: str) -> Optional[MetricFamily]:
+        with self._lock:
+            return self._families.get(name)
+
+    def families(self) -> List[MetricFamily]:
+        with self._lock:
+            return [self._families[name] for name in sorted(self._families)]
+
+    def reset_values(self) -> None:
+        """Drop every child's value but keep families registered — handles
+        instrumented modules that captured family references at import."""
+        for family in self.families():
+            family.reset_values()
+
+    # -- exposition ---------------------------------------------------------
+    def render(self) -> str:
+        """Prometheus text format 0.0.4; deterministic ordering."""
+        lines: List[str] = []
+        for family in self.families():
+            children = family.children()
+            if not children:
+                continue
+            if family.help_text:
+                lines.append(f"# HELP {family.name} "
+                             f"{_escape_help(family.help_text)}")
+            lines.append(f"# TYPE {family.name} {family.kind}")
+            for label_values, child in children:
+                if family.kind == "histogram":
+                    lines.extend(self._render_histogram(
+                        family, label_values, child))
+                else:
+                    labels = _render_labels(family.label_names, label_values)
+                    lines.append(
+                        f"{family.name}{labels} {_format_value(child.value)}")
+        return "\n".join(lines) + "\n" if lines else ""
+
+    @staticmethod
+    def _render_histogram(family: MetricFamily, label_values: Sequence[str],
+                          child: Histogram) -> Iterable[str]:
+        counts, total_sum, count, _ = child.snapshot()
+        cumulative = 0
+        for bound, bucket_count in zip(family.bucket_bounds, counts):
+            cumulative += bucket_count
+            labels = _render_labels(family.label_names, label_values,
+                                    extra=("le", _format_value(bound)))
+            yield f"{family.name}_bucket{labels} {cumulative}"
+        labels = _render_labels(family.label_names, label_values,
+                                extra=("le", "+Inf"))
+        yield f"{family.name}_bucket{labels} {count}"
+        plain = _render_labels(family.label_names, label_values)
+        yield f"{family.name}_sum{plain} {_format_value(total_sum)}"
+        yield f"{family.name}_count{plain} {count}"
+
+
+def parse_rendered(text: str) -> Mapping[str, float]:
+    """Parse exposition text back into {sample-line-name+labels: value} —
+    test helper so assertions don't regex the format by hand."""
+    samples: Dict[str, float] = {}
+    for line in text.splitlines():
+        if not line or line.startswith("#"):
+            continue
+        key, _, raw = line.rpartition(" ")
+        samples[key] = float(raw)
+    return samples

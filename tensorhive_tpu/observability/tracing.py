@@ -1,0 +1,150 @@
+"""Bounded ring-buffer span tracer.
+
+Covers the request/tick/span tracing the reference never had (SURVEY.md §5):
+API request dispatch, service ticks, monitor updates, transport fan-outs and
+job spawns each record a :class:`Span`. Spans carry parent ids via a
+per-thread stack, so a probe round-trip initiated inside a monitoring tick
+shows up as a child of that tick without any explicit plumbing.
+
+Completed spans land in a fixed-capacity ring buffer (old spans evicted,
+O(1) append, no unbounded growth on a busy server) and are dumped by
+``GET /api/admin/traces``. Each span gets a process-wide monotone sequence
+number at completion time; the dump is ordered by it, so consumers see
+monotonically non-decreasing end timestamps even when threads interleave.
+"""
+from __future__ import annotations
+
+import collections
+import itertools
+import threading
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Deque, Dict, Iterator, List, Optional
+
+DEFAULT_CAPACITY = 512
+
+
+@dataclass
+class Span:
+    span_id: str
+    parent_id: Optional[str]
+    name: str
+    kind: str
+    #: wall-clock start (unix seconds) — for humans correlating with logs
+    start_ts: float
+    #: perf_counter at start — for exact durations
+    _started: float = field(repr=False, default=0.0)
+    duration_s: Optional[float] = None
+    status: str = "ok"
+    attrs: Dict[str, str] = field(default_factory=dict)
+    #: completion sequence number (monotone across the process)
+    seq: int = -1
+
+    def to_dict(self) -> Dict:
+        return {
+            "spanId": self.span_id,
+            "parentId": self.parent_id,
+            "name": self.name,
+            "kind": self.kind,
+            "startTs": round(self.start_ts, 6),
+            "durationMs": (round(self.duration_s * 1000, 3)
+                           if self.duration_s is not None else None),
+            "status": self.status,
+            "attrs": dict(self.attrs),
+            "seq": self.seq,
+        }
+
+
+class SpanTracer:
+    """Thread-safe tracer: start/end pairs or the :meth:`span` context
+    manager; completed spans retained in a bounded ring buffer."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY) -> None:
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        self.capacity = capacity
+        self._lock = threading.Lock()
+        self._finished: Deque[Span] = collections.deque(maxlen=capacity)
+        self._ids = itertools.count(1)
+        self._seq = itertools.count(1)
+        self._local = threading.local()
+
+    # -- thread-local parent stack ------------------------------------------
+    def _stack(self) -> List[Span]:
+        stack = getattr(self._local, "stack", None)
+        if stack is None:
+            stack = self._local.stack = []
+        return stack
+
+    def current_span(self) -> Optional[Span]:
+        stack = self._stack()
+        return stack[-1] if stack else None
+
+    # -- explicit API --------------------------------------------------------
+    def start_span(self, name: str, kind: str = "internal",
+                   **attrs: object) -> Span:
+        parent = self.current_span()
+        with self._lock:
+            span_id = f"{next(self._ids):08x}"
+        span = Span(
+            span_id=span_id,
+            parent_id=parent.span_id if parent else None,
+            name=name,
+            kind=kind,
+            start_ts=time.time(),
+            _started=time.perf_counter(),
+            attrs={key: str(value) for key, value in attrs.items()},
+        )
+        self._stack().append(span)
+        return span
+
+    def end_span(self, span: Span, status: str = "ok",
+                 **attrs: object) -> Span:
+        span.duration_s = time.perf_counter() - span._started
+        span.status = status
+        for key, value in attrs.items():
+            span.attrs[key] = str(value)
+        stack = self._stack()
+        if span in stack:           # tolerate out-of-order ends across threads
+            while stack and stack[-1] is not span:
+                stack.pop()
+            if stack:
+                stack.pop()
+        with self._lock:
+            span.seq = next(self._seq)
+            self._finished.append(span)
+        return span
+
+    # -- context-manager API -------------------------------------------------
+    @contextmanager
+    def span(self, name: str, kind: str = "internal",
+             **attrs: object) -> Iterator[Span]:
+        span = self.start_span(name, kind, **attrs)
+        try:
+            yield span
+        except BaseException:
+            self.end_span(span, status="error")
+            raise
+        else:
+            self.end_span(span, status=span.status)
+
+    # -- reading -------------------------------------------------------------
+    def recent(self, limit: Optional[int] = None,
+               kind: Optional[str] = None) -> List[Dict]:
+        """Completed spans, oldest first (monotone ``seq``/end order)."""
+        with self._lock:
+            spans = list(self._finished)
+        if kind is not None:
+            spans = [span for span in spans if span.kind == kind]
+        if limit is not None and limit >= 0:
+            spans = spans[-limit:]
+        return [span.to_dict() for span in spans]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._finished)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._finished.clear()

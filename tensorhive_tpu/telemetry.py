@@ -21,7 +21,30 @@ import time
 from pathlib import Path
 from typing import Dict, Optional
 
+from .observability import get_registry
+
 DEFAULT_METRICS_DIR = "~/.tpuhive/metrics"
+
+# the registry mirror of the drop-file payload: workload-side HBM/duty
+# metrics share the same /api/metrics exposition surface as the control
+# plane, so one Prometheus scrape covers both (observability tentpole)
+_HBM_USED = get_registry().gauge(
+    "tpuhive_workload_hbm_used_bytes",
+    "Per-device HBM bytes in use, from the workload telemetry emitter.",
+    labels=("device",))
+_HBM_TOTAL = get_registry().gauge(
+    "tpuhive_workload_hbm_total_bytes",
+    "Per-device HBM capacity in bytes.", labels=("device",))
+_DUTY = get_registry().gauge(
+    "tpuhive_workload_duty_cycle_pct",
+    "Per-device duty-cycle estimate over the last write window (percent).",
+    labels=("device",))
+_PUBLISHES = get_registry().counter(
+    "tpuhive_workload_publishes_total",
+    "Successful drop-file publishes by the telemetry emitter.")
+_PUBLISH_FAILURES = get_registry().counter(
+    "tpuhive_workload_publish_failures_total",
+    "Drop-file publishes that failed (I/O errors).")
 
 
 class TelemetryEmitter:
@@ -68,9 +91,23 @@ class TelemetryEmitter:
 
         metrics = self.collect(duty_cycle_pct=duty)
         if metrics:
+            self._mirror_to_registry(metrics)
             self._write(metrics)
             self._last_write = now
         return metrics
+
+    @staticmethod
+    def _mirror_to_registry(metrics: Dict[str, Dict]) -> None:
+        """Copy the drop-file payload into the in-process metrics registry
+        so training-loop telemetry appears on /api/metrics alongside the
+        control-plane instrumentation."""
+        for device, values in metrics.items():
+            if values.get("hbm_used_bytes") is not None:
+                _HBM_USED.labels(device=device).set(values["hbm_used_bytes"])
+            if values.get("hbm_total_bytes") is not None:
+                _HBM_TOTAL.labels(device=device).set(values["hbm_total_bytes"])
+            if values.get("duty_cycle_pct") is not None:
+                _DUTY.labels(device=device).set(values["duty_cycle_pct"])
 
     @staticmethod
     def collect(duty_cycle_pct: Optional[float] = None) -> Dict[str, Dict]:
@@ -106,10 +143,24 @@ class TelemetryEmitter:
                 json.dump(metrics, fh)
             os.replace(tmp, self.path)
         except OSError:
-            try:
-                os.unlink(tmp)
-            except OSError:
-                pass
+            self._discard_tmp(tmp)
+            _PUBLISH_FAILURES.inc()  # I/O flakiness is expected; swallowed
+        except BaseException:
+            # the temp file must never survive a failed publish — json.dump
+            # raises non-OSError too (a non-serializable value lands here as
+            # TypeError), and each such failure used to litter the metrics
+            # dir with an orphan .tmp the probe would skip but never reclaim
+            self._discard_tmp(tmp)
+            raise  # programming errors stay loud
+        else:
+            _PUBLISHES.inc()
+
+    @staticmethod
+    def _discard_tmp(tmp: str) -> None:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
 
     def close(self) -> None:
         """Remove the drop-file (job teardown)."""

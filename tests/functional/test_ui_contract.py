@@ -71,6 +71,8 @@ UI_CALLS = {
     ("GET", "/nodes/<hostname>/cpu/metrics"):
         "`/nodes/${encodeURIComponent(host)}/cpu/metrics`",
     ("GET", "/admin/services"): 'api("/admin/services")',
+    ("GET", "/admin/traces"): 'api("/admin/traces',
+    ("GET", "/metrics"): 'href="/api/metrics"',
     # reservations calendar (calendar.js)
     ("GET", "/resources"): 'api("/resources")',
     ("GET", "/resources/<uid>"): '"/resources/" + encodeURIComponent(uid)',

@@ -17,6 +17,22 @@ def test_lint_gate_is_clean():
     assert proc.returncode == 0, f"\n{proc.stdout}{proc.stderr}"
 
 
+def test_lint_gate_covers_observability_package():
+    """The observability layer is on the gate's default target set (it lives
+    under tensorhive_tpu/), and the gate actually walks it — an explicit run
+    against the package must find its modules and report them clean."""
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "tools" / "lint.py"),
+         "tensorhive_tpu/observability"],
+        capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, f"\n{proc.stdout}{proc.stderr}"
+    # stderr summary is "lint: N files, M problems" — all package modules
+    # must be walked (init + metrics + tracing)
+    files_checked = int(proc.stderr.split("lint: ")[1].split(" files")[0])
+    assert files_checked >= 3, proc.stderr
+
+
 def test_ci_manifest_pins_gate_order():
     """The committed CI workflow must run the same gates as `make check`
     plus the suite, in the pinned order lint → style/type → native probe →

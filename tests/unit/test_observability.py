@@ -1,0 +1,313 @@
+"""Unit coverage for the observability layer (ISSUE 1 tentpole).
+
+Registry correctness under concurrent writers, histogram bucketing +
+quantile estimation, exact Prometheus text rendering (golden), tracer
+ring-buffer eviction, service tick accounting, and the telemetry emitter's
+temp-file hygiene on failed publishes.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import os
+import threading
+
+import pytest
+
+from tensorhive_tpu.core.services.base import Service
+from tensorhive_tpu.observability import (
+    Histogram,
+    MetricsRegistry,
+    SpanTracer,
+)
+from tensorhive_tpu.observability.metrics import parse_rendered
+
+
+# -- registry ----------------------------------------------------------------
+
+def test_counter_gauge_basics():
+    registry = MetricsRegistry()
+    requests = registry.counter("reqs_total", "requests", labels=("code",))
+    requests.labels(code="200").inc()
+    requests.labels(code="200").inc(2)
+    requests.labels(code="500").inc()
+    assert requests.labels(code="200").value == 3
+    assert requests.labels(code="500").value == 1
+
+    temperature = registry.gauge("temp", "gauge")
+    temperature.set(41.5)
+    temperature.inc(0.5)
+    temperature.dec(2)
+    assert registry.get("temp").labels().value == 40.0
+
+
+def test_counter_rejects_decrease_and_label_mismatch():
+    registry = MetricsRegistry()
+    counter = registry.counter("c_total", "", labels=("a",))
+    with pytest.raises(ValueError):
+        counter.labels(a="x").inc(-1)
+    with pytest.raises(ValueError):
+        counter.labels(b="x")
+    with pytest.raises(ValueError):
+        counter.inc()          # label-less convenience needs no labels
+
+
+def test_registration_is_idempotent_but_type_safe():
+    registry = MetricsRegistry()
+    first = registry.counter("x_total", "help", labels=("a",))
+    assert registry.counter("x_total", "ignored", labels=("a",)) is first
+    with pytest.raises(ValueError):
+        registry.gauge("x_total")
+    with pytest.raises(ValueError):
+        registry.counter("x_total", labels=("b",))
+
+
+def test_registry_under_concurrent_writers():
+    """8 writer threads, interleaved counter/gauge/histogram traffic: totals
+    must be exact (no lost updates)."""
+    registry = MetricsRegistry()
+    counter = registry.counter("hits_total", "", labels=("worker",))
+    shared = registry.counter("shared_total", "")
+    histogram = registry.histogram("lat_seconds", "", buckets=(0.5, 1.0))
+    iterations, workers = 1000, 8
+    barrier = threading.Barrier(workers)
+
+    def writer(index: int) -> None:
+        barrier.wait()
+        child = counter.labels(worker=str(index))
+        for step in range(iterations):
+            child.inc()
+            shared.inc()
+            histogram.observe((step % 3) * 0.4)
+
+    threads = [threading.Thread(target=writer, args=(i,)) for i in range(workers)]
+    for thread in threads:
+        thread.start()
+    for thread in threads:
+        thread.join()
+
+    assert shared.labels().value == workers * iterations
+    for index in range(workers):
+        assert counter.labels(worker=str(index)).value == iterations
+    counts, total_sum, count, observed_max = registry.get(
+        "lat_seconds").labels().snapshot()
+    assert count == workers * iterations
+    assert sum(counts) == count
+    assert observed_max == pytest.approx(0.8)
+    per_worker = sum((step % 3) * 0.4 for step in range(iterations))
+    assert total_sum == pytest.approx(workers * per_worker, rel=1e-6)
+
+
+# -- histogram ---------------------------------------------------------------
+
+def test_histogram_bucketing_is_cumulative_and_exact():
+    histogram = Histogram(buckets=(0.1, 1.0, 5.0))
+    for value in (0.05, 0.1, 0.5, 2.0, 99.0):
+        histogram.observe(value)
+    counts, total_sum, count, observed_max = histogram.snapshot()
+    # per-bucket (non-cumulative) occupancy: le=0.1 gets 0.05 AND the exact
+    # boundary 0.1 (le is inclusive), le=1.0 gets 0.5, le=5.0 gets 2.0,
+    # +Inf gets 99.0
+    assert counts == [2, 1, 1, 1]
+    assert count == 5
+    assert total_sum == pytest.approx(101.65)
+    assert observed_max == 99.0
+
+
+def test_histogram_rejects_bad_buckets():
+    with pytest.raises(ValueError):
+        Histogram(buckets=())
+    with pytest.raises(ValueError):
+        Histogram(buckets=(1.0, 1.0))
+
+
+def test_quantile_estimation():
+    histogram = Histogram(buckets=(1.0, 2.0, 4.0))
+    for value in (0.5, 1.5, 1.6, 2.5, 3.0, 3.5):
+        histogram.observe(value)
+    assert histogram.quantile(0.0) == 0.0
+    # p50: rank 3 of 6 → exactly fills the le=2 bucket → its upper bound
+    assert histogram.quantile(0.5) == pytest.approx(2.0)
+    # p100 clamps to the exact observed max, not a bucket bound
+    assert histogram.quantile(1.0) == pytest.approx(3.5)
+    assert Histogram().quantile(0.5) is None
+    with pytest.raises(ValueError):
+        histogram.quantile(1.5)
+
+
+def test_quantile_inf_bucket_clamps_to_observed_max():
+    histogram = Histogram(buckets=(1.0,))
+    histogram.observe(50.0)
+    histogram.observe(60.0)
+    assert histogram.quantile(0.99) == 60.0
+
+
+# -- Prometheus rendering ----------------------------------------------------
+
+def test_prometheus_text_rendering_golden():
+    """Exact-format golden: HELP/TYPE headers, label rendering, histogram
+    _bucket/_sum/_count expansion, deterministic ordering, trailing \\n."""
+    registry = MetricsRegistry()
+    registry.counter("tpuhive_requests_total", "API requests.",
+                     labels=("method",)).labels(method="GET").inc(3)
+    registry.gauge("tpuhive_queue_depth", "Jobs waiting.").set(2)
+    hist = registry.histogram("tpuhive_tick_seconds", "Tick time.",
+                              buckets=(0.1, 0.5))
+    hist.observe(0.05)
+    hist.observe(0.3)
+    hist.observe(7.0)
+    assert registry.render() == (
+        "# HELP tpuhive_queue_depth Jobs waiting.\n"
+        "# TYPE tpuhive_queue_depth gauge\n"
+        "tpuhive_queue_depth 2\n"
+        "# HELP tpuhive_requests_total API requests.\n"
+        "# TYPE tpuhive_requests_total counter\n"
+        'tpuhive_requests_total{method="GET"} 3\n'
+        "# HELP tpuhive_tick_seconds Tick time.\n"
+        "# TYPE tpuhive_tick_seconds histogram\n"
+        'tpuhive_tick_seconds_bucket{le="0.1"} 1\n'
+        'tpuhive_tick_seconds_bucket{le="0.5"} 2\n'
+        'tpuhive_tick_seconds_bucket{le="+Inf"} 3\n'
+        "tpuhive_tick_seconds_sum 7.35\n"
+        "tpuhive_tick_seconds_count 3\n"
+    )
+
+
+def test_label_value_escaping():
+    registry = MetricsRegistry()
+    registry.counter("c_total", "", labels=("cmd",)).labels(
+        cmd='echo "a\\b"\nexit').inc()
+    rendered = registry.render()
+    assert r'cmd="echo \"a\\b\"\nexit"' in rendered
+
+
+def test_render_skips_empty_families_and_parses_back():
+    registry = MetricsRegistry()
+    registry.counter("never_used_total", "no children yet")
+    registry.gauge("g").set(1.25)
+    rendered = registry.render()
+    assert "never_used_total" not in rendered
+    assert parse_rendered(rendered) == {"g": 1.25}
+
+
+def test_reset_values_keeps_child_references_live():
+    registry = MetricsRegistry()
+    child = registry.counter("c_total", "", labels=("a",)).labels(a="1")
+    child.inc(5)
+    registry.reset_values()
+    assert child.value == 0
+    child.inc()
+    # the SAME child is still what renders — instrumented modules hold
+    # references captured at import, reset must not orphan them
+    assert 'c_total{a="1"} 1' in registry.render()
+
+
+# -- tracer ------------------------------------------------------------------
+
+def test_tracer_ring_buffer_eviction():
+    tracer = SpanTracer(capacity=4)
+    for index in range(10):
+        with tracer.span(f"s{index}"):
+            pass
+    assert len(tracer) == 4
+    spans = tracer.recent()
+    assert [span["name"] for span in spans] == ["s6", "s7", "s8", "s9"]
+    seqs = [span["seq"] for span in spans]
+    assert seqs == sorted(seqs) and len(set(seqs)) == 4
+
+
+def test_tracer_parent_ids_and_status():
+    tracer = SpanTracer()
+    with tracer.span("outer", kind="tick") as outer:
+        with tracer.span("inner", kind="probe", host="vm-0"):
+            pass
+    with pytest.raises(RuntimeError):
+        with tracer.span("boom"):
+            raise RuntimeError("x")
+    by_name = {span["name"]: span for span in tracer.recent()}
+    assert by_name["inner"]["parentId"] == outer.span_id
+    assert by_name["outer"]["parentId"] is None
+    assert by_name["inner"]["attrs"]["host"] == "vm-0"
+    assert by_name["boom"]["status"] == "error"
+    assert by_name["inner"]["durationMs"] >= 0
+    # completion order: inner finished before outer
+    assert by_name["inner"]["seq"] < by_name["outer"]["seq"]
+
+
+def test_tracer_recent_limit_and_kind_filter():
+    tracer = SpanTracer()
+    for kind in ("api", "tick", "api"):
+        with tracer.span("s", kind=kind):
+            pass
+    assert len(tracer.recent(kind="api")) == 2
+    assert len(tracer.recent(limit=1)) == 1
+    assert tracer.recent(limit=1)[0]["kind"] == "api"
+    tracer.clear()
+    assert tracer.recent() == []
+
+
+# -- Service tick accounting -------------------------------------------------
+
+class _NoopService(Service):
+    def do_run(self) -> None:
+        pass
+
+
+def test_service_latency_stats_and_p50_shim():
+    service = _NoopService(interval_s=10.0, name="StatsSvc")
+    assert service.tick_latency_p50() is None
+    assert service.tick_latency_stats() == {"p50": None, "p95": None, "max": None}
+    for elapsed in (0.002, 0.004, 0.008, 0.2):
+        service.record_tick(elapsed)
+    stats = service.tick_latency_stats()
+    assert service.ticks_completed == 4
+    assert stats["max"] == pytest.approx(0.2)
+    assert service.tick_latency_p50() == stats["p50"]
+    assert 0.002 <= stats["p50"] <= 0.008
+    assert stats["p50"] <= stats["p95"] <= stats["max"]
+
+
+def test_service_instances_do_not_share_latency_history():
+    first = _NoopService(interval_s=10.0, name="SameName")
+    first.record_tick(5.0)
+    second = _NoopService(interval_s=10.0, name="SameName")
+    assert second.tick_latency_p50() is None
+
+
+def test_first_overrun_warns_then_debug(caplog):
+    service = _NoopService(interval_s=0.001, name="OverrunSvc")
+    with caplog.at_level(logging.DEBUG,
+                         logger="tensorhive_tpu.core.services.base"):
+        service.record_overrun(0.5)
+        service.record_overrun(0.6)
+    overrun_records = [record for record in caplog.records
+                       if "overran" in record.message]
+    assert [record.levelno for record in overrun_records] == [
+        logging.WARNING, logging.DEBUG]
+    assert service.tick_overruns == 2
+
+
+# -- telemetry emitter hygiene ----------------------------------------------
+
+def test_telemetry_write_cleans_tmp_on_serialization_error(tmp_path):
+    from tensorhive_tpu.telemetry import TelemetryEmitter
+
+    emitter = TelemetryEmitter(name="w", metrics_dir=str(tmp_path))
+    with pytest.raises(TypeError):
+        emitter._write({"0": {"bad": object()}})   # json.dump raises TypeError
+    assert list(tmp_path.glob("*.tmp")) == []      # no orphan temp file
+    assert not emitter.path.exists()               # and no torn drop-file
+
+    emitter._write({"0": {"ok": 1}})               # healthy path still works
+    assert json.loads(emitter.path.read_text()) == {"0": {"ok": 1}}
+    assert list(tmp_path.glob("*.tmp")) == []
+
+
+def test_telemetry_write_swallows_oserror_but_cleans_up(tmp_path, monkeypatch):
+    from tensorhive_tpu.telemetry import TelemetryEmitter
+
+    emitter = TelemetryEmitter(name="w", metrics_dir=str(tmp_path))
+    monkeypatch.setattr(os, "replace",
+                        lambda src, dst: (_ for _ in ()).throw(OSError("disk")))
+    emitter._write({"0": {"ok": 1}})               # swallowed, like before
+    assert list(tmp_path.glob("*.tmp")) == []

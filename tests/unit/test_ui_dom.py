@@ -500,3 +500,45 @@ def test_reservation_details_edit_and_usage_card(ui):
 def _auth_headers(ui):
     token = js_str(ui.interp.eval_expr("state.access"))
     return {"Authorization": f"Bearer {token}"}
+
+
+def test_service_health_strip_and_traces_dialog(ui, config):
+    """The admin service strip executes the new p50/p95 badges and the
+    traces dialog renders real spans recorded by the live dispatch path —
+    both through minijs against the real WSGI app + tracer."""
+    from tensorhive_tpu.core.managers.manager import TpuHiveManager, set_manager
+    from tensorhive_tpu.core.services.base import Service
+    from tensorhive_tpu.observability import reset_observability
+
+    class TinySvc(Service):
+        def do_run(self):
+            pass
+
+    reset_observability()
+    manager = TpuHiveManager(config=config, services=[TinySvc(5.0)])
+    manager.configure_services_from_config()
+    service = manager.service_manager.services[0]
+    service.record_tick(0.004)
+    service.record_tick(0.006)
+    service.record_overrun(6.0)
+    set_manager(manager)
+    try:
+        login(ui)
+        ui.interp.eval_expr("go('nodes')")
+        strip = ui.page.by_id("svc-health").js_get("innerHTML")
+        assert "TinySvc" in strip
+        assert "p50/p95" in strip, "latency badge missing: " + strip[:300]
+        assert "overruns" in strip, "overrun count missing from badge title"
+        assert 'href="/api/metrics"' in strip
+
+        ui.interp.eval_expr("openTracesDialog()")
+        dialog = ui.page.by_id("chip-dialog")
+        assert dialog.node.dialog_open, "traces dialog did not open"
+        html = dialog.js_get("innerHTML")
+        assert "Recent spans" in html
+        # the login POST above went through the real dispatch path, so its
+        # span is in the ring and rendered
+        assert "api POST /api/user/login" in html
+    finally:
+        set_manager(None)
+        reset_observability()

@@ -1,0 +1,73 @@
+"""Smoke-test the metrics exposition end to end (``make metrics-smoke``).
+
+Boots the real WSGI app in-process on an ephemeral port (in-memory DB, no
+hosts), issues one real API request so the dispatch instrumentation has
+something to count, then scrapes ``/api/metrics`` over HTTP and checks the
+Prometheus text format. Exit 0 = healthy.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import tempfile
+import urllib.request
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+os.environ.setdefault("TPUHIVE_PYTEST", "1")          # DB goes in-memory
+
+
+def main() -> int:
+    from tensorhive_tpu.config import Config, set_config
+
+    set_config(Config(config_dir=tempfile.mkdtemp(prefix="tpuhive-smoke-")))
+
+    from tensorhive_tpu.db.engine import Engine, set_engine
+    from tensorhive_tpu.db.migrations import ensure_schema
+
+    engine = Engine(":memory:")
+    ensure_schema(engine)
+    set_engine(engine)
+
+    from tensorhive_tpu.core.managers.manager import TpuHiveManager, set_manager
+
+    set_manager(TpuHiveManager(services=[]))
+
+    from tensorhive_tpu.api.server import APIServer
+
+    server = APIServer()
+    server.config.api.url_hostname = "127.0.0.1"
+    server.config.api.url_port = 0                     # ephemeral
+    port = server.start()
+    base = f"http://127.0.0.1:{port}/api"
+    try:
+        # one real dispatched request so the scrape has populated families
+        with urllib.request.urlopen(f"{base}/openapi.json", timeout=10) as resp:
+            assert resp.status == 200
+
+        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as resp:
+            content_type = resp.headers.get("Content-Type", "")
+            body = resp.read().decode()
+    finally:
+        server.stop()
+
+    problems = []
+    if "text/plain" not in content_type or "version=0.0.4" not in content_type:
+        problems.append(f"unexpected content type: {content_type!r}")
+    if "# TYPE tpuhive_api_requests_total counter" not in body:
+        problems.append("request counter missing from exposition")
+    if "tpuhive_api_request_seconds_bucket" not in body:
+        problems.append("request latency histogram missing from exposition")
+    if not body.endswith("\n"):
+        problems.append("exposition must end with a newline")
+    for problem in problems:
+        print(f"metrics-smoke: FAIL: {problem}", file=sys.stderr)
+    if problems:
+        return 1
+    lines = len(body.splitlines())
+    print(f"metrics-smoke: OK — {lines} exposition lines from {base}/metrics")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
