@@ -1,14 +1,21 @@
 # Developer entry points. `check` is the static gate (reference CI parity:
 # mypy + flake8 per .circleci/config.yml:33-38): the dependency-free AST
-# lint always runs; mypy/ruff run when installed (absent from this image).
-.PHONY: check lint test bench probe metrics-smoke
+# lint + thivelint analyzer always run; mypy/ruff run when installed
+# (absent from this image).
+.PHONY: check lint analysis test bench probe metrics-smoke
 
-check: lint
+check: lint analysis
 	@command -v ruff >/dev/null 2>&1 && ruff check . || echo "ruff not installed; skipped (tools/lint.py covered the always-on subset)"
 	@command -v mypy >/dev/null 2>&1 && mypy || echo "mypy not installed; skipped (tools/lint.py covered the always-on subset)"
 
 lint:
 	python tools/lint.py
+
+# the multi-pass static analyzer (docs/STATIC_ANALYSIS.md): lock discipline,
+# exception hygiene, blocking calls, JAX host-sync — `lint` is an alias that
+# runs the same passes; this target exists for the pinned CI gate order
+analysis:
+	python -m tools.analysis
 
 test:
 	python -m pytest tests/ -q

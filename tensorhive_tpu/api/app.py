@@ -52,6 +52,11 @@ _REQUEST_SECONDS = get_registry().histogram(
     "tpuhive_api_request_seconds",
     "API request dispatch latency by route pattern and method.",
     labels=("endpoint", "method"))
+_UNHANDLED_ERRORS = get_registry().counter(
+    "tpuhive_api_unhandled_errors_total",
+    "Requests that hit the catch-all 500 handler, by route pattern — the "
+    "exceptions the typed error mapping did not anticipate.",
+    labels=("endpoint",))
 
 
 @dataclasses.dataclass
@@ -256,7 +261,11 @@ class ApiApp:
         except TransportError as exc:
             response = self._error(502, str(exc))
         except Exception:
+            # the catch-all is deliberate (a handler bug must 500, not kill
+            # the worker) but never silent: logged with traceback AND
+            # counted per route pattern, so a spike is alertable (TH-E)
             log.exception("unhandled error on %s %s", request.method, request.path)
+            _UNHANDLED_ERRORS.labels(endpoint=endpoint.path).inc()
             response = self._error(500, "internal server error")
         return self._with_cors(response), endpoint.path
 

@@ -273,7 +273,10 @@ def evaluate(
     if num_batches < 1:
         raise ValueError(f"num_batches must be >= 1, got {num_batches}")
     loss_fn = _eval_loss_fn(config, mesh)
-    total = 0.0
+    # accumulate ON DEVICE: a float() per batch would force a blocking
+    # device->host sync each iteration, serializing the async dispatch
+    # pipeline (TH-J); one conversion after the loop syncs once
+    total = jnp.zeros((), jnp.float32)
     for index in range(num_batches):
         try:
             tokens = next(batches)
@@ -281,7 +284,7 @@ def evaluate(
             raise ValueError(
                 f"batches iterator exhausted at batch {index} of "
                 f"{num_batches}") from None
-        total += float(loss_fn(params, tokens))
-    mean = total / num_batches
+        total = total + loss_fn(params, tokens)
+    mean = float(total) / num_batches
     return {"loss": mean, "perplexity": float(jnp.exp(mean)),
             "batches": num_batches}

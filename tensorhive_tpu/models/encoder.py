@@ -144,7 +144,10 @@ def mlm_evaluate(
         raise ValueError(f"num_batches must be >= 1, got {num_batches}")
     loss_fn = _mlm_eval_loss_fn(config, mesh)
     key = jax.random.PRNGKey(seed)
-    total = 0.0
+    # on-device accumulation, one host sync after the loop — same TH-J
+    # discipline as decode.evaluate (a per-batch float() would block the
+    # dispatch pipeline once per batch)
+    total = jnp.zeros((), jnp.float32)
     for index in range(num_batches):
         try:
             tokens = next(batches)
@@ -154,8 +157,8 @@ def mlm_evaluate(
                 f"{num_batches}") from None
         packed = pack_mlm_batch(jax.random.fold_in(key, index), tokens,
                                 config, mask_ratio)
-        total += float(loss_fn(params, packed))
-    mean = total / num_batches
+        total = total + loss_fn(params, packed)
+    mean = float(total) / num_batches
     return {"loss": mean,
             "pseudo_perplexity": float(jnp.exp(jnp.float32(mean))),
             "batches": num_batches}

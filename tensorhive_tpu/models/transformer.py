@@ -129,7 +129,12 @@ def _chunk_threshold_bytes() -> int:
     device = jax.devices()[0]
     try:
         return int(device.memory_stats()["bytes_limit"] * 0.7)
-    except Exception:
+    except (AttributeError, KeyError, TypeError, RuntimeError,
+            NotImplementedError):
+        # the known no-memory-introspection shapes: memory_stats absent
+        # (AttributeError), unimplemented (RuntimeError incl. XlaRuntimeError,
+        # NotImplementedError), returns None (TypeError) or lacks the key
+        # (KeyError) — all fall through to the platform defaults below
         pass
     if device.platform == "tpu":
         # some TPU runtimes don't expose memory_stats; assume the smallest

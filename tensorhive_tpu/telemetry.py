@@ -15,6 +15,7 @@ the accelerator path.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import tempfile
 import time
@@ -22,6 +23,8 @@ from pathlib import Path
 from typing import Dict, Optional
 
 from .observability import get_registry
+
+log = logging.getLogger(__name__)
 
 DEFAULT_METRICS_DIR = "~/.tpuhive/metrics"
 
@@ -45,6 +48,10 @@ _PUBLISHES = get_registry().counter(
 _PUBLISH_FAILURES = get_registry().counter(
     "tpuhive_workload_publish_failures_total",
     "Drop-file publishes that failed (I/O errors).")
+_MEMORY_STATS_FAILURES = get_registry().counter(
+    "tpuhive_workload_memory_stats_failures_total",
+    "device.memory_stats() calls that raised (backend without support); "
+    "a fleet silently losing HBM metrics shows up here.")
 
 
 class TelemetryEmitter:
@@ -124,7 +131,12 @@ class TelemetryEmitter:
             try:
                 stats = device.memory_stats() or {}
             except Exception:
-                pass  # backends without memory_stats (CPU) report None fields
+                # backends without memory_stats (CPU) report None fields —
+                # tolerated, but counted + debug-logged so HBM metrics
+                # silently missing from a dashboard is diagnosable (TH-E)
+                _MEMORY_STATS_FAILURES.inc()
+                log.debug("memory_stats unavailable for device %s",
+                          device, exc_info=True)
             metrics[str(device.local_hardware_id
                         if hasattr(device, "local_hardware_id") else device.id)] = {
                 "hbm_used_bytes": stats.get("bytes_in_use"),

@@ -1,7 +1,13 @@
-"""The static gate (tools/lint.py) must stay clean — reference CI parity
-(mypy + flake8 on every push, .circleci/config.yml:33-38 via SURVEY.md §4).
-Running it inside pytest makes the gate part of every `pytest tests/` run,
-exactly as the reference's CI couples lint to its test job."""
+"""The static gate must stay clean — reference CI parity (mypy + flake8 on
+every push, .circleci/config.yml:33-38 via SURVEY.md §4). Running it inside
+pytest makes the gate part of every `pytest tests/` run, exactly as the
+reference's CI couples lint to its test job.
+
+Since the tools/analysis package, the gate is the FULL multi-pass analyzer
+(TH-C/TH-E/TH-B/TH-J + the legacy syntax/import/name passes), not just the
+legacy subset; `python tools/lint.py` stays a working alias for it.
+"""
+import json
 import subprocess
 import sys
 from pathlib import Path
@@ -17,6 +23,30 @@ def test_lint_gate_is_clean():
     assert proc.returncode == 0, f"\n{proc.stdout}{proc.stderr}"
 
 
+def test_full_analyzer_is_clean():
+    """`python -m tools.analysis` (all passes, checked-in baseline) must
+    exit 0 on the whole repo — every true finding is fixed or carries a
+    justified waiver; nothing lands flagged."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.analysis"],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert proc.returncode == 0, f"\n{proc.stdout}{proc.stderr}"
+
+
+def test_analyzer_runs_all_new_passes():
+    """The four defect-family passes are registered and actually run (a
+    refactor that silently drops a pass must fail here, not in review)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.analysis", "--format=json",
+         "tensorhive_tpu/observability"],
+        capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert proc.returncode == 0, f"\n{proc.stdout}{proc.stderr}"
+    report = json.loads(proc.stdout)
+    assert {"TH-B", "TH-C", "TH-E", "TH-J"} <= set(report["rules"])
+
+
 def test_lint_gate_covers_observability_package():
     """The observability layer is on the gate's default target set (it lives
     under tensorhive_tpu/), and the gate actually walks it — an explicit run
@@ -27,20 +57,22 @@ def test_lint_gate_covers_observability_package():
         capture_output=True, text=True, timeout=120,
     )
     assert proc.returncode == 0, f"\n{proc.stdout}{proc.stderr}"
-    # stderr summary is "lint: N files, M problems" — all package modules
-    # must be walked (init + metrics + tracing)
+    # stderr summary is "lint: N files, M problems ..." — all package
+    # modules must be walked (init + metrics + tracing)
     files_checked = int(proc.stderr.split("lint: ")[1].split(" files")[0])
     assert files_checked >= 3, proc.stderr
 
 
 def test_ci_manifest_pins_gate_order():
     """The committed CI workflow must run the same gates as `make check`
-    plus the suite, in the pinned order lint → style/type → native probe →
-    tests (reference parity: .circleci/config.yml:6-41)."""
+    plus the suite, in the pinned order lint → analysis → style/type →
+    native probe → tests (reference parity: .circleci/config.yml:6-41)."""
     manifest = (REPO / ".github" / "workflows" / "ci.yml").read_text()
-    order = ["name: lint", "name: ruff", "name: mypy",
+    order = ["name: lint", "name: analysis", "name: ruff", "name: mypy",
              "name: native probe", "name: tests"]
     positions = [manifest.index(marker) for marker in order]
     assert positions == sorted(positions), "CI gate order drifted"
     assert "tools/lint.py" in manifest
+    assert "tools.analysis" in manifest
+    assert "--format=json" in manifest, "CI must emit the JSON trend artifact"
     assert "pytest tests/" in manifest

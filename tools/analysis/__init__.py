@@ -1,0 +1,17 @@
+"""thivelint: the repo's multi-pass static analyzer (see engine.py).
+
+Run: ``python -m tools.analysis [paths...] [--format=json]``.
+``python tools/lint.py`` remains a working alias for the same gate.
+"""
+from .engine import (  # noqa: F401
+    Baseline,
+    Finding,
+    ModuleContext,
+    Rule,
+    all_rules,
+    analyze_source,
+    main,
+    register,
+    run,
+    waiver_for,
+)

@@ -1,0 +1,328 @@
+"""thivelint engine: rule registry, shared AST walk, suppressions, baseline.
+
+The static gate grew out of ``tools/lint.py`` (syntax / unused-import /
+undefined-name, reference CI parity with mypy+flake8). This package turns it
+into a multi-pass analyzer: every pass is a :class:`Rule` registered against
+ONE shared parse of each module (the AST plus a parent map is built once per
+file, every rule reuses it), with three escape hatches:
+
+* per-line suppression — ``# thive: disable=TH-C`` (comma-separated ids or
+  ``*``) on the flagged line;
+* a checked-in waiver baseline (``tools/analysis/baseline.json``) for
+  findings that are provably safe but beyond the analyzer's reasoning, each
+  entry carrying a mandatory human-written ``reason``;
+* ``noqa`` on an import line (legacy compatibility for TH-F401).
+
+Output is text (``path:line: RULE message``) or ``--format=json`` for CI
+trend artifacts. Exit 0 = no active findings.
+"""
+from __future__ import annotations
+
+import argparse
+import ast
+import dataclasses
+import json
+import re
+import sys
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Sequence, Set
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+
+#: the same default walk set as the original tools/lint.py gate
+DEFAULT_TARGETS = ("tensorhive_tpu", "tests", "examples", "tools", "bench.py",
+                   "__graft_entry__.py")
+
+DEFAULT_BASELINE = Path(__file__).resolve().parent / "baseline.json"
+
+_SUPPRESS_RE = re.compile(r"#\s*thive:\s*disable=([A-Za-z0-9_*,\- ]+)")
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str            # repo-relative posix path
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+    def to_dict(self) -> Dict[str, object]:
+        return dataclasses.asdict(self)
+
+
+class Rule:
+    """One analysis pass. Subclasses set ``id``/``title``/``rationale`` and
+    implement :meth:`check`; ``applies`` scopes the pass to path prefixes
+    (posix, repo-relative) so e.g. concurrency discipline is not enforced on
+    test fixtures."""
+
+    id: str = ""
+    title: str = ""
+    rationale: str = ""
+    #: repo-relative posix prefixes this rule runs on; empty = everywhere
+    scope: Sequence[str] = ()
+
+    def applies(self, relpath: str) -> bool:
+        if not self.scope:
+            return True
+        return any(relpath.startswith(prefix) for prefix in self.scope)
+
+    def check(self, module: "ModuleContext") -> List[Finding]:
+        raise NotImplementedError
+
+
+_RULES: Dict[str, Rule] = {}
+
+
+def register(rule: Rule) -> Rule:
+    """Register a rule instance (id must be unique)."""
+    if rule.id in _RULES:
+        raise ValueError(f"duplicate rule id {rule.id!r}")
+    _RULES[rule.id] = rule
+    return rule
+
+
+def all_rules() -> List[Rule]:
+    _load_rules()
+    return [_RULES[rule_id] for rule_id in sorted(_RULES)]
+
+
+_rules_loaded = False
+
+
+def _load_rules() -> None:
+    global _rules_loaded
+    if not _rules_loaded:
+        from . import rules  # noqa: F401  (import side effect: register())
+
+        _rules_loaded = True
+
+
+def _parse_suppressions(lines: Sequence[str]) -> Dict[int, Set[str]]:
+    suppressions: Dict[int, Set[str]] = {}
+    for lineno, line in enumerate(lines, start=1):
+        match = _SUPPRESS_RE.search(line)
+        if match:
+            ids = {token.strip() for token in match.group(1).split(",")}
+            suppressions[lineno] = {token for token in ids if token}
+    return suppressions
+
+
+class ModuleContext:
+    """One parsed module shared by every rule: source, AST, parent links,
+    and the per-line suppression map."""
+
+    def __init__(self, source: str, relpath: str,
+                 path: Optional[Path] = None) -> None:
+        self.source = source
+        self.relpath = relpath
+        self.path = path
+        self.lines = source.splitlines()
+        self.suppressions = _parse_suppressions(self.lines)
+        self.syntax_error: Optional[SyntaxError] = None
+        try:
+            self.tree: Optional[ast.AST] = ast.parse(source, filename=relpath)
+        except SyntaxError as exc:
+            self.tree = None
+            self.syntax_error = exc
+        self._parents: Optional[Dict[int, ast.AST]] = None
+
+    @classmethod
+    def from_file(cls, path: Path) -> "ModuleContext":
+        try:
+            relpath = path.resolve().relative_to(REPO_ROOT).as_posix()
+        except ValueError:
+            relpath = path.as_posix()
+        return cls(path.read_text(), relpath, path=path)
+
+    @property
+    def parents(self) -> Dict[int, ast.AST]:
+        """id(node) -> parent node, built once on first use."""
+        if self._parents is None:
+            parents: Dict[int, ast.AST] = {}
+            if self.tree is not None:
+                for node in ast.walk(self.tree):
+                    for child in ast.iter_child_nodes(node):
+                        parents[id(child)] = node
+            self._parents = parents
+        return self._parents
+
+    def ancestors(self, node: ast.AST) -> Iterable[ast.AST]:
+        """Walk from ``node``'s parent up to the module root."""
+        parents = self.parents
+        current = parents.get(id(node))
+        while current is not None:
+            yield current
+            current = parents.get(id(current))
+
+    def nearest_class(self, node: ast.AST) -> Optional[ast.ClassDef]:
+        for ancestor in self.ancestors(node):
+            if isinstance(ancestor, ast.ClassDef):
+                return ancestor
+        return None
+
+    def suppressed(self, finding: Finding) -> bool:
+        ids = self.suppressions.get(finding.line)
+        return bool(ids) and (finding.rule in ids or "*" in ids)
+
+
+# -- baseline ----------------------------------------------------------------
+
+class BaselineError(ValueError):
+    pass
+
+
+class Baseline:
+    """Checked-in waivers: each entry matches findings by rule + path +
+    message substring and MUST carry a non-empty justification."""
+
+    def __init__(self, waivers: List[Dict[str, str]]) -> None:
+        for entry in waivers:
+            for key in ("rule", "path", "contains", "reason"):
+                if not str(entry.get(key, "")).strip():
+                    raise BaselineError(
+                        f"baseline entry {entry!r} is missing {key!r} — "
+                        "every waiver needs a justified reason")
+        self.waivers = waivers
+        self.used = [False] * len(waivers)
+
+    @classmethod
+    def load(cls, path: Path) -> "Baseline":
+        if not path.exists():
+            return cls([])
+        data = json.loads(path.read_text())
+        return cls(list(data.get("waivers", [])))
+
+    def waives(self, finding: Finding) -> bool:
+        hit = False
+        for index, entry in enumerate(self.waivers):
+            if (entry["rule"] == finding.rule
+                    and entry["path"] == finding.path
+                    and entry["contains"] in finding.message):
+                self.used[index] = True
+                hit = True
+        return hit
+
+    def unused(self) -> List[Dict[str, str]]:
+        return [entry for entry, used in zip(self.waivers, self.used)
+                if not used]
+
+
+def waiver_for(finding: Finding, reason: str) -> Dict[str, str]:
+    """Baseline entry matching exactly this finding (test/CLI helper)."""
+    return {"rule": finding.rule, "path": finding.path,
+            "contains": finding.message, "reason": reason}
+
+
+# -- driver ------------------------------------------------------------------
+
+def iter_sources(args: Sequence[str]) -> List[Path]:
+    targets = [REPO_ROOT / t for t in (list(args) or DEFAULT_TARGETS)]
+    files: List[Path] = []
+    for target in targets:
+        if target.is_dir():
+            files.extend(sorted(target.rglob("*.py")))
+        elif target.suffix == ".py":
+            files.append(target)
+    return files
+
+
+def analyze_source(source: str, relpath: str,
+                   rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
+    """Run rules over an in-memory module; suppressions honored, baseline
+    not consulted. The fixture-snippet seam the unit tests drive."""
+    module = ModuleContext(source, relpath)
+    findings = _check_module(module, rules if rules is not None else all_rules())
+    return [f for f in findings if not module.suppressed(f)]
+
+
+def _check_module(module: ModuleContext, rules: Sequence[Rule]) -> List[Finding]:
+    if module.tree is None:
+        exc = module.syntax_error
+        return [Finding("TH-SYNTAX", module.relpath, exc.lineno or 1,
+                        f"syntax error: {exc.msg}")]
+    findings: List[Finding] = []
+    for rule in rules:
+        if rule.applies(module.relpath):
+            findings.extend(rule.check(module))
+    return sorted(findings, key=lambda f: (f.path, f.line, f.rule))
+
+
+def run(paths: Sequence[str], baseline_path: Optional[Path] = None,
+        rule_ids: Optional[Sequence[str]] = None) -> Dict[str, object]:
+    """Analyze files; returns the full report dict (see keys below)."""
+    rules = all_rules()
+    if rule_ids:
+        wanted = set(rule_ids)
+        unknown = wanted - {rule.id for rule in rules}
+        if unknown:
+            raise SystemExit(f"unknown rule ids: {sorted(unknown)}")
+        rules = [rule for rule in rules if rule.id in wanted]
+    baseline = Baseline.load(baseline_path or DEFAULT_BASELINE)
+    files = iter_sources(paths)
+    active: List[Finding] = []
+    suppressed: List[Finding] = []
+    waived: List[Finding] = []
+    for path in files:
+        module = ModuleContext.from_file(path)
+        for finding in _check_module(module, rules):
+            if module.suppressed(finding):
+                suppressed.append(finding)
+            elif baseline.waives(finding):
+                waived.append(finding)
+            else:
+                active.append(finding)
+    return {
+        "files": len(files),
+        "rules": [rule.id for rule in rules],
+        "findings": active,
+        "suppressed": suppressed,
+        "waived": waived,
+        "unused_waivers": baseline.unused(),
+    }
+
+
+def main(argv: Optional[Sequence[str]] = None, prog: str = "analysis") -> int:
+    parser = argparse.ArgumentParser(
+        prog=prog, description="thivelint: the repo's multi-pass static gate")
+    parser.add_argument("paths", nargs="*",
+                        help="files/dirs to analyze (default: repo gate set)")
+    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--baseline", type=Path, default=DEFAULT_BASELINE,
+                        help="waiver baseline JSON (default: checked-in)")
+    parser.add_argument("--select", default="",
+                        help="comma-separated rule ids to run (default: all)")
+    parser.add_argument("--list-rules", action="store_true",
+                        help="print the rule catalog and exit")
+    options = parser.parse_args(argv)
+
+    if options.list_rules:
+        for rule in all_rules():
+            scope = ", ".join(rule.scope) if rule.scope else "everywhere"
+            print(f"{rule.id}: {rule.title} [{scope}]")
+        return 0
+
+    selected = [token.strip() for token in options.select.split(",")
+                if token.strip()]
+    report = run(options.paths, baseline_path=options.baseline,
+                 rule_ids=selected or None)
+    findings: List[Finding] = report["findings"]  # type: ignore[assignment]
+
+    if options.format == "json":
+        payload = dict(report)
+        for key in ("findings", "suppressed", "waived"):
+            payload[key] = [f.to_dict() for f in report[key]]
+        json.dump(payload, sys.stdout, indent=2)
+        sys.stdout.write("\n")
+    else:
+        for finding in findings:
+            print(finding.render())
+    for entry in report["unused_waivers"]:
+        print(f"{prog}: warning: unused baseline waiver {entry['rule']} "
+              f"{entry['path']!r} ({entry['reason']})", file=sys.stderr)
+    print(f"{prog}: {report['files']} files, {len(findings)} problems "
+          f"({len(report['suppressed'])} suppressed, "
+          f"{len(report['waived'])} waived)", file=sys.stderr)
+    return 1 if findings else 0

@@ -1,0 +1,3 @@
+"""Rule passes — importing this package registers every rule with the
+engine registry (one module per defect family)."""
+from . import blocking, concurrency, exceptions, jax_sync, legacy  # noqa: F401

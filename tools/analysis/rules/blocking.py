@@ -1,0 +1,100 @@
+"""TH-B: blocking calls without a deadline in latency-sensitive paths.
+
+Two kinds of function are on the serving hot path: API handler functions
+(decorated with ``@route(...)`` — one slow handler stalls a worker thread
+and every request queued behind it) and ``Service.do_run`` tick bodies (one
+hung tick starves the poll cadence for the whole cluster — monitoring,
+protection and scheduling all ride a 2-30 s loop).
+
+Inside those functions this pass flags, lexically:
+
+* ``time.sleep(...)`` — always (handlers must not sleep; services sleep via
+  the interruptible ``StoppableThread.wait``);
+* ``subprocess.run/call/check_call/check_output/Popen(...)`` without a
+  ``timeout=`` keyword;
+* transport fan-out calls (``.run_on_all(...)``, ``.check_output(...)``)
+  without a ``timeout=`` keyword — an unreachable host must cost a bounded
+  wait, never a hung tick.
+
+The analysis is lexical (calls made by helpers the hot path invokes are not
+chased); it catches the shape that actually regresses: the blocking call
+written directly into the handler/tick body.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from ..engine import Finding, ModuleContext, Rule, register
+
+SUBPROCESS_CALLS = {"run", "call", "check_call", "check_output", "Popen"}
+TRANSPORT_CALLS = {"run_on_all", "check_output"}
+
+
+def _is_hot_path(node: ast.AST) -> Optional[str]:
+    """'handler' for @route-decorated functions, 'do_run tick' for service
+    tick bodies, else None."""
+    if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return None
+    if node.name == "do_run":
+        return "do_run tick"
+    for decorator in node.decorator_list:
+        target = decorator.func if isinstance(decorator, ast.Call) else decorator
+        name = target.id if isinstance(target, ast.Name) else (
+            target.attr if isinstance(target, ast.Attribute) else None)
+        if name == "route":
+            return "API handler"
+    return None
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    return any(kw.arg == "timeout" for kw in call.keywords)
+
+
+class BlockingCallRule(Rule):
+    id = "TH-B"
+    title = "blocking call without timeout in API handler / service tick"
+    rationale = ("A handler or tick that blocks without a deadline turns one "
+                 "slow host into a stalled control plane.")
+    scope = ("tensorhive_tpu/", "tools/")
+
+    def check(self, module: ModuleContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(module.tree):
+            kind = _is_hot_path(node)
+            if kind is None:
+                continue
+            findings.extend(self._check_body(module, node, kind))
+        return findings
+
+    def _check_body(self, module: ModuleContext, fn: ast.AST,
+                    kind: str) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            if not isinstance(func, ast.Attribute):
+                continue
+            receiver = (func.value.id
+                        if isinstance(func.value, ast.Name) else None)
+            if receiver == "time" and func.attr == "sleep":
+                findings.append(Finding(
+                    self.id, module.relpath, node.lineno,
+                    f"time.sleep in {kind} blocks the thread; use an "
+                    "interruptible wait outside the hot path"))
+            elif (receiver == "subprocess" and func.attr in SUBPROCESS_CALLS
+                    and not _has_timeout(node)):
+                findings.append(Finding(
+                    self.id, module.relpath, node.lineno,
+                    f"subprocess.{func.attr} without timeout= in {kind} can "
+                    "hang the thread on a wedged child"))
+            elif func.attr in TRANSPORT_CALLS and not _has_timeout(node):
+                findings.append(Finding(
+                    self.id, module.relpath, node.lineno,
+                    f".{func.attr}(...) without timeout= in {kind}: an "
+                    "unreachable host must cost a bounded wait"))
+        return findings
+
+
+register(BlockingCallRule())

@@ -1,0 +1,190 @@
+"""TH-C: lock discipline in classes that own a threading lock.
+
+The control plane is a set of daemon threads (services, transport pool, API
+request threads) sharing mutable state under ad-hoc locks. Two defect shapes
+this pass catches:
+
+* an instance attribute written both inside ``with self._lock:`` and outside
+  it — the unguarded write races every guarded reader/writer;
+* a blocking call (``time.sleep``, ``subprocess.*``) executed while holding
+  a lock — every other thread touching that lock stalls for the duration.
+
+Scope: a class "owns" a lock when any method assigns ``self.<attr>`` a
+``threading.Lock/RLock/Condition`` (directly or via ``lock or Lock()``).
+``__init__``/``__new__`` writes are construction (happens-before publication)
+and never flagged. Locks acquired through other objects or custom guards
+(e.g. ``RWLock.write()``) are beyond this pass — waive with a justified
+baseline entry where a human has proven the path safe.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..engine import Finding, ModuleContext, Rule, register
+
+LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+
+#: calls that block the holder for an unbounded / scheduled duration
+BLOCKING_CALLS = {
+    ("time", "sleep"),
+    ("subprocess", "run"), ("subprocess", "call"),
+    ("subprocess", "check_call"), ("subprocess", "check_output"),
+    ("subprocess", "Popen"),
+}
+
+#: method calls that mutate a container in place
+MUTATOR_METHODS = {"append", "add", "update", "extend", "insert", "remove",
+                   "pop", "popitem", "clear", "discard", "setdefault",
+                   "appendleft"}
+
+CONSTRUCTORS = {"__init__", "__new__"}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    """``self.X`` / ``self.X[...]`` -> ``X`` (the attribute whose object is
+    mutated); anything else -> None."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name) and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _is_lock_value(node: ast.AST) -> bool:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            func = sub.func
+            name = func.id if isinstance(func, ast.Name) else (
+                func.attr if isinstance(func, ast.Attribute) else None)
+            if name in LOCK_FACTORIES:
+                return True
+    return False
+
+
+def _dotted(func: ast.AST) -> Optional[Tuple[str, str]]:
+    """``mod.attr(...)`` -> ("mod", "attr") for plain Name receivers."""
+    if isinstance(func, ast.Attribute) and isinstance(func.value, ast.Name):
+        return (func.value.id, func.attr)
+    return None
+
+
+class LockDisciplineRule(Rule):
+    id = "TH-C"
+    title = "inconsistent lock discipline / blocking call under lock"
+    rationale = ("State shared across daemon threads must be mutated under "
+                 "its lock every time, and locks must not be held across "
+                 "blocking calls.")
+    scope = ("tensorhive_tpu/", "tools/")
+
+    def check(self, module: ModuleContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ClassDef):
+                findings.extend(self._check_class(module, node))
+        return findings
+
+    # ------------------------------------------------------------------
+    def _class_nodes(self, module: ModuleContext, cls: ast.ClassDef):
+        """Nodes whose nearest enclosing ClassDef is ``cls`` (nested classes
+        are analyzed on their own)."""
+        for node in ast.walk(cls):
+            if node is cls:
+                continue
+            if module.nearest_class(node) is cls:
+                yield node
+
+    def _lock_attrs(self, module: ModuleContext, cls: ast.ClassDef) -> Set[str]:
+        attrs: Set[str] = set()
+        for node in self._class_nodes(module, cls):
+            if isinstance(node, ast.Assign) and _is_lock_value(node.value):
+                for target in node.targets:
+                    attr = _self_attr(target)
+                    if attr is not None:
+                        attrs.add(attr)
+        return attrs
+
+    def _enclosing_method(self, module: ModuleContext,
+                          node: ast.AST) -> Optional[str]:
+        for ancestor in module.ancestors(node):
+            if isinstance(ancestor, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return ancestor.name
+            if isinstance(ancestor, ast.ClassDef):
+                return None
+        return None
+
+    def _held_lock(self, module: ModuleContext, node: ast.AST,
+                   lock_attrs: Set[str]) -> Optional[str]:
+        """The class lock held at ``node`` (lexically inside a
+        ``with self.<lock>:``), or None."""
+        for ancestor in module.ancestors(node):
+            if isinstance(ancestor, (ast.With, ast.AsyncWith)):
+                for item in ancestor.items:
+                    attr = _self_attr(item.context_expr)
+                    if attr in lock_attrs:
+                        return attr
+            if isinstance(ancestor, ast.ClassDef):
+                break
+        return None
+
+    def _check_class(self, module: ModuleContext,
+                     cls: ast.ClassDef) -> List[Finding]:
+        lock_attrs = self._lock_attrs(module, cls)
+        if not lock_attrs:
+            return []
+        findings: List[Finding] = []
+        # attr -> (guarded linenos, unguarded (lineno, method) sites)
+        guarded: Dict[str, List[int]] = {}
+        unguarded: Dict[str, List[Tuple[int, str]]] = {}
+
+        def record(attr: Optional[str], node: ast.AST) -> None:
+            if attr is None or attr in lock_attrs:
+                return
+            method = self._enclosing_method(module, node)
+            if method is None or method in CONSTRUCTORS:
+                return
+            if self._held_lock(module, node, lock_attrs):
+                guarded.setdefault(attr, []).append(node.lineno)
+            else:
+                unguarded.setdefault(attr, []).append((node.lineno, method))
+
+        for node in self._class_nodes(module, cls):
+            if isinstance(node, ast.Assign):
+                for target in node.targets:
+                    record(_self_attr(target), node)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                record(_self_attr(node.target), node)
+            elif isinstance(node, ast.Delete):
+                for target in node.targets:
+                    record(_self_attr(target), node)
+            elif isinstance(node, ast.Call):
+                func = node.func
+                if (isinstance(func, ast.Attribute)
+                        and func.attr in MUTATOR_METHODS):
+                    record(_self_attr(func.value), node)
+                # blocking call while holding the class lock
+                dotted = _dotted(func)
+                if dotted in BLOCKING_CALLS:
+                    held = self._held_lock(module, node, lock_attrs)
+                    if held is not None:
+                        findings.append(Finding(
+                            self.id, module.relpath, node.lineno,
+                            f"blocking call {dotted[0]}.{dotted[1]}() while "
+                            f"holding self.{held} (class {cls.name}) stalls "
+                            "every thread contending on the lock"))
+
+        for attr, sites in unguarded.items():
+            if attr not in guarded:
+                continue        # never guarded: not this pass's contract
+            lock_name = sorted(lock_attrs)[0]
+            for lineno, method in sites:
+                findings.append(Finding(
+                    self.id, module.relpath, lineno,
+                    f"self.{attr} is mutated under self.{lock_name} "
+                    f"elsewhere (e.g. line {min(guarded[attr])}) but written "
+                    f"without it here (method {method}, class {cls.name})"))
+        return findings
+
+
+register(LockDisciplineRule())

@@ -1,0 +1,104 @@
+"""TH-E: exception hygiene.
+
+Silently swallowed exceptions are how this codebase's failures historically
+died invisible (the reference's service threads had no guard at all — a
+monitor exception stopped all monitoring with no trace). The contract this
+pass enforces: a broad handler (``except:``, ``except Exception:``,
+``except BaseException:``) must do at least one of
+
+* re-raise (``raise`` anywhere in the body),
+* log (any ``.exception/.error/.warning/.info/.debug/.critical/.log`` call),
+* record a metric (``.inc/.dec/.observe/.set/.labels`` call — the
+  docs/OBSERVABILITY.md "count swallowed exceptions" guidance), or
+* actually consume the bound exception object (``except Exception as exc``
+  with ``exc`` read in the body — the value flows somewhere, it is not
+  silent).
+
+Narrow handlers (``except OSError:``) are trusted: naming the type is the
+author stating which failure is expected. The pass also flags mutable
+default arguments (``def f(x=[])``) — shared-state-across-calls bugs that
+read like per-call state.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from ..engine import Finding, ModuleContext, Rule, register
+
+BROAD = {"Exception", "BaseException"}
+LOG_METHODS = {"exception", "error", "warning", "warn", "info", "debug",
+               "critical", "log"}
+METRIC_METHODS = {"inc", "dec", "observe", "set", "labels"}
+
+
+def _broad_name(type_node: Optional[ast.AST]) -> Optional[str]:
+    """'Exception'/'BaseException'/'bare' when the handler is broad."""
+    if type_node is None:
+        return "bare"
+    if isinstance(type_node, ast.Name) and type_node.id in BROAD:
+        return type_node.id
+    if isinstance(type_node, ast.Tuple):
+        for element in type_node.elts:
+            if isinstance(element, ast.Name) and element.id in BROAD:
+                return element.id
+    return None
+
+
+def _handler_is_silent(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return False
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute):
+            if node.func.attr in LOG_METHODS | METRIC_METHODS:
+                return False
+        if (handler.name is not None and isinstance(node, ast.Name)
+                and node.id == handler.name
+                and isinstance(node.ctx, ast.Load)):
+            return False
+    return True
+
+
+def _mutable_default(node: ast.AST) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set)):
+        return True
+    return (isinstance(node, ast.Call) and isinstance(node.func, ast.Name)
+            and node.func.id in {"list", "dict", "set"} and not node.args
+            and not node.keywords)
+
+
+class ExceptionHygieneRule(Rule):
+    id = "TH-E"
+    title = "silent broad exception handler / mutable default argument"
+    rationale = ("except Exception: pass makes production failures "
+                 "undiagnosable; broad handlers must log, re-raise, count a "
+                 "metric, or consume the exception value.")
+    scope = ("tensorhive_tpu/", "tools/", "bench.py")
+
+    def check(self, module: ModuleContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ExceptHandler):
+                broad = _broad_name(node.type)
+                if broad is not None and _handler_is_silent(node):
+                    what = ("bare except:" if broad == "bare"
+                            else f"except {broad}:")
+                    findings.append(Finding(
+                        self.id, module.relpath, node.lineno,
+                        f"{what} swallows the exception silently — log it, "
+                        "re-raise, count a metric, or narrow the type"))
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defaults = (list(node.args.defaults)
+                            + [d for d in node.args.kw_defaults
+                               if d is not None])
+                for default in defaults:
+                    if _mutable_default(default):
+                        findings.append(Finding(
+                            self.id, module.relpath, default.lineno,
+                            f"mutable default argument in {node.name}() is "
+                            "shared across calls; default to None and "
+                            "construct inside"))
+        return findings
+
+
+register(ExceptionHygieneRule())

@@ -1,0 +1,133 @@
+"""TH-J: JAX host-sync and trace-purity defects in the compute stack.
+
+The ROADMAP north star is "as fast as the hardware allows"; the quietest way
+to lose that is a device→host synchronization on the hot path. Two shapes:
+
+* **Trace purity**: ``float()``, ``int()``, ``.item()``, ``np.asarray``/
+  ``np.array`` or ``jax.device_get`` applied to a traced value inside a
+  ``@jax.jit``/``@jax.pmap``-decorated function either fails at trace time
+  (ConcretizationTypeError) or — worse — silently bakes a constant into the
+  compiled program.
+* **Per-iteration eval-loop syncs** (``tensorhive_tpu/{models,ops,parallel}``
+  only): host conversions (``float(...)``, ``.item()``, ``np.asarray``,
+  ``jax.device_get``, ``.block_until_ready()``) inside a ``for``/``while``
+  loop body force one blocking device round-trip per batch, serializing the
+  async dispatch pipeline. Accumulate on device and convert ONCE after the
+  loop (measured pattern: models/decode.py evaluate / models/encoder.py
+  mlm_evaluate).
+
+Lexical, like the rest of the gate: functions jitted at call sites
+(``jax.jit(f)``) are not chased.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from ..engine import Finding, ModuleContext, Rule, register
+
+JIT_NAMES = {"jit", "pmap"}
+HOST_CONVERSIONS = {"float", "int"}
+HOST_METHODS = {"item", "block_until_ready"}
+#: loops in these subtrees are assumed to iterate over device computations
+LOOP_SCOPES = ("tensorhive_tpu/models/", "tensorhive_tpu/ops/",
+               "tensorhive_tpu/parallel/")
+
+
+def _decorator_is_jit(decorator: ast.AST) -> bool:
+    """@jit / @jax.jit / @jit(...) / @functools.partial(jax.jit, ...)."""
+    if isinstance(decorator, ast.Call):
+        if any(_decorator_is_jit(arg) for arg in decorator.args):
+            return True     # functools.partial(jax.jit, ...)
+        decorator = decorator.func
+    if isinstance(decorator, ast.Name):
+        return decorator.id in JIT_NAMES
+    if isinstance(decorator, ast.Attribute):
+        return decorator.attr in JIT_NAMES
+    return False
+
+
+def _host_sync_call(node: ast.Call) -> Optional[str]:
+    """Name of the host-forcing operation, or None."""
+    func = node.func
+    if isinstance(func, ast.Name) and func.id in HOST_CONVERSIONS:
+        # float(0.5) is a constant, float(x) forces the device value
+        if node.args and not isinstance(node.args[0], ast.Constant):
+            return f"{func.id}()"
+        return None
+    if isinstance(func, ast.Attribute):
+        if func.attr in HOST_METHODS:
+            return f".{func.attr}()"
+        receiver = func.value.id if isinstance(func.value, ast.Name) else None
+        if receiver in {"np", "numpy"} and func.attr in {"asarray", "array"}:
+            return f"{receiver}.{func.attr}()"
+        if receiver == "jax" and func.attr == "device_get":
+            return "jax.device_get()"
+    return None
+
+
+class JaxHostSyncRule(Rule):
+    id = "TH-J"
+    title = "host sync / impurity on the JAX hot path"
+    rationale = ("Device->host conversions inside jitted functions break "
+                 "tracing; inside eval loops they serialize async dispatch "
+                 "to one blocking round-trip per batch.")
+    scope = ("tensorhive_tpu/",)
+
+    def check(self, module: ModuleContext) -> List[Finding]:
+        findings: List[Finding] = []
+        findings.extend(self._check_jitted(module))
+        if module.relpath.startswith(LOOP_SCOPES):
+            findings.extend(self._check_loops(module))
+        return findings
+
+    # -- purity inside @jit/@pmap ------------------------------------------
+    def _check_jitted(self, module: ModuleContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not any(_decorator_is_jit(d) for d in node.decorator_list):
+                continue
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call):
+                    op = _host_sync_call(sub)
+                    if op is not None:
+                        findings.append(Finding(
+                            self.id, module.relpath, sub.lineno,
+                            f"{op} on a traced value inside jitted "
+                            f"{node.name}() either fails to trace or bakes "
+                            "in a constant"))
+        return findings
+
+    # -- per-iteration syncs in eval/train loops ---------------------------
+    def _check_loops(self, module: ModuleContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for loop in ast.walk(module.tree):
+            if not isinstance(loop, (ast.For, ast.While)):
+                continue
+            for sub in ast.walk(loop):
+                if sub is loop or not isinstance(sub, ast.Call):
+                    continue
+                # only direct loop-body calls: a nested loop's findings are
+                # reported once, for the innermost loop containing them
+                if self._innermost_loop(module, sub) is not loop:
+                    continue
+                op = _host_sync_call(sub)
+                if op is not None:
+                    findings.append(Finding(
+                        self.id, module.relpath, sub.lineno,
+                        f"{op} inside a loop forces one device->host sync "
+                        "per iteration; accumulate on device and convert "
+                        "once after the loop"))
+        return findings
+
+    @staticmethod
+    def _innermost_loop(module: ModuleContext, node: ast.AST):
+        for ancestor in module.ancestors(node):
+            if isinstance(ancestor, (ast.For, ast.While)):
+                return ancestor
+        return None
+
+
+register(JaxHostSyncRule())

@@ -1,0 +1,157 @@
+"""The original tools/lint.py checks as registered passes.
+
+TH-F401 (unused imports) and TH-F821 (undefined names, module-flat subset)
+keep the exact conservative semantics the repo gate has enforced since PR 0;
+TH-SYNTAX is emitted by the engine when a file fails to parse. ``noqa`` on an
+import line is honored for back-compat with existing annotations, alongside
+the ``# thive: disable=`` syntax.
+"""
+from __future__ import annotations
+
+import ast
+import builtins
+from typing import List
+
+from ..engine import Finding, ModuleContext, Rule, register
+
+#: names every module may reference without defining (dunders + pytest)
+IMPLICIT = {"__file__", "__name__", "__doc__", "__package__", "__spec__",
+            "__builtins__", "__debug__", "__class__"}
+
+BUILTIN_NAMES = set(dir(builtins)) | IMPLICIT
+
+
+class NameCollector(ast.NodeVisitor):
+    """All identifiers read or written anywhere in the module; first read
+    lineno retained so findings are line-addressable (suppressible)."""
+
+    def __init__(self) -> None:
+        self.read = {}          # name -> first read lineno
+        self.bound = set()
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if isinstance(node.ctx, ast.Load):
+            self.read.setdefault(node.id, node.lineno)
+        else:
+            self.bound.add(node.id)
+        self.generic_visit(node)
+
+    def _bind_args(self, args: ast.arguments) -> None:
+        for arg in ([*args.posonlyargs, *args.args, *args.kwonlyargs]
+                    + ([args.vararg] if args.vararg else [])
+                    + ([args.kwarg] if args.kwarg else [])):
+            self.bound.add(arg.arg)
+
+    def visit_FunctionDef(self, node) -> None:
+        self.bound.add(node.name)
+        self._bind_args(node.args)
+        self.generic_visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self.bound.add(node.name)
+        self.generic_visit(node)
+
+    def visit_Global(self, node: ast.Global) -> None:
+        self.bound.update(node.names)
+
+    def visit_Nonlocal(self, node: ast.Nonlocal) -> None:
+        self.bound.update(node.names)
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        if node.name:
+            self.bound.add(node.name)
+        self.generic_visit(node)
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self._bind_args(node.args)
+        self.generic_visit(node)
+
+
+def imported_names(tree: ast.AST):
+    """(bound name, lineno, display) for every import binding."""
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                bound = alias.asname or alias.name.split(".")[0]
+                out.append((bound, node.lineno, alias.name))
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "__future__":
+                continue
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                bound = alias.asname or alias.name
+                out.append((bound, node.lineno, alias.name))
+    return out
+
+
+def string_literals(tree: ast.AST):
+    """String constants — names referenced in __all__, TYPE_CHECKING hints,
+    or docstring doctests count as uses (conservative)."""
+    found = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            for token in node.value.replace(".", " ").replace(",", " ").split():
+                if token.isidentifier():
+                    found.add(token)
+    return found
+
+
+class UnusedImportRule(Rule):
+    id = "TH-F401"
+    title = "unused import"
+    rationale = ("An import bound but never read is dead weight and often a "
+                 "refactor leftover; __init__.py re-exports are exempt.")
+
+    def check(self, module: ModuleContext) -> List[Finding]:
+        tree = module.tree
+        if module.relpath.endswith("__init__.py"):
+            return []       # __init__ imports are the package's public API
+        collector = NameCollector()
+        collector.visit(tree)
+        strings = string_literals(tree)
+        findings = []
+        for bound, lineno, display in imported_names(tree):
+            line = (module.lines[lineno - 1]
+                    if lineno - 1 < len(module.lines) else "")
+            if "noqa" in line:
+                continue
+            if bound not in collector.read and bound not in strings:
+                findings.append(Finding(
+                    self.id, module.relpath, lineno,
+                    f"unused import: {display}"))
+        return findings
+
+
+class UndefinedNameRule(Rule):
+    id = "TH-F821"
+    title = "undefined name (module-flat subset)"
+    rationale = ("A name read anywhere but bound nowhere in the module, not "
+                 "imported, and not a builtin is a NameError waiting for its "
+                 "code path. Module-flat = zero scope-model false positives.")
+
+    def check(self, module: ModuleContext) -> List[Finding]:
+        tree = module.tree
+        has_star = any(
+            isinstance(node, ast.ImportFrom)
+            and any(a.name == "*" for a in node.names)
+            for node in ast.walk(tree))
+        if has_star:
+            return []
+        collector = NameCollector()
+        collector.visit(tree)
+        imported = {bound for bound, _, _ in imported_names(tree)}
+        known = collector.bound | imported | BUILTIN_NAMES
+        return [
+            Finding(self.id, module.relpath, lineno,
+                    f"undefined name: {name}")
+            for name, lineno in sorted(collector.read.items())
+            if name not in known
+        ]
+
+
+register(UnusedImportRule())
+register(UndefinedNameRule())

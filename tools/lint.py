@@ -1,181 +1,22 @@
-"""Dependency-free static gate (reference CI parity: mypy + flake8 on every
-push, /root/reference/.circleci/config.yml:33-38 via SURVEY.md §4).
+"""Dependency-free static gate — alias for the tools/analysis package.
 
-This image ships neither mypy nor ruff and has no network, so the gate that
-ALWAYS runs is this stdlib checker; ``make check`` additionally invokes mypy
-and ruff (configured in pyproject.toml) when they are installed. Checks:
-
-* every source file parses (syntax gate = flake8's E9 class),
-* no unused imports (flake8 F401) — the highest-signal pyflakes rule,
-* no obvious undefined names in function bodies for a conservative subset
-  (flake8 F821-lite): names read in a module that are neither defined
-  anywhere in it, imported, builtins, nor comprehension/loop targets.
+Historically this file WAS the gate (syntax + unused imports + F821-lite,
+reference CI parity: mypy + flake8 on every push, SURVEY.md §4). Those checks
+now live in ``tools/analysis`` as registered passes (TH-SYNTAX / TH-F401 /
+TH-F821) next to the concurrency / exception-hygiene / blocking-call /
+JAX-host-sync passes; this entry point keeps every existing invocation
+(``make lint``, CI, tests/unit/test_lint_gate.py) running the full analyzer.
 
 Exit 0 = clean. Run: ``python tools/lint.py [paths...]``
 """
 from __future__ import annotations
 
-import ast
-import builtins
 import sys
 from pathlib import Path
 
-DEFAULT_TARGETS = ("tensorhive_tpu", "tests", "examples", "tools", "bench.py",
-                   "__graft_entry__.py")
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-#: names every module may reference without defining (dunders + pytest)
-IMPLICIT = {"__file__", "__name__", "__doc__", "__package__", "__spec__",
-            "__builtins__", "__debug__", "__class__"}
-
-
-def iter_sources(args: list) -> list:
-    root = Path(__file__).resolve().parent.parent
-    targets = [root / t for t in (args or DEFAULT_TARGETS)]
-    files = []
-    for target in targets:
-        if target.is_dir():
-            files.extend(sorted(target.rglob("*.py")))
-        elif target.suffix == ".py":
-            files.append(target)
-    return files
-
-
-class NameCollector(ast.NodeVisitor):
-    """All identifiers read or written anywhere in the module."""
-
-    def __init__(self) -> None:
-        self.read = set()
-        self.bound = set()
-
-    def visit_Name(self, node: ast.Name) -> None:
-        if isinstance(node.ctx, ast.Load):
-            self.read.add(node.id)
-        else:
-            self.bound.add(node.id)
-        self.generic_visit(node)
-
-    def visit_FunctionDef(self, node) -> None:
-        self.bound.add(node.name)
-        for arg in ([*node.args.posonlyargs, *node.args.args,
-                     *node.args.kwonlyargs]
-                    + ([node.args.vararg] if node.args.vararg else [])
-                    + ([node.args.kwarg] if node.args.kwarg else [])):
-            self.bound.add(arg.arg)
-        self.generic_visit(node)
-
-    visit_AsyncFunctionDef = visit_FunctionDef
-
-    def visit_ClassDef(self, node: ast.ClassDef) -> None:
-        self.bound.add(node.name)
-        self.generic_visit(node)
-
-    def visit_Global(self, node: ast.Global) -> None:
-        self.bound.update(node.names)
-
-    def visit_Nonlocal(self, node: ast.Nonlocal) -> None:
-        self.bound.update(node.names)
-
-    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
-        if node.name:
-            self.bound.add(node.name)
-        self.generic_visit(node)
-
-    def visit_Lambda(self, node: ast.Lambda) -> None:
-        for arg in ([*node.args.posonlyargs, *node.args.args,
-                     *node.args.kwonlyargs]
-                    + ([node.args.vararg] if node.args.vararg else [])
-                    + ([node.args.kwarg] if node.args.kwarg else [])):
-            self.bound.add(arg.arg)
-        self.generic_visit(node)
-
-
-def imported_names(tree: ast.AST):
-    """(bound name, lineno, display) for every import binding."""
-    out = []
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            for alias in node.names:
-                bound = alias.asname or alias.name.split(".")[0]
-                out.append((bound, node.lineno, alias.name))
-        elif isinstance(node, ast.ImportFrom):
-            if node.module == "__future__":
-                continue
-            for alias in node.names:
-                if alias.name == "*":
-                    continue
-                bound = alias.asname or alias.name
-                out.append((bound, node.lineno, alias.name))
-    return out
-
-
-def string_literals(tree: ast.AST):
-    """String constants — names referenced in __all__, TYPE_CHECKING hints,
-    or docstring doctests count as uses (conservative)."""
-    found = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Constant) and isinstance(node.value, str):
-            for token in node.value.replace(".", " ").replace(",", " ").split():
-                if token.isidentifier():
-                    found.add(token)
-    return found
-
-
-BUILTIN_NAMES = set(dir(builtins)) | IMPLICIT
-
-
-def check_file(path: Path) -> list:
-    problems = []
-    source = path.read_text()
-    try:
-        tree = ast.parse(source, filename=str(path))
-    except SyntaxError as exc:
-        return [f"{path}:{exc.lineno}: syntax error: {exc.msg}"]
-
-    lines = source.splitlines()
-    collector = NameCollector()
-    collector.visit(tree)
-    strings = string_literals(tree)
-    imports = imported_names(tree)
-    imported = {bound for bound, _, _ in imports}
-    has_star = any(
-        isinstance(node, ast.ImportFrom) and any(a.name == "*" for a in node.names)
-        for node in ast.walk(tree))
-
-    init_reexport = path.name == "__init__.py"
-    for bound, lineno, display in imports:
-        if init_reexport:
-            continue        # __init__ imports are the package's public API
-        line = lines[lineno - 1] if lineno - 1 < len(lines) else ""
-        if "noqa" in line:
-            continue
-        if bound not in collector.read and bound not in strings:
-            problems.append(f"{path}:{lineno}: unused import: {display}")
-
-    # module-flat undefined-name pass (F821-lite): a name read anywhere but
-    # bound nowhere in the module, not imported, and not a builtin is a
-    # NameError waiting for its code path. Module-flat = zero scope-model
-    # false positives (an inner binding whitelists the name file-wide).
-    if not has_star:
-        known = collector.bound | imported | BUILTIN_NAMES
-        for name in sorted(collector.read - known):
-            problems.append(f"{path}: undefined name: {name}")
-    return problems
-
-
-def main() -> int:
-    files = iter_sources(sys.argv[1:])
-    if not files:
-        print("lint: no python sources found", file=sys.stderr)
-        return 1
-    problems = []
-    for path in files:
-        problems.extend(check_file(path))
-    for problem in problems:
-        print(problem)
-    print(f"lint: {len(files)} files, {len(problems)} problems",
-          file=sys.stderr)
-    return 1 if problems else 0
-
+from tools.analysis.engine import main  # noqa: E402
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(main(prog="lint"))
