@@ -2,7 +2,7 @@
 # mypy + flake8 per .circleci/config.yml:33-38): the dependency-free AST
 # lint + thivelint analyzer always run; mypy/ruff run when installed
 # (absent from this image).
-.PHONY: check lint analysis test bench probe metrics-smoke
+.PHONY: check lint analysis test bench probe metrics-smoke decode-smoke
 
 check: lint analysis
 	@command -v ruff >/dev/null 2>&1 && ruff check . || echo "ruff not installed; skipped (tools/lint.py covered the always-on subset)"
@@ -27,6 +27,12 @@ bench:
 # /api/metrics over HTTP (Prometheus text-format smoke test)
 metrics-smoke:
 	python tools/metrics_smoke.py
+
+# CPU-backend tiny-config generate round-trip over the decode fast path
+# (donated in-place cache + bucketed prefill): prints tokens/s and the
+# compile counter, fails on round-trip or executable-count regressions
+decode-smoke:
+	python tools/decode_smoke.py
 
 probe:
 	$(MAKE) -C tensorhive_tpu/native

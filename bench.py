@@ -189,24 +189,39 @@ def bench_train() -> dict:
 
 
 def bench_generate():
-    """Serving-side numbers: batched-prefill tokens/s and steady-state
-    decode tokens/s on t2t-base (the on-device lax.scan decode loop +
-    one-pass prefill, models/decode.py). These existed since round 2/3 but
-    never appeared in a BENCH artifact."""
+    """Serving-side numbers on the decode fast path (models/decode.py):
+    donated in-place-cache prefill and steady-state decode tokens/s, plus a
+    mixed-length prompt-bucket sweep whose compile counters pin the
+    one-executable-per-bucket contract (docs/PERF.md "Decode fast path").
+    Buffers are DONATED on the hot path, so every timed rep consumes its
+    own cache copy — reusing one donated buffer across calls is a
+    use-after-free on TPU, and silently measures nothing on CPU."""
     import jax
     import jax.numpy as jnp
 
     from tensorhive_tpu.models import decode
     from tensorhive_tpu.models.transformer import PRESETS, TransformerLM
+    from tensorhive_tpu.observability import get_registry
+
+    def compile_counts():
+        family = get_registry().get("tpuhive_decode_compile_total")
+        if family is None:
+            return {}
+        return {"_".join(label_values): int(child.value)
+                for label_values, child in family.children()}
 
     if jax.default_backend() == "tpu":
         preset = "t2t-base"
         batch, prompt_len, new_tokens = 8, 1024, 128
+        # two prompt lengths per bucket: heads 299/449 -> 512, 699/999 -> 1024
+        sweep_lens = (300, 450, 700, 1000)
     else:
         # off-TPU smoke run: mirror bench_train's degradation — the full
         # t2t-base serving sweep on CPU takes minutes through the oracle
         preset = "tiny"
         batch, prompt_len, new_tokens = 2, 64, 8
+        # heads 19/27 -> bucket 32, 39/55 -> bucket 64
+        sweep_lens = (20, 28, 40, 56)
     config = PRESETS[preset]
     total = prompt_len + new_tokens
     if config.max_seq_len < total:
@@ -216,43 +231,98 @@ def bench_generate():
     prompt = jax.random.randint(key, (batch, prompt_len), 0,
                                 config.vocab_size, dtype=jnp.int32)
 
-    # prefill: one full-width trunk pass writes the prompt KV cache
-    cache = decode.init_cache(config, batch, max_len=total)
-    head = prompt[:, :prompt_len - 1]
-    jax.block_until_ready(decode._prefill_cache(params, head, cache, config))
+    head_width = decode._prefill_bucket(
+        prompt_len - 1, config.max_seq_len - new_tokens - 1)
+    buffer_total = head_width + 1 + new_tokens
+    head = jnp.pad(prompt[:, :prompt_len - 1],
+                   ((0, 0), (0, head_width - (prompt_len - 1))))
+    real_len = jnp.int32(prompt_len - 1)
     reps = 3
-    started = time.perf_counter()
-    for _ in range(reps):
-        filled = decode._prefill_cache(params, head, cache, config)
+
+    # prefill: one full-width trunk pass writes the prompt KV cache in
+    # place; each timed rep donates a fresh zero buffer
+    def fresh_cache(batch_n=batch):
+        return decode.init_cache(config, batch_n, max_len=buffer_total)
+
+    filled = decode._prefill_cache(params, head, fresh_cache(), config,
+                                   real_len)
     jax.block_until_ready(filled)
+    caches = [fresh_cache() for _ in range(reps)]
+    jax.block_until_ready(caches)
+    started = time.perf_counter()
+    for cache in caches:
+        out = decode._prefill_cache(params, head, cache, config, real_len)
+    jax.block_until_ready(out)
     prefill_s = (time.perf_counter() - started) / reps
     prefill_tps = batch * (prompt_len - 1) / prefill_s
 
-    # steady-state decode: the generation scan alone, cache pre-filled
+    # steady-state decode: the generation scan alone, cache pre-filled;
+    # tokens/cache/key donate, so each rep is armed with its own copy
     def decode_tps_at(batch_n, filled_cache, prompt_n):
-        tokens = jnp.concatenate(
-            [prompt_n, jnp.zeros((batch_n, new_tokens), jnp.int32)], axis=1)
-        scan = lambda: decode._generate_on_device(  # noqa: E731
-            params, tokens, filled_cache, jax.random.PRNGKey(0),
-            jnp.int32(prompt_len), jnp.float32(1.0), config=config,
-            total=total, sampling=False, top_k=None, start=prompt_len - 1)
-        scan().block_until_ready()
+        def arm():
+            tokens = jnp.concatenate(
+                [prompt_n,
+                 jnp.zeros((batch_n, buffer_total - prompt_len), jnp.int32)],
+                axis=1)
+            copy = decode.KVCache(k=jnp.array(filled_cache.k),
+                                  v=jnp.array(filled_cache.v))
+            return tokens, copy, jax.random.PRNGKey(0)
+
+        def scan(args):
+            tokens, cache, scan_key = args
+            return decode._generate_on_device(
+                params, tokens, cache, scan_key, jnp.int32(prompt_len),
+                jnp.float32(1.0), jnp.int32(prompt_len - 1), config=config,
+                num_steps=new_tokens, sampling=False, top_k=None)[0]
+
+        jax.block_until_ready(scan(arm()))
+        armed = [arm() for _ in range(reps)]
+        jax.block_until_ready(armed)
         started = time.perf_counter()
-        for _ in range(reps):
-            out = scan()
+        for args in armed:
+            out = scan(args)
         out.block_until_ready()
         decode_s = (time.perf_counter() - started) / reps
         return batch_n * new_tokens / decode_s, decode_s
 
     decode_tps, decode_s = decode_tps_at(batch, filled, prompt)
+
+    # mixed-length sweep through the public generate(): lengths sharing a
+    # bucket must reuse one executable (counted misses == distinct buckets)
+    before = compile_counts()
+    sweep, buckets = [], set()
+    for plen in sweep_lens:
+        sweep_prompt = jax.random.randint(
+            jax.random.PRNGKey(plen), (batch, plen), 0, config.vocab_size,
+            dtype=jnp.int32)
+        bucket = decode._prefill_bucket(
+            plen - 1, config.max_seq_len - new_tokens - 1)
+        buckets.add(bucket)
+        jax.block_until_ready(decode.generate(
+            params, config, sweep_prompt, max_new_tokens=new_tokens))
+        started = time.perf_counter()
+        jax.block_until_ready(decode.generate(
+            params, config, sweep_prompt, max_new_tokens=new_tokens))
+        gen_s = time.perf_counter() - started
+        sweep.append({"prompt_len": plen, "bucket": bucket,
+                      "tokens_per_sec": round(batch * new_tokens / gen_s, 1)})
+    delta = {k: v - before.get(k, 0) for k, v in compile_counts().items()
+             if v != before.get(k, 0)}
+
     result = {
         "preset": preset,
         "batch": batch,
         "prompt_len": prompt_len,
         "new_tokens": new_tokens,
+        "cache_update": "inplace_donated",
+        "prefill_bucket": head_width,
         "prefill_tokens_per_sec": round(prefill_tps, 1),
         "decode_tokens_per_sec": round(decode_tps, 1),
         "decode_ms_per_token": round(decode_s / new_tokens * 1e3, 3),
+        "bucket_sweep": sweep,
+        "compile": {**delta, "buckets": len(buckets),
+                    "one_executable_per_bucket":
+                        delta.get("generate_miss", 0) == len(buckets)},
     }
     if jax.default_backend() == "tpu":
         # batch sweep: decode at b8 runs ~15% of the HBM roofline
@@ -261,9 +331,10 @@ def bench_generate():
         batch4 = batch * 4
         prompt4 = jax.random.randint(key, (batch4, prompt_len), 0,
                                      config.vocab_size, dtype=jnp.int32)
-        cache4 = decode.init_cache(config, batch4, max_len=total)
-        filled4 = decode._prefill_cache(params, prompt4[:, :prompt_len - 1],
-                                        cache4, config)
+        head4 = jnp.pad(prompt4[:, :prompt_len - 1],
+                        ((0, 0), (0, head_width - (prompt_len - 1))))
+        filled4 = decode._prefill_cache(params, head4, fresh_cache(batch4),
+                                        config, real_len)
         jax.block_until_ready(filled4)
         tps4, s4 = decode_tps_at(batch4, filled4, prompt4)
         result[f"decode_b{batch4}_tokens_per_sec"] = round(tps4, 1)

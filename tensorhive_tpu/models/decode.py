@@ -4,11 +4,21 @@ The inference side of the training stack (no reference counterpart — the
 reference manages clusters, it has no model code at all). TPU-first design:
 
 * **one jitted scan, static shapes** — the cache is a fixed
-  [layers, B, max_len, KV_HEADS, D] buffer updated with
-  ``dynamic_update_slice``; prefill + generation run as a single on-device
-  ``lax.scan`` (position and prompt length traced, total length static), so
-  one compiled executable covers the whole generation with no per-token
-  host dispatch (measured 24× over a python token loop on a tunneled v5e).
+  [layers, B, max_len, KV_HEADS, D] buffer updated IN PLACE with one
+  ``dynamic_update_slice`` at ``(layer, 0, position, 0, 0)`` per layer;
+  prefill + generation run as a single on-device ``lax.scan`` (position,
+  prompt length and scan start traced, step count static), so one compiled
+  executable covers the whole generation with no per-token host dispatch
+  (measured 24× over a python token loop on a tunneled v5e).
+* **donated buffers** — the cache, token buffer and PRNG key are donated
+  across the ``_prefill_cache`` → ``_generate_on_device`` boundary
+  (``donate_argnames``), so XLA aliases the multi-hundred-MB cache between
+  the two executables and across scan steps instead of copying it.
+* **shape-bucketed prefill** — prompt lengths pad up to power-of-two
+  buckets (``_prefill_bucket``; real length stays a traced operand that
+  masks the padded cache writes), so serving mixed-length prompts compiles
+  O(log S) executables instead of one per distinct length; compiles vs.
+  shape-cache reuses are counted in ``tpuhive_decode_compile_total``.
 * **decode attention is a masked grouped dot over the cache** — single-token
   decode is HBM-bandwidth-bound (reading K/V), not FLOP-bound, so a pallas
   kernel buys nothing here; GQA attends against the unexpanded cache.
@@ -16,11 +26,13 @@ reference manages clusters, it has no model code at all). TPU-first design:
 from __future__ import annotations
 
 import functools
+import math
 from typing import Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
+from ..observability import get_registry
 from .transformer import (
     Params,
     TransformerConfig,
@@ -79,75 +91,106 @@ def apply_step(
 
     Routes through TransformerLM.block_forward (the single copy of the
     block math) with a cache-updating attend strategy, so training and
-    decoding cannot architecturally drift."""
+    decoding cannot architecturally drift. Each layer writes its [B,1,H,Dh]
+    K/V directly into the full 5-D buffer with ONE dynamic_update_slice at
+    (layer, 0, position, 0, 0) — the seed version sliced a per-layer view
+    and re-``jnp.stack``ed all layers every step, an O(layers·B·S·Hkv·Dh)
+    rebuild per token that XLA cannot reliably alias away inside a scan."""
     dtype = config.dtype
     x = params["tok_embed"].astype(dtype)[token][:, None, :]   # [B,1,D]
     positions = jnp.full((token.shape[0], 1), position, jnp.int32)
-    new_k, new_v = [], []
-    for layer_index, block in enumerate(params["blocks"]):
-        def attend(q, k, v, _layer=layer_index):
-            k_cache = jax.lax.dynamic_update_slice(
-                cache.k[_layer], k.astype(cache.k.dtype), (0, position, 0, 0))
-            v_cache = jax.lax.dynamic_update_slice(
-                cache.v[_layer], v.astype(cache.v.dtype), (0, position, 0, 0))
-            new_k.append(k_cache)
-            new_v.append(v_cache)
-            return _decode_attend(q, k_cache, v_cache, position)
+    cache_k, cache_v = cache.k, cache.v
 
-        x = TransformerLM.block_forward(x, block, config, positions, attend)
+    def attend(q, k, v, layer):
+        nonlocal cache_k, cache_v
+        cache_k = jax.lax.dynamic_update_slice(
+            cache_k, k.astype(cache_k.dtype)[None],
+            (layer, 0, position, 0, 0))
+        cache_v = jax.lax.dynamic_update_slice(
+            cache_v, v.astype(cache_v.dtype)[None],
+            (layer, 0, position, 0, 0))
+        return _decode_attend(q, cache_k[layer], cache_v[layer], position)
+
+    for layer_index, block in enumerate(params["blocks"]):
+        x = TransformerLM.block_forward(x, block, config, positions, attend,
+                                        layer_index=layer_index)
     x = _rmsnorm(x, params["final_norm"]["scale"])
     logits = jnp.dot(x[:, 0].astype(dtype), params["w_lm_head"].astype(dtype),
                      preferred_element_type=jnp.float32)
-    cache = KVCache(k=jnp.stack(new_k), v=jnp.stack(new_v))
-    return logits, cache
+    return logits, KVCache(k=cache_k, v=cache_v)
 
 
-@functools.partial(jax.jit, static_argnames=("config",))
-def _prefill_cache(params, prompt_head, cache, config):
-    """Write K/V for prompt positions 0..L0-1 into the cache in ONE batched
-    pass — thousands of serial single-token cache updates for a long prompt
-    collapse into one full-width trunk pass (flash attention over the
-    prompt, no LM head). Cache contents match the sequential path to float
-    accumulation-order tolerance — batched vs per-token matmuls cannot be
-    bit-equal (tested at 2e-4 in
+def _prefill_body(params, prompt_head, cache, config, real_len=None):
+    """Write K/V for prompt positions 0..real_len-1 into the cache in ONE
+    batched pass — thousands of serial single-token cache updates for a long
+    prompt collapse into one full-width trunk pass (flash attention over the
+    prompt, no LM head). ``prompt_head`` may be right-padded up to a shape
+    bucket; ``real_len`` (traced) zero-masks the padded K/V writes, and
+    causal attention already keeps every real position exact regardless of
+    what sits to its right. Cache contents match the sequential path to
+    float accumulation-order tolerance — batched vs per-token matmuls cannot
+    be bit-equal (tested at 2e-4 in
     test_decode.py::test_batched_prefill_cache_matches_sequential)."""
     from .transformer import flash_attention
 
     dtype = config.dtype
-    batch, l0 = prompt_head.shape
+    batch, width = prompt_head.shape
     x = params["tok_embed"].astype(dtype)[prompt_head]
-    positions = jnp.broadcast_to(jnp.arange(l0, dtype=jnp.int32), (batch, l0))
-    new_k, new_v = [], []
+    positions = jnp.broadcast_to(jnp.arange(width, dtype=jnp.int32),
+                                 (batch, width))
+    if real_len is None:
+        valid = None                    # exact-width call: nothing padded
+    else:
+        valid = (jnp.arange(width, dtype=jnp.int32)
+                 < real_len)[None, :, None, None]
+    cache_k, cache_v = cache.k, cache.v
+
+    def attend(q, k, v, layer):
+        nonlocal cache_k, cache_v
+        write_k, write_v = k, v
+        if valid is not None:
+            write_k = jnp.where(valid, k, 0)
+            write_v = jnp.where(valid, v, 0)
+        cache_k = jax.lax.dynamic_update_slice(
+            cache_k, write_k.astype(cache_k.dtype)[None], (layer, 0, 0, 0, 0))
+        cache_v = jax.lax.dynamic_update_slice(
+            cache_v, write_v.astype(cache_v.dtype)[None], (layer, 0, 0, 0, 0))
+        # GQA runs natively in the kernel (KV head h // group via the
+        # BlockSpec index maps) — no expanded K/V copy
+        return flash_attention(q, k, v, causal=True)
 
     for layer_index, block in enumerate(params["blocks"]):
-        def attend(q, k, v, _layer=layer_index):
-            new_k.append(jax.lax.dynamic_update_slice(
-                cache.k[_layer], k.astype(cache.k.dtype), (0, 0, 0, 0)))
-            new_v.append(jax.lax.dynamic_update_slice(
-                cache.v[_layer], v.astype(cache.v.dtype), (0, 0, 0, 0)))
-            # GQA runs natively in the kernel (KV head h // group via the
-            # BlockSpec index maps) — no expanded K/V copy
-            return flash_attention(q, k, v, causal=True)
-
-        x = TransformerLM.block_forward(x, block, config, positions, attend)
-    return KVCache(k=jnp.stack(new_k), v=jnp.stack(new_v))
+        x = TransformerLM.block_forward(x, block, config, positions, attend,
+                                        layer_index=layer_index)
+    return KVCache(k=cache_k, v=cache_v)
 
 
-@functools.partial(
-    jax.jit, static_argnames=("config", "total", "start", "sampling", "top_k"))
-def _generate_on_device(params, tokens, cache, key, prompt_len, temperature,
-                        config, total, sampling, top_k, start=0):
+#: the serving path donates the cache (XLA aliases the buffer into the
+#: output instead of copying it); the undonated twin exists for callers
+#: that reuse one filled cache across calls (bench steady-state timing)
+_prefill_cache = functools.partial(jax.jit, static_argnames=("config",),
+                                   donate_argnames=("cache",))(_prefill_body)
+_prefill_cache_undonated = functools.partial(
+    jax.jit, static_argnames=("config",))(_prefill_body)
+
+
+def _generate_body(params, tokens, cache, key, prompt_len, temperature,
+                   start, config, num_steps, sampling, top_k):
     """The whole prefill+generate loop as ONE lax.scan on device. A python
     per-token loop pays the host→device dispatch latency every step — ~80 ms
     per token over a tunneled link vs ~3.5 ms for the step itself; the scan
     leaves the device busy end to end (measured 24× on t2t-base).
 
-    Only shape-determining values are static (total, the sampling MODE and
-    top_k); prompt_len and temperature are traced operands, so varying
-    prompt lengths or temperatures reuse one compiled executable."""
+    Only shape-determining values are static (num_steps, the sampling MODE
+    and top_k); prompt_len, temperature and the scan start position are
+    traced operands, so — with prefill shapes bucketed — varying prompt
+    lengths, temperatures and seeds all reuse one compiled executable per
+    (batch, bucket) pair."""
+    total = tokens.shape[1]
 
-    def step(carry, position):
+    def step(carry, index):
         tokens, cache, key = carry
+        position = start + index
         current = jax.lax.dynamic_slice_in_dim(tokens, position, 1, axis=1)[:, 0]
         logits, cache = apply_step(params, current, cache, position, config)
 
@@ -161,15 +204,18 @@ def _generate_on_device(params, tokens, cache, key, prompt_len, temperature,
                 return jnp.argmax(logits, axis=-1).astype(tokens.dtype), key
             scaled = logits / temperature
             if top_k is not None:
-                kth = jnp.sort(scaled, axis=-1)[:, -top_k][:, None]
+                # only the k-th largest value is needed for the filter:
+                # lax.top_k is O(V·log k) over the vocab where the seed's
+                # full jnp.sort paid O(V·log V) every sampled step
+                kth = jax.lax.top_k(scaled, top_k)[0][:, -1][:, None]
                 scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
             key, sample_key = jax.random.split(key)
             chosen = jax.random.categorical(sample_key, scaled, axis=-1)
             return chosen.astype(tokens.dtype), key
 
         def prefill(operands):
-            # next token comes from the prompt: skip the vocab-wide sort/
-            # sample work entirely and leave the PRNG stream untouched
+            # next token comes from the prompt: skip the top-k/sample work
+            # entirely and leave the PRNG stream untouched
             logits, key = operands
             upcoming = jax.lax.dynamic_slice_in_dim(
                 tokens, jnp.minimum(position + 1, total - 1), 1, axis=1)[:, 0]
@@ -181,9 +227,54 @@ def _generate_on_device(params, tokens, cache, key, prompt_len, temperature,
             tokens, chosen[:, None], (0, position + 1))
         return (tokens, cache, key), None
 
-    (tokens, _, _), _ = jax.lax.scan(
-        step, (tokens, cache, key), jnp.arange(start, total - 1))
-    return tokens
+    # return the WHOLE final carry: donation is implemented as XLA
+    # input-output aliasing, so each donated operand needs a same-shaped
+    # output to alias into — returning only tokens would leave the cache
+    # and key donations unusable (and the final cache is the natural hook
+    # for continuation decoding)
+    carry, _ = jax.lax.scan(
+        step, (tokens, cache, key), jnp.arange(num_steps))
+    return carry
+
+
+_GENERATE_STATICS = ("config", "num_steps", "sampling", "top_k")
+#: serving path: tokens/cache/key are donated — the scan carry and the
+#: prefill output alias in place instead of being copied into the executable
+_generate_on_device = functools.partial(
+    jax.jit, static_argnames=_GENERATE_STATICS,
+    donate_argnames=("tokens", "cache", "key"))(_generate_body)
+_generate_on_device_undonated = functools.partial(
+    jax.jit, static_argnames=_GENERATE_STATICS)(_generate_body)
+
+
+#: floor for prefill shape buckets — below this, distinct executables are
+#: cheap enough that finer buckets would only fragment the compile cache
+PREFILL_BUCKET_FLOOR = 16
+
+
+def _prefill_bucket(length: int, cap: int,
+                    floor: int = PREFILL_BUCKET_FLOOR) -> int:
+    """Pad a prefill width up to the next power of two (min ``floor``),
+    capped at ``cap`` (the widest head max_seq_len admits) so the top
+    bucket never allocates past the model's sequence budget."""
+    bucket = max(floor, 1 << max(0, length - 1).bit_length())
+    return min(bucket, max(length, cap))
+
+
+_compile_seen: set = set()
+
+
+def _count_compile(fn: str, fingerprint: tuple) -> None:
+    """Count decode-path executable compiles (miss = first time this shape
+    fingerprint is dispatched in-process, mirroring jax's jit cache) vs.
+    shape-cache reuses (hit) in ``tpuhive_decode_compile_total``."""
+    event = "hit" if fingerprint in _compile_seen else "miss"
+    _compile_seen.add(fingerprint)
+    get_registry().counter(
+        "tpuhive_decode_compile_total",
+        "decode-path executables: miss = new shape compiled, "
+        "hit = shape-cache reuse",
+        labels=("fn", "event")).labels(fn=fn, event=event).inc()
 
 
 def generate(
@@ -195,6 +286,8 @@ def generate(
     top_k: Optional[int] = None,
     seed: int = 0,
     batched_prefill: bool = True,
+    bucket_prompt: bool = True,
+    donate: bool = True,
 ) -> jax.Array:
     """Generate ``max_new_tokens`` continuations: returns [B, P+N] int32.
 
@@ -206,14 +299,29 @@ def generate(
     ONE full-width trunk pass and the decode scan runs only the generated
     positions — a 1-2k-token prompt costs one batched forward instead of
     thousands of serial cache updates (measured on v5e, t2t-base,
-    1024-token prompt + 32 new: 168 ms vs 692 ms host-synced — 4.1×). The
-    executable then specializes on the prompt length (the TPU prefill
-    idiom: shape-bucketed compiles); ``batched_prefill=False`` keeps the
-    round-2 behavior of one executable for all prompt lengths at the same
-    total. The two paths are logically identical (tested exactly in f32);
-    in bf16 a batched and a sequential matmul differ in accumulation
-    order, so greedy argmax near-ties (untrained weights) can pick
-    different tokens — same caveat as any batch-size change."""
+    1024-token prompt + 32 new: 168 ms vs 692 ms host-synced — 4.1×).
+
+    ``bucket_prompt`` (default) pads the prefill width up to a power-of-two
+    bucket (``_prefill_bucket``) and sizes the token/cache buffers off the
+    bucket, so mixed-length prompts at one (batch, max_new_tokens) compile
+    O(log S) executables instead of one per distinct length — the real
+    prompt length stays a traced operand (it masks padded cache writes and
+    steers the prompt-vs-sample branch), so only the bucket is baked in.
+    Compiles vs. reuses are observable in ``tpuhive_decode_compile_total``.
+    ``batched_prefill=False`` keeps the round-2 behavior of one executable
+    for all prompt lengths at the same total (and never buckets).
+
+    ``donate`` hands the token/cache/key buffers to XLA (`donate_argnames`)
+    so the prefill output aliases into the generate executable instead of
+    being copied — at t2t-big scale the cache is hundreds of MB per call.
+    Donation changes buffer ownership, never values (pinned exactly in f32
+    by test_decode.py::test_donated_generate_matches_undonated); pass
+    ``donate=False`` only when profiling against held cache references.
+
+    All paths are logically identical (tested exactly in f32); in bf16 a
+    batched and a sequential matmul differ in accumulation order, so greedy
+    argmax near-ties (untrained weights) can pick different tokens — same
+    caveat as any batch-size change."""
     if not config.causal:
         raise ValueError("generate() needs an autoregressive model; this "
                          "config is a bidirectional encoder (causal=False)")
@@ -227,23 +335,50 @@ def generate(
         # clamping would otherwise silently disable the filter
         raise ValueError(
             f"top_k must be in (0, {config.vocab_size}], got {top_k}")
-    cache = init_cache(config, batch, max_len=total)
+    sampling = temperature > 0.0
+    prefilling = batched_prefill and prompt_len > 1
+    head_width = prompt_len - 1
+    if prefilling and bucket_prompt:
+        # cap: the widest head any prompt at this max_new could have, so
+        # the top bucket never allocates past max_seq_len
+        head_width = _prefill_bucket(
+            prompt_len - 1, config.max_seq_len - max_new_tokens - 1)
+    # buffers sized off the BUCKET: padding lives at positions the scan
+    # either overwrites before attending or never attends at all (mask is
+    # `<= position`), so bucketed output is exact, not approximate
+    buffer_total = head_width + 1 + max_new_tokens if prefilling else total
+    num_steps = max_new_tokens if prefilling else total - 1
+
+    cache = init_cache(config, batch, max_len=buffer_total)
     key = jax.random.PRNGKey(seed)
     tokens = jnp.concatenate(
-        [prompt, jnp.zeros((batch, max_new_tokens), prompt.dtype)], axis=1)
-    sampling = temperature > 0.0
+        [prompt, jnp.zeros((batch, buffer_total - prompt_len), prompt.dtype)],
+        axis=1)
     start = 0
-    if batched_prefill and prompt_len > 1:
+    if prefilling:
         # prefill positions 0..P-2; the scan's first step consumes the
         # token at P-1 and emits the first generated position
-        cache = _prefill_cache(params, prompt[:, :prompt_len - 1], cache,
-                               config)
+        head = prompt[:, :prompt_len - 1]
+        if head_width > prompt_len - 1:
+            head = jnp.pad(head, ((0, 0), (0, head_width - (prompt_len - 1))))
+        _count_compile("prefill",
+                       ("prefill", config, batch, head_width, buffer_total,
+                        donate))
+        prefill_fn = _prefill_cache if donate else _prefill_cache_undonated
+        cache = prefill_fn(params, head, cache, config,
+                           jnp.int32(prompt_len - 1))
         start = prompt_len - 1
-    return _generate_on_device(
+    _count_compile("generate",
+                   ("generate", config, batch, buffer_total, num_steps,
+                    sampling, top_k if sampling else None, donate))
+    generate_fn = (_generate_on_device if donate
+                   else _generate_on_device_undonated)
+    out, _, _ = generate_fn(
         params, tokens, cache, key, jnp.int32(prompt_len),
-        jnp.float32(temperature if sampling else 1.0),
-        config=config, total=total, sampling=sampling,
-        top_k=top_k if sampling else None, start=start)
+        jnp.float32(temperature if sampling else 1.0), jnp.int32(start),
+        config=config, num_steps=num_steps, sampling=sampling,
+        top_k=top_k if sampling else None)
+    return out[:, :total]
 
 
 @functools.lru_cache(maxsize=8)
@@ -286,5 +421,10 @@ def evaluate(
                 f"{num_batches}") from None
         total = total + loss_fn(params, tokens)
     mean = float(total) / num_batches
-    return {"loss": mean, "perplexity": float(jnp.exp(mean)),
-            "batches": num_batches}
+    # math.exp on the already-synced host float: jnp.exp here would be a
+    # SECOND device dispatch + blocking sync after the loss sync above
+    try:
+        perplexity = math.exp(mean)
+    except OverflowError:           # diverged eval; jnp.exp returned inf too
+        perplexity = float("inf")
+    return {"loss": mean, "perplexity": perplexity, "batches": num_batches}

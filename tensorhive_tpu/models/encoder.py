@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import math
 from typing import Optional, Tuple
 
 import jax
@@ -159,8 +160,14 @@ def mlm_evaluate(
                                 config, mask_ratio)
         total = total + loss_fn(params, packed)
     mean = float(total) / num_batches
+    # math.exp on the already-synced host float: jnp.exp here would be a
+    # SECOND device dispatch + blocking sync after the loss sync above
+    try:
+        pseudo_perplexity = math.exp(mean)
+    except OverflowError:           # diverged eval; jnp.exp returned inf too
+        pseudo_perplexity = float("inf")
     return {"loss": mean,
-            "pseudo_perplexity": float(jnp.exp(jnp.float32(mean))),
+            "pseudo_perplexity": pseudo_perplexity,
             "batches": num_batches}
 
 

@@ -259,9 +259,15 @@ class TransformerLM:
     # -- forward ------------------------------------------------------------
     @staticmethod
     def block_attn_half(x, block, config: TransformerConfig, positions,
-                        attend) -> jax.Array:
+                        attend, layer_index: Optional[int] = None) -> jax.Array:
         """Attention half of a block: pre-norm QKV + rope + attend + output
-        projection, residual added."""
+        projection, residual added.
+
+        ``attend`` is called as ``attend(q, k, v)`` — or, when the caller
+        passes ``layer_index``, as ``attend(q, k, v, layer_index)``: cache-
+        updating strategies (models/decode.py) write each layer's K/V into
+        one full 5-D buffer and need the layer coordinate, without building
+        a fresh closure per layer."""
         dtype = config.dtype
         h = _rmsnorm(x, block["attn_norm"]["scale"])
         b, l, d = h.shape
@@ -273,7 +279,9 @@ class TransformerLM:
                                                     config.d_head)
         q = _rope(q, positions, config.rope_theta)
         k = _rope(k, positions, config.rope_theta)
-        attn = attend(q, k, v).reshape(b, l, config.n_heads * config.d_head)
+        attn = (attend(q, k, v) if layer_index is None
+                else attend(q, k, v, layer_index))
+        attn = attn.reshape(b, l, config.n_heads * config.d_head)
         return x + attn @ block["wo"].astype(dtype)
 
     @staticmethod
@@ -288,13 +296,16 @@ class TransformerLM:
 
     @staticmethod
     def block_forward(x, block, config: TransformerConfig, positions,
-                      attend) -> jax.Array:
+                      attend, layer_index: Optional[int] = None) -> jax.Array:
         """One transformer block (pre-norm attention + SwiGLU MLP). The
         SINGLE copy of the block math — training (apply_trunk) and cached
         decoding (models/decode.py apply_step) both route through it with
         their own ``attend(q, k, v) -> [B, L, H, Dh]`` strategy, so the
-        architectures cannot drift apart."""
-        x = TransformerLM.block_attn_half(x, block, config, positions, attend)
+        architectures cannot drift apart. ``layer_index`` (optional) is
+        forwarded to ``attend`` for strategies that index a stacked
+        all-layers KV cache — see block_attn_half."""
+        x = TransformerLM.block_attn_half(x, block, config, positions, attend,
+                                          layer_index=layer_index)
         return TransformerLM.block_mlp_half(x, block, config)
 
     @staticmethod

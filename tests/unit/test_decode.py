@@ -166,3 +166,176 @@ def test_gqa_batched_prefill_matches_sequential():
     slow = generate(params, config, prompt, max_new_tokens=4,
                     batched_prefill=False)
     np.testing.assert_array_equal(np.asarray(fast), np.asarray(slow))
+
+
+# -- decode fast path: donation, in-place cache, bucketed prefill ------------
+
+
+def test_donated_generate_matches_undonated():
+    """Donation changes buffer ownership, never values: the donated and
+    undonated executables must agree bit-for-bit in f32 on both the greedy
+    and the sampled path."""
+    params = TransformerLM.init(jax.random.PRNGKey(10), F32_TINY)
+    prompt = jax.random.randint(jax.random.PRNGKey(11), (2, 13), 0,
+                                F32_TINY.vocab_size)
+    for kwargs in ({"temperature": 0.0},
+                   {"temperature": 0.9, "top_k": 5, "seed": 7}):
+        donated = generate(params, F32_TINY, prompt, max_new_tokens=6,
+                           donate=True, **kwargs)
+        held = generate(params, F32_TINY, prompt, max_new_tokens=6,
+                        donate=False, **kwargs)
+        np.testing.assert_array_equal(np.asarray(donated), np.asarray(held))
+
+
+def test_bucketed_prefill_matches_exact():
+    """Bucket padding is exact, not approximate: padded cache writes are
+    masked to zero and causal attention keeps every real position identical,
+    so the bucketed and exact-width prefill caches — and the generated
+    tokens — must match in f32."""
+    from tensorhive_tpu.models.decode import _prefill_bucket, _prefill_cache
+
+    params = TransformerLM.init(jax.random.PRNGKey(12), F32_TINY)
+    batch, plen, new = 2, 11, 5
+    prompt = jax.random.randint(jax.random.PRNGKey(13), (batch, plen), 0,
+                                F32_TINY.vocab_size)
+
+    bucket = _prefill_bucket(plen - 1, F32_TINY.max_seq_len - new - 1)
+    assert bucket > plen - 1, "pick plen so the bucket actually pads"
+    total = bucket + 1 + new
+    head = jnp.pad(prompt[:, :plen - 1], ((0, 0), (0, bucket - (plen - 1))))
+    bucketed = _prefill_cache(params, head,
+                              init_cache(F32_TINY, batch, max_len=total),
+                              F32_TINY, jnp.int32(plen - 1))
+    exact = _prefill_cache(params, prompt[:, :plen - 1],
+                           init_cache(F32_TINY, batch, max_len=total),
+                           F32_TINY)
+    np.testing.assert_array_equal(np.asarray(bucketed.k[:, :, :plen - 1]),
+                                  np.asarray(exact.k[:, :, :plen - 1]))
+    np.testing.assert_array_equal(np.asarray(bucketed.v[:, :, :plen - 1]),
+                                  np.asarray(exact.v[:, :, :plen - 1]))
+    # padded positions are masked to zero, not garbage from the pad tokens
+    np.testing.assert_array_equal(np.asarray(bucketed.k[:, :, plen - 1:]), 0.0)
+    np.testing.assert_array_equal(np.asarray(bucketed.v[:, :, plen - 1:]), 0.0)
+
+    for kwargs in ({"temperature": 0.0},
+                   {"temperature": 0.8, "top_k": 6, "seed": 3}):
+        padded = generate(params, F32_TINY, prompt, max_new_tokens=new,
+                          bucket_prompt=True, **kwargs)
+        unpadded = generate(params, F32_TINY, prompt, max_new_tokens=new,
+                            bucket_prompt=False, **kwargs)
+        np.testing.assert_array_equal(np.asarray(padded),
+                                      np.asarray(unpadded))
+
+
+def test_inplace_cache_matches_stacked_rebuild():
+    """apply_step's single 5-D dynamic_update_slice per layer must produce
+    exactly the cache (and logits) of the seed's per-layer-slice +
+    jnp.stack rebuild, reimplemented here as the reference."""
+    from tensorhive_tpu.models.decode import KVCache, _decode_attend
+    from tensorhive_tpu.models.transformer import _rmsnorm
+
+    def stacked_apply_step(params, token, cache, position, config):
+        dtype = config.dtype
+        x = params["tok_embed"].astype(dtype)[token][:, None, :]
+        positions = jnp.full((token.shape[0], 1), position, jnp.int32)
+        new_k, new_v = [], []
+        for layer_index, block in enumerate(params["blocks"]):
+            def attend(q, k, v, _layer=layer_index):
+                k_cache = jax.lax.dynamic_update_slice(
+                    cache.k[_layer], k.astype(cache.k.dtype),
+                    (0, position, 0, 0))
+                v_cache = jax.lax.dynamic_update_slice(
+                    cache.v[_layer], v.astype(cache.v.dtype),
+                    (0, position, 0, 0))
+                new_k.append(k_cache)
+                new_v.append(v_cache)
+                return _decode_attend(q, k_cache, v_cache, position)
+
+            x = TransformerLM.block_forward(x, block, config, positions,
+                                            attend)
+        x = _rmsnorm(x, params["final_norm"]["scale"])
+        logits = jnp.dot(x[:, 0].astype(dtype),
+                         params["w_lm_head"].astype(dtype),
+                         preferred_element_type=jnp.float32)
+        return logits, KVCache(k=jnp.stack(new_k), v=jnp.stack(new_v))
+
+    params = TransformerLM.init(jax.random.PRNGKey(14), F32_TINY)
+    tokens = jax.random.randint(jax.random.PRNGKey(15), (2, 6), 0,
+                                F32_TINY.vocab_size)
+    inplace_cache = init_cache(F32_TINY, 2, max_len=6)
+    stacked_cache = init_cache(F32_TINY, 2, max_len=6)
+    for position in range(6):
+        fast_logits, inplace_cache = apply_step(
+            params, tokens[:, position], inplace_cache, jnp.int32(position),
+            F32_TINY)
+        ref_logits, stacked_cache = stacked_apply_step(
+            params, tokens[:, position], stacked_cache, jnp.int32(position),
+            F32_TINY)
+        np.testing.assert_array_equal(np.asarray(fast_logits),
+                                      np.asarray(ref_logits))
+    np.testing.assert_array_equal(np.asarray(inplace_cache.k),
+                                  np.asarray(stacked_cache.k))
+    np.testing.assert_array_equal(np.asarray(inplace_cache.v),
+                                  np.asarray(stacked_cache.v))
+
+
+def test_generate_compiles_one_executable_per_bucket():
+    """Mixed prompt lengths sharing a prefill bucket must reuse ONE
+    generate (and one prefill) executable; the compile counter mirrors it
+    as one miss + N-1 hits. Shapes here (batch 4, 7 new tokens) are unique
+    to this test so the in-process jit cache starts cold for them."""
+    from tensorhive_tpu.models import decode
+    from tensorhive_tpu.observability import get_registry
+
+    params = TransformerLM.init(jax.random.PRNGKey(16), F32_TINY)
+    counter = get_registry().counter(
+        "tpuhive_decode_compile_total",
+        "decode-path executables: miss = new shape compiled, "
+        "hit = shape-cache reuse",
+        labels=("fn", "event"))
+    gen_before = decode._generate_on_device._cache_size()
+    pre_before = decode._prefill_cache._cache_size()
+    miss_before = counter.labels(fn="generate", event="miss").value
+    hit_before = counter.labels(fn="generate", event="hit").value
+
+    lengths = (18, 22, 26, 30)      # heads 17..29 all bucket to 32
+    assert len({decode._prefill_bucket(n - 1, 200) for n in lengths}) == 1
+    for plen in lengths:
+        prompt = jax.random.randint(jax.random.PRNGKey(plen), (4, plen), 0,
+                                    F32_TINY.vocab_size)
+        out = generate(params, F32_TINY, prompt, max_new_tokens=7)
+        assert out.shape == (4, plen + 7)
+
+    assert decode._generate_on_device._cache_size() - gen_before <= 1
+    assert decode._prefill_cache._cache_size() - pre_before <= 1
+    assert counter.labels(fn="generate", event="miss").value - miss_before == 1
+    assert counter.labels(fn="generate", event="hit").value - hit_before == 3
+
+
+def test_prefill_bucket_mapping():
+    from tensorhive_tpu.models.decode import (
+        PREFILL_BUCKET_FLOOR,
+        _prefill_bucket,
+    )
+
+    assert _prefill_bucket(1, 1000) == PREFILL_BUCKET_FLOOR
+    assert _prefill_bucket(16, 1000) == 16
+    assert _prefill_bucket(17, 1000) == 32
+    assert _prefill_bucket(63, 1000) == 64
+    assert _prefill_bucket(65, 1000) == 128
+    # the cap bounds the top bucket at the widest head max_seq_len admits
+    assert _prefill_bucket(200, 249) == 249
+    assert _prefill_bucket(249, 249) == 249
+
+
+def test_top_k_one_is_greedy():
+    """lax.top_k filter semantics: top_k=1 leaves only the argmax token, so
+    sampling at any temperature must reproduce the greedy continuation."""
+    params = TransformerLM.init(jax.random.PRNGKey(17), F32_TINY)
+    prompt = jax.random.randint(jax.random.PRNGKey(18), (2, 8), 0,
+                                F32_TINY.vocab_size)
+    greedy = generate(params, F32_TINY, prompt, max_new_tokens=5,
+                      temperature=0.0)
+    forced = generate(params, F32_TINY, prompt, max_new_tokens=5,
+                      temperature=1.3, top_k=1, seed=5)
+    np.testing.assert_array_equal(np.asarray(greedy), np.asarray(forced))

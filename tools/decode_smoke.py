@@ -1,0 +1,92 @@
+"""CPU-backend decode smoke: tiny-config generate round-trip.
+
+Exercises the serving fast path end to end on the CPU backend — donated
+in-place KV cache, bucketed prefill, greedy + top-k sampling — and prints
+tokens/s plus the ``tpuhive_decode_compile_total`` counter state. Exits
+nonzero if the round-trip breaks (prompt not preserved, wrong shape,
+out-of-vocab tokens, or more compiled executables than prompt buckets).
+
+Run via ``make decode-smoke``; CI runs it right after the static-analysis
+gate so a decode-path regression fails before the full suite spins up.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import time
+from pathlib import Path
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import jax  # noqa: E402
+
+# the axon TPU plugin overrides the env var; pin through the config API
+# (same discipline as tests/conftest.py and bench.probe_backend)
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp  # noqa: E402
+
+from tensorhive_tpu.models import decode  # noqa: E402
+from tensorhive_tpu.models.transformer import PRESETS, TransformerLM  # noqa: E402
+from tensorhive_tpu.observability import get_registry  # noqa: E402
+
+
+def main() -> int:
+    config = PRESETS["tiny"]
+    batch, new_tokens = 2, 8
+    params = TransformerLM.init(jax.random.PRNGKey(0), config)
+
+    # mixed lengths on purpose: 20/28 share bucket 32, 40/56 share 64 —
+    # the compile counter must show one generate executable per bucket
+    prompt_lens = (20, 28, 40, 56)
+    counter = get_registry().counter(
+        "tpuhive_decode_compile_total",
+        "decode-path executables: miss = new shape compiled, "
+        "hit = shape-cache reuse",
+        labels=("fn", "event"))
+    failures = []
+    generated = 0
+    buckets = set()
+    started = time.perf_counter()
+    for index, prompt_len in enumerate(prompt_lens):
+        prompt = jax.random.randint(
+            jax.random.PRNGKey(index), (batch, prompt_len), 0,
+            config.vocab_size, dtype=jnp.int32)
+        buckets.add(decode._prefill_bucket(
+            prompt_len - 1, config.max_seq_len - new_tokens - 1))
+        temperature, top_k = ((0.0, None) if index % 2 == 0 else (0.8, 10))
+        out = decode.generate(params, config, prompt,
+                              max_new_tokens=new_tokens,
+                              temperature=temperature, top_k=top_k)
+        out = jax.block_until_ready(out)
+        generated += batch * new_tokens
+        if out.shape != (batch, prompt_len + new_tokens):
+            failures.append(f"P={prompt_len}: shape {out.shape}")
+        if not bool((out[:, :prompt_len] == prompt).all()):
+            failures.append(f"P={prompt_len}: prompt not preserved")
+        if not 0 <= int(out.min()) <= int(out.max()) < config.vocab_size:
+            failures.append(f"P={prompt_len}: out-of-vocab token")
+    elapsed = time.perf_counter() - started
+
+    misses = int(counter.labels(fn="generate", event="miss").value)
+    hits = int(counter.labels(fn="generate", event="hit").value)
+    # greedy and sampled steps are distinct executables by design (the
+    # sampling MODE is static), so the budget is one per (bucket, mode)
+    budget = len(buckets) * 2
+    if misses > budget:
+        failures.append(
+            f"{misses} generate executables for {len(buckets)} buckets "
+            f"x 2 sampling modes (budget {budget})")
+
+    print(f"decode-smoke: {generated} tokens in {elapsed:.2f}s "
+          f"({generated / elapsed:.1f} tok/s incl. compiles) | "
+          f"buckets={sorted(buckets)} compile miss={misses} hit={hits}")
+    for failure in failures:
+        print(f"decode-smoke FAILURE: {failure}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
