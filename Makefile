@@ -2,7 +2,7 @@
 # mypy + flake8 per .circleci/config.yml:33-38): the dependency-free AST
 # lint + thivelint analyzer always run; mypy/ruff run when installed
 # (absent from this image).
-.PHONY: check lint analysis test bench probe metrics-smoke decode-smoke
+.PHONY: check lint analysis test bench probe metrics-smoke decode-smoke alerts-smoke
 
 check: lint analysis
 	@command -v ruff >/dev/null 2>&1 && ruff check . || echo "ruff not installed; skipped (tools/lint.py covered the always-on subset)"
@@ -33,6 +33,12 @@ metrics-smoke:
 # compile counter, fails on round-trip or executable-count regressions
 decode-smoke:
 	python tools/decode_smoke.py
+
+# boots the WSGI app with a deliberately dead daemon service: /api/readyz
+# must flip to 503 naming it, the service_down rule must fire exactly once
+# through the sink fan-out, then resolve once the service starts
+alerts-smoke:
+	python tools/alerts_smoke.py
 
 probe:
 	$(MAKE) -C tensorhive_tpu/native

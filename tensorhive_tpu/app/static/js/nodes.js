@@ -78,6 +78,7 @@ function toggleWatch(name, on) {
 
 function renderNodes(main) {
   main.innerHTML = `<div id="svc-health"></div>
+    <div id="alert-strip"></div>
     <div class="card"><div class="row">
       <h3 style="margin:0">Watches</h3>
       ${["hbm", "duty", "procs"].map(name => `<label class="inline">
@@ -89,7 +90,7 @@ function renderNodes(main) {
     <div id="nodes"></div><dialog id="chip-dialog"></dialog>`;
   const refresh = async () => {
     try {
-      if (isAdmin()) refreshServiceHealth();
+      if (isAdmin()) { refreshServiceHealth(); refreshAlerts(); }
       const infra = await api("/nodes/metrics");
       for (const node of Object.values(infra)) {
         for (const [uid, chip] of Object.entries(node.TPU || {})) {
@@ -146,6 +147,50 @@ async function refreshServiceHealth() {
     <button class="ghost" onclick="openTracesDialog()">traces</button>
     <a class="ghost" href="/api/metrics" target="_blank"
        title="Prometheus text exposition">metrics</a>
+  </div></div>`;
+}
+
+/* alerts strip (admin): firing/pending rules from the in-process alert
+   engine (GET /admin/alerts), shown next to the service-health strip, plus
+   entry points to the health probes an orchestrator would watch */
+function isActiveAlert(rule) {
+  return rule.status === "firing" || rule.status === "pending";
+}
+
+function alertBadge(rule) {
+  const detail = (rule.description || "") + " · " + rule.severity +
+    (rule.lastValue != null ? " · value " + rule.lastValue : "") +
+    (rule.firedCount ? " · fired " + rule.firedCount + "×" : "");
+  const mark = rule.status === "firing" ? "⚠" : "…";
+  return `<span class="badge unsynchronized" title="${esc(detail)}">
+    ${mark} ${esc(rule.name)} ${esc(rule.status)}</span>`;
+}
+
+async function refreshAlerts() {
+  const el = document.getElementById("alert-strip");
+  if (!el) return;
+  let doc;
+  try { doc = await api("/admin/alerts"); }
+  catch (e) {
+    // like the service strip: never pretend "quiet" when the alert source
+    // itself is unreachable
+    el.innerHTML = `<div class="card"><div class="row">
+      <h3 style="margin:0">Alerts</h3>
+      <span class="badge unsynchronized">alerts unavailable: ${esc(e.message)}</span>
+    </div></div>`;
+    return;
+  }
+  const rules = doc.rules || [];
+  const active = rules.filter(isActiveAlert);
+  el.innerHTML = `<div class="card"><div class="row">
+    <h3 style="margin:0">Alerts</h3>
+    ${active.length ? active.map(alertBadge).join("")
+      : '<span class="badge on">all ' + rules.length + ' rules quiet</span>'}
+    <span style="flex:1"></span>
+    <a class="ghost" href="/api/healthz" target="_blank"
+       title="liveness probe">healthz</a>
+    <a class="ghost" href="/api/readyz" target="_blank"
+       title="readiness probe (503 + reasons when degraded)">readyz</a>
   </div></div>`;
 }
 

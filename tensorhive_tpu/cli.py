@@ -20,9 +20,15 @@ log = logging.getLogger(__name__)
 def setup_logging(verbose: bool = False) -> None:
     logging.basicConfig(
         level=logging.DEBUG if verbose else logging.INFO,
-        format="%(asctime)s %(levelname)-7s %(name)s: %(message)s",
+        # span_id is injected by SpanLogFilter: log lines emitted inside a
+        # tick/request span carry its id, joinable against /api/admin/traces
+        format="%(asctime)s %(levelname)-7s %(name)s [%(span_id)s]: %(message)s",
         datefmt="%H:%M:%S",
     )
+    from .observability import SpanLogFilter
+
+    for handler in logging.getLogger().handlers:
+        handler.addFilter(SpanLogFilter())
     logging.getLogger("werkzeug").setLevel(logging.WARNING)
 
 

@@ -145,6 +145,20 @@ class JobSchedulingConfig:
 
 
 @dataclasses.dataclass
+class AlertingConfig:
+    """Alert rule engine over the metrics registry (no reference analog —
+    the reference had no alerting; docs/OBSERVABILITY.md 'Alerting &
+    health'). The webhook sink is enabled by setting ``webhook_url``; every
+    delivery carries ``webhook_timeout_s`` and retries at most
+    ``webhook_retries`` extra times."""
+    enabled: bool = True
+    interval_s: float = 5.0
+    webhook_url: str = ""
+    webhook_timeout_s: float = 5.0
+    webhook_retries: int = 2
+
+
+@dataclasses.dataclass
 class SshConfig:
     """Control-plane transport settings (reference: tensorhive/config.py:113-120)."""
     timeout_s: float = 10.0
@@ -194,6 +208,7 @@ class Config:
     mailbot: MailbotConfig = dataclasses.field(default_factory=MailbotConfig)
     usage_logging: UsageLoggingConfig = dataclasses.field(default_factory=UsageLoggingConfig)
     job_scheduling: JobSchedulingConfig = dataclasses.field(default_factory=JobSchedulingConfig)
+    alerting: AlertingConfig = dataclasses.field(default_factory=AlertingConfig)
     ssh: SshConfig = dataclasses.field(default_factory=SshConfig)
     hosts: Dict[str, HostConfig] = dataclasses.field(default_factory=dict)
 
@@ -230,6 +245,7 @@ _SECTION_MAP = {
     "protection_service": "protection",
     "usage_logging_service": "usage_logging",
     "job_scheduling_service": "job_scheduling",
+    "alerting_service": "alerting",
     "ssh": "ssh",
 }
 
@@ -335,6 +351,13 @@ interval_s = 2.0
 enabled = true
 interval_s = 30.0
 schedule_queued_when_free_mins = 30.0
+
+[alerting_service]
+enabled = true
+interval_s = 5.0
+# webhook_url = "https://hooks.example.com/tpuhive"
+# webhook_timeout_s = 5.0
+# webhook_retries = 2
 
 [ssh]
 timeout_s = 10.0

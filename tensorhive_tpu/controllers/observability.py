@@ -1,6 +1,7 @@
-"""Observability controller: metrics exposition + trace dump.
+"""Observability controller: metrics exposition, trace dump, alert state
+and health probes.
 
-The two read surfaces of tensorhive_tpu/observability:
+The read surfaces of tensorhive_tpu/observability:
 
 * ``GET /metrics`` — Prometheus text format (version 0.0.4), unauthenticated
   like a conventional scrape target (it carries latency/count aggregates,
@@ -8,16 +9,23 @@ The two read surfaces of tensorhive_tpu/observability:
   this per-resource endpoint).
 * ``GET /admin/traces`` — recent spans from the ring-buffer tracer,
   admin-auth (span attrs include hostnames and job ids).
+* ``GET /healthz`` / ``GET /readyz`` — liveness and readiness, both
+  unauthenticated (an orchestrator's kubelet-style prober has no JWT);
+  readiness returns 503 with a JSON reason list when any component fails.
+* ``GET /admin/alerts`` — full rule/state dump of the alert engine plus
+  the transition history ring, admin-auth.
 """
 from __future__ import annotations
 
-from typing import Dict
+from typing import Dict, Tuple
 
 from werkzeug.wrappers import Response
 
 from ..api.app import RequestContext, int_arg, route
 from ..api.schema import arr, obj, s
 from ..observability import get_registry, get_tracer
+from ..observability.alerts import get_alert_engine
+from ..observability.health import liveness, readiness
 
 #: content type Prometheus scrapers negotiate for the text format
 PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
@@ -63,3 +71,79 @@ def get_traces(context: RequestContext) -> Dict:
         "recorded": len(tracer),
         "spans": tracer.recent(limit=limit, kind=kind),
     }
+
+
+HEALTH_COMPONENT_SCHEMA = obj(
+    required=["component", "ok"],
+    component=s("string"),
+    ok=s("boolean"),
+    reason=s("string"),
+)
+
+ALERT_RULE_SCHEMA = obj(
+    required=["name", "severity", "kind", "status"],
+    name=s("string"),
+    severity=s("string"),
+    kind=s("string"),
+    metric=s("string", nullable=True),
+    labels={"type": "object", "additionalProperties": True},
+    op=s("string"),
+    threshold=s("number"),
+    windowS=s("number"),
+    forS=s("number"),
+    description=s("string"),
+    status=s("string"),
+    since=s("number", nullable=True),
+    lastValue=s("number", nullable=True),
+    firedCount=s("integer"),
+)
+
+
+@route("/healthz", ["GET"], auth=None,
+       summary="Liveness probe (process is serving requests)",
+       tag="observability",
+       responses={200: obj(required=["status", "version", "uptimeS"],
+                           status=s("string"),
+                           version=s("string"),
+                           uptimeS=s("number"))})
+def get_healthz(context: RequestContext) -> Dict:
+    """Unauthenticated by design: a kubelet-style prober carries no JWT,
+    and the payload holds nothing but uptime + build version."""
+    return liveness()
+
+
+@route("/readyz", ["GET"], auth=None,
+       summary="Readiness probe (503 + reasons when any component fails)",
+       tag="observability",
+       responses={200: obj(required=["ready", "components"],
+                           ready=s("boolean"),
+                           components=arr(HEALTH_COMPONENT_SCHEMA),
+                           reasons=arr(s("string"))),
+                  503: obj(required=["ready", "components", "reasons"],
+                           ready=s("boolean"),
+                           components=arr(HEALTH_COMPONENT_SCHEMA),
+                           reasons=arr(s("string")))})
+def get_readyz(context: RequestContext) -> Tuple[Dict, int]:
+    """DB answers a query, every registered service is alive and ticking
+    within 3x its interval, the probe round is fresh when hosts are
+    managed — any failure 503s with the component named."""
+    ready, components = readiness()
+    reasons = [f"{c['component']}: {c.get('reason', 'not ok')}"
+               for c in components if not c["ok"]]
+    return ({"ready": ready, "components": components, "reasons": reasons},
+            200 if ready else 503)
+
+
+@route("/admin/alerts", ["GET"], auth="admin",
+       summary="Alert rule/state dump with transition history",
+       tag="observability",
+       responses={200: obj(required=["rules", "firing", "transitions"],
+                           rules=arr(ALERT_RULE_SCHEMA),
+                           firing=arr(s("string")),
+                           transitions=arr({"type": "object",
+                                            "additionalProperties": True}))})
+def get_alerts(context: RequestContext) -> Dict:
+    """Current engine truth: every rule with its lifecycle status and last
+    value, the firing subset, and the bounded transition history ring —
+    the same state the `tpuhive_alerts_firing` gauges export."""
+    return get_alert_engine().dump()

@@ -92,6 +92,10 @@ def instantiate_services_from_config(config: Config) -> List[Service]:
         from ..services.job_scheduling import JobSchedulingService
 
         services.append(JobSchedulingService(config=config))
+    if config.alerting.enabled:
+        from ..services.alerting import AlertingService
+
+        services.append(AlertingService(config=config))
     return services
 
 

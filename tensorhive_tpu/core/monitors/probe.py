@@ -74,6 +74,11 @@ _PROBE_FAILURES = get_registry().counter(
 _PROBE_HOSTS_OK = get_registry().gauge(
     "tpuhive_probe_hosts_ok",
     "Hosts whose last probe round produced a valid sample.")
+_PROBE_LAST_ROUND_TS = get_registry().gauge(
+    "tpuhive_probe_last_round_timestamp_seconds",
+    "Unix time the last probe round completed — readiness and the "
+    "probe_round_stale alert rule compare it against 3x the monitoring "
+    "interval.")
 
 PROBE_VERSION = 1
 #: stable marker present in every probe invocation (fake transports match it)
@@ -361,6 +366,7 @@ def collect_probe_samples(
     _ROUND_SECONDS.observe(time.perf_counter() - started)
     _ROUNDS_TOTAL.inc()
     _PROBE_HOSTS_OK.set(healthy)
+    _PROBE_LAST_ROUND_TS.set(time.time())
     return samples
 
 

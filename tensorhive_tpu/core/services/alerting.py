@@ -1,0 +1,72 @@
+"""AlertingService: evaluate the alert rule engine each tick, fan out
+transitions to notification sinks.
+
+The reference had no alerting at all — its services died silently (SURVEY
+§5). This daemon is deliberately thin: all rule/state logic lives in
+tensorhive_tpu/observability/alerts.py (deterministically testable with a
+fake clock), and subclassing :class:`Service` buys the tick histogram, the
+overrun counter and the liveness stamps for free — so the alerting loop is
+itself covered by the ``service_down`` rule and the readiness check like
+any other daemon.
+
+Sink fan-out happens here, outside the engine lock, with per-sink
+isolation: one broken webhook must neither skip the log sink nor kill the
+evaluating tick.
+"""
+from __future__ import annotations
+
+import logging
+from typing import List, Optional
+
+from ...config import Config, get_config
+from ...observability import get_registry
+from ...observability.alerts import (
+    AlertEngine,
+    AlertSink,
+    LogSink,
+    WebhookSink,
+    get_alert_engine,
+)
+from .base import Service
+
+log = logging.getLogger(__name__)
+
+_SINK_FAILURES = get_registry().counter(
+    "tpuhive_alert_sink_failures_total",
+    "Alert notifications a sink raised on (delivery is per-sink isolated).",
+    labels=("sink",))
+
+
+class AlertingService(Service):
+    def __init__(self, config: Optional[Config] = None,
+                 engine: Optional[AlertEngine] = None,
+                 sinks: Optional[List[AlertSink]] = None) -> None:
+        config = config or get_config()
+        super().__init__(interval_s=config.alerting.interval_s)
+        self.engine = engine if engine is not None else get_alert_engine()
+        self.sinks = sinks if sinks is not None else default_sinks(config)
+
+    def do_run(self) -> None:
+        for event in self.engine.evaluate():
+            self.dispatch(event)
+
+    def dispatch(self, event: dict) -> None:
+        for sink in self.sinks:
+            try:
+                sink.notify(event)
+            except Exception:
+                log.exception("alert sink %s failed on %s -> %s",
+                              sink.name, event.get("rule"), event.get("to"))
+                _SINK_FAILURES.labels(sink=sink.name).inc()
+
+
+def default_sinks(config: Config) -> List[AlertSink]:
+    """Structured log sink always-on; webhook sink when configured."""
+    sinks: List[AlertSink] = [LogSink()]
+    if config.alerting.webhook_url:
+        sinks.append(WebhookSink(
+            config.alerting.webhook_url,
+            timeout_s=config.alerting.webhook_timeout_s,
+            retries=config.alerting.webhook_retries,
+        ))
+    return sinks

@@ -65,6 +65,12 @@ class Service(StoppableThread):
         self.ticks_completed = 0
         self.tick_overruns = 0
         self._overrun_warned = False
+        #: wall-clock stamps backing the readiness staleness check
+        #: (observability/health.py): a service is "fresh" when its last
+        #: completed tick — or, before the first completes, its run-loop
+        #: entry — is within 3x the interval
+        self.last_tick_ts: Optional[float] = None
+        self.run_started_ts: Optional[float] = None
 
     def inject(self, infrastructure_manager: "InfrastructureManager",
                transport_manager: "TransportManager") -> None:
@@ -75,6 +81,7 @@ class Service(StoppableThread):
     # -- loop ---------------------------------------------------------------
     def run(self) -> None:
         tracer = get_tracer()
+        self.run_started_ts = time.time()
         while not self.stopped:
             started = time.perf_counter()
             span = tracer.start_span(f"tick.{self.name}", kind="tick",
@@ -105,6 +112,7 @@ class Service(StoppableThread):
         _TICK_SECONDS.labels(service=self.name).observe(elapsed_s)
         _TICKS_TOTAL.labels(service=self.name).inc()
         self.ticks_completed += 1
+        self.last_tick_ts = time.time()
 
     def record_overrun(self, elapsed_s: float) -> None:
         """A tick exceeded the interval: silent starvation of the poll

@@ -20,15 +20,23 @@ from __future__ import annotations
 
 from .metrics import (
     DEFAULT_BUCKETS,
+    PROCESS_START_TS,
     Counter,
     Gauge,
     Histogram,
     MetricsRegistry,
+    register_process_metrics,
 )
-from .tracing import Span, SpanTracer
+from .tracing import Span, SpanLogFilter, SpanTracer
 
 _registry = MetricsRegistry()
 _tracer = SpanTracer()
+
+from .. import __version__ as _version  # noqa: E402  (cheap: pure-constant module)
+
+# build info + lazy process self-metrics (RSS/threads/uptime/fds) on the
+# process-wide registry, refreshed by a collector at exposition time
+register_process_metrics(_registry, _version)
 
 
 def get_registry() -> MetricsRegistry:
@@ -42,14 +50,20 @@ def get_tracer() -> SpanTracer:
 
 
 def reset_observability() -> None:
-    """Zero all metric values and drop recorded spans (test isolation).
+    """Zero all metric values, drop recorded spans, and discard alert-engine
+    state (test isolation).
 
     Metric families and their child references stay valid — instrumented
     modules hold family/child handles created at import time, so a reset
-    must clear values in place rather than discard the objects.
+    must clear values in place rather than discard the objects. The alert
+    engine by contrast is dropped outright (rebuilt lazily on next use) —
+    its rule thresholds derive from config, which tests swap per-case.
     """
     _registry.reset_values()
     _tracer.clear()
+    from .alerts import set_alert_engine
+
+    set_alert_engine(None)
 
 
 __all__ = [
@@ -58,9 +72,12 @@ __all__ = [
     "Gauge",
     "Histogram",
     "MetricsRegistry",
+    "PROCESS_START_TS",
     "Span",
+    "SpanLogFilter",
     "SpanTracer",
     "get_registry",
     "get_tracer",
+    "register_process_metrics",
     "reset_observability",
 ]

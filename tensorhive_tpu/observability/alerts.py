@@ -1,0 +1,499 @@
+"""Declarative alert rules evaluated directly against the metrics registry.
+
+PR 1 made the control plane *measurable*; nothing in-process *evaluated*
+those measurements — a dead daemon or a climbing failure counter was only
+visible if a human happened to read a scrape. This module closes the loop
+from measured to actionable, the way cluster managers in related work treat
+health as a control signal rather than a dashboard afterthought (JIRIAF
+provisions against live node health, arxiv 2502.18596; Tally depends on
+continuously detecting interference, arxiv 2410.07381):
+
+* :class:`AlertRule` — a declarative rule over one registry family (or an
+  arbitrary ``source`` callable for signals the registry cannot carry, like
+  thread liveness). Kinds: ``threshold`` (instantaneous comparison),
+  ``increase`` (growth over a lookback window — counter-reset aware),
+  ``absent`` (the signal is missing entirely), ``stale`` (a unix-timestamp
+  gauge has not been refreshed within ``threshold`` seconds).
+* :class:`AlertEngine` — evaluates rules straight off the in-process
+  registry (no scrape round-trip), driving one state machine per rule::
+
+      inactive -> pending -(held for `for_s`)-> firing -> resolved
+
+  ``for_s`` debounces flapping signals; sinks are notified exactly once on
+  ``pending -> firing`` and once on ``firing -> resolved``. Every
+  transition (including pending entries that never fire) lands in a bounded
+  history ring for ``GET /api/admin/alerts``.
+* sinks — :class:`LogSink` (always on, structured single-line JSON payload
+  so log lines are machine-joinable) and :class:`WebhookSink` (JSON POST
+  with a hard timeout and bounded retry; failures are counted, never
+  raised into the evaluating tick).
+
+Firing state is mirrored into ``tpuhive_alerts_firing{rule,severity}``
+gauges at exposition time (a registry collector), so an external Prometheus
+sees exactly the same truth the in-process engine acts on.
+
+Evaluation takes an explicit ``now`` so tests drive the whole lifecycle on
+a fake clock; the :class:`AlertingService` daemon (core/services/alerting)
+calls it on the wall clock every tick.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import urllib.error
+import urllib.request
+from collections import deque
+from dataclasses import dataclass, field
+from typing import (
+    Callable,
+    Deque,
+    Dict,
+    List,
+    Mapping,
+    Optional,
+    Sequence,
+    Tuple,
+)
+
+from .metrics import Counter, Gauge, Histogram, MetricsRegistry
+
+log = logging.getLogger(__name__)
+
+#: comparators a threshold rule may use
+_OPS: Dict[str, Callable[[float, float], bool]] = {
+    ">": lambda a, b: a > b,
+    ">=": lambda a, b: a >= b,
+    "<": lambda a, b: a < b,
+    "<=": lambda a, b: a <= b,
+    "==": lambda a, b: a == b,
+    "!=": lambda a, b: a != b,
+}
+
+_KINDS = ("threshold", "increase", "absent", "stale")
+
+#: alert lifecycle states
+INACTIVE, PENDING, FIRING, RESOLVED = "inactive", "pending", "firing", "resolved"
+
+HISTORY_CAPACITY = 256
+
+
+@dataclass(frozen=True)
+class AlertRule:
+    """One declarative rule. ``metric`` names a registry family; ``labels``
+    filters its children (subset match — a child matches when every filter
+    pair is present among its labels); matching children are summed
+    (histograms contribute their observation count). ``source`` overrides
+    the registry read entirely for non-metric signals; it returns the
+    current value or None for "no signal"."""
+
+    name: str
+    severity: str = "warning"            # "info" | "warning" | "critical"
+    kind: str = "threshold"
+    metric: str = ""
+    labels: Mapping[str, str] = field(default_factory=dict)
+    op: str = ">"
+    threshold: float = 0.0
+    #: lookback for ``increase`` rules (seconds)
+    window_s: float = 300.0
+    #: how long the condition must hold before pending becomes firing
+    for_s: float = 0.0
+    description: str = ""
+    source: Optional[Callable[[], Optional[float]]] = None
+
+    def __post_init__(self) -> None:
+        if self.kind not in _KINDS:
+            raise ValueError(f"unknown rule kind {self.kind!r}")
+        if self.op not in _OPS:
+            raise ValueError(f"unknown comparator {self.op!r}")
+        if not self.metric and self.source is None:
+            raise ValueError(f"rule {self.name!r} needs a metric or a source")
+
+
+@dataclass
+class AlertState:
+    """Mutable per-rule lifecycle state."""
+
+    status: str = INACTIVE
+    since: Optional[float] = None        # when the current status was entered
+    pending_since: Optional[float] = None
+    last_value: Optional[float] = None
+    fired_count: int = 0
+    #: (ts, value) samples for increase rules, oldest first
+    history: Deque[Tuple[float, float]] = field(default_factory=deque)
+
+
+class AlertSink:
+    """Receives one dict per notification-worthy transition."""
+
+    name = "sink"
+
+    def notify(self, event: Dict) -> None:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class LogSink(AlertSink):
+    """Always-on structured sink: one JSON payload per line so alert log
+    lines are machine-parseable and joinable against the span ids the
+    tracing filter injects."""
+
+    name = "log"
+
+    def notify(self, event: Dict) -> None:
+        payload = json.dumps(event, sort_keys=True, default=str)
+        if event.get("to") == FIRING:
+            log.warning("ALERT firing: %s", payload)
+        else:
+            log.info("ALERT resolved: %s", payload)
+
+
+class WebhookSink(AlertSink):
+    """POST each transition as JSON to ``url``.
+
+    Every request carries ``timeout_s`` (a wedged receiver must cost a
+    bounded wait, never a hung alerting tick — the same TH-B contract as
+    transport calls) and failures retry at most ``retries`` extra times
+    back-to-back before being counted and dropped; alert delivery is
+    best-effort by design, the log sink is the durable record.
+    """
+
+    name = "webhook"
+
+    def __init__(self, url: str, timeout_s: float = 5.0,
+                 retries: int = 2) -> None:
+        if not url:
+            raise ValueError("webhook sink needs a url")
+        self.url = url
+        self.timeout_s = float(timeout_s)
+        self.retries = max(0, int(retries))
+
+    def notify(self, event: Dict) -> None:
+        body = json.dumps(event, sort_keys=True, default=str).encode()
+        request = urllib.request.Request(
+            self.url, data=body,
+            headers={"Content-Type": "application/json"}, method="POST")
+        last_error: Optional[Exception] = None
+        for _attempt in range(self.retries + 1):
+            try:
+                with urllib.request.urlopen(request,
+                                            timeout=self.timeout_s) as resp:
+                    resp.read()
+                return
+            except (urllib.error.URLError, OSError, ValueError) as exc:
+                last_error = exc
+        _WEBHOOK_FAILURES.inc()
+        log.warning("webhook sink gave up after %d attempts on %s: %s",
+                    self.retries + 1, self.url, last_error)
+
+
+class AlertEngine:
+    """Evaluates a rule set against a registry; thread-safe.
+
+    ``evaluate(now)`` advances every rule's state machine and returns the
+    notification-worthy transitions (entered ``firing`` / ``resolved``) for
+    the caller to fan out to sinks — sink I/O deliberately happens OUTSIDE
+    the engine lock.
+    """
+
+    def __init__(self, rules: Sequence[AlertRule],
+                 registry: Optional[MetricsRegistry] = None) -> None:
+        names = [rule.name for rule in rules]
+        if len(set(names)) != len(names):
+            raise ValueError(f"duplicate rule names in {names}")
+        self.rules: Tuple[AlertRule, ...] = tuple(rules)
+        if registry is None:
+            from . import get_registry
+
+            registry = get_registry()
+        self._registry = registry
+        self._lock = threading.Lock()
+        self._states: Dict[str, AlertState] = {
+            rule.name: AlertState() for rule in self.rules}
+        self._transitions: Deque[Dict] = deque(maxlen=HISTORY_CAPACITY)
+
+    # -- signal reading -----------------------------------------------------
+    def _read_value(self, rule: AlertRule) -> Optional[float]:
+        if rule.source is not None:
+            return rule.source()
+        family = self._registry.get(rule.metric)
+        if family is None:
+            return None
+        total = 0.0
+        matched = False
+        for label_values, child in family.children():
+            labels = dict(zip(family.label_names, label_values))
+            if any(labels.get(k) != v for k, v in rule.labels.items()):
+                continue
+            matched = True
+            if isinstance(child, (Counter, Gauge)):
+                total += child.value
+            elif isinstance(child, Histogram):
+                total += child.count
+        return total if matched else None
+
+    def _breached(self, rule: AlertRule, state: AlertState,
+                  value: Optional[float], now: float) -> bool:
+        if rule.kind == "absent":
+            return value is None
+        if value is None:
+            # no signal yet: threshold/increase/stale rules stay quiet until
+            # the subsystem they watch produces its first sample
+            state.history.clear()
+            return False
+        if rule.kind == "threshold":
+            return _OPS[rule.op](value, rule.threshold)
+        if rule.kind == "stale":
+            # value is a unix timestamp gauge; 0 means "never happened yet"
+            return value > 0 and (now - value) > rule.threshold
+        # increase: growth over the lookback window, counter-reset aware
+        history = state.history
+        if history and value < history[-1][1]:
+            history.clear()              # counter reset (process restart)
+        history.append((now, value))
+        while history and history[0][0] < now - rule.window_s:
+            history.popleft()
+        increase = value - history[0][1]
+        return _OPS[rule.op](increase, rule.threshold)
+
+    # -- lifecycle ----------------------------------------------------------
+    def evaluate(self, now: Optional[float] = None) -> List[Dict]:
+        """Advance every rule; returns transitions sinks must be told about
+        (``pending -> firing`` and ``firing -> resolved``), in rule order."""
+        if now is None:
+            now = time.time()
+        notifications: List[Dict] = []
+        with self._lock:
+            for rule in self.rules:
+                state = self._states[rule.name]
+                value = self._read_value(rule)
+                state.last_value = value
+                breached = self._breached(rule, state, value, now)
+                event = self._advance(rule, state, breached, value, now)
+                if event is not None:
+                    notifications.append(event)
+        return notifications
+
+    def _advance(self, rule: AlertRule, state: AlertState, breached: bool,
+                 value: Optional[float], now: float) -> Optional[Dict]:
+        """One state-machine step; returns the notification event if this
+        step entered ``firing`` or ``resolved``."""
+        if breached:
+            if state.status in (INACTIVE, RESOLVED):
+                self._transition(rule, state, PENDING, value, now)
+                state.pending_since = now
+            if (state.status == PENDING
+                    and now - (state.pending_since or now) >= rule.for_s):
+                return self._transition(rule, state, FIRING, value, now)
+            return None
+        if state.status == PENDING:
+            # condition cleared before the for-duration elapsed: debounced,
+            # no notification was ever sent so none is owed
+            self._transition(rule, state, INACTIVE, value, now)
+            state.pending_since = None
+        elif state.status == FIRING:
+            state.pending_since = None
+            return self._transition(rule, state, RESOLVED, value, now)
+        return None
+
+    def _transition(self, rule: AlertRule, state: AlertState, to: str,
+                    value: Optional[float], now: float) -> Dict:
+        event = {
+            "rule": rule.name,
+            "severity": rule.severity,
+            "from": state.status,
+            "to": to,
+            "ts": round(now, 3),
+            "value": value,
+            "description": rule.description,
+        }
+        state.status = to
+        state.since = now
+        if to == FIRING:
+            state.fired_count += 1
+        self._transitions.append(event)
+        return event
+
+    # -- reading ------------------------------------------------------------
+    def firing(self) -> List[str]:
+        with self._lock:
+            return [name for name, state in self._states.items()
+                    if state.status == FIRING]
+
+    def export_gauges(self) -> None:
+        """Mirror firing state into ``tpuhive_alerts_firing`` children (one
+        per rule, 1.0 while firing) — called by the registry collector at
+        exposition time so scrapes always carry the full rule set."""
+        with self._lock:
+            for rule in self.rules:
+                _FIRING_GAUGE.labels(
+                    rule=rule.name, severity=rule.severity,
+                ).set(1.0 if self._states[rule.name].status == FIRING else 0.0)
+
+    def dump(self) -> Dict:
+        """Full rule/state dump for ``GET /api/admin/alerts``."""
+        with self._lock:
+            rules = []
+            for rule in self.rules:
+                state = self._states[rule.name]
+                rules.append({
+                    "name": rule.name,
+                    "severity": rule.severity,
+                    "kind": rule.kind,
+                    "metric": rule.metric or None,
+                    "labels": dict(rule.labels),
+                    "op": rule.op,
+                    "threshold": rule.threshold,
+                    "windowS": rule.window_s,
+                    "forS": rule.for_s,
+                    "description": rule.description,
+                    "status": state.status,
+                    "since": state.since,
+                    "lastValue": state.last_value,
+                    "firedCount": state.fired_count,
+                })
+            return {
+                "rules": rules,
+                "firing": [r["name"] for r in rules if r["status"] == FIRING],
+                "transitions": list(self._transitions),
+            }
+
+
+# -- default rule pack -------------------------------------------------------
+
+def _dead_service_count() -> Optional[float]:
+    """Registered daemon services whose thread is not alive (None before a
+    manager exists — nothing to watch yet)."""
+    from ..core.managers import manager as manager_module
+
+    # read the module global (never get_manager(): that would CONSTRUCT a
+    # manager as a side effect of evaluating an alert rule)
+    manager = manager_module._instance
+    if manager is None or manager.service_manager is None:
+        return None
+    services = manager.service_manager.services
+    if not services:
+        return None
+    return float(sum(1 for service in services if not service.is_alive()))
+
+
+def default_rule_pack(monitoring_interval_s: Optional[float] = None,
+                      alert_interval_s: float = 5.0) -> List[AlertRule]:
+    """The signals the registry already records (docs/OBSERVABILITY.md),
+    promoted to rules. ``for_s`` debounces are expressed in multiples of the
+    alerting tick so one noisy sample never pages."""
+    if monitoring_interval_s is None:
+        try:
+            from ..config import get_config
+
+            monitoring_interval_s = get_config().monitoring.interval_s
+        except Exception:
+            # config not materialized yet (bare library use): fall back to
+            # the shipped default rather than refusing to build the pack
+            log.warning("default_rule_pack: config unavailable, assuming "
+                        "2s monitoring interval", exc_info=True)
+            monitoring_interval_s = 2.0
+    probe_stale_after = 3.0 * float(monitoring_interval_s)
+    return [
+        AlertRule(
+            name="service_down", severity="critical",
+            kind="threshold", op=">", threshold=0.0, for_s=0.0,
+            source=_dead_service_count,
+            description="a registered daemon service thread is not alive"),
+        AlertRule(
+            name="service_tick_overruns", severity="warning",
+            kind="increase", metric="tpuhive_service_tick_overruns_total",
+            op=">", threshold=0.0, window_s=120.0,
+            for_s=2 * alert_interval_s,
+            description="service ticks overran their interval in the last "
+                        "2 minutes (interval starvation)"),
+        AlertRule(
+            name="probe_failures", severity="warning",
+            kind="increase", metric="tpuhive_probe_failures_total",
+            op=">", threshold=0.0, window_s=120.0,
+            for_s=2 * alert_interval_s,
+            description="per-host probe failures (unreachable/unparseable) "
+                        "in the last 2 minutes"),
+        AlertRule(
+            name="probe_round_stale", severity="critical",
+            kind="stale", metric="tpuhive_probe_last_round_timestamp_seconds",
+            threshold=probe_stale_after, for_s=alert_interval_s,
+            description="no probe round completed within 3x the monitoring "
+                        "interval — telemetry is blind"),
+        AlertRule(
+            name="job_spawn_failures", severity="warning",
+            kind="increase", metric="tpuhive_job_spawn_failures_total",
+            op=">", threshold=0.0, window_s=300.0,
+            for_s=alert_interval_s,
+            description="scheduled job spawns failed in the last 5 minutes"),
+        AlertRule(
+            name="protection_violations", severity="warning",
+            kind="threshold", metric="tpuhive_protection_active_violations",
+            op=">", threshold=0.0, for_s=2 * alert_interval_s,
+            description="reservation intruders present in the latest "
+                        "protection tick"),
+        AlertRule(
+            name="api_5xx", severity="warning",
+            kind="increase", metric="tpuhive_api_unhandled_errors_total",
+            op=">", threshold=0.0, window_s=300.0,
+            for_s=0.0,
+            description="requests hit the catch-all 500 handler in the last "
+                        "5 minutes"),
+        AlertRule(
+            name="decode_compile_miss_growth", severity="warning",
+            kind="increase", metric="tpuhive_decode_compile_total",
+            labels={"event": "miss"},
+            op=">", threshold=4.0, window_s=300.0,
+            for_s=0.0,
+            description="decode executables keep compiling — prompt shapes "
+                        "are escaping the prefill buckets (docs/PERF.md)"),
+    ]
+
+
+# -- process-wide engine -----------------------------------------------------
+_engine: Optional[AlertEngine] = None
+_engine_lock = threading.Lock()
+
+
+def get_alert_engine() -> AlertEngine:
+    """Process-wide engine over the default rule pack (what the
+    AlertingService evaluates and /api/admin/alerts dumps); built lazily so
+    the rule pack reads the materialized config."""
+    global _engine
+    with _engine_lock:
+        if _engine is None:
+            _engine = AlertEngine(default_rule_pack())
+        return _engine
+
+
+def set_alert_engine(engine: Optional[AlertEngine]) -> None:
+    """Replace (or with None: drop, to be lazily rebuilt) the process-wide
+    engine — test isolation and custom rule packs."""
+    global _engine
+    with _engine_lock:
+        _engine = engine
+
+
+def _collect_alert_gauges(registry: MetricsRegistry) -> None:
+    """Registry collector: refresh the firing gauges at exposition time. The
+    engine is built on first scrape if nothing built it earlier, so
+    ``tpuhive_alerts_firing`` children exist in every scrape."""
+    get_alert_engine().export_gauges()
+
+
+def _register_exports() -> Tuple[object, object]:
+    from . import get_registry
+
+    registry = get_registry()
+    firing = registry.gauge(
+        "tpuhive_alerts_firing",
+        "1 while the named alert rule is firing, else 0.",
+        labels=("rule", "severity"))
+    webhook_failures = registry.counter(
+        "tpuhive_alert_webhook_failures_total",
+        "Alert webhook deliveries dropped after exhausting retries.")
+    registry.register_collector(_collect_alert_gauges)
+    return firing, webhook_failures
+
+
+_FIRING_GAUGE, _WEBHOOK_FAILURES = _register_exports()

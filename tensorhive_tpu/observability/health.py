@@ -1,0 +1,130 @@
+"""Machine-readable liveness + readiness, the probe surface an orchestrator
+points at (JIRIAF's virtual-kubelet integration provisions against exactly
+this kind of per-node health signal, PAPERS arxiv 2502.18596).
+
+Two distinct questions, two endpoints (controllers/observability.py):
+
+* **liveness** (``GET /api/healthz``) — "is the process serving requests?"
+  Trivially yes if the handler runs; carries uptime + version so a flapping
+  restart loop is visible from the probe alone.
+* **readiness** (``GET /api/readyz``) — "should traffic/work be routed
+  here?" Component checks with a JSON reason list: the DB answers a real
+  query, every registered daemon service is alive AND has ticked within 3x
+  its interval (a wedged tick is as dead as a dead thread — it just hasn't
+  admitted it yet), and the telemetry probe round is fresh when hosts are
+  managed. Any failing component flips the endpoint to 503.
+
+Everything takes an explicit ``now`` and manager so tests drive it on a
+fake clock with stub services; the controllers call the zero-argument form.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Tuple
+
+from .metrics import PROCESS_START_TS
+
+#: a service is stale once it has gone this many intervals without a tick
+STALE_INTERVALS = 3.0
+
+
+def liveness() -> Dict:
+    from .. import __version__
+
+    return {
+        "status": "ok",
+        "version": __version__,
+        "uptimeS": round(time.time() - PROCESS_START_TS, 3),
+    }
+
+
+def _component(name: str, ok: bool, reason: str = "") -> Dict:
+    entry: Dict = {"component": name, "ok": ok}
+    if reason:
+        entry["reason"] = reason
+    return entry
+
+
+def check_db() -> Dict:
+    """The DB must answer a real query — not just exist as a file handle."""
+    from ..db.engine import get_engine
+
+    try:
+        value = get_engine().scalar("SELECT 1")
+    except Exception as exc:  # sqlite3 raises several unrelated types
+        return _component("db", False, f"query failed: {exc}")
+    if value != 1:
+        return _component("db", False, f"SELECT 1 returned {value!r}")
+    return _component("db", True)
+
+
+def check_service(service, now: float) -> Dict:
+    """One registered daemon: thread alive and ticking within
+    ``STALE_INTERVALS`` x its interval. The freshness reference is the last
+    completed tick, or the run-loop start for a service still inside its
+    first tick — so a tick that hangs forever goes stale instead of hiding
+    behind ``is_alive()``."""
+    name = f"service:{service.name}"
+    if not service.is_alive():
+        return _component(name, False, "thread not alive")
+    stale_after = STALE_INTERVALS * float(service.interval_s)
+    reference = service.last_tick_ts or service.run_started_ts
+    if reference is None:
+        return _component(name, False, "run loop not entered yet")
+    age = now - reference
+    if age > stale_after:
+        return _component(
+            name, False,
+            f"no tick for {age:.1f}s (> {STALE_INTERVALS:.0f}x "
+            f"{service.interval_s:g}s interval)")
+    return _component(name, True)
+
+
+def check_probe_freshness(now: float, interval_s: float) -> Dict:
+    """Telemetry freshness off the registry gauge the probe layer stamps
+    after every round — no scrape round-trip, same truth Prometheus sees."""
+    from . import get_registry
+
+    family = get_registry().get("tpuhive_probe_last_round_timestamp_seconds")
+    last_ts = 0.0
+    if family is not None:
+        children = family.children()
+        if children:
+            last_ts = children[0][1].value
+    if last_ts <= 0:
+        return _component("probe", False, "no probe round completed yet")
+    age = now - last_ts
+    stale_after = STALE_INTERVALS * interval_s
+    if age > stale_after:
+        return _component(
+            "probe", False,
+            f"last probe round {age:.1f}s ago (> {stale_after:g}s)")
+    return _component("probe", True)
+
+
+def readiness(manager=None, now: Optional[float] = None,
+              ) -> Tuple[bool, List[Dict]]:
+    """(ready, component breakdown). ``manager`` defaults to the process
+    manager if one was set — a process without a manager (bare API in
+    tests/tools) is ready when its DB answers."""
+    if now is None:
+        now = time.time()
+    if manager is None:
+        from ..core.managers import manager as manager_module
+
+        manager = manager_module._instance
+    components = [check_db()]
+    monitoring = None
+    if manager is not None and manager.service_manager is not None:
+        from ..core.services.monitoring import MonitoringService
+
+        for service in manager.service_manager.services:
+            components.append(check_service(service, now))
+            if isinstance(service, MonitoringService):
+                monitoring = service
+    if monitoring is not None and getattr(manager.config, "hosts", None):
+        # probe freshness only binds when there are hosts to probe; an
+        # empty inventory has no round to be stale
+        components.append(check_probe_freshness(now, monitoring.interval_s))
+    ready = all(component["ok"] for component in components)
+    return ready, components

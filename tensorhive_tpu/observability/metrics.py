@@ -24,10 +24,29 @@ children by label values, so output is deterministic (golden-testable).
 """
 from __future__ import annotations
 
+import logging
 import math
+import os
 import threading
+import time
 from bisect import bisect_left
-from typing import Dict, Iterable, List, Mapping, Optional, Sequence, Tuple
+from typing import (
+    Callable,
+    Dict,
+    Iterable,
+    List,
+    Mapping,
+    Optional,
+    Sequence,
+    Tuple,
+)
+
+log = logging.getLogger(__name__)
+
+#: wall-clock import time of this module ≈ process start (the registry is
+#: imported by every entry point before any work happens) — backs the
+#: process uptime gauge and the readiness payload
+PROCESS_START_TS = time.time()
 
 #: default latency buckets (seconds): 1 ms .. 60 s, roughly log-spaced —
 #: covers API dispatch (~ms) through SSH probe round-trips (~100 ms) and
@@ -292,6 +311,7 @@ class MetricsRegistry:
     def __init__(self) -> None:
         self._lock = threading.Lock()
         self._families: Dict[str, MetricFamily] = {}
+        self._collectors: List[Callable[["MetricsRegistry"], None]] = []
 
     # -- registration (idempotent) ------------------------------------------
     def _register(self, kind: str, name: str, help_text: str,
@@ -337,9 +357,32 @@ class MetricsRegistry:
         for family in self.families():
             family.reset_values()
 
+    # -- lazy collectors ----------------------------------------------------
+    def register_collector(
+            self, collector: Callable[["MetricsRegistry"], None]) -> None:
+        """Register a callback run at the START of every :meth:`render` —
+        for values that are cheap to read but pointless to poll (process
+        RSS, alert firing state): scrapes see fresh numbers, idle processes
+        pay nothing. Registration is idempotent per callable."""
+        with self._lock:
+            if collector not in self._collectors:
+                self._collectors.append(collector)
+
+    def _run_collectors(self) -> None:
+        with self._lock:
+            collectors = list(self._collectors)
+        for collector in collectors:
+            try:
+                collector(self)
+            except Exception:
+                # a broken collector must not take down the whole scrape;
+                # logged so the breakage is visible (TH-E)
+                log.exception("metrics collector %r failed", collector)
+
     # -- exposition ---------------------------------------------------------
     def render(self) -> str:
         """Prometheus text format 0.0.4; deterministic ordering."""
+        self._run_collectors()
         lines: List[str] = []
         for family in self.families():
             children = family.children()
@@ -375,6 +418,68 @@ class MetricsRegistry:
         plain = _render_labels(family.label_names, label_values)
         yield f"{family.name}_sum{plain} {_format_value(total_sum)}"
         yield f"{family.name}_count{plain} {count}"
+
+
+# -- build info + process self-metrics ---------------------------------------
+
+def _read_rss_bytes() -> Optional[float]:
+    """Current resident set from /proc/self/status (None where /proc is not
+    a Linux procfs — macOS dev laptops)."""
+    try:
+        with open("/proc/self/status") as fh:
+            for line in fh:
+                if line.startswith("VmRSS:"):
+                    return float(line.split()[1]) * 1024.0
+    except (OSError, ValueError, IndexError):
+        return None
+    return None
+
+
+def _count_open_fds() -> Optional[float]:
+    try:
+        return float(len(os.listdir("/proc/self/fd")))
+    except OSError:
+        return None
+
+
+def register_process_metrics(registry: "MetricsRegistry",
+                             version: str) -> None:
+    """Register ``tpuhive_build_info{version}`` plus process self-metrics
+    (RSS, thread count, uptime, open fds where /proc exists), all refreshed
+    lazily by a collector at exposition time — so scrapes and readiness
+    checks can correlate behavior with the running build without any
+    background sampler thread."""
+    build_info = registry.gauge(
+        "tpuhive_build_info",
+        "Constant 1, labeled with the running tpuhive version.",
+        labels=("version",))
+    rss = registry.gauge(
+        "tpuhive_process_resident_memory_bytes",
+        "Resident set size of this process (from /proc/self/status).")
+    threads = registry.gauge(
+        "tpuhive_process_threads",
+        "Live Python threads in this process.")
+    uptime = registry.gauge(
+        "tpuhive_process_uptime_seconds",
+        "Seconds since the observability layer was imported.")
+    open_fds = registry.gauge(
+        "tpuhive_process_open_fds",
+        "Open file descriptors (from /proc/self/fd; absent without procfs).")
+
+    def _collect(_registry: "MetricsRegistry") -> None:
+        # set inside the collector (not once at registration) so
+        # reset_values() in tests cannot leave a stale zero behind
+        build_info.labels(version=version).set(1.0)
+        rss_bytes = _read_rss_bytes()
+        if rss_bytes is not None:
+            rss.set(rss_bytes)
+        threads.set(float(threading.active_count()))
+        uptime.set(time.time() - PROCESS_START_TS)
+        fds = _count_open_fds()
+        if fds is not None:
+            open_fds.set(fds)
+
+    registry.register_collector(_collect)
 
 
 def parse_rendered(text: str) -> Mapping[str, float]:

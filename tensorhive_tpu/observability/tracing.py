@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import collections
 import itertools
+import logging
 import threading
 import time
 from contextlib import contextmanager
@@ -148,3 +149,30 @@ class SpanTracer:
     def clear(self) -> None:
         with self._lock:
             self._finished.clear()
+
+
+class SpanLogFilter(logging.Filter):
+    """Injects the id of the thread's current span into every log record as
+    ``record.span_id`` (empty string when no span is open), so a log format
+    containing ``%(span_id)s`` makes ``log.exception`` lines joinable
+    against the ``GET /api/admin/traces`` dump — the tick or request a
+    traceback happened inside is one grep away.
+
+    Attach to a *handler* (cli.setup_logging does), so every record passing
+    through it carries the attribute regardless of originating logger.
+    """
+
+    def __init__(self, tracer: Optional[SpanTracer] = None) -> None:
+        super().__init__()
+        self._tracer = tracer
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        tracer = self._tracer
+        if tracer is None:
+            # late-bound so the filter follows tracer swaps in tests
+            from . import get_tracer
+
+            tracer = get_tracer()
+        span = tracer.current_span()
+        record.span_id = span.span_id if span is not None else ""
+        return True

@@ -1,0 +1,225 @@
+"""Functional coverage for the health + alerting endpoints (ISSUE 4).
+
+Drives the REAL WSGI app: readiness must flip 200 ↔ 503 off genuine
+service-thread state (including a hung first tick — alive but not
+ticking), and the alert engine's state must be visible both at
+``GET /api/admin/alerts`` and as ``tpuhive_alerts_firing`` gauges in the
+same scrape an external Prometheus would take.
+"""
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+from werkzeug.test import Client
+
+from tensorhive_tpu.api.server import ApiApp
+from tensorhive_tpu.core.managers.manager import TpuHiveManager, set_manager
+from tensorhive_tpu.core.services.base import Service
+from tensorhive_tpu.observability import reset_observability
+from tests.fixtures import make_user
+
+
+class _TinyService(Service):
+    def do_run(self) -> None:
+        pass
+
+
+class _StallingService(Service):
+    """First tick blocks until released — alive, but not ticking."""
+
+    def __init__(self, interval_s: float) -> None:
+        super().__init__(interval_s)
+        self.release = threading.Event()
+
+    def do_run(self) -> None:
+        self.release.wait(30)
+
+
+@pytest.fixture()
+def services(request):
+    """Default: one healthy tiny service. Parametrize (indirect) with a
+    zero-arg factory to swap the service set per test."""
+    factory = getattr(request, "param", None)
+    if factory is not None:
+        return factory()
+    return [_TinyService(0.01)]
+
+
+@pytest.fixture()
+def api(db, config, services):
+    config.api.secret_key = "test-secret"
+    reset_observability()
+    manager = TpuHiveManager(config=config, services=services)
+    manager.configure_services_from_config()
+    set_manager(manager)
+    yield Client(ApiApp(url_prefix="api"))
+    for service in services:
+        service.shutdown()
+        if hasattr(service, "release"):
+            service.release.set()
+        if service.is_alive():
+            service.join(timeout=5)
+    set_manager(None)
+    reset_observability()
+
+
+@pytest.fixture()
+def admin_headers(api, db):
+    make_user(username="root1", password="SuperSecret42", admin=True)
+    tokens = api.post("/api/user/login", json={
+        "username": "root1", "password": "SuperSecret42"}).get_json()
+    return {"Authorization": f"Bearer {tokens['accessToken']}"}
+
+
+def _wait_for_tick(service, minimum=1):
+    deadline = time.time() + 5
+    while service.ticks_completed < minimum and time.time() < deadline:
+        time.sleep(0.005)
+    assert service.ticks_completed >= minimum
+
+
+# -- healthz -----------------------------------------------------------------
+
+def test_healthz_is_unauthenticated_and_carries_build(api):
+    response = api.get("/api/healthz")
+    assert response.status_code == 200
+    doc = response.get_json()
+    assert doc["status"] == "ok"
+    assert doc["uptimeS"] >= 0
+    from tensorhive_tpu import __version__
+
+    assert doc["version"] == __version__
+
+
+# -- readyz ------------------------------------------------------------------
+
+def test_readyz_200_with_component_breakdown_when_all_alive(api, services):
+    services[0].start()
+    _wait_for_tick(services[0])
+    response = api.get("/api/readyz")
+    assert response.status_code == 200
+    doc = response.get_json()
+    assert doc["ready"] is True and doc["reasons"] == []
+    by_name = {c["component"]: c for c in doc["components"]}
+    assert by_name["db"]["ok"] is True
+    assert by_name["service:_TinyService"]["ok"] is True
+
+
+def test_readyz_503_names_dead_service(api):
+    # registered but never started: thread not alive
+    response = api.get("/api/readyz")
+    assert response.status_code == 503
+    doc = response.get_json()
+    assert doc["ready"] is False
+    assert any("service:_TinyService" in reason for reason in doc["reasons"])
+    failing = [c for c in doc["components"] if not c["ok"]]
+    assert [c["component"] for c in failing] == ["service:_TinyService"]
+
+
+@pytest.mark.parametrize("services", [lambda: [_StallingService(0.05)]],
+                         ids=["stalling"], indirect=True)
+def test_readyz_503_when_service_misses_three_intervals(api, services):
+    """The acceptance shape: a service whose thread is ALIVE but whose tick
+    hangs must flip readiness once 3x its interval passes without a tick."""
+    stalling = services[0]
+    stalling.start()
+    deadline = time.time() + 5
+    while stalling.run_started_ts is None and time.time() < deadline:
+        time.sleep(0.005)
+    assert stalling.is_alive()
+    time.sleep(4 * stalling.interval_s)         # > 3 x 0.05s, no tick yet
+    response = api.get("/api/readyz")
+    assert response.status_code == 503
+    doc = response.get_json()
+    component = next(c for c in doc["components"]
+                     if c["component"] == "service:_StallingService")
+    assert component["ok"] is False
+    assert "no tick for" in component["reason"]
+    assert any("service:_StallingService" in r for r in doc["reasons"])
+
+    # release the tick: the service recovers, readiness flips back
+    stalling.release.set()
+    _wait_for_tick(stalling)
+    response = api.get("/api/readyz")
+    assert response.status_code == 200
+    assert response.get_json()["ready"] is True
+
+
+def test_readyz_needs_no_auth(api, services):
+    services[0].start()
+    _wait_for_tick(services[0])
+    assert api.get("/api/readyz").status_code == 200
+
+
+# -- /admin/alerts + gauge export -------------------------------------------
+
+def test_alerts_endpoint_requires_admin(api, db):
+    make_user(username="alice", password="SuperSecret42")
+    tokens = api.post("/api/user/login", json={
+        "username": "alice", "password": "SuperSecret42"}).get_json()
+    headers = {"Authorization": f"Bearer {tokens['accessToken']}"}
+    assert api.get("/api/admin/alerts").status_code == 401
+    assert api.get("/api/admin/alerts", headers=headers).status_code == 403
+
+
+def test_alerts_dump_lists_default_rule_pack(api, admin_headers):
+    response = api.get("/api/admin/alerts", headers=admin_headers)
+    assert response.status_code == 200
+    doc = response.get_json()
+    names = {rule["name"] for rule in doc["rules"]}
+    assert {"service_down", "probe_round_stale", "api_5xx",
+            "decode_compile_miss_growth"} <= names
+    assert all(rule["status"] == "inactive" for rule in doc["rules"])
+    assert doc["firing"] == [] and doc["transitions"] == []
+
+
+def test_dead_service_fires_alert_visible_in_api_and_scrape(
+        api, admin_headers, config):
+    """The full measured→actionable loop against the real app: a dead
+    registered service fires `service_down` through the AlertingService
+    fan-out exactly once, and the same truth shows at /api/admin/alerts
+    AND as a gauge in /api/metrics."""
+    from tensorhive_tpu.core.services.alerting import AlertingService
+    from tensorhive_tpu.observability.alerts import get_alert_engine
+
+    notifications = []
+
+    class RecordingSink:
+        name = "recording"
+
+        def notify(self, event):
+            notifications.append(event)
+
+    alerting = AlertingService(config=config, engine=get_alert_engine(),
+                               sinks=[RecordingSink()])
+    alerting.do_run()                           # service dead -> fires
+    alerting.do_run()                           # no duplicate
+    fired = [e for e in notifications if e["to"] == "firing"]
+    assert [e["rule"] for e in fired] == ["service_down"]
+
+    doc = api.get("/api/admin/alerts", headers=admin_headers).get_json()
+    assert "service_down" in doc["firing"]
+    rule = next(r for r in doc["rules"] if r["name"] == "service_down")
+    assert rule["status"] == "firing" and rule["firedCount"] == 1
+    assert [(t["from"], t["to"]) for t in doc["transitions"]] == [
+        ("inactive", "pending"), ("pending", "firing")]
+
+    scrape = api.get("/api/metrics").get_data(as_text=True)
+    assert ('tpuhive_alerts_firing{rule="service_down",severity="critical"} 1'
+            in scrape)
+    assert 'tpuhive_build_info{version="' in scrape
+
+
+def test_alerting_service_ships_in_default_service_set(config):
+    from tensorhive_tpu.core.managers.manager import (
+        instantiate_services_from_config,
+    )
+    from tensorhive_tpu.core.services.alerting import AlertingService
+
+    services = instantiate_services_from_config(config)
+    assert any(isinstance(s, AlertingService) for s in services)
+    config.alerting.enabled = False
+    services = instantiate_services_from_config(config)
+    assert not any(isinstance(s, AlertingService) for s in services)

@@ -72,7 +72,10 @@ UI_CALLS = {
         "`/nodes/${encodeURIComponent(host)}/cpu/metrics`",
     ("GET", "/admin/services"): 'api("/admin/services")',
     ("GET", "/admin/traces"): 'api("/admin/traces',
+    ("GET", "/admin/alerts"): 'api("/admin/alerts")',
     ("GET", "/metrics"): 'href="/api/metrics"',
+    ("GET", "/healthz"): 'href="/api/healthz"',
+    ("GET", "/readyz"): 'href="/api/readyz"',
     # reservations calendar (calendar.js)
     ("GET", "/resources"): 'api("/resources")',
     ("GET", "/resources/<uid>"): '"/resources/" + encodeURIComponent(uid)',

@@ -1,0 +1,398 @@
+"""Unit coverage for the alert rule engine (ISSUE 4 tentpole).
+
+Everything runs on an injected fake clock and a private registry — the
+lifecycle acceptance test drives ``inactive → pending → firing → resolved``
+tick by tick and counts sink notifications exactly.
+"""
+from __future__ import annotations
+
+import json
+import logging
+
+import pytest
+
+from tensorhive_tpu.observability.alerts import (
+    AlertEngine,
+    AlertRule,
+    LogSink,
+    WebhookSink,
+    default_rule_pack,
+)
+from tensorhive_tpu.observability.metrics import MetricsRegistry
+
+
+class RecordingSink:
+    name = "recording"
+
+    def __init__(self):
+        self.events = []
+
+    def notify(self, event):
+        self.events.append(event)
+
+
+def make_engine(rules):
+    return AlertEngine(rules, registry=MetricsRegistry()), None
+
+
+# -- rule validation ---------------------------------------------------------
+
+def test_rule_validation():
+    with pytest.raises(ValueError):
+        AlertRule(name="x", kind="nope", metric="m")
+    with pytest.raises(ValueError):
+        AlertRule(name="x", op="~", metric="m")
+    with pytest.raises(ValueError):
+        AlertRule(name="x")                     # neither metric nor source
+    with pytest.raises(ValueError):
+        AlertEngine([AlertRule(name="dup", metric="m"),
+                     AlertRule(name="dup", metric="m")],
+                    registry=MetricsRegistry())
+
+
+# -- the acceptance lifecycle ------------------------------------------------
+
+def test_alert_lifecycle_is_deterministic_and_fires_exactly_once():
+    """A rule crossing its threshold goes inactive → pending, holds through
+    the `for` duration, fires exactly ONE notification on pending → firing,
+    and exactly one on firing → resolved — no duplicates on repeated
+    evaluation ticks (injected fake clock)."""
+    registry = MetricsRegistry()
+    errors = registry.counter("errs_total", "test signal")
+    engine = AlertEngine([AlertRule(
+        name="too_many_errors", severity="critical",
+        kind="threshold", metric="errs_total", op=">", threshold=2.0,
+        for_s=30.0)], registry=registry)
+
+    def status():
+        return engine.dump()["rules"][0]["status"]
+
+    errors.inc()                                        # value 1: below
+    assert engine.evaluate(now=0.0) == []
+    assert status() == "inactive"
+
+    errors.inc(5)                                       # value 6: breached
+    assert engine.evaluate(now=10.0) == []              # enters pending
+    assert status() == "pending"
+    assert engine.evaluate(now=25.0) == []              # held, for_s not met
+    assert status() == "pending"
+
+    events = engine.evaluate(now=45.0)                  # 35s > for_s=30
+    assert [e["to"] for e in events] == ["firing"]
+    assert events[0]["rule"] == "too_many_errors"
+    assert events[0]["from"] == "pending"
+    assert status() == "firing"
+
+    # repeated ticks while still breached: NO duplicate notifications
+    assert engine.evaluate(now=50.0) == []
+    assert engine.evaluate(now=55.0) == []
+    assert status() == "firing"
+
+    # signal recovers (counters cannot decrease — swap to a fresh registry
+    # state by resetting the child)
+    registry.get("errs_total").reset_values()
+    events = engine.evaluate(now=60.0)
+    assert [e["to"] for e in events] == ["resolved"]
+    assert events[0]["from"] == "firing"
+    assert status() == "resolved"
+    assert engine.evaluate(now=70.0) == []              # stays quiet
+
+    # a NEW breach after resolution starts a fresh pending cycle
+    errors.inc(10)
+    assert engine.evaluate(now=80.0) == []
+    assert status() == "pending"
+
+    dump = engine.dump()
+    assert dump["rules"][0]["firedCount"] == 1
+    transitions = [(t["from"], t["to"]) for t in dump["transitions"]]
+    assert transitions == [
+        ("inactive", "pending"), ("pending", "firing"),
+        ("firing", "resolved"), ("resolved", "pending"),
+    ]
+
+
+def test_pending_that_recovers_before_for_duration_never_notifies():
+    registry = MetricsRegistry()
+    gauge = registry.gauge("g", "test signal")
+    engine = AlertEngine([AlertRule(
+        name="flap", kind="threshold", metric="g", op=">", threshold=1.0,
+        for_s=60.0)], registry=registry)
+    gauge.set(5)
+    assert engine.evaluate(now=0.0) == []               # pending
+    gauge.set(0)
+    assert engine.evaluate(now=10.0) == []              # debounced away
+    assert engine.dump()["rules"][0]["status"] == "inactive"
+    assert engine.dump()["rules"][0]["firedCount"] == 0
+
+
+def test_zero_for_duration_fires_on_first_breached_tick():
+    registry = MetricsRegistry()
+    gauge = registry.gauge("g", "")
+    engine = AlertEngine([AlertRule(
+        name="instant", kind="threshold", metric="g", op=">", threshold=0.0,
+        for_s=0.0)], registry=registry)
+    gauge.set(1)
+    events = engine.evaluate(now=5.0)
+    assert [e["to"] for e in events] == ["firing"]
+    # the pending entry is still recorded in the transition history
+    transitions = [(t["from"], t["to"]) for t in engine.dump()["transitions"]]
+    assert transitions == [("inactive", "pending"), ("pending", "firing")]
+
+
+# -- rule kinds --------------------------------------------------------------
+
+def test_increase_rule_measures_growth_within_window():
+    registry = MetricsRegistry()
+    counter = registry.counter("c_total", "")
+    engine = AlertEngine([AlertRule(
+        name="growth", kind="increase", metric="c_total",
+        op=">", threshold=3.0, window_s=100.0)], registry=registry)
+    counter.inc(10)
+    assert engine.evaluate(now=0.0) == []       # baseline sample
+    counter.inc(2)
+    assert engine.evaluate(now=50.0) == []      # +2 within window: below
+    counter.inc(5)
+    events = engine.evaluate(now=90.0)          # +7 within window: breached
+    assert [e["to"] for e in events] == ["firing"]
+
+
+def test_increase_rule_forgets_samples_outside_window():
+    registry = MetricsRegistry()
+    counter = registry.counter("c_total", "")
+    engine = AlertEngine([AlertRule(
+        name="growth", kind="increase", metric="c_total",
+        op=">", threshold=3.0, window_s=100.0)], registry=registry)
+    counter.inc(10)
+    engine.evaluate(now=0.0)
+    counter.inc(4)                              # would breach vs the t=0 base
+    # but that baseline is older than the window by now
+    assert engine.evaluate(now=200.0) == []
+
+
+def test_increase_rule_survives_counter_reset():
+    registry = MetricsRegistry()
+    counter = registry.counter("c_total", "")
+    engine = AlertEngine([AlertRule(
+        name="growth", kind="increase", metric="c_total",
+        op=">", threshold=3.0, window_s=1000.0)], registry=registry)
+    counter.inc(100)
+    engine.evaluate(now=0.0)
+    registry.get("c_total").reset_values()      # process-restart analog
+    counter.inc(1)
+    # value dropped 100 -> 1: history resets instead of computing -99 or a
+    # spurious +1-over-0 breach
+    assert engine.evaluate(now=10.0) == []
+    assert engine.dump()["rules"][0]["status"] == "inactive"
+
+
+def test_absent_rule_fires_when_signal_missing_and_resolves_when_present():
+    registry = MetricsRegistry()
+    engine = AlertEngine([AlertRule(
+        name="gone", kind="absent", metric="heartbeats_total",
+        for_s=0.0)], registry=registry)
+    events = engine.evaluate(now=0.0)
+    assert [e["to"] for e in events] == ["firing"]
+    registry.counter("heartbeats_total", "").inc()
+    events = engine.evaluate(now=5.0)
+    assert [e["to"] for e in events] == ["resolved"]
+
+
+def test_stale_rule_compares_timestamp_age():
+    registry = MetricsRegistry()
+    stamp = registry.gauge("last_round_ts", "")
+    engine = AlertEngine([AlertRule(
+        name="stale", kind="stale", metric="last_round_ts",
+        threshold=6.0, for_s=0.0)], registry=registry)
+    # 0 == "never happened yet": quiet (startup must not page)
+    assert engine.evaluate(now=100.0) == []
+    stamp.set(100.0)
+    assert engine.evaluate(now=103.0) == []     # 3s old: fresh
+    events = engine.evaluate(now=110.0)         # 10s > 6s: stale
+    assert [e["to"] for e in events] == ["firing"]
+    stamp.set(111.0)
+    events = engine.evaluate(now=112.0)
+    assert [e["to"] for e in events] == ["resolved"]
+
+
+def test_label_filtered_rule_sums_only_matching_children():
+    registry = MetricsRegistry()
+    compiles = registry.counter("compiles_total", "", labels=("fn", "event"))
+    compiles.labels(fn="prefill", event="hit").inc(100)   # hits are fine
+    engine = AlertEngine([AlertRule(
+        name="miss_growth", kind="increase", metric="compiles_total",
+        labels={"event": "miss"}, op=">", threshold=2.0, window_s=1000.0,
+    )], registry=registry)
+    # no child matches event=miss yet -> no signal -> quiet
+    assert engine.evaluate(now=0.0) == []
+    compiles.labels(fn="prefill", event="miss").inc()
+    assert engine.evaluate(now=1.0) == []       # baseline
+    compiles.labels(fn="generate", event="miss").inc(2)
+    compiles.labels(fn="prefill", event="hit").inc(500)   # ignored
+    assert engine.evaluate(now=2.0) == []       # miss growth +2: not > 2
+    compiles.labels(fn="prefill", event="miss").inc(1)
+    events = engine.evaluate(now=3.0)           # +3 > 2
+    assert [e["to"] for e in events] == ["firing"]
+
+
+def test_source_callable_overrides_registry_and_none_means_no_signal():
+    values = {"v": None}
+    engine = AlertEngine([AlertRule(
+        name="src", kind="threshold", op=">", threshold=0.0,
+        source=lambda: values["v"])], registry=MetricsRegistry())
+    assert engine.evaluate(now=0.0) == []       # None: quiet
+    values["v"] = 2.0
+    events = engine.evaluate(now=1.0)
+    assert [e["to"] for e in events] == ["firing"]
+    values["v"] = 0.0
+    events = engine.evaluate(now=2.0)
+    assert [e["to"] for e in events] == ["resolved"]
+
+
+# -- gauge export + dump -----------------------------------------------------
+
+def test_firing_gauge_export_reflects_engine_state(config):
+    from tensorhive_tpu.observability import get_registry, reset_observability
+    from tensorhive_tpu.observability.alerts import set_alert_engine
+
+    reset_observability()
+    try:
+        registry = MetricsRegistry()
+        gauge = registry.gauge("g", "")
+        engine = AlertEngine([AlertRule(
+            name="exported", severity="critical", kind="threshold",
+            metric="g", op=">", threshold=0.0)], registry=registry)
+        set_alert_engine(engine)
+        gauge.set(1)
+        engine.evaluate(now=1.0)
+        text = get_registry().render()          # collector runs at render
+        assert ('tpuhive_alerts_firing{rule="exported",severity="critical"} 1'
+                in text)
+        gauge.set(0)
+        engine.evaluate(now=2.0)
+        text = get_registry().render()
+        assert ('tpuhive_alerts_firing{rule="exported",severity="critical"} 0'
+                in text)
+    finally:
+        reset_observability()
+
+
+def test_dump_shape():
+    registry = MetricsRegistry()
+    registry.gauge("g", "").set(3)
+    engine = AlertEngine([AlertRule(
+        name="r", kind="threshold", metric="g", op=">", threshold=1.0,
+        description="testing")], registry=registry)
+    engine.evaluate(now=7.0)
+    dump = engine.dump()
+    assert dump["firing"] == ["r"]
+    rule = dump["rules"][0]
+    assert rule["name"] == "r" and rule["status"] == "firing"
+    assert rule["lastValue"] == 3.0 and rule["description"] == "testing"
+    assert dump["transitions"][-1]["to"] == "firing"
+    json.dumps(dump)                            # API-serializable as-is
+
+
+# -- sinks -------------------------------------------------------------------
+
+def test_log_sink_emits_structured_json(caplog):
+    sink = LogSink()
+    with caplog.at_level(logging.INFO,
+                         logger="tensorhive_tpu.observability.alerts"):
+        sink.notify({"rule": "r1", "to": "firing", "severity": "critical"})
+        sink.notify({"rule": "r1", "to": "resolved", "severity": "critical"})
+    firing = [r for r in caplog.records if "firing" in r.message]
+    assert firing and firing[0].levelno == logging.WARNING
+    payload = json.loads(firing[0].message.split("ALERT firing: ", 1)[1])
+    assert payload["rule"] == "r1"
+    resolved = [r for r in caplog.records if "resolved" in r.message]
+    assert resolved and resolved[0].levelno == logging.INFO
+
+
+def test_webhook_sink_posts_with_timeout_and_bounded_retry(monkeypatch):
+    calls = []
+
+    class FakeResponse:
+        def read(self):
+            return b"ok"
+
+        def __enter__(self):
+            return self
+
+        def __exit__(self, *exc):
+            return False
+
+    def fake_urlopen(request, timeout=None):
+        calls.append((request.full_url, timeout,
+                      json.loads(request.data.decode())))
+        if len(calls) < 3:
+            raise OSError("connection refused")
+        return FakeResponse()
+
+    monkeypatch.setattr("urllib.request.urlopen", fake_urlopen)
+    sink = WebhookSink("http://hooks.example/alerts", timeout_s=2.5, retries=3)
+    sink.notify({"rule": "r", "to": "firing"})
+    assert len(calls) == 3                      # 2 failures + 1 success
+    url, timeout, body = calls[0]
+    assert url == "http://hooks.example/alerts"
+    assert timeout == 2.5                       # every attempt bounded
+    assert body["rule"] == "r"
+
+
+def test_webhook_sink_gives_up_after_retries_and_counts(monkeypatch, config):
+    from tensorhive_tpu.observability import reset_observability
+    from tensorhive_tpu.observability.alerts import _WEBHOOK_FAILURES
+
+    reset_observability()
+    attempts = []
+
+    def always_down(request, timeout=None):
+        attempts.append(timeout)
+        raise OSError("down")
+
+    monkeypatch.setattr("urllib.request.urlopen", always_down)
+    sink = WebhookSink("http://hooks.example/alerts", retries=2)
+    sink.notify({"rule": "r", "to": "firing"})  # must NOT raise
+    assert len(attempts) == 3                   # 1 + 2 retries, then drop
+    assert _WEBHOOK_FAILURES.labels().value == 1
+    reset_observability()
+
+
+# -- default rule pack -------------------------------------------------------
+
+def test_default_rule_pack_covers_the_registry_signals(config):
+    rules = {rule.name: rule for rule in default_rule_pack()}
+    assert {"service_down", "service_tick_overruns", "probe_failures",
+            "probe_round_stale", "job_spawn_failures",
+            "protection_violations", "api_5xx",
+            "decode_compile_miss_growth"} <= set(rules)
+    assert rules["service_down"].severity == "critical"
+    assert rules["decode_compile_miss_growth"].labels == {"event": "miss"}
+    # probe staleness threshold derives from the monitoring interval
+    assert rules["probe_round_stale"].threshold == pytest.approx(
+        3 * config.monitoring.interval_s)
+
+
+def test_service_down_source_counts_dead_services(config, db):
+    from tensorhive_tpu.core.managers.manager import TpuHiveManager, set_manager
+    from tensorhive_tpu.core.services.base import Service
+    from tensorhive_tpu.observability.alerts import _dead_service_count
+
+    set_manager(None)
+    assert _dead_service_count() is None        # no manager: no signal
+
+    class Tiny(Service):
+        def do_run(self):
+            pass
+
+    service = Tiny(0.01)
+    manager = TpuHiveManager(config=config, services=[service])
+    manager.configure_services_from_config()
+    set_manager(manager)
+    try:
+        assert _dead_service_count() == 1.0     # registered, never started
+        service.start()
+        assert _dead_service_count() == 0.0
+    finally:
+        service.shutdown()
+        service.join(timeout=5)
+        set_manager(None)

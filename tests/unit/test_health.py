@@ -1,0 +1,190 @@
+"""Unit coverage for liveness/readiness (observability/health.py) — pure
+functions driven with stub services and an explicit fake clock."""
+from __future__ import annotations
+
+from typing import Optional
+
+import pytest
+
+from tensorhive_tpu.observability import get_registry, reset_observability
+from tensorhive_tpu.observability.health import (
+    STALE_INTERVALS,
+    check_db,
+    check_probe_freshness,
+    check_service,
+    liveness,
+    readiness,
+)
+
+
+class StubService:
+    """Just the surface health.check_service reads."""
+
+    def __init__(self, name="stub", alive=True, interval_s=2.0,
+                 last_tick_ts: Optional[float] = None,
+                 run_started_ts: Optional[float] = None):
+        self.name = name
+        self._alive = alive
+        self.interval_s = interval_s
+        self.last_tick_ts = last_tick_ts
+        self.run_started_ts = run_started_ts
+
+    def is_alive(self):
+        return self._alive
+
+
+def test_liveness_payload():
+    doc = liveness()
+    assert doc["status"] == "ok"
+    assert doc["uptimeS"] >= 0
+    from tensorhive_tpu import __version__
+
+    assert doc["version"] == __version__
+
+
+def test_check_db_answers_query(db):
+    component = check_db()
+    assert component == {"component": "db", "ok": True}
+
+
+def test_check_db_reports_failure(db):
+    db.close()          # engine still set, but the connection is gone
+    component = check_db()
+    assert component["ok"] is False
+    assert "query failed" in component["reason"]
+
+
+def test_check_service_dead_thread():
+    component = check_service(StubService(alive=False), now=100.0)
+    assert component["ok"] is False
+    assert component["reason"] == "thread not alive"
+    assert component["component"] == "service:stub"
+
+
+def test_check_service_fresh_tick():
+    service = StubService(interval_s=2.0, last_tick_ts=99.0,
+                          run_started_ts=90.0)
+    assert check_service(service, now=100.0)["ok"] is True
+
+
+def test_check_service_missed_three_intervals():
+    service = StubService(interval_s=2.0, last_tick_ts=93.0,
+                          run_started_ts=90.0)
+    # 7s since last tick > 3 x 2s
+    component = check_service(service, now=100.0)
+    assert component["ok"] is False
+    assert "no tick for 7.0s" in component["reason"]
+    # exactly at the boundary is still fresh (> not >=)
+    service.last_tick_ts = 100.0 - STALE_INTERVALS * 2.0
+    assert check_service(service, now=100.0)["ok"] is True
+
+
+def test_check_service_hung_first_tick_uses_run_start():
+    """A service whose FIRST tick hangs has no last_tick_ts; the run-loop
+    entry stamp must make it go stale instead of hiding behind is_alive."""
+    service = StubService(interval_s=1.0, last_tick_ts=None,
+                          run_started_ts=90.0)
+    component = check_service(service, now=100.0)
+    assert component["ok"] is False
+    assert "no tick for 10.0s" in component["reason"]
+    assert check_service(service, now=91.0)["ok"] is True
+
+
+def test_check_probe_freshness(config):
+    reset_observability()
+    try:
+        # gauge exists process-wide (registered by monitors/probe) but a
+        # fresh reset leaves it at 0 == "no round yet"
+        import tensorhive_tpu.core.monitors.probe  # noqa: F401
+
+        component = check_probe_freshness(now=100.0, interval_s=2.0)
+        assert component["ok"] is False
+        assert "no probe round" in component["reason"]
+
+        gauge = get_registry().get(
+            "tpuhive_probe_last_round_timestamp_seconds")
+        gauge.set(95.0)
+        assert check_probe_freshness(now=100.0, interval_s=2.0)["ok"] is True
+        assert check_probe_freshness(now=102.0, interval_s=2.0)["ok"] is False
+    finally:
+        reset_observability()
+
+
+def test_readiness_without_manager_is_db_only(db):
+    from tensorhive_tpu.core.managers.manager import set_manager
+
+    set_manager(None)
+    ready, components = readiness(now=100.0)
+    assert ready is True
+    assert [c["component"] for c in components] == ["db"]
+
+
+def test_readiness_names_every_failing_component(db, config):
+    from tensorhive_tpu.core.managers.manager import TpuHiveManager, set_manager
+    from tensorhive_tpu.core.services.base import Service
+
+    class Tiny(Service):
+        def do_run(self):
+            pass
+
+    dead = Tiny(0.01, name="DeadService")
+    manager = TpuHiveManager(config=config, services=[dead])
+    manager.configure_services_from_config()
+    set_manager(manager)
+    try:
+        ready, components = readiness(now=100.0)
+        assert ready is False
+        by_name = {c["component"]: c for c in components}
+        assert by_name["db"]["ok"] is True
+        assert by_name["service:DeadService"]["ok"] is False
+    finally:
+        set_manager(None)
+
+
+def test_readiness_skips_probe_without_hosts(db, config):
+    """No managed hosts -> no probe round to be stale; a MonitoringService
+    alone must not fail readiness on probe freshness."""
+    from tensorhive_tpu.core.managers.manager import TpuHiveManager, set_manager
+    from tensorhive_tpu.core.services.monitoring import MonitoringService
+
+    monitoring = MonitoringService(monitors=[], config=config)
+    manager = TpuHiveManager(config=config, services=[monitoring])
+    manager.configure_services_from_config()
+    set_manager(manager)
+    try:
+        _, components = readiness(now=100.0)
+        assert all(c["component"] != "probe" for c in components)
+    finally:
+        set_manager(None)
+
+
+def test_readiness_includes_probe_with_hosts(db, config):
+    from tensorhive_tpu.config import HostConfig
+    from tensorhive_tpu.core.managers.manager import TpuHiveManager, set_manager
+    from tensorhive_tpu.core.services.monitoring import MonitoringService
+
+    config.hosts["vm-0"] = HostConfig(name="vm-0", backend="local")
+    monitoring = MonitoringService(monitors=[], config=config)
+    manager = TpuHiveManager(config=config, services=[monitoring])
+    manager.configure_services_from_config()
+    set_manager(manager)
+    reset_observability()
+    try:
+        ready, components = readiness(now=100.0)
+        by_name = {c["component"]: c for c in components}
+        assert "probe" in by_name
+        assert by_name["probe"]["ok"] is False      # no round completed yet
+        assert ready is False
+    finally:
+        set_manager(None)
+        reset_observability()
+
+
+@pytest.mark.parametrize("bad_value", [0, 2, None])
+def test_check_db_select_value_guard(db, monkeypatch, bad_value):
+    from tensorhive_tpu.db import engine as engine_module
+
+    monkeypatch.setattr(engine_module.Engine, "scalar",
+                        lambda self, sql, params=(): bad_value)
+    component = check_db()
+    assert component["ok"] is False

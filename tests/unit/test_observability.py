@@ -7,6 +7,7 @@ temp-file hygiene on failed publishes.
 """
 from __future__ import annotations
 
+import io
 import json
 import logging
 import os
@@ -311,3 +312,207 @@ def test_telemetry_write_swallows_oserror_but_cleans_up(tmp_path, monkeypatch):
                         lambda src, dst: (_ for _ in ()).throw(OSError("disk")))
     emitter._write({"0": {"ok": 1}})               # swallowed, like before
     assert list(tmp_path.glob("*.tmp")) == []
+
+
+# -- quantile edge cases (the surface the alert engine now leans on) ---------
+
+def test_quantile_with_no_observations_is_none_for_every_q():
+    empty = Histogram(buckets=(1.0, 2.0))
+    for q in (0.0, 0.5, 0.95, 1.0):
+        assert empty.quantile(q) is None
+
+
+def test_quantile_empty_family_child_is_none():
+    """A freshly-registered family child (no observe() yet) must answer None,
+    not 0 — readiness/alert consumers treat None as 'no signal'."""
+    registry = MetricsRegistry()
+    family = registry.histogram("h_seconds", "", labels=("who",))
+    child = family.labels(who="a")
+    assert child.quantile(0.5) is None
+    assert child.max is None and child.count == 0
+
+
+def test_quantile_single_observation_stays_inside_its_bucket():
+    histogram = Histogram(buckets=(1.0, 2.0, 4.0))
+    histogram.observe(1.5)
+    # one sample in (1, 2]: every estimate interpolates within that bucket
+    # and clamps at the observed max — never the bucket's upper bound 2.0,
+    # never below the bucket's lower bound
+    for q in (0.01, 0.5, 0.99, 1.0):
+        estimate = histogram.quantile(q)
+        assert 1.0 < estimate <= 1.5
+    # from the median up, the clamp pins the estimate to the sample exactly
+    assert histogram.quantile(0.5) == pytest.approx(1.5)
+    assert histogram.quantile(0.99) == pytest.approx(1.5)
+    assert histogram.quantile(1.0) == pytest.approx(1.5)
+
+
+def test_quantile_all_observations_in_overflow_bucket():
+    """Every sample beyond the last bound: the +Inf bucket has no upper
+    bound to interpolate toward, so estimates clamp to the observed max
+    instead of reporting something unbounded or the last finite bound."""
+    histogram = Histogram(buckets=(0.1, 1.0))
+    for value in (10.0, 20.0, 30.0):
+        histogram.observe(value)
+    assert histogram.quantile(0.5) == 30.0
+    assert histogram.quantile(0.99) == 30.0
+    assert histogram.quantile(1.0) == 30.0
+
+
+# -- tracer parent stacks are per-thread -------------------------------------
+
+def test_tracer_spans_do_not_adopt_parents_across_threads():
+    """A span started on a worker thread must NOT become a child of a span
+    that happens to be open on another thread — the parent stack is
+    thread-local by contract (a probe round inside a monitoring tick is a
+    child; an API request racing that tick is not)."""
+    tracer = SpanTracer()
+    worker_started = threading.Event()
+    main_span_open = threading.Event()
+    results = {}
+
+    def worker():
+        worker_started.set()
+        assert main_span_open.wait(5)
+        # the main thread's "tick" span is open RIGHT NOW
+        with tracer.span("worker-op", kind="api") as span:
+            results["parent_id"] = span.parent_id
+            with tracer.span("worker-child", kind="api") as child:
+                results["child_parent_id"] = child.parent_id
+
+    thread = threading.Thread(target=worker)
+    thread.start()
+    assert worker_started.wait(5)
+    with tracer.span("main-tick", kind="tick") as main_span:
+        main_span_open.set()
+        thread.join(timeout=5)
+    assert not thread.is_alive()
+    # cross-thread isolation: no adopted parent...
+    assert results["parent_id"] is None
+    # ...while same-thread nesting still links up
+    worker_ids = {span["name"]: span["spanId"] for span in tracer.recent()}
+    assert results["child_parent_id"] == worker_ids["worker-op"]
+    assert tracer.recent()[-1]["name"] == "main-tick"
+    assert main_span.parent_id is None
+
+
+def test_tracer_current_span_is_thread_local():
+    tracer = SpanTracer()
+    observed = {}
+
+    def worker():
+        observed["inside"] = tracer.current_span()
+
+    span = tracer.start_span("outer")
+    try:
+        thread = threading.Thread(target=worker)
+        thread.start()
+        thread.join(timeout=5)
+    finally:
+        tracer.end_span(span)
+    assert observed["inside"] is None
+
+
+# -- lazy collectors + process self-metrics ----------------------------------
+
+def test_register_collector_runs_at_render_and_is_idempotent():
+    registry = MetricsRegistry()
+    gauge = registry.gauge("lazy_value", "")
+    calls = []
+
+    def collect(reg):
+        calls.append(reg)
+        gauge.set(len(calls))
+
+    registry.register_collector(collect)
+    registry.register_collector(collect)        # same callable: registered once
+    assert "lazy_value 1" in registry.render()
+    assert "lazy_value 2" in registry.render()
+    assert calls == [registry, registry]
+
+
+def test_broken_collector_does_not_kill_the_scrape(caplog):
+    registry = MetricsRegistry()
+    registry.gauge("g", "").set(7)
+
+    def broken(reg):
+        raise RuntimeError("collector bug")
+
+    registry.register_collector(broken)
+    with caplog.at_level(logging.ERROR,
+                         logger="tensorhive_tpu.observability.metrics"):
+        rendered = registry.render()
+    assert "g 7" in rendered                     # scrape survived
+    assert any("collector" in record.message for record in caplog.records)
+
+
+def test_process_metrics_render_lazily_with_build_info():
+    from tensorhive_tpu.observability.metrics import register_process_metrics
+
+    registry = MetricsRegistry()
+    register_process_metrics(registry, version="9.9.9-test")
+    rendered = registry.render()
+    samples = parse_rendered(rendered)
+    assert samples['tpuhive_build_info{version="9.9.9-test"}'] == 1
+    assert samples["tpuhive_process_threads"] >= 1
+    assert samples["tpuhive_process_uptime_seconds"] >= 0
+    # Linux CI: procfs-backed gauges present and sane
+    if os.path.exists("/proc/self/status"):
+        assert samples["tpuhive_process_resident_memory_bytes"] > 1024 * 1024
+    if os.path.exists("/proc/self/fd"):
+        assert samples["tpuhive_process_open_fds"] >= 1
+
+
+def test_process_metrics_survive_reset_values():
+    from tensorhive_tpu.observability.metrics import register_process_metrics
+
+    registry = MetricsRegistry()
+    register_process_metrics(registry, version="9.9.9-test")
+    registry.render()
+    registry.reset_values()                      # test-isolation path
+    samples = parse_rendered(registry.render())  # collector repopulates
+    assert samples['tpuhive_build_info{version="9.9.9-test"}'] == 1
+
+
+# -- trace-correlated logging -------------------------------------------------
+
+def test_span_log_filter_injects_current_span_id():
+    from tensorhive_tpu.observability import SpanLogFilter
+
+    tracer = SpanTracer()
+    span_filter = SpanLogFilter(tracer=tracer)
+    record = logging.LogRecord("test", logging.INFO, __file__, 1, "m", (), None)
+    span_filter.filter(record)
+    assert record.span_id == ""                  # no span open
+
+    with tracer.span("tick.Monitoring", kind="tick") as span:
+        record = logging.LogRecord("test", logging.INFO, __file__, 1,
+                                   "m", (), None)
+        span_filter.filter(record)
+        assert record.span_id == span.span_id
+
+    record = logging.LogRecord("test", logging.INFO, __file__, 1, "m", (), None)
+    span_filter.filter(record)
+    assert record.span_id == ""                  # span closed again
+
+
+def test_span_log_filter_formats_into_log_lines():
+    from tensorhive_tpu.observability import SpanLogFilter
+
+    tracer = SpanTracer()
+    logger = logging.getLogger("test_span_format")
+    logger.propagate = False
+    stream = io.StringIO()
+    handler = logging.StreamHandler(stream)
+    handler.setFormatter(logging.Formatter("%(levelname)s [%(span_id)s] %(message)s"))
+    handler.addFilter(SpanLogFilter(tracer=tracer))
+    logger.addHandler(handler)
+    try:
+        with tracer.span("tick.Svc", kind="tick") as span:
+            logger.warning("inside")
+        logger.warning("outside")
+    finally:
+        logger.removeHandler(handler)
+    lines = stream.getvalue().splitlines()
+    assert lines[0] == f"WARNING [{span.span_id}] inside"
+    assert lines[1] == "WARNING [] outside"

@@ -45,6 +45,14 @@ def main() -> int:
         with urllib.request.urlopen(f"{base}/openapi.json", timeout=10) as resp:
             assert resp.status == 200
 
+        # the health probes answer over real HTTP (unauthenticated)
+        with urllib.request.urlopen(f"{base}/healthz", timeout=10) as resp:
+            healthz_status = resp.status
+            healthz_body = resp.read().decode()
+        with urllib.request.urlopen(f"{base}/readyz", timeout=10) as resp:
+            readyz_status = resp.status
+            readyz_body = resp.read().decode()
+
         with urllib.request.urlopen(f"{base}/metrics", timeout=10) as resp:
             content_type = resp.headers.get("Content-Type", "")
             body = resp.read().decode()
@@ -58,6 +66,18 @@ def main() -> int:
         problems.append("request counter missing from exposition")
     if "tpuhive_api_request_seconds_bucket" not in body:
         problems.append("request latency histogram missing from exposition")
+    if "tpuhive_alerts_firing{" not in body:
+        problems.append("alert firing gauges missing from exposition")
+    if 'tpuhive_build_info{version="' not in body:
+        problems.append("build info gauge missing from exposition")
+    if "tpuhive_process_uptime_seconds" not in body:
+        problems.append("process self-metrics missing from exposition")
+    if healthz_status != 200 or '"status": "ok"' not in healthz_body:
+        problems.append(
+            f"healthz not ok: {healthz_status} {healthz_body[:200]!r}")
+    if readyz_status != 200 or '"ready": true' not in readyz_body:
+        problems.append(
+            f"readyz not ready: {readyz_status} {readyz_body[:200]!r}")
     if not body.endswith("\n"):
         problems.append("exposition must end with a newline")
     for problem in problems:
