@@ -2,7 +2,7 @@
 # mypy + flake8 per .circleci/config.yml:33-38): the dependency-free AST
 # lint + thivelint analyzer always run; mypy/ruff run when installed
 # (absent from this image).
-.PHONY: check lint analysis test bench probe metrics-smoke decode-smoke alerts-smoke
+.PHONY: check lint analysis test bench probe metrics-smoke decode-smoke alerts-smoke chaos-smoke
 
 check: lint analysis
 	@command -v ruff >/dev/null 2>&1 && ruff check . || echo "ruff not installed; skipped (tools/lint.py covered the always-on subset)"
@@ -39,6 +39,13 @@ decode-smoke:
 # through the sink fan-out, then resolve once the service starts
 alerts-smoke:
 	python tools/alerts_smoke.py
+
+# deterministic fault-injection walk (docs/ROBUSTNESS.md): kill a fake host
+# -> breaker opens after N seeded failures, fan-out + queue scheduling skip
+# it, readyz degrades -> revive -> half-open probe closes it, alert
+# fires/resolves exactly once; fake clock + seeded rng, zero real waiting
+chaos-smoke:
+	python tools/chaos_smoke.py
 
 probe:
 	$(MAKE) -C tensorhive_tpu/native

@@ -222,6 +222,14 @@ function nodeCard(host, node) {
   const cpu = Object.values(node.CPU || {})[0];
   const chips = Object.entries(node.TPU || {});
   const warnings = node.WARNINGS || [];
+  const health = node.HEALTH || {};
+  const unhealthy = health.state === "degraded" || health.state === "unreachable";
+  const staleFor = health.staleness_s != null
+    ? Math.round(health.staleness_s) + "s ago" : "never";
+  const healthBadge = unhealthy
+    ? `<div class="badge unsynchronized" style="margin-top:.3rem"
+        title="telemetry below is last-known-good, not live (docs/ROBUSTNESS.md)">⚠ ${esc(health.state)}: last seen ${esc(staleFor)}</div>`
+    : "";
   return `<div class="card">
     <div class="row">
       <h3 style="margin:.1rem 0;cursor:pointer" title="node details"
@@ -229,6 +237,7 @@ function nodeCard(host, node) {
       <span class="muted">${cpu ? `CPU ${cpu.util_pct ?? "?"}% ·
         RAM ${cpu.mem_used_mib ?? "?"}/${cpu.mem_total_mib ?? "?"} MiB` : "no CPU data"}</span>
     </div>
+    ${healthBadge}
     ${warnings.map(w => `<div class="badge unsynchronized" style="margin-top:.3rem"
       title="${esc(w.message || "")}">⚠ ${esc(w.key || "warning")}: ${esc(w.message || "")}</div>`).join("")}
     <div class="grid" style="margin-top:.6rem">${chips.map(([uid, c]) => chipCard(uid, c, host)).join("")

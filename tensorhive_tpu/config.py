@@ -160,9 +160,24 @@ class AlertingConfig:
 
 @dataclasses.dataclass
 class SshConfig:
-    """Control-plane transport settings (reference: tensorhive/config.py:113-120)."""
+    """Control-plane transport settings (reference: tensorhive/config.py:113-120).
+
+    The resilience knobs (docs/ROBUSTNESS.md) feed
+    ``core/transport/resilience.py``: retries are exponential-backoff with
+    full jitter and always fit the caller's timeout budget; the per-host
+    circuit breaker trips after ``breaker_failure_threshold`` consecutive
+    channel failures and cools down ``breaker_cooldown_s`` seconds
+    (+ up to ``breaker_cooldown_jitter`` fraction of jitter) before
+    granting ``breaker_half_open_probes`` half-open probes.
+    """
     timeout_s: float = 10.0
     num_retries: int = 1
+    retry_backoff_base_s: float = 0.2
+    retry_backoff_max_s: float = 5.0
+    breaker_failure_threshold: int = 3
+    breaker_cooldown_s: float = 30.0
+    breaker_cooldown_jitter: float = 0.1
+    breaker_half_open_probes: int = 1
     key_path: str = "{config_dir}/ssh_key"
     # name of transport backend: 'ssh' (openssh binary), 'local' (subprocess on
     # this machine — useful for single-VM installs and the localhost example)
@@ -362,6 +377,14 @@ interval_s = 5.0
 [ssh]
 timeout_s = 10.0
 default_backend = "ssh"
+# control-plane resilience (docs/ROBUSTNESS.md)
+# num_retries = 1
+# retry_backoff_base_s = 0.2
+# retry_backoff_max_s = 5.0
+# breaker_failure_threshold = 3
+# breaker_cooldown_s = 30.0
+# breaker_cooldown_jitter = 0.1
+# breaker_half_open_probes = 1
 """
 
 _HOSTS_TEMPLATE = """\

@@ -25,9 +25,22 @@ reboots, playing the role the 40-char GPU UUID plays in the reference
 from __future__ import annotations
 
 import copy
+import time
 from typing import Dict, List, Optional
 
 from ...utils.threading import RWLock
+
+#: host health states, surfaced in every snapshot under the ``HEALTH`` key:
+#: ``unknown`` (never successfully probed), ``ok`` (fresh telemetry),
+#: ``degraded`` (1..unreachable_after-1 consecutive probe failures — the
+#: last-known-good subtrees are RETAINED with a staleness age instead of
+#: being dropped), ``unreachable`` (>= unreachable_after consecutive
+#: failures). The reference left stale values in place indefinitely with no
+#: marker; round 1 of this rebuild dropped them, which threw away the
+#: last-known-good picture operators need to debug a dead node. This keeps
+#: both truths: the data AND how stale it is.
+HOST_UNKNOWN, HOST_OK, HOST_DEGRADED, HOST_UNREACHABLE = (
+    "unknown", "ok", "degraded", "unreachable")
 
 #: executable basenames never treated as foreign/intruding (reference
 #: InfrastructureManager.ignored_processes: Xorg and friends; the TPU
@@ -49,37 +62,105 @@ class InfrastructureManager:
     """Thread-safe latest-metrics store; monitors replace whole per-host
     subtrees, readers receive snapshots."""
 
+    #: consecutive probe failures after which ``degraded`` becomes
+    #: ``unreachable`` (aligned with the circuit-breaker default threshold)
+    unreachable_after: int = 3
+
     def __init__(self, hostnames: Optional[List[str]] = None) -> None:
         self._lock = RWLock()
         self._infra: Dict[str, Dict] = {name: {} for name in (hostnames or [])}
+        #: hostname -> {state, last_seen_ts, consecutive_failures, last_error}
+        self._health: Dict[str, Dict] = {
+            name: self._fresh_health() for name in (hostnames or [])}
         self.ignored_processes: List[str] = list(DEFAULT_IGNORED_PROCESSES)
+
+    @staticmethod
+    def _fresh_health() -> Dict:
+        return {"state": HOST_UNKNOWN, "last_seen_ts": None,
+                "consecutive_failures": 0, "last_error": ""}
 
     # -- write path (monitors) ---------------------------------------------
     def update_subtree(self, hostname: str, key: str, subtree: Dict) -> None:
         """Atomically replace one monitor's subtree for one host (reference
-        monitors assign whole ``['GPU']`` dicts, GPUMonitor.py:92)."""
+        monitors assign whole ``['GPU']`` dicts, GPUMonitor.py:92). A write
+        is evidence of a successful probe: the host's health flips to ``ok``
+        and its last-known-good stamp refreshes."""
         with self._lock.write():
             self._infra.setdefault(hostname, {})[key] = subtree
+            health = self._health.setdefault(hostname, self._fresh_health())
+            health.update(state=HOST_OK, last_seen_ts=time.time(),
+                          consecutive_failures=0, last_error="")
+
+    def record_probe_failure(self, hostname: str, error: str = "") -> int:
+        """One failed probe round for ``hostname``: the consecutive-failure
+        streak grows, state degrades (``degraded`` → ``unreachable`` at
+        ``unreachable_after``), and the last-known-good subtrees stay in
+        place with their staleness age. Returns the new streak."""
+        with self._lock.write():
+            health = self._health.setdefault(hostname, self._fresh_health())
+            health["consecutive_failures"] += 1
+            health["state"] = (
+                HOST_UNREACHABLE
+                if health["consecutive_failures"] >= self.unreachable_after
+                else HOST_DEGRADED)
+            health["last_error"] = error
+            return health["consecutive_failures"]
+
+    def record_probe_success(self, hostname: str) -> None:
+        """Reset a host's streak without writing telemetry (monitors that
+        write subtrees get this implicitly via :meth:`update_subtree`)."""
+        with self._lock.write():
+            health = self._health.setdefault(hostname, self._fresh_health())
+            health.update(state=HOST_OK, last_seen_ts=time.time(),
+                          consecutive_failures=0, last_error="")
 
     def mark_unreachable(self, hostname: str, key: str) -> None:
-        """Drop a host's subtree when it stops responding so stale telemetry
-        is never mistaken for live (the reference leaves the last values in
-        place indefinitely — a known sharp edge)."""
-        with self._lock.write():
-            node = self._infra.get(hostname)
-            if node is not None:
-                node.pop(key, None)
+        """Compatibility shim for the old drop-the-subtree API: now records
+        one probe failure and RETAINS the last-known-good data (``key`` is
+        ignored — health is per host, not per subtree)."""
+        self.record_probe_failure(hostname)
 
     # -- read path ----------------------------------------------------------
+    def _health_view(self, hostname: str, now: Optional[float] = None) -> Dict:
+        """Computed HEALTH entry for one host; caller holds the read lock."""
+        health = self._health.get(hostname) or self._fresh_health()
+        last_seen = health["last_seen_ts"]
+        return {
+            "state": health["state"],
+            "last_seen_ts": last_seen,
+            "staleness_s": (round((now or time.time()) - last_seen, 1)
+                            if last_seen is not None else None),
+            "consecutive_failures": health["consecutive_failures"],
+            "last_error": health["last_error"],
+        }
+
+    def host_health(self, now: Optional[float] = None) -> Dict[str, Dict]:
+        """{hostname: computed HEALTH entry} — staleness evaluated at
+        ``now`` (injectable for deterministic tests)."""
+        with self._lock.read():
+            return {name: self._health_view(name, now) for name in self._infra}
+
+    def host_state(self, hostname: str) -> str:
+        with self._lock.read():
+            health = self._health.get(hostname)
+            return health["state"] if health else HOST_UNKNOWN
+
     @property
     def infrastructure(self) -> Dict[str, Dict]:
-        """Deep-copied snapshot of everything."""
+        """Deep-copied snapshot of everything, each node carrying a computed
+        ``HEALTH`` entry (state + staleness of the last-known-good data)."""
         with self._lock.read():
-            return copy.deepcopy(self._infra)
+            now = time.time()
+            snapshot = copy.deepcopy(self._infra)
+            for hostname, node in snapshot.items():
+                node["HEALTH"] = self._health_view(hostname, now)
+            return snapshot
 
     def node(self, hostname: str) -> Dict:
         with self._lock.read():
-            return copy.deepcopy(self._infra.get(hostname, {}))
+            node = copy.deepcopy(self._infra.get(hostname, {}))
+            node["HEALTH"] = self._health_view(hostname)
+            return node
 
     @property
     def hostnames(self) -> List[str]:
@@ -103,8 +184,12 @@ class InfrastructureManager:
             return result
 
     def all_nodes_with_tpu_processes(self) -> Dict[str, Dict[str, List[Dict]]]:
-        """Reference InfrastructureManager.all_nodes_with_gpu_processes:63."""
-        return {host: self.node_tpu_processes(host) for host in self.hostnames}
+        """Reference InfrastructureManager.all_nodes_with_gpu_processes:63 —
+        but only hosts with FRESH telemetry: now that last-known-good data is
+        retained for degraded/unreachable hosts, the protection path must not
+        act (kill, email) on a process list that may be minutes dead."""
+        return {host: self.node_tpu_processes(host) for host in self.hostnames
+                if self.host_state(host) not in (HOST_DEGRADED, HOST_UNREACHABLE)}
 
     def find_chip(self, uid: str) -> Optional[Dict]:
         """Locate a chip's metrics dict by uid across all hosts."""

@@ -42,7 +42,12 @@ class CpuMonitor(Monitor):
         samples = self._collect_samples(transports)
         for hostname, sample in samples.items():
             if sample is None:
-                infra.mark_unreachable(hostname, self.key)
+                # record the health event only when this monitor ran the
+                # probe round itself; chained behind TpuMonitor, that
+                # monitor already counted this host's failure — a second
+                # count here would double every streak
+                if self._tpu_monitor is None:
+                    infra.record_probe_failure(hostname)
                 continue
             infra.update_subtree(hostname, self.key, self._cpu_subtree(hostname, sample))
 

@@ -15,6 +15,7 @@ import logging
 from typing import TYPE_CHECKING, Dict, Optional
 
 from ...config import Config, get_config
+from ...observability import get_registry
 from ..managers.infrastructure import chip_uid
 from .base import Monitor
 from .probe import ProbeSample, collect_probe_samples, probe_command
@@ -24,6 +25,14 @@ if TYPE_CHECKING:
     from ..transport.base import TransportManager
 
 log = logging.getLogger(__name__)
+
+# the probe monitor owns the per-host consecutive-failure streak: the raw
+# signal behind the breaker/health state machines, exported so dashboards
+# can see a host flapping BEFORE it trips anything
+_CONSECUTIVE_FAILURES = get_registry().gauge(
+    "tpuhive_probe_consecutive_failures",
+    "Consecutive failed probe rounds per host (0 = healthy).",
+    labels=("host",))
 
 
 class TpuMonitor(Monitor):
@@ -42,9 +51,13 @@ class TpuMonitor(Monitor):
         self.last_samples = {h: s for h, s in samples.items() if s is not None}
         for hostname, sample in samples.items():
             if sample is None:
-                infra.mark_unreachable(hostname, self.key)
-                infra.mark_unreachable(hostname, "WARNINGS")
+                # one failed round = ONE health event (the old code dropped
+                # both subtrees; now the last-known-good data is retained
+                # and the host is marked degraded/unreachable instead)
+                streak = infra.record_probe_failure(hostname)
+                _CONSECUTIVE_FAILURES.labels(host=hostname).set(streak)
                 continue
+            _CONSECUTIVE_FAILURES.labels(host=hostname).set(0)
             if sample.restricted > 0 and hostname not in self._restricted_warned:
                 self._restricted_warned.add(hostname)
                 log.warning(

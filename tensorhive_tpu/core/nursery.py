@@ -102,7 +102,10 @@ class HostOps:
             f'for _ in $(seq 1 100); do [ -s "{pidfile}" ] && break; sleep 0.05; done; '
             f'cat "{pidfile}"'
         )
-        result = self.transport.run(script, timeout=timeout)
+        # idempotent=False: a spawn that timed out ambiguously may still have
+        # started its process — the resilient transport must never re-issue
+        # it (a retry would double-spawn and orphan the first pidfile)
+        result = self.transport.run(script, timeout=timeout, idempotent=False)
         if not result.ok or not result.stdout.strip():
             raise SpawnError(
                 f"[{self.hostname}] spawn of task {task_id} failed: "

@@ -115,16 +115,27 @@ class JobSchedulingService(Service):
             self.stop_with_grace(job, now)
 
     def stop_with_grace(self, job: Job, now) -> None:
-        first_attempt = self._stop_first_attempt.setdefault(job.id, now)
+        job_id = job.id
+        first_attempt = self._stop_first_attempt.setdefault(job_id, now)
         try:
-            if job.id in self.stubborn_job_ids:
-                log.warning("job %d ignored graceful stop; killing", job.id)
-                business_stop(job.id, gracefully=False)
+            if job_id in self.stubborn_job_ids:
+                log.warning("job %d ignored graceful stop; killing", job_id)
+                business_stop(job_id, gracefully=False)
             else:
-                business_stop(job.id, gracefully=True)
+                business_stop(job_id, gracefully=True)
         except TpuHiveError as exc:
-            log.warning("stopping job %d failed: %s", job.id, exc)
-        job = Job.get(job.id)
+            log.warning("stopping job %d failed: %s", job_id, exc)
+        try:
+            job = Job.get(job_id)
+        except NotFoundError:
+            # the row vanished mid-stop (deleted via the API between
+            # business_stop and the re-read): there is nothing left to stop —
+            # clean up the escalation bookkeeping that used to leak (and the
+            # raise used to crash the whole tick, stalling every other job)
+            log.info("job %d deleted during stop; treating as stopped", job_id)
+            self.stubborn_job_ids.discard(job_id)
+            self._stop_first_attempt.pop(job_id, None)
+            return
         if job.status is JobStatus.running:
             if (now - first_attempt >= self.stop_attempts_after
                     and job.id not in self.stubborn_job_ids):
@@ -181,13 +192,25 @@ class JobSchedulingService(Service):
 
         The infra snapshot (a deepcopy under the RWLock) is taken once per
         schedule pass and eligibility is memoized per owner, so N queued
-        jobs don't cost N snapshots + N restriction-query sets."""
+        jobs don't cost N snapshots + N restriction-query sets.
+
+        Host-health gating: the snapshot now RETAINS last-known-good data
+        for degraded/unreachable hosts, so presence of a ``TPU`` subtree no
+        longer implies the host is alive — nodes whose HEALTH state is
+        degraded or unreachable are excluded, as are hosts whose transport
+        circuit breaker is open (a queued job must never spawn onto a node
+        the control plane cannot even reach)."""
         if self.infrastructure_manager is None:
             return None
+        open_circuit = (
+            set(self.transport_manager.open_circuit_hosts())
+            if self.transport_manager is not None else set())
         host_chips = {
             hostname: set(node["TPU"])
             for hostname, node in self.infrastructure_manager.infrastructure.items()
-            if "TPU" in node  # absent = never reported or marked unreachable
+            if "TPU" in node  # absent = never reported
+            and node.get("HEALTH", {}).get("state") not in ("degraded", "unreachable")
+            and hostname not in open_circuit
         }
         by_owner: Dict[int, Set[str]] = {}
 

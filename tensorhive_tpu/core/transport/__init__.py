@@ -9,7 +9,8 @@ The reference hardwires parallel-ssh/libssh2; this rebuild defines a narrow
 * ``fake``  — in-process simulated cluster, closing the reference's test gap
   (SURVEY.md §4: "There is no fake SSH backend and no multi-node simulation").
 """
-from .base import CommandResult, Transport, TransportManager, get_transport_manager, set_transport_manager  # noqa: F401
+from .base import CommandResult, ResilientTransport, Transport, TransportManager, get_transport_manager, set_transport_manager  # noqa: F401
+from .resilience import BreakerOpenError, CircuitBreaker, TransportResilience  # noqa: F401
 from .local import LocalTransport  # noqa: F401
 from .ssh import SshTransport  # noqa: F401
-from .fake import FakeCluster, FakeTransport  # noqa: F401
+from .fake import FakeCluster, FakeTransport, FaultPlan  # noqa: F401

@@ -14,6 +14,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 from ...config import Config, HostConfig, get_config
 from ...observability import get_registry, get_tracer
 from ...utils.exceptions import TransportError
+from .resilience import BreakerOpenError, TransportResilience
 
 log = logging.getLogger(__name__)
 
@@ -25,7 +26,8 @@ _COMMAND_SECONDS = get_registry().histogram(
     labels=("host",))
 _COMMANDS_TOTAL = get_registry().counter(
     "tpuhive_transport_commands_total",
-    "Remote commands by host and outcome (ok, error, unreachable).",
+    "Remote commands by host and outcome (ok, error, unreachable, "
+    "circuit_open).",
     labels=("host", "outcome"))
 
 
@@ -48,6 +50,10 @@ class CommandResult:
 class Transport:
     """One (host, user) command channel."""
 
+    #: per-attempt deadline used when callers pass no timeout; backends
+    #: built with a config overwrite this from ``config.ssh.timeout_s``
+    timeout_s: float = 10.0
+
     def __init__(self, host: HostConfig, user: Optional[str] = None) -> None:
         self.host = host
         self.user = user or host.user
@@ -56,10 +62,17 @@ class Transport:
     def hostname(self) -> str:
         return self.host.name
 
-    def run(self, command: str, timeout: Optional[float] = None) -> CommandResult:
+    def run(self, command: str, timeout: Optional[float] = None,
+            idempotent: bool = True) -> CommandResult:
         """Execute a shell command; returns CommandResult (non-zero exit codes
         are returned, not raised). Raises TransportError only when the channel
-        itself fails (unreachable host, auth failure, timeout)."""
+        itself fails (unreachable host, auth failure, timeout).
+
+        ``idempotent=False`` marks commands with side effects that must not
+        be re-issued on an ambiguous failure (a spawn that timed out may
+        still have started its process); the resilient wrapper honors it by
+        never retrying such calls — concrete backends ignore it.
+        """
         raise NotImplementedError
 
     def check_output(self, command: str, timeout: Optional[float] = None) -> str:
@@ -75,9 +88,10 @@ class Transport:
 
     def test(self) -> bool:
         """Connectivity probe (reference runs `uname` on every node,
-        SSHConnectionManager.test_all_connections:76-121)."""
+        SSHConnectionManager.test_all_connections:76-121). Uses the
+        configured per-attempt timeout, not a hardcoded one."""
         try:
-            return self.run("uname", timeout=10).ok
+            return self.run("uname", timeout=self.timeout_s).ok
         except TransportError:
             return False
 
@@ -118,6 +132,54 @@ class Transport:
             self.run(f"rm -f {quoted}.b64")
 
 
+class ResilientTransport(Transport):
+    """Breaker + retry protection around one cached backend transport.
+
+    ``TransportManager.for_host`` hands these out, so single-host callers
+    (nursery spawns, deploys, ad-hoc ``check_output``) share the same
+    per-host failure streaks and open-circuit fast-fail as the
+    ``run_on_all`` fan-out. All base-class helpers (``check_output``,
+    ``test``, ``expand_remote_path``) funnel through the protected
+    :meth:`run`; unknown attributes delegate to the wrapped backend so
+    backend-specific surfaces (e.g. ``FakeTransport.on``) keep working.
+    """
+
+    def __init__(self, inner: Transport, resilience: TransportResilience) -> None:
+        super().__init__(inner.host, inner.user)
+        self.inner = inner
+        self.timeout_s = getattr(inner, "timeout_s", Transport.timeout_s)
+        self._resilience = resilience
+
+    def run(self, command: str, timeout: Optional[float] = None,
+            idempotent: bool = True) -> CommandResult:
+        if not idempotent:
+            # side-effecting command: breaker check only, never a re-issue
+            breaker = self._resilience.breaker(self.hostname)
+            if not breaker.allow():
+                raise BreakerOpenError(self.hostname, breaker.retry_in_s(),
+                                       breaker.consecutive_failures)
+            try:
+                result = self.inner.run(command, timeout=timeout)
+            except TransportError:
+                breaker.record_failure()
+                raise
+            breaker.record_success()
+            return result
+        return self._resilience.call(
+            self.hostname,
+            lambda attempt_timeout: self.inner.run(command, timeout=attempt_timeout),
+            timeout=timeout,
+        )
+
+    def put_file(self, local_path: str, remote_path: str, mode: int = 0o755) -> None:
+        # delegate so backend-native copy paths (scp) are preserved; the
+        # many-step streaming fallback is not safely retryable as a unit
+        self.inner.put_file(local_path, remote_path, mode=mode)
+
+    def __getattr__(self, name: str):
+        return getattr(self.inner, name)
+
+
 _BACKENDS: Dict[str, Callable[..., Transport]] = {}
 
 
@@ -145,10 +207,16 @@ class TransportManager:
     thread pool with ``stop_on_errors=False`` semantics — per-host failures
     are isolated into the result map)."""
 
-    def __init__(self, config: Optional[Config] = None, max_workers: int = 32) -> None:
+    def __init__(self, config: Optional[Config] = None, max_workers: int = 32,
+                 resilience: Optional[TransportResilience] = None) -> None:
         self.config = config or get_config()
+        #: per-host breakers + retry policy shared by the fan-out and cached
+        #: single-host transports; injectable so tests/chaos harnesses drive
+        #: it on a fake clock with a seeded rng
+        self.resilience = resilience or TransportResilience(self.config)
         self._cache: Dict[Tuple[str, Optional[str]], Transport] = {}
         self._cache_lock = threading.Lock()
+        self._closed = False
         # persistent pool: run_on_all fires once per monitor per ~2s tick, so
         # per-call executor construction would churn threads on the hot path
         self._pool = concurrent.futures.ThreadPoolExecutor(
@@ -166,13 +234,24 @@ class TransportManager:
     def for_host(self, hostname: str, user: Optional[str] = None) -> Transport:
         key = (hostname, user)
         with self._cache_lock:
+            if self._closed:
+                raise TransportError(
+                    "transport manager is closed; no transports available")
             if key not in self._cache:
                 try:
                     host = self.config.hosts[hostname]
                 except KeyError:
                     raise TransportError(f"unknown host {hostname!r}")
-                self._cache[key] = make_transport(host, user=user, config=self.config)
+                self._cache[key] = ResilientTransport(
+                    make_transport(host, user=user, config=self.config),
+                    self.resilience,
+                )
             return self._cache[key]
+
+    def open_circuit_hosts(self) -> List[str]:
+        """Hosts the resilience layer is currently refusing to contact —
+        the set ``run_on_all`` skips and the job scheduler excludes."""
+        return self.resilience.open_hosts()
 
     def invalidate(self, hostname: Optional[str] = None) -> None:
         with self._cache_lock:
@@ -195,11 +274,22 @@ class TransportManager:
         if not hostnames:
             return results
 
-        def _one(name: str) -> CommandResult:
+        def _one(name: str) -> Tuple[CommandResult, str]:
             started = time.perf_counter()
             try:
                 result = self.for_host(name).run(command, timeout=timeout)
                 outcome = "ok" if result.ok else "error"
+            except BreakerOpenError as exc:
+                # open circuit: skipped outright — no round-trip happened, so
+                # no latency observation; the synthetic result keeps the
+                # per-host isolation contract (callers see a failure, fast)
+                outcome = "circuit_open"
+                result = CommandResult(
+                    host=name, command=command, exit_code=255, stdout="",
+                    stderr=str(exc),
+                )
+                _COMMANDS_TOTAL.labels(host=name, outcome=outcome).inc()
+                return result, outcome
             except TransportError as exc:
                 log.warning("host %s unreachable: %s", name, exc)
                 outcome = "unreachable"
@@ -209,19 +299,29 @@ class TransportManager:
             _COMMAND_SECONDS.labels(host=name).observe(
                 time.perf_counter() - started)
             _COMMANDS_TOTAL.labels(host=name, outcome=outcome).inc()
-            return result
+            return result, outcome
 
         with get_tracer().span("transport.run_on_all", kind="transport",
                                hosts=len(hostnames)) as span:
-            for name, result in zip(hostnames, self._pool.map(_one, hostnames)):
+            skipped = 0
+            for name, (result, outcome) in zip(
+                    hostnames, self._pool.map(_one, hostnames)):
                 results[name] = result
+                if outcome == "circuit_open":
+                    skipped += 1
             failed = sum(1 for result in results.values() if not result.ok)
             span.attrs["failed"] = str(failed)
+            span.attrs["circuit_open"] = str(skipped)
             if failed:
                 span.status = "error"
         return results
 
     def close(self) -> None:
+        """Shut down the pool AND drop cached transports: a closed manager
+        must never hand out channels backed by a dead pool."""
+        with self._cache_lock:
+            self._closed = True
+            self._cache.clear()
         self._pool.shutdown(wait=False)
 
     def test_all_connections(self) -> Dict[str, bool]:

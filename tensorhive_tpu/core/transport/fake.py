@@ -13,7 +13,9 @@ backend and no multi-node simulation"). This module provides:
 """
 from __future__ import annotations
 
+import dataclasses
 import itertools
+import random
 import threading
 from dataclasses import dataclass, field
 from typing import Callable, Dict, List, Optional, Tuple
@@ -22,6 +24,77 @@ from ...config import HostConfig
 from ...utils.exceptions import SpawnError, TransportError
 from ..nursery import HostOps, OpsFactory, Termination
 from .base import CommandResult, Transport
+
+
+@dataclass
+class FaultPlan:
+    """Deterministic, seeded fault injection for one fake host.
+
+    Attached via :meth:`FakeCluster.set_fault_plan`; every
+    :meth:`FakeTransport.run` consults the plan before (and after) executing
+    the canned handlers, so monitors, the nursery, and job scheduling can be
+    chaos-tested in-process without sleeping or flaking:
+
+    * ``fail_next`` — the next N calls raise ``TransportError`` outright;
+    * ``flap_every`` — every K-th call fails (counted per plan, so a plan
+      with ``flap_every=3`` fails calls 3, 6, 9, …);
+    * ``fail_probability`` — seeded coin per call: deterministic given
+      ``seed`` and the call order;
+    * ``latency_s`` — injected round-trip latency; when the call carries a
+      timeout smaller than the latency it raises a timeout-shaped
+      ``TransportError`` (no real sleeping — the latency is *modeled*, which
+      keeps chaos runs instant and exact);
+    * ``partial_stdout_chars`` — truncate successful stdout (a cut
+      connection mid-reply: drives the probe's unparseable-output path).
+
+    Every injected failure increments :attr:`faults_injected`;
+    :attr:`calls` counts all calls that consulted the plan (the chaos smoke
+    asserts an open breaker stops the counter moving).
+    """
+
+    seed: int = 0
+    fail_next: int = 0
+    flap_every: int = 0
+    fail_probability: float = 0.0
+    latency_s: float = 0.0
+    partial_stdout_chars: Optional[int] = None
+    error: str = "injected fault"
+
+    def __post_init__(self) -> None:
+        self._rng = random.Random(self.seed)
+        self._lock = threading.Lock()
+        with self._lock:
+            self.calls = 0
+            self.faults_injected = 0
+
+    def before_call(self, hostname: str, command: str,
+                    timeout: Optional[float]) -> None:
+        """Raise the planned ``TransportError`` for this call, if any."""
+        with self._lock:
+            self.calls += 1
+            reason = None
+            if self.fail_next > 0:
+                self.fail_next -= 1
+                reason = "fail_next"
+            elif self.flap_every and self.calls % self.flap_every == 0:
+                reason = "flap"
+            elif (self.fail_probability
+                    and self._rng.random() < self.fail_probability):
+                reason = "seeded"
+            elif (self.latency_s and timeout is not None
+                    and self.latency_s > timeout):
+                reason = f"latency {self.latency_s:g}s > timeout {timeout:g}s"
+            if reason is not None:
+                self.faults_injected += 1
+                raise TransportError(
+                    f"[{hostname}] {self.error} ({reason})")
+
+    def after_result(self, result: CommandResult) -> CommandResult:
+        with self._lock:
+            if self.partial_stdout_chars is not None:
+                return dataclasses.replace(
+                    result, stdout=result.stdout[:self.partial_stdout_chars])
+            return result
 
 
 @dataclass
@@ -65,6 +138,16 @@ class FakeCluster:
         self._pid_counter = itertools.count(1000)
         self._lock = threading.RLock()
         self.spawn_failures: Dict[str, str] = {}  # hostname -> error message
+        #: per-host deterministic fault injection (chaos harness)
+        self.fault_plans: Dict[str, FaultPlan] = {}
+
+    def set_fault_plan(self, hostname: str, plan: Optional[FaultPlan]) -> Optional[FaultPlan]:
+        """Attach (or with None: clear) a host's fault plan; returns it."""
+        if plan is None:
+            self.fault_plans.pop(hostname, None)
+        else:
+            self.fault_plans[hostname] = plan
+        return plan
 
     def add_host(self, name: str, chips: int = 0, accel: str = "v5litepod-8") -> FakeHost:
         host = FakeHost(name=name)
@@ -171,10 +254,20 @@ class FakeTransport(Transport):
     def on(self, predicate: Callable[[str], bool], respond: Callable[[str], str]) -> None:
         self._handlers.append((predicate, respond))
 
-    def run(self, command: str, timeout: Optional[float] = None) -> CommandResult:
+    def run(self, command: str, timeout: Optional[float] = None,
+            idempotent: bool = True) -> CommandResult:
         fake_host = self.cluster.host(self.hostname)
+        plan = self.cluster.fault_plans.get(self.hostname)
+        if plan is not None:
+            plan.before_call(self.hostname, command, timeout)
         if not fake_host.reachable:
             raise TransportError(f"[{self.hostname}] unreachable (fake)")
+        result = self._dispatch(command)
+        if plan is not None:
+            result = plan.after_result(result)
+        return result
+
+    def _dispatch(self, command: str) -> CommandResult:
         for predicate, respond in self._handlers:
             if predicate(command):
                 return CommandResult(self.hostname, command, 0, respond(command))

@@ -20,7 +20,8 @@ class LocalTransport(Transport):
         super().__init__(host, user)
         self.timeout_s = (config.ssh.timeout_s if config else 10.0)
 
-    def run(self, command: str, timeout: Optional[float] = None) -> CommandResult:
+    def run(self, command: str, timeout: Optional[float] = None,
+            idempotent: bool = True) -> CommandResult:
         try:
             proc = subprocess.run(
                 ["bash", "-c", command],

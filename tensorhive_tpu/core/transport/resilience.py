@@ -1,0 +1,333 @@
+"""Control-plane resilience: per-host circuit breakers + retry/backoff budgets.
+
+The transport layer used to be fail-fast-and-forget: one ``TransportError``
+in the fan-out produced a synthetic exit-255 result and the monitors came
+back ~2 s later to hammer the same dead host with a fresh full-timeout SSH
+attempt. Nothing distinguished a transient blip (retry it, cheaply) from a
+down node (stop paying the timeout for it). JIRIAF-style provisioning
+layers (PAPERS arxiv 2502.18596) model node health as an explicit state
+machine for exactly this reason; this module gives every managed host one:
+
+* :class:`CircuitBreaker` — classic closed → open → half-open per host.
+  ``failure_threshold`` consecutive *channel* failures (TransportError, not
+  non-zero exit codes — a host that answers with exit 1 is reachable) trip
+  the breaker open for ``cooldown_s`` seconds (+ deterministic-given-rng
+  jitter so a fleet of breakers does not re-probe in lockstep). After the
+  cool-down the next caller is granted one of ``half_open_probes`` probe
+  slots: success closes the breaker, failure re-opens it with a fresh
+  cool-down.
+* :class:`TransportResilience` — one registry of breakers per
+  :class:`~.base.TransportManager`, plus the retry policy wrapped around
+  every ``Transport.run``: bounded attempts (``1 + ssh.num_retries``),
+  exponential backoff with **full jitter** (AWS-style:
+  ``uniform(0, min(cap, base·2^attempt))``), and a per-call deadline budget
+  so retries can never exceed the caller's timeout — an unreachable host
+  costs at most the time the caller already agreed to wait, never a retry
+  storm on top of it.
+
+Clock, sleep, and rng are injectable so the whole state machine is testable
+(and chaos-smokeable, tools/chaos_smoke.py) on a fake clock with zero real
+waiting. Everything is thread-safe: breakers are shared by the fan-out pool
+and single-host callers.
+
+Exported metrics (docs/OBSERVABILITY.md, docs/ROBUSTNESS.md):
+
+* ``tpuhive_transport_breaker_state{host}`` — 0 closed, 1 half-open, 2 open;
+* ``tpuhive_transport_breaker_transitions_total{host,to}`` — one increment
+  per state transition (the chaos smoke asserts exactly-once per phase);
+* ``tpuhive_transport_retries_total{host,outcome}`` — calls that needed a
+  retry, by how the retry loop ended (``success``, ``exhausted``,
+  ``deadline``).
+"""
+from __future__ import annotations
+
+import random
+import threading
+import time
+from typing import TYPE_CHECKING, Callable, Dict, List, Optional
+
+from ...observability import get_registry
+from ...utils.exceptions import TransportError
+
+if TYPE_CHECKING:
+    from ...config import Config
+    from .base import CommandResult
+
+#: breaker states; the gauge encodes them in escalation order so
+#: ``max(gauge)`` over hosts is "worst breaker in the fleet"
+CLOSED, HALF_OPEN, OPEN = "closed", "half_open", "open"
+STATE_VALUES = {CLOSED: 0.0, HALF_OPEN: 1.0, OPEN: 2.0}
+
+_BREAKER_STATE = get_registry().gauge(
+    "tpuhive_transport_breaker_state",
+    "Per-host circuit-breaker state: 0 closed, 1 half-open, 2 open.",
+    labels=("host",))
+_BREAKER_TRANSITIONS = get_registry().counter(
+    "tpuhive_transport_breaker_transitions_total",
+    "Circuit-breaker state transitions per host, by target state.",
+    labels=("host", "to"))
+_RETRIES_TOTAL = get_registry().counter(
+    "tpuhive_transport_retries_total",
+    "Transport calls that needed at least one retry, by how the retry "
+    "loop ended (success, exhausted, deadline).",
+    labels=("host", "outcome"))
+
+
+class BreakerOpenError(TransportError):
+    """Raised instead of attempting a round-trip while a host's breaker is
+    open. Subclasses TransportError so every existing channel-failure path
+    (monitor isolation, ``Transport.test``, nursery) handles it — just much
+    faster than a timeout."""
+
+    def __init__(self, hostname: str, retry_in_s: float,
+                 consecutive_failures: int) -> None:
+        self.hostname = hostname
+        self.retry_in_s = retry_in_s
+        super().__init__(
+            f"[{hostname}] circuit open after {consecutive_failures} "
+            f"consecutive failures; next probe in {retry_in_s:.1f}s")
+
+
+class CircuitBreaker:
+    """One host's failure state machine; thread-safe.
+
+    Only *channel* failures count: callers record a failure when the
+    transport raised (unreachable/auth/timeout), a success when a round-trip
+    completed — whatever the remote exit code was.
+    """
+
+    def __init__(
+        self,
+        hostname: str,
+        failure_threshold: int = 3,
+        cooldown_s: float = 30.0,
+        cooldown_jitter: float = 0.1,
+        half_open_probes: int = 1,
+        clock: Callable[[], float] = time.monotonic,
+        rng: Optional[random.Random] = None,
+    ) -> None:
+        self.hostname = hostname
+        self.failure_threshold = max(1, int(failure_threshold))
+        self.cooldown_s = float(cooldown_s)
+        self.cooldown_jitter = max(0.0, float(cooldown_jitter))
+        self.half_open_probes = max(1, int(half_open_probes))
+        self._clock = clock
+        self._rng = rng or random.Random()
+        self._lock = threading.Lock()
+        self._state = CLOSED
+        self._consecutive_failures = 0
+        self._open_until = 0.0
+        self._probes_left = 0
+        self._opened_count = 0
+        _BREAKER_STATE.labels(host=hostname).set(STATE_VALUES[CLOSED])
+
+    # -- introspection -------------------------------------------------------
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    @property
+    def consecutive_failures(self) -> int:
+        with self._lock:
+            return self._consecutive_failures
+
+    @property
+    def opened_count(self) -> int:
+        with self._lock:
+            return self._opened_count
+
+    def retry_in_s(self) -> float:
+        """Seconds until an open breaker grants a half-open probe (0 when
+        not open)."""
+        with self._lock:
+            if self._state != OPEN:
+                return 0.0
+            return max(0.0, self._open_until - self._clock())
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {
+                "state": self._state,
+                "consecutive_failures": self._consecutive_failures,
+                "opened_count": self._opened_count,
+                "retry_in_s": (max(0.0, self._open_until - self._clock())
+                               if self._state == OPEN else 0.0),
+            }
+
+    # -- state machine -------------------------------------------------------
+    def allow(self) -> bool:
+        """May a call proceed right now? Open breakers refuse until the
+        cool-down elapses, then grant up to ``half_open_probes`` probes."""
+        with self._lock:
+            if self._state == CLOSED:
+                return True
+            if self._state == OPEN:
+                if self._clock() < self._open_until:
+                    return False
+                self._transition(HALF_OPEN)
+                self._probes_left = self.half_open_probes
+            # HALF_OPEN: hand out the remaining probe budget; everyone else
+            # waits for the probes' verdict instead of stampeding the host
+            if self._probes_left > 0:
+                self._probes_left -= 1
+                return True
+            return False
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._consecutive_failures = 0
+            if self._state != CLOSED:
+                self._transition(CLOSED)
+
+    def record_failure(self) -> int:
+        """Count one channel failure; returns the new consecutive streak."""
+        with self._lock:
+            self._consecutive_failures += 1
+            if self._state == HALF_OPEN:
+                self._trip()                 # probe failed: fresh cool-down
+            elif (self._state == CLOSED
+                    and self._consecutive_failures >= self.failure_threshold):
+                self._trip()
+            return self._consecutive_failures
+
+    def _trip(self) -> None:
+        # jitter spreads re-probe times across the fleet: cooldown ..
+        # cooldown*(1+jitter), deterministic given the injected rng
+        jitter = 1.0 + self.cooldown_jitter * self._rng.random()
+        self._open_until = self._clock() + self.cooldown_s * jitter
+        self._opened_count += 1
+        self._transition(OPEN)
+
+    def _transition(self, to: str) -> None:
+        # caller holds self._lock
+        self._state = to
+        _BREAKER_STATE.labels(host=self.hostname).set(STATE_VALUES[to])
+        _BREAKER_TRANSITIONS.labels(host=self.hostname, to=to).inc()
+
+
+class TransportResilience:
+    """Per-manager breaker registry + the retry policy around every call.
+
+    ``call(host, fn, timeout)`` is the single protected entry point: both
+    the ``run_on_all`` fan-out and cached single-host transports route
+    through it, so a host's failure streak is one number no matter which
+    path observed the failures.
+    """
+
+    def __init__(
+        self,
+        config: Optional["Config"] = None,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Callable[[float], None] = time.sleep,
+        rng: Optional[random.Random] = None,
+    ) -> None:
+        if config is None:
+            from ...config import get_config
+
+            config = get_config()
+        ssh = config.ssh
+        self.default_timeout_s = float(ssh.timeout_s)
+        self.max_attempts = 1 + max(0, int(ssh.num_retries))
+        self.backoff_base_s = float(ssh.retry_backoff_base_s)
+        self.backoff_max_s = float(ssh.retry_backoff_max_s)
+        self.failure_threshold = int(ssh.breaker_failure_threshold)
+        self.cooldown_s = float(ssh.breaker_cooldown_s)
+        self.cooldown_jitter = float(ssh.breaker_cooldown_jitter)
+        self.half_open_probes = int(ssh.breaker_half_open_probes)
+        self._clock = clock
+        self._sleep = sleep
+        self._rng = rng or random.Random()
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self._lock = threading.Lock()
+
+    # -- breaker registry ----------------------------------------------------
+    def breaker(self, hostname: str) -> CircuitBreaker:
+        with self._lock:
+            breaker = self._breakers.get(hostname)
+            if breaker is None:
+                breaker = CircuitBreaker(
+                    hostname,
+                    failure_threshold=self.failure_threshold,
+                    cooldown_s=self.cooldown_s,
+                    cooldown_jitter=self.cooldown_jitter,
+                    half_open_probes=self.half_open_probes,
+                    clock=self._clock,
+                    rng=self._rng,
+                )
+                self._breakers[hostname] = breaker
+            return breaker
+
+    def open_hosts(self) -> List[str]:
+        """Hosts whose breaker is currently refusing calls (open AND still
+        inside the cool-down — a breaker one ``allow()`` away from granting
+        a half-open probe is not 'skipped', it is about to be probed)."""
+        with self._lock:
+            breakers = list(self._breakers.items())
+        return sorted(host for host, breaker in breakers
+                      if breaker.state == OPEN)
+
+    def snapshot(self) -> Dict[str, Dict]:
+        with self._lock:
+            breakers = list(self._breakers.items())
+        return {host: breaker.snapshot() for host, breaker in breakers}
+
+    # -- the protected call path --------------------------------------------
+    def call(self, hostname: str, fn: Callable[[Optional[float]], "CommandResult"],
+             timeout: Optional[float] = None) -> "CommandResult":
+        """Run ``fn(attempt_timeout)`` under breaker + retry protection.
+
+        ``fn`` receives the per-attempt timeout; ``TransportError`` counts as
+        a channel failure (retried while budget remains), anything it
+        *returns* — including non-zero exits — is a success for the breaker.
+
+        The deadline budget: with an explicit caller ``timeout``, the whole
+        loop (attempts + backoff sleeps) fits inside it. With ``timeout=None``
+        each attempt gets the configured default and the budget is
+        ``default · max_attempts`` — still bounded, never unbounded waiting.
+        """
+        breaker = self.breaker(hostname)
+        if not breaker.allow():
+            raise BreakerOpenError(hostname, breaker.retry_in_s(),
+                                   breaker.consecutive_failures)
+        per_attempt = timeout if timeout is not None else self.default_timeout_s
+        budget = (timeout if timeout is not None
+                  else self.default_timeout_s * self.max_attempts)
+        deadline = self._clock() + budget
+        attempt = 0
+        while True:
+            attempt += 1
+            remaining = deadline - self._clock()
+            attempt_timeout = max(0.001, min(per_attempt, remaining))
+            try:
+                result = fn(attempt_timeout)
+            except BreakerOpenError:
+                raise
+            except TransportError:
+                breaker.record_failure()
+                if attempt >= self.max_attempts:
+                    if attempt > 1:
+                        _RETRIES_TOTAL.labels(
+                            host=hostname, outcome="exhausted").inc()
+                    raise
+                if breaker.state == OPEN:
+                    # the streak just tripped the breaker: stop hammering,
+                    # the cool-down owns the next contact with this host
+                    raise
+                delay = self._backoff(attempt)
+                if self._clock() + delay >= deadline:
+                    _RETRIES_TOTAL.labels(
+                        host=hostname, outcome="deadline").inc()
+                    raise
+                self._sleep(delay)
+                continue
+            breaker.record_success()
+            if attempt > 1:
+                _RETRIES_TOTAL.labels(host=hostname, outcome="success").inc()
+            return result
+
+    def _backoff(self, attempt: int) -> float:
+        """Full jitter: uniform over [0, min(cap, base·2^(attempt-1))] —
+        decorrelates retry waves across hosts and callers."""
+        cap = min(self.backoff_max_s, self.backoff_base_s * (2 ** (attempt - 1)))
+        return self._rng.uniform(0.0, cap)

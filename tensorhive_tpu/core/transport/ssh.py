@@ -57,7 +57,8 @@ class SshTransport(Transport):
     def _base_args(self) -> List[str]:
         return ["ssh"] + self._common_options() + ["-p", str(self.host.port)]
 
-    def run(self, command: str, timeout: Optional[float] = None) -> CommandResult:
+    def run(self, command: str, timeout: Optional[float] = None,
+            idempotent: bool = True) -> CommandResult:
         target = f"{self.user}@{self.host.address}" if self.user else self.host.address
         argv = self._base_args() + [target, command]
         try:

@@ -377,6 +377,42 @@ def _dead_service_count() -> Optional[float]:
     return float(sum(1 for service in services if not service.is_alive()))
 
 
+def _open_breaker_count() -> Optional[float]:
+    """Hosts whose transport circuit breaker is currently open (None before
+    a transport manager exists — nothing to watch yet)."""
+    from ..core.transport import base as transport_base
+
+    # read the module global (never get_transport_manager(): that would
+    # CONSTRUCT a manager as a side effect of evaluating an alert rule)
+    manager = transport_base._manager
+    if manager is None:
+        return None
+    return float(len(manager.resilience.open_hosts()))
+
+
+def _stale_host_counter(stale_after_s: float) -> Callable[[], Optional[float]]:
+    """Source callable: managed hosts whose last-known-good telemetry
+    snapshot is older than ``stale_after_s`` (or that never produced one
+    and are marked unreachable)."""
+
+    def _stale_host_count() -> Optional[float]:
+        from ..core.managers import manager as manager_module
+
+        manager = manager_module._instance
+        if manager is None:
+            return None
+        health = manager.infrastructure_manager.host_health()
+        if not health:
+            return None
+        return float(sum(
+            1 for entry in health.values()
+            if (entry["staleness_s"] is not None
+                and entry["staleness_s"] > stale_after_s)
+            or entry["state"] == "unreachable"))
+
+    return _stale_host_count
+
+
 def default_rule_pack(monitoring_interval_s: Optional[float] = None,
                       alert_interval_s: float = 5.0) -> List[AlertRule]:
     """The signals the registry already records (docs/OBSERVABILITY.md),
@@ -420,6 +456,21 @@ def default_rule_pack(monitoring_interval_s: Optional[float] = None,
             threshold=probe_stale_after, for_s=alert_interval_s,
             description="no probe round completed within 3x the monitoring "
                         "interval — telemetry is blind"),
+        AlertRule(
+            name="transport_breaker_open", severity="critical",
+            kind="threshold", op=">", threshold=0.0, for_s=0.0,
+            source=_open_breaker_count,
+            description="a host's transport circuit breaker is open — the "
+                        "control plane is refusing to contact it until the "
+                        "cool-down elapses (docs/ROBUSTNESS.md)"),
+        AlertRule(
+            name="host_snapshot_stale", severity="warning",
+            kind="threshold", op=">", threshold=0.0,
+            for_s=alert_interval_s,
+            source=_stale_host_counter(probe_stale_after),
+            description="a managed host's last-known-good telemetry "
+                        "snapshot is older than 3x the monitoring interval "
+                        "— its infra data is being served stale"),
         AlertRule(
             name="job_spawn_failures", severity="warning",
             kind="increase", metric="tpuhive_job_spawn_failures_total",

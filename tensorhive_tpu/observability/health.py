@@ -80,6 +80,19 @@ def check_service(service, now: float) -> Dict:
     return _component(name, True)
 
 
+def check_transport_breakers(transport_manager) -> Dict:
+    """No open circuit breakers: an open breaker means part of the fleet is
+    unreachable from this control plane — traffic/work routed here would be
+    scheduled against hosts it cannot contact."""
+    open_hosts = transport_manager.open_circuit_hosts()
+    if open_hosts:
+        return _component(
+            "transport", False,
+            f"circuit open for {len(open_hosts)} host(s): "
+            f"{', '.join(open_hosts)}")
+    return _component("transport", True)
+
+
 def check_probe_freshness(now: float, interval_s: float) -> Dict:
     """Telemetry freshness off the registry gauge the probe layer stamps
     after every round — no scrape round-trip, same truth Prometheus sees."""
@@ -126,5 +139,8 @@ def readiness(manager=None, now: Optional[float] = None,
         # probe freshness only binds when there are hosts to probe; an
         # empty inventory has no round to be stale
         components.append(check_probe_freshness(now, monitoring.interval_s))
+    if (manager is not None and getattr(manager.config, "hosts", None)
+            and getattr(manager, "transport_manager", None) is not None):
+        components.append(check_transport_breakers(manager.transport_manager))
     ready = all(component["ok"] for component in components)
     return ready, components

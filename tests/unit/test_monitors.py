@@ -41,6 +41,10 @@ def cluster(config):
 
 @pytest.fixture()
 def transports(config, cluster):
+    # zero breaker cool-down: a host that recovers is re-probed on the next
+    # round (half-open) instead of being circuit-skipped for the default 30 s
+    # — these tests exercise monitor semantics, not breaker timing
+    config.ssh.breaker_cooldown_s = 0.0
     manager = TransportManager(config)
     yield manager
     manager.close()
@@ -166,17 +170,48 @@ def test_tpu_monitor_isolates_unreachable_host(cluster, transports):
     monitor.update(transports, infra)
     snapshot = infra.infrastructure
     assert "TPU" in snapshot["vm-0"]
-    assert "TPU" not in snapshot["vm-1"]  # stale data dropped, not retained
+    assert "TPU" not in snapshot["vm-1"]  # never reported: nothing to retain
+    assert snapshot["vm-1"]["HEALTH"]["state"] == "degraded"
 
 
-def test_tpu_monitor_drops_stale_subtree_when_host_goes_dark(cluster, transports):
+def test_tpu_monitor_retains_last_known_good_when_host_goes_dark(cluster, transports):
+    """Policy reversal (ISSUE 5): a dark host's last telemetry is RETAINED
+    with an explicit HEALTH marker + staleness age instead of being dropped —
+    operators keep the last-known-good picture, consumers gate on HEALTH."""
     infra = InfrastructureManager(["vm-0"])
     monitor = TpuMonitor()
     monitor.update(transports, infra)
-    assert "TPU" in infra.infrastructure["vm-0"]
+    node = infra.infrastructure["vm-0"]
+    assert "TPU" in node
+    assert node["HEALTH"]["state"] == "ok"
+    assert node["HEALTH"]["consecutive_failures"] == 0
+
     cluster.host("vm-0").reachable = False
     monitor.update(transports, infra)
-    assert "TPU" not in infra.infrastructure["vm-0"]
+    node = infra.infrastructure["vm-0"]
+    assert "TPU" in node                      # last-known-good kept
+    assert node["HEALTH"]["state"] == "degraded"
+    assert node["HEALTH"]["consecutive_failures"] == 1
+    assert node["HEALTH"]["staleness_s"] is not None
+
+    # streak grows to the unreachable threshold; exactly ONE failure per
+    # round even though both the TPU and WARNINGS subtrees used to be marked
+    monitor.update(transports, infra)
+    monitor.update(transports, infra)
+    node = infra.infrastructure["vm-0"]
+    assert node["HEALTH"]["state"] == "unreachable"
+    assert node["HEALTH"]["consecutive_failures"] == 3
+
+    # stale process data must not reach the protection fan-out
+    assert "vm-0" not in infra.all_nodes_with_tpu_processes()
+
+    # recovery: one good round resets everything
+    cluster.host("vm-0").reachable = True
+    monitor.update(transports, infra)
+    node = infra.infrastructure["vm-0"]
+    assert node["HEALTH"]["state"] == "ok"
+    assert node["HEALTH"]["consecutive_failures"] == 0
+    assert "vm-0" in infra.all_nodes_with_tpu_processes()
 
 
 def test_tpu_monitor_warns_when_sysfs_absent(cluster, transports):

@@ -1,0 +1,562 @@
+"""Control-plane resilience tests: circuit breakers, retry/backoff budgets,
+the deterministic FaultPlan chaos harness, host-health retention, and the
+scheduler/readiness/alerting integration (ISSUE 5).
+
+Everything runs on a fake clock with injected sleep + seeded rng — no real
+waiting, no flaking. Hostnames are unique per test because breaker/counter
+children live in the process-wide metrics registry.
+"""
+import random
+
+import pytest
+
+from tensorhive_tpu.config import HostConfig
+from tensorhive_tpu.core.managers.infrastructure import InfrastructureManager
+from tensorhive_tpu.core.transport.base import (
+    ResilientTransport,
+    TransportManager,
+    register_backend,
+)
+from tensorhive_tpu.core.transport.fake import FakeCluster, FakeTransport, FaultPlan
+from tensorhive_tpu.core.transport.resilience import (
+    CLOSED,
+    HALF_OPEN,
+    OPEN,
+    BreakerOpenError,
+    CircuitBreaker,
+    TransportResilience,
+)
+from tensorhive_tpu.observability import get_registry
+from tensorhive_tpu.utils.exceptions import TransportError
+
+
+class FakeClock:
+    """Manually advanced monotonic clock; sleep() advances it."""
+
+    def __init__(self, start: float = 1000.0) -> None:
+        self.now = start
+        self.sleeps = []
+
+    def __call__(self) -> float:
+        return self.now
+
+    def sleep(self, seconds: float) -> None:
+        self.sleeps.append(seconds)
+        self.now += seconds
+
+    def advance(self, seconds: float) -> None:
+        self.now += seconds
+
+
+def counter_value(name: str, **labels) -> float:
+    family = get_registry().get(name)
+    return family.labels(**labels).value if family is not None else 0.0
+
+
+def make_resilience(config, clock, **ssh_overrides) -> TransportResilience:
+    for key, value in ssh_overrides.items():
+        setattr(config.ssh, key, value)
+    return TransportResilience(config, clock=clock, sleep=clock.sleep,
+                               rng=random.Random(42))
+
+
+# -- CircuitBreaker state machine --------------------------------------------
+
+def test_breaker_opens_after_threshold_and_cools_down():
+    clock = FakeClock()
+    breaker = CircuitBreaker("b1", failure_threshold=3, cooldown_s=30.0,
+                             cooldown_jitter=0.0, clock=clock,
+                             rng=random.Random(0))
+    assert breaker.state == CLOSED and breaker.allow()
+    breaker.record_failure()
+    breaker.record_failure()
+    assert breaker.state == CLOSED          # below threshold: still closed
+    breaker.record_failure()
+    assert breaker.state == OPEN
+    assert not breaker.allow()              # inside the cool-down
+    assert breaker.retry_in_s() == pytest.approx(30.0)
+
+    clock.advance(29.9)
+    assert not breaker.allow()
+    clock.advance(0.2)                      # cool-down elapsed
+    assert breaker.allow()                  # half-open probe granted
+    assert breaker.state == HALF_OPEN
+    breaker.record_success()
+    assert breaker.state == CLOSED and breaker.consecutive_failures == 0
+
+
+def test_breaker_half_open_probe_budget_and_reopen():
+    clock = FakeClock()
+    breaker = CircuitBreaker("b2", failure_threshold=1, cooldown_s=10.0,
+                             cooldown_jitter=0.0, half_open_probes=2,
+                             clock=clock, rng=random.Random(0))
+    breaker.record_failure()
+    assert breaker.state == OPEN
+    clock.advance(10.1)
+    assert breaker.allow() and breaker.allow()   # exactly the probe budget
+    assert not breaker.allow()                   # third caller waits
+    breaker.record_failure()                     # a probe failed
+    assert breaker.state == OPEN                 # fresh cool-down
+    assert not breaker.allow()
+    clock.advance(10.1)
+    assert breaker.allow()
+    breaker.record_success()
+    assert breaker.state == CLOSED
+
+
+def test_breaker_cooldown_jitter_is_bounded_and_seeded():
+    clock = FakeClock()
+    opens = []
+    for seed in (7, 7):                      # same seed -> same jitter
+        breaker = CircuitBreaker("b3", failure_threshold=1, cooldown_s=20.0,
+                                 cooldown_jitter=0.25, clock=clock,
+                                 rng=random.Random(seed))
+        breaker.record_failure()
+        opens.append(breaker.retry_in_s())
+    assert opens[0] == opens[1]
+    assert 20.0 <= opens[0] <= 20.0 * 1.25
+
+
+def test_breaker_state_gauge_and_transition_counters():
+    clock = FakeClock()
+    breaker = CircuitBreaker("b4", failure_threshold=1, cooldown_s=5.0,
+                             cooldown_jitter=0.0, clock=clock,
+                             rng=random.Random(0))
+    gauge = get_registry().get("tpuhive_transport_breaker_state")
+    breaker.record_failure()
+    assert gauge.labels(host="b4").value == 2.0          # open
+    clock.advance(5.1)
+    assert breaker.allow()
+    assert gauge.labels(host="b4").value == 1.0          # half-open
+    breaker.record_success()
+    assert gauge.labels(host="b4").value == 0.0          # closed
+    for state, expected in (("open", 1.0), ("half_open", 1.0), ("closed", 1.0)):
+        assert counter_value("tpuhive_transport_breaker_transitions_total",
+                             host="b4", to=state) == expected
+
+
+# -- retry policy / deadline budget ------------------------------------------
+
+def test_retry_succeeds_within_budget(config):
+    clock = FakeClock()
+    resilience = make_resilience(config, clock, num_retries=2,
+                                 retry_backoff_base_s=0.1)
+    attempts = []
+
+    def flaky(timeout):
+        attempts.append(timeout)
+        if len(attempts) < 3:
+            raise TransportError("blip")
+        from tensorhive_tpu.core.transport.base import CommandResult
+
+        return CommandResult("r-ok", "cmd", 0, "fine")
+
+    result = resilience.call("r-ok", flaky, timeout=30.0)
+    assert result.ok and len(attempts) == 3
+    assert len(clock.sleeps) == 2                       # backoff between attempts
+    assert counter_value("tpuhive_transport_retries_total",
+                         host="r-ok", outcome="success") == 1.0
+    assert resilience.breaker("r-ok").state == CLOSED   # success reset the streak
+
+
+def test_retries_respect_deadline_budget(config):
+    """Retries must never exceed the caller's timeout: total attempt time +
+    backoff stays inside the budget, and each attempt's timeout shrinks to
+    the remaining budget (no retry storm past the deadline)."""
+    clock = FakeClock()
+    resilience = make_resilience(config, clock, num_retries=10,
+                                 retry_backoff_base_s=0.5,
+                                 retry_backoff_max_s=2.0,
+                                 breaker_failure_threshold=100)
+    attempt_timeouts = []
+
+    def failing(timeout):
+        attempt_timeouts.append(timeout)
+        clock.advance(timeout)              # the attempt burns its timeout
+        raise TransportError("down")
+
+    start = clock.now
+    with pytest.raises(TransportError):
+        resilience.call("r-deadline", failing, timeout=3.0)
+    assert clock.now - start <= 3.0 + 1e-6
+    assert all(t <= 3.0 for t in attempt_timeouts)
+    # attempts after the first get only what's left of the budget
+    assert attempt_timeouts[0] == pytest.approx(3.0)
+    if len(attempt_timeouts) > 1:
+        assert attempt_timeouts[-1] < 3.0
+    assert counter_value("tpuhive_transport_retries_total",
+                         host="r-deadline", outcome="deadline") >= 1.0
+
+
+def test_retry_stops_when_breaker_trips_mid_call(config):
+    clock = FakeClock()
+    resilience = make_resilience(config, clock, num_retries=5,
+                                 breaker_failure_threshold=2,
+                                 retry_backoff_base_s=0.01)
+    calls = []
+
+    def failing(timeout):
+        calls.append(timeout)
+        raise TransportError("down")
+
+    with pytest.raises(TransportError):
+        resilience.call("r-trip", failing, timeout=60.0)
+    # threshold 2: the second failure tripped the breaker, retries 3..6 never ran
+    assert len(calls) == 2
+    assert resilience.breaker("r-trip").state == OPEN
+    with pytest.raises(BreakerOpenError):
+        resilience.call("r-trip", failing, timeout=60.0)
+    assert len(calls) == 2                  # open circuit: fn never invoked
+
+
+# -- FaultPlan ----------------------------------------------------------------
+
+def test_fault_plan_fail_next_flap_and_partial_stdout():
+    cluster = FakeCluster()
+    cluster.add_host("fp-0")
+    transport = FakeTransport(HostConfig(name="fp-0"), cluster)
+
+    plan = cluster.set_fault_plan("fp-0", FaultPlan(fail_next=2))
+    with pytest.raises(TransportError):
+        transport.run("uname")
+    with pytest.raises(TransportError):
+        transport.run("uname")
+    assert transport.run("uname").ok        # plan exhausted
+    assert plan.faults_injected == 2 and plan.calls == 3
+
+    cluster.set_fault_plan("fp-0", FaultPlan(flap_every=3))
+    outcomes = []
+    for _ in range(6):
+        try:
+            transport.run("uname")
+            outcomes.append("ok")
+        except TransportError:
+            outcomes.append("fail")
+    assert outcomes == ["ok", "ok", "fail", "ok", "ok", "fail"]
+
+    cluster.set_fault_plan("fp-0", FaultPlan(partial_stdout_chars=3))
+    assert transport.run("uname").stdout == "Lin"       # cut mid-reply
+
+
+def test_fault_plan_latency_vs_timeout_and_seeded_determinism():
+    cluster = FakeCluster()
+    cluster.add_host("fp-1")
+    transport = FakeTransport(HostConfig(name="fp-1"), cluster)
+    cluster.set_fault_plan("fp-1", FaultPlan(latency_s=5.0))
+    with pytest.raises(TransportError):
+        transport.run("uname", timeout=1.0)             # modeled timeout
+    assert transport.run("uname", timeout=10.0).ok      # latency fits
+    assert transport.run("uname").ok                    # no timeout: no trip
+
+    def pattern(seed):
+        plan = FaultPlan(seed=seed, fail_probability=0.5)
+        cluster.set_fault_plan("fp-1", plan)
+        out = []
+        for _ in range(12):
+            try:
+                transport.run("uname")
+                out.append(1)
+            except TransportError:
+                out.append(0)
+        return out
+
+    assert pattern(123) == pattern(123)                 # same seed, same chaos
+    assert pattern(123) != pattern(321)
+
+
+# -- run_on_all with mixed healthy/unreachable/flapping hosts ----------------
+
+@pytest.fixture()
+def mixed_cluster(config):
+    cluster = FakeCluster()
+    register_backend("fake", lambda host, user=None, config=None: FakeTransport(
+        host, cluster, user))
+    for name in ("mx-good", "mx-dead", "mx-flap"):
+        config.hosts[name] = HostConfig(name=name, backend="fake")
+        cluster.add_host(name)
+    cluster.host("mx-dead").reachable = False
+    return cluster
+
+
+def test_run_on_all_mixed_outcomes_and_breaker_lifecycle(config, mixed_cluster):
+    clock = FakeClock()
+    resilience = make_resilience(config, clock, num_retries=1,
+                                 breaker_failure_threshold=3,
+                                 breaker_cooldown_s=30.0,
+                                 breaker_cooldown_jitter=0.0,
+                                 retry_backoff_base_s=0.05)
+    manager = TransportManager(config, resilience=resilience)
+    before = {
+        (host, outcome): counter_value("tpuhive_transport_commands_total",
+                                       host=host, outcome=outcome)
+        for host in ("mx-good", "mx-dead", "mx-flap")
+        for outcome in ("ok", "error", "unreachable", "circuit_open")
+    }
+
+    def delta(host, outcome):
+        return counter_value("tpuhive_transport_commands_total",
+                             host=host, outcome=outcome) - before[(host, outcome)]
+
+    # round 1: dead host fails (attempt + retry = 2 streak), others fine
+    results = manager.run_on_all("uname", timeout=5.0)
+    assert results["mx-good"].ok and results["mx-flap"].ok
+    assert not results["mx-dead"].ok and results["mx-dead"].exit_code == 255
+    assert delta("mx-good", "ok") == 1
+    assert delta("mx-dead", "unreachable") == 1
+    assert resilience.breaker("mx-dead").consecutive_failures == 2
+
+    # round 2: third failure trips the breaker mid-call
+    manager.run_on_all("uname", timeout=5.0)
+    assert resilience.breaker("mx-dead").state == OPEN
+
+    # round 3: open circuit -> skipped outright, fake never called
+    dead_plan = mixed_cluster.set_fault_plan("mx-dead", FaultPlan())
+    results = manager.run_on_all("uname", timeout=5.0)
+    assert "circuit open" in results["mx-dead"].stderr
+    assert delta("mx-dead", "circuit_open") == 1
+    assert dead_plan.calls == 0                     # skipped = no round-trip
+    assert manager.open_circuit_hosts() == ["mx-dead"]
+
+    # revive + cool-down elapses: half-open probe closes the breaker
+    mixed_cluster.host("mx-dead").reachable = True
+    clock.advance(31.0)
+    results = manager.run_on_all("uname", timeout=5.0)
+    assert results["mx-dead"].ok
+    assert resilience.breaker("mx-dead").state == CLOSED
+    assert delta("mx-dead", "ok") == 1
+    assert manager.open_circuit_hosts() == []
+    manager.close()
+
+
+def test_run_on_all_flapping_host_recovers_without_tripping(config, mixed_cluster):
+    """A host that fails every 3rd call keeps its streak below the threshold
+    (the retry absorbs single blips), so the breaker never opens."""
+    clock = FakeClock()
+    resilience = make_resilience(config, clock, num_retries=1,
+                                 breaker_failure_threshold=3,
+                                 retry_backoff_base_s=0.01)
+    manager = TransportManager(config, resilience=resilience)
+    mixed_cluster.host("mx-dead").reachable = True
+    mixed_cluster.set_fault_plan("mx-flap", FaultPlan(flap_every=3))
+    for _ in range(6):
+        results = manager.run_on_all("uname", timeout=5.0)
+        assert results["mx-flap"].ok        # every blip absorbed by the retry
+    assert resilience.breaker("mx-flap").state == CLOSED
+    assert counter_value("tpuhive_transport_retries_total",
+                         host="mx-flap", outcome="success") >= 1.0
+    manager.close()
+
+
+# -- single-host path / manager lifecycle ------------------------------------
+
+def test_for_host_is_protected_and_close_clears_cache(config):
+    cluster = FakeCluster()
+    cluster.add_host("sh-0")
+    register_backend("fake", lambda host, user=None, config=None: FakeTransport(
+        host, cluster, user))
+    config.hosts["sh-0"] = HostConfig(name="sh-0", backend="fake")
+    clock = FakeClock()
+    resilience = make_resilience(config, clock, num_retries=0,
+                                 breaker_failure_threshold=1,
+                                 breaker_cooldown_s=60.0)
+    manager = TransportManager(config, resilience=resilience)
+    transport = manager.for_host("sh-0")
+    assert isinstance(transport, ResilientTransport)
+    assert transport.run("uname").ok
+
+    cluster.host("sh-0").reachable = False
+    with pytest.raises(TransportError):
+        transport.run("uname")
+    # breaker open: the single-host path fast-fails without a round-trip
+    plan = cluster.set_fault_plan("sh-0", FaultPlan())
+    with pytest.raises(BreakerOpenError):
+        transport.run("uname")
+    assert plan.calls == 0
+    assert not transport.test()                     # BreakerOpenError -> False
+
+    manager.close()
+    with pytest.raises(TransportError):
+        manager.for_host("sh-0")                    # closed: no stale handouts
+
+
+def test_transport_test_uses_configured_timeout(config):
+    recorded = {}
+
+    class RecordingTransport(FakeTransport):
+        def run(self, command, timeout=None, idempotent=True):
+            recorded["timeout"] = timeout
+            return super().run(command, timeout=timeout)
+
+    cluster = FakeCluster()
+    cluster.add_host("t-0")
+    transport = RecordingTransport(HostConfig(name="t-0"), cluster)
+    transport.timeout_s = 3.5
+    assert transport.test()
+    assert recorded["timeout"] == 3.5               # not the old hardcoded 10
+
+
+def test_non_idempotent_run_is_never_retried(config):
+    cluster = FakeCluster()
+    cluster.add_host("sp-0")
+    register_backend("fake", lambda host, user=None, config=None: FakeTransport(
+        host, cluster, user))
+    config.hosts["sp-0"] = HostConfig(name="sp-0", backend="fake")
+    clock = FakeClock()
+    resilience = make_resilience(config, clock, num_retries=3,
+                                 breaker_failure_threshold=10)
+    manager = TransportManager(config, resilience=resilience)
+    plan = cluster.set_fault_plan("sp-0", FaultPlan(fail_next=1))
+    with pytest.raises(TransportError):
+        manager.for_host("sp-0").run("spawn-ish", idempotent=False)
+    assert plan.calls == 1                          # one attempt, no re-issue
+    assert resilience.breaker("sp-0").consecutive_failures == 1
+    manager.close()
+
+
+# -- infrastructure health retention ------------------------------------------
+
+def test_infra_health_states_and_staleness():
+    infra = InfrastructureManager(["h-0"])
+    assert infra.host_state("h-0") == "unknown"
+    infra.update_subtree("h-0", "TPU", {"h-0:tpu:0": {"index": 0}})
+    health = infra.host_health()["h-0"]
+    assert health["state"] == "ok" and health["consecutive_failures"] == 0
+
+    for expected_state in ("degraded", "degraded", "unreachable"):
+        infra.record_probe_failure("h-0", error="boom")
+        assert infra.host_state("h-0") == expected_state
+    node = infra.infrastructure["h-0"]
+    assert "TPU" in node                            # last-known-good retained
+    assert node["HEALTH"]["last_error"] == "boom"
+
+    # staleness is measured against the injectable now
+    seen = infra.host_health()["h-0"]["last_seen_ts"]
+    aged = infra.host_health(now=seen + 120.0)["h-0"]
+    assert aged["staleness_s"] == pytest.approx(120.0, abs=0.2)
+
+    infra.record_probe_success("h-0")
+    assert infra.host_state("h-0") == "ok"
+    assert infra.host_health()["h-0"]["consecutive_failures"] == 0
+
+
+def test_mark_unreachable_shim_retains_data():
+    infra = InfrastructureManager(["h-1"])
+    infra.update_subtree("h-1", "TPU", {"h-1:tpu:0": {"index": 0}})
+    infra.mark_unreachable("h-1", "TPU")
+    node = infra.infrastructure["h-1"]
+    assert "TPU" in node and node["HEALTH"]["state"] == "degraded"
+
+
+# -- scheduler exclusion -------------------------------------------------------
+
+def test_eligible_hosts_exclude_unhealthy_and_open_circuit(config, db):
+    from tensorhive_tpu.core.services.job_scheduling import JobSchedulingService
+    from tests.fixtures import make_job, make_permissive_restriction, make_user
+
+    make_permissive_restriction()
+    owner = make_user()
+    infra = InfrastructureManager(["el-ok", "el-degraded", "el-open"])
+    for host in ("el-ok", "el-degraded", "el-open"):
+        infra.update_subtree(host, "TPU", {f"{host}:tpu:0": {"index": 0}})
+    infra.record_probe_failure("el-degraded")
+
+    clock = FakeClock()
+    resilience = make_resilience(config, clock, breaker_failure_threshold=1,
+                                 breaker_cooldown_s=60.0)
+    manager = TransportManager(config, resilience=resilience)
+    resilience.breaker("el-open").record_failure()          # trips open
+    service = JobSchedulingService(config=config)
+    service.inject(infra, manager)
+
+    resolver = service._eligible_hosts_resolver()
+    eligible = resolver(make_job(owner))
+    assert eligible == {"el-ok"}
+    manager.close()
+
+
+def test_new_alert_rules_in_default_pack(config):
+    from tensorhive_tpu.observability.alerts import default_rule_pack
+
+    rules = {rule.name: rule for rule in default_rule_pack()}
+    assert {"transport_breaker_open", "host_snapshot_stale"} <= set(rules)
+    assert rules["transport_breaker_open"].severity == "critical"
+    assert rules["transport_breaker_open"].for_s == 0.0   # fires on first eval
+    assert rules["host_snapshot_stale"].source is not None
+
+
+def test_breaker_alert_source_tracks_global_transport_manager(config):
+    from tensorhive_tpu.core.transport.base import set_transport_manager
+    from tensorhive_tpu.observability.alerts import _open_breaker_count
+
+    set_transport_manager(None)
+    assert _open_breaker_count() is None      # no manager: nothing to watch
+    clock = FakeClock()
+    resilience = make_resilience(config, clock, breaker_failure_threshold=1,
+                                 breaker_cooldown_s=60.0)
+    manager = TransportManager(config, resilience=resilience)
+    set_transport_manager(manager)
+    try:
+        assert _open_breaker_count() == 0.0
+        resilience.breaker("al-0").record_failure()
+        assert _open_breaker_count() == 1.0
+    finally:
+        set_transport_manager(None)
+        manager.close()
+
+
+def test_stale_host_alert_source_counts_aged_snapshots(config):
+    from tensorhive_tpu.core.managers.manager import TpuHiveManager, set_manager
+    from tensorhive_tpu.observability.alerts import _stale_host_counter
+
+    source = _stale_host_counter(stale_after_s=6.0)
+    set_manager(None)
+    assert source() is None                   # no manager yet
+    config.hosts["st-0"] = HostConfig(name="st-0", backend="fake")
+    manager = TpuHiveManager(config=config)
+    set_manager(manager)
+    try:
+        infra = manager.infrastructure_manager
+        assert source() == 0.0                # never seen: not "stale"
+        for _ in range(3):                    # unreachable counts regardless
+            infra.record_probe_failure("st-0")
+        assert source() == 1.0
+        infra.record_probe_success("st-0")
+        assert source() == 0.0
+    finally:
+        set_manager(None)
+        manager.transport_manager.close()
+
+
+def test_readiness_transport_component(config):
+    from tensorhive_tpu.observability.health import check_transport_breakers
+
+    clock = FakeClock()
+    resilience = make_resilience(config, clock, breaker_failure_threshold=1,
+                                 breaker_cooldown_s=60.0)
+    manager = TransportManager(config, resilience=resilience)
+    assert check_transport_breakers(manager)["ok"]
+    resilience.breaker("rd-0").record_failure()
+    component = check_transport_breakers(manager)
+    assert not component["ok"] and "rd-0" in component["reason"]
+    manager.close()
+
+
+def test_stop_with_grace_survives_vanished_job(config, db, monkeypatch):
+    from tensorhive_tpu.core.services import job_scheduling as js
+    from tests.fixtures import make_job, make_permissive_restriction, make_user
+    from tensorhive_tpu.utils.timeutils import utcnow
+
+    make_permissive_restriction()
+    owner = make_user()
+    job = make_job(owner)
+    job_id = job.id
+
+    def deleting_stop(job_id_arg, gracefully=True):
+        js.Job.get(job_id_arg).destroy()        # row vanishes mid-stop
+
+    monkeypatch.setattr(js, "business_stop", deleting_stop)
+    service = js.JobSchedulingService(config=config)
+    service.stubborn_job_ids.add(job_id)
+    service.stop_with_grace(job, utcnow())      # must not raise
+    assert job_id not in service.stubborn_job_ids
+    assert job_id not in service._stop_first_attempt

@@ -1,0 +1,274 @@
+"""Chaos-smoke the control-plane resilience loop end to end (``make chaos-smoke``).
+
+Deterministic by construction: the fake cluster's FaultPlan is seeded, the
+circuit breakers run on an injected fake clock (sleep advances it — zero
+real waiting), and the retry/backoff rng is pinned. The walk
+(docs/ROBUSTNESS.md):
+
+1. healthy fleet → probe round populates infra, ``/api/readyz`` is 200;
+2. kill a host → injected failures grow the streak, the breaker opens after
+   exactly ``breaker_failure_threshold`` failures, the next fan-out skips
+   the host outright (zero round-trips, ``circuit_open`` outcome), queue
+   scheduling refuses to spawn onto it, readiness flips to 503 naming the
+   host, and the ``transport_breaker_open`` rule fires exactly once;
+3. revive the host + elapse the cool-down → the half-open probe closes the
+   breaker, the queued job finally spawns, readiness recovers, the alert
+   resolves exactly once, and every breaker transition was counted exactly
+   once.
+
+Exit 0 = healthy.
+"""
+from __future__ import annotations
+
+import json
+import os
+import random
+import sys
+import tempfile
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+os.environ.setdefault("TPUHIVE_PYTEST", "1")          # DB goes in-memory
+
+PROBLEMS = []
+
+
+def check(ok: bool, what: str) -> None:
+    status = "ok" if ok else "FAIL"
+    print(f"chaos-smoke: {status}: {what}")
+    if not ok:
+        PROBLEMS.append(what)
+
+
+def fetch(url: str):
+    """(status, body) — urllib raises on >=400, readiness 503 is a result."""
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as exc:
+        return exc.code, exc.read().decode()
+
+
+class FakeClock:
+    def __init__(self, start: float = 1000.0) -> None:
+        self.now = start
+
+    def __call__(self) -> float:
+        return self.now
+
+    def sleep(self, seconds: float) -> None:
+        self.now += seconds
+
+    def advance(self, seconds: float) -> None:
+        self.now += seconds
+
+
+def main() -> int:
+    from tensorhive_tpu.config import Config, HostConfig, set_config
+
+    config = Config(config_dir=Path(tempfile.mkdtemp(prefix="tpuhive-chaos-")))
+    config.ssh.num_retries = 1
+    config.ssh.breaker_failure_threshold = 3
+    config.ssh.breaker_cooldown_s = 30.0
+    config.ssh.breaker_cooldown_jitter = 0.1
+    config.ssh.breaker_half_open_probes = 1
+    for name in ("vm-0", "vm-1"):
+        config.hosts[name] = HostConfig(name=name, user="hive", backend="fake",
+                                        accelerator_type="v5litepod-8", chips=4)
+    set_config(config)
+
+    from tensorhive_tpu.db.engine import Engine, set_engine
+    from tensorhive_tpu.db.migrations import ensure_schema
+
+    engine = Engine(":memory:")
+    ensure_schema(engine)
+    set_engine(engine)
+
+    from tensorhive_tpu.core.managers.manager import TpuHiveManager, set_manager
+    from tensorhive_tpu.core.monitors.tpu import TpuMonitor
+    from tensorhive_tpu.core.nursery import set_ops_factory
+    from tensorhive_tpu.core.services.job_scheduling import JobSchedulingService
+    from tensorhive_tpu.core.transport.base import (
+        TransportManager,
+        register_backend,
+        set_transport_manager,
+    )
+    from tensorhive_tpu.core.transport.fake import (
+        FakeCluster,
+        FakeOpsFactory,
+        FakeTransport,
+        FaultPlan,
+    )
+    from tensorhive_tpu.core.transport.resilience import TransportResilience
+    from tensorhive_tpu.db.models.job import Job, JobStatus
+    from tensorhive_tpu.db.models.restriction import Restriction
+    from tensorhive_tpu.db.models.task import Task
+    from tensorhive_tpu.db.models.user import User
+    from tensorhive_tpu.observability import get_registry
+    from tensorhive_tpu.observability.alerts import AlertEngine, default_rule_pack
+    from tensorhive_tpu.utils.timeutils import utcnow
+
+    cluster = FakeCluster()
+    register_backend("fake", lambda host, user=None, config=None: FakeTransport(
+        host, cluster, user))
+    for name in config.hosts:
+        cluster.add_host(name, chips=4)
+    set_ops_factory(FakeOpsFactory(cluster))
+
+    clock = FakeClock()
+    resilience = TransportResilience(config, clock=clock, sleep=clock.sleep,
+                                     rng=random.Random(42))
+    transports = TransportManager(config, resilience=resilience)
+    set_transport_manager(transports)
+
+    manager = TpuHiveManager(config=config, transport_manager=transports,
+                             services=[])
+    manager.configure_services_from_config()
+    set_manager(manager)
+    infra = manager.infrastructure_manager
+    monitor = TpuMonitor(config)
+
+    engine_rules = AlertEngine(default_rule_pack(monitoring_interval_s=2.0))
+    notifications = []
+
+    def evaluate(now):
+        events = engine_rules.evaluate(now=now)
+        notifications.extend(events)
+        return events
+
+    def breaker_events(rule, to):
+        return [e for e in notifications if e["rule"] == rule and e["to"] == to]
+
+    def transitions(host, to):
+        family = get_registry().get("tpuhive_transport_breaker_transitions_total")
+        return family.labels(host=host, to=to).value
+
+    # a queued CPU-only job pinned to vm-0: the scheduling gate under test
+    from datetime import timedelta
+
+    Restriction(name="permissive", starts_at=utcnow() - timedelta(days=1),
+                is_global=True).save()
+    owner = User(username="alice", email="alice@example.com",
+                 password="SuperSecret42").save()
+    owner.add_role("user")
+    job = Job(name="chaos-job", user_id=owner.id).save()
+    Task(job_id=job.id, hostname="vm-0", command="python train.py").save()
+    job.enqueue()
+    scheduler = JobSchedulingService(config=config)
+    scheduler.inject(infra, transports)
+
+    from tensorhive_tpu.api.server import APIServer
+
+    server = APIServer()
+    server.config.api.url_hostname = "127.0.0.1"
+    server.config.api.url_port = 0                     # ephemeral
+    port = server.start()
+    base = f"http://127.0.0.1:{port}/api"
+    alert_now = 10_000.0
+    try:
+        # -- phase 1: healthy fleet ----------------------------------------
+        monitor.update(transports, infra)
+        check(infra.host_state("vm-0") == "ok", "vm-0 healthy after round 1")
+        status, _ = fetch(f"{base}/readyz")
+        check(status == 200, f"readyz is 200 on a healthy fleet (got {status})")
+        evaluate(alert_now)
+        check(not breaker_events("transport_breaker_open", "firing"),
+              "no breaker alert while healthy")
+
+        # -- phase 2: vm-0 dies --------------------------------------------
+        cluster.host("vm-0").reachable = False
+        monitor.update(transports, infra)              # 2 failures (attempt+retry)
+        check(resilience.breaker("vm-0").consecutive_failures == 2,
+              "round 1 against the dead host = attempt + one retry")
+        monitor.update(transports, infra)              # 3rd failure trips it
+        check(resilience.breaker("vm-0").state == "open",
+              "breaker opened after exactly 3 injected failures")
+        check(transports.open_circuit_hosts() == ["vm-0"],
+              "manager reports vm-0 open-circuit")
+
+        plan = cluster.set_fault_plan("vm-0", FaultPlan(seed=7))
+        results = transports.run_on_all("uname", timeout=5.0)
+        check("circuit open" in results["vm-0"].stderr
+              and not results["vm-0"].ok,
+              "run_on_all returns a synthetic circuit_open result")
+        check(plan.calls == 0, "open circuit: zero round-trips reached vm-0")
+        check(results["vm-1"].ok, "vm-1 unaffected by vm-0's breaker")
+
+        health = infra.host_health()["vm-0"]
+        check(health["state"] in ("degraded", "unreachable")
+              and health["staleness_s"] is not None,
+              f"infra retains last-known-good with staleness ({health['state']})")
+        check("TPU" in infra.infrastructure["vm-0"],
+              "last-known-good TPU subtree retained, not dropped")
+
+        scheduler.do_run()
+        check(Job.get(job.id).status is JobStatus.pending,
+              "queued job NOT spawned onto the open-circuit host")
+
+        status, body = fetch(f"{base}/readyz")
+        doc = json.loads(body)
+        check(status == 503, f"readyz is 503 while a breaker is open (got {status})")
+        check(any(c["component"] == "transport" and not c["ok"]
+                  and "vm-0" in c.get("reason", "")
+                  for c in doc.get("components", [])),
+              "readyz names vm-0 in the transport component")
+
+        _, scrape = fetch(f"{base}/metrics")
+        check('tpuhive_transport_breaker_state{host="vm-0"} 2' in scrape,
+              "breaker gauge exports open (2) for vm-0")
+
+        evaluate(alert_now + 5)
+        evaluate(alert_now + 10)                       # re-evaluate: no dupes
+        fired = breaker_events("transport_breaker_open", "firing")
+        check(len(fired) == 1,
+              f"transport_breaker_open fired exactly once (got {len(fired)})")
+
+        # -- phase 3: vm-0 revives ------------------------------------------
+        cluster.host("vm-0").reachable = True
+        cluster.set_fault_plan("vm-0", None)
+        clock.advance(34.0)                            # past cooldown + jitter
+        monitor.update(transports, infra)              # half-open probe closes it
+        check(resilience.breaker("vm-0").state == "closed",
+              "half-open probe restored the breaker to closed")
+        check(infra.host_state("vm-0") == "ok", "vm-0 healthy again in infra")
+
+        scheduler.do_run()
+        check(Job.get(job.id).status is JobStatus.running,
+              "queued job spawns once the host is back")
+
+        status, _ = fetch(f"{base}/readyz")
+        check(status == 200, f"readyz back to 200 after recovery (got {status})")
+
+        _, scrape = fetch(f"{base}/metrics")
+        check('tpuhive_transport_breaker_state{host="vm-0"} 0' in scrape,
+              "breaker gauge exports closed (0) after recovery")
+
+        evaluate(alert_now + 15)
+        evaluate(alert_now + 20)
+        resolved = breaker_events("transport_breaker_open", "resolved")
+        check(len(resolved) == 1,
+              f"transport_breaker_open resolved exactly once (got {len(resolved)})")
+
+        for to in ("open", "half_open", "closed"):
+            check(transitions("vm-0", to) == 1,
+                  f"breaker transition to={to} counted exactly once")
+    finally:
+        server.stop()
+        transports.close()
+        set_transport_manager(None)
+        set_manager(None)
+        set_ops_factory(None)
+
+    if PROBLEMS:
+        print(f"chaos-smoke: {len(PROBLEMS)} problem(s)", file=sys.stderr)
+        return 1
+    print("chaos-smoke: OK — breaker opened after N injected failures, "
+          "fan-out + scheduler skipped the host, readiness degraded and "
+          "recovered, alert fired/resolved exactly once")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
