@@ -2,7 +2,7 @@
 # mypy + flake8 per .circleci/config.yml:33-38): the dependency-free AST
 # lint + thivelint analyzer always run; mypy/ruff run when installed
 # (absent from this image).
-.PHONY: check lint analysis test bench probe metrics-smoke decode-smoke alerts-smoke chaos-smoke
+.PHONY: check lint analysis test bench probe metrics-smoke decode-smoke alerts-smoke chaos-smoke serving-smoke
 
 check: lint analysis
 	@command -v ruff >/dev/null 2>&1 && ruff check . || echo "ruff not installed; skipped (tools/lint.py covered the always-on subset)"
@@ -46,6 +46,13 @@ alerts-smoke:
 # fires/resolves exactly once; fake clock + seeded rng, zero real waiting
 chaos-smoke:
 	python tools/chaos_smoke.py
+
+# continuous-batching gateway on the CPU tiny model: >= 8 mixed-length
+# requests join/leave one running batch, zero decode recompiles after
+# warmup, batched throughput >= 2x the serial path, queue metrics present,
+# one admission rejection when over capacity (docs/SERVING.md)
+serving-smoke:
+	python tools/serving_smoke.py
 
 probe:
 	$(MAKE) -C tensorhive_tpu/native

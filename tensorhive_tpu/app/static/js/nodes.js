@@ -79,6 +79,7 @@ function toggleWatch(name, on) {
 function renderNodes(main) {
   main.innerHTML = `<div id="svc-health"></div>
     <div id="alert-strip"></div>
+    <div id="serving-strip"></div>
     <div class="card"><div class="row">
       <h3 style="margin:0">Watches</h3>
       ${["hbm", "duty", "procs"].map(name => `<label class="inline">
@@ -91,6 +92,7 @@ function renderNodes(main) {
   const refresh = async () => {
     try {
       if (isAdmin()) { refreshServiceHealth(); refreshAlerts(); }
+      refreshServing();
       const infra = await api("/nodes/metrics");
       for (const node of Object.values(infra)) {
         for (const [uid, chip] of Object.entries(node.TPU || {})) {
@@ -192,6 +194,67 @@ async function refreshAlerts() {
     <a class="ghost" href="/api/readyz" target="_blank"
        title="readiness probe (503 + reasons when degraded)">readyz</a>
   </div></div>`;
+}
+
+/* serving strip: continuous-batching gateway SLOs (GET /generate/stats) —
+   queue depth, slot occupancy, TTFT/inter-token percentiles, following the
+   alerts-strip pattern. Hidden quietly when serving is disabled (the stats
+   endpoint 503s with enabled=false). */
+function servingBadge(label, value, hot) {
+  return `<span class="badge ${hot ? "unsynchronized" : "on"}">
+    ${esc(label)} ${esc(value)}</span>`;
+}
+
+async function refreshServing() {
+  const el = document.getElementById("serving-strip");
+  if (!el) return;
+  let stats;
+  try { stats = await api("/generate/stats"); }
+  catch (e) { el.innerHTML = ""; return; }   // disabled (503) or unreachable
+  const ms = v => v == null ? "–" : v.toFixed(1) + "ms";
+  el.innerHTML = `<div class="card"><div class="row">
+    <h3 style="margin:0">Serving</h3>
+    ${servingBadge("queue", stats.queueDepth + "/" + stats.queueCapacity,
+                   stats.queueDepth >= stats.queueCapacity)}
+    ${servingBadge("slots", stats.slotsBusy + "/" + stats.slots,
+                   stats.slotsBusy >= stats.slots && stats.queueDepth > 0)}
+    ${servingBadge("TTFT p50/p95",
+                   ms(stats.ttftP50Ms) + " / " + ms(stats.ttftP95Ms), false)}
+    ${servingBadge("inter-token p50",
+                   ms(stats.intertokenP50Ms), false)}
+    <span class="muted">${stats.tokensEmitted} tokens ·
+      ${stats.requestsCompleted} requests</span>
+    <span style="flex:1"></span>
+    <button class="ghost" onclick="probeGenerate()"
+      title="stream a tiny generation through POST /generate">probe</button>
+  </div></div>`;
+}
+
+/* fire one small generation through the streaming endpoint and toast the
+   result — raw fetch (not api()): the response is chunked NDJSON, one JSON
+   object per line, which the JSON helper cannot parse */
+async function probeGenerate() {
+  try {
+    const resp = await fetch(API + "/generate", {
+      method: "POST",
+      headers: { "Content-Type": "application/json",
+                 Authorization: "Bearer " + state.access },
+      body: JSON.stringify({ promptTokens: [1, 2, 3, 4],
+                             maxNewTokens: 8, temperature: 0 }) });
+    if (resp.status === 429) {
+      return toast("serving saturated — retry after " +
+                   (resp.headers.get("Retry-After") || "?") + "s", true);
+    }
+    if (!resp.ok) {
+      const body = await resp.json().catch(() => ({}));
+      return toast(body.msg || resp.statusText, true);
+    }
+    const lines = (await resp.text()).trim().split("\n");
+    const last = JSON.parse(lines[lines.length - 1]);
+    if (last.error) return toast("generate: " + last.error, true);
+    toast(`generated ${last.tokens.length} tokens · TTFT ${last.ttftMs}ms`);
+    refreshServing();
+  } catch (e) { toast(e.message, true); }
 }
 
 /* recent-span dump from the ring-buffer tracer (GET /admin/traces) */

@@ -159,6 +159,34 @@ class AlertingConfig:
 
 
 @dataclasses.dataclass
+class GenerationConfig:
+    """Continuous-batching inference gateway (docs/SERVING.md; no reference
+    analog — the reference manages clusters, it serves no model traffic).
+
+    Disabled by default: enabling allocates a model + a
+    ``[layers, slots, max_len, kv_heads, d_head]`` KV cache at boot. The
+    slot pool size IS the decode batch size; ``queue_depth`` bounds the
+    admission queue (full = 429 + Retry-After). ``top_k``/``eos_token`` use
+    0/-1 as "unset" because TOML has no null."""
+    enabled: bool = False
+    preset: str = "tiny"
+    slots: int = 8
+    max_len: int = 0                 # 0 = the preset's max_seq_len
+    queue_depth: int = 32
+    max_new_tokens: int = 128        # per-request cap
+    top_k: int = 0                   # 0 = no top-k sampling filter
+    eos_token: int = -1              # -1 = no EOS, run to max_new_tokens
+    max_concurrent_per_user: int = 4  # 0 = unlimited
+    require_restriction: bool = True  # gate /generate on an active Restriction
+    use_flash: bool = True           # false: XLA reference attention prefill
+                                     # (runtimes without the pallas kernels)
+    interval_s: float = 0.02         # pump tick; do_run budgets inside it
+    stream_timeout_s: float = 30.0   # client-side max silent gap
+    ttft_slo_s: float = 2.0          # p95 budget the alert pack enforces
+    slot_leak_after_s: float = 60.0  # silent-busy-slot alert threshold
+
+
+@dataclasses.dataclass
 class SshConfig:
     """Control-plane transport settings (reference: tensorhive/config.py:113-120).
 
@@ -224,6 +252,7 @@ class Config:
     usage_logging: UsageLoggingConfig = dataclasses.field(default_factory=UsageLoggingConfig)
     job_scheduling: JobSchedulingConfig = dataclasses.field(default_factory=JobSchedulingConfig)
     alerting: AlertingConfig = dataclasses.field(default_factory=AlertingConfig)
+    generation: GenerationConfig = dataclasses.field(default_factory=GenerationConfig)
     ssh: SshConfig = dataclasses.field(default_factory=SshConfig)
     hosts: Dict[str, HostConfig] = dataclasses.field(default_factory=dict)
 
@@ -261,6 +290,7 @@ _SECTION_MAP = {
     "usage_logging_service": "usage_logging",
     "job_scheduling_service": "job_scheduling",
     "alerting_service": "alerting",
+    "generation_service": "generation",
     "ssh": "ssh",
 }
 
@@ -373,6 +403,18 @@ interval_s = 5.0
 # webhook_url = "https://hooks.example.com/tpuhive"
 # webhook_timeout_s = 5.0
 # webhook_retries = 2
+
+[generation_service]
+# continuous-batching inference gateway (docs/SERVING.md); enabling
+# allocates the model + slot-pool KV cache at boot
+enabled = false
+# preset = "tiny"
+# slots = 8
+# queue_depth = 32
+# max_new_tokens = 128
+# max_concurrent_per_user = 4
+# require_restriction = true
+# ttft_slo_s = 2.0
 
 [ssh]
 timeout_s = 10.0

@@ -1,0 +1,168 @@
+"""Generation gateway controller: streaming inference + SLO stats.
+
+The user-facing edge of the continuous-batching engine (docs/SERVING.md):
+
+* ``POST /generate`` — submit a prompt, stream tokens back as NDJSON chunks
+  (one JSON object per line) over a chunked response. Admission control is
+  explicit: a full queue or a per-user concurrency cap answers **429 with a
+  Retry-After header** (load is shed at the edge, never absorbed as
+  latency), and a user without an active Restriction covering any resource
+  is **403** — the same permission model that gates reservations gates
+  inference capacity (Tally-style: fairness enforced outside the model).
+* ``GET /generate/stats`` — queue/slot occupancy + TTFT/inter-token
+  percentiles for the dashboard serving strip.
+
+Serving disabled (no engine installed) answers 503 on both, so probes and
+the SPA can distinguish "off" from "broken".
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, Optional
+
+from werkzeug.wrappers import Response
+
+from ..api.app import RequestContext, json_body, route
+from ..api.schema import arr, obj, s
+from ..serving import AdmissionError, get_engine
+from ..utils.exceptions import ForbiddenError
+
+#: streaming media type: one JSON object per line, flushed per token
+NDJSON_CONTENT_TYPE = "application/x-ndjson"
+
+GENERATE_BODY = obj(
+    required=["promptTokens"],
+    promptTokens=arr(s("integer")),
+    maxNewTokens=s("integer"),
+    temperature=s("number"),
+)
+
+STATS_SCHEMA = obj(
+    required=["enabled"],
+    enabled=s("boolean"),
+    slots=s("integer"),
+    slotsBusy=s("integer"),
+    queueDepth=s("integer"),
+    queueCapacity=s("integer"),
+    maxSeqLen=s("integer"),
+    requestsCompleted=s("integer"),
+    tokensEmitted=s("integer"),
+    steps=s("integer"),
+    ttftP50Ms=s("number", nullable=True),
+    ttftP95Ms=s("number", nullable=True),
+    intertokenP50Ms=s("number", nullable=True),
+    intertokenP95Ms=s("number", nullable=True),
+)
+
+
+def _service_unavailable() -> Response:
+    return Response(
+        json.dumps({"msg": "generation serving is not enabled on this "
+                           "manager ([generation_service] in config.toml)"}),
+        status=503, content_type="application/json")
+
+
+def _rejection(exc: AdmissionError) -> Response:
+    """429 with an honest Retry-After (seconds, integral per RFC 9110)."""
+    response = Response(
+        json.dumps({"msg": str(exc),
+                    "retryAfterS": round(exc.retry_after_s, 1)}),
+        status=429, content_type="application/json")
+    response.headers["Retry-After"] = str(max(1, int(exc.retry_after_s)))
+    return response
+
+
+def _check_restriction_gate(context: RequestContext) -> None:
+    """Inference capacity rides the reservation permission model: a user
+    with no active Restriction (direct, via group, or global) may not pull
+    tokens from the shared slot pool. Admins bypass, as everywhere."""
+    from ..config import get_config
+
+    if not get_config().generation.require_restriction:
+        return
+    user = context.current_user()
+    if user.has_role("admin"):
+        return
+    if not any(r.is_active() for r in user.get_restrictions()):
+        raise ForbiddenError(
+            "no active restriction grants you generation capacity — ask an "
+            "admin to attach one (docs/SERVING.md)")
+
+
+@route("/generate", ["POST"], auth="jwt", tag="generate",
+       summary="Stream a model generation (NDJSON chunked response)",
+       body=GENERATE_BODY,
+       responses={200: s("string"),
+                  403: obj(required=["msg"], msg=s("string")),
+                  429: obj(required=["msg"], msg=s("string"),
+                           retryAfterS=s("number")),
+                  503: obj(required=["msg"], msg=s("string"))})
+def post_generate(context: RequestContext) -> Response:
+    """Submit one prompt to the continuous-batching engine and stream its
+    tokens. Response lines: ``{"token": n}`` per generated token, then one
+    ``{"done": true, "tokens": [...], "outcome": ..., "ttftMs": ...}``; a
+    mid-stream failure emits ``{"error": msg}`` as the final line."""
+    engine = get_engine()
+    if engine is None:
+        return _service_unavailable()
+    _check_restriction_gate(context)
+    body = json_body(context, "promptTokens")
+    prompt = body["promptTokens"]
+    max_new = int(body.get("maxNewTokens") or 16)
+    temperature = float(body.get("temperature") or 0.0)
+    from ..config import get_config
+
+    timeout_s = get_config().generation.stream_timeout_s
+    try:
+        # submit() validates prompt/length/temperature (ValueError -> 422
+        # via the standard mapping is NOT available here since ValueError
+        # isn't typed; map explicitly)
+        handle = engine.submit(prompt, max_new_tokens=max_new,
+                               temperature=temperature,
+                               user_key=str(context.user_id))
+    except AdmissionError as exc:
+        return _rejection(exc)
+    except ValueError as exc:
+        return Response(json.dumps({"msg": str(exc)}), status=422,
+                        content_type="application/json")
+
+    def stream():
+        try:
+            for token in handle.tokens(timeout_s=timeout_s):
+                yield json.dumps({"token": token}) + "\n"
+            summary = handle.result(timeout_s=timeout_s)
+            yield json.dumps({
+                "done": True,
+                "outcome": summary["outcome"],
+                "tokens": summary["tokens"],
+                "ttftMs": (round(summary["ttftS"] * 1e3, 3)
+                           if summary.get("ttftS") is not None else None),
+                "durationMs": round(summary["durationS"] * 1e3, 3),
+            }) + "\n"
+        except (TimeoutError, RuntimeError) as exc:
+            yield json.dumps({"error": str(exc)}) + "\n"
+        finally:
+            # a client that disconnects mid-stream must not leak its slot:
+            # generator close cancels the request (no-op when finished)
+            handle.cancel()
+
+    return Response(stream(), content_type=NDJSON_CONTENT_TYPE,
+                    headers={"X-Accel-Buffering": "no",
+                             "Cache-Control": "no-cache"})
+
+
+@route("/generate/stats", ["GET"], auth="jwt", tag="generate",
+       summary="Serving SLO snapshot (queue, slots, latency percentiles)",
+       responses={200: STATS_SCHEMA,
+                  503: obj(required=["enabled", "msg"],
+                           enabled=s("boolean"), msg=s("string"))})
+def get_generate_stats(context: RequestContext):
+    """Queue depth, slot occupancy and TTFT/inter-token p50/p95 — the same
+    numbers the ``generate_*`` alert rules and the dashboard strip read."""
+    engine = get_engine()
+    if engine is None:
+        return ({"enabled": False,
+                 "msg": "generation serving is not enabled"}, 503)
+    stats: Dict[str, Optional[float]] = {"enabled": True}
+    stats.update(engine.stats())
+    return stats

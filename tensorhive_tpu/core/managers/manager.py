@@ -92,7 +92,14 @@ def instantiate_services_from_config(config: Config) -> List[Service]:
         from ..services.job_scheduling import JobSchedulingService
 
         services.append(JobSchedulingService(config=config))
+    if config.generation.enabled:
+        from ..services.generation import GenerationService
+
+        services.append(GenerationService(config=config))
     if config.alerting.enabled:
+        # alerting starts LAST (start order == list order): its service_down
+        # rule has for_s=0, so every other daemon must be alive before the
+        # first evaluation tick or boot fires a false critical
         from ..services.alerting import AlertingService
 
         services.append(AlertingService(config=config))

@@ -1,0 +1,98 @@
+"""GenerationService: the pump thread of the continuous-batching gateway.
+
+Deliberately thin, like AlertingService: all scheduling/batching logic
+lives in :mod:`tensorhive_tpu.serving.engine` (deterministically testable
+with a fake clock); this daemon owns the *process lifecycle* — build the
+model + engine at boot, install it as the process-wide engine the API
+controller reads, and drive ``engine.pump`` every tick. Subclassing
+:class:`Service` buys the tick histogram, overrun counters and liveness
+stamps, so the serving loop is covered by the ``service_down`` rule and
+``/api/readyz`` like every other daemon.
+
+The tick body budgets itself inside the service interval (``pump`` takes a
+wall budget and re-checks ``self.stopped``): a saturated engine keeps a
+~90% duty cycle without tripping the tick-overrun alert on every tick, and
+shutdown never waits on a long generation.
+"""
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Optional
+
+from ...config import Config, get_config
+from .base import Service
+
+log = logging.getLogger(__name__)
+
+
+class GenerationService(Service):
+    def __init__(self, config: Optional[Config] = None,
+                 engine: Optional[object] = None) -> None:
+        config = config or get_config()
+        super().__init__(interval_s=config.generation.interval_s)
+        self.generation_config = config.generation
+        # ~90% duty cycle: pump inside the interval, leave a sliver for the
+        # run-loop's interruptible wait so stop() is honored promptly
+        self._pump_budget_s = max(0.001, self.interval_s * 0.9)
+        self.engine = engine if engine is not None else build_engine(config)
+        from ... import serving
+
+        serving.set_engine(self.engine)
+
+    def do_run(self) -> None:
+        self.engine.pump(budget_s=self._pump_budget_s,
+                         should_stop=lambda: self.stopped)
+
+    def shutdown(self) -> None:
+        # un-publish before stopping so the controller 503s new requests
+        # instead of queueing onto a pump that will never run again
+        from ... import serving
+
+        if serving.get_engine() is self.engine:
+            serving.set_engine(None)
+        super().shutdown()
+
+
+def build_engine(config: Config):
+    """Construct the slot engine from ``[generation_service]`` config and
+    warm its executables so the first request never pays a compile.
+
+    Imports jax lazily: processes with serving disabled must not pay model
+    stack import time (instantiate_services_from_config only calls this
+    when enabled)."""
+    import jax
+
+    from ...models.transformer import PRESETS, TransformerLM
+    from ...serving.engine import SlotEngine
+
+    generation = config.generation
+    if generation.preset not in PRESETS:
+        raise ValueError(
+            f"[generation_service] preset {generation.preset!r} unknown; "
+            f"choose from {sorted(PRESETS)}")
+    model_config = PRESETS[generation.preset]
+    max_len = generation.max_len or model_config.max_seq_len
+    model_config = dataclasses.replace(
+        model_config,
+        max_seq_len=max(max_len, model_config.max_seq_len),
+        use_flash=generation.use_flash)
+    # random init: the gateway serves whatever params the process holds —
+    # checkpoint loading is the job template / train_loop story, and the
+    # serving plane is checkpoint-agnostic by design
+    params = TransformerLM.init(jax.random.PRNGKey(0), model_config)
+    engine = SlotEngine(
+        params, model_config,
+        slots=generation.slots,
+        max_len=max_len,
+        queue_depth=generation.queue_depth,
+        top_k=generation.top_k or None,
+        eos_token=None if generation.eos_token < 0 else generation.eos_token,
+        max_new_tokens_cap=generation.max_new_tokens,
+        max_concurrent_per_user=generation.max_concurrent_per_user,
+    )
+    engine.warmup(prompt_lens=(16, max_len // 2))
+    log.info("generation engine ready: preset=%s slots=%d max_len=%d "
+             "queue_depth=%d", generation.preset, generation.slots, max_len,
+             generation.queue_depth)
+    return engine

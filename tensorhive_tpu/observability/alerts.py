@@ -413,6 +413,45 @@ def _stale_host_counter(stale_after_s: float) -> Callable[[], Optional[float]]:
     return _stale_host_count
 
 
+def _serving_queue_saturation() -> Optional[float]:
+    """Admission-queue fill fraction of the serving engine (None while no
+    engine is installed — serving disabled is not an alertable state)."""
+    from ..serving import get_engine
+
+    engine = get_engine()
+    if engine is None:
+        return None
+    return engine.queue_saturation()
+
+
+def _serving_ttft_p95() -> Optional[float]:
+    """p95 submit-to-first-token latency in seconds (None before the first
+    completed prefill — an idle gateway has no TTFT to breach)."""
+    from ..serving import get_engine
+
+    engine = get_engine()
+    if engine is None:
+        return None
+    return engine.ttft_p95_s()
+
+
+def _serving_stalled_slot_counter(
+        leak_after_s: float) -> Callable[[], Optional[float]]:
+    """Source callable: busy slots that have emitted nothing for
+    ``leak_after_s`` — occupancy that traffic cannot explain, i.e. a leaked
+    or wedged slot starving admission."""
+
+    def _stalled_slot_count() -> Optional[float]:
+        from ..serving import get_engine
+
+        engine = get_engine()
+        if engine is None:
+            return None
+        return float(engine.stalled_slots(leak_after_s))
+
+    return _stalled_slot_count
+
+
 def default_rule_pack(monitoring_interval_s: Optional[float] = None,
                       alert_interval_s: float = 5.0) -> List[AlertRule]:
     """The signals the registry already records (docs/OBSERVABILITY.md),
@@ -430,6 +469,18 @@ def default_rule_pack(monitoring_interval_s: Optional[float] = None,
                         "2s monitoring interval", exc_info=True)
             monitoring_interval_s = 2.0
     probe_stale_after = 3.0 * float(monitoring_interval_s)
+    try:
+        from ..config import get_config
+
+        generation = get_config().generation
+        ttft_slo_s = generation.ttft_slo_s
+        slot_leak_after_s = generation.slot_leak_after_s
+    except Exception:
+        # same fallback posture as the monitoring interval above: bare
+        # library use gets the shipped serving SLO defaults
+        log.warning("default_rule_pack: config unavailable, assuming "
+                    "2s TTFT SLO / 60s slot-leak threshold", exc_info=True)
+        ttft_slo_s, slot_leak_after_s = 2.0, 60.0
     return [
         AlertRule(
             name="service_down", severity="critical",
@@ -498,6 +549,30 @@ def default_rule_pack(monitoring_interval_s: Optional[float] = None,
             for_s=0.0,
             description="decode executables keep compiling — prompt shapes "
                         "are escaping the prefill buckets (docs/PERF.md)"),
+        AlertRule(
+            name="generate_queue_saturated", severity="warning",
+            kind="threshold", op=">=", threshold=1.0,
+            for_s=2 * alert_interval_s,
+            source=_serving_queue_saturation,
+            description="the serving admission queue has been full — new "
+                        "generation requests are being 429'd "
+                        "(docs/SERVING.md)"),
+        AlertRule(
+            name="generate_ttft_slo", severity="warning",
+            kind="threshold", op=">", threshold=ttft_slo_s,
+            for_s=2 * alert_interval_s,
+            source=_serving_ttft_p95,
+            description="p95 time-to-first-token is over the "
+                        "[generation_service] ttft_slo_s budget — prefill "
+                        "queueing is eating the latency SLO"),
+        AlertRule(
+            name="generate_slot_leak", severity="critical",
+            kind="threshold", op=">", threshold=0.0,
+            for_s=alert_interval_s,
+            source=_serving_stalled_slot_counter(slot_leak_after_s),
+            description="a busy serving slot has emitted nothing for "
+                        "slot_leak_after_s — occupancy without progress "
+                        "starves admission (docs/SERVING.md)"),
     ]
 
 

@@ -1,0 +1,65 @@
+"""Serving subsystem: continuous-batching inference over the decode fast
+path (docs/SERVING.md).
+
+This package root is deliberately jax-free: the API controller and the
+alert-rule sources import it on every boot, and they must not drag the
+model stack (jax + models/) into processes that never serve. The heavy
+engine lives in :mod:`tensorhive_tpu.serving.engine` and is imported only
+by whoever constructs one (GenerationService, tests, smokes, bench).
+
+The process-wide engine is set in ONE place (GenerationService boot, or a
+test/smoke harness) and read by the API controller and the alert-rule
+sources; ``get_engine`` never constructs — an unconfigured process simply
+has no serving plane, and the controller answers 503.
+"""
+from __future__ import annotations
+
+import threading
+from typing import TYPE_CHECKING, Optional
+
+if TYPE_CHECKING:  # pragma: no cover - typing only
+    from .engine import SlotEngine
+
+
+class AdmissionError(Exception):
+    """Base for load-shedding rejections; carries the Retry-After hint the
+    API layer surfaces on its 429 response."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0) -> None:
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+class QueueFullError(AdmissionError):
+    """Admission queue is at capacity — the API layer answers 429."""
+
+
+class RateLimitError(AdmissionError):
+    """Per-user concurrency cap exceeded — the API layer answers 429."""
+
+
+__all__ = [
+    "AdmissionError",
+    "QueueFullError",
+    "RateLimitError",
+    "get_engine",
+    "set_engine",
+]
+
+_engine: Optional["SlotEngine"] = None
+_engine_lock = threading.Lock()
+
+
+def get_engine() -> Optional["SlotEngine"]:
+    """The process-wide serving engine, or None when serving is disabled.
+    Never constructs (building an engine allocates model + cache buffers)."""
+    with _engine_lock:
+        return _engine
+
+
+def set_engine(engine: Optional["SlotEngine"]) -> None:
+    """Install (or with None: clear) the process-wide engine — called by
+    GenerationService at boot and by tests/smokes for isolation."""
+    global _engine
+    with _engine_lock:
+        _engine = engine

@@ -1,0 +1,674 @@
+"""Slot-based continuous-batching engine over the decode fast path.
+
+PR 3 built single-tenant decode primitives (donated in-place KV cache,
+bucketed prefill executables, ``lax.top_k`` sampling); this module turns
+them into the first user-facing data plane: many concurrent generation
+requests share ONE running decode batch on one chip, FlexNPU-style
+(PAPERS.md — dynamic prefill/decode co-location on a single accelerator).
+
+Design, in the order the constraints forced it:
+
+* **Fixed-capacity slot pool, one persistent cache.** The KV cache is a
+  single ``[layers, slots, max_len, kv_heads, d_head]`` buffer allocated
+  once; a request *joins* by prefilling its prompt into a free slot row and
+  *leaves* by having its slot freed on EOS/max-tokens. Batch shape never
+  changes, so the decode executable never recompiles.
+* **Per-slot state is traced, never static.** The fused step takes per-slot
+  token/position/active/temperature arrays as *operands*; joins and leaves
+  only flip mask entries host-side. ``tpuhive_decode_compile_total`` counts
+  ``serving_step``/``serving_prefill`` compiles so the zero-recompile
+  contract is observable (and gated by tools/serving_smoke.py).
+* **Prefill co-location.** Each scheduler iteration admits waiting requests
+  (bucketed prefill — power-of-two widths reuse PR 3's
+  ``_prefill_bucket``) and then advances the whole running batch one token,
+  interleaving prefill and decode work on the same chip instead of
+  dedicating it to either phase.
+* **Admission control at the edge.** The pending queue is bounded; a full
+  queue rejects at submit time (the API layer maps that to 429 +
+  Retry-After) rather than letting latency collapse for everyone already
+  admitted. Per-user concurrency caps ride the same path (Tally-style
+  non-intrusive fairness: the model itself is never preempted).
+* **Inactive slots are harmless by construction.** A parked slot keeps
+  stepping (masked) and writes garbage K/V at its frozen position; that is
+  safe because a joining sequence's own prefill/steps rewrite every
+  position it will ever attend to *before* attending to it (the attend
+  mask is ``<= position`` and each step writes its position first) — this
+  is what makes join/leave free of any cache scrubbing pass, and it is
+  pinned by test_serving.py::test_slot_reuse_matches_fresh_engine.
+
+SLO instrumentation (TTFT, inter-token latency, queue depth, slot
+occupancy, batch efficiency) lands in the PR 1 registry; docs/SERVING.md
+is the operator guide.
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import functools
+import queue as queue_module
+import threading
+import time
+from typing import Callable, Deque, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.decode import (
+    KVCache,
+    _count_compile,
+    _decode_attend,
+    _prefill_bucket,
+)
+from ..models.transformer import (
+    TransformerConfig,
+    TransformerLM,
+    _rmsnorm,
+)
+from ..observability import get_registry, Histogram
+from . import QueueFullError, RateLimitError
+
+# -- metrics (registered once at import; one exposition surface) -------------
+_REQUESTS = get_registry().counter(
+    "tpuhive_generate_requests_total",
+    "Generation requests by outcome: completed, rejected_queue, "
+    "rejected_ratelimit, cancelled, failed.",
+    labels=("outcome",))
+_TOKENS = get_registry().counter(
+    "tpuhive_generate_tokens_total",
+    "Tokens emitted by the serving engine across all requests.")
+_QUEUE_DEPTH = get_registry().gauge(
+    "tpuhive_generate_queue_depth",
+    "Requests waiting for a slot (admission queue occupancy).")
+_QUEUE_CAPACITY = get_registry().gauge(
+    "tpuhive_generate_queue_capacity",
+    "Bound of the admission queue — depth/capacity == 1 is saturation.")
+_SLOTS_BUSY = get_registry().gauge(
+    "tpuhive_generate_slots_busy",
+    "Slots currently occupied by a running sequence.")
+_SLOTS_TOTAL = get_registry().gauge(
+    "tpuhive_generate_slots_total",
+    "Slot-pool capacity (the fixed decode batch size).")
+_TTFT_SECONDS = get_registry().histogram(
+    "tpuhive_generate_ttft_seconds",
+    "Submit-to-first-token latency (queue wait + prefill + first step).")
+_INTERTOKEN_SECONDS = get_registry().histogram(
+    "tpuhive_generate_intertoken_seconds",
+    "Gap between consecutive emitted tokens of one sequence.")
+_BATCH_EFFICIENCY = get_registry().histogram(
+    "tpuhive_generate_batch_efficiency",
+    "Active slots / capacity per decode step (1.0 = perfectly packed).",
+    buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0))
+
+
+# -- device functions ---------------------------------------------------------
+#
+# Both are jitted with EVERYTHING shape-determining static (config, slot
+# count, cache length, bucket width, top_k) and all per-slot state traced:
+# one step executable for the engine's lifetime, one prefill executable per
+# prompt bucket. The cache is donated through both so the multi-hundred-MB
+# buffer aliases in place instead of being copied per token.
+
+def _step_body(params, tokens, positions, active, temps, cache, key,
+               config: TransformerConfig, top_k: Optional[int]):
+    """One fused decode step for the whole slot batch.
+
+    tokens/positions/active/temps are [S] per-slot operands; each active
+    slot consumes the token AT its own position and emits the token for
+    position+1. Per-slot cache writes are a vmapped dynamic_update_slice
+    (batched start indices lower to one scatter) into this layer's
+    [S, max_len, Hkv, Dh] page of the 5-D buffer — the attend math itself
+    is the SAME ``_decode_attend`` the single-tenant path uses (positions
+    broadcast per slot), so serving and ``decode.generate`` cannot drift.
+    """
+    dtype = config.dtype
+    x = params["tok_embed"].astype(dtype)[tokens][:, None, :]     # [S,1,D]
+    rope_positions = positions[:, None]                           # [S,1]
+    cache_k, cache_v = cache.k, cache.v
+
+    write = jax.vmap(
+        lambda row, update, position: jax.lax.dynamic_update_slice(
+            row, update, (position, 0, 0)))
+
+    def attend(q, k, v, layer):
+        nonlocal cache_k, cache_v
+        layer_k = write(cache_k[layer], k.astype(cache_k.dtype), positions)
+        layer_v = write(cache_v[layer], v.astype(cache_v.dtype), positions)
+        cache_k = jax.lax.dynamic_update_slice(
+            cache_k, layer_k[None], (layer, 0, 0, 0, 0))
+        cache_v = jax.lax.dynamic_update_slice(
+            cache_v, layer_v[None], (layer, 0, 0, 0, 0))
+        # per-slot causal mask: broadcastable positions [S,1,1,1,1] against
+        # the key iota inside _decode_attend
+        return _decode_attend(q, cache_k[layer], cache_v[layer],
+                              positions[:, None, None, None, None])
+
+    for layer_index, block in enumerate(params["blocks"]):
+        x = TransformerLM.block_forward(x, block, config, rope_positions,
+                                        attend, layer_index=layer_index)
+    x = _rmsnorm(x, params["final_norm"]["scale"])
+    logits = jnp.dot(x[:, 0].astype(dtype), params["w_lm_head"].astype(dtype),
+                     preferred_element_type=jnp.float32)           # [S,V]
+
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    safe_temps = jnp.where(temps > 0.0, temps, 1.0)
+    scaled = logits / safe_temps[:, None]
+    if top_k is not None:
+        kth = jax.lax.top_k(scaled, top_k)[0][:, -1][:, None]
+        scaled = jnp.where(scaled < kth, -jnp.inf, scaled)
+    key, sample_key = jax.random.split(key)
+    sampled = jax.random.categorical(sample_key, scaled, axis=-1)
+    chosen = jnp.where(temps > 0.0, sampled.astype(jnp.int32), greedy)
+    # inactive slots keep their frozen token so their (harmless) writes
+    # stay deterministic
+    chosen = jnp.where(active, chosen, tokens)
+    return chosen, KVCache(k=cache_k, v=cache_v), key
+
+
+_serving_step = functools.partial(
+    jax.jit, static_argnames=("config", "top_k"),
+    donate_argnames=("cache",))(_step_body)
+
+
+def _prefill_body(params, head, cache, slot, real_len,
+                  config: TransformerConfig):
+    """Prefill one joining sequence's prompt head into its slot row.
+
+    ``head`` is [1, W] with W a power-of-two bucket; ``real_len`` (traced)
+    zero-masks the padded K/V writes and ``slot`` (traced) selects the row,
+    so every prompt length in a bucket — in any slot — reuses ONE
+    executable. Mirrors models/decode.py::_prefill_body, with the write
+    offset at (layer, slot, 0, 0, 0) instead of a whole-batch write.
+    ``config.use_flash`` picks the attention impl like the training attend
+    does (runtimes without the pallas kernels serve via the XLA reference
+    path — identical math, tested exact in f32)."""
+    from ..models.transformer import flash_attention
+    from ..ops.flash_attention import reference_attention
+
+    dtype = config.dtype
+    batch, width = head.shape
+    x = params["tok_embed"].astype(dtype)[head]
+    positions = jnp.broadcast_to(jnp.arange(width, dtype=jnp.int32),
+                                 (batch, width))
+    valid = (jnp.arange(width, dtype=jnp.int32)
+             < real_len)[None, :, None, None]
+    cache_k, cache_v = cache.k, cache.v
+
+    def attend(q, k, v, layer):
+        nonlocal cache_k, cache_v
+        write_k = jnp.where(valid, k, 0).astype(cache_k.dtype)
+        write_v = jnp.where(valid, v, 0).astype(cache_v.dtype)
+        cache_k = jax.lax.dynamic_update_slice(
+            cache_k, write_k[None], (layer, slot, 0, 0, 0))
+        cache_v = jax.lax.dynamic_update_slice(
+            cache_v, write_v[None], (layer, slot, 0, 0, 0))
+        if config.use_flash:
+            return flash_attention(q, k, v, causal=True)
+        return reference_attention(q, k, v, causal=True)
+
+    for layer_index, block in enumerate(params["blocks"]):
+        x = TransformerLM.block_forward(x, block, config, positions, attend,
+                                        layer_index=layer_index)
+    return KVCache(k=cache_k, v=cache_v)
+
+
+_serving_prefill = functools.partial(
+    jax.jit, static_argnames=("config",),
+    donate_argnames=("cache",))(_prefill_body)
+
+
+# -- request plumbing ---------------------------------------------------------
+
+#: handle event kinds
+TOKEN, DONE, ERROR = "token", "done", "error"
+
+
+class GenerationHandle:
+    """Consumer side of one request: a bounded event stream plus final
+    summary. ``tokens()`` is what the streaming endpoint iterates."""
+
+    def __init__(self, engine: "SlotEngine", request: "_Request") -> None:
+        self._engine = engine
+        self._request = request
+        self._events: "queue_module.Queue[tuple]" = queue_module.Queue()
+        self._summary: Optional[Dict] = None
+
+    # -- engine side ------------------------------------------------------
+    def _push(self, kind: str, payload: object) -> None:
+        self._events.put((kind, payload))
+
+    # -- consumer side ----------------------------------------------------
+    def tokens(self, timeout_s: float = 30.0):
+        """Yield generated token ids as they are produced. Raises
+        ``TimeoutError`` if the engine produces nothing for ``timeout_s``
+        (a wedged pump must cost the client a bounded wait, never a hung
+        connection) and ``RuntimeError`` on engine-side failure."""
+        while True:
+            try:
+                kind, payload = self._events.get(timeout=timeout_s)
+            except queue_module.Empty:
+                self.cancel()
+                raise TimeoutError(
+                    f"no token within {timeout_s:.0f}s") from None
+            if kind == TOKEN:
+                yield payload
+            elif kind == DONE:
+                self._summary = payload
+                return
+            else:
+                raise RuntimeError(str(payload))
+
+    def result(self, timeout_s: float = 30.0) -> Dict:
+        """Drain the stream and return the completion summary."""
+        if self._summary is None:
+            for _ in self.tokens(timeout_s=timeout_s):
+                pass
+        assert self._summary is not None
+        return self._summary
+
+    def cancel(self) -> None:
+        """Mark the request cancelled; the engine frees its slot (or drops
+        it from the queue) at the next scheduler iteration."""
+        self._engine._cancel(self._request)
+
+    @property
+    def done(self) -> bool:
+        return self._request.finished
+
+
+@dataclasses.dataclass
+class _Request:
+    prompt: List[int]
+    max_new_tokens: int
+    temperature: float
+    user_key: Optional[str]
+    submitted_ts: float
+    handle: Optional[GenerationHandle] = None
+    generated: List[int] = dataclasses.field(default_factory=list)
+    first_token_ts: Optional[float] = None
+    last_token_ts: Optional[float] = None
+    cancelled: bool = False
+    finished: bool = False
+
+
+@dataclasses.dataclass
+class _Slot:
+    request: _Request
+    joined_ts: float
+
+
+class SlotEngine:
+    """The continuous-batching scheduler + device state.
+
+    Host-side bookkeeping (queue, slot table, per-user counts, metrics) is
+    guarded by one lock; device calls happen OUTSIDE the lock and only ever
+    from the single pump thread (GenerationService), so submitters are never
+    blocked behind a decode step.
+    """
+
+    def __init__(
+        self,
+        params,
+        config: TransformerConfig,
+        *,
+        slots: int = 8,
+        max_len: Optional[int] = None,
+        queue_depth: int = 32,
+        top_k: Optional[int] = None,
+        eos_token: Optional[int] = None,
+        max_new_tokens_cap: int = 512,
+        max_concurrent_per_user: int = 0,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        if not config.causal:
+            raise ValueError("serving needs an autoregressive model; this "
+                             "config is a bidirectional encoder")
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        if queue_depth < 1:
+            raise ValueError(f"queue_depth must be >= 1, got {queue_depth}")
+        if top_k is not None and not 0 < top_k <= config.vocab_size:
+            raise ValueError(
+                f"top_k must be in (0, {config.vocab_size}], got {top_k}")
+        self.params = params
+        self.config = config
+        self.capacity = int(slots)
+        self.max_len = int(max_len or config.max_seq_len)
+        self.queue_depth = int(queue_depth)
+        self.top_k = top_k
+        self.eos_token = eos_token
+        self.max_new_tokens_cap = int(max_new_tokens_cap)
+        self.max_concurrent_per_user = int(max_concurrent_per_user)
+        self.clock = clock
+
+        self._lock = threading.Lock()
+        self._pending: Deque[_Request] = collections.deque()
+        self._slots: List[Optional[_Slot]] = [None] * self.capacity
+        self._user_active: Dict[str, int] = {}
+        self.completed_requests = 0
+        self.emitted_tokens = 0
+        self.steps = 0
+        #: private latency views backing ``stats()`` p50/p95 (the registry
+        #: children are shared across engine instances in tests)
+        self._ttft_hist = Histogram()
+        self._intertoken_hist = Histogram()
+
+        # device state: one persistent cache + per-slot operand arrays
+        # (host numpy masters; tiny, shipped per step)
+        shape = (config.n_layers, self.capacity, self.max_len,
+                 config.kv_heads, config.d_head)
+        self._cache = KVCache(k=jnp.zeros(shape, config.dtype),
+                              v=jnp.zeros(shape, config.dtype))
+        self._tokens = np.zeros(self.capacity, np.int32)
+        self._positions = np.zeros(self.capacity, np.int32)
+        self._active = np.zeros(self.capacity, bool)
+        self._temps = np.zeros(self.capacity, np.float32)
+        self._key = jax.random.PRNGKey(0)
+
+        _QUEUE_CAPACITY.set(self.queue_depth)
+        _SLOTS_TOTAL.set(self.capacity)
+        _QUEUE_DEPTH.set(0)
+        _SLOTS_BUSY.set(0)
+
+    # -- admission --------------------------------------------------------
+    def submit(self, prompt: Sequence[int], max_new_tokens: int,
+               temperature: float = 0.0,
+               user_key: Optional[str] = None) -> GenerationHandle:
+        """Queue one request; raises ``ValueError`` on malformed input,
+        ``RateLimitError``/``QueueFullError`` on admission failure."""
+        prompt = [int(token) for token in prompt]
+        if not prompt:
+            raise ValueError("prompt must contain at least one token")
+        if any(not 0 <= t < self.config.vocab_size for t in prompt):
+            raise ValueError(
+                f"prompt tokens must be in [0, {self.config.vocab_size})")
+        if not 1 <= max_new_tokens <= self.max_new_tokens_cap:
+            raise ValueError(
+                f"max_new_tokens must be in [1, {self.max_new_tokens_cap}], "
+                f"got {max_new_tokens}")
+        if len(prompt) + max_new_tokens > self.max_len:
+            raise ValueError(
+                f"prompt+new = {len(prompt) + max_new_tokens} exceeds the "
+                f"engine sequence budget {self.max_len}")
+        if temperature < 0.0:
+            raise ValueError(f"temperature must be >= 0, got {temperature}")
+        request = _Request(prompt=prompt, max_new_tokens=int(max_new_tokens),
+                           temperature=float(temperature),
+                           user_key=str(user_key) if user_key else None,
+                           submitted_ts=self.clock())
+        handle = GenerationHandle(self, request)
+        request.handle = handle
+        with self._lock:
+            if (self.max_concurrent_per_user > 0 and request.user_key
+                    and self._user_active.get(request.user_key, 0)
+                    >= self.max_concurrent_per_user):
+                _REQUESTS.labels(outcome="rejected_ratelimit").inc()
+                raise RateLimitError(
+                    f"user has {self.max_concurrent_per_user} generation "
+                    "requests in flight; retry when one completes",
+                    retry_after_s=self._retry_after_locked())
+            if len(self._pending) >= self.queue_depth:
+                _REQUESTS.labels(outcome="rejected_queue").inc()
+                raise QueueFullError(
+                    f"admission queue is full ({self.queue_depth} waiting); "
+                    "retry shortly",
+                    retry_after_s=self._retry_after_locked())
+            if request.user_key:
+                self._user_active[request.user_key] = (
+                    self._user_active.get(request.user_key, 0) + 1)
+            self._pending.append(request)
+            _QUEUE_DEPTH.set(len(self._pending))
+        return handle
+
+    def _retry_after_locked(self) -> float:
+        """Honest Retry-After: time for the oldest running sequence to
+        finish at the observed inter-token rate (floor 1 s)."""
+        per_token = self._intertoken_hist.quantile(0.5) or 0.05
+        remaining = [
+            slot.request.max_new_tokens - len(slot.request.generated)
+            for slot in self._slots if slot is not None]
+        if not remaining:
+            return 1.0
+        return max(1.0, round(min(remaining) * per_token, 1))
+
+    def _cancel(self, request: _Request) -> None:
+        with self._lock:
+            if not request.finished:
+                request.cancelled = True
+
+    # -- scheduler --------------------------------------------------------
+    def has_work(self) -> bool:
+        with self._lock:
+            return bool(self._pending) or any(
+                slot is not None for slot in self._slots)
+
+    def step(self) -> int:
+        """One scheduler iteration: admit joins, then advance the running
+        batch one token. Returns the number of active slots stepped."""
+        self._admit()
+        return self._decode_step()
+
+    def pump(self, budget_s: Optional[float] = None,
+             should_stop: Optional[Callable[[], bool]] = None) -> int:
+        """Run scheduler iterations until idle, the wall budget is spent,
+        or ``should_stop()`` — the GenerationService tick body."""
+        deadline = None if budget_s is None else self.clock() + budget_s
+        steps = 0
+        while self.has_work():
+            if should_stop is not None and should_stop():
+                break
+            if deadline is not None and self.clock() >= deadline:
+                break
+            self.step()
+            steps += 1
+        return steps
+
+    def warmup(self, prompt_lens: Sequence[int] = ()) -> None:
+        """Compile the step executable and the prefill executable for each
+        bucket the given prompt lengths map to (plus the smallest bucket),
+        so steady-state traffic never pays a compile."""
+        buckets = {_prefill_bucket(max(1, length - 1), self.max_len - 1)
+                   for length in prompt_lens} or {
+                       _prefill_bucket(1, self.max_len - 1)}
+        for width in sorted(buckets):
+            head = jnp.zeros((1, width), jnp.int32)
+            self._count_prefill_compile(width)
+            self._cache = _serving_prefill(
+                self.params, head, self._cache, jnp.int32(0), jnp.int32(0),
+                self.config)
+        chosen, self._cache, self._key = self._run_step()
+        np.asarray(chosen)      # force the compile before traffic arrives
+
+    # -- internals --------------------------------------------------------
+    def _count_prefill_compile(self, width: int) -> None:
+        _count_compile("serving_prefill",
+                       ("serving_prefill", self.config, self.capacity,
+                        self.max_len, width))
+
+    def _run_step(self):
+        _count_compile("serving_step",
+                       ("serving_step", self.config, self.capacity,
+                        self.max_len, self.top_k))
+        return _serving_step(
+            self.params, jnp.asarray(self._tokens),
+            jnp.asarray(self._positions), jnp.asarray(self._active),
+            jnp.asarray(self._temps), self._cache, self._key,
+            config=self.config, top_k=self.top_k)
+
+    def _admit(self) -> int:
+        """Move pending requests into free slots (prefill co-located with
+        decode: every scheduler iteration does its joins first, then the
+        batch step — FlexNPU's dynamic phase mixing on one chip)."""
+        joined = 0
+        while True:
+            with self._lock:
+                self._drop_cancelled_pending_locked()
+                free = next((index for index, slot
+                             in enumerate(self._slots) if slot is None), None)
+                if free is None or not self._pending:
+                    _QUEUE_DEPTH.set(len(self._pending))
+                    return joined
+                request = self._pending.popleft()
+                self._slots[free] = _Slot(request=request,
+                                          joined_ts=self.clock())
+                _QUEUE_DEPTH.set(len(self._pending))
+                _SLOTS_BUSY.set(self._busy_locked())
+            self._join(free, request)
+            joined += 1
+
+    def _drop_cancelled_pending_locked(self) -> None:
+        kept: Deque[_Request] = collections.deque()
+        for request in self._pending:
+            if request.cancelled:
+                self._finish_locked(request, outcome="cancelled")
+            else:
+                kept.append(request)
+        self._pending = kept  # thive: disable=TH-C — caller holds the lock (_locked suffix)
+
+    def _join(self, slot: int, request: _Request) -> None:
+        """Prefill the prompt head into the slot row and arm the per-slot
+        operands; the first decode step after this emits the request's
+        first token."""
+        prompt = request.prompt
+        prompt_len = len(prompt)
+        if prompt_len > 1:
+            width = _prefill_bucket(prompt_len - 1, self.max_len - 1)
+            head = np.zeros((1, width), np.int32)
+            head[0, :prompt_len - 1] = prompt[:-1]
+            self._count_prefill_compile(width)
+            self._cache = _serving_prefill(
+                self.params, jnp.asarray(head), self._cache,
+                jnp.int32(slot), jnp.int32(prompt_len - 1), self.config)
+        with self._lock:
+            self._tokens[slot] = prompt[-1]
+            self._positions[slot] = prompt_len - 1
+            self._temps[slot] = request.temperature
+            self._active[slot] = True
+
+    def _decode_step(self) -> int:
+        with self._lock:
+            stepped = [(index, slot.request)
+                       for index, slot in enumerate(self._slots)
+                       if slot is not None]
+        if not stepped:
+            return 0
+        chosen, self._cache, self._key = self._run_step()
+        emitted = np.asarray(chosen)
+        now = self.clock()
+        with self._lock:
+            self.steps += 1
+            _BATCH_EFFICIENCY.observe(len(stepped) / self.capacity)
+            for index, request in stepped:
+                if self._slots[index] is None or (
+                        self._slots[index].request is not request):
+                    continue        # freed between snapshot and apply
+                token = int(emitted[index])
+                self._tokens[index] = token
+                self._positions[index] += 1
+                self._apply_token_locked(index, request, token, now)
+            _SLOTS_BUSY.set(self._busy_locked())
+        return len(stepped)
+
+    def _apply_token_locked(self, index: int, request: _Request,
+                            token: int, now: float) -> None:
+        if request.cancelled:
+            self._free_slot_locked(index)
+            self._finish_locked(request, outcome="cancelled")
+            return
+        request.generated.append(token)
+        self.emitted_tokens += 1
+        _TOKENS.inc()
+        if request.first_token_ts is None:
+            request.first_token_ts = now
+            ttft = now - request.submitted_ts
+            _TTFT_SECONDS.observe(ttft)
+            self._ttft_hist.observe(ttft)
+        else:
+            gap = now - (request.last_token_ts or now)
+            _INTERTOKEN_SECONDS.observe(gap)
+            self._intertoken_hist.observe(gap)
+        request.last_token_ts = now
+        if request.handle is not None:
+            request.handle._push(TOKEN, token)
+        hit_eos = (self.eos_token is not None and token == self.eos_token)
+        if hit_eos or len(request.generated) >= request.max_new_tokens:
+            self._free_slot_locked(index)
+            self._finish_locked(request, outcome="completed")
+
+    def _free_slot_locked(self, index: int) -> None:
+        self._slots[index] = None  # thive: disable=TH-C — caller holds the lock (_locked suffix)
+        self._active[index] = False  # thive: disable=TH-C — caller holds the lock (_locked suffix)
+        # position stays frozen: the parked slot's masked writes keep
+        # landing on one already-consumed coordinate (see module docstring)
+
+    def _finish_locked(self, request: _Request, outcome: str) -> None:
+        if request.finished:
+            return
+        request.finished = True
+        _REQUESTS.labels(outcome=outcome).inc()
+        if outcome == "completed":
+            self.completed_requests += 1
+        if request.user_key:
+            remaining = self._user_active.get(request.user_key, 1) - 1
+            if remaining <= 0:
+                self._user_active.pop(request.user_key, None)  # thive: disable=TH-C — caller holds the lock (_locked suffix)
+            else:
+                self._user_active[request.user_key] = remaining  # thive: disable=TH-C — caller holds the lock (_locked suffix)
+        if request.handle is not None:
+            now = self.clock()
+            request.handle._push(DONE, {
+                "tokens": list(request.generated),
+                "outcome": outcome,
+                "ttftS": (round(request.first_token_ts - request.submitted_ts,
+                                6)
+                          if request.first_token_ts is not None else None),
+                "durationS": round(now - request.submitted_ts, 6),
+            })
+
+    # -- introspection ----------------------------------------------------
+    def _busy_locked(self) -> int:
+        return sum(1 for slot in self._slots if slot is not None)
+
+    def stalled_slots(self, older_than_s: float) -> int:
+        """Busy slots that have not emitted a token for ``older_than_s`` —
+        the generate_slot_leak alert signal."""
+        now = self.clock()
+        with self._lock:
+            count = 0
+            for slot in self._slots:
+                if slot is None:
+                    continue
+                last = (slot.request.last_token_ts
+                        or slot.request.first_token_ts or slot.joined_ts)
+                if now - last > older_than_s:
+                    count += 1
+            return count
+
+    def stats(self) -> Dict:
+        """SLO snapshot for ``GET /api/generate/stats`` + the dashboard."""
+        def ms(value: Optional[float]) -> Optional[float]:
+            return None if value is None else round(value * 1e3, 3)
+
+        with self._lock:
+            busy = self._busy_locked()
+            return {
+                "slots": self.capacity,
+                "slotsBusy": busy,
+                "queueDepth": len(self._pending),
+                "queueCapacity": self.queue_depth,
+                "maxSeqLen": self.max_len,
+                "requestsCompleted": self.completed_requests,
+                "tokensEmitted": self.emitted_tokens,
+                "steps": self.steps,
+                "ttftP50Ms": ms(self._ttft_hist.quantile(0.5)),
+                "ttftP95Ms": ms(self._ttft_hist.quantile(0.95)),
+                "intertokenP50Ms": ms(self._intertoken_hist.quantile(0.5)),
+                "intertokenP95Ms": ms(self._intertoken_hist.quantile(0.95)),
+            }
+
+    def ttft_p95_s(self) -> Optional[float]:
+        return self._ttft_hist.quantile(0.95)
+
+    def queue_saturation(self) -> float:
+        with self._lock:
+            return len(self._pending) / self.queue_depth

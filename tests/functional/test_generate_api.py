@@ -1,0 +1,188 @@
+"""POST /api/generate end to end: real WSGI app, real JWTs, real engine,
+a live pump thread — the streaming NDJSON contract, admission control
+(429 + Retry-After), the Restriction capacity gate, and the stats
+endpoint the dashboard serving strip reads."""
+import dataclasses
+import json
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from werkzeug.test import Client
+
+from tensorhive_tpu.api.server import ApiApp
+from tensorhive_tpu.models import decode
+from tensorhive_tpu.models.transformer import PRESETS, TransformerLM
+from tensorhive_tpu.serving import set_engine
+from tensorhive_tpu.serving.engine import SlotEngine
+from tests.fixtures import make_permissive_restriction, make_user
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device CPU platform"
+)
+
+F32_TINY = dataclasses.replace(PRESETS["tiny"], dtype=jnp.float32,
+                               use_flash=False, remat=False, max_seq_len=128)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return TransformerLM.init(jax.random.PRNGKey(0), F32_TINY)
+
+
+@pytest.fixture()
+def engine(params):
+    engine = SlotEngine(params, F32_TINY, slots=2, max_len=96,
+                        queue_depth=2, max_new_tokens_cap=32,
+                        max_concurrent_per_user=1)
+    set_engine(engine)
+    yield engine
+    set_engine(None)
+
+
+@pytest.fixture()
+def pump(engine):
+    """Background scheduler standing in for GenerationService: the handler
+    generator blocks on the token stream, so someone else must step."""
+    running = threading.Event()
+    running.set()
+
+    def loop():
+        while running.is_set():
+            if engine.has_work():
+                engine.step()
+            else:
+                time.sleep(0.001)
+
+    thread = threading.Thread(target=loop, daemon=True)
+    thread.start()
+    yield running
+    running.clear()
+    thread.join(timeout=5)
+
+
+@pytest.fixture()
+def api(db, config, engine):
+    config.api.secret_key = "test-secret"
+    config.generation.stream_timeout_s = 10.0
+    return Client(ApiApp(url_prefix="api"))
+
+
+@pytest.fixture()
+def user_headers(api, db):
+    user = make_user(username="alice", password="SuperSecret42")
+    make_permissive_restriction(user)
+    return _login(api, "alice")
+
+
+@pytest.fixture()
+def admin_headers(api, db):
+    make_user(username="root1", password="SuperSecret42", admin=True)
+    return _login(api, "root1")
+
+
+def _login(api, username):
+    response = api.post("/api/user/login", json={
+        "username": username, "password": "SuperSecret42"})
+    assert response.status_code == 200, response.get_data(as_text=True)
+    token = response.get_json()["accessToken"]
+    return {"Authorization": f"Bearer {token}"}
+
+
+def _stream_lines(response):
+    lines = response.get_data(as_text=True).strip().splitlines()
+    return [json.loads(line) for line in lines]
+
+
+def test_generate_streams_ndjson_matching_reference(api, pump, user_headers,
+                                                    params):
+    prompt = list(range(3, 11))
+    response = api.post("/api/generate", headers=user_headers, json={
+        "promptTokens": prompt, "maxNewTokens": 5, "temperature": 0})
+    assert response.status_code == 200, response.get_data(as_text=True)
+    assert response.content_type == "application/x-ndjson"
+    lines = _stream_lines(response)
+    tokens = [line["token"] for line in lines[:-1]]
+    done = lines[-1]
+    assert done["done"] is True
+    assert done["outcome"] == "completed"
+    assert done["tokens"] == tokens
+    assert done["ttftMs"] is not None and done["durationMs"] is not None
+    reference = decode.generate(params, F32_TINY,
+                                jnp.asarray([prompt], jnp.int32),
+                                max_new_tokens=5, temperature=0.0)
+    assert tokens == np.asarray(reference)[0, len(prompt):].tolist()
+
+
+def test_generate_requires_active_restriction(api, pump, db, admin_headers):
+    # a user with NO restriction: capacity denied with the reason named
+    make_user(username="bob", password="SuperSecret42")
+    bob = _login(api, "bob")
+    response = api.post("/api/generate", headers=bob, json={
+        "promptTokens": [1, 2, 3], "maxNewTokens": 2})
+    assert response.status_code == 403
+    assert "restriction" in response.get_json()["msg"]
+    # admins bypass the gate (same posture as reservations)
+    response = api.post("/api/generate", headers=admin_headers, json={
+        "promptTokens": [1, 2, 3], "maxNewTokens": 2})
+    assert response.status_code == 200
+    assert _stream_lines(response)[-1]["outcome"] == "completed"
+
+
+def test_generate_queue_full_answers_429_with_retry_after(api, engine,
+                                                          user_headers):
+    # no pump running: park the queue at capacity directly at the engine
+    for _ in range(engine.queue_depth):
+        engine.submit([1, 2, 3], max_new_tokens=4)
+    response = api.post("/api/generate", headers=user_headers, json={
+        "promptTokens": [1, 2, 3], "maxNewTokens": 2})
+    assert response.status_code == 429
+    assert int(response.headers["Retry-After"]) >= 1
+    assert response.get_json()["retryAfterS"] >= 1.0
+
+
+def test_generate_per_user_rate_limit_429(api, engine, db, user_headers):
+    from tensorhive_tpu.db.models.user import User
+
+    user = User.where("username = ?", ["alice"])[0]
+    engine.submit([1, 2, 3], max_new_tokens=4, user_key=str(user.id))
+    response = api.post("/api/generate", headers=user_headers, json={
+        "promptTokens": [1, 2, 3], "maxNewTokens": 2})
+    assert response.status_code == 429
+    assert "in flight" in response.get_json()["msg"]
+
+
+def test_generate_validation_422(api, pump, user_headers):
+    response = api.post("/api/generate", headers=user_headers, json={
+        "promptTokens": [F32_TINY.vocab_size + 5], "maxNewTokens": 2})
+    assert response.status_code == 422
+    response = api.post("/api/generate", headers=user_headers, json={
+        "promptTokens": []})
+    assert response.status_code == 422
+
+
+def test_generate_stats_snapshot(api, pump, user_headers):
+    response = api.post("/api/generate", headers=user_headers, json={
+        "promptTokens": [1, 2, 3, 4], "maxNewTokens": 3})
+    assert response.status_code == 200
+    assert _stream_lines(response)[-1]["outcome"] == "completed"
+    stats = api.get("/api/generate/stats", headers=user_headers)
+    assert stats.status_code == 200
+    doc = stats.get_json()
+    assert doc["enabled"] is True
+    assert doc["slots"] == 2 and doc["queueCapacity"] == 2
+    assert doc["tokensEmitted"] >= 3
+    assert doc["ttftP50Ms"] is not None
+
+
+def test_generate_disabled_answers_503(api, user_headers):
+    set_engine(None)
+    response = api.post("/api/generate", headers=user_headers, json={
+        "promptTokens": [1, 2, 3], "maxNewTokens": 2})
+    assert response.status_code == 503
+    stats = api.get("/api/generate/stats", headers=user_headers)
+    assert stats.status_code == 503
+    assert stats.get_json()["enabled"] is False

@@ -71,6 +71,8 @@ UI_CALLS = {
     ("GET", "/nodes/<hostname>/cpu/metrics"):
         "`/nodes/${encodeURIComponent(host)}/cpu/metrics`",
     ("GET", "/admin/services"): 'api("/admin/services")',
+    ("GET", "/generate/stats"): 'api("/generate/stats")',
+    ("POST", "/generate"): 'fetch(API + "/generate"',
     ("GET", "/admin/traces"): 'api("/admin/traces',
     ("GET", "/admin/alerts"): 'api("/admin/alerts")',
     ("GET", "/metrics"): 'href="/api/metrics"',

@@ -184,6 +184,40 @@ def test_probe_backend_failing_cmd_returns_none():
     assert result is None
 
 
+def test_probe_backend_reattaches_after_transient_failure(tmp_path):
+    """The r03/r05 flake shape: the first connect dies, the reattach a
+    moment later succeeds — one probe attempt must not be the verdict."""
+    marker = tmp_path / "attempts"
+    script = (
+        "import pathlib, sys\n"
+        f"p = pathlib.Path({str(marker)!r})\n"
+        "n = int(p.read_text()) if p.exists() else 0\n"
+        "p.write_text(str(n + 1))\n"
+        "if n < 1:\n"
+        "    sys.exit(1)\n"
+        "print('BACKEND=cpu')\n")
+    result = bench.probe_backend(
+        timeout_s=30.0, cmd=[sys.executable, "-c", script],
+        attempts=3, backoff_base_s=0.0)
+    assert result == "cpu"
+    assert marker.read_text() == "2"            # failed once, reattached once
+
+
+def test_probe_backend_gives_up_after_attempt_budget(tmp_path):
+    marker = tmp_path / "attempts"
+    script = (
+        "import pathlib, sys\n"
+        f"p = pathlib.Path({str(marker)!r})\n"
+        "n = int(p.read_text()) if p.exists() else 0\n"
+        "p.write_text(str(n + 1))\n"
+        "sys.exit(1)\n")
+    result = bench.probe_backend(
+        timeout_s=30.0, cmd=[sys.executable, "-c", script],
+        attempts=2, backoff_base_s=0.0)
+    assert result is None
+    assert marker.read_text() == "2"            # exactly the attempt budget
+
+
 @pytest.fixture(scope="module")
 def native_probe_built():
     """Build the native telemetry probe once so subprocess bench runs don't

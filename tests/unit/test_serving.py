@@ -1,0 +1,332 @@
+"""Slot-engine unit tests: continuous batching must be *exactly* the
+single-tenant decode path, just multiplexed.
+
+Everything host-side runs on a fake clock (submit/step/stall timestamps are
+injected), so SLO bookkeeping is asserted deterministically; everything
+device-side is pinned against `decode.generate` in f32 — a slot is not
+allowed to be "approximately" a fresh cache.
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorhive_tpu.models import decode
+from tensorhive_tpu.models.transformer import PRESETS, TransformerLM
+from tensorhive_tpu.serving import (
+    QueueFullError,
+    RateLimitError,
+    get_engine,
+    set_engine,
+)
+from tensorhive_tpu.serving.engine import (
+    SlotEngine,
+    _serving_prefill,
+    _serving_step,
+)
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device CPU platform"
+)
+
+F32_TINY = dataclasses.replace(PRESETS["tiny"], dtype=jnp.float32,
+                               use_flash=False, remat=False, max_seq_len=128)
+
+
+class FakeClock:
+    def __init__(self, start: float = 1000.0) -> None:
+        self.now = start
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, seconds: float) -> None:
+        self.now += seconds
+
+
+@pytest.fixture(scope="module")
+def params():
+    return TransformerLM.init(jax.random.PRNGKey(0), F32_TINY)
+
+
+def make_engine(params, clock=None, **kwargs):
+    kwargs.setdefault("slots", 4)
+    kwargs.setdefault("max_len", 96)
+    kwargs.setdefault("queue_depth", 8)
+    return SlotEngine(params, F32_TINY, clock=clock or FakeClock(),
+                      **kwargs)
+
+
+def drain(engine):
+    while engine.has_work():
+        engine.step()
+
+
+def reference_tokens(params, prompt, new_tokens):
+    out = decode.generate(params, F32_TINY,
+                          jnp.asarray([prompt], jnp.int32),
+                          max_new_tokens=new_tokens, temperature=0.0)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+# -- exactness ---------------------------------------------------------------
+
+def test_join_leave_mid_batch_matches_generate_exactly(params):
+    """Requests joining a batch that is already decoding (and leaving it at
+    different times) must each produce the SAME tokens as the single-tenant
+    `decode.generate` on a fresh cache — greedy, f32, exact. This is the
+    whole isolation contract of the slot pool."""
+    engine = make_engine(params)
+    prompts = [list(range(3, 11)),          # len 8  -> bucket 16
+               [5],                         # len 1  -> no prefill
+               list(range(1, 21)),          # len 20 -> bucket 32
+               list(range(2, 14))]          # len 12 -> bucket 16
+    news = [6, 9, 4, 7]                     # leave at different steps
+    handles = []
+    for prompt, new in zip(prompts, news):
+        handles.append(engine.submit(prompt, max_new_tokens=new))
+        engine.step()                        # join mid-batch, not en masse
+    drain(engine)
+    for prompt, new, handle in zip(prompts, news, handles):
+        summary = handle.result(timeout_s=5)
+        assert summary["outcome"] == "completed"
+        assert summary["tokens"] == reference_tokens(params, prompt, new)
+
+
+def test_slot_reuse_matches_fresh_engine(params):
+    """A sequence decoded in a REUSED slot (previous occupant's K/V still
+    parked beyond its positions) must equal the same sequence on a fresh
+    engine bit-for-bit — the parked-garbage-is-unreachable argument in the
+    engine docstring, executed."""
+    first = list(range(1, 41))               # long: fills positions 0..40+
+    second = [9, 8, 7, 6, 5]                 # short: reuses the same slot
+    reused = make_engine(params, slots=1)
+    reused.submit(first, max_new_tokens=8)
+    drain(reused)
+    handle = reused.submit(second, max_new_tokens=8)
+    drain(reused)
+    fresh = make_engine(params, slots=1)
+    fresh_handle = fresh.submit(second, max_new_tokens=8)
+    drain(fresh)
+    assert (handle.result(timeout_s=5)["tokens"]
+            == fresh_handle.result(timeout_s=5)["tokens"]
+            == reference_tokens(params, second, 8))
+
+
+# -- compile discipline ------------------------------------------------------
+
+def test_zero_recompiles_across_mixed_length_joins(params):
+    """After warmup, mixed prompt lengths (across buckets), mixed
+    temperatures and every slot position must all reuse the SAME
+    executables: one step executable, one prefill executable per bucket.
+    The jit cache size is the ground truth the smoke gate also uses."""
+    engine = make_engine(params)
+    lens = (8, 20, 28, 40, 1, 56)
+    engine.warmup(prompt_lens=lens)
+    step_execs = _serving_step._cache_size()
+    prefill_execs = _serving_prefill._cache_size()
+    handles = []
+    for index, plen in enumerate(lens):
+        prompt = [(3 * index + j) % F32_TINY.vocab_size or 1
+                  for j in range(plen)]
+        handles.append(engine.submit(
+            prompt, max_new_tokens=5,
+            temperature=0.0 if index % 2 == 0 else 0.7))
+        engine.step()
+    drain(engine)
+    assert all(h.result(timeout_s=5)["outcome"] == "completed"
+               for h in handles)
+    assert _serving_step._cache_size() == step_execs
+    assert _serving_prefill._cache_size() == prefill_execs
+
+
+# -- admission control -------------------------------------------------------
+
+def test_queue_full_rejects_with_retry_after(params):
+    engine = make_engine(params, slots=1, queue_depth=2)
+    engine.submit([1, 2, 3], max_new_tokens=4)
+    engine.submit([1, 2, 3], max_new_tokens=4)
+    with pytest.raises(QueueFullError) as excinfo:
+        engine.submit([1, 2, 3], max_new_tokens=4)
+    assert excinfo.value.retry_after_s >= 1.0
+    drain(engine)                            # the admitted two still finish
+
+
+def test_per_user_rate_limit(params):
+    engine = make_engine(params, max_concurrent_per_user=1)
+    engine.submit([1, 2, 3], max_new_tokens=4, user_key="7")
+    with pytest.raises(RateLimitError):
+        engine.submit([4, 5, 6], max_new_tokens=4, user_key="7")
+    engine.submit([4, 5, 6], max_new_tokens=4, user_key="8")  # other user ok
+    drain(engine)
+    # capacity returns once the first request completes
+    engine.submit([4, 5, 6], max_new_tokens=4, user_key="7")
+    drain(engine)
+
+
+def test_submit_validation(params):
+    engine = make_engine(params)
+    with pytest.raises(ValueError):
+        engine.submit([], max_new_tokens=4)
+    with pytest.raises(ValueError):
+        engine.submit([F32_TINY.vocab_size], max_new_tokens=4)
+    with pytest.raises(ValueError):
+        engine.submit([1], max_new_tokens=0)
+    with pytest.raises(ValueError):
+        engine.submit([1] * 95, max_new_tokens=10)   # over max_len budget
+    with pytest.raises(ValueError):
+        engine.submit([1], max_new_tokens=4, temperature=-0.1)
+
+
+# -- lifecycle ---------------------------------------------------------------
+
+def test_eos_frees_slot_early(params):
+    prompt = list(range(3, 11))
+    eos = reference_tokens(params, prompt, 3)[1]    # greedy token #2
+    engine = make_engine(params, eos_token=eos)
+    handle = engine.submit(prompt, max_new_tokens=50)
+    drain(engine)
+    summary = handle.result(timeout_s=5)
+    assert summary["outcome"] == "completed"
+    assert summary["tokens"][-1] == eos
+    assert len(summary["tokens"]) == 2               # stopped at EOS
+    assert engine.stats()["slotsBusy"] == 0
+
+
+def test_cancel_frees_slot(params):
+    engine = make_engine(params, slots=1)
+    handle = engine.submit([1, 2, 3, 4], max_new_tokens=50)
+    engine.step()
+    engine.step()
+    handle.cancel()
+    engine.step()
+    assert engine.stats()["slotsBusy"] == 0
+    assert handle.result(timeout_s=5)["outcome"] == "cancelled"
+    # the freed slot is immediately reusable, and clean
+    follow_up = engine.submit([9, 8, 7], max_new_tokens=4)
+    drain(engine)
+    assert (follow_up.result(timeout_s=5)["tokens"]
+            == reference_tokens(params, [9, 8, 7], 4))
+
+
+# -- fake-clock SLO bookkeeping ----------------------------------------------
+
+def test_ttft_and_intertoken_on_fake_clock(params):
+    clock = FakeClock()
+    engine = make_engine(params, clock=clock)
+    handle = engine.submit([1, 2, 3, 4], max_new_tokens=3)
+    clock.advance(0.5)                       # queue wait + prefill
+    engine.step()                            # first token at +0.5s
+    clock.advance(0.25)
+    engine.step()                            # second token: 0.25s gap
+    clock.advance(0.25)
+    engine.step()
+    assert handle.result(timeout_s=5)["ttftS"] == pytest.approx(0.5)
+    # the histogram p50 is a within-bucket interpolation clamped to the
+    # observed max, so assert the containing bucket, not the exact value
+    stats = engine.stats()
+    assert 250.0 < stats["ttftP50Ms"] <= 500.0
+    assert 100.0 < stats["intertokenP50Ms"] <= 250.0
+
+
+def test_stalled_slots_and_queue_saturation(params):
+    clock = FakeClock()
+    engine = make_engine(params, slots=1, queue_depth=2, clock=clock)
+    engine.submit([1, 2, 3], max_new_tokens=50)
+    engine.step()                            # busy, has emitted one token
+    assert engine.stalled_slots(60.0) == 0
+    clock.advance(120.0)                     # ...then silence
+    assert engine.stalled_slots(60.0) == 1
+    engine.submit([1, 2], max_new_tokens=4)
+    engine.submit([1, 2], max_new_tokens=4)
+    assert engine.queue_saturation() == pytest.approx(1.0)
+    drain(engine)
+    assert engine.queue_saturation() == 0.0
+    assert engine.stalled_slots(60.0) == 0
+
+
+# -- alert-rule sources ------------------------------------------------------
+
+def test_alert_sources_read_the_process_engine(params, config):
+    from tensorhive_tpu.observability.alerts import (
+        _serving_queue_saturation,
+        _serving_stalled_slot_counter,
+        _serving_ttft_p95,
+    )
+
+    set_engine(None)
+    assert _serving_queue_saturation() is None       # disabled: no signal
+    assert _serving_ttft_p95() is None
+    assert _serving_stalled_slot_counter(60.0)() is None
+
+    clock = FakeClock()
+    engine = make_engine(params, slots=1, queue_depth=2, clock=clock)
+    set_engine(engine)
+    try:
+        assert get_engine() is engine
+        assert _serving_queue_saturation() == 0.0
+        assert _serving_ttft_p95() is None           # idle: no TTFT yet
+        engine.submit([1, 2, 3], max_new_tokens=50)
+        engine.step()
+        assert _serving_ttft_p95() is not None
+        clock.advance(120.0)
+        assert _serving_stalled_slot_counter(60.0)() == 1.0
+        engine.submit([1, 2], max_new_tokens=4)
+        engine.submit([1, 2], max_new_tokens=4)
+        assert _serving_queue_saturation() == pytest.approx(1.0)
+    finally:
+        set_engine(None)
+
+
+def test_default_rule_pack_gains_serving_rules(config):
+    from tensorhive_tpu.observability.alerts import default_rule_pack
+
+    rules = {rule.name: rule for rule in default_rule_pack()}
+    assert {"generate_queue_saturated", "generate_ttft_slo",
+            "generate_slot_leak"} <= set(rules)
+    assert rules["generate_ttft_slo"].threshold == pytest.approx(
+        config.generation.ttft_slo_s)
+    assert rules["generate_slot_leak"].severity == "critical"
+
+
+# -- GenerationService wiring ------------------------------------------------
+
+def test_generation_service_pumps_and_publishes_engine(params, config):
+    from tensorhive_tpu.core.services.generation import GenerationService
+
+    config.generation.interval_s = 0.05
+    engine = make_engine(params)
+    service = GenerationService(config=config, engine=engine)
+    try:
+        assert get_engine() is engine        # published at construction
+        handle = engine.submit([1, 2, 3, 4], max_new_tokens=4)
+        service.do_run()                     # one tick drains the request
+        assert handle.result(timeout_s=5)["outcome"] == "completed"
+    finally:
+        service.shutdown()
+    assert get_engine() is None              # shutdown un-publishes
+
+
+def test_generation_service_enabled_via_config(config, db):
+    from tensorhive_tpu.core.managers.manager import (
+        instantiate_services_from_config,
+    )
+    from tensorhive_tpu.core.services.generation import GenerationService
+
+    names = [type(s).__name__
+             for s in instantiate_services_from_config(config)]
+    assert "GenerationService" not in names  # disabled by default
+    config.generation.enabled = True
+    config.generation.slots = 2
+    config.generation.max_len = 64
+    services = [s for s in instantiate_services_from_config(config)
+                if isinstance(s, GenerationService)]
+    try:
+        assert len(services) == 1            # built a real engine from toml
+        assert services[0].engine.capacity == 2
+        assert get_engine() is services[0].engine
+    finally:
+        for service in services:
+            service.shutdown()
