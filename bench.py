@@ -354,11 +354,18 @@ def bench_generate():
 def bench_generate_serving():
     """Continuous-batching gateway numbers (tensorhive_tpu/serving): batched
     throughput of a full slot pool vs the serial single-request path through
-    the SAME engine, plus the zero-recompile verdict. This is the number the
-    multi-tenant north star is measured through (docs/SERVING.md)."""
+    the SAME engine, plus a ``paged_vs_contiguous`` comparison — tokens/s,
+    max concurrent sequences at equal cache HBM, and the zero-recompile
+    verdict for the paged executables. This is the number the multi-tenant
+    north star is measured through (docs/SERVING.md).
+
+    The section dict is installed into ``_state`` UP FRONT and mutated in
+    place, so a backend death mid-section (the BENCH r03-r05
+    flight-blindness pattern) still leaves every sub-result measured so far
+    in the emitted artifact instead of a bare null."""
     import jax
     from tensorhive_tpu.models.transformer import PRESETS, TransformerLM
-    from tensorhive_tpu.serving.engine import SlotEngine, _serving_step
+    from tensorhive_tpu.serving.engine import SlotEngine
 
     if jax.default_backend() == "tpu":
         preset, slots, new_tokens = "t2t-base", 8, 64
@@ -366,51 +373,116 @@ def bench_generate_serving():
     else:
         preset, slots, new_tokens = "tiny", 8, 16
         prompt_lens = (20, 28, 40, 56, 20, 28, 40, 56)
+    page_size = 16
     config = PRESETS[preset]
     max_len = min(config.max_seq_len, max(prompt_lens) + new_tokens + 64)
     params = TransformerLM.init(jax.random.PRNGKey(0), config)
-    engine = SlotEngine(params, config, slots=slots, max_len=max_len,
-                        queue_depth=2 * slots)
-    engine.warmup(prompt_lens=prompt_lens)
+    result = {
+        "preset": preset,
+        "slots": slots,
+        "requests": len(prompt_lens),
+        "new_tokens_per_request": new_tokens,
+    }
+    # partial-artifact hook: from here on, whatever this section has
+    # already measured survives a watchdog emit or a backend loss
+    _state["generate_serving"] = result
 
     def prompts():
         return [list(range(1, plen + 1)) for plen in prompt_lens]
 
-    def drain():
+    def drain(engine):
         while engine.has_work():
             engine.step()
+
+    def batched_run(engine):
+        """Full-pool storm through ``engine``: (elapsed_s, recompiles)."""
+        compiles_before = engine.step_executable._cache_size()
+        started = time.perf_counter()
+        handles = [engine.submit(prompt, max_new_tokens=new_tokens)
+                   for prompt in prompts()]
+        drain(engine)
+        elapsed = time.perf_counter() - started
+        assert all(handle.done for handle in handles)
+        return elapsed, engine.step_executable._cache_size() - compiles_before
+
+    def max_concurrent(engine, count, prompt_len):
+        """Submit ``count`` equal requests and report the max
+        concurrently-busy slot count while draining — the 'concurrent
+        admitted sequences at equal HBM' number."""
+        handles = [engine.submit(list(range(1, prompt_len + 1)),
+                                 max_new_tokens=new_tokens)
+                   for _ in range(count)]
+        busy = 0
+        while engine.has_work():
+            engine.step()
+            busy = max(busy, engine.stats()["slotsBusy"])
+        assert all(handle.done for handle in handles)
+        return busy
+
+    engine = SlotEngine(params, config, slots=slots, max_len=max_len,
+                        queue_depth=2 * slots, paged=True,
+                        page_size=page_size)
+    engine.warmup(prompt_lens=prompt_lens)
 
     # serial: one request at a time through the same engine — the
     # no-batching baseline every continuous-batching claim is against
     started = time.perf_counter()
     for prompt in prompts():
         engine.submit(prompt, max_new_tokens=new_tokens)
-        drain()
+        drain(engine)
     serial_s = time.perf_counter() - started
 
-    compiles_before = _serving_step._cache_size()
-    started = time.perf_counter()
-    handles = [engine.submit(prompt, max_new_tokens=new_tokens)
-               for prompt in prompts()]
-    drain()
-    batched_s = time.perf_counter() - started
-    assert all(handle.done for handle in handles)
-
+    batched_s, paged_recompiles = batched_run(engine)
     total_tokens = len(prompt_lens) * new_tokens
-    result = {
-        "preset": preset,
-        "slots": slots,
-        "requests": len(prompt_lens),
-        "new_tokens_per_request": new_tokens,
+    result.update({
         "serial_tokens_per_sec": round(total_tokens / serial_s, 1),
         "batched_tokens_per_sec": round(total_tokens / batched_s, 1),
         "batched_vs_serial": round(serial_s / batched_s, 2),
-        "step_executables": _serving_step._cache_size(),
-        "recompiles_during_batch": _serving_step._cache_size()
-                                   - compiles_before,
+        "step_executables": engine.step_executable._cache_size(),
+        "recompiles_during_batch": paged_recompiles,
         "stats": engine.stats(),
+    })
+    _log(f"  generate_serving (paged): {result}")
+
+    # paged vs contiguous: same slot count and workload, both layouts
+    contiguous = SlotEngine(params, config, slots=slots, max_len=max_len,
+                            queue_depth=2 * slots, paged=False)
+    contiguous.warmup(prompt_lens=prompt_lens)
+    contiguous_s, contiguous_recompiles = batched_run(contiguous)
+    comparison = {
+        "page_size": page_size,
+        "paged_tokens_per_sec": round(total_tokens / batched_s, 1),
+        "contiguous_tokens_per_sec": round(total_tokens / contiguous_s, 1),
+        "paged_vs_contiguous_tokens": round(contiguous_s / batched_s, 2),
+        "paged_recompiles": paged_recompiles,
+        "contiguous_recompiles": contiguous_recompiles,
+        "zero_recompile_verdict": paged_recompiles == 0,
     }
-    _log(f"  generate_serving: {result}")
+    result["paged_vs_contiguous"] = comparison
+
+    # capacity at EQUAL cache HBM: a small contiguous engine vs a paged
+    # engine holding the identical cell count as pages across more slots
+    contig_capacity_slots = 2
+    equal_hbm_pages = contig_capacity_slots * max_len // page_size
+    probe_len = prompt_lens[0]
+    paged_pool = SlotEngine(params, config, slots=slots, max_len=max_len,
+                            queue_depth=len(prompt_lens), paged=True,
+                            page_size=page_size, kv_pages=equal_hbm_pages)
+    paged_pool.warmup(prompt_lens=(probe_len,))
+    small_contig = SlotEngine(params, config, slots=contig_capacity_slots,
+                              max_len=max_len,
+                              queue_depth=len(prompt_lens), paged=False)
+    small_contig.warmup(prompt_lens=(probe_len,))
+    paged_busy = max_concurrent(paged_pool, len(prompt_lens), probe_len)
+    contig_busy = max_concurrent(small_contig, len(prompt_lens), probe_len)
+    comparison.update({
+        "equal_hbm_pages": equal_hbm_pages,
+        "max_concurrent_paged": paged_busy,
+        "max_concurrent_contiguous": contig_busy,
+        "concurrency_at_equal_hbm": round(paged_busy / max(1, contig_busy),
+                                          2),
+    })
+    _log(f"  paged_vs_contiguous: {comparison}")
     return result
 
 

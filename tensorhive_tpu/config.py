@@ -172,6 +172,11 @@ class GenerationConfig:
     preset: str = "tiny"
     slots: int = 8
     max_len: int = 0                 # 0 = the preset's max_seq_len
+    paged: bool = True               # false: contiguous per-slot cache
+                                     # rollback (docs/SERVING.md)
+    page_size: int = 16              # tokens per KV page
+    kv_pages: int = 0                # 0 = slots * ceil(max_len / page_size)
+                                     # (the contiguous layout's HBM)
     queue_depth: int = 32
     max_new_tokens: int = 128        # per-request cap
     top_k: int = 0                   # 0 = no top-k sampling filter
@@ -406,10 +411,13 @@ interval_s = 5.0
 
 [generation_service]
 # continuous-batching inference gateway (docs/SERVING.md); enabling
-# allocates the model + slot-pool KV cache at boot
+# allocates the model + paged KV page pool at boot
 enabled = false
 # preset = "tiny"
 # slots = 8
+# paged = true        # false: contiguous per-slot cache rollback
+# page_size = 16
+# kv_pages = 0        # 0 = equal HBM to the contiguous layout
 # queue_depth = 32
 # max_new_tokens = 128
 # max_concurrent_per_user = 4

@@ -45,6 +45,10 @@ STATS_SCHEMA = obj(
     queueDepth=s("integer"),
     queueCapacity=s("integer"),
     maxSeqLen=s("integer"),
+    paged=s("boolean"),
+    pageSize=s("integer", nullable=True),
+    kvPagesTotal=s("integer", nullable=True),
+    kvPagesFree=s("integer", nullable=True),
     requestsCompleted=s("integer"),
     tokensEmitted=s("integer"),
     steps=s("integer"),

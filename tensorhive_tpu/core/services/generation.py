@@ -85,6 +85,9 @@ def build_engine(config: Config):
         params, model_config,
         slots=generation.slots,
         max_len=max_len,
+        paged=generation.paged,
+        page_size=generation.page_size,
+        kv_pages=generation.kv_pages,
         queue_depth=generation.queue_depth,
         top_k=generation.top_k or None,
         eos_token=None if generation.eos_token < 0 else generation.eos_token,

@@ -80,6 +80,34 @@ def _decode_attend(q, k_cache, v_cache, position):
     return out.reshape(batch, 1, heads, d_head).astype(q.dtype)
 
 
+def _paged_attend(q, k_pages, v_pages, page_table, position):
+    """Paged-cache decode attention: gather each slot's pages, then the
+    SAME masked grouped math as :func:`_decode_attend`.
+
+    q: [S,1,H,Dh]; ``k_pages``/``v_pages`` are one layer of the paged cache
+    [num_pages, page_size, Hkv, Dh]; ``page_table`` [S, max_pages] holds
+    physical page indices (a traced operand — page assignment must never be
+    a shape, or every admission would recompile); ``position`` broadcasts
+    per slot like the contiguous path.
+
+    The gather reconstructs a contiguous [S, max_pages*page_size, Hkv, Dh]
+    per-slot view: logical position p of slot s lives at
+    ``(page_table[s, p // page_size], p % page_size)``, so reshaping the
+    gathered pages lays keys out in logical order and the ``<= position``
+    mask inside ``_decode_attend`` applies unchanged. Entries still
+    pointing at the trash page hold other sequences' (or garbage) K/V, but
+    every such logical position is > the slot's position — masked to -1e30,
+    exp-underflowed to exactly 0.0 in the softmax — which is why paged
+    output is f32-EXACT against the contiguous engine and
+    ``decode.generate`` (test_paging.py), not merely close."""
+    num_slots, max_pages = page_table.shape
+    page_size = k_pages.shape[1]
+    window = max_pages * page_size
+    k = k_pages[page_table].reshape(num_slots, window, *k_pages.shape[2:])
+    v = v_pages[page_table].reshape(num_slots, window, *v_pages.shape[2:])
+    return _decode_attend(q, k, v, position)
+
+
 def apply_step(
     params: Params,
     token: jax.Array,               # [B] int32 — the token AT `position`

@@ -435,6 +435,20 @@ def _serving_ttft_p95() -> Optional[float]:
     return engine.ttft_p95_s()
 
 
+def _serving_kv_page_saturation() -> Optional[float]:
+    """KV page-pool fill fraction of the paged serving engine (None while
+    no engine is installed OR the engine runs the contiguous rollback
+    layout — neither is an alertable state). 1.0 means admission is
+    page-bound: requests queue-wait or 429 until a running sequence
+    releases pages (docs/SERVING.md "Paged KV cache")."""
+    from ..serving import get_engine
+
+    engine = get_engine()
+    if engine is None:
+        return None
+    return engine.kv_page_saturation()
+
+
 def _serving_stalled_slot_counter(
         leak_after_s: float) -> Callable[[], Optional[float]]:
     """Source callable: busy slots that have emitted nothing for
@@ -565,6 +579,15 @@ def default_rule_pack(monitoring_interval_s: Optional[float] = None,
             description="p95 time-to-first-token is over the "
                         "[generation_service] ttft_slo_s budget — prefill "
                         "queueing is eating the latency SLO"),
+        AlertRule(
+            name="kv_pages_exhausted", severity="warning",
+            kind="threshold", op=">=", threshold=1.0,
+            for_s=2 * alert_interval_s,
+            source=_serving_kv_page_saturation,
+            description="the paged KV pool is fully allocated — new "
+                        "generation requests are queue-waiting (or 429ing) "
+                        "for pages to be released; raise kv_pages or shed "
+                        "long-context load (docs/SERVING.md)"),
         AlertRule(
             name="generate_slot_leak", severity="critical",
             kind="threshold", op=">", threshold=0.0,

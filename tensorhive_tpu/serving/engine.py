@@ -9,10 +9,21 @@ requests share ONE running decode batch on one chip, FlexNPU-style
 Design, in the order the constraints forced it:
 
 * **Fixed-capacity slot pool, one persistent cache.** The KV cache is a
-  single ``[layers, slots, max_len, kv_heads, d_head]`` buffer allocated
-  once; a request *joins* by prefilling its prompt into a free slot row and
-  *leaves* by having its slot freed on EOS/max-tokens. Batch shape never
-  changes, so the decode executable never recompiles.
+  single buffer allocated once; a request *joins* by prefilling its prompt
+  into a free slot and *leaves* by having its slot freed on EOS/max-tokens.
+  Batch shape never changes, so the decode executable never recompiles.
+* **Paged by default, contiguous as rollback.** The default cache layout is
+  a block-paged pool ``[layers, 1 + num_pages, page_size, kv_heads,
+  d_head]`` (physical page 0 is the trash page): a slot owns only the pages
+  its request needs — ``ceil((prompt + max_new) / page_size)`` from
+  :class:`~tensorhive_tpu.serving.paging.PagePool`'s free list — so serving
+  capacity is bound by *tokens in flight*, not ``slots × max_len``; at
+  equal HBM the pool admits strictly more concurrent short/mixed sequences
+  (docs/SERVING.md "Paged KV cache"). The page table rides into the
+  step/prefill executables as a TRACED operand, so page assignment never
+  recompiles. ``paged=False`` (``[generation_service] paged``) restores the
+  PR 6 contiguous ``[layers, slots, max_len, kv_heads, d_head]`` layout —
+  both are pinned f32-exact against ``decode.generate``.
 * **Per-slot state is traced, never static.** The fused step takes per-slot
   token/position/active/temperature arrays as *operands*; joins and leaves
   only flip mask entries host-side. ``tpuhive_decode_compile_total`` counts
@@ -58,6 +69,7 @@ from ..models.decode import (
     KVCache,
     _count_compile,
     _decode_attend,
+    _paged_attend,
     _prefill_bucket,
 )
 from ..models.transformer import (
@@ -67,6 +79,7 @@ from ..models.transformer import (
 )
 from ..observability import get_registry, Histogram
 from . import QueueFullError, RateLimitError
+from .paging import PagePool
 
 # -- metrics (registered once at import; one exposition surface) -------------
 _REQUESTS = get_registry().counter(
@@ -99,6 +112,18 @@ _BATCH_EFFICIENCY = get_registry().histogram(
     "tpuhive_generate_batch_efficiency",
     "Active slots / capacity per decode step (1.0 = perfectly packed).",
     buckets=(0.125, 0.25, 0.375, 0.5, 0.625, 0.75, 0.875, 1.0))
+_KV_PAGES_FREE = get_registry().gauge(
+    "tpuhive_generate_kv_pages_free",
+    "Free KV-cache pages in the paged engine's pool (0 = admission is "
+    "page-bound; the kv_pages_exhausted alert signal).")
+_KV_PAGES_TOTAL = get_registry().gauge(
+    "tpuhive_generate_kv_pages_total",
+    "Usable KV-cache pages in the paged engine's pool (excludes the trash "
+    "page parked slots write into).")
+_SLOT_PAGES = get_registry().gauge(
+    "tpuhive_generate_slot_kv_pages",
+    "KV pages currently owned by each slot (0 when free or contiguous).",
+    labels=("slot",))
 
 
 # -- device functions ---------------------------------------------------------
@@ -146,6 +171,17 @@ def _step_body(params, tokens, positions, active, temps, cache, key,
     for layer_index, block in enumerate(params["blocks"]):
         x = TransformerLM.block_forward(x, block, config, rope_positions,
                                         attend, layer_index=layer_index)
+    chosen, key = _choose_next(params, x, tokens, active, temps, key,
+                               config, top_k)
+    return chosen, KVCache(k=cache_k, v=cache_v), key
+
+
+def _choose_next(params, x, tokens, active, temps, key,
+                 config: TransformerConfig, top_k: Optional[int]):
+    """Shared step tail: final norm -> logits -> per-slot greedy/sampled
+    choice. One copy for the contiguous and paged step bodies so the two
+    cache layouts cannot drift in sampling semantics."""
+    dtype = config.dtype
     x = _rmsnorm(x, params["final_norm"]["scale"])
     logits = jnp.dot(x[:, 0].astype(dtype), params["w_lm_head"].astype(dtype),
                      preferred_element_type=jnp.float32)           # [S,V]
@@ -162,12 +198,67 @@ def _step_body(params, tokens, positions, active, temps, cache, key,
     # inactive slots keep their frozen token so their (harmless) writes
     # stay deterministic
     chosen = jnp.where(active, chosen, tokens)
-    return chosen, KVCache(k=cache_k, v=cache_v), key
+    return chosen, key
 
 
 _serving_step = functools.partial(
     jax.jit, static_argnames=("config", "top_k"),
     donate_argnames=("cache",))(_step_body)
+
+
+def _paged_step_body(params, tokens, positions, active, temps, page_tables,
+                     cache, key, config: TransformerConfig,
+                     top_k: Optional[int]):
+    """One fused decode step over the PAGED cache.
+
+    Identical to :func:`_step_body` except for where K/V live: the cache is
+    ``[layers, 1 + num_pages, page_size, kv_heads, d_head]`` and each slot's
+    write lands at ``(page_tables[slot, pos // page_size], pos % page_size)``
+    — a scatter with per-slot (page, offset) indices instead of a vmapped
+    row update. ``page_tables`` is a TRACED operand like every other piece
+    of per-slot state, so page assignment (the thing that changes on every
+    join/leave) never produces a new shape and never recompiles — the same
+    discipline that makes the contiguous engine's joins free.
+
+    Parked slots (``active`` False, page-table row reset to the trash page,
+    position frozen at 0) scatter their garbage K/V into physical page 0,
+    which no live sequence's page table ever references — the paged
+    equivalent of the contiguous engine's "parked writes land in the
+    parked slot's own row" argument.
+    """
+    dtype = config.dtype
+    x = params["tok_embed"].astype(dtype)[tokens][:, None, :]     # [S,1,D]
+    rope_positions = positions[:, None]                           # [S,1]
+    cache_k, cache_v = cache.k, cache.v
+    page_size = cache_k.shape[2]
+    slot_ids = jnp.arange(tokens.shape[0])
+    pages = page_tables[slot_ids, positions // page_size]         # [S]
+    offsets = positions % page_size                               # [S]
+
+    def attend(q, k, v, layer):
+        nonlocal cache_k, cache_v
+        layer_k = cache_k[layer].at[pages, offsets].set(
+            k[:, 0].astype(cache_k.dtype))
+        layer_v = cache_v[layer].at[pages, offsets].set(
+            v[:, 0].astype(cache_v.dtype))
+        cache_k = jax.lax.dynamic_update_slice(
+            cache_k, layer_k[None], (layer, 0, 0, 0, 0))
+        cache_v = jax.lax.dynamic_update_slice(
+            cache_v, layer_v[None], (layer, 0, 0, 0, 0))
+        return _paged_attend(q, cache_k[layer], cache_v[layer], page_tables,
+                             positions[:, None, None, None, None])
+
+    for layer_index, block in enumerate(params["blocks"]):
+        x = TransformerLM.block_forward(x, block, config, rope_positions,
+                                        attend, layer_index=layer_index)
+    chosen, key = _choose_next(params, x, tokens, active, temps, key,
+                               config, top_k)
+    return chosen, KVCache(k=cache_k, v=cache_v), key
+
+
+_paged_serving_step = functools.partial(
+    jax.jit, static_argnames=("config", "top_k"),
+    donate_argnames=("cache",))(_paged_step_body)
 
 
 def _prefill_body(params, head, cache, slot, real_len,
@@ -215,6 +306,68 @@ def _prefill_body(params, head, cache, slot, real_len,
 _serving_prefill = functools.partial(
     jax.jit, static_argnames=("config",),
     donate_argnames=("cache",))(_prefill_body)
+
+
+def _paged_prefill_body(params, head, cache, page_table_row, real_len,
+                        config: TransformerConfig):
+    """Prefill one joining sequence's prompt head through its page table.
+
+    Mirrors :func:`_prefill_body` — same trunk pass, same bucketed ``head``
+    [1, W], same traced ``real_len`` — but the K/V of prompt position ``w``
+    scatters to ``(page_table_row[w // page_size], w % page_size)`` in the
+    paged cache instead of ``(layer, slot, w)``. ``page_table_row`` [mp] is
+    a traced operand: one executable per bucket width serves every page
+    assignment.
+
+    Padded positions (``w >= real_len``) are routed OUT OF BOUNDS and
+    dropped (``mode="drop"``) rather than zero-masked like the contiguous
+    path: a padded write must not touch ANY physical page — entries of the
+    row beyond the request's allocation still point at the trash page, and
+    scribbling zeros there would race other joiners' padded writes for no
+    benefit. The dropped cells hold stale garbage until the decode steps
+    rewrite them position by position before first attending them — the
+    same rewrite-before-attend argument the contiguous engine pins with
+    test_slot_reuse_matches_fresh_engine."""
+    from ..models.transformer import flash_attention
+    from ..ops.flash_attention import reference_attention
+
+    dtype = config.dtype
+    batch, width = head.shape
+    x = params["tok_embed"].astype(dtype)[head]
+    positions = jnp.broadcast_to(jnp.arange(width, dtype=jnp.int32),
+                                 (batch, width))
+    num_physical = cache.k.shape[1]
+    page_size = cache.k.shape[2]
+    token_index = jnp.arange(width, dtype=jnp.int32)
+    valid = token_index < real_len
+    pages = jnp.where(valid, page_table_row[token_index // page_size],
+                      num_physical)                       # OOB -> dropped
+    offsets = token_index % page_size
+    cache_k, cache_v = cache.k, cache.v
+
+    def attend(q, k, v, layer):
+        nonlocal cache_k, cache_v
+        layer_k = cache_k[layer].at[pages, offsets].set(
+            k[0].astype(cache_k.dtype), mode="drop")
+        layer_v = cache_v[layer].at[pages, offsets].set(
+            v[0].astype(cache_v.dtype), mode="drop")
+        cache_k = jax.lax.dynamic_update_slice(
+            cache_k, layer_k[None], (layer, 0, 0, 0, 0))
+        cache_v = jax.lax.dynamic_update_slice(
+            cache_v, layer_v[None], (layer, 0, 0, 0, 0))
+        if config.use_flash:
+            return flash_attention(q, k, v, causal=True)
+        return reference_attention(q, k, v, causal=True)
+
+    for layer_index, block in enumerate(params["blocks"]):
+        x = TransformerLM.block_forward(x, block, config, positions, attend,
+                                        layer_index=layer_index)
+    return KVCache(k=cache_k, v=cache_v)
+
+
+_paged_serving_prefill = functools.partial(
+    jax.jit, static_argnames=("config",),
+    donate_argnames=("cache",))(_paged_prefill_body)
 
 
 # -- request plumbing ---------------------------------------------------------
@@ -318,6 +471,9 @@ class SlotEngine:
         eos_token: Optional[int] = None,
         max_new_tokens_cap: int = 512,
         max_concurrent_per_user: int = 0,
+        paged: bool = True,
+        page_size: int = 16,
+        kv_pages: int = 0,
         clock: Callable[[], float] = time.monotonic,
     ) -> None:
         if not config.causal:
@@ -339,6 +495,7 @@ class SlotEngine:
         self.eos_token = eos_token
         self.max_new_tokens_cap = int(max_new_tokens_cap)
         self.max_concurrent_per_user = int(max_concurrent_per_user)
+        self.paged = bool(paged)
         self.clock = clock
 
         self._lock = threading.Lock()
@@ -355,8 +512,27 @@ class SlotEngine:
 
         # device state: one persistent cache + per-slot operand arrays
         # (host numpy masters; tiny, shipped per step)
-        shape = (config.n_layers, self.capacity, self.max_len,
-                 config.kv_heads, config.d_head)
+        if self.paged:
+            if page_size < 1:
+                raise ValueError(f"page_size must be >= 1, got {page_size}")
+            self.page_size = int(page_size)
+            max_pages_per_slot = -(-self.max_len // self.page_size)
+            #: 0 = the contiguous engine's HBM at the same slot count — the
+            #: rollback-neutral default; serving more sequences at equal
+            #: HBM means raising ``slots`` while keeping ``kv_pages``
+            num_pages = int(kv_pages) or self.capacity * max_pages_per_slot
+            self._pool = PagePool(num_pages=num_pages,
+                                  page_size=self.page_size,
+                                  slots=self.capacity,
+                                  max_pages_per_slot=max_pages_per_slot)
+            # physical page 0 is the trash page -> 1 + num_pages rows
+            shape = (config.n_layers, 1 + num_pages, self.page_size,
+                     config.kv_heads, config.d_head)
+        else:
+            self.page_size = None
+            self._pool = None
+            shape = (config.n_layers, self.capacity, self.max_len,
+                     config.kv_heads, config.d_head)
         self._cache = KVCache(k=jnp.zeros(shape, config.dtype),
                               v=jnp.zeros(shape, config.dtype))
         self._tokens = np.zeros(self.capacity, np.int32)
@@ -369,6 +545,23 @@ class SlotEngine:
         _SLOTS_TOTAL.set(self.capacity)
         _QUEUE_DEPTH.set(0)
         _SLOTS_BUSY.set(0)
+        if self.paged:
+            _KV_PAGES_TOTAL.set(self._pool.num_pages)
+            _KV_PAGES_FREE.set(self._pool.free_pages)
+            for index in range(self.capacity):
+                _SLOT_PAGES.labels(slot=str(index)).set(0)
+
+    @property
+    def step_executable(self):
+        """The jitted step function this engine dispatches —
+        ``.step_executable._cache_size()`` is the recompile ground truth
+        the smoke gate and tests assert on (paged and contiguous engines
+        use different executables)."""
+        return _paged_serving_step if self.paged else _serving_step
+
+    @property
+    def prefill_executable(self):
+        return _paged_serving_prefill if self.paged else _serving_prefill
 
     # -- admission --------------------------------------------------------
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
@@ -392,6 +585,14 @@ class SlotEngine:
                 f"engine sequence budget {self.max_len}")
         if temperature < 0.0:
             raise ValueError(f"temperature must be >= 0, got {temperature}")
+        if self.paged:
+            needed = self._pool.pages_for(len(prompt) + max_new_tokens)
+            if needed > self._pool.num_pages:
+                # can NEVER be admitted — an honest 422, not an eternal wait
+                raise ValueError(
+                    f"request needs {needed} KV pages but the pool only has "
+                    f"{self._pool.num_pages}; shorten the prompt or "
+                    "max_new_tokens")
         request = _Request(prompt=prompt, max_new_tokens=int(max_new_tokens),
                            temperature=float(temperature),
                            user_key=str(user_key) if user_key else None,
@@ -412,7 +613,10 @@ class SlotEngine:
                 raise QueueFullError(
                     f"admission queue is full ({self.queue_depth} waiting); "
                     "retry shortly",
-                    retry_after_s=self._retry_after_locked())
+                    retry_after_s=self._retry_after_locked(
+                        needed_pages=(self._pool.pages_for(
+                            len(prompt) + max_new_tokens)
+                            if self.paged else None)))
             if request.user_key:
                 self._user_active[request.user_key] = (
                     self._user_active.get(request.user_key, 0) + 1)
@@ -420,16 +624,32 @@ class SlotEngine:
             _QUEUE_DEPTH.set(len(self._pending))
         return handle
 
-    def _retry_after_locked(self) -> float:
-        """Honest Retry-After: time for the oldest running sequence to
-        finish at the observed inter-token rate (floor 1 s)."""
+    def _retry_after_locked(self, needed_pages: Optional[int] = None) -> float:
+        """Honest Retry-After (floor 1 s). Contiguous: time for the
+        shortest-remaining running sequence to free its slot at the observed
+        inter-token p50. Paged with ``needed_pages``: the wait is for PAGES,
+        not a slot — walk running sequences in completion order accumulating
+        the pages each will release on top of the current free count, and
+        answer the completion time at which ``needed_pages`` fit (a
+        long-context request correctly waits for several short ones, not
+        just the first)."""
         per_token = self._intertoken_hist.quantile(0.5) or 0.05
-        remaining = [
-            slot.request.max_new_tokens - len(slot.request.generated)
-            for slot in self._slots if slot is not None]
-        if not remaining:
+        running = [
+            (slot.request.max_new_tokens - len(slot.request.generated), index)
+            for index, slot in enumerate(self._slots) if slot is not None]
+        if not running:
             return 1.0
-        return max(1.0, round(min(remaining) * per_token, 1))
+        if self.paged and needed_pages is not None:
+            free = self._pool.free_pages
+            if free < needed_pages:
+                eta_tokens = 0
+                for remaining, index in sorted(running):
+                    free += self._pool.owned_count(index)
+                    eta_tokens = remaining
+                    if free >= needed_pages:
+                        break
+                return max(1.0, round(eta_tokens * per_token, 1))
+        return max(1.0, round(min(r for r, _ in running) * per_token, 1))
 
     def _cancel(self, request: _Request) -> None:
         with self._lock:
@@ -471,21 +691,54 @@ class SlotEngine:
                    for length in prompt_lens} or {
                        _prefill_bucket(1, self.max_len - 1)}
         for width in sorted(buckets):
-            head = jnp.zeros((1, width), jnp.int32)
-            self._count_prefill_compile(width)
-            self._cache = _serving_prefill(
-                self.params, head, self._cache, jnp.int32(0), jnp.int32(0),
-                self.config)
+            # real_len 0: every write is masked (contiguous) or dropped
+            # (paged — slot 0's table row still points at the trash page),
+            # so warmup compiles without touching any page
+            self._dispatch_prefill(np.zeros((1, width), np.int32),
+                                   slot=0, real_len=0)
         chosen, self._cache, self._key = self._run_step()
         np.asarray(chosen)      # force the compile before traffic arrives
 
     # -- internals --------------------------------------------------------
     def _count_prefill_compile(self, width: int) -> None:
-        _count_compile("serving_prefill",
-                       ("serving_prefill", self.config, self.capacity,
-                        self.max_len, width))
+        if self.paged:
+            _count_compile("serving_paged_prefill",
+                           ("serving_paged_prefill", self.config,
+                            self._pool.num_pages, self.page_size,
+                            self._pool.max_pages_per_slot, width))
+        else:
+            _count_compile("serving_prefill",
+                           ("serving_prefill", self.config, self.capacity,
+                            self.max_len, width))
+
+    def _dispatch_prefill(self, head, slot: int, real_len: int) -> None:
+        """Run the joining sequence's trunk pass through whichever cache
+        layout this engine uses. Paged passes the slot's page-table ROW as
+        a traced operand (the executable never sees the slot index);
+        contiguous passes the traced slot index."""
+        self._count_prefill_compile(head.shape[1])
+        if self.paged:
+            self._cache = _paged_serving_prefill(
+                self.params, jnp.asarray(head), self._cache,
+                jnp.asarray(self._pool.page_table[slot]),
+                jnp.int32(real_len), self.config)
+        else:
+            self._cache = _serving_prefill(
+                self.params, jnp.asarray(head), self._cache,
+                jnp.int32(slot), jnp.int32(real_len), self.config)
 
     def _run_step(self):
+        if self.paged:
+            _count_compile("serving_paged_step",
+                           ("serving_paged_step", self.config, self.capacity,
+                            self._pool.num_pages, self.page_size,
+                            self._pool.max_pages_per_slot, self.top_k))
+            return _paged_serving_step(
+                self.params, jnp.asarray(self._tokens),
+                jnp.asarray(self._positions), jnp.asarray(self._active),
+                jnp.asarray(self._temps), jnp.asarray(self._pool.page_table),
+                self._cache, self._key,
+                config=self.config, top_k=self.top_k)
         _count_compile("serving_step",
                        ("serving_step", self.config, self.capacity,
                         self.max_len, self.top_k))
@@ -508,7 +761,21 @@ class SlotEngine:
                 if free is None or not self._pending:
                     _QUEUE_DEPTH.set(len(self._pending))
                     return joined
-                request = self._pending.popleft()
+                request = self._pending[0]
+                if self.paged:
+                    needed = self._pool.pages_for(
+                        len(request.prompt) + request.max_new_tokens)
+                    if not self._pool.assign(free, needed):
+                        # head-of-line waits for pages. Strict FIFO on
+                        # purpose: letting smaller requests overtake would
+                        # starve long-context requests under sustained
+                        # short-request load (submit() already rejected
+                        # anything that can NEVER fit)
+                        _QUEUE_DEPTH.set(len(self._pending))
+                        return joined
+                    _KV_PAGES_FREE.set(self._pool.free_pages)
+                    _SLOT_PAGES.labels(slot=str(free)).set(needed)
+                self._pending.popleft()
                 self._slots[free] = _Slot(request=request,
                                           joined_ts=self.clock())
                 _QUEUE_DEPTH.set(len(self._pending))
@@ -535,10 +802,7 @@ class SlotEngine:
             width = _prefill_bucket(prompt_len - 1, self.max_len - 1)
             head = np.zeros((1, width), np.int32)
             head[0, :prompt_len - 1] = prompt[:-1]
-            self._count_prefill_compile(width)
-            self._cache = _serving_prefill(
-                self.params, jnp.asarray(head), self._cache,
-                jnp.int32(slot), jnp.int32(prompt_len - 1), self.config)
+            self._dispatch_prefill(head, slot, prompt_len - 1)
         with self._lock:
             self._tokens[slot] = prompt[-1]
             self._positions[slot] = prompt_len - 1
@@ -598,8 +862,19 @@ class SlotEngine:
     def _free_slot_locked(self, index: int) -> None:
         self._slots[index] = None  # thive: disable=TH-C — caller holds the lock (_locked suffix)
         self._active[index] = False  # thive: disable=TH-C — caller holds the lock (_locked suffix)
-        # position stays frozen: the parked slot's masked writes keep
-        # landing on one already-consumed coordinate (see module docstring)
+        if self.paged:
+            # the pages go back to the pool NOW (they may be reassigned on
+            # the very next _admit), so the parked slot must stop writing
+            # through them: release() points the whole page-table row at
+            # the trash page and the position resets to 0 — parked writes
+            # land at (trash, 0) forever, never on a recycled page
+            self._pool.release(index)  # thive: disable=TH-C — caller holds the lock (_locked suffix)
+            self._positions[index] = 0  # thive: disable=TH-C — caller holds the lock (_locked suffix)
+            _KV_PAGES_FREE.set(self._pool.free_pages)
+            _SLOT_PAGES.labels(slot=str(index)).set(0)
+        # (contiguous) position stays frozen: the parked slot's masked
+        # writes keep landing on one already-consumed coordinate of its own
+        # row (see module docstring)
 
     def _finish_locked(self, request: _Request, outcome: str) -> None:
         if request.finished:
@@ -657,6 +932,10 @@ class SlotEngine:
                 "queueDepth": len(self._pending),
                 "queueCapacity": self.queue_depth,
                 "maxSeqLen": self.max_len,
+                "paged": self.paged,
+                "pageSize": self.page_size,
+                "kvPagesTotal": self._pool.num_pages if self.paged else None,
+                "kvPagesFree": self._pool.free_pages if self.paged else None,
                 "requestsCompleted": self.completed_requests,
                 "tokensEmitted": self.emitted_tokens,
                 "steps": self.steps,
@@ -672,3 +951,11 @@ class SlotEngine:
     def queue_saturation(self) -> float:
         with self._lock:
             return len(self._pending) / self.queue_depth
+
+    def kv_page_saturation(self) -> Optional[float]:
+        """Pool-fill fraction, 1.0 = exhausted (None for the contiguous
+        engine — no pool, nothing to alert on)."""
+        if not self.paged:
+            return None
+        with self._lock:
+            return self._pool.saturation()

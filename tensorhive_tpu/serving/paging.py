@@ -1,0 +1,132 @@
+"""Block-paged KV cache bookkeeping: the host side of paged attention.
+
+PR 6's slot engine allocates one contiguous ``[layers, slots, max_len, ...]``
+cache, so every slot pays ``max_len`` HBM whether its request uses 16 tokens
+or 2000 — pool size is welded to context length. This module decouples them
+(ROADMAP item 1): the cache becomes a pool of fixed-size *pages* of
+``page_size`` token positions each, and a slot owns only the pages its
+request actually needs (``ceil((prompt + max_new) / page_size)``), handed
+out from a host-side free list at admission and recycled the moment the
+slot leaves.
+
+Everything here is host-side numpy — deliberately jax-free, like the
+package root: the allocator is pure bookkeeping that tests exercise without
+a device, and the engine ships its ``page_table`` array to the device as a
+*traced operand* of the paged step/prefill executables (page assignment
+must never be a shape, or every admission would recompile —
+docs/SERVING.md "Paged KV cache").
+
+Physical page 0 is the **trash page**: it is never handed out, and a freed
+slot's page-table row resets to it, so the parked slot's masked garbage
+writes (see engine docstring — parked slots keep stepping) land somewhere
+no live sequence ever reads. Without it, a parked slot would keep writing
+through page-table entries whose pages may already belong to a *new*
+request — the one corruption mode paging introduces over the contiguous
+layout.
+
+Pages are all the same size, so the pool cannot fragment: any ``n`` free
+pages satisfy any ``n``-page request regardless of allocation history
+(pinned by test_paging.py's churn test). Concurrency: the pool is NOT
+internally locked — the engine mutates it only under its own lock / from
+its single pump thread, the same discipline as the per-slot operand arrays.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import numpy as np
+
+#: physical index of the write sink for parked slots; never allocated
+TRASH_PAGE = 0
+
+
+class PagePool:
+    """Fixed-size page allocator + per-slot page tables.
+
+    ``num_pages`` usable pages (physical indices ``1..num_pages`` — index 0
+    is the trash page), each covering ``page_size`` consecutive token
+    positions of one sequence. ``page_table`` is the ``[slots,
+    max_pages_per_slot]`` int32 array the paged executables consume: row
+    ``s``, entry ``j`` is the physical page holding slot ``s``'s logical
+    positions ``j*page_size .. (j+1)*page_size-1``; unassigned entries
+    point at the trash page (they are masked out of attention by the
+    ``<= position`` mask long before they could matter, because a slot's
+    position never enters a page that was not assigned first).
+    """
+
+    def __init__(self, num_pages: int, page_size: int, slots: int,
+                 max_pages_per_slot: int) -> None:
+        if num_pages < 1:
+            raise ValueError(f"num_pages must be >= 1, got {num_pages}")
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        if max_pages_per_slot < 1:
+            raise ValueError(
+                f"max_pages_per_slot must be >= 1, got {max_pages_per_slot}")
+        self.num_pages = int(num_pages)
+        self.page_size = int(page_size)
+        self.slots = int(slots)
+        self.max_pages_per_slot = int(max_pages_per_slot)
+        # LIFO free list: recently-used pages are reissued first (their
+        # cache lines are warm, and reuse-after-free is exercised hardest)
+        self._free: List[int] = list(range(self.num_pages, 0, -1))
+        self._owned: List[List[int]] = [[] for _ in range(self.slots)]
+        self.page_table = np.full((self.slots, self.max_pages_per_slot),
+                                  TRASH_PAGE, np.int32)
+
+    # -- sizing ------------------------------------------------------------
+    def pages_for(self, tokens: int) -> int:
+        """Pages a ``tokens``-position sequence occupies (ceil division)."""
+        if tokens < 1:
+            raise ValueError(f"tokens must be >= 1, got {tokens}")
+        return -(-tokens // self.page_size)
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        return self.num_pages - len(self._free)
+
+    def owned_count(self, slot: int) -> int:
+        return len(self._owned[slot])
+
+    def saturation(self) -> float:
+        """Fraction of the pool in use — 1.0 is the kv_pages_exhausted
+        alert condition."""
+        return self.used_pages / self.num_pages
+
+    # -- allocation --------------------------------------------------------
+    def assign(self, slot: int, pages: int) -> bool:
+        """Move ``pages`` pages from the free list to ``slot`` and fill its
+        page-table row. Returns False (taking nothing) when the pool cannot
+        satisfy the request — partial grants would deadlock admission.
+        Raises on a slot that already holds pages (a free-slot invariant
+        violation, never load)."""
+        if not 0 < pages <= self.max_pages_per_slot:
+            raise ValueError(
+                f"pages must be in [1, {self.max_pages_per_slot}], "
+                f"got {pages}")
+        if self._owned[slot]:
+            raise ValueError(
+                f"slot {slot} already owns {len(self._owned[slot])} pages; "
+                "release before reassigning")
+        if pages > len(self._free):
+            return False
+        granted = [self._free.pop() for _ in range(pages)]
+        self._owned[slot] = granted
+        self.page_table[slot, :pages] = granted
+        return True
+
+    def release(self, slot: int) -> int:
+        """Return ``slot``'s pages to the free list and point its whole
+        page-table row back at the trash page; idempotent (releasing an
+        empty slot is a no-op returning 0)."""
+        granted = self._owned[slot]
+        self._owned[slot] = []
+        self._free.extend(reversed(granted))
+        self.page_table[slot, :] = TRASH_PAGE
+        return len(granted)

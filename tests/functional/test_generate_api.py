@@ -176,6 +176,11 @@ def test_generate_stats_snapshot(api, pump, user_headers):
     assert doc["slots"] == 2 and doc["queueCapacity"] == 2
     assert doc["tokensEmitted"] >= 3
     assert doc["ttftP50Ms"] is not None
+    # the page-pool badge fields (docs/SERVING.md "Paged KV cache"): the
+    # fixture engine runs the default paged layout, pool fully free at rest
+    assert doc["paged"] is True
+    assert doc["kvPagesTotal"] >= 1
+    assert doc["kvPagesFree"] == doc["kvPagesTotal"]
 
 
 def test_generate_disabled_answers_503(api, user_headers):

@@ -163,6 +163,16 @@ def test_ui_source_fragments_exist():
     assert not gone, f"UI no longer contains the fragment for: {gone}"
 
 
+def test_serving_strip_renders_page_pool_badge():
+    """The paged-KV utilization badge (docs/SERVING.md "Paged KV cache")
+    must render from the exact ``kvPagesFree``/``kvPagesTotal`` fields
+    ``GET /generate/stats`` exports — a rename on either side breaks this
+    fragment, like a vanished UI_CALLS fragment would."""
+    source = (STATIC_DIR / "js" / "nodes.js").read_text()
+    assert 'stats.kvPagesFree + "/" + stats.kvPagesTotal' in source
+    assert "stats.kvPagesTotal == null" in source   # hidden for contiguous
+
+
 # ---------------------------------------------------------------------------
 # shape replay fixtures
 # ---------------------------------------------------------------------------

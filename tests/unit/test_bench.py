@@ -121,6 +121,25 @@ def test_main_emits_valid_json_despite_midsweep_failure(monkeypatch, capsys):
     assert doc["vs_baseline"] > 0
 
 
+def test_generate_serving_leaves_partial_section_on_backend_loss(monkeypatch):
+    """The r03-r05 flight-blindness fix, serving edition: if the backend
+    dies mid-section (here: at engine construction), whatever
+    bench_generate_serving measured so far must already be in ``_state`` so
+    the watchdog/partial emit carries it — not a bare null."""
+    from tensorhive_tpu.serving import engine as serving_engine
+
+    def dying_engine(*args, **kwargs):
+        raise RuntimeError("UNAVAILABLE: backend tunnel lost")
+
+    monkeypatch.setattr(serving_engine, "SlotEngine", dying_engine)
+    bench._reset_state()
+    with pytest.raises(RuntimeError, match="tunnel lost"):
+        bench.bench_generate_serving()
+    partial = bench._state["generate_serving"]
+    assert partial is not None
+    assert partial["preset"] and partial["slots"] >= 1
+
+
 def test_main_emits_valid_json_when_everything_burns(monkeypatch, capsys):
     monkeypatch.setattr(bench, "probe_backend", lambda: "cpu")
     monkeypatch.setattr(bench, "bench_train",

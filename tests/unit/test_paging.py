@@ -1,0 +1,315 @@
+"""Paged KV cache tests: the allocator's bookkeeping and the engine-level
+contract that paging is INVISIBLE to outputs — paged ≡ contiguous ≡
+`decode.generate`, f32-exact, including page recycling after leave/cancel.
+
+The PagePool half runs without a device (the allocator is host-side numpy
+by design); the engine half mirrors test_serving.py's exactness style.
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorhive_tpu.models import decode
+from tensorhive_tpu.models.transformer import PRESETS, TransformerLM
+from tensorhive_tpu.serving import QueueFullError, set_engine
+from tensorhive_tpu.serving.engine import SlotEngine
+from tensorhive_tpu.serving.paging import TRASH_PAGE, PagePool
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device CPU platform"
+)
+
+F32_TINY = dataclasses.replace(PRESETS["tiny"], dtype=jnp.float32,
+                               use_flash=False, remat=False, max_seq_len=128)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return TransformerLM.init(jax.random.PRNGKey(0), F32_TINY)
+
+
+def make_engine(params, **kwargs):
+    kwargs.setdefault("slots", 4)
+    kwargs.setdefault("max_len", 96)
+    kwargs.setdefault("queue_depth", 8)
+    return SlotEngine(params, F32_TINY, **kwargs)
+
+
+def drain(engine):
+    while engine.has_work():
+        engine.step()
+
+
+def reference_tokens(params, prompt, new_tokens):
+    out = decode.generate(params, F32_TINY,
+                          jnp.asarray([prompt], jnp.int32),
+                          max_new_tokens=new_tokens, temperature=0.0)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+# -- PagePool bookkeeping ----------------------------------------------------
+
+def test_pages_for_rounds_up():
+    pool = PagePool(num_pages=8, page_size=16, slots=2, max_pages_per_slot=4)
+    assert pool.pages_for(1) == 1
+    assert pool.pages_for(16) == 1
+    assert pool.pages_for(17) == 2
+    assert pool.pages_for(64) == 4
+    with pytest.raises(ValueError):
+        pool.pages_for(0)
+
+
+def test_assign_release_reuse():
+    pool = PagePool(num_pages=6, page_size=16, slots=3, max_pages_per_slot=3)
+    assert pool.free_pages == 6 and pool.used_pages == 0
+    assert pool.assign(0, 3)
+    assert pool.free_pages == 3 and pool.owned_count(0) == 3
+    # the page table holds real (non-trash) physical pages for the grant
+    row = pool.page_table[0]
+    assert all(page != TRASH_PAGE for page in row[:3])
+    assert row[2] != row[1] != row[0]
+    assert pool.assign(1, 3)
+    assert pool.free_pages == 0
+    assert pool.saturation() == pytest.approx(1.0)
+    # no pages left: assign must take NOTHING (no partial grants)
+    assert not pool.assign(2, 1)
+    assert pool.owned_count(2) == 0 and pool.free_pages == 0
+    # release recycles, row resets to the trash page, and is idempotent
+    assert pool.release(0) == 3
+    assert pool.free_pages == 3
+    assert all(page == TRASH_PAGE for page in pool.page_table[0])
+    assert pool.release(0) == 0
+    assert pool.assign(2, 3)            # freed pages immediately reusable
+
+
+def test_double_assign_is_an_invariant_violation():
+    pool = PagePool(num_pages=4, page_size=16, slots=2, max_pages_per_slot=2)
+    assert pool.assign(0, 1)
+    with pytest.raises(ValueError):
+        pool.assign(0, 1)
+    with pytest.raises(ValueError):
+        pool.assign(1, 3)               # over max_pages_per_slot
+
+
+def test_churn_never_fragments():
+    """Unit-size pages cannot fragment: after ANY alloc/release history,
+    n free pages satisfy any n-page request. Churn a pseudo-random-ish
+    pattern and assert a full-pool grant still succeeds."""
+    pool = PagePool(num_pages=12, page_size=8, slots=4, max_pages_per_slot=3)
+    for round_index in range(50):
+        for slot in range(4):
+            pool.release(slot)
+            assert pool.assign(slot, 1 + (round_index + slot) % 3)
+        for slot in range(4):
+            pool.release(slot)
+    assert pool.free_pages == 12
+    for slot in range(4):
+        assert pool.assign(slot, 3)     # 4 x 3 = the whole pool
+    assert pool.free_pages == 0
+
+
+# -- paged == contiguous == generate, exactly --------------------------------
+
+def test_paged_equals_contiguous_equals_generate(params):
+    """The tri-equality the tentpole hangs on: the same request through the
+    paged engine, the contiguous engine and single-tenant decode.generate
+    yields identical tokens, f32 greedy — cache layout is an implementation
+    detail, never a behavior."""
+    prompts = [list(range(3, 11)),       # len 8  -> bucket 16
+               [5],                      # len 1  -> no prefill
+               list(range(1, 21)),       # len 20 -> bucket 32
+               list(range(2, 14))]       # len 12 -> bucket 16
+    news = [6, 9, 4, 7]
+    paged = make_engine(params, paged=True, page_size=16)
+    contiguous = make_engine(params, paged=False)
+    for engine in (paged, contiguous):
+        handles = []
+        for prompt, new in zip(prompts, news):
+            handles.append(engine.submit(prompt, max_new_tokens=new))
+            engine.step()                # join mid-batch
+        drain(engine)
+        for prompt, new, handle in zip(prompts, news, handles):
+            summary = handle.result(timeout_s=5)
+            assert summary["outcome"] == "completed"
+            assert summary["tokens"] == reference_tokens(params, prompt, new)
+
+
+def test_page_recycling_after_leave_and_cancel_is_clean(params):
+    """Pages released by a finished AND a cancelled request are reissued to
+    the next joiner — which must still decode exactly like a fresh engine
+    (recycled pages carry the previous owner's K/V until overwritten; the
+    rewrite-before-attend argument must hold through recycling)."""
+    engine = make_engine(params, slots=1, page_size=16, kv_pages=6)
+    first = engine.submit(list(range(1, 41)), max_new_tokens=8)   # 3 pages
+    drain(engine)
+    assert first.result(timeout_s=5)["outcome"] == "completed"
+    assert engine.stats()["kvPagesFree"] == 6
+    cancelled = engine.submit(list(range(4, 40)), max_new_tokens=20)
+    engine.step()
+    engine.step()
+    cancelled.cancel()
+    engine.step()
+    assert cancelled.result(timeout_s=5)["outcome"] == "cancelled"
+    assert engine.stats()["kvPagesFree"] == 6     # cancel released them all
+    follow_up = engine.submit([9, 8, 7, 6, 5], max_new_tokens=8)
+    drain(engine)
+    assert (follow_up.result(timeout_s=5)["tokens"]
+            == reference_tokens(params, [9, 8, 7, 6, 5], 8))
+
+
+def test_zero_recompiles_across_page_assignments(params):
+    """Joins, leaves and every page reassignment in between must reuse the
+    warmed paged executables — the page table is a traced operand, so the
+    jit cache must not grow."""
+    engine = make_engine(params, page_size=16)
+    lens = (8, 20, 1, 40, 12, 28)
+    engine.warmup(prompt_lens=lens)
+    step_execs = engine.step_executable._cache_size()
+    prefill_execs = engine.prefill_executable._cache_size()
+    handles = []
+    for index, plen in enumerate(lens):
+        prompt = [(3 * index + j) % F32_TINY.vocab_size or 1
+                  for j in range(plen)]
+        handles.append(engine.submit(prompt, max_new_tokens=5,
+                                     temperature=0.0 if index % 2 else 0.6))
+        engine.step()
+    drain(engine)
+    assert all(h.result(timeout_s=5)["outcome"] == "completed"
+               for h in handles)
+    assert engine.step_executable._cache_size() == step_execs
+    assert engine.prefill_executable._cache_size() == prefill_execs
+
+
+# -- page-bound admission ----------------------------------------------------
+
+def test_exhausted_pool_queue_waits_then_completes(params):
+    """More requested context than the pool holds: later requests wait in
+    the queue for pages (NOT a capacity lie, NOT a deadlock) and every
+    request still completes as pages recycle."""
+    # 8 pages x 8 tokens; each request needs ceil((7+9)/8) = 2 pages, so
+    # only 4 of 6 requests fit concurrently despite 6 free slots
+    engine = make_engine(params, slots=6, page_size=8, kv_pages=8,
+                         queue_depth=8)
+    handles = [engine.submit([1 + i] * 7, max_new_tokens=9)
+               for i in range(6)]
+    engine.step()
+    waiting = engine.stats()
+    assert waiting["slotsBusy"] == 4          # page-bound, not slot-bound
+    assert waiting["queueDepth"] == 2
+    assert waiting["kvPagesFree"] == 0
+    assert engine.kv_page_saturation() == pytest.approx(1.0)
+    drain(engine)
+    for i, handle in enumerate(handles):
+        summary = handle.result(timeout_s=5)
+        assert summary["outcome"] == "completed"
+        assert summary["tokens"] == reference_tokens(params, [1 + i] * 7, 9)
+    assert engine.stats()["kvPagesFree"] == 8
+
+
+def test_pool_exhaustion_hits_queue_full_429_path(params):
+    """With pages exhausted AND the queue full, the next submit raises
+    QueueFullError (the API's 429) whose Retry-After accounts for the pages
+    the running sequences will release."""
+    engine = make_engine(params, slots=2, page_size=8, kv_pages=4,
+                         queue_depth=2)
+    engine.submit([1] * 7, max_new_tokens=9)   # 2 pages
+    engine.submit([2] * 7, max_new_tokens=9)   # 2 pages
+    engine.step()                               # both running, 0 pages free
+    engine.submit([3] * 7, max_new_tokens=9)   # waits for pages
+    engine.submit([4] * 7, max_new_tokens=9)   # queue now full
+    with pytest.raises(QueueFullError) as excinfo:
+        engine.submit([5] * 7, max_new_tokens=9)
+    assert excinfo.value.retry_after_s >= 1.0
+    drain(engine)
+
+
+def test_request_that_can_never_fit_is_rejected_up_front(params):
+    engine = make_engine(params, slots=2, page_size=8, kv_pages=4,
+                         max_len=96)
+    with pytest.raises(ValueError, match="KV pages"):
+        engine.submit([1] * 40, max_new_tokens=10)   # needs 7 > 4 pages
+
+
+def test_retry_after_accumulates_page_releases(params):
+    """A rejection that needs MORE pages than the first completion frees
+    must quote the later completion's ETA — walk the running sequences in
+    completion order, not just min(remaining)."""
+    engine = make_engine(params, slots=2, page_size=8, kv_pages=4,
+                         queue_depth=2)
+    short = engine.submit([1] * 7, max_new_tokens=2)    # 2 pages, done soon
+    long = engine.submit([2] * 7, max_new_tokens=9)     # 2 pages, done later
+    engine.step()          # both running: short has 1 token left, long 8
+    # seed the inter-token histogram so the estimate has a rate to use
+    for _ in range(3):
+        engine._intertoken_hist.observe(2.0)
+    # 1-page ask: the short request's 2-page release suffices
+    eta_small = engine._retry_after_locked(needed_pages=1)
+    # 4-page ask: must wait for BOTH -> bounded by the long request
+    eta_large = engine._retry_after_locked(needed_pages=4)
+    assert eta_large > eta_small
+    del short, long
+    drain(engine)
+
+
+# -- observability -----------------------------------------------------------
+
+def test_page_gauges_and_stats(params):
+    from tensorhive_tpu.observability import get_registry
+
+    engine = make_engine(params, slots=2, page_size=8, kv_pages=6)
+    handle = engine.submit([1] * 7, max_new_tokens=9)    # 2 pages
+    engine.step()
+    stats = engine.stats()
+    assert stats["paged"] is True
+    assert stats["pageSize"] == 8
+    assert stats["kvPagesTotal"] == 6
+    assert stats["kvPagesFree"] == 4
+    rendered = get_registry().render()
+    assert "tpuhive_generate_kv_pages_total 6" in rendered
+    assert "tpuhive_generate_kv_pages_free 4" in rendered
+    assert 'tpuhive_generate_slot_kv_pages{slot="0"} 2' in rendered
+    drain(engine)
+    assert handle.result(timeout_s=5)["outcome"] == "completed"
+    assert "tpuhive_generate_kv_pages_free 6" in get_registry().render()
+
+    contiguous = make_engine(params, paged=False)
+    stats = contiguous.stats()
+    assert stats["paged"] is False
+    assert stats["kvPagesTotal"] is None and stats["kvPagesFree"] is None
+    assert contiguous.kv_page_saturation() is None
+
+
+def test_kv_pages_exhausted_alert_source_and_rule(params, config):
+    from tensorhive_tpu.observability.alerts import (
+        _serving_kv_page_saturation,
+        default_rule_pack,
+    )
+
+    set_engine(None)
+    assert _serving_kv_page_saturation() is None         # disabled: silent
+    contiguous = make_engine(params, paged=False)
+    set_engine(contiguous)
+    try:
+        assert _serving_kv_page_saturation() is None     # rollback: silent
+    finally:
+        set_engine(None)
+    engine = make_engine(params, slots=2, page_size=8, kv_pages=4)
+    set_engine(engine)
+    try:
+        assert _serving_kv_page_saturation() == 0.0
+        engine.submit([1] * 7, max_new_tokens=9)
+        engine.submit([2] * 7, max_new_tokens=9)
+        engine.step()
+        assert _serving_kv_page_saturation() == pytest.approx(1.0)
+        drain(engine)
+        assert _serving_kv_page_saturation() == 0.0
+    finally:
+        set_engine(None)
+
+    rules = {rule.name: rule for rule in default_rule_pack()}
+    assert "kv_pages_exhausted" in rules
+    assert rules["kv_pages_exhausted"].threshold == pytest.approx(1.0)
+    assert rules["kv_pages_exhausted"].severity == "warning"

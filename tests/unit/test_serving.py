@@ -21,11 +21,7 @@ from tensorhive_tpu.serving import (
     get_engine,
     set_engine,
 )
-from tensorhive_tpu.serving.engine import (
-    SlotEngine,
-    _serving_prefill,
-    _serving_step,
-)
+from tensorhive_tpu.serving.engine import SlotEngine
 
 pytestmark = pytest.mark.skipif(
     len(jax.devices()) < 8, reason="needs the 8-device CPU platform"
@@ -117,16 +113,19 @@ def test_slot_reuse_matches_fresh_engine(params):
 
 # -- compile discipline ------------------------------------------------------
 
-def test_zero_recompiles_across_mixed_length_joins(params):
+@pytest.mark.parametrize("paged", [True, False])
+def test_zero_recompiles_across_mixed_length_joins(params, paged):
     """After warmup, mixed prompt lengths (across buckets), mixed
-    temperatures and every slot position must all reuse the SAME
-    executables: one step executable, one prefill executable per bucket.
-    The jit cache size is the ground truth the smoke gate also uses."""
-    engine = make_engine(params)
+    temperatures and every slot position — and, paged, every page
+    assignment — must all reuse the SAME executables: one step executable,
+    one prefill executable per bucket. The jit cache size is the ground
+    truth the smoke gate also uses; ``engine.step_executable`` points at
+    whichever jitted function this engine's layout dispatches."""
+    engine = make_engine(params, paged=paged)
     lens = (8, 20, 28, 40, 1, 56)
     engine.warmup(prompt_lens=lens)
-    step_execs = _serving_step._cache_size()
-    prefill_execs = _serving_prefill._cache_size()
+    step_execs = engine.step_executable._cache_size()
+    prefill_execs = engine.prefill_executable._cache_size()
     handles = []
     for index, plen in enumerate(lens):
         prompt = [(3 * index + j) % F32_TINY.vocab_size or 1
@@ -138,8 +137,8 @@ def test_zero_recompiles_across_mixed_length_joins(params):
     drain(engine)
     assert all(h.result(timeout_s=5)["outcome"] == "completed"
                for h in handles)
-    assert _serving_step._cache_size() == step_execs
-    assert _serving_prefill._cache_size() == prefill_execs
+    assert engine.step_executable._cache_size() == step_execs
+    assert engine.prefill_executable._cache_size() == prefill_execs
 
 
 # -- admission control -------------------------------------------------------

@@ -1,20 +1,26 @@
-"""CPU-backend serving smoke: continuous batching end to end.
+"""CPU-backend serving smoke: continuous batching + paged KV end to end.
 
-Boots the slot engine on the tiny CPU model and proves the four contracts
-the serving layer exists for (docs/SERVING.md):
+Boots the slot engine on the tiny CPU model and proves the contracts the
+serving layer exists for (docs/SERVING.md):
 
 1. **Liveness under concurrency** — >= 8 mixed-length requests (greedy and
    sampled) join and leave one running batch and ALL complete with the
    right token counts.
 2. **Zero decode recompiles after warmup** — the step/prefill executable
-   counts must not grow while mixed-length traffic joins mid-batch (the
-   whole point of traced per-slot state + bucketed prefill).
+   counts must not grow while mixed-length traffic joins mid-batch and
+   pages are assigned/recycled (the whole point of traced per-slot state,
+   traced page tables and bucketed prefill).
 3. **Batching is worth it** — batched throughput through the engine must
    beat the serial one-request-at-a-time path through the SAME engine by
    >= 2x (the continuous-batching claim, measured not asserted).
 4. **Admission control sheds load** — with the queue full, exactly one
-   extra submit is rejected (the API layer's 429) and the queue/slot
+   extra submit is rejected (the API layer's 429) and the queue/slot/page
    metrics are present in the exposition.
+5. **Paging decouples capacity from context length** — at EQUAL cache HBM,
+   a paged engine admits >= 1.5x more concurrent sequences than the
+   contiguous engine when the summed requested context exceeds what the
+   contiguous layout can hold, all of them complete, and none of it
+   recompiles anything.
 
 Run via ``make serving-smoke``; CI runs it after the chaos gate so a
 serving regression fails before the full suite spins up.
@@ -39,11 +45,7 @@ jax.config.update("jax_platforms", "cpu")
 from tensorhive_tpu.models.transformer import PRESETS, TransformerLM  # noqa: E402
 from tensorhive_tpu.observability import get_registry  # noqa: E402
 from tensorhive_tpu.serving import QueueFullError  # noqa: E402
-from tensorhive_tpu.serving.engine import (  # noqa: E402
-    SlotEngine,
-    _serving_prefill,
-    _serving_step,
-)
+from tensorhive_tpu.serving.engine import SlotEngine  # noqa: E402
 
 SLOTS = 8
 NEW_TOKENS = 12
@@ -51,12 +53,35 @@ NEW_TOKENS = 12
 #: single-token prompt exercises the no-prefill join
 PROMPT_LENS = (20, 28, 40, 56, 1, 20, 40, 56)
 
+#: scenario 5 — equal-HBM capacity comparison. The contiguous engine gets
+#: CONTIG_SLOTS x MAX_LEN cache cells; the paged engine gets the SAME cell
+#: count as pages (OVERCOMMIT_PAGES x PAGE_SIZE) spread over more slots.
+#: Each long request needs ceil((33 + 7) / 16) = 3 pages, so the summed
+#: requested context (8 x 40 = 320) exceeds the 256-cell HBM budget and
+#: NEITHER engine can hold all 8 at once — the paged one just holds 2.5x
+#: more (5 vs 2) because slots no longer reserve max_len upfront.
+MAX_LEN = 128
+CONTIG_SLOTS = 2
+PAGE_SIZE = 16
+OVERCOMMIT_PAGES = CONTIG_SLOTS * MAX_LEN // PAGE_SIZE      # equal HBM
+LONG_PROMPT, LONG_NEW, LONG_REQUESTS = 33, 7, 8
+
+
+def drain_tracking_busy(engine) -> int:
+    """Drain the engine, returning the max concurrently-busy slot count
+    observed — the 'concurrent admitted sequences' number of scenario 5."""
+    max_busy = 0
+    while engine.has_work():
+        engine.step()
+        max_busy = max(max_busy, engine.stats()["slotsBusy"])
+    return max_busy
+
 
 def main() -> int:
     failures = []
     config = PRESETS["tiny"]
     params = TransformerLM.init(jax.random.PRNGKey(0), config)
-    engine = SlotEngine(params, config, slots=SLOTS, max_len=128,
+    engine = SlotEngine(params, config, slots=SLOTS, max_len=MAX_LEN,
                         queue_depth=SLOTS, max_new_tokens_cap=64)
     engine.warmup(prompt_lens=PROMPT_LENS)
 
@@ -77,8 +102,8 @@ def main() -> int:
     serial_s = time.perf_counter() - started
 
     # -- batched storm: everyone joins/leaves one running batch ------------
-    step_execs = _serving_step._cache_size()
-    prefill_execs = _serving_prefill._cache_size()
+    step_execs = engine.step_executable._cache_size()
+    prefill_execs = engine.prefill_executable._cache_size()
     started = time.perf_counter()
     handles = [engine.submit(prompt, max_new_tokens=NEW_TOKENS,
                              temperature=0.0 if index % 2 == 0 else 0.8)
@@ -95,13 +120,13 @@ def main() -> int:
                 f"P={plen}: {len(summary['tokens'])} tokens, "
                 f"wanted {NEW_TOKENS}")
 
-    step_growth = _serving_step._cache_size() - step_execs
-    prefill_growth = _serving_prefill._cache_size() - prefill_execs
+    step_growth = engine.step_executable._cache_size() - step_execs
+    prefill_growth = engine.prefill_executable._cache_size() - prefill_execs
     if step_growth or prefill_growth:
         failures.append(
             f"recompiles after warmup: step +{step_growth}, "
-            f"prefill +{prefill_growth} — per-slot state leaked into a "
-            "static shape")
+            f"prefill +{prefill_growth} — per-slot state or a page table "
+            "leaked into a static shape")
 
     speedup = serial_s / batched_s
     if speedup < 2.0:
@@ -125,12 +150,62 @@ def main() -> int:
         if handle.result(timeout_s=5)["outcome"] != "completed":
             failures.append("parked request did not complete after drain")
 
-    # -- queue/SLO metrics present in the exposition ------------------------
+    # -- paged vs contiguous at EQUAL HBM: long-context over-commit --------
+    def long_prompts():
+        return [[(5 * i + j) % config.vocab_size or 1
+                 for j in range(LONG_PROMPT)] for i in range(LONG_REQUESTS)]
+
+    requested = LONG_REQUESTS * (LONG_PROMPT + LONG_NEW)
+    hbm_cells = OVERCOMMIT_PAGES * PAGE_SIZE
+    assert requested > hbm_cells, "scenario must over-commit the HBM budget"
+
+    paged = SlotEngine(params, config, slots=SLOTS, max_len=MAX_LEN,
+                       queue_depth=LONG_REQUESTS, paged=True,
+                       page_size=PAGE_SIZE, kv_pages=OVERCOMMIT_PAGES)
+    paged.warmup(prompt_lens=(LONG_PROMPT,))
+    paged_step_execs = paged.step_executable._cache_size()
+    paged_prefill_execs = paged.prefill_executable._cache_size()
+    paged_handles = [paged.submit(prompt, max_new_tokens=LONG_NEW)
+                     for prompt in long_prompts()]
+    paged_busy = drain_tracking_busy(paged)
+    if not all(h.result(timeout_s=5)["outcome"] == "completed"
+               for h in paged_handles):
+        failures.append("paged over-commit: not every request completed")
+    if (paged.step_executable._cache_size() != paged_step_execs
+            or paged.prefill_executable._cache_size()
+            != paged_prefill_execs):
+        failures.append("paged over-commit: page assignment recompiled an "
+                        "executable")
+
+    contiguous = SlotEngine(params, config, slots=CONTIG_SLOTS,
+                            max_len=MAX_LEN, queue_depth=LONG_REQUESTS,
+                            paged=False)
+    contiguous.warmup(prompt_lens=(LONG_PROMPT,))
+    contiguous_handles = [contiguous.submit(prompt,
+                                            max_new_tokens=LONG_NEW)
+                          for prompt in long_prompts()]
+    contiguous_busy = drain_tracking_busy(contiguous)
+    if not all(h.result(timeout_s=5)["outcome"] == "completed"
+               for h in contiguous_handles):
+        failures.append("contiguous over-commit: not every request "
+                        "completed")
+
+    concurrency_gain = paged_busy / max(1, contiguous_busy)
+    if concurrency_gain < 1.5:
+        failures.append(
+            f"paged engine admitted only {concurrency_gain:.2f}x the "
+            f"contiguous concurrency at equal HBM ({paged_busy} vs "
+            f"{contiguous_busy}); wanted >= 1.5x")
+
+    # -- queue/SLO/page metrics present in the exposition -------------------
     rendered = get_registry().render()
     for family in ("tpuhive_generate_queue_depth",
                    "tpuhive_generate_slots_busy",
                    "tpuhive_generate_ttft_seconds",
                    "tpuhive_generate_batch_efficiency",
+                   "tpuhive_generate_kv_pages_free",
+                   "tpuhive_generate_kv_pages_total",
+                   "tpuhive_generate_slot_kv_pages",
                    'tpuhive_generate_requests_total{outcome="rejected_queue"}'):
         if family not in rendered:
             failures.append(f"metric missing from exposition: {family}")
@@ -139,9 +214,11 @@ def main() -> int:
     print(f"serving-smoke: {len(PROMPT_LENS)} requests x {NEW_TOKENS} tokens "
           f"on {SLOTS} slots | serial {total / serial_s:.1f} tok/s, "
           f"batched {total / batched_s:.1f} tok/s ({speedup:.2f}x) | "
-          f"step_execs={_serving_step._cache_size()} "
-          f"prefill_execs={_serving_prefill._cache_size()} | "
-          f"stats={engine.stats()}")
+          f"step_execs={engine.step_executable._cache_size()} "
+          f"prefill_execs={engine.prefill_executable._cache_size()} | "
+          f"over-commit {requested} tokens into {hbm_cells} HBM cells: "
+          f"paged {paged_busy} vs contiguous {contiguous_busy} concurrent "
+          f"({concurrency_gain:.2f}x) | stats={engine.stats()}")
     for failure in failures:
         print(f"serving-smoke FAILURE: {failure}", file=sys.stderr)
     return 1 if failures else 0
