@@ -219,7 +219,8 @@ async function refreshServing() {
     ${servingBadge("slots", stats.slotsBusy + "/" + stats.slots,
                    stats.slotsBusy >= stats.slots && stats.queueDepth > 0)}
     ${stats.kvPagesTotal == null ? "" :
-      servingBadge("KV pages", stats.kvPagesFree + "/" + stats.kvPagesTotal,
+      servingBadge("KV pages · " + stats.pagedKernel,
+                   stats.kvPagesFree + "/" + stats.kvPagesTotal,
                    stats.kvPagesFree === 0)}
     ${servingBadge("TTFT p50/p95",
                    ms(stats.ttftP50Ms) + " / " + ms(stats.ttftP95Ms), false)}

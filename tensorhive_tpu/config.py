@@ -177,6 +177,10 @@ class GenerationConfig:
     page_size: int = 16              # tokens per KV page
     kv_pages: int = 0                # 0 = slots * ceil(max_len / page_size)
                                      # (the contiguous layout's HBM)
+    paged_kernel: str = "auto"       # fused paged-attention decode kernel:
+                                     # auto = pallas on real TPU, XLA page
+                                     # gather elsewhere; on/off force a
+                                     # dispatch (docs/SERVING.md)
     queue_depth: int = 32
     max_new_tokens: int = 128        # per-request cap
     top_k: int = 0                   # 0 = no top-k sampling filter
@@ -418,6 +422,7 @@ enabled = false
 # paged = true        # false: contiguous per-slot cache rollback
 # page_size = 16
 # kv_pages = 0        # 0 = equal HBM to the contiguous layout
+# paged_kernel = "auto"  # fused decode kernel: auto|on|off
 # queue_depth = 32
 # max_new_tokens = 128
 # max_concurrent_per_user = 4

@@ -47,6 +47,9 @@ STATS_SCHEMA = obj(
     maxSeqLen=s("integer"),
     paged=s("boolean"),
     pageSize=s("integer", nullable=True),
+    #: which paged decode attention dispatch compiled: "pallas" (the fused
+    #: page-table kernel), "xla" (the gather reference) or null (contiguous)
+    pagedKernel=s("string", nullable=True),
     kvPagesTotal=s("integer", nullable=True),
     kvPagesFree=s("integer", nullable=True),
     requestsCompleted=s("integer"),

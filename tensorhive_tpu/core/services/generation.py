@@ -88,6 +88,7 @@ def build_engine(config: Config):
         paged=generation.paged,
         page_size=generation.page_size,
         kv_pages=generation.kv_pages,
+        paged_kernel=generation.paged_kernel,
         queue_depth=generation.queue_depth,
         top_k=generation.top_k or None,
         eos_token=None if generation.eos_token < 0 else generation.eos_token,

@@ -80,32 +80,52 @@ def _decode_attend(q, k_cache, v_cache, position):
     return out.reshape(batch, 1, heads, d_head).astype(q.dtype)
 
 
-def _paged_attend(q, k_pages, v_pages, page_table, position):
-    """Paged-cache decode attention: gather each slot's pages, then the
-    SAME masked grouped math as :func:`_decode_attend`.
+def _paged_attend(q, k_pages, v_pages, page_table, positions,
+                  use_kernel: bool = False,
+                  interpret: Optional[bool] = None):
+    """Paged-cache decode attention, two dispatches behind one signature
+    (the ``use_flash`` pattern — serving/engine.py prefill):
+
+    * **XLA gather path** (``use_kernel=False``, the reference): gather
+      each slot's pages into logical order, then the SAME masked grouped
+      math as :func:`_decode_attend`. f32-EXACT against the contiguous
+      engine and ``decode.generate`` (test_paging.py) — but it
+      materializes a ``[S, max_pages*page_size, Hkv, Dh]`` copy of every
+      slot's pages each step (the gather tax docs/PERF.md measures).
+    * **Fused pallas kernel** (``use_kernel=True``,
+      :func:`~tensorhive_tpu.ops.paged_attention.paged_attention`): the
+      grid walks the page table and streams K/V straight from their
+      physical pages with online-softmax accumulation — no gathered
+      intermediate. Within ~1e-7 of the gather path in f32 (accumulation
+      order; tolerance rationale in docs/SERVING.md), greedy tokens
+      pinned identical.
 
     q: [S,1,H,Dh]; ``k_pages``/``v_pages`` are one layer of the paged cache
     [num_pages, page_size, Hkv, Dh]; ``page_table`` [S, max_pages] holds
-    physical page indices (a traced operand — page assignment must never be
-    a shape, or every admission would recompile); ``position`` broadcasts
-    per slot like the contiguous path.
+    physical page indices and ``positions`` [S] each slot's current
+    position — both traced operands (page assignment must never be a
+    shape, or every admission would recompile).
 
-    The gather reconstructs a contiguous [S, max_pages*page_size, Hkv, Dh]
-    per-slot view: logical position p of slot s lives at
-    ``(page_table[s, p // page_size], p % page_size)``, so reshaping the
-    gathered pages lays keys out in logical order and the ``<= position``
-    mask inside ``_decode_attend`` applies unchanged. Entries still
-    pointing at the trash page hold other sequences' (or garbage) K/V, but
-    every such logical position is > the slot's position — masked to -1e30,
-    exp-underflowed to exactly 0.0 in the softmax — which is why paged
-    output is f32-EXACT against the contiguous engine and
-    ``decode.generate`` (test_paging.py), not merely close."""
+    The gather reconstructs a contiguous per-slot view: logical position p
+    of slot s lives at ``(page_table[s, p // page_size], p % page_size)``,
+    so reshaping the gathered pages lays keys out in logical order and the
+    ``<= position`` mask inside :func:`_decode_attend` applies unchanged.
+    Entries still pointing at the trash page hold other sequences' (or
+    garbage) K/V, but every such logical position is > the slot's position
+    — masked to -1e30, exp-underflowed to exactly 0.0 in the softmax (the
+    kernel applies the identical mask per page block)."""
+    if use_kernel:
+        from ..ops.paged_attention import paged_attention
+
+        return paged_attention(q, k_pages, v_pages, page_table, positions,
+                               interpret=interpret)
     num_slots, max_pages = page_table.shape
     page_size = k_pages.shape[1]
     window = max_pages * page_size
     k = k_pages[page_table].reshape(num_slots, window, *k_pages.shape[2:])
     v = v_pages[page_table].reshape(num_slots, window, *v_pages.shape[2:])
-    return _decode_attend(q, k, v, position)
+    return _decode_attend(q, k, v,
+                          positions[:, None, None, None, None])
 
 
 def apply_step(

@@ -1,0 +1,221 @@
+"""Fused paged-attention decode kernel: K/V read THROUGH the page table.
+
+PR 7's paged KV cache decoupled serving capacity from context length but
+paid for it on the hot path: ``models/decode._paged_attend`` materializes a
+contiguous ``[slots, max_pages * page_size, Hkv, Dh]`` copy of every slot's
+pages via ``k_pages[page_table]`` on EVERY decode step — on CPU a measured
+0.88× tokens/s vs the contiguous layout, and on TPU roughly double the
+decode HBM traffic in a regime docs/PERF.md documents as bandwidth-bound.
+This kernel deletes the gathered intermediate: the grid walks each slot's
+page-table row and streams K/V pages **directly from their physical
+locations**, accumulating the attended output with an online softmax.
+
+Mechanics (the idiom of ``ops/flash_attention.py``, adapted to paging):
+
+* **Grid (slots, max_pages_per_slot)**, pages innermost. The page table and
+  per-slot positions ride in as **scalar-prefetch operands**
+  (``pltpu.PrefetchScalarGridSpec``) so the K/V BlockSpec index maps can
+  resolve *logical page j of slot s* to its **physical** page
+  ``page_table[s, j]`` before the kernel body runs — the gather IS the
+  read, no intermediate buffer ever exists. Both stay traced operands of
+  the enclosing jit, so page assignment never recompiles (the same
+  discipline as the XLA gather path).
+* **Online softmax per page block** in f32 VMEM scratch (running max +
+  denominator, exactly ``_fwd_kernel``'s recurrence), finalized once on the
+  last page. Per-page masking compares each logical offset
+  ``j * page_size + k`` against the slot's position, so trash-page entries
+  at logical positions > position contribute exactly 0 by exp-underflow —
+  the identical masking argument the gather path relies on.
+* **Early exit past the live window**: compute is gated on
+  ``j * page_size <= position`` (``pl.when``), and the K/V index map clamps
+  ``j`` to the slot's last live page, so blocks past ``position //
+  page_size`` re-select the block already resident in VMEM — the pipeline
+  issues **no DMA** for them (pallas only fetches when the mapped block
+  index changes). Trash-page entries are never even read.
+* **GQA-native reads at ``kv_heads`` width**: query heads are grouped
+  ``head i -> kv head i // group`` (the training expand's convention) and
+  K/V blocks are read unexpanded — the per-kv-head 2D dots keep the MXU on
+  ``[group, page_size]`` tiles with no expanded copy, mirroring the
+  ``b // group`` index maps of the flash kernels.
+
+Numerics: the online-softmax recurrence rescales partial sums by
+``exp(m_old - m_new)`` where the gather path subtracts one global max — the
+same math at different accumulation order, so kernel output is within a few
+ULP of the gather path (~1e-7 absolute in f32) but NOT bit-identical; the
+greedy token stream is unaffected (pinned exactly by the parity tests) and
+docs/SERVING.md records the tolerance rationale. Non-TPU backends run the
+kernel in interpret mode (CPU tests) or fall back to the XLA gather, chosen
+by :func:`resolve_paged_kernel` — the ``[generation_service] paged_kernel``
+knob's ``auto`` mode.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+#: per-program VMEM budget, ``RESIDENT_KV_MAX_BYTES``-style: one program
+#: holds q + out + one K/V page + the f32 accumulator/stat scratch. Decode
+#: pages are tiny (a 16-position page at Hkv=8, Dh=128 in bf16 is 32 KiB),
+#: so this only gates pathological page_size/d_head combinations out of
+#: ``auto`` — the knob's ``on`` stays an explicit operator override.
+PAGED_KERNEL_MAX_BYTES = 4 * 1024 * 1024
+
+
+def kernel_fits(page_size: int, kv_heads: int, d_head: int, heads: int,
+                dtype) -> bool:
+    """True when one grid program's working set fits the VMEM budget —
+    ``default_blocks``-style sizing, except paging fixes the block shape
+    (one physical page) so the heuristic gates dispatch instead of picking
+    a block size."""
+    itemsize = jnp.dtype(dtype).itemsize
+    kv_page = 2 * page_size * kv_heads * d_head * itemsize
+    q_out = 2 * heads * d_head * itemsize
+    scratch = (heads * d_head + 2 * heads * 128) * 4      # f32 acc + m/l
+    return kv_page + q_out + scratch <= PAGED_KERNEL_MAX_BYTES
+
+
+def resolve_paged_kernel(mode: str, *, page_size: int, kv_heads: int,
+                         d_head: int, heads: int, dtype) -> str:
+    """Resolve the ``[generation_service] paged_kernel`` knob to the
+    dispatch actually used: ``"pallas"`` or ``"xla"``.
+
+    ``on`` forces the kernel (interpret mode off-TPU — the CPU test/smoke
+    path); ``off`` forces the XLA gather reference; ``auto`` uses the
+    kernel on a real TPU when the working set fits VMEM and the gather
+    path everywhere else — mirroring how ``use_flash`` keeps the XLA
+    reference attention as the portable fallback."""
+    if mode not in ("auto", "on", "off"):
+        raise ValueError(
+            f"paged_kernel must be auto|on|off, got {mode!r}")
+    if mode == "on":
+        return "pallas"
+    if mode == "off":
+        return "xla"
+    if (jax.default_backend() == "tpu"
+            and kernel_fits(page_size, kv_heads, d_head, heads, dtype)):
+        return "pallas"
+    return "xla"
+
+
+def _decode_kernel(page_table_ref, positions_ref, q_ref, k_ref, v_ref,
+                   out_ref, acc_ref, m_ref, l_ref, *, page_size: int,
+                   kv_heads: int):
+    """Grid (slots, pages), pages innermost. Blocks: q/out [1, H, Dh] per
+    slot; k/v [1, page_size, Hkv, Dh] — ONE physical page, selected by the
+    index map through the prefetched page table. Scratch (f32): acc
+    [H, Dh], m/l [H, 128] (lane-replicated row stats, the flash layout)."""
+    slot = pl.program_id(0)
+    page = pl.program_id(1)
+    last_page = pl.num_programs(1) - 1
+    position = positions_ref[slot]
+
+    @pl.when(page == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # pages past position // page_size hold nothing visible (and their
+    # block index was clamped, so nothing was fetched): skip the compute
+    @pl.when(page * page_size <= position)
+    def _compute():
+        q = q_ref[0]                                    # [H, Dh]
+        heads, d_head = q.shape
+        group = heads // kv_heads
+        scale = d_head ** -0.5
+        logical = page * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, (1, page_size), 1)
+        visible = logical <= position                   # [1, page_size]
+        # per-kv-head 2D dots (kv_heads is static, the loop unrolls): input
+        # dtype on the MXU, f32 accumulation — _online_softmax_block's rule
+        scores = jnp.concatenate([
+            jnp.dot(q[h * group:(h + 1) * group], k_ref[0, :, h, :].T,
+                    preferred_element_type=jnp.float32)
+            for h in range(kv_heads)], axis=0) * scale  # [H, page_size]
+        scores = jnp.where(visible, scores, NEG_INF)
+        m_prev = m_ref[:, 0]
+        l_prev = l_ref[:, 0]
+        block_max = jnp.max(scores, axis=-1)
+        # this block always contains a visible key (the gate above), so
+        # new_max is finite from the first update and masked scores
+        # contribute exp(NEG_INF - finite) == 0 by underflow — no re-mask
+        new_max = jnp.maximum(m_prev, block_max)
+        correction = jnp.exp(m_prev - new_max)
+        probs = jnp.exp(scores - new_max[:, None])      # [H, page_size] f32
+        acc_ref[...] = acc_ref[...] * correction[:, None] + jnp.concatenate([
+            jnp.dot(probs[h * group:(h + 1) * group].astype(v_ref.dtype),
+                    v_ref[0, :, h, :], preferred_element_type=jnp.float32)
+            for h in range(kv_heads)], axis=0)
+        row_sum = l_prev * correction + jnp.sum(probs, axis=-1)
+        m_ref[...] = jnp.broadcast_to(new_max[:, None], m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(row_sum[:, None], l_ref.shape)
+
+    @pl.when(page == last_page)
+    def _finalize():
+        row_sum = l_ref[:, 0]
+        denom = jnp.where(row_sum == 0.0, 1.0, row_sum)
+        out_ref[0] = (acc_ref[...] / denom[:, None]).astype(out_ref.dtype)
+
+
+def paged_attention(
+    q: jax.Array,               # [S, 1, H, Dh]
+    k_pages: jax.Array,         # [num_physical, page_size, Hkv, Dh]
+    v_pages: jax.Array,
+    page_table: jax.Array,      # [S, max_pages_per_slot] int32
+    positions: jax.Array,       # [S] int32 — attend to logical <= position
+    interpret: Optional[bool] = None,
+) -> jax.Array:
+    """Paged decode attention with zero gathered intermediate: the attended
+    output of :func:`~tensorhive_tpu.models.decode._paged_attend`'s gather
+    path, computed by streaming each slot's pages from their physical
+    locations. ``page_table``/``positions`` are values, never shapes —
+    callers inside a jit keep the zero-recompile contract."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    num_slots, _, heads, d_head = q.shape
+    page_size, kv_heads = k_pages.shape[1], k_pages.shape[2]
+    max_pages = page_table.shape[1]
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+
+    def q_map(slot, page, table, positions):
+        return (slot, 0, 0)
+
+    def kv_map(slot, page, table, positions):
+        # clamp to the slot's last live page: blocks past the boundary
+        # re-select the resident block, so the pipeline fetches nothing
+        # for them (pallas only issues a DMA when the index changes) —
+        # trash-page entries are never read, not merely masked
+        live = jnp.maximum(positions[slot], 0) // page_size
+        return (table[slot, jnp.minimum(page, live)], 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(num_slots, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, heads, d_head), q_map),
+            pl.BlockSpec((1, page_size, kv_heads, d_head), kv_map),
+            pl.BlockSpec((1, page_size, kv_heads, d_head), kv_map),
+        ],
+        out_specs=pl.BlockSpec((1, heads, d_head), q_map),
+        scratch_shapes=[
+            pltpu.VMEM((heads, d_head), jnp.float32),
+            pltpu.VMEM((heads, 128), jnp.float32),
+            pltpu.VMEM((heads, 128), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, page_size=page_size,
+                          kv_heads=kv_heads),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((num_slots, heads, d_head), q.dtype),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), positions.astype(jnp.int32),
+      q[:, 0], k_pages, v_pages)
+    return out[:, None]

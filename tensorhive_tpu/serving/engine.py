@@ -78,6 +78,7 @@ from ..models.transformer import (
     _rmsnorm,
 )
 from ..observability import get_registry, Histogram
+from ..ops.paged_attention import resolve_paged_kernel
 from . import QueueFullError, RateLimitError
 from .paging import PagePool
 
@@ -208,7 +209,8 @@ _serving_step = functools.partial(
 
 def _paged_step_body(params, tokens, positions, active, temps, page_tables,
                      cache, key, config: TransformerConfig,
-                     top_k: Optional[int]):
+                     top_k: Optional[int], use_kernel: bool = False,
+                     interpret: bool = False):
     """One fused decode step over the PAGED cache.
 
     Identical to :func:`_step_body` except for where K/V live: the cache is
@@ -219,6 +221,13 @@ def _paged_step_body(params, tokens, positions, active, temps, page_tables,
     of per-slot state, so page assignment (the thing that changes on every
     join/leave) never produces a new shape and never recompiles — the same
     discipline that makes the contiguous engine's joins free.
+
+    ``use_kernel``/``interpret`` are STATIC (they pick the attend dispatch,
+    resolved once at engine construction from the ``paged_kernel`` knob):
+    True streams K/V through the fused pallas kernel
+    (``ops/paged_attention.py``) instead of the XLA page gather —
+    fingerprinted separately as ``serving_paged_step_kernel`` so operators
+    can see which dispatch compiled.
 
     Parked slots (``active`` False, page-table row reset to the trash page,
     position frozen at 0) scatter their garbage K/V into physical page 0,
@@ -246,7 +255,8 @@ def _paged_step_body(params, tokens, positions, active, temps, page_tables,
         cache_v = jax.lax.dynamic_update_slice(
             cache_v, layer_v[None], (layer, 0, 0, 0, 0))
         return _paged_attend(q, cache_k[layer], cache_v[layer], page_tables,
-                             positions[:, None, None, None, None])
+                             positions, use_kernel=use_kernel,
+                             interpret=interpret)
 
     for layer_index, block in enumerate(params["blocks"]):
         x = TransformerLM.block_forward(x, block, config, rope_positions,
@@ -257,7 +267,8 @@ def _paged_step_body(params, tokens, positions, active, temps, page_tables,
 
 
 _paged_serving_step = functools.partial(
-    jax.jit, static_argnames=("config", "top_k"),
+    jax.jit,
+    static_argnames=("config", "top_k", "use_kernel", "interpret"),
     donate_argnames=("cache",))(_paged_step_body)
 
 
@@ -474,6 +485,7 @@ class SlotEngine:
         paged: bool = True,
         page_size: int = 16,
         kv_pages: int = 0,
+        paged_kernel: str = "auto",
         clock: Callable[[], float] = time.monotonic,
     ) -> None:
         if not config.causal:
@@ -516,6 +528,16 @@ class SlotEngine:
             if page_size < 1:
                 raise ValueError(f"page_size must be >= 1, got {page_size}")
             self.page_size = int(page_size)
+            # resolve the paged_kernel knob ONCE (auto|on|off ->
+            # pallas|xla); the result rides into the step executable as a
+            # STATIC arg, so the dispatch is part of the compile
+            # fingerprint, never a per-step branch
+            self.paged_kernel = resolve_paged_kernel(
+                paged_kernel, page_size=self.page_size,
+                kv_heads=config.kv_heads, d_head=config.d_head,
+                heads=config.n_heads, dtype=config.dtype)
+            self._use_kernel = self.paged_kernel == "pallas"
+            self._kernel_interpret = jax.default_backend() != "tpu"
             max_pages_per_slot = -(-self.max_len // self.page_size)
             #: 0 = the contiguous engine's HBM at the same slot count — the
             #: rollback-neutral default; serving more sequences at equal
@@ -531,6 +553,9 @@ class SlotEngine:
         else:
             self.page_size = None
             self._pool = None
+            self.paged_kernel = None
+            self._use_kernel = False
+            self._kernel_interpret = False
             shape = (config.n_layers, self.capacity, self.max_len,
                      config.kv_heads, config.d_head)
         self._cache = KVCache(k=jnp.zeros(shape, config.dtype),
@@ -729,16 +754,25 @@ class SlotEngine:
 
     def _run_step(self):
         if self.paged:
-            _count_compile("serving_paged_step",
-                           ("serving_paged_step", self.config, self.capacity,
+            # the kernel dispatch gets its own fingerprint so operators can
+            # tell WHICH paged step compiled (docs/OBSERVABILITY.md); page
+            # tables/positions stay traced operands either way — page
+            # assignment never recompiles regardless of dispatch
+            fn = ("serving_paged_step_kernel" if self._use_kernel
+                  else "serving_paged_step")
+            _count_compile(fn,
+                           (fn, self.config, self.capacity,
                             self._pool.num_pages, self.page_size,
-                            self._pool.max_pages_per_slot, self.top_k))
+                            self._pool.max_pages_per_slot, self.top_k,
+                            self._kernel_interpret))
             return _paged_serving_step(
                 self.params, jnp.asarray(self._tokens),
                 jnp.asarray(self._positions), jnp.asarray(self._active),
                 jnp.asarray(self._temps), jnp.asarray(self._pool.page_table),
                 self._cache, self._key,
-                config=self.config, top_k=self.top_k)
+                config=self.config, top_k=self.top_k,
+                use_kernel=self._use_kernel,
+                interpret=self._kernel_interpret)
         _count_compile("serving_step",
                        ("serving_step", self.config, self.capacity,
                         self.max_len, self.top_k))
@@ -934,6 +968,7 @@ class SlotEngine:
                 "maxSeqLen": self.max_len,
                 "paged": self.paged,
                 "pageSize": self.page_size,
+                "pagedKernel": self.paged_kernel,
                 "kvPagesTotal": self._pool.num_pages if self.paged else None,
                 "kvPagesFree": self._pool.free_pages if self.paged else None,
                 "requestsCompleted": self.completed_requests,

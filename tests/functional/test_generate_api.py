@@ -181,6 +181,9 @@ def test_generate_stats_snapshot(api, pump, user_headers):
     assert doc["paged"] is True
     assert doc["kvPagesTotal"] >= 1
     assert doc["kvPagesFree"] == doc["kvPagesTotal"]
+    # the attend dispatch the engine resolved from the paged_kernel knob
+    # ("auto" off-TPU -> the XLA gather reference) — the KV badge renders it
+    assert doc["pagedKernel"] == "xla"
 
 
 def test_generate_disabled_answers_503(api, user_headers):

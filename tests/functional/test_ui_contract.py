@@ -171,6 +171,10 @@ def test_serving_strip_renders_page_pool_badge():
     source = (STATIC_DIR / "js" / "nodes.js").read_text()
     assert 'stats.kvPagesFree + "/" + stats.kvPagesTotal' in source
     assert "stats.kvPagesTotal == null" in source   # hidden for contiguous
+    # the badge also names the attend dispatch that compiled ("pallas" for
+    # the fused page-table kernel, "xla" for the gather reference) from the
+    # exact pagedKernel field the stats endpoint exports
+    assert '"KV pages · " + stats.pagedKernel' in source
 
 
 # ---------------------------------------------------------------------------

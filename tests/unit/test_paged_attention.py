@@ -1,0 +1,198 @@
+"""Fused paged-attention decode kernel vs the XLA gather reference.
+
+The kernel (``ops/paged_attention.py``) streams K/V through the page table
+with online-softmax accumulation; the gather path
+(``models/decode._paged_attend`` with ``use_kernel=False``) materializes
+the pages in logical order and runs the dense masked math. Same
+mathematics, different accumulation order — so the float outputs agree to
+a few ULP (``TOL``, rationale in docs/SERVING.md "Paged KV cache"), and
+the engine-level greedy token parity is pinned EXACTLY in
+test_paging.py's parametrized tri-equality.
+
+Everything runs the kernel in interpret mode (CPU backend), so Tier-1
+covers the whole dispatch without a TPU. Cases the paging design makes
+load-bearing: positions straddling page boundaries, a parked slot whose
+page-table row points at the trash page, and a freed-then-recycled page
+shared into a new slot's table.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorhive_tpu.models import decode
+from tensorhive_tpu.ops.paged_attention import (
+    kernel_fits,
+    paged_attention,
+    resolve_paged_kernel,
+)
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device CPU platform"
+)
+
+#: f32 ULP-scale agreement bound between the two accumulation orders
+TOL = 5e-6
+
+
+def random_case(seed, *, slots, heads, kv_heads, d_head, page_size,
+                num_pages, max_pages):
+    keys = jax.random.split(jax.random.PRNGKey(seed), 3)
+    q = jax.random.normal(keys[0], (slots, 1, heads, d_head), jnp.float32)
+    k_pages = jax.random.normal(
+        keys[1], (1 + num_pages, page_size, kv_heads, d_head), jnp.float32)
+    v_pages = jax.random.normal(
+        keys[2], (1 + num_pages, page_size, kv_heads, d_head), jnp.float32)
+    return q, k_pages, v_pages
+
+
+def gather_reference(q, k_pages, v_pages, page_table, positions):
+    return decode._paged_attend(q, k_pages, v_pages, page_table, positions,
+                                use_kernel=False)
+
+
+def assert_close(kernel_out, reference_out):
+    np.testing.assert_allclose(np.asarray(kernel_out),
+                               np.asarray(reference_out),
+                               atol=TOL, rtol=TOL)
+
+
+@pytest.mark.parametrize("page_size", [8, 16])
+@pytest.mark.parametrize("kv_heads,heads", [(4, 4), (2, 4)])  # MHA, GQA g=2
+def test_kernel_matches_gather_reference(page_size, kv_heads, heads):
+    """The headline parity: every (page_size, GQA group) combination the
+    serving configs use, random pages, random non-trivial page tables."""
+    slots, d_head, num_pages, max_pages = 4, 16, 11, 4
+    q, k_pages, v_pages = random_case(
+        page_size, slots=slots, heads=heads, kv_heads=kv_heads,
+        d_head=d_head, page_size=page_size, num_pages=num_pages,
+        max_pages=max_pages)
+    page_table = jnp.asarray([[3, 7, 1, 9],
+                              [5, 2, 0, 0],
+                              [10, 4, 8, 6],
+                              [11, 0, 0, 0]], jnp.int32)
+    positions = jnp.asarray(
+        [4 * page_size - 2, 2 * page_size - 1, 3 * page_size + 1, 3],
+        jnp.int32)
+    out = paged_attention(q, k_pages, v_pages, page_table, positions,
+                          interpret=True)
+    assert out.shape == q.shape and out.dtype == q.dtype
+    assert_close(out, gather_reference(q, k_pages, v_pages, page_table,
+                                       positions))
+
+
+@pytest.mark.parametrize("offset", [-1, 0, 1])
+def test_positions_straddling_page_boundaries(offset):
+    """position = k*page_size + {-1, 0, +1}: the per-page mask must cut
+    exactly at the logical offset, including the one-token-into-a-new-page
+    and last-token-of-a-page edges."""
+    page_size, slots = 8, 3
+    q, k_pages, v_pages = random_case(
+        offset + 100, slots=slots, heads=4, kv_heads=2, d_head=16,
+        page_size=page_size, num_pages=9, max_pages=3)
+    page_table = jnp.asarray([[2, 5, 8], [1, 4, 7], [3, 6, 9]], jnp.int32)
+    positions = jnp.asarray(
+        [max(0, page_size + offset), max(0, 2 * page_size + offset), 0],
+        jnp.int32)
+    out = paged_attention(q, k_pages, v_pages, page_table, positions,
+                          interpret=True)
+    assert_close(out, gather_reference(q, k_pages, v_pages, page_table,
+                                       positions))
+
+
+def test_parked_slot_on_trash_page_matches_reference():
+    """A parked slot (page-table row all trash page, position 0) attends to
+    whatever garbage sits at (trash, 0) — discarded by the engine, but the
+    kernel must still agree with the gather path on it (no NaN, no
+    divergence) so parked slots stay harmless by construction."""
+    page_size = 8
+    q, k_pages, v_pages = random_case(
+        7, slots=2, heads=4, kv_heads=4, d_head=16, page_size=page_size,
+        num_pages=5, max_pages=2)
+    page_table = jnp.asarray([[0, 0],       # parked: trash page row
+                              [2, 4]], jnp.int32)
+    positions = jnp.asarray([0, 11], jnp.int32)
+    out = paged_attention(q, k_pages, v_pages, page_table, positions,
+                          interpret=True)
+    assert bool(jnp.all(jnp.isfinite(out)))
+    assert_close(out, gather_reference(q, k_pages, v_pages, page_table,
+                                       positions))
+
+
+def test_recycled_page_reissued_to_another_slot():
+    """A freed-then-recycled physical page shows up in a NEW slot's table
+    (and nowhere in the old one): the kernel must read it through the new
+    row only — physical aliasing across time is the allocator's normal
+    mode, never a kernel special case."""
+    page_size = 8
+    q, k_pages, v_pages = random_case(
+        13, slots=2, heads=4, kv_heads=2, d_head=16, page_size=page_size,
+        num_pages=6, max_pages=3)
+    # before: slot 0 owned pages (1, 2); after free+recycle, page 2 belongs
+    # to slot 1 while slot 0's row fell back to the trash page
+    recycled = jnp.asarray([[0, 0, 0], [2, 5, 3]], jnp.int32)
+    positions = jnp.asarray([0, 2 * page_size + 3], jnp.int32)
+    out = paged_attention(q, k_pages, v_pages, recycled, positions,
+                          interpret=True)
+    assert_close(out, gather_reference(q, k_pages, v_pages, recycled,
+                                       positions))
+
+
+def test_single_page_and_full_window():
+    """Degenerate table widths: one page per slot, and a position at the
+    very last offset of the last page (full window visible)."""
+    page_size = 8
+    q, k_pages, v_pages = random_case(
+        21, slots=2, heads=4, kv_heads=2, d_head=16, page_size=page_size,
+        num_pages=4, max_pages=1)
+    page_table = jnp.asarray([[3], [1]], jnp.int32)
+    positions = jnp.asarray([page_size - 1, 0], jnp.int32)
+    out = paged_attention(q, k_pages, v_pages, page_table, positions,
+                          interpret=True)
+    assert_close(out, gather_reference(q, k_pages, v_pages, page_table,
+                                       positions))
+
+
+def test_dispatch_inside_jit_keeps_operands_traced():
+    """paged_attention must be callable inside a jit with page table and
+    positions as TRACED operands — different page assignments at the same
+    shapes reuse one executable (the zero-recompile contract the engine
+    smoke gates end to end)."""
+    page_size = 8
+    q, k_pages, v_pages = random_case(
+        31, slots=2, heads=4, kv_heads=2, d_head=16, page_size=page_size,
+        num_pages=6, max_pages=2)
+
+    @jax.jit
+    def attend(q, k_pages, v_pages, table, positions):
+        return decode._paged_attend(q, k_pages, v_pages, table, positions,
+                                    use_kernel=True, interpret=True)
+
+    for table, positions in (
+            (jnp.asarray([[1, 4], [2, 0]], jnp.int32),
+             jnp.asarray([9, 3], jnp.int32)),
+            (jnp.asarray([[5, 3], [6, 1]], jnp.int32),
+             jnp.asarray([12, 7], jnp.int32))):
+        assert_close(attend(q, k_pages, v_pages, table, positions),
+                     gather_reference(q, k_pages, v_pages, table, positions))
+    assert attend._cache_size() == 1
+
+
+def test_resolve_paged_kernel_knob():
+    """auto|on|off semantics on this (CPU) backend: on forces pallas, off
+    forces the gather, auto falls back to the gather off-TPU; anything
+    else is a loud config error."""
+    sizing = dict(page_size=16, kv_heads=2, d_head=16, heads=4,
+                  dtype=jnp.float32)
+    assert resolve_paged_kernel("on", **sizing) == "pallas"
+    assert resolve_paged_kernel("off", **sizing) == "xla"
+    assert resolve_paged_kernel("auto", **sizing) == "xla"  # no TPU here
+    with pytest.raises(ValueError, match="auto\\|on\\|off"):
+        resolve_paged_kernel("yes", **sizing)
+
+
+def test_kernel_fits_vmem_budget():
+    """The default_blocks-style sizing gate: serving-scale pages fit, a
+    pathological page_size does not (and would steer auto to the gather)."""
+    assert kernel_fits(16, 8, 128, 64, jnp.bfloat16)
+    assert not kernel_fits(65536, 32, 128, 64, jnp.float32)

@@ -113,17 +113,23 @@ def test_churn_never_fragments():
 
 # -- paged == contiguous == generate, exactly --------------------------------
 
-def test_paged_equals_contiguous_equals_generate(params):
+@pytest.mark.parametrize("paged_kernel", ["off", "on"])
+def test_paged_equals_contiguous_equals_generate(params, paged_kernel):
     """The tri-equality the tentpole hangs on: the same request through the
-    paged engine, the contiguous engine and single-tenant decode.generate
-    yields identical tokens, f32 greedy — cache layout is an implementation
-    detail, never a behavior."""
+    paged engine (BOTH attend dispatches — the XLA gather and the fused
+    pallas kernel in interpret mode), the contiguous engine and
+    single-tenant decode.generate yields identical tokens, f32 greedy —
+    cache layout and attend dispatch are implementation details, never a
+    behavior. (The kernel's float outputs differ from the gather's by ULPs
+    — accumulation order, docs/SERVING.md — but the greedy token stream is
+    pinned IDENTICAL here.)"""
     prompts = [list(range(3, 11)),       # len 8  -> bucket 16
                [5],                      # len 1  -> no prefill
                list(range(1, 21)),       # len 20 -> bucket 32
                list(range(2, 14))]       # len 12 -> bucket 16
     news = [6, 9, 4, 7]
-    paged = make_engine(params, paged=True, page_size=16)
+    paged = make_engine(params, paged=True, page_size=16,
+                        paged_kernel=paged_kernel)
     contiguous = make_engine(params, paged=False)
     for engine in (paged, contiguous):
         handles = []
@@ -137,12 +143,16 @@ def test_paged_equals_contiguous_equals_generate(params):
             assert summary["tokens"] == reference_tokens(params, prompt, new)
 
 
-def test_page_recycling_after_leave_and_cancel_is_clean(params):
+@pytest.mark.parametrize("paged_kernel", ["off", "on"])
+def test_page_recycling_after_leave_and_cancel_is_clean(params,
+                                                        paged_kernel):
     """Pages released by a finished AND a cancelled request are reissued to
     the next joiner — which must still decode exactly like a fresh engine
     (recycled pages carry the previous owner's K/V until overwritten; the
-    rewrite-before-attend argument must hold through recycling)."""
-    engine = make_engine(params, slots=1, page_size=16, kv_pages=6)
+    rewrite-before-attend argument must hold through recycling), under
+    both attend dispatches."""
+    engine = make_engine(params, slots=1, page_size=16, kv_pages=6,
+                         paged_kernel=paged_kernel)
     first = engine.submit(list(range(1, 41)), max_new_tokens=8)   # 3 pages
     drain(engine)
     assert first.result(timeout_s=5)["outcome"] == "completed"
@@ -160,11 +170,13 @@ def test_page_recycling_after_leave_and_cancel_is_clean(params):
             == reference_tokens(params, [9, 8, 7, 6, 5], 8))
 
 
-def test_zero_recompiles_across_page_assignments(params):
+@pytest.mark.parametrize("paged_kernel", ["off", "on"])
+def test_zero_recompiles_across_page_assignments(params, paged_kernel):
     """Joins, leaves and every page reassignment in between must reuse the
-    warmed paged executables — the page table is a traced operand, so the
-    jit cache must not grow."""
-    engine = make_engine(params, page_size=16)
+    warmed paged executables — the page table is a traced operand (a
+    scalar-prefetch VALUE in the kernel dispatch, still never a shape), so
+    the jit cache must not grow under either dispatch."""
+    engine = make_engine(params, page_size=16, paged_kernel=paged_kernel)
     lens = (8, 20, 1, 40, 12, 28)
     engine.warmup(prompt_lens=lens)
     step_execs = engine.step_executable._cache_size()
@@ -265,6 +277,7 @@ def test_page_gauges_and_stats(params):
     stats = engine.stats()
     assert stats["paged"] is True
     assert stats["pageSize"] == 8
+    assert stats["pagedKernel"] == "xla"    # auto resolves off-TPU -> gather
     assert stats["kvPagesTotal"] == 6
     assert stats["kvPagesFree"] == 4
     rendered = get_registry().render()
@@ -275,9 +288,14 @@ def test_page_gauges_and_stats(params):
     assert handle.result(timeout_s=5)["outcome"] == "completed"
     assert "tpuhive_generate_kv_pages_free 6" in get_registry().render()
 
+    kernel = make_engine(params, slots=2, page_size=8, kv_pages=6,
+                         paged_kernel="on")
+    assert kernel.stats()["pagedKernel"] == "pallas"
+
     contiguous = make_engine(params, paged=False)
     stats = contiguous.stats()
     assert stats["paged"] is False
+    assert stats["pagedKernel"] is None     # no pool, no paged dispatch
     assert stats["kvPagesTotal"] is None and stats["kvPagesFree"] is None
     assert contiguous.kv_page_saturation() is None
 

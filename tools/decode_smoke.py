@@ -6,6 +6,12 @@ tokens/s plus the ``tpuhive_decode_compile_total`` counter state. Exits
 nonzero if the round-trip breaks (prompt not preserved, wrong shape,
 out-of-vocab tokens, or more compiled executables than prompt buckets).
 
+Also exercises the ``paged_kernel`` dispatch knob (docs/SERVING.md): a
+paged engine per knob value — ``on`` (fused pallas kernel, interpret mode
+on CPU), ``off`` (XLA page gather) and ``auto`` (gather on this backend) —
+must resolve to the documented dispatch and emit IDENTICAL greedy tokens,
+so flipping the knob can never change what the model says.
+
 Run via ``make decode-smoke``; CI runs it right after the static-analysis
 gate so a decode-path regression fails before the full suite spins up.
 """
@@ -70,6 +76,35 @@ def main() -> int:
             failures.append(f"P={prompt_len}: out-of-vocab token")
     elapsed = time.perf_counter() - started
 
+    # -- paged_kernel dispatch knob: on / off / auto must agree ------------
+    import dataclasses
+
+    from tensorhive_tpu.serving.engine import SlotEngine
+
+    f32_config = dataclasses.replace(config, dtype=jnp.float32,
+                                     use_flash=False, remat=False,
+                                     max_seq_len=128)
+    f32_params = TransformerLM.init(jax.random.PRNGKey(0), f32_config)
+    knob_prompt = list(range(3, 21))
+    expected_dispatch = {"on": "pallas", "off": "xla", "auto": "xla"}
+    knob_tokens = {}
+    for knob in ("on", "off", "auto"):
+        engine = SlotEngine(f32_params, f32_config, slots=2, max_len=64,
+                            queue_depth=4, page_size=16, paged_kernel=knob)
+        dispatch = engine.stats()["pagedKernel"]
+        if dispatch != expected_dispatch[knob]:
+            failures.append(
+                f"paged_kernel={knob!r} resolved to {dispatch!r} on the "
+                f"CPU backend, wanted {expected_dispatch[knob]!r}")
+        handle = engine.submit(knob_prompt, max_new_tokens=new_tokens)
+        while engine.has_work():
+            engine.step()
+        knob_tokens[knob] = handle.result(timeout_s=10)["tokens"]
+    if not knob_tokens["on"] == knob_tokens["off"] == knob_tokens["auto"]:
+        failures.append(
+            f"paged_kernel dispatches disagree on greedy tokens: "
+            f"{ {k: v[:4] for k, v in knob_tokens.items()} }...")
+
     misses = int(counter.labels(fn="generate", event="miss").value)
     hits = int(counter.labels(fn="generate", event="hit").value)
     # greedy and sampled steps are distinct executables by design (the
@@ -82,7 +117,9 @@ def main() -> int:
 
     print(f"decode-smoke: {generated} tokens in {elapsed:.2f}s "
           f"({generated / elapsed:.1f} tok/s incl. compiles) | "
-          f"buckets={sorted(buckets)} compile miss={misses} hit={hits}")
+          f"buckets={sorted(buckets)} compile miss={misses} hit={hits} | "
+          f"paged_kernel on/off/auto agree "
+          f"({len(knob_tokens['on'])} greedy tokens)")
     for failure in failures:
         print(f"decode-smoke FAILURE: {failure}", file=sys.stderr)
     return 1 if failures else 0

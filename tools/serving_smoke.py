@@ -20,7 +20,11 @@ serving layer exists for (docs/SERVING.md):
    a paged engine admits >= 1.5x more concurrent sequences than the
    contiguous engine when the summed requested context exceeds what the
    contiguous layout can hold, all of them complete, and none of it
-   recompiles anything.
+   recompiles anything. The paged engine runs with the FUSED KERNEL
+   dispatch active (``paged_kernel="on"`` — pallas interpret mode on this
+   CPU backend), proving the kernel preserves the traced-page-table
+   property: page assignment churns through the whole over-commit drain
+   with zero post-warmup recompiles.
 
 Run via ``make serving-smoke``; CI runs it after the chaos gate so a
 serving regression fails before the full suite spins up.
@@ -161,7 +165,11 @@ def main() -> int:
 
     paged = SlotEngine(params, config, slots=SLOTS, max_len=MAX_LEN,
                        queue_depth=LONG_REQUESTS, paged=True,
-                       page_size=PAGE_SIZE, kv_pages=OVERCOMMIT_PAGES)
+                       page_size=PAGE_SIZE, kv_pages=OVERCOMMIT_PAGES,
+                       paged_kernel="on")
+    if paged.stats()["pagedKernel"] != "pallas":
+        failures.append("paged_kernel='on' did not dispatch the pallas "
+                        "kernel — scenario 5 must exercise the fused path")
     paged.warmup(prompt_lens=(LONG_PROMPT,))
     paged_step_execs = paged.step_executable._cache_size()
     paged_prefill_execs = paged.prefill_executable._cache_size()
@@ -174,8 +182,9 @@ def main() -> int:
     if (paged.step_executable._cache_size() != paged_step_execs
             or paged.prefill_executable._cache_size()
             != paged_prefill_execs):
-        failures.append("paged over-commit: page assignment recompiled an "
-                        "executable")
+        failures.append("paged over-commit (kernel dispatch): page "
+                        "assignment recompiled an executable — the page "
+                        "table leaked into a kernel shape")
 
     contiguous = SlotEngine(params, config, slots=CONTIG_SLOTS,
                             max_len=MAX_LEN, queue_depth=LONG_REQUESTS,
@@ -216,7 +225,8 @@ def main() -> int:
           f"batched {total / batched_s:.1f} tok/s ({speedup:.2f}x) | "
           f"step_execs={engine.step_executable._cache_size()} "
           f"prefill_execs={engine.prefill_executable._cache_size()} | "
-          f"over-commit {requested} tokens into {hbm_cells} HBM cells: "
+          f"over-commit {requested} tokens into {hbm_cells} HBM cells "
+          f"(kernel dispatch: {paged.stats()['pagedKernel']}): "
           f"paged {paged_busy} vs contiguous {contiguous_busy} concurrent "
           f"({concurrency_gain:.2f}x) | stats={engine.stats()}")
     for failure in failures:
