@@ -2,7 +2,7 @@
 # mypy + flake8 per .circleci/config.yml:33-38): the dependency-free AST
 # lint + thivelint analyzer always run; mypy/ruff run when installed
 # (absent from this image).
-.PHONY: check lint analysis test bench probe metrics-smoke decode-smoke alerts-smoke chaos-smoke serving-smoke
+.PHONY: check lint analysis test bench probe metrics-smoke decode-smoke alerts-smoke chaos-smoke serving-smoke serving-mesh-smoke
 
 check: lint analysis
 	@command -v ruff >/dev/null 2>&1 && ruff check . || echo "ruff not installed; skipped (tools/lint.py covered the always-on subset)"
@@ -53,6 +53,14 @@ chaos-smoke:
 # one admission rejection when over capacity (docs/SERVING.md)
 serving-smoke:
 	python tools/serving_smoke.py
+
+# multi-chip serving on 8 forced host devices (the MULTICHIP dryrun trick):
+# a mesh_dp=2 x mesh_tp=2 engine must emit tokens identical to the 1x1
+# engine, recompile nothing after warmup, scale slot capacity by dp at
+# equal per-chip HBM, and the 1x1 config must roll back to the single-chip
+# executables fingerprint-identically (docs/SERVING.md "Multi-chip serving")
+serving-mesh-smoke:
+	python tools/serving_mesh_smoke.py
 
 probe:
 	$(MAKE) -C tensorhive_tpu/native

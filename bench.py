@@ -149,6 +149,17 @@ def _try_config(*args, attempts: int = 3, **kwargs):
     return None
 
 
+def _device_meta(mesh_shape: str = "1x1") -> dict:
+    """The device view a section measured under. Every section records
+    ``jax.device_count()`` + the mesh shape it ran on, so an artifact
+    reader can tell a single-chip number from a meshed one at a glance
+    (docs/SERVING.md "Multi-chip serving") — device counts differ between
+    the v5e hosts, the forced-8-device CPU suite and a laptop smoke run."""
+    import jax
+
+    return {"num_devices": jax.device_count(), "mesh_shape": mesh_shape}
+
+
 def bench_train() -> dict:
     import jax
 
@@ -156,6 +167,8 @@ def bench_train() -> dict:
     # hung compile RPC has no per-attempt timeout, so if the watchdog fires
     # mid-sweep every already-finished config must be in the artifact
     out = _state["train"]
+    # every _run_config goes through train_loop(mesh=None): single-device
+    out["devices"] = _device_meta()
     on_tpu = jax.default_backend() == "tpu"
     _log(f"backend={jax.default_backend()} devices={jax.devices()}")
     if not on_tpu:
@@ -321,6 +334,7 @@ def bench_generate():
         "batch": batch,
         "prompt_len": prompt_len,
         "new_tokens": new_tokens,
+        "devices": _device_meta(),      # decode.generate is single-device
         "cache_update": "inplace_donated",
         "prefill_bucket": head_width,
         "prefill_tokens_per_sec": round(prefill_tps, 1),
@@ -384,6 +398,8 @@ def bench_generate_serving():
         "slots": slots,
         "requests": len(prompt_lens),
         "new_tokens_per_request": new_tokens,
+        "devices": _device_meta(),      # the headline engines are 1x1;
+                                        # mesh_scaling records its own shape
     }
     # partial-artifact hook: from here on, whatever this section has
     # already measured survives a watchdog emit or a backend loss
@@ -513,6 +529,49 @@ def bench_generate_serving():
                                           2),
     })
     _log(f"  paged_vs_contiguous: {comparison}")
+
+    # multi-chip serving (docs/SERVING.md "Multi-chip serving"): the
+    # 1-device engine above vs a dp-sharded one at EQUAL PER-CHIP BATCH —
+    # slots and workload both scale by dp, so per-chip work is identical
+    # and the ratio reads as capacity scaling, not batch-size effects.
+    # Progressive-install like paged_vs_contiguous: the block lands in the
+    # result BEFORE the meshed engine exists, so a backend death mid-block
+    # keeps the single-device number and the attempted shape
+    mesh_block = {"num_devices": jax.device_count()}
+    result["mesh_scaling"] = mesh_block
+    if jax.device_count() < 2:
+        mesh_block["skipped"] = "single-device backend"
+    else:
+        from tensorhive_tpu.parallel.mesh import serving_mesh
+
+        dp = 4 if jax.device_count() >= 4 else 2
+        mesh_block["mesh_shape"] = f"{dp}x1"
+        mesh_block["single_tokens_per_sec"] = result[
+            "batched_tokens_per_sec"]
+        meshed = SlotEngine(params, config, slots=dp * slots,
+                            max_len=max_len, queue_depth=2 * dp * slots,
+                            paged=True, page_size=page_size,
+                            mesh=serving_mesh(dp=dp, tp=1))
+        meshed.warmup(prompt_lens=prompt_lens)
+        compiles_before = meshed.step_executable._cache_size()
+        started = time.perf_counter()
+        handles = [meshed.submit(prompt, max_new_tokens=new_tokens)
+                   for _ in range(dp) for prompt in prompts()]
+        drain(meshed)
+        meshed_s = time.perf_counter() - started
+        assert all(handle.done for handle in handles)
+        meshed_tps = dp * total_tokens / meshed_s
+        mesh_block.update({
+            "meshed_tokens_per_sec": round(meshed_tps, 1),
+            "meshed_recompiles": (meshed.step_executable._cache_size()
+                                  - compiles_before),
+            # per-chip parity = 1.0; forced host devices timeshare one CPU,
+            # so off-TPU this records the emulation tax, honestly
+            "scaling_vs_single": round(
+                meshed_tps / max(result["batched_tokens_per_sec"], 1e-9),
+                2),
+        })
+        _log(f"  mesh_scaling: {mesh_block}")
     return result
 
 
@@ -639,6 +698,9 @@ def _build_result() -> dict:
         "metric": "t2t_transformer tokens/sec/chip",
         "value": best["tokens_per_sec_per_chip"] if best else 0.0,
         "unit": "tokens/s/chip",
+        # the train section's device view (generate/generate_serving carry
+        # their own "devices" blocks; serving may be meshed, train is not)
+        "devices": train.get("devices"),
         # R01 is a TPU v5e number: comparing a CPU smoke run against it
         # would report a spurious ~1000x regression, so off-TPU pins 1.0;
         # an on-TPU sweep that produced NOTHING — and an unreachable

@@ -218,6 +218,9 @@ async function refreshServing() {
                    stats.queueDepth >= stats.queueCapacity)}
     ${servingBadge("slots", stats.slotsBusy + "/" + stats.slots,
                    stats.slotsBusy >= stats.slots && stats.queueDepth > 0)}
+    ${stats.numDevices <= 1 ? "" :
+      servingBadge("mesh " + stats.meshShape,
+                   stats.numDevices + " devices", false)}
     ${stats.kvPagesTotal == null ? "" :
       servingBadge("KV pages · " + stats.pagedKernel,
                    stats.kvPagesFree + "/" + stats.kvPagesTotal,

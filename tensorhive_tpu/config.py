@@ -170,8 +170,22 @@ class GenerationConfig:
     0/-1 as "unset" because TOML has no null."""
     enabled: bool = False
     preset: str = "tiny"
-    slots: int = 8
+    slots: int = 8                   # PER-DP-SHARD slot count: the engine
+                                     # serves slots * mesh_dp sequences
     max_len: int = 0                 # 0 = the preset's max_seq_len
+    mesh_dp: int = 1                 # serving mesh data-parallel degree:
+                                     # shards the slot/page pool so capacity
+                                     # scales with chips (docs/SERVING.md
+                                     # "Multi-chip serving")
+    mesh_tp: int = 1                 # tensor-parallel degree: megatron
+                                     # head/ffn/vocab splits; capped by the
+                                     # model's kv_heads for K/V sharding
+                                     # (GQA guard replicates K/V past it)
+    checkpoint_path: str = ""        # orbax checkpoint dir (train_loop
+                                     # format); "" serves random init
+                                     # params. Shape mismatch disables
+                                     # serving with a 503 reason, never
+                                     # crashes boot
     paged: bool = True               # false: contiguous per-slot cache
                                      # rollback (docs/SERVING.md)
     page_size: int = 16              # tokens per KV page
@@ -418,7 +432,10 @@ interval_s = 5.0
 # allocates the model + paged KV page pool at boot
 enabled = false
 # preset = "tiny"
-# slots = 8
+# slots = 8           # per-dp-shard; total capacity = slots * mesh_dp
+# mesh_dp = 1         # multi-chip serving (docs/SERVING.md): shard the
+# mesh_tp = 1         # slot/page pool over dp, heads/ffn/vocab over tp
+# checkpoint_path = ""  # orbax train_loop checkpoint dir; "" = init params
 # paged = true        # false: contiguous per-slot cache rollback
 # page_size = 16
 # kv_pages = 0        # 0 = equal HBM to the contiguous layout

@@ -45,6 +45,10 @@ STATS_SCHEMA = obj(
     queueDepth=s("integer"),
     queueCapacity=s("integer"),
     maxSeqLen=s("integer"),
+    #: serving mesh layout "dp x tp" (docs/SERVING.md "Multi-chip
+    #: serving"); "1x1" = single-chip engine
+    meshShape=s("string"),
+    numDevices=s("integer"),
     paged=s("boolean"),
     pageSize=s("integer", nullable=True),
     #: which paged decode attention dispatch compiled: "pallas" (the fused
@@ -62,10 +66,20 @@ STATS_SCHEMA = obj(
 )
 
 
+def _unavailable_msg() -> str:
+    """503 body: a recorded boot failure (e.g. checkpoint shape mismatch —
+    docs/SERVING.md "Loading checkpoints") beats the generic disabled
+    message, so operators see WHY the plane is down, not just that it is."""
+    from ..serving import get_unavailable_reason
+
+    return (get_unavailable_reason()
+            or "generation serving is not enabled on this manager "
+               "([generation_service] in config.toml)")
+
+
 def _service_unavailable() -> Response:
     return Response(
-        json.dumps({"msg": "generation serving is not enabled on this "
-                           "manager ([generation_service] in config.toml)"}),
+        json.dumps({"msg": _unavailable_msg()}),
         status=503, content_type="application/json")
 
 
@@ -168,8 +182,7 @@ def get_generate_stats(context: RequestContext):
     numbers the ``generate_*`` alert rules and the dashboard strip read."""
     engine = get_engine()
     if engine is None:
-        return ({"enabled": False,
-                 "msg": "generation serving is not enabled"}, 503)
+        return ({"enabled": False, "msg": _unavailable_msg()}, 503)
     stats: Dict[str, Optional[float]] = {"enabled": True}
     stats.update(engine.stats())
     return stats

@@ -13,6 +13,12 @@ The tick body budgets itself inside the service interval (``pump`` takes a
 wall budget and re-checks ``self.stopped``): a saturated engine keeps a
 ~90% duty cycle without tripping the tick-overrun alert on every tick, and
 shutdown never waits on a long generation.
+
+Boot-time failure policy: a configured checkpoint that cannot be served
+(missing, unreadable, params shaped for a different preset) must neither
+crash the whole daemon NOR silently fall back to random init params — the
+service comes up with no engine, records the reason, and the API answers
+503 carrying it (docs/SERVING.md "Loading checkpoints").
 """
 from __future__ import annotations
 
@@ -21,6 +27,7 @@ import logging
 from typing import Optional
 
 from ...config import Config, get_config
+from ...serving import CheckpointLoadError
 from .base import Service
 
 log = logging.getLogger(__name__)
@@ -35,12 +42,28 @@ class GenerationService(Service):
         # ~90% duty cycle: pump inside the interval, leave a sliver for the
         # run-loop's interruptible wait so stop() is honored promptly
         self._pump_budget_s = max(0.001, self.interval_s * 0.9)
-        self.engine = engine if engine is not None else build_engine(config)
         from ... import serving
 
-        serving.set_engine(self.engine)
+        if engine is not None:
+            self.engine = engine
+        else:
+            try:
+                self.engine = build_engine(config)
+            except CheckpointLoadError as exc:
+                # the daemon stays up (monitoring/scheduling are unaffected)
+                # and the serving plane 503s with the reason — an operator
+                # fixing the path re-enables it with a restart, and nothing
+                # ever silently serves init params in place of a requested
+                # checkpoint
+                log.error("generation serving disabled: %s", exc)
+                serving.set_unavailable_reason(str(exc))
+                self.engine = None
+        if self.engine is not None:
+            serving.set_engine(self.engine)
 
     def do_run(self) -> None:
+        if self.engine is None:
+            return
         self.engine.pump(budget_s=self._pump_budget_s,
                          should_stop=lambda: self.stopped)
 
@@ -49,14 +72,98 @@ class GenerationService(Service):
         # instead of queueing onto a pump that will never run again
         from ... import serving
 
-        if serving.get_engine() is self.engine:
+        if self.engine is not None and serving.get_engine() is self.engine:
             serving.set_engine(None)
         super().shutdown()
+
+
+def load_checkpoint_params(path: str, model_config):
+    """Load train_loop params (orbax, ``{"params", "opt_state"}`` layout —
+    train.py::save_checkpoint) for serving: returns ``(step, params)``
+    restored to the default single-device placement, which the engine's
+    ``device_put`` then moves into the serving-mesh layout (orbax reshards
+    on restore anyway — train.py::restore_checkpoint — so the save-time
+    topology never constrains where serving runs).
+
+    Raises :class:`~tensorhive_tpu.serving.CheckpointLoadError` — with the
+    exact tree/shape mismatches in the message — whenever the checkpoint
+    cannot be served as-configured; the caller turns that into a 503
+    reason, never a crash and never a silent init-params fallback."""
+    import jax
+
+    from ...models.transformer import TransformerLM
+
+    try:
+        import orbax.checkpoint as ocp
+    except ImportError as exc:  # pragma: no cover - orbax is in the image
+        raise CheckpointLoadError(
+            f"checkpoint_path is set but orbax is unavailable: {exc}"
+        ) from exc
+    try:
+        with ocp.CheckpointManager(path) as manager:
+            step = manager.latest_step()
+            if step is None:
+                raise CheckpointLoadError(
+                    f"no checkpoint steps under {path!r}")
+            # template-free PyTreeRestore: the tree layout comes from the
+            # checkpoint itself (this loader must read checkpoints for ANY
+            # preset to report a shape mismatch instead of crashing on a
+            # structure it guessed wrong); a bare restore(step) is rejected
+            # by this orbax ("provide a CheckpointArgs subclass")
+            restored = manager.restore(step, args=ocp.args.PyTreeRestore())
+    except CheckpointLoadError:
+        raise
+    except Exception as exc:
+        raise CheckpointLoadError(
+            f"cannot read checkpoint {path!r}: "
+            f"{type(exc).__name__}: {exc}") from exc
+    params = restored.get("params") if hasattr(restored, "get") else None
+    if params is None:
+        raise CheckpointLoadError(
+            f"checkpoint {path!r} has no 'params' entry — not a "
+            "train_loop checkpoint?")
+
+    # shape-validate against the preset BEFORE any device allocation:
+    # eval_shape materializes nothing, and the mismatch message names the
+    # offending leaves so the 503 is actionable
+    expected = jax.eval_shape(
+        lambda key: TransformerLM.init(key, model_config),
+        jax.random.PRNGKey(0))
+
+    def leaves_by_path(tree):
+        flat = jax.tree_util.tree_flatten_with_path(tree)[0]
+        return {jax.tree_util.keystr(kp): leaf for kp, leaf in flat}
+
+    got = leaves_by_path(params)
+    want = leaves_by_path(expected)
+    problems = []
+    for missing in sorted(set(want) - set(got)):
+        problems.append(f"{missing} missing")
+    for extra in sorted(set(got) - set(want)):
+        problems.append(f"{extra} unexpected")
+    for key in sorted(set(want) & set(got)):
+        if tuple(got[key].shape) != tuple(want[key].shape):
+            problems.append(
+                f"{key} shape {tuple(got[key].shape)} != expected "
+                f"{tuple(want[key].shape)}")
+    if problems:
+        raise CheckpointLoadError(
+            f"checkpoint {path!r} does not fit preset params "
+            f"({len(problems)} mismatches): " + "; ".join(problems[:6]))
+    return step, params
 
 
 def build_engine(config: Config):
     """Construct the slot engine from ``[generation_service]`` config and
     warm its executables so the first request never pays a compile.
+
+    Multi-chip serving (docs/SERVING.md): ``mesh_dp``/``mesh_tp`` build a
+    serving mesh over the first ``dp*tp`` devices — capacity scales with
+    dp (the configured ``slots``/``kv_pages`` are PER DP SHARD, so per-chip
+    HBM stays what the operator sized) and per-token work shards over tp.
+    The 1x1 default passes ``mesh=None``: byte-identical to the single-chip
+    engine, same executables, same compile fingerprints (the rollback
+    contract the mesh smoke pins).
 
     Imports jax lazily: processes with serving disabled must not pay model
     stack import time (instantiate_services_from_config only calls this
@@ -71,24 +178,43 @@ def build_engine(config: Config):
         raise ValueError(
             f"[generation_service] preset {generation.preset!r} unknown; "
             f"choose from {sorted(PRESETS)}")
+    mesh_dp, mesh_tp = int(generation.mesh_dp), int(generation.mesh_tp)
+    if mesh_dp < 1 or mesh_tp < 1:
+        raise ValueError(
+            f"[generation_service] mesh_dp/mesh_tp must be >= 1, got "
+            f"{mesh_dp}/{mesh_tp}")
+    mesh = None
+    if mesh_dp * mesh_tp > 1:
+        from ...parallel.mesh import serving_mesh
+
+        mesh = serving_mesh(dp=mesh_dp, tp=mesh_tp)
     model_config = PRESETS[generation.preset]
     max_len = generation.max_len or model_config.max_seq_len
     model_config = dataclasses.replace(
         model_config,
         max_seq_len=max(max_len, model_config.max_seq_len),
         use_flash=generation.use_flash)
-    # random init: the gateway serves whatever params the process holds —
-    # checkpoint loading is the job template / train_loop story, and the
-    # serving plane is checkpoint-agnostic by design
-    params = TransformerLM.init(jax.random.PRNGKey(0), model_config)
+    if generation.checkpoint_path:
+        step, params = load_checkpoint_params(
+            generation.checkpoint_path, model_config)
+        log.info("serving checkpoint %s step %d", generation.checkpoint_path,
+                 step)
+        if mesh is None:
+            # no mesh layout to target — commit the host arrays once so the
+            # executables never re-transfer them per dispatch
+            params = jax.tree_util.tree_map(jax.device_put, params)
+    else:
+        # random init: the gateway serves whatever params the process holds
+        params = TransformerLM.init(jax.random.PRNGKey(0), model_config)
     engine = SlotEngine(
         params, model_config,
-        slots=generation.slots,
+        slots=generation.slots * mesh_dp,
         max_len=max_len,
         paged=generation.paged,
         page_size=generation.page_size,
-        kv_pages=generation.kv_pages,
+        kv_pages=generation.kv_pages * mesh_dp,
         paged_kernel=generation.paged_kernel,
+        mesh=mesh,
         queue_depth=generation.queue_depth,
         top_k=generation.top_k or None,
         eos_token=None if generation.eos_token < 0 else generation.eos_token,
@@ -97,6 +223,7 @@ def build_engine(config: Config):
     )
     engine.warmup(prompt_lens=(16, max_len // 2))
     log.info("generation engine ready: preset=%s slots=%d max_len=%d "
-             "queue_depth=%d", generation.preset, generation.slots, max_len,
-             generation.queue_depth)
+             "queue_depth=%d mesh=%s devices=%d", generation.preset,
+             engine.capacity, max_len, generation.queue_depth,
+             engine.mesh_shape, engine.num_devices)
     return engine

@@ -82,7 +82,8 @@ def _decode_attend(q, k_cache, v_cache, position):
 
 def _paged_attend(q, k_pages, v_pages, page_table, positions,
                   use_kernel: bool = False,
-                  interpret: Optional[bool] = None):
+                  interpret: Optional[bool] = None,
+                  mesh=None, shard_heads: bool = False):
     """Paged-cache decode attention, two dispatches behind one signature
     (the ``use_flash`` pattern — serving/engine.py prefill):
 
@@ -113,10 +114,36 @@ def _paged_attend(q, k_pages, v_pages, page_table, positions,
     Entries still pointing at the trash page hold other sequences' (or
     garbage) K/V, but every such logical position is > the slot's position
     — masked to -1e30, exp-underflowed to exactly 0.0 in the softmax (the
-    kernel applies the identical mask per page block)."""
+    kernel applies the identical mask per page block).
+
+    ``mesh``/``shard_heads`` (serving mesh, docs/SERVING.md "Multi-chip
+    serving"): under a sharded engine the XLA gather path needs nothing —
+    GSPMD partitions it off the cache's NamedSharding, bit-identically —
+    but the pallas custom call MUST NOT be left to GSPMD (it partitions
+    the grid blindly and the per-shard page tables would index physical
+    pages the shard does not hold: silently wrong output, pinned by the
+    mesh parity tests). The kernel therefore runs under ``shard_map``:
+    with ``shard_heads`` each tp shard runs the UNCHANGED grid on its
+    local head slice (q heads and kv_heads both split over tp — contiguous
+    head blocks keep the ``i // group`` GQA mapping aligned per shard)
+    against the full page pool, page tables/positions replicated; without
+    it (the GQA replication guard, tp not dividing both head counts) the
+    kernel runs fully replicated — correct, and the cache layout the
+    engine picks for the kernel dispatch matches these specs."""
     if use_kernel:
         from ..ops.paged_attention import paged_attention
 
+        kernel = functools.partial(paged_attention, interpret=interpret)
+        if mesh is not None:
+            from jax.experimental.shard_map import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            head_spec = (P(None, None, "tp", None) if shard_heads else P())
+            return shard_map(
+                kernel, mesh=mesh,
+                in_specs=(head_spec, head_spec, head_spec, P(), P()),
+                out_specs=head_spec, check_rep=False,
+            )(q, k_pages, v_pages, page_table, positions)
         return paged_attention(q, k_pages, v_pages, page_table, positions,
                                interpret=interpret)
     num_slots, max_pages = page_table.shape

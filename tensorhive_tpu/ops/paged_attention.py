@@ -81,7 +81,8 @@ def kernel_fits(page_size: int, kv_heads: int, d_head: int, heads: int,
 
 
 def resolve_paged_kernel(mode: str, *, page_size: int, kv_heads: int,
-                         d_head: int, heads: int, dtype) -> str:
+                         d_head: int, heads: int, dtype,
+                         mesh_devices: int = 1) -> str:
     """Resolve the ``[generation_service] paged_kernel`` knob to the
     dispatch actually used: ``"pallas"`` or ``"xla"``.
 
@@ -89,7 +90,15 @@ def resolve_paged_kernel(mode: str, *, page_size: int, kv_heads: int,
     path); ``off`` forces the XLA gather reference; ``auto`` uses the
     kernel on a real TPU when the working set fits VMEM and the gather
     path everywhere else — mirroring how ``use_flash`` keeps the XLA
-    reference attention as the portable fallback."""
+    reference attention as the portable fallback.
+
+    ``mesh_devices`` is the serving mesh size: ``auto`` stays on the XLA
+    gather when the engine is sharded (GSPMD partitions the gather path
+    with the cache's NamedSharding for free; handing it the pallas custom
+    call instead is correct — the mesh parity tests pin it token-identical
+    under ``on`` — but its multi-chip TPU performance is unbenched, so
+    auto does not pick it sight unseen; docs/SERVING.md "Multi-chip
+    serving"). ``on`` remains the explicit operator override."""
     if mode not in ("auto", "on", "off"):
         raise ValueError(
             f"paged_kernel must be auto|on|off, got {mode!r}")
@@ -97,7 +106,7 @@ def resolve_paged_kernel(mode: str, *, page_size: int, kv_heads: int,
         return "pallas"
     if mode == "off":
         return "xla"
-    if (jax.default_backend() == "tpu"
+    if (jax.default_backend() == "tpu" and mesh_devices == 1
             and kernel_fits(page_size, kv_heads, d_head, heads, dtype)):
         return "pallas"
     return "xla"

@@ -61,11 +61,18 @@ def make_mesh(
     return Mesh(np.asarray(devices).reshape(shape), AXES)
 
 
-def best_mesh_shape(n_devices: int, seq_parallel: bool = False) -> Dict[str, int]:
+def best_mesh_shape(n_devices: int, seq_parallel: bool = False,
+                    kv_heads: Optional[int] = None) -> Dict[str, int]:
     """Heuristic default mesh for n devices: fsdp-dominant (the within-slice
     scaling axis), with a modest tp factor once the slice is large, and an
     sp factor when long-context is requested. Factors are only taken when
-    they divide n, so the product always equals n_devices."""
+    they divide n, so the product always equals n_devices.
+
+    ``kv_heads`` caps the auto-chosen tp at the model's K/V head count:
+    tp > kv_heads buys nothing for attention (the K/V shards would be
+    empty) and forces the GQA replication fallback (:func:`serving_rules`),
+    so a GQA model must never be handed a head-starved mesh by default —
+    the cap halves tp until it divides ``kv_heads``."""
     sizes = {"dp": 1, "fsdp": n_devices, "tp": 1, "sp": 1}
     if seq_parallel:
         sp = 4 if n_devices >= 16 and n_devices % 4 == 0 else \
@@ -75,6 +82,9 @@ def best_mesh_shape(n_devices: int, seq_parallel: bool = False) -> Dict[str, int
     else:
         tp = 4 if n_devices >= 16 and n_devices % 4 == 0 else \
             2 if n_devices >= 4 and n_devices % 2 == 0 else 1
+        if kv_heads is not None:
+            while tp > 1 and (tp > kv_heads or kv_heads % tp):
+                tp //= 2
         sizes["tp"] = tp
         sizes["fsdp"] = n_devices // tp
     return sizes
@@ -92,6 +102,11 @@ class MeshRules:
 
     embed: Optional[str] = "fsdp"
     heads: Optional[str] = "tp"
+    #: K/V projection head axis (wk/wv) — separate from ``heads`` so GQA
+    #: serving can replicate K/V while still sharding the Q-side matmuls
+    #: (:func:`serving_rules`); training defaults keep both on tp, so the
+    #: split changes nothing for existing meshes
+    kv_heads: Optional[str] = "tp"
     ffn: Optional[str] = "tp"
     vocab: Optional[str] = "tp"
     batch: Tuple[str, ...] = ("dp", "fsdp")
@@ -109,8 +124,8 @@ _PARAM_LOGICAL: Dict[str, Tuple[Optional[str], ...]] = {
     "tok_embed": ("vocab", "embed"),
     "pos_embed": (None, "embed"),
     "wq": ("embed", "heads"),
-    "wk": ("embed", "heads"),
-    "wv": ("embed", "heads"),
+    "wk": ("embed", "kv_heads"),
+    "wv": ("embed", "kv_heads"),
     "wo": ("heads", "embed"),
     "w_in": ("embed", "ffn"),
     "w_gate": ("embed", "ffn"),
@@ -154,3 +169,73 @@ def tree_shardings(mesh: Mesh, params, rules: MeshRules = DEFAULT_RULES):
     return jax.tree_util.tree_unflatten(
         treedef, [shardings[path_str(kp)] for kp, _ in flat]
     )
+
+
+# -- serving mesh (docs/SERVING.md "Multi-chip serving") ----------------------
+#
+# Inference shards differently from training: there is no gradient, so fsdp
+# buys nothing — the serving engine uses only dp (replicate params, shard the
+# slot/page pool so capacity scales with chips) and tp (megatron head/ffn/
+# vocab splits so per-token latency scales). The helpers below build that
+# 2-axis layout out of the SAME 5-axis mesh machinery the training dryruns
+# certify (size-1 fsdp/sp/pp axes), so one MeshRules vocabulary covers both.
+
+def serving_mesh(dp: int = 1, tp: int = 1,
+                 devices: Optional[Sequence] = None) -> Mesh:
+    """The serving engine's mesh: ``dp x tp`` over the first ``dp*tp``
+    devices (fsdp/sp/pp pinned to 1). Raises when the product exceeds the
+    available device count — a serving config must never silently fall back
+    to fewer chips than the operator budgeted HBM for."""
+    if dp < 1 or tp < 1:
+        raise ValueError(f"mesh axes must be >= 1, got dp={dp} tp={tp}")
+    devices = list(devices if devices is not None else jax.devices())
+    if dp * tp > len(devices):
+        raise ValueError(
+            f"serving mesh dp={dp} x tp={tp} needs {dp * tp} devices, "
+            f"have {len(devices)}")
+    return make_mesh(dp=dp, fsdp=1, tp=tp, devices=devices[:dp * tp])
+
+
+def serving_rules(config, tp: int) -> MeshRules:
+    """Sharding rules for a serving engine at tensor-parallel degree ``tp``.
+
+    Every tp-sharded axis is checked for divisibility and demoted to
+    replication when it cannot split evenly — most importantly the **GQA
+    guard**: when ``tp > kv_heads`` (or tp does not divide kv_heads), the
+    K/V projections and the KV cache replicate across tp and only the
+    Q-side matmuls (wq/wo, and ffn/vocab when they divide) stay sharded.
+    Crashing instead would make every GQA preset unservable at high tp;
+    replicated K/V merely costs cache HBM (kv_heads/tp of it), never
+    correctness — documented in docs/SERVING.md "Multi-chip serving".
+    ``embed`` maps to the size-1 fsdp axis (a no-op kept for rule symmetry
+    with training)."""
+    def axis_or_none(size: int) -> Optional[str]:
+        return "tp" if tp > 1 and size % tp == 0 else None
+
+    return MeshRules(
+        heads=axis_or_none(config.n_heads),
+        kv_heads=axis_or_none(config.kv_heads),
+        ffn=axis_or_none(config.d_ff),
+        vocab=axis_or_none(config.vocab_size),
+    )
+
+
+def normalized_spec(*entries: Optional[str]) -> P:
+    """PartitionSpec with trailing Nones trimmed. jax normalizes specs this
+    way on executable OUTPUTS, so a donated buffer device_put with the
+    untrimmed spelling would compare unequal to its own round-trip through
+    the jit and recompile once per executable — exactly the class of leak
+    the serving zero-recompile tests exist to catch."""
+    trimmed = list(entries)
+    while trimmed and trimmed[-1] is None:
+        trimmed.pop()
+    return P(*trimmed)
+
+
+def serving_cache_spec(rules: MeshRules) -> P:
+    """PartitionSpec for the serving KV cache, either layout:
+    ``[layers, slots | pages, positions, kv_heads, d_head]`` — the pool
+    axis (slots or physical pages) shards over dp so capacity scales with
+    chips, the kv_heads axis follows the same GQA-guarded rule as wk/wv,
+    and layers/positions/d_head stay unsharded."""
+    return normalized_spec(None, "dp", None, rules.kv_heads, None)

@@ -38,15 +38,27 @@ class RateLimitError(AdmissionError):
     """Per-user concurrency cap exceeded — the API layer answers 429."""
 
 
+class CheckpointLoadError(Exception):
+    """A configured ``[generation_service] checkpoint_path`` could not be
+    served (missing, unreadable, or params shaped for a different model
+    config). GenerationService catches this at boot, leaves the engine
+    unpublished and records the reason — the API answers 503 with it
+    instead of the process crashing or silently serving init params."""
+
+
 __all__ = [
     "AdmissionError",
+    "CheckpointLoadError",
     "QueueFullError",
     "RateLimitError",
     "get_engine",
+    "get_unavailable_reason",
     "set_engine",
+    "set_unavailable_reason",
 ]
 
 _engine: Optional["SlotEngine"] = None
+_unavailable_reason: Optional[str] = None
 _engine_lock = threading.Lock()
 
 
@@ -59,7 +71,23 @@ def get_engine() -> Optional["SlotEngine"]:
 
 def set_engine(engine: Optional["SlotEngine"]) -> None:
     """Install (or with None: clear) the process-wide engine — called by
-    GenerationService at boot and by tests/smokes for isolation."""
-    global _engine
+    GenerationService at boot and by tests/smokes for isolation. Installing
+    a real engine clears any recorded unavailability reason."""
+    global _engine, _unavailable_reason
     with _engine_lock:
         _engine = engine
+        if engine is not None:
+            _unavailable_reason = None
+
+
+def get_unavailable_reason() -> Optional[str]:
+    """Why serving is down beyond 'not enabled' (e.g. a checkpoint shape
+    mismatch at boot) — surfaced in the controller's 503 body."""
+    with _engine_lock:
+        return _unavailable_reason
+
+
+def set_unavailable_reason(reason: Optional[str]) -> None:
+    global _unavailable_reason
+    with _engine_lock:
+        _unavailable_reason = reason

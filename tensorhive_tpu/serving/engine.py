@@ -34,6 +34,17 @@ Design, in the order the constraints forced it:
   ``_prefill_bucket``) and then advances the whole running batch one token,
   interleaving prefill and decode work on the same chip instead of
   dedicating it to either phase.
+* **Mesh-aware, single-chip by default.** An optional serving mesh
+  (``parallel/mesh.py::serving_mesh``; ``[generation_service]
+  mesh_dp``/``mesh_tp``) shards params over tp via the SAME
+  ``MeshRules``/``tree_shardings`` machinery the training dryruns certify,
+  and gives the KV cache a ``NamedSharding`` — kv_heads over tp (GQA guard:
+  replicate K/V when tp does not divide kv_heads), the slot/page pool axis
+  over dp so capacity scales with chips. Per-slot operands/page tables/
+  positions are device_put replicated but stay TRACED, so the
+  zero-recompile contract survives sharding (fingerprints gain a
+  ``serving_mesh_*`` variant); ``mesh=None`` is byte-identical to the
+  single-chip engine (docs/SERVING.md "Multi-chip serving").
 * **Admission control at the edge.** The pending queue is bounded; a full
   queue rejects at submit time (the API layer maps that to 429 +
   Retry-After) rather than letting latency collapse for everyone already
@@ -125,6 +136,9 @@ _SLOT_PAGES = get_registry().gauge(
     "tpuhive_generate_slot_kv_pages",
     "KV pages currently owned by each slot (0 when free or contiguous).",
     labels=("slot",))
+_MESH_DEVICES = get_registry().gauge(
+    "tpuhive_generate_mesh_devices",
+    "Devices in the serving mesh (dp x tp; 1 = single-chip engine).")
 
 
 # -- device functions ---------------------------------------------------------
@@ -210,7 +224,8 @@ _serving_step = functools.partial(
 def _paged_step_body(params, tokens, positions, active, temps, page_tables,
                      cache, key, config: TransformerConfig,
                      top_k: Optional[int], use_kernel: bool = False,
-                     interpret: bool = False):
+                     interpret: bool = False, mesh=None,
+                     shard_heads: bool = False):
     """One fused decode step over the PAGED cache.
 
     Identical to :func:`_step_body` except for where K/V live: the cache is
@@ -256,7 +271,8 @@ def _paged_step_body(params, tokens, positions, active, temps, page_tables,
             cache_v, layer_v[None], (layer, 0, 0, 0, 0))
         return _paged_attend(q, cache_k[layer], cache_v[layer], page_tables,
                              positions, use_kernel=use_kernel,
-                             interpret=interpret)
+                             interpret=interpret, mesh=mesh,
+                             shard_heads=shard_heads)
 
     for layer_index, block in enumerate(params["blocks"]):
         x = TransformerLM.block_forward(x, block, config, rope_positions,
@@ -268,7 +284,8 @@ def _paged_step_body(params, tokens, positions, active, temps, page_tables,
 
 _paged_serving_step = functools.partial(
     jax.jit,
-    static_argnames=("config", "top_k", "use_kernel", "interpret"),
+    static_argnames=("config", "top_k", "use_kernel", "interpret", "mesh",
+                     "shard_heads"),
     donate_argnames=("cache",))(_paged_step_body)
 
 
@@ -486,6 +503,7 @@ class SlotEngine:
         page_size: int = 16,
         kv_pages: int = 0,
         paged_kernel: str = "auto",
+        mesh=None,
         clock: Callable[[], float] = time.monotonic,
     ) -> None:
         if not config.causal:
@@ -498,7 +516,6 @@ class SlotEngine:
         if top_k is not None and not 0 < top_k <= config.vocab_size:
             raise ValueError(
                 f"top_k must be in (0, {config.vocab_size}], got {top_k}")
-        self.params = params
         self.config = config
         self.capacity = int(slots)
         self.max_len = int(max_len or config.max_seq_len)
@@ -509,6 +526,39 @@ class SlotEngine:
         self.max_concurrent_per_user = int(max_concurrent_per_user)
         self.paged = bool(paged)
         self.clock = clock
+
+        # -- serving mesh (docs/SERVING.md "Multi-chip serving") -----------
+        # mesh=None is the single-chip engine, byte-identical to PR 6-8:
+        # params/cache stay wherever jax puts them and the executables keep
+        # their original compile fingerprints (the rollback contract). With
+        # a mesh, params shard via the training MeshRules machinery (heads/
+        # ffn/vocab over tp, GQA-guarded), the cache pool axis shards over
+        # dp so capacity scales with chips, and every per-slot operand is
+        # device_put REPLICATED — still traced, so joins/leaves/page
+        # assignment keep the zero-recompile contract under sharding.
+        self.mesh = mesh
+        if mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec
+            from ..parallel.mesh import (
+                serving_cache_spec,
+                serving_rules,
+                tree_shardings,
+            )
+
+            axis_sizes = dict(mesh.shape)
+            self.mesh_dp = int(axis_sizes.get("dp", 1))
+            self.mesh_tp = int(axis_sizes.get("tp", 1))
+            self._rules = serving_rules(config, self.mesh_tp)
+            self._replicated = NamedSharding(mesh, PartitionSpec())
+            self._cache_spec = serving_cache_spec(self._rules)
+            self.params = jax.device_put(
+                params, tree_shardings(mesh, params, self._rules))
+        else:
+            self.mesh_dp = self.mesh_tp = 1
+            self._rules = None
+            self._replicated = None
+            self._cache_spec = None
+            self.params = params
 
         self._lock = threading.Lock()
         self._pending: Deque[_Request] = collections.deque()
@@ -535,7 +585,8 @@ class SlotEngine:
             self.paged_kernel = resolve_paged_kernel(
                 paged_kernel, page_size=self.page_size,
                 kv_heads=config.kv_heads, d_head=config.d_head,
-                heads=config.n_heads, dtype=config.dtype)
+                heads=config.n_heads, dtype=config.dtype,
+                mesh_devices=self.mesh_dp * self.mesh_tp)
             self._use_kernel = self.paged_kernel == "pallas"
             self._kernel_interpret = jax.default_backend() != "tpu"
             max_pages_per_slot = -(-self.max_len // self.page_size)
@@ -543,38 +594,95 @@ class SlotEngine:
             #: rollback-neutral default; serving more sequences at equal
             #: HBM means raising ``slots`` while keeping ``kv_pages``
             num_pages = int(kv_pages) or self.capacity * max_pages_per_slot
+            if num_pages % self.mesh_dp:
+                raise ValueError(
+                    f"kv_pages={num_pages} must be divisible by mesh "
+                    f"dp={self.mesh_dp} (the page pool shards over dp)")
+            # the pages axis shards over dp, and jax refuses uneven
+            # shardings — reserve dp trash rows (page 0 + dp-1 padding)
+            # so trash + usable stays divisible (paging.PagePool)
             self._pool = PagePool(num_pages=num_pages,
                                   page_size=self.page_size,
                                   slots=self.capacity,
-                                  max_pages_per_slot=max_pages_per_slot)
-            # physical page 0 is the trash page -> 1 + num_pages rows
-            shape = (config.n_layers, 1 + num_pages, self.page_size,
-                     config.kv_heads, config.d_head)
+                                  max_pages_per_slot=max_pages_per_slot,
+                                  trash_pages=self.mesh_dp)
+            shape = (config.n_layers, self._pool.physical_pages,
+                     self.page_size, config.kv_heads, config.d_head)
         else:
             self.page_size = None
             self._pool = None
             self.paged_kernel = None
             self._use_kernel = False
             self._kernel_interpret = False
+            if self.capacity % self.mesh_dp:
+                raise ValueError(
+                    f"slots={self.capacity} must be divisible by mesh "
+                    f"dp={self.mesh_dp} (the slot pool shards over dp)")
             shape = (config.n_layers, self.capacity, self.max_len,
                      config.kv_heads, config.d_head)
+        #: kernel dispatch under a mesh: the pallas call runs in shard_map
+        #: (models/decode._paged_attend), splitting q heads AND kv_heads
+        #: over tp only when both divide — contiguous head blocks keep the
+        #: GQA ``i // group`` mapping aligned per shard; otherwise the
+        #: kernel runs replicated (the GQA guard's kernel analog)
+        self._kernel_shard_heads = (
+            self.mesh is not None and self._rules.heads == "tp"
+            and self._rules.kv_heads == "tp")
         self._cache = KVCache(k=jnp.zeros(shape, config.dtype),
                               v=jnp.zeros(shape, config.dtype))
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding
+
+            from ..parallel.mesh import normalized_spec
+
+            cache_spec = self._cache_spec
+            if self._use_kernel:
+                # page tables hold GLOBAL physical indices, so the kernel's
+                # shard_map needs every shard to hold the whole page pool:
+                # pages replicate (no dp sharding) and the kv_heads axis
+                # shards only when the head-aligned split applies
+                cache_spec = normalized_spec(
+                    None, None, None,
+                    "tp" if self._kernel_shard_heads else None, None)
+            sharding = NamedSharding(self.mesh, cache_spec)
+            self._cache = jax.device_put(
+                self._cache, KVCache(k=sharding, v=sharding))
         self._tokens = np.zeros(self.capacity, np.int32)
         self._positions = np.zeros(self.capacity, np.int32)
         self._active = np.zeros(self.capacity, bool)
         self._temps = np.zeros(self.capacity, np.float32)
-        self._key = jax.random.PRNGKey(0)
+        self._key = self._operand(jax.random.PRNGKey(0))
 
         _QUEUE_CAPACITY.set(self.queue_depth)
         _SLOTS_TOTAL.set(self.capacity)
         _QUEUE_DEPTH.set(0)
         _SLOTS_BUSY.set(0)
+        _MESH_DEVICES.set(self.num_devices)
         if self.paged:
             _KV_PAGES_TOTAL.set(self._pool.num_pages)
             _KV_PAGES_FREE.set(self._pool.free_pages)
             for index in range(self.capacity):
                 _SLOT_PAGES.labels(slot=str(index)).set(0)
+
+    @property
+    def num_devices(self) -> int:
+        """Chips the engine spans (dp x tp; 1 = single-chip)."""
+        return self.mesh_dp * self.mesh_tp
+
+    @property
+    def mesh_shape(self) -> str:
+        """Human-readable mesh layout for stats/dashboard: ``"dp x tp"``
+        rendered as e.g. ``"2x2"`` (``"1x1"`` = the single-chip engine)."""
+        return f"{self.mesh_dp}x{self.mesh_tp}"
+
+    def _operand(self, value):
+        """Ship one per-slot operand (or the PRNG key) to the device state:
+        plain ``jnp.asarray`` single-chip; device_put REPLICATED across the
+        mesh — per-slot state is values, never shapes, under either
+        placement, so the executables' zero-recompile contract holds."""
+        if self.mesh is None:
+            return jnp.asarray(value)
+        return jax.device_put(value, self._replicated)
 
     @property
     def step_executable(self):
@@ -725,16 +833,31 @@ class SlotEngine:
         np.asarray(chosen)      # force the compile before traffic arrives
 
     # -- internals --------------------------------------------------------
+    def _fingerprint_fn(self, base: str) -> str:
+        """Compile-counter fn name: mesh engines get a ``serving_mesh_*``
+        variant (docs/OBSERVABILITY.md) so operators can tell the sharded
+        executables from the single-chip ones — and the rollback test can
+        assert a 1x1 config never mints a mesh fingerprint."""
+        if self.mesh is None:
+            return base
+        return base.replace("serving_", "serving_mesh_", 1)
+
+    def _mesh_fingerprint(self) -> tuple:
+        return (self.mesh_dp, self.mesh_tp) if self.mesh is not None else ()
+
     def _count_prefill_compile(self, width: int) -> None:
         if self.paged:
-            _count_compile("serving_paged_prefill",
-                           ("serving_paged_prefill", self.config,
+            fn = self._fingerprint_fn("serving_paged_prefill")
+            _count_compile(fn,
+                           (fn, self.config,
                             self._pool.num_pages, self.page_size,
-                            self._pool.max_pages_per_slot, width))
+                            self._pool.max_pages_per_slot, width)
+                           + self._mesh_fingerprint())
         else:
-            _count_compile("serving_prefill",
-                           ("serving_prefill", self.config, self.capacity,
-                            self.max_len, width))
+            fn = self._fingerprint_fn("serving_prefill")
+            _count_compile(fn,
+                           (fn, self.config, self.capacity,
+                            self.max_len, width) + self._mesh_fingerprint())
 
     def _dispatch_prefill(self, head, slot: int, real_len: int) -> None:
         """Run the joining sequence's trunk pass through whichever cache
@@ -744,42 +867,61 @@ class SlotEngine:
         self._count_prefill_compile(head.shape[1])
         if self.paged:
             self._cache = _paged_serving_prefill(
-                self.params, jnp.asarray(head), self._cache,
-                jnp.asarray(self._pool.page_table[slot]),
-                jnp.int32(real_len), self.config)
+                self.params, self._operand(head), self._cache,
+                self._operand(self._pool.page_table[slot]),
+                self._operand(np.int32(real_len)), self.config)
         else:
             self._cache = _serving_prefill(
-                self.params, jnp.asarray(head), self._cache,
-                jnp.int32(slot), jnp.int32(real_len), self.config)
+                self.params, self._operand(head), self._cache,
+                self._operand(np.int32(slot)),
+                self._operand(np.int32(real_len)), self.config)
 
     def _run_step(self):
+        chosen, cache, key = self._run_step_dispatch()
+        if self.mesh is not None:
+            # GSPMD is free to hand the PRNG key back sharded over a size-1
+            # axis (observed: P('fsdp') — same bytes everywhere, different
+            # label); feeding that back verbatim would miss the executable
+            # compiled for the replicated key and recompile once. Re-pin the
+            # 8-byte key to the replicated sharding every step — a no-op
+            # transfer that keeps the one-executable contract airtight.
+            key = jax.device_put(key, self._replicated)
+        return chosen, cache, key
+
+    def _run_step_dispatch(self):
         if self.paged:
             # the kernel dispatch gets its own fingerprint so operators can
             # tell WHICH paged step compiled (docs/OBSERVABILITY.md); page
             # tables/positions stay traced operands either way — page
             # assignment never recompiles regardless of dispatch
-            fn = ("serving_paged_step_kernel" if self._use_kernel
-                  else "serving_paged_step")
+            fn = self._fingerprint_fn(
+                "serving_paged_step_kernel" if self._use_kernel
+                else "serving_paged_step")
             _count_compile(fn,
                            (fn, self.config, self.capacity,
                             self._pool.num_pages, self.page_size,
                             self._pool.max_pages_per_slot, self.top_k,
-                            self._kernel_interpret))
+                            self._kernel_interpret)
+                           + self._mesh_fingerprint())
             return _paged_serving_step(
-                self.params, jnp.asarray(self._tokens),
-                jnp.asarray(self._positions), jnp.asarray(self._active),
-                jnp.asarray(self._temps), jnp.asarray(self._pool.page_table),
+                self.params, self._operand(self._tokens),
+                self._operand(self._positions), self._operand(self._active),
+                self._operand(self._temps),
+                self._operand(self._pool.page_table),
                 self._cache, self._key,
                 config=self.config, top_k=self.top_k,
                 use_kernel=self._use_kernel,
-                interpret=self._kernel_interpret)
-        _count_compile("serving_step",
-                       ("serving_step", self.config, self.capacity,
-                        self.max_len, self.top_k))
+                interpret=self._kernel_interpret,
+                mesh=self.mesh if self._use_kernel else None,
+                shard_heads=self._kernel_shard_heads)
+        fn = self._fingerprint_fn("serving_step")
+        _count_compile(fn,
+                       (fn, self.config, self.capacity,
+                        self.max_len, self.top_k) + self._mesh_fingerprint())
         return _serving_step(
-            self.params, jnp.asarray(self._tokens),
-            jnp.asarray(self._positions), jnp.asarray(self._active),
-            jnp.asarray(self._temps), self._cache, self._key,
+            self.params, self._operand(self._tokens),
+            self._operand(self._positions), self._operand(self._active),
+            self._operand(self._temps), self._cache, self._key,
             config=self.config, top_k=self.top_k)
 
     def _admit(self) -> int:
@@ -966,6 +1108,8 @@ class SlotEngine:
                 "queueDepth": len(self._pending),
                 "queueCapacity": self.queue_depth,
                 "maxSeqLen": self.max_len,
+                "meshShape": self.mesh_shape,
+                "numDevices": self.num_devices,
                 "paged": self.paged,
                 "pageSize": self.page_size,
                 "pagedKernel": self.paged_kernel,

@@ -55,7 +55,7 @@ class PagePool:
     """
 
     def __init__(self, num_pages: int, page_size: int, slots: int,
-                 max_pages_per_slot: int) -> None:
+                 max_pages_per_slot: int, trash_pages: int = 1) -> None:
         if num_pages < 1:
             raise ValueError(f"num_pages must be >= 1, got {num_pages}")
         if page_size < 1:
@@ -65,16 +65,35 @@ class PagePool:
         if max_pages_per_slot < 1:
             raise ValueError(
                 f"max_pages_per_slot must be >= 1, got {max_pages_per_slot}")
+        if trash_pages < 1:
+            raise ValueError(
+                f"trash_pages must be >= 1, got {trash_pages}")
         self.num_pages = int(num_pages)
         self.page_size = int(page_size)
         self.slots = int(slots)
         self.max_pages_per_slot = int(max_pages_per_slot)
+        #: physical rows reserved ahead of the usable pool. 1 everywhere
+        #: except the dp-sharded serving mesh, which reserves ``dp`` rows so
+        #: the physical-pages axis (trash + usable) stays divisible by dp —
+        #: jax refuses uneven NamedShardings, and padding with extra trash
+        #: rows costs dp-1 pages of HBM instead of a layout change. Parked
+        #: rows still reset to TRASH_PAGE (= 0); the extra reserved rows are
+        #: simply never referenced by any table.
+        self.trash_pages = int(trash_pages)
         # LIFO free list: recently-used pages are reissued first (their
-        # cache lines are warm, and reuse-after-free is exercised hardest)
-        self._free: List[int] = list(range(self.num_pages, 0, -1))
+        # cache lines are warm, and reuse-after-free is exercised hardest).
+        # Usable physical pages are trash_pages .. trash_pages+num_pages-1.
+        self._free: List[int] = list(
+            range(self.trash_pages + self.num_pages - 1,
+                  self.trash_pages - 1, -1))
         self._owned: List[List[int]] = [[] for _ in range(self.slots)]
         self.page_table = np.full((self.slots, self.max_pages_per_slot),
                                   TRASH_PAGE, np.int32)
+
+    @property
+    def physical_pages(self) -> int:
+        """Rows of the physical cache array: reserved trash + usable."""
+        return self.trash_pages + self.num_pages
 
     # -- sizing ------------------------------------------------------------
     def pages_for(self, tokens: int) -> int:

@@ -177,6 +177,15 @@ def test_serving_strip_renders_page_pool_badge():
     assert '"KV pages · " + stats.pagedKernel' in source
 
 
+def test_serving_strip_renders_mesh_badge():
+    """The multi-chip badge (docs/SERVING.md "Multi-chip serving") must
+    render from the exact ``meshShape``/``numDevices`` fields
+    ``GET /generate/stats`` exports, and hide on single-chip engines."""
+    source = (STATIC_DIR / "js" / "nodes.js").read_text()
+    assert '"mesh " + stats.meshShape' in source
+    assert "stats.numDevices <= 1" in source        # hidden for single-chip
+
+
 # ---------------------------------------------------------------------------
 # shape replay fixtures
 # ---------------------------------------------------------------------------

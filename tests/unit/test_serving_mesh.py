@@ -1,0 +1,348 @@
+"""Multi-chip serving tests (docs/SERVING.md "Multi-chip serving").
+
+The contract under test: a serving mesh is a PLACEMENT decision, never a
+behavior — meshed engines (dp-sharded slot/page pool, tp-sharded params,
+GQA-guarded K/V) emit tokens identical to the single-chip engine and to
+`decode.generate`, keep the zero-recompile discipline through joins/leaves/
+page recycling on the sharded cache, and a 1x1 config rolls back to the
+single-chip executables fingerprint-identically. Runs on the suite's
+virtual 8-device CPU platform (tests/conftest.py), so the same tests cover
+1 vs 8 devices in one process. Checkpoint serving ([generation_service]
+checkpoint_path) is covered at the loader, build_engine and service layers.
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from tensorhive_tpu.models import decode
+from tensorhive_tpu.models.transformer import PRESETS, TransformerLM
+from tensorhive_tpu.parallel.mesh import (
+    best_mesh_shape,
+    serving_cache_spec,
+    serving_mesh,
+    serving_rules,
+)
+from tensorhive_tpu.serving.engine import SlotEngine
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device CPU platform"
+)
+
+F32_TINY = dataclasses.replace(PRESETS["tiny"], dtype=jnp.float32,
+                               use_flash=False, remat=False, max_seq_len=128)
+#: grouped-query variant: 4 Q heads over 2 K/V heads — tp=4 divides heads
+#: but NOT kv_heads, so it exercises the GQA replication guard
+GQA_TINY = dataclasses.replace(F32_TINY, n_kv_heads=2)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return TransformerLM.init(jax.random.PRNGKey(0), F32_TINY)
+
+
+@pytest.fixture(scope="module")
+def gqa_params():
+    return TransformerLM.init(jax.random.PRNGKey(0), GQA_TINY)
+
+
+def make_engine(params, dp=1, tp=1, config=F32_TINY, **kwargs):
+    kwargs.setdefault("slots", 4)
+    kwargs.setdefault("max_len", 96)
+    kwargs.setdefault("queue_depth", 8)
+    mesh = serving_mesh(dp=dp, tp=tp) if dp * tp > 1 else None
+    return SlotEngine(params, config, mesh=mesh, **kwargs)
+
+
+def drain(engine):
+    while engine.has_work():
+        engine.step()
+
+
+def reference_tokens(params, prompt, new_tokens, config=F32_TINY):
+    out = decode.generate(params, config,
+                          jnp.asarray([prompt], jnp.int32),
+                          max_new_tokens=new_tokens, temperature=0.0)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+# -- mesh construction & rules ----------------------------------------------
+
+def test_serving_mesh_shape_and_validation():
+    mesh = serving_mesh(dp=2, tp=2)
+    assert dict(mesh.shape)["dp"] == 2
+    assert dict(mesh.shape)["tp"] == 2
+    assert dict(mesh.shape)["fsdp"] == 1        # training axes pinned to 1
+    with pytest.raises(ValueError, match="needs 16 devices"):
+        serving_mesh(dp=4, tp=4)                # only 8 exist
+    with pytest.raises(ValueError, match=">= 1"):
+        serving_mesh(dp=0, tp=2)
+
+
+def test_serving_rules_and_cache_spec_gqa_guard():
+    # MHA tiny: tp=2 divides heads=4, kv_heads=4, d_ff=176, vocab=512
+    rules = serving_rules(F32_TINY, tp=2)
+    assert rules.heads == "tp" and rules.kv_heads == "tp"
+    assert rules.ffn == "tp" and rules.vocab == "tp"
+    assert serving_cache_spec(rules) == P(None, "dp", None, "tp")
+
+    # the GQA guard: tp=4 divides the 4 Q heads but not the 2 K/V heads —
+    # K/V (and the cache's kv_heads axis) REPLICATE, Q-side stays sharded
+    gqa_rules = serving_rules(GQA_TINY, tp=4)
+    assert gqa_rules.heads == "tp"
+    assert gqa_rules.kv_heads is None
+    assert serving_cache_spec(gqa_rules) == P(None, "dp")
+
+    # tp=1: everything replicates (the spec is all-None, trimmed empty)
+    assert serving_cache_spec(serving_rules(F32_TINY, tp=1)) == P(None, "dp")
+
+
+def test_best_mesh_shape_respects_kv_heads_cap():
+    import math
+
+    # uncapped: 8 devices pick tp=2; a 1-KV-head model must not
+    assert best_mesh_shape(8)["tp"] == 2
+    assert best_mesh_shape(8, kv_heads=1)["tp"] == 1
+    # 16 devices pick tp=4; a 2-KV-head model caps at tp=2
+    assert best_mesh_shape(16, kv_heads=2)["tp"] == 2
+    # the cap never breaks the product invariant
+    for n in (1, 2, 4, 8, 16, 64):
+        for kv in (1, 2, 3, 8):
+            sizes = best_mesh_shape(n, kv_heads=kv)
+            assert math.prod(sizes.values()) == n, (n, kv, sizes)
+            assert sizes["tp"] <= max(kv, 1)
+
+
+def test_slot_and_page_pool_divisibility_guards(params):
+    with pytest.raises(ValueError, match="divisible by mesh"):
+        make_engine(params, dp=2, slots=3, paged=False)
+    with pytest.raises(ValueError, match="divisible by mesh"):
+        make_engine(params, dp=2, slots=4, page_size=16, kv_pages=7)
+
+
+# -- meshed == single-chip == generate, exactly ------------------------------
+
+@pytest.mark.parametrize("dp,tp", [(2, 1), (1, 2), (2, 2)])
+def test_meshed_engine_matches_generate(params, dp, tp):
+    """The tentpole equality: the dp/tp-sharded paged engine emits the same
+    greedy tokens as single-tenant decode.generate (and therefore as the
+    single-chip engine, which test_paging pins to the same reference) —
+    with more requests than slots, so slot reuse and page recycling run on
+    the SHARDED cache."""
+    engine = make_engine(params, dp=dp, tp=tp, page_size=16)
+    prompts = [list(range(3, 11)),           # len 8  -> bucket 16
+               [5],                          # len 1  -> no prefill
+               list(range(1, 21)),           # len 20 -> bucket 32
+               list(range(2, 14)),           # len 12 -> bucket 16
+               list(range(7, 40)),           # len 33 -> bucket 64
+               [9, 8, 7]]                    # 6 requests > 4 slots
+    news = [6, 9, 4, 7, 5, 8]
+    handles = []
+    for prompt, new in zip(prompts, news):
+        handles.append(engine.submit(prompt, max_new_tokens=new))
+        engine.step()                        # join mid-batch
+    drain(engine)
+    for prompt, new, handle in zip(prompts, news, handles):
+        summary = handle.result(timeout_s=5)
+        assert summary["outcome"] == "completed"
+        assert summary["tokens"] == reference_tokens(params, prompt, new)
+
+
+def test_meshed_contiguous_and_kernel_match_generate(params):
+    """The other two layouts under the same 2x2 mesh: the contiguous cache
+    (slots axis over dp) and the pallas kernel dispatch (shard_map over the
+    tp head slices — GSPMD must never partition the custom call blindly)
+    both stay token-identical to the reference."""
+    prompts = [list(range(2, 12)), [4], list(range(5, 23))]
+    news = [6, 8, 5]
+    for engine in (make_engine(params, dp=2, tp=2, paged=False),
+                   make_engine(params, dp=2, tp=2, page_size=16,
+                               paged_kernel="on")):
+        handles = [engine.submit(prompt, max_new_tokens=new)
+                   for prompt, new in zip(prompts, news)]
+        drain(engine)
+        for prompt, new, handle in zip(prompts, news, handles):
+            assert (handle.result(timeout_s=5)["tokens"]
+                    == reference_tokens(params, prompt, new))
+
+
+def test_gqa_replication_guard_end_to_end(gqa_params):
+    """tp=4 over a 2-KV-head model: K/V and the cache replicate while the
+    Q-side matmuls shard (serving_rules) — and under the kernel dispatch
+    shard_map runs the kernel REPLICATED (the head split would misalign the
+    i // group GQA mapping). Both dispatches must still match the GQA
+    reference exactly."""
+    prompts = [list(range(4, 14)), list(range(6, 9))]
+    news = [6, 7]
+    for paged_kernel in ("off", "on"):
+        engine = make_engine(gqa_params, dp=1, tp=4, config=GQA_TINY,
+                             page_size=16, paged_kernel=paged_kernel)
+        assert engine._rules.kv_heads is None          # the guard engaged
+        assert not engine._kernel_shard_heads
+        handles = [engine.submit(prompt, max_new_tokens=new)
+                   for prompt, new in zip(prompts, news)]
+        drain(engine)
+        for prompt, new, handle in zip(prompts, news, handles):
+            assert (handle.result(timeout_s=5)["tokens"]
+                    == reference_tokens(gqa_params, prompt, new,
+                                        config=GQA_TINY))
+
+
+# -- zero recompiles on the sharded cache ------------------------------------
+
+@pytest.mark.parametrize("dp,tp", [(1, 1), (2, 2)])
+def test_zero_recompiles_with_reuse_and_recycling(params, dp, tp):
+    """Joins, leaves, a cancel and every page reassignment must reuse the
+    warmed executables on the single-chip AND the 2x2-meshed engine — page
+    tables, positions and per-slot operands stay traced (replicated
+    device_put under the mesh, never a shape), so the jit cache must not
+    grow after warmup."""
+    engine = make_engine(params, dp=dp, tp=tp, page_size=16)
+    lens = (8, 20, 1, 40, 12, 28)
+    engine.warmup(prompt_lens=lens)
+    step_execs = engine.step_executable._cache_size()
+    prefill_execs = engine.prefill_executable._cache_size()
+    handles = []
+    for index, plen in enumerate(lens):
+        prompt = [(3 * index + j) % F32_TINY.vocab_size or 1
+                  for j in range(plen)]
+        handles.append(engine.submit(prompt, max_new_tokens=5,
+                                     temperature=0.0 if index % 2 else 0.6))
+        engine.step()
+    handles[3].cancel()                     # recycle pages mid-storm
+    drain(engine)
+    outcomes = [handle.result(timeout_s=5)["outcome"] for handle in handles]
+    assert outcomes.count("completed") == 5
+    assert outcomes[3] == "cancelled"
+    assert engine.stats()["kvPagesFree"] == engine.stats()["kvPagesTotal"]
+    assert engine.step_executable._cache_size() == step_execs
+    assert engine.prefill_executable._cache_size() == prefill_execs
+
+
+# -- fingerprints, stats, rollback -------------------------------------------
+
+def test_mesh_fingerprints_stats_and_rollback(params):
+    from tensorhive_tpu.observability import get_registry
+
+    meshed = make_engine(params, dp=2, tp=2, page_size=16)
+    assert meshed.mesh_shape == "2x2" and meshed.num_devices == 4
+    stats = meshed.stats()
+    assert stats["meshShape"] == "2x2" and stats["numDevices"] == 4
+    # meshed engines mint serving_mesh_* compile fingerprints...
+    assert (meshed._fingerprint_fn("serving_paged_step")
+            == "serving_mesh_paged_step")
+    handle = meshed.submit([1, 2, 3], max_new_tokens=2)
+    drain(meshed)
+    assert handle.result(timeout_s=5)["outcome"] == "completed"
+    rendered = get_registry().render()
+    assert 'fn="serving_mesh_paged_step"' in rendered
+    assert "tpuhive_generate_mesh_devices 4" in rendered
+
+    # ...and a 1x1 engine is a fingerprint-identical rollback: no mesh, the
+    # ORIGINAL fn names, and the gauge drops back to 1
+    single = make_engine(params, page_size=16)
+    assert single.mesh is None
+    assert single.mesh_shape == "1x1" and single.num_devices == 1
+    assert (single._fingerprint_fn("serving_paged_step")
+            == "serving_paged_step")
+    assert single.stats()["meshShape"] == "1x1"
+    assert "tpuhive_generate_mesh_devices 1" in get_registry().render()
+
+
+def test_build_engine_scales_capacity_with_dp(config):
+    """[generation_service] slots is PER DP SHARD: dp=2 doubles engine
+    capacity and the page pool at equal per-chip HBM, and the 1x1 default
+    builds the plain single-chip engine (the rollback contract the mesh
+    smoke also pins end to end)."""
+    from tensorhive_tpu.core.services.generation import build_engine
+
+    config.generation.enabled = True
+    config.generation.slots = 2
+    config.generation.max_len = 48
+    config.generation.use_flash = False
+    single = build_engine(config)
+    assert single.mesh is None and single.capacity == 2
+
+    config.generation.mesh_dp = 2
+    meshed = build_engine(config)
+    assert meshed.mesh_shape == "2x1"
+    assert meshed.capacity == 2 * single.capacity
+    assert meshed._pool.num_pages == 2 * single._pool.num_pages
+
+
+# -- checkpoint serving ------------------------------------------------------
+
+def checkpoint_of(params, path):
+    from tensorhive_tpu.train import save_checkpoint
+
+    save_checkpoint(str(path), 7, params, {"nu": jnp.zeros(1)})
+
+
+def test_load_checkpoint_roundtrip_and_errors(tmp_path):
+    from tensorhive_tpu.core.services.generation import (
+        load_checkpoint_params,
+    )
+    from tensorhive_tpu.serving import CheckpointLoadError
+
+    saved = TransformerLM.init(jax.random.PRNGKey(1), F32_TINY)
+    checkpoint_of(saved, tmp_path)
+    step, loaded = load_checkpoint_params(str(tmp_path), F32_TINY)
+    assert step == 7
+    assert jax.tree_util.tree_all(jax.tree_util.tree_map(
+        lambda a, b: np.allclose(np.asarray(a), np.asarray(b)),
+        saved, loaded))
+
+    # a checkpoint for a DIFFERENT model shape: the error names the leaves
+    with pytest.raises(CheckpointLoadError, match="does not fit"):
+        load_checkpoint_params(
+            str(tmp_path), dataclasses.replace(F32_TINY, d_model=32))
+    # nothing saved there at all
+    with pytest.raises(CheckpointLoadError, match="no checkpoint steps"):
+        load_checkpoint_params(str(tmp_path / "empty"), F32_TINY)
+
+
+def test_build_engine_serves_checkpoint_params(config, tmp_path):
+    """checkpoint_path params flow into the engine (NOT random init) —
+    build_engine's model config only widens max_seq_len, so train_loop
+    checkpoints of the same preset fit as-is."""
+    from tensorhive_tpu.core.services.generation import build_engine
+
+    model_config = dataclasses.replace(PRESETS["tiny"], use_flash=False)
+    saved = TransformerLM.init(jax.random.PRNGKey(5), model_config)
+    checkpoint_of(saved, tmp_path)
+    config.generation.enabled = True
+    config.generation.slots = 2
+    config.generation.max_len = 48
+    config.generation.use_flash = False
+    config.generation.checkpoint_path = str(tmp_path)
+    engine = build_engine(config)
+    assert np.allclose(np.asarray(engine.params["tok_embed"]),
+                       np.asarray(saved["tok_embed"]))
+
+
+def test_generation_service_503_reason_on_bad_checkpoint(config):
+    """A broken checkpoint_path must not crash the daemon OR silently serve
+    init params: the service boots with no engine and the recorded reason
+    reaches the controller's 503 body."""
+    from tensorhive_tpu import serving
+    from tensorhive_tpu.controllers.generate import _unavailable_msg
+    from tensorhive_tpu.core.services.generation import GenerationService
+
+    config.generation.enabled = True
+    config.generation.slots = 2
+    config.generation.max_len = 48
+    config.generation.checkpoint_path = "/nonexistent/checkpoints"
+    service = GenerationService(config=config)
+    try:
+        assert service.engine is None
+        assert serving.get_engine() is None
+        reason = serving.get_unavailable_reason()
+        assert reason and "/nonexistent/checkpoints" in reason
+        assert reason in _unavailable_msg()
+        service.do_run()                    # engine-less tick is a no-op
+    finally:
+        service.shutdown()
+        serving.set_unavailable_reason(None)

@@ -1,0 +1,144 @@
+"""Multi-chip serving smoke: the sharded slot engine on 8 forced devices.
+
+Boots the serving plane exactly as ``[generation_service] mesh_dp = 2,
+mesh_tp = 2`` would — through ``build_engine`` on a virtual 8-device CPU
+platform (``--xla_force_host_platform_device_count=8``, the same trick the
+test suite and the MULTICHIP dryruns use) — and proves the contracts
+docs/SERVING.md "Multi-chip serving" promises:
+
+1. **Sharded == single-chip, token-identical.** The same mixed-length
+   greedy workload through the 2x2-mesh engine and through the 1x1 engine
+   yields identical token streams (GSPMD partitioning is a placement
+   decision, never a behavior).
+2. **Zero post-warmup recompiles under sharding.** Joins, leaves and page
+   assignment on the dp-sharded cache must not mint new executables — the
+   traced-operand discipline survives NamedShardings.
+3. **Slot capacity scales with dp at equal per-chip HBM.** ``slots`` is
+   per-dp-shard, so the 2x2 engine serves 2x the sequences of the
+   single-chip config while each chip holds the same cache rows.
+4. **1x1 is a fingerprint-identical rollback.** ``mesh_dp = mesh_tp = 1``
+   builds an engine with NO mesh (same executables, same
+   ``serving_*`` — not ``serving_mesh_*`` — compile fingerprints).
+
+Run via ``make serving-mesh-smoke``; CI runs it after the serving smoke.
+"""
+from __future__ import annotations
+
+import os
+import sys
+from pathlib import Path
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[1]))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from tensorhive_tpu.config import Config  # noqa: E402
+from tensorhive_tpu.core.services.generation import build_engine  # noqa: E402
+from tensorhive_tpu.observability import get_registry  # noqa: E402
+
+SLOTS_PER_SHARD = 4
+NEW_TOKENS = 8
+PROMPT_LENS = (12, 20, 1, 33, 12, 20, 33, 5)
+MAX_LEN = 64
+
+
+def serving_config(mesh_dp: int, mesh_tp: int) -> Config:
+    cfg = Config(config_dir=Path("/tmp"))
+    cfg.generation.enabled = True
+    cfg.generation.preset = "tiny"
+    cfg.generation.slots = SLOTS_PER_SHARD
+    cfg.generation.max_len = MAX_LEN
+    cfg.generation.mesh_dp = mesh_dp
+    cfg.generation.mesh_tp = mesh_tp
+    cfg.generation.queue_depth = 2 * len(PROMPT_LENS)
+    cfg.generation.use_flash = False
+    return cfg
+
+
+def run_workload(engine):
+    """Submit the mixed-length storm (more requests than one shard's slots,
+    so slots are reused and pages recycled) and return every token list."""
+    prompts = [[(7 * i + j) % engine.config.vocab_size or 1
+                for j in range(plen)] for i, plen in enumerate(PROMPT_LENS)]
+    handles = [engine.submit(prompt, max_new_tokens=NEW_TOKENS)
+               for prompt in prompts]
+    while engine.has_work():
+        engine.step()
+    return [handle.result(timeout_s=10)["tokens"] for handle in handles]
+
+
+def main() -> int:
+    failures = []
+
+    single = build_engine(serving_config(1, 1))
+    if single.mesh is not None or single.mesh_shape != "1x1":
+        failures.append("1x1 config built a mesh engine — rollback broken")
+    if single._fingerprint_fn("serving_paged_step") != "serving_paged_step":
+        failures.append("1x1 engine mints serving_mesh_* fingerprints — "
+                        "rollback is not fingerprint-identical")
+    single_tokens = run_workload(single)
+
+    meshed = build_engine(serving_config(2, 2))
+    stats = meshed.stats()
+    if stats["meshShape"] != "2x2" or stats["numDevices"] != 4:
+        failures.append(f"mesh stats wrong: {stats['meshShape']} / "
+                        f"{stats['numDevices']} devices")
+    if meshed.capacity != 2 * single.capacity:
+        failures.append(
+            f"dp=2 capacity {meshed.capacity} != 2x single-chip "
+            f"{single.capacity} — the slot pool is not scaling with dp")
+    # equal per-chip HBM: the dp-sharded page pool holds the single-chip
+    # engine's rows PER SHARD
+    if meshed._pool.num_pages != 2 * single._pool.num_pages:
+        failures.append(
+            f"dp=2 page pool {meshed._pool.num_pages} != 2x single-chip "
+            f"{single._pool.num_pages} — per-chip HBM drifted")
+    if meshed._cache.k.sharding.spec != jax.sharding.PartitionSpec(
+            None, "dp", None, "tp"):
+        failures.append(
+            f"cache sharding {meshed._cache.k.sharding.spec} is not "
+            "(pages over dp, kv_heads over tp)")
+
+    step_execs = meshed.step_executable._cache_size()
+    prefill_execs = meshed.prefill_executable._cache_size()
+    mesh_tokens = run_workload(meshed)
+    step_growth = meshed.step_executable._cache_size() - step_execs
+    prefill_growth = meshed.prefill_executable._cache_size() - prefill_execs
+    if step_growth or prefill_growth:
+        failures.append(
+            f"recompiles on the sharded engine: step +{step_growth}, "
+            f"prefill +{prefill_growth} — a sharding or page table leaked "
+            "into a static shape")
+
+    if mesh_tokens != single_tokens:
+        diffs = sum(1 for a, b in zip(mesh_tokens, single_tokens) if a != b)
+        failures.append(
+            f"sharded tokens differ from single-chip on {diffs}/"
+            f"{len(single_tokens)} requests — GSPMD changed behavior")
+
+    rendered = get_registry().render()
+    if "tpuhive_generate_mesh_devices 4" not in rendered:
+        failures.append("tpuhive_generate_mesh_devices gauge missing or "
+                        "wrong in the exposition")
+
+    print(f"serving-mesh-smoke: {len(PROMPT_LENS)} requests x {NEW_TOKENS} "
+          f"tokens | 1x1 capacity {single.capacity} vs 2x2 capacity "
+          f"{meshed.capacity} on {jax.device_count()} forced devices | "
+          f"cache {meshed._cache.k.sharding.spec} | "
+          f"step_growth={step_growth} prefill_growth={prefill_growth} | "
+          f"token-identical={mesh_tokens == single_tokens}")
+    for failure in failures:
+        print(f"serving-mesh-smoke FAILURE: {failure}", file=sys.stderr)
+    return 1 if failures else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
