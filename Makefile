@@ -2,7 +2,7 @@
 # mypy + flake8 per .circleci/config.yml:33-38): the dependency-free AST
 # lint + thivelint analyzer always run; mypy/ruff run when installed
 # (absent from this image).
-.PHONY: check lint analysis test bench probe metrics-smoke decode-smoke alerts-smoke chaos-smoke serving-smoke serving-mesh-smoke
+.PHONY: check lint analysis test bench probe metrics-smoke decode-smoke alerts-smoke chaos-smoke serving-smoke serving-mesh-smoke trace-smoke
 
 check: lint analysis
 	@command -v ruff >/dev/null 2>&1 && ruff check . || echo "ruff not installed; skipped (tools/lint.py covered the always-on subset)"
@@ -61,6 +61,15 @@ serving-smoke:
 # executables fingerprint-identically (docs/SERVING.md "Multi-chip serving")
 serving-mesh-smoke:
 	python tools/serving_mesh_smoke.py
+
+# request tracing + on-demand profiling over real HTTP: one streamed
+# /api/generate request must land in /api/admin/requests with sanely
+# ordered phase timings and request_id-labelled spans, a profile capture
+# must write a real artifact on the CPU backend, and the queue-wait
+# histogram + per-device HBM gauge must be scrapeable (docs/OBSERVABILITY.md
+# "Request tracing & profiling")
+trace-smoke:
+	python tools/trace_smoke.py
 
 probe:
 	$(MAKE) -C tensorhive_tpu/native

@@ -460,6 +460,27 @@ def bench_generate_serving():
         "recompiles_during_batch": paged_recompiles,
         "stats": engine.stats(),
     })
+    # per-phase request breakdown off the serving ledger (the same rows
+    # GET /api/admin/requests serves): mean queue/prefill/ttft/decode over
+    # the batched storm — the numbers FlexNPU-style co-location tuning and
+    # the ttft_slo/queue_wait_slo alert thresholds are set against
+    from tensorhive_tpu.observability import get_request_ledger
+
+    batched_rows = get_request_ledger().recent(limit=len(prompt_lens),
+                                               outcome="completed")
+
+    def _phase_mean(key):
+        values = [row[key] for row in batched_rows if row[key] is not None]
+        return round(sum(values) / len(values), 2) if values else None
+
+    result["request_phase_breakdown_ms"] = {
+        "requests": len(batched_rows),
+        "queue_mean": _phase_mean("queueMs"),
+        "prefill_mean": _phase_mean("prefillMs"),
+        "ttft_mean": _phase_mean("ttftMs"),
+        "decode_mean": _phase_mean("decodeMs"),
+        "intertoken_p50_mean": _phase_mean("intertokenP50Ms"),
+    }
     _log(f"  generate_serving (paged): {result}")
 
     # paged vs contiguous: same slot count and workload, both layouts

@@ -80,6 +80,7 @@ function renderNodes(main) {
   main.innerHTML = `<div id="svc-health"></div>
     <div id="alert-strip"></div>
     <div id="serving-strip"></div>
+    <div id="requests-strip"></div>
     <div class="card"><div class="row">
       <h3 style="margin:0">Watches</h3>
       ${["hbm", "duty", "procs"].map(name => `<label class="inline">
@@ -91,7 +92,9 @@ function renderNodes(main) {
     <div id="nodes"></div><dialog id="chip-dialog"></dialog>`;
   const refresh = async () => {
     try {
-      if (isAdmin()) { refreshServiceHealth(); refreshAlerts(); }
+      if (isAdmin()) {
+        refreshServiceHealth(); refreshAlerts(); refreshRecentRequests();
+      }
       refreshServing();
       const infra = await api("/nodes/metrics");
       for (const node of Object.values(infra)) {
@@ -147,9 +150,34 @@ async function refreshServiceHealth() {
     <h3 style="margin:0">Services</h3>
     ${services.map(svcBadge).join("")}
     <button class="ghost" onclick="openTracesDialog()">traces</button>
+    <button class="ghost" onclick="captureProfile()"
+      title="capture a jax.profiler trace to the artifact dir (404 while [profiling] is disabled)">profile</button>
+    <button class="ghost" onclick="showMemoryProfile()"
+      title="live per-device HBM snapshot from the XLA memory profiler">HBM</button>
     <a class="ghost" href="/api/metrics" target="_blank"
        title="Prometheus text exposition">metrics</a>
   </div></div>`;
+}
+
+/* on-demand device profiling (docs/OBSERVABILITY.md "Request tracing &
+   profiling"): POST a bounded trace capture / toast the live-HBM summary;
+   404 (profiling disabled) and 409 (capture in flight) surface as toasts */
+async function captureProfile() {
+  try {
+    const doc = await api("/admin/profile", { json: {} });
+    toast(`profile captured: ${doc.files.length} files · ` +
+          `${(doc.bytes / 1024).toFixed(0)} KiB → ${doc.artifactDir}`);
+  } catch (e) { toast(e.message, true); }
+}
+
+async function showMemoryProfile() {
+  try {
+    const doc = await api("/admin/profile/memory");
+    const per = (doc.devices || []).map(d =>
+      d.device + " " + (d.liveBytes / 1048576).toFixed(1) + " MiB");
+    toast(per.length ? "live HBM: " + per.join(" · ")
+                     : "no live device buffers");
+  } catch (e) { toast(e.message, true); }
 }
 
 /* alerts strip (admin): firing/pending rules from the in-process alert
@@ -234,6 +262,50 @@ async function refreshServing() {
     <span style="flex:1"></span>
     <button class="ghost" onclick="probeGenerate()"
       title="stream a tiny generation through POST /generate">probe</button>
+  </div></div>`;
+}
+
+/* recent-requests strip (admin): the request-scoped view behind the serving
+   strip's aggregates — last ~10 generate requests from the ledger
+   (GET /admin/requests) as queue/prefill/decode phase bars + an outcome
+   badge, so "TTFT regressed" decomposes into WHICH request and WHICH phase
+   (docs/OBSERVABILITY.md "Request tracing & profiling") */
+function requestPhaseBar(req) {
+  const total = Math.max(req.totalMs || 0, 0.001);
+  const seg = (ms, cls, label) => (ms == null || ms <= 0) ? "" :
+    `<i class="${cls}" title="${label} ${ms.toFixed(1)}ms"
+        style="width:${Math.min(100, 100 * ms / total).toFixed(1)}%"></i>`;
+  return `<span class="phase-bar" title="queue ${req.queueMs ?? "–"} /
+      prefill ${req.prefillMs ?? "–"} / decode ${req.decodeMs ?? "–"} ms">
+    ${seg(req.queueMs, "queue", "queue")}${seg(req.prefillMs, "prefill", "prefill")}${seg(req.decodeMs, "decode", "decode")}</span>`;
+}
+
+function requestBadge(req) {
+  const ok = req.outcome === "completed";
+  const detail = req.requestId + " · " + req.tokens + " tokens · queue " +
+    (req.queueMs ?? "–") + "ms · prefill " + (req.prefillMs ?? "–") +
+    "ms (bucket " + (req.prefillBucket ?? "–") + ", compile " +
+    (req.prefillCompile ?? "–") + ") · TTFT " + (req.ttftMs ?? "–") +
+    "ms · decode " + (req.decodeMs ?? "–") + "ms · slot " +
+    (req.slot ?? "–") + " · pages " + (req.kvPages ?? "–");
+  return `<span class="badge ${ok ? "on" : "unsynchronized"}"
+      title="${esc(detail)}">
+    ${requestPhaseBar(req)} ${esc(req.outcome || "running")} ·
+    ${req.tokens}tok · ${req.ttftMs != null ? req.ttftMs.toFixed(0) + "ms" : "–"}</span>`;
+}
+
+async function refreshRecentRequests() {
+  const el = document.getElementById("requests-strip");
+  if (!el) return;
+  let doc;
+  try { doc = await api("/admin/requests?limit=10"); }
+  catch (e) { el.innerHTML = ""; return; }   // serving quiet or unreachable
+  const reqs = doc.requests || [];
+  if (!reqs.length) { el.innerHTML = ""; return; }
+  el.innerHTML = `<div class="card"><div class="row">
+    <h3 style="margin:0">Requests</h3>
+    ${reqs.map(requestBadge).join("")}
+    <span class="muted">${doc.recorded} recorded · ring ${doc.capacity}</span>
   </div></div>`;
 }
 

@@ -206,7 +206,25 @@ class GenerationConfig:
     interval_s: float = 0.02         # pump tick; do_run budgets inside it
     stream_timeout_s: float = 30.0   # client-side max silent gap
     ttft_slo_s: float = 2.0          # p95 budget the alert pack enforces
+    queue_wait_slo_s: float = 1.0    # p95 admission-queue wait budget (the
+                                     # queue_wait_slo alert rule; TTFT minus
+                                     # this is the prefill share)
     slot_leak_after_s: float = 60.0  # silent-busy-slot alert threshold
+    request_ledger_size: int = 256   # bounded per-request trace ring
+                                     # (GET /api/admin/requests)
+
+
+@dataclasses.dataclass
+class ProfilingConfig:
+    """On-demand device profiling (docs/OBSERVABILITY.md "Request tracing &
+    profiling"; no reference analog). Disabled by default: the profiler is a
+    process-wide singleton and captures write artifacts to disk, so exposing
+    it is an explicit operator decision. When disabled, the
+    ``/api/admin/profile*`` endpoints answer 404."""
+    enabled: bool = False
+    artifact_dir: str = "{config_dir}/profiles"
+    max_duration_s: float = 10.0     # per-capture ceiling (absolute cap 60)
+    default_duration_s: float = 1.0  # when POST body omits durationS
 
 
 @dataclasses.dataclass
@@ -276,6 +294,7 @@ class Config:
     job_scheduling: JobSchedulingConfig = dataclasses.field(default_factory=JobSchedulingConfig)
     alerting: AlertingConfig = dataclasses.field(default_factory=AlertingConfig)
     generation: GenerationConfig = dataclasses.field(default_factory=GenerationConfig)
+    profiling: ProfilingConfig = dataclasses.field(default_factory=ProfilingConfig)
     ssh: SshConfig = dataclasses.field(default_factory=SshConfig)
     hosts: Dict[str, HostConfig] = dataclasses.field(default_factory=dict)
 
@@ -291,6 +310,11 @@ class Config:
     @property
     def ssh_key_path(self) -> Path:
         return Path(self.ssh.key_path.format(config_dir=str(self.config_dir)))
+
+    @property
+    def profile_artifact_dir(self) -> Path:
+        return Path(self.profiling.artifact_dir.format(
+            config_dir=str(self.config_dir)))
 
     @property
     def slices(self) -> Dict[str, List[HostConfig]]:
@@ -314,6 +338,7 @@ _SECTION_MAP = {
     "job_scheduling_service": "job_scheduling",
     "alerting_service": "alerting",
     "generation_service": "generation",
+    "profiling": "profiling",
     "ssh": "ssh",
 }
 
@@ -445,6 +470,17 @@ enabled = false
 # max_concurrent_per_user = 4
 # require_restriction = true
 # ttft_slo_s = 2.0
+# queue_wait_slo_s = 1.0
+# request_ledger_size = 256   # GET /api/admin/requests ring bound
+
+[profiling]
+# on-demand jax.profiler captures via POST /api/admin/profile and the
+# live-HBM snapshot at GET /api/admin/profile/memory (docs/OBSERVABILITY.md
+# "Request tracing & profiling"); endpoints 404 while disabled
+enabled = false
+# artifact_dir = "{{config_dir}}/profiles"
+# max_duration_s = 10.0
+# default_duration_s = 1.0
 
 [ssh]
 timeout_s = 10.0

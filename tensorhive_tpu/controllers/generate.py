@@ -18,6 +18,7 @@ the SPA can distinguish "off" from "broken".
 from __future__ import annotations
 
 import json
+import time
 from typing import Dict, Optional
 
 from werkzeug.wrappers import Response
@@ -84,12 +85,16 @@ def _service_unavailable() -> Response:
 
 
 def _rejection(exc: AdmissionError) -> Response:
-    """429 with an honest Retry-After (seconds, integral per RFC 9110)."""
+    """429 with an honest Retry-After (seconds, integral per RFC 9110) and
+    the rejection's ledger id — a shed request is still quotable against
+    ``GET /api/admin/requests``."""
     response = Response(
         json.dumps({"msg": str(exc),
                     "retryAfterS": round(exc.retry_after_s, 1)}),
         status=429, content_type="application/json")
     response.headers["Retry-After"] = str(max(1, int(exc.retry_after_s)))
+    if exc.request_id:
+        response.headers["X-Request-Id"] = exc.request_id
     return response
 
 
@@ -148,12 +153,17 @@ def post_generate(context: RequestContext) -> Response:
                         content_type="application/json")
 
     def stream():
+        from ..observability import get_tracer
+
+        stream_started = time.time()
+        status = "ok"
         try:
             for token in handle.tokens(timeout_s=timeout_s):
                 yield json.dumps({"token": token}) + "\n"
             summary = handle.result(timeout_s=timeout_s)
             yield json.dumps({
                 "done": True,
+                "requestId": summary["requestId"],
                 "outcome": summary["outcome"],
                 "tokens": summary["tokens"],
                 "ttftMs": (round(summary["ttftS"] * 1e3, 3)
@@ -161,15 +171,28 @@ def post_generate(context: RequestContext) -> Response:
                 "durationMs": round(summary["durationS"] * 1e3, 3),
             }) + "\n"
         except (TimeoutError, RuntimeError) as exc:
+            status = "error"
             yield json.dumps({"error": str(exc)}) + "\n"
         finally:
             # a client that disconnects mid-stream must not leak its slot:
             # generator close cancels the request (no-op when finished)
             handle.cancel()
+            # the streaming phase outlives the api dispatch span (werkzeug
+            # iterates this generator after dispatch returns), so it gets
+            # its own request_id-labelled span — the fourth phase of the
+            # ledger's queue/prefill/decode story
+            get_tracer().record_span(
+                "generate.stream", kind="generate",
+                start_ts=stream_started,
+                duration_s=time.time() - stream_started,
+                status=status, request_id=handle.request_id)
 
     return Response(stream(), content_type=NDJSON_CONTENT_TYPE,
                     headers={"X-Accel-Buffering": "no",
-                             "Cache-Control": "no-cache"})
+                             "Cache-Control": "no-cache",
+                             # quotable against /api/admin/requests and the
+                             # request_id-labelled spans in /api/admin/traces
+                             "X-Request-Id": handle.request_id})
 
 
 @route("/generate/stats", ["GET"], auth="jwt", tag="generate",

@@ -1,5 +1,5 @@
-"""Observability controller: metrics exposition, trace dump, alert state
-and health probes.
+"""Observability controller: metrics exposition, trace dump, alert state,
+request ledger, profiler captures and health probes.
 
 The read surfaces of tensorhive_tpu/observability:
 
@@ -9,6 +9,13 @@ The read surfaces of tensorhive_tpu/observability:
   this per-resource endpoint).
 * ``GET /admin/traces`` — recent spans from the ring-buffer tracer,
   admin-auth (span attrs include hostnames and job ids).
+* ``GET /admin/requests`` — the per-request serving ledger: phase timings
+  (queue/prefill/decode), slot/page placement, compile hit/miss and outcome
+  for recent generate requests, admin-auth (docs/OBSERVABILITY.md "Request
+  tracing & profiling").
+* ``POST /api/admin/profile`` / ``GET /api/admin/profile/memory`` —
+  on-demand ``jax.profiler`` trace captures and live-HBM snapshots,
+  admin-auth, 404 while ``[profiling]`` is disabled.
 * ``GET /healthz`` / ``GET /readyz`` — liveness and readiness, both
   unauthenticated (an orchestrator's kubelet-style prober has no JWT);
   readiness returns 503 with a JSON reason list when any component fails.
@@ -21,11 +28,12 @@ from typing import Dict, Tuple
 
 from werkzeug.wrappers import Response
 
-from ..api.app import RequestContext, int_arg, route
+from ..api.app import RequestContext, int_arg, json_body, route
 from ..api.schema import arr, obj, s
-from ..observability import get_registry, get_tracer
+from ..observability import get_registry, get_request_ledger, get_tracer
 from ..observability.alerts import get_alert_engine
 from ..observability.health import liveness, readiness
+from ..utils.exceptions import ConflictError, NotFoundError, ValidationError
 
 #: content type Prometheus scrapers negotiate for the text format
 PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
@@ -132,6 +140,152 @@ def get_readyz(context: RequestContext) -> Tuple[Dict, int]:
                for c in components if not c["ok"]]
     return ({"ready": ready, "components": components, "reasons": reasons},
             200 if ready else 503)
+
+
+REQUEST_RECORD_SCHEMA = obj(
+    required=["requestId", "submittedTs", "promptTokens", "maxNewTokens",
+              "tokens"],
+    requestId=s("string"),
+    outcome=s("string", nullable=True),
+    submittedTs=s("number"),
+    finishedTs=s("number", nullable=True),
+    promptTokens=s("integer"),
+    maxNewTokens=s("integer"),
+    temperature=s("number"),
+    userKey=s("string", nullable=True),
+    slot=s("integer", nullable=True),
+    kvPages=s("integer", nullable=True),
+    queueMs=s("number", nullable=True),
+    prefillBucket=s("integer", nullable=True),
+    prefillCompile=s("string", nullable=True),
+    prefillMs=s("number", nullable=True),
+    ttftMs=s("number", nullable=True),
+    decodeMs=s("number", nullable=True),
+    totalMs=s("number", nullable=True),
+    tokens=s("integer"),
+    intertokenP50Ms=s("number", nullable=True),
+)
+
+
+@route("/admin/requests", ["GET"], auth="admin",
+       summary="Per-request serving traces (phase timings + outcomes)",
+       tag="observability",
+       query={"limit": s("integer"), "outcome": s("string")},
+       responses={200: obj(required=["capacity", "recorded", "requests",
+                                     "inFlight"],
+                           capacity=s("integer"),
+                           recorded=s("integer"),
+                           requests=arr(REQUEST_RECORD_SCHEMA),
+                           inFlight=arr(REQUEST_RECORD_SCHEMA))})
+def get_requests(context: RequestContext) -> Dict:
+    """Finished generate requests newest-first with their
+    queue/prefill/decode phase breakdown, slot/page placement, prefill
+    compile hit/miss and outcome (rejections included), plus the requests
+    currently queued or running; ``?limit=`` caps the finished dump,
+    ``?outcome=`` filters it. Every row's ``requestId`` matches the
+    ``X-Request-Id`` response header and the ``request_id`` attr on the
+    ``generate.*`` spans in ``GET /api/admin/traces``."""
+    ledger = get_request_ledger()
+    limit = int_arg(context, "limit")
+    outcome = context.request.args.get("outcome")
+    return {
+        "capacity": ledger.capacity,
+        "recorded": len(ledger),
+        "requests": ledger.recent(limit=limit, outcome=outcome),
+        "inFlight": ledger.in_flight(),
+    }
+
+
+def _profiling_config():
+    """The [profiling] config, or a 404 while the subsystem is disabled —
+    surfacing capture endpoints on a process whose operator never opted in
+    would expose disk writes + a process-wide profiler to any admin JWT."""
+    from ..config import get_config
+
+    config = get_config()
+    if not config.profiling.enabled:
+        raise NotFoundError(
+            "profiling is disabled on this manager ([profiling] enabled "
+            "in config.toml; docs/OBSERVABILITY.md)")
+    return config
+
+
+@route("/admin/profile", ["POST"], auth="admin",
+       summary="Capture a bounded jax.profiler trace to the artifact dir",
+       tag="observability",
+       body=obj(durationS=s("number"), ),
+       responses={200: obj(required=["artifactDir", "durationS", "files",
+                                     "bytes"],
+                           artifactDir=s("string"),
+                           durationS=s("number"),
+                           startedTs=s("number"),
+                           files=arr(s("string")),
+                           bytes=s("integer")),
+                  404: obj(required=["msg"], msg=s("string")),
+                  409: obj(required=["msg"], msg=s("string"))})
+def post_profile(context: RequestContext) -> Dict:
+    """Run ``jax.profiler.start_trace``/``stop_trace`` around a bounded
+    window (body ``durationS``, default/ceiling from ``[profiling]``) so
+    steady-state serving traffic lands in a TensorBoard-loadable artifact.
+    Single-flight: a concurrent capture answers 409 — the XLA profiler is
+    process-wide and two captures would corrupt each other."""
+    from ..observability import get_tracer as _get_tracer
+    from ..observability.profiling import (
+        ProfileInFlightError,
+        ProfileUnavailableError,
+        capture_trace,
+    )
+
+    config = _profiling_config()
+    body = json_body(context)
+    duration_raw = body.get("durationS")
+    duration_s = (config.profiling.default_duration_s
+                  if duration_raw is None else float(duration_raw))
+    try:
+        return capture_trace(
+            str(config.profile_artifact_dir), duration_s,
+            max_duration_s=config.profiling.max_duration_s,
+            tracer=_get_tracer())
+    except ValueError as exc:
+        raise ValidationError(str(exc))
+    except ProfileInFlightError as exc:
+        raise ConflictError(str(exc))
+    except ProfileUnavailableError as exc:
+        raise NotFoundError(str(exc))
+
+
+@route("/admin/profile/memory", ["GET"], auth="admin",
+       summary="Live device-memory snapshot (per-device HBM bytes)",
+       tag="observability",
+       query={"format": s("string")},
+       responses={200: obj(required=["capturedTs", "devices",
+                                     "totalLiveBytes"],
+                           capturedTs=s("number"),
+                           devices=arr(obj(
+                               required=["device", "liveBytes",
+                                         "allocations"],
+                               device=s("string"),
+                               liveBytes=s("integer"),
+                               allocations=s("integer"))),
+                           totalLiveBytes=s("integer"),
+                           profileBytes=s("integer")),
+                  404: obj(required=["msg"], msg=s("string"))})
+def get_profile_memory(context: RequestContext):
+    """One ``jax.profiler.device_memory_profile`` snapshot parsed to
+    per-device live bytes (also exported as
+    ``tpuhive_device_hbm_live_bytes{device}`` so HBM growth is scrapeable
+    alongside the KV-pages gauges); ``?format=pprof`` returns the raw
+    gzipped pprof blob for offline analysis."""
+    from ..observability.profiling import (
+        device_memory_summary,
+        raw_device_memory_profile,
+    )
+
+    _profiling_config()
+    if context.request.args.get("format") == "pprof":
+        return Response(raw_device_memory_profile(),
+                        content_type="application/octet-stream")
+    return device_memory_summary(registry=get_registry())
 
 
 @route("/admin/alerts", ["GET"], auth="admin",

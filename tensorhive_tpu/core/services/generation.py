@@ -178,6 +178,16 @@ def build_engine(config: Config):
         raise ValueError(
             f"[generation_service] preset {generation.preset!r} unknown; "
             f"choose from {sorted(PRESETS)}")
+    if generation.request_ledger_size < 1:
+        raise ValueError(
+            f"[generation_service] request_ledger_size must be >= 1, got "
+            f"{generation.request_ledger_size}")
+    # bound the per-request trace ring (GET /api/admin/requests) the engine
+    # will write into — sized here so the knob lives with the rest of the
+    # serving config
+    from ...observability import get_request_ledger
+
+    get_request_ledger().set_capacity(generation.request_ledger_size)
     mesh_dp, mesh_tp = int(generation.mesh_dp), int(generation.mesh_tp)
     if mesh_dp < 1 or mesh_tp < 1:
         raise ValueError(

@@ -339,10 +339,12 @@ def _prefill_bucket(length: int, cap: int,
 _compile_seen: set = set()
 
 
-def _count_compile(fn: str, fingerprint: tuple) -> None:
+def _count_compile(fn: str, fingerprint: tuple) -> str:
     """Count decode-path executable compiles (miss = first time this shape
     fingerprint is dispatched in-process, mirroring jax's jit cache) vs.
-    shape-cache reuses (hit) in ``tpuhive_decode_compile_total``."""
+    shape-cache reuses (hit) in ``tpuhive_decode_compile_total``; returns
+    the event so per-request callers (the serving ledger) can attribute
+    THIS dispatch without re-deriving the fingerprint."""
     event = "hit" if fingerprint in _compile_seen else "miss"
     _compile_seen.add(fingerprint)
     get_registry().counter(
@@ -350,6 +352,7 @@ def _count_compile(fn: str, fingerprint: tuple) -> None:
         "decode-path executables: miss = new shape compiled, "
         "hit = shape-cache reuse",
         labels=("fn", "event")).labels(fn=fn, event=event).inc()
+    return event
 
 
 def generate(

@@ -27,6 +27,7 @@ from .metrics import (
     MetricsRegistry,
     register_process_metrics,
 )
+from .requests import RequestLedger, RequestRecord, get_request_ledger
 from .tracing import Span, SpanLogFilter, SpanTracer
 
 _registry = MetricsRegistry()
@@ -37,6 +38,12 @@ from .. import __version__ as _version  # noqa: E402  (cheap: pure-constant modu
 # build info + lazy process self-metrics (RSS/threads/uptime/fds) on the
 # process-wide registry, refreshed by a collector at exposition time
 register_process_metrics(_registry, _version)
+
+# per-device live-HBM gauges refreshed at scrape time — inert (and jax-free)
+# unless [profiling] is enabled AND jax is already in the process
+from .profiling import hbm_collector as _hbm_collector  # noqa: E402
+
+_registry.register_collector(_hbm_collector)
 
 
 def get_registry() -> MetricsRegistry:
@@ -61,6 +68,8 @@ def reset_observability() -> None:
     """
     _registry.reset_values()
     _tracer.clear()
+    _ledger_singleton = get_request_ledger()
+    _ledger_singleton.clear()
     from .alerts import set_alert_engine
 
     set_alert_engine(None)
@@ -73,10 +82,13 @@ __all__ = [
     "Histogram",
     "MetricsRegistry",
     "PROCESS_START_TS",
+    "RequestLedger",
+    "RequestRecord",
     "Span",
     "SpanLogFilter",
     "SpanTracer",
     "get_registry",
+    "get_request_ledger",
     "get_tracer",
     "register_process_metrics",
     "reset_observability",

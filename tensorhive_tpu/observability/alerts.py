@@ -435,6 +435,20 @@ def _serving_ttft_p95() -> Optional[float]:
     return engine.ttft_p95_s()
 
 
+def _serving_queue_wait_p95() -> Optional[float]:
+    """p95 admission-queue wait in seconds (None before the first join).
+    Split out of TTFT so the alert names WHICH phase ate the budget:
+    queue wait over SLO means admission/capacity tuning, TTFT over SLO
+    with queue wait under it means prefill cost (docs/OBSERVABILITY.md
+    "Request tracing & profiling")."""
+    from ..serving import get_engine
+
+    engine = get_engine()
+    if engine is None:
+        return None
+    return engine.queue_wait_p95_s()
+
+
 def _serving_kv_page_saturation() -> Optional[float]:
     """KV page-pool fill fraction of the paged serving engine (None while
     no engine is installed OR the engine runs the contiguous rollback
@@ -488,13 +502,14 @@ def default_rule_pack(monitoring_interval_s: Optional[float] = None,
 
         generation = get_config().generation
         ttft_slo_s = generation.ttft_slo_s
+        queue_wait_slo_s = generation.queue_wait_slo_s
         slot_leak_after_s = generation.slot_leak_after_s
     except Exception:
         # same fallback posture as the monitoring interval above: bare
         # library use gets the shipped serving SLO defaults
         log.warning("default_rule_pack: config unavailable, assuming "
                     "2s TTFT SLO / 60s slot-leak threshold", exc_info=True)
-        ttft_slo_s, slot_leak_after_s = 2.0, 60.0
+        ttft_slo_s, queue_wait_slo_s, slot_leak_after_s = 2.0, 1.0, 60.0
     return [
         AlertRule(
             name="service_down", severity="critical",
@@ -579,6 +594,15 @@ def default_rule_pack(monitoring_interval_s: Optional[float] = None,
             description="p95 time-to-first-token is over the "
                         "[generation_service] ttft_slo_s budget — prefill "
                         "queueing is eating the latency SLO"),
+        AlertRule(
+            name="generate_queue_wait_slo", severity="warning",
+            kind="threshold", op=">", threshold=queue_wait_slo_s,
+            for_s=2 * alert_interval_s,
+            source=_serving_queue_wait_p95,
+            description="p95 admission-queue wait is over the "
+                        "[generation_service] queue_wait_slo_s budget — "
+                        "TTFT is being eaten in the queue, not in prefill; "
+                        "add capacity or shed load (docs/SERVING.md)"),
         AlertRule(
             name="kv_pages_exhausted", severity="warning",
             kind="threshold", op=">=", threshold=1.0,

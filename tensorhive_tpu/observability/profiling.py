@@ -1,0 +1,323 @@
+"""On-demand device profiling: bounded trace captures + live-HBM snapshots.
+
+The perf campaign (ROADMAP item 5, docs/PERF.md) needs on-chip evidence —
+which kernels a decode step actually runs, where HBM goes — but shelling
+into a serving host to wrap code in ``jax.profiler.trace`` is not an
+operator workflow. This module gives the admin API two capture surfaces:
+
+* :func:`capture_trace` (``POST /api/admin/profile``) — run
+  ``jax.profiler.start_trace``/``stop_trace`` around a bounded sleep so the
+  steady-state serving traffic of the next N seconds lands in a TensorBoard
+  -loadable artifact under the configured dir. **Single-flight**: the XLA
+  profiler is a process-wide singleton, so a second concurrent capture is
+  refused (the API maps that to 409) instead of corrupting the first.
+* :func:`device_memory_summary` (``GET /api/admin/profile/memory``) — a
+  ``jax.profiler.device_memory_profile`` snapshot parsed down to per-device
+  live bytes/allocation counts, also exported as
+  ``tpuhive_device_hbm_live_bytes{device}`` so HBM growth is scrapeable and
+  correlatable with the KV-pages gauges (docs/OBSERVABILITY.md).
+
+The pprof parsing is a minimal varint walk over the two message levels we
+need (sample values + labels + string table) — the full protobuf toolchain
+is deliberately not a dependency. Everything importing jax does so lazily:
+this module is imported by the controllers package on every boot, including
+processes that never touch a device.
+"""
+from __future__ import annotations
+
+import logging
+import sys
+import threading
+import time
+from pathlib import Path
+from typing import Callable, Dict, Iterator, List, Optional, Tuple
+
+from .metrics import MetricsRegistry
+
+log = logging.getLogger(__name__)
+
+#: hard ceiling no config can raise — a "profile for an hour" typo must not
+#: leave the process-wide profiler wedged for an hour
+ABSOLUTE_MAX_DURATION_S = 60.0
+
+
+class ProfileInFlightError(Exception):
+    """A trace capture is already running (the profiler is process-wide);
+    the API layer answers 409 so the first capture finishes uncorrupted."""
+
+
+class ProfileUnavailableError(Exception):
+    """Profiling is disabled by config (or jax cannot start the profiler);
+    the API layer answers 404 with the reason."""
+
+
+# -- trace capture (single-flight) -------------------------------------------
+
+_capture_lock = threading.Lock()
+
+
+def capture_trace(artifact_dir: str, duration_s: float,
+                  max_duration_s: float = ABSOLUTE_MAX_DURATION_S,
+                  sleep: Callable[[float], None] = time.sleep,
+                  tracer=None) -> Dict:
+    """Capture one bounded ``jax.profiler`` trace into ``artifact_dir``.
+
+    Blocks the calling thread for ``duration_s`` (validated against both
+    the configured and the absolute ceiling) while every thread's device
+    activity streams into the artifact — the caller IS the admin request,
+    and a bounded synchronous capture beats a background job the operator
+    then has to poll. Returns artifact metadata (dir, files, bytes).
+    """
+    if not duration_s > 0:
+        raise ValueError(f"durationS must be > 0, got {duration_s}")
+    ceiling = min(float(max_duration_s), ABSOLUTE_MAX_DURATION_S)
+    if duration_s > ceiling:
+        raise ValueError(
+            f"durationS {duration_s} exceeds the capture ceiling {ceiling}s "
+            "([profiling] max_duration_s)")
+    if not _capture_lock.acquire(blocking=False):
+        raise ProfileInFlightError(
+            "a profile capture is already in flight — the device profiler "
+            "is process-wide; retry when it finishes")
+    try:
+        import jax
+
+        target = Path(artifact_dir)
+        target.mkdir(parents=True, exist_ok=True)
+        started_ts = time.time()
+        started = time.perf_counter()
+        try:
+            jax.profiler.start_trace(str(target))
+        except Exception as exc:
+            raise ProfileUnavailableError(
+                f"cannot start the device profiler: "
+                f"{type(exc).__name__}: {exc}") from exc
+        try:
+            sleep(duration_s)
+        finally:
+            jax.profiler.stop_trace()
+        elapsed_s = time.perf_counter() - started
+        files = _artifact_files(target, newer_than=started_ts)
+        total_bytes = sum(size for _, size in files)
+        result = {
+            "artifactDir": str(target),
+            "durationS": round(elapsed_s, 3),
+            "startedTs": round(started_ts, 3),
+            "files": [name for name, _ in files],
+            "bytes": total_bytes,
+        }
+        if tracer is not None:
+            tracer.record_span("profile.capture", kind="profile",
+                               start_ts=started_ts, duration_s=elapsed_s,
+                               artifact_dir=str(target), bytes=total_bytes)
+        log.info("profile capture: %.2fs -> %s (%d files, %d bytes)",
+                 elapsed_s, target, len(files), total_bytes)
+        return result
+    finally:
+        _capture_lock.release()
+
+
+def capture_in_flight() -> bool:
+    """Whether a trace capture currently holds the single-flight lock."""
+    if _capture_lock.acquire(blocking=False):
+        _capture_lock.release()
+        return False
+    return True
+
+
+def _artifact_files(root: Path,
+                    newer_than: float) -> List[Tuple[str, int]]:
+    """Profiler output files under ``root`` written by THIS capture
+    (mtime-filtered: repeated captures share the dir), relative paths."""
+    files = []
+    for path in sorted(root.rglob("*")):
+        if not path.is_file():
+            continue
+        stat = path.stat()
+        # 1s slack: coarse filesystem mtime granularity must not hide the
+        # artifact this capture just wrote
+        if stat.st_mtime >= newer_than - 1.0:
+            files.append((str(path.relative_to(root)), stat.st_size))
+    return files
+
+
+# -- device memory profile ----------------------------------------------------
+
+def _varints(buf: bytes) -> Iterator[int]:
+    value = shift = 0
+    for byte in buf:
+        value |= (byte & 0x7F) << shift
+        if byte & 0x80:
+            shift += 7
+        else:
+            yield value
+            value = shift = 0
+
+
+def _fields(buf: bytes) -> Iterator[Tuple[int, object]]:
+    """Walk one protobuf message's (field_number, payload) pairs — varint
+    fields yield ints, length-delimited fields yield bytes."""
+    i = 0
+    length = len(buf)
+    while i < length:
+        tag = shift = 0
+        while True:
+            byte = buf[i]
+            i += 1
+            tag |= (byte & 0x7F) << shift
+            if not byte & 0x80:
+                break
+            shift += 7
+        field_number, wire_type = tag >> 3, tag & 7
+        if wire_type == 0:                     # varint
+            value = shift = 0
+            while True:
+                byte = buf[i]
+                i += 1
+                value |= (byte & 0x7F) << shift
+                if not byte & 0x80:
+                    break
+                shift += 7
+            yield field_number, value
+        elif wire_type == 2:                   # length-delimited
+            size = shift = 0
+            while True:
+                byte = buf[i]
+                i += 1
+                size |= (byte & 0x7F) << shift
+                if not byte & 0x80:
+                    break
+                shift += 7
+            yield field_number, buf[i:i + size]
+            i += size
+        elif wire_type == 5:                   # fixed32
+            i += 4
+        elif wire_type == 1:                   # fixed64
+            i += 8
+        else:
+            raise ValueError(f"unsupported pprof wire type {wire_type}")
+
+
+def parse_device_memory_profile(profile: bytes) -> Dict[str, Dict[str, int]]:
+    """Reduce a ``jax.profiler.device_memory_profile()`` blob (gzipped pprof
+    ``Profile`` proto) to ``{device: {"liveBytes": n, "allocations": n}}``.
+
+    Only ``kind=buffer`` samples count — executable allocations carry no
+    device label and describe compiled-code host memory, not HBM. Samples
+    the runtime leaves unattributed aggregate under ``"unattributed"``.
+    """
+    import gzip
+
+    raw = gzip.decompress(profile)
+    strings: List[str] = []
+    samples: List[bytes] = []
+    for field_number, payload in _fields(raw):
+        if field_number == 6:                              # string_table
+            strings.append(payload.decode("utf-8", "replace"))
+        elif field_number == 2:                            # sample
+            samples.append(payload)
+    per_device: Dict[str, Dict[str, int]] = {}
+    for sample in samples:
+        values: List[int] = []
+        labels: Dict[str, str] = {}
+        for field_number, payload in _fields(sample):
+            if field_number == 2:          # repeated int64 values
+                if isinstance(payload, bytes):     # packed encoding
+                    values.extend(_varints(payload))
+                else:
+                    values.append(payload)
+            elif field_number == 3:        # Label {key=1, str=2, num=3}
+                parts = dict(_fields(payload))
+                key = strings[parts.get(1, 0)]
+                if 2 in parts:
+                    labels[key] = strings[parts[2]]
+        if labels.get("kind") != "buffer":
+            continue
+        device = labels.get("device", "unattributed")
+        entry = per_device.setdefault(device,
+                                      {"liveBytes": 0, "allocations": 0})
+        # sample_type order is fixed by the XLA exporter:
+        # [(allocations, count), (space, bytes)]
+        entry["allocations"] += values[0] if values else 0
+        entry["liveBytes"] += values[1] if len(values) > 1 else 0
+    return per_device
+
+
+def device_memory_summary(
+        registry: Optional[MetricsRegistry] = None) -> Dict:
+    """One ``device_memory_profile`` snapshot: parsed per-device live bytes
+    (gauged as ``tpuhive_device_hbm_live_bytes{device}``) plus the raw blob
+    size so callers can fetch the full pprof when the summary is not
+    enough."""
+    import jax
+
+    profile = jax.profiler.device_memory_profile()
+    per_device = parse_device_memory_profile(profile)
+    if registry is not None:
+        _set_live_bytes_gauges(registry, per_device)
+    devices = [
+        {"device": device,
+         "liveBytes": entry["liveBytes"],
+         "allocations": entry["allocations"]}
+        for device, entry in sorted(per_device.items())
+    ]
+    return {
+        "capturedTs": round(time.time(), 3),
+        "devices": devices,
+        "totalLiveBytes": sum(d["liveBytes"] for d in devices),
+        "profileBytes": len(profile),
+    }
+
+
+def raw_device_memory_profile() -> bytes:
+    """The unparsed gzipped pprof blob (``?format=pprof``) for
+    ``pprof``/``go tool pprof`` style offline analysis."""
+    import jax
+
+    return jax.profiler.device_memory_profile()
+
+
+def _set_live_bytes_gauges(registry: MetricsRegistry,
+                           per_device: Dict[str, Dict[str, int]]) -> None:
+    family = registry.gauge(
+        "tpuhive_device_hbm_live_bytes",
+        "Live device-memory bytes per device from the XLA memory profiler "
+        "(kind=buffer samples) — the scrapeable HBM-growth signal that "
+        "correlates with the KV-pages gauges.",
+        labels=("device",))
+    for device, entry in per_device.items():
+        family.labels(device=device).set(entry["liveBytes"])
+
+
+def hbm_collector(registry: MetricsRegistry) -> None:
+    """Registry collector: refresh the live-bytes gauges at scrape time.
+
+    Guarded three ways so a bare ``/api/metrics`` scrape stays cheap and
+    jax-free on processes that never serve: profiling must be enabled in
+    config, jax must ALREADY be imported (a scrape never pulls in the model
+    stack), and a capture in flight is left alone (the memory profiler and
+    the trace profiler share runtime plumbing)."""
+    if "jax" not in sys.modules:
+        return
+    try:
+        from ..config import get_config
+
+        if not get_config().profiling.enabled:
+            return
+    except Exception:
+        # config not materialized (bare library use): nothing to scrape;
+        # debug-level — this runs on every exposition
+        log.debug("hbm collector: config unavailable", exc_info=True)
+        return
+    if capture_in_flight():
+        return
+    try:
+        import jax
+
+        per_device = parse_device_memory_profile(
+            jax.profiler.device_memory_profile())
+    except Exception:
+        log.warning("hbm collector: device_memory_profile failed",
+                    exc_info=True)
+        return
+    _set_live_bytes_gauges(registry, per_device)

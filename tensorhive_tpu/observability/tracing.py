@@ -117,6 +117,37 @@ class SpanTracer:
             self._finished.append(span)
         return span
 
+    def record_span(self, name: str, kind: str = "internal", *,
+                    start_ts: float, duration_s: float, status: str = "ok",
+                    parent_id: Optional[str] = None,
+                    **attrs: object) -> Span:
+        """Append an already-completed span with explicit timing.
+
+        For phases whose boundaries are observed after the fact from a
+        different thread than the one that "owns" them (the serving
+        engine's queue/prefill/decode phases complete inside the pump
+        thread): a start/end pair would push onto the pump thread's parent
+        stack and misparent every span the pump opens while a request is
+        in flight. Recording retrospectively keeps the per-thread stacks
+        untouched while the ring buffer still gets the span — ordering by
+        completion ``seq`` like every other span."""
+        with self._lock:
+            span_id = f"{next(self._ids):08x}"
+        span = Span(
+            span_id=span_id,
+            parent_id=parent_id,
+            name=name,
+            kind=kind,
+            start_ts=start_ts,
+            duration_s=duration_s,
+            status=status,
+            attrs={key: str(value) for key, value in attrs.items()},
+        )
+        with self._lock:
+            span.seq = next(self._seq)
+            self._finished.append(span)
+        return span
+
     # -- context-manager API -------------------------------------------------
     @contextmanager
     def span(self, name: str, kind: str = "internal",

@@ -23,11 +23,15 @@ if TYPE_CHECKING:  # pragma: no cover - typing only
 
 class AdmissionError(Exception):
     """Base for load-shedding rejections; carries the Retry-After hint the
-    API layer surfaces on its 429 response."""
+    API layer surfaces on its 429 response, and the ``request_id`` the
+    ledger recorded the rejection under (so a 429 is quotable against
+    ``GET /api/admin/requests`` just like a completion is)."""
 
-    def __init__(self, message: str, retry_after_s: float = 1.0) -> None:
+    def __init__(self, message: str, retry_after_s: float = 1.0,
+                 request_id: Optional[str] = None) -> None:
         super().__init__(message)
         self.retry_after_s = retry_after_s
+        self.request_id = request_id
 
 
 class QueueFullError(AdmissionError):

@@ -88,7 +88,12 @@ from ..models.transformer import (
     TransformerLM,
     _rmsnorm,
 )
-from ..observability import get_registry, Histogram
+from ..observability import (
+    Histogram,
+    get_registry,
+    get_request_ledger,
+    get_tracer,
+)
 from ..ops.paged_attention import resolve_paged_kernel
 from . import QueueFullError, RateLimitError
 from .paging import PagePool
@@ -117,6 +122,11 @@ _SLOTS_TOTAL = get_registry().gauge(
 _TTFT_SECONDS = get_registry().histogram(
     "tpuhive_generate_ttft_seconds",
     "Submit-to-first-token latency (queue wait + prefill + first step).")
+_QUEUE_WAIT_SECONDS = get_registry().histogram(
+    "tpuhive_generate_queue_wait_seconds",
+    "Submit-to-slot-join latency: the admission-queue share of TTFT, "
+    "separated so queue pressure and prefill cost are tunable apart "
+    "(docs/OBSERVABILITY.md 'Request tracing & profiling').")
 _INTERTOKEN_SECONDS = get_registry().histogram(
     "tpuhive_generate_intertoken_seconds",
     "Gap between consecutive emitted tokens of one sequence.")
@@ -456,6 +466,12 @@ class GenerationHandle:
     def done(self) -> bool:
         return self._request.finished
 
+    @property
+    def request_id(self) -> str:
+        """The id the ledger, the tracer spans and the ``X-Request-Id``
+        response header all key on (docs/OBSERVABILITY.md)."""
+        return self._request.request_id
+
 
 @dataclasses.dataclass
 class _Request:
@@ -464,12 +480,22 @@ class _Request:
     temperature: float
     user_key: Optional[str]
     submitted_ts: float
+    request_id: str = ""
+    #: wall-clock anchor for the submitted_ts engine-clock stamp: spans and
+    #: ledger rows translate engine-clock offsets onto this so fake clocks
+    #: stay exact while humans still get unix timestamps
+    submitted_wall: float = 0.0
+    record: Optional[object] = None          # observability RequestRecord
     handle: Optional[GenerationHandle] = None
     generated: List[int] = dataclasses.field(default_factory=list)
     first_token_ts: Optional[float] = None
     last_token_ts: Optional[float] = None
     cancelled: bool = False
     finished: bool = False
+
+    def wall(self, clock_ts: float) -> float:
+        """Translate an engine-clock stamp to wall-clock seconds."""
+        return self.submitted_wall + (clock_ts - self.submitted_ts)
 
 
 @dataclasses.dataclass
@@ -571,6 +597,7 @@ class SlotEngine:
         #: children are shared across engine instances in tests)
         self._ttft_hist = Histogram()
         self._intertoken_hist = Histogram()
+        self._queue_wait_hist = Histogram()
 
         # device state: one persistent cache + per-slot operand arrays
         # (host numpy masters; tiny, shipped per step)
@@ -726,10 +753,18 @@ class SlotEngine:
                     f"request needs {needed} KV pages but the pool only has "
                     f"{self._pool.num_pages}; shorten the prompt or "
                     "max_new_tokens")
+        ledger = get_request_ledger()
         request = _Request(prompt=prompt, max_new_tokens=int(max_new_tokens),
                            temperature=float(temperature),
                            user_key=str(user_key) if user_key else None,
-                           submitted_ts=self.clock())
+                           submitted_ts=self.clock(),
+                           request_id=ledger.new_request_id(),
+                           submitted_wall=time.time())
+        request.record = ledger.begin(
+            request.request_id, prompt_tokens=len(prompt),
+            max_new_tokens=request.max_new_tokens,
+            temperature=request.temperature, user_key=request.user_key,
+            submitted_ts=request.submitted_wall)
         handle = GenerationHandle(self, request)
         request.handle = handle
         with self._lock:
@@ -737,19 +772,23 @@ class SlotEngine:
                     and self._user_active.get(request.user_key, 0)
                     >= self.max_concurrent_per_user):
                 _REQUESTS.labels(outcome="rejected_ratelimit").inc()
+                self._record_rejection_locked(request, "rejected_ratelimit")
                 raise RateLimitError(
                     f"user has {self.max_concurrent_per_user} generation "
                     "requests in flight; retry when one completes",
-                    retry_after_s=self._retry_after_locked())
+                    retry_after_s=self._retry_after_locked(),
+                    request_id=request.request_id)
             if len(self._pending) >= self.queue_depth:
                 _REQUESTS.labels(outcome="rejected_queue").inc()
+                self._record_rejection_locked(request, "rejected_queue")
                 raise QueueFullError(
                     f"admission queue is full ({self.queue_depth} waiting); "
                     "retry shortly",
                     retry_after_s=self._retry_after_locked(
                         needed_pages=(self._pool.pages_for(
                             len(prompt) + max_new_tokens)
-                            if self.paged else None)))
+                            if self.paged else None)),
+                    request_id=request.request_id)
             if request.user_key:
                 self._user_active[request.user_key] = (
                     self._user_active.get(request.user_key, 0) + 1)
@@ -788,6 +827,16 @@ class SlotEngine:
         with self._lock:
             if not request.finished:
                 request.cancelled = True
+
+    def _record_rejection_locked(self, request: _Request,
+                                 outcome: str) -> None:
+        """Ledger a shed request: rejections are the requests admission
+        tuning most needs to see, so they get a record with their outcome
+        even though no phase beyond the submit ever ran."""
+        record = request.record
+        if record is not None:
+            get_request_ledger().finish(
+                record, outcome, finished_ts=request.wall(self.clock()))
 
     # -- scheduler --------------------------------------------------------
     def has_work(self) -> bool:
@@ -845,26 +894,27 @@ class SlotEngine:
     def _mesh_fingerprint(self) -> tuple:
         return (self.mesh_dp, self.mesh_tp) if self.mesh is not None else ()
 
-    def _count_prefill_compile(self, width: int) -> None:
+    def _count_prefill_compile(self, width: int) -> str:
         if self.paged:
             fn = self._fingerprint_fn("serving_paged_prefill")
-            _count_compile(fn,
-                           (fn, self.config,
-                            self._pool.num_pages, self.page_size,
-                            self._pool.max_pages_per_slot, width)
-                           + self._mesh_fingerprint())
-        else:
-            fn = self._fingerprint_fn("serving_prefill")
-            _count_compile(fn,
-                           (fn, self.config, self.capacity,
-                            self.max_len, width) + self._mesh_fingerprint())
+            return _count_compile(fn,
+                                  (fn, self.config,
+                                   self._pool.num_pages, self.page_size,
+                                   self._pool.max_pages_per_slot, width)
+                                  + self._mesh_fingerprint())
+        fn = self._fingerprint_fn("serving_prefill")
+        return _count_compile(fn,
+                              (fn, self.config, self.capacity,
+                               self.max_len, width)
+                              + self._mesh_fingerprint())
 
-    def _dispatch_prefill(self, head, slot: int, real_len: int) -> None:
+    def _dispatch_prefill(self, head, slot: int, real_len: int) -> str:
         """Run the joining sequence's trunk pass through whichever cache
         layout this engine uses. Paged passes the slot's page-table ROW as
         a traced operand (the executable never sees the slot index);
-        contiguous passes the traced slot index."""
-        self._count_prefill_compile(head.shape[1])
+        contiguous passes the traced slot index. Returns the compile
+        fingerprint event ("hit"/"miss") for the request ledger."""
+        compile_event = self._count_prefill_compile(head.shape[1])
         if self.paged:
             self._cache = _paged_serving_prefill(
                 self.params, self._operand(head), self._cache,
@@ -875,6 +925,7 @@ class SlotEngine:
                 self.params, self._operand(head), self._cache,
                 self._operand(np.int32(slot)),
                 self._operand(np.int32(real_len)), self.config)
+        return compile_event
 
     def _run_step(self):
         chosen, cache, key = self._run_step_dispatch()
@@ -952,8 +1003,26 @@ class SlotEngine:
                     _KV_PAGES_FREE.set(self._pool.free_pages)
                     _SLOT_PAGES.labels(slot=str(free)).set(needed)
                 self._pending.popleft()
+                joined_ts = self.clock()
                 self._slots[free] = _Slot(request=request,
-                                          joined_ts=self.clock())
+                                          joined_ts=joined_ts)
+                # the queue phase closes HERE, separately from TTFT: the
+                # queue share is what admission tuning moves, the prefill
+                # share is what bucket/kernel work moves
+                queue_wait_s = joined_ts - request.submitted_ts
+                _QUEUE_WAIT_SECONDS.observe(queue_wait_s)
+                self._queue_wait_hist.observe(queue_wait_s)
+                record = request.record
+                if record is not None:
+                    record.queue_ms = queue_wait_s * 1e3
+                    record.slot = free
+                    if self.paged:
+                        record.kv_pages = needed
+                get_tracer().record_span(
+                    "generate.queue", kind="generate",
+                    start_ts=request.submitted_wall,
+                    duration_s=queue_wait_s,
+                    request_id=request.request_id, slot=free)
                 _QUEUE_DEPTH.set(len(self._pending))
                 _SLOTS_BUSY.set(self._busy_locked())
             self._join(free, request)
@@ -974,11 +1043,32 @@ class SlotEngine:
         first token."""
         prompt = request.prompt
         prompt_len = len(prompt)
+        record = request.record
         if prompt_len > 1:
             width = _prefill_bucket(prompt_len - 1, self.max_len - 1)
             head = np.zeros((1, width), np.int32)
             head[0, :prompt_len - 1] = prompt[:-1]
-            self._dispatch_prefill(head, slot, prompt_len - 1)
+            started = self.clock()
+            compile_event = self._dispatch_prefill(head, slot,
+                                                   prompt_len - 1)
+            # host dispatch time: the device work itself drains inside the
+            # first decode step (jax is async), which TTFT captures — a
+            # block_until_ready here would serialize joins against the
+            # running batch just to relabel the same latency
+            prefill_s = self.clock() - started
+            if record is not None:
+                record.prefill_bucket = width
+                record.prefill_compile = compile_event
+                record.prefill_ms = prefill_s * 1e3
+            get_tracer().record_span(
+                "generate.prefill", kind="generate",
+                start_ts=request.wall(started), duration_s=prefill_s,
+                request_id=request.request_id, slot=slot, bucket=width,
+                compile=compile_event)
+        elif record is not None:
+            # single-token prompt: nothing to prefill, the phase is 0 by
+            # construction (None would read as "never reached")
+            record.prefill_ms = 0.0
         with self._lock:
             self._tokens[slot] = prompt[-1]
             self._positions[slot] = prompt_len - 1
@@ -1018,16 +1108,23 @@ class SlotEngine:
         request.generated.append(token)
         self.emitted_tokens += 1
         _TOKENS.inc()
+        record = request.record
         if request.first_token_ts is None:
             request.first_token_ts = now
             ttft = now - request.submitted_ts
             _TTFT_SECONDS.observe(ttft)
             self._ttft_hist.observe(ttft)
+            if record is not None:
+                record.ttft_ms = ttft * 1e3
         else:
             gap = now - (request.last_token_ts or now)
             _INTERTOKEN_SECONDS.observe(gap)
             self._intertoken_hist.observe(gap)
+            if record is not None:
+                record._gaps_ms.append(gap * 1e3)
         request.last_token_ts = now
+        if record is not None:
+            record.tokens = len(request.generated)
         if request.handle is not None:
             request.handle._push(TOKEN, token)
         hit_eos = (self.eos_token is not None and token == self.eos_token)
@@ -1056,6 +1153,7 @@ class SlotEngine:
         if request.finished:
             return
         request.finished = True
+        now = self.clock()
         _REQUESTS.labels(outcome=outcome).inc()
         if outcome == "completed":
             self.completed_requests += 1
@@ -1065,9 +1163,29 @@ class SlotEngine:
                 self._user_active.pop(request.user_key, None)  # thive: disable=TH-C — caller holds the lock (_locked suffix)
             else:
                 self._user_active[request.user_key] = remaining  # thive: disable=TH-C — caller holds the lock (_locked suffix)
+        record = request.record
+        if record is not None:
+            if (request.first_token_ts is not None
+                    and request.last_token_ts is not None):
+                record.decode_ms = (request.last_token_ts
+                                    - request.first_token_ts) * 1e3
+            record.total_ms = (now - request.submitted_ts) * 1e3
+            get_request_ledger().finish(record, outcome,
+                                        finished_ts=request.wall(now))
+        if request.first_token_ts is not None:
+            # the decode phase span closes with the request; spans for a
+            # request that never produced a token (queue cancel, rejection)
+            # would carry nothing the ledger row doesn't
+            get_tracer().record_span(
+                "generate.decode", kind="generate",
+                start_ts=request.wall(request.first_token_ts),
+                duration_s=(request.last_token_ts
+                            - request.first_token_ts),
+                request_id=request.request_id,
+                tokens=len(request.generated), outcome=outcome)
         if request.handle is not None:
-            now = self.clock()
             request.handle._push(DONE, {
+                "requestId": request.request_id,
                 "tokens": list(request.generated),
                 "outcome": outcome,
                 "ttftS": (round(request.first_token_ts - request.submitted_ts,
@@ -1126,6 +1244,11 @@ class SlotEngine:
 
     def ttft_p95_s(self) -> Optional[float]:
         return self._ttft_hist.quantile(0.95)
+
+    def queue_wait_p95_s(self) -> Optional[float]:
+        """p95 admission-queue wait — the queue_wait_slo alert signal
+        (None before the first join: an idle queue has no wait to breach)."""
+        return self._queue_wait_hist.quantile(0.95)
 
     def queue_saturation(self) -> float:
         with self._lock:

@@ -104,17 +104,67 @@ def test_generate_streams_ndjson_matching_reference(api, pump, user_headers,
         "promptTokens": prompt, "maxNewTokens": 5, "temperature": 0})
     assert response.status_code == 200, response.get_data(as_text=True)
     assert response.content_type == "application/x-ndjson"
+    # the id the ledger (/api/admin/requests) and the generate.* spans key
+    # on rides the response header AND the done chunk, so clients can quote
+    # it from either (docs/OBSERVABILITY.md "Request tracing & profiling")
+    request_id = response.headers["X-Request-Id"]
+    assert request_id
     lines = _stream_lines(response)
     tokens = [line["token"] for line in lines[:-1]]
     done = lines[-1]
     assert done["done"] is True
     assert done["outcome"] == "completed"
+    assert done["requestId"] == request_id
     assert done["tokens"] == tokens
     assert done["ttftMs"] is not None and done["durationMs"] is not None
     reference = decode.generate(params, F32_TINY,
                                 jnp.asarray([prompt], jnp.int32),
                                 max_new_tokens=5, temperature=0.0)
     assert tokens == np.asarray(reference)[0, len(prompt):].tolist()
+
+
+def test_completed_request_in_admin_ledger_with_matching_spans(
+        api, pump, user_headers, admin_headers):
+    """ISSUE 10 acceptance: a completed /api/generate request appears in
+    GET /api/admin/requests with its phase timings, and its spans in
+    GET /api/admin/traces carry the same request_id."""
+    response = api.post("/api/generate", headers=user_headers, json={
+        "promptTokens": list(range(3, 11)), "maxNewTokens": 4,
+        "temperature": 0})
+    assert response.status_code == 200
+    request_id = response.headers["X-Request-Id"]
+    assert _stream_lines(response)[-1]["outcome"] == "completed"
+
+    doc = api.get("/api/admin/requests", headers=admin_headers).get_json()
+    row = next(r for r in doc["requests"] if r["requestId"] == request_id)
+    assert row["outcome"] == "completed"
+    assert row["tokens"] == 4
+    assert row["queueMs"] is not None and row["ttftMs"] is not None
+    assert row["queueMs"] <= row["ttftMs"] <= row["totalMs"]
+    assert row["slot"] is not None
+    # non-admins don't get the ledger (userKey + placement are in it)
+    assert api.get("/api/admin/requests",
+                   headers=user_headers).status_code == 403
+
+    traces = api.get("/api/admin/traces?kind=generate",
+                     headers=admin_headers).get_json()
+    names = {span["name"] for span in traces["spans"]
+             if span["attrs"].get("request_id") == request_id}
+    assert {"generate.queue", "generate.prefill", "generate.decode",
+            "generate.stream"} <= names
+
+
+def test_queue_full_429_carries_request_id(api, engine, user_headers,
+                                           admin_headers):
+    for _ in range(engine.queue_depth):
+        engine.submit([1, 2, 3], max_new_tokens=4)
+    response = api.post("/api/generate", headers=user_headers, json={
+        "promptTokens": [1, 2, 3], "maxNewTokens": 2})
+    assert response.status_code == 429
+    rejected_id = response.headers["X-Request-Id"]
+    doc = api.get("/api/admin/requests?outcome=rejected_queue",
+                  headers=admin_headers).get_json()
+    assert rejected_id in [r["requestId"] for r in doc["requests"]]
 
 
 def test_generate_requires_active_restriction(api, pump, db, admin_headers):

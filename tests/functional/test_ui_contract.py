@@ -74,6 +74,9 @@ UI_CALLS = {
     ("GET", "/generate/stats"): 'api("/generate/stats")',
     ("POST", "/generate"): 'fetch(API + "/generate"',
     ("GET", "/admin/traces"): 'api("/admin/traces',
+    ("GET", "/admin/requests"): 'api("/admin/requests',
+    ("POST", "/admin/profile"): 'api("/admin/profile", { json: {} })',
+    ("GET", "/admin/profile/memory"): 'api("/admin/profile/memory")',
     ("GET", "/admin/alerts"): 'api("/admin/alerts")',
     ("GET", "/metrics"): 'href="/api/metrics"',
     ("GET", "/healthz"): 'href="/api/healthz"',
@@ -175,6 +178,26 @@ def test_serving_strip_renders_page_pool_badge():
     # the fused page-table kernel, "xla" for the gather reference) from the
     # exact pagedKernel field the stats endpoint exports
     assert '"KV pages · " + stats.pagedKernel' in source
+
+
+def test_requests_strip_renders_ledger_fields():
+    """The recent-requests strip (docs/OBSERVABILITY.md "Request tracing &
+    profiling") must render its phase bars and badges from the exact field
+    names ``GET /admin/requests`` exports — a rename on either side breaks
+    these fragments, like a vanished UI_CALLS fragment would."""
+    source = (STATIC_DIR / "js" / "nodes.js").read_text()
+    # the phase bar decomposes one request's wall time into the ledger's
+    # queue/prefill/decode millisecond fields
+    assert 'seg(req.queueMs, "queue", "queue")' in source
+    assert 'seg(req.prefillMs, "prefill", "prefill")' in source
+    assert 'seg(req.decodeMs, "decode", "decode")' in source
+    assert "req.totalMs" in source
+    # the badge carries outcome + the ledger id the X-Request-Id header and
+    # the generate.* spans share
+    assert 'req.outcome === "completed"' in source
+    assert "req.requestId" in source
+    assert "req.ttftMs" in source
+    assert "req.prefillCompile" in source
 
 
 def test_serving_strip_renders_mesh_badge():

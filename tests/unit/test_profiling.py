@@ -1,0 +1,253 @@
+"""On-demand profiling tests (docs/OBSERVABILITY.md "Request tracing &
+profiling"): the pprof reduction, the single-flight capture contract, and
+the admin endpoints end to end on the CPU backend — including the artifact
+actually landing on disk, not just a 200.
+"""
+from __future__ import annotations
+
+import gzip
+import threading
+
+import pytest
+from werkzeug.test import Client
+
+from tensorhive_tpu.api.server import ApiApp
+from tensorhive_tpu.observability import get_registry, reset_observability
+from tensorhive_tpu.observability.profiling import (
+    ProfileInFlightError,
+    capture_in_flight,
+    capture_trace,
+    device_memory_summary,
+    parse_device_memory_profile,
+)
+from tests.fixtures import make_user
+
+
+@pytest.fixture(autouse=True)
+def clean_registry():
+    reset_observability()
+    yield
+    reset_observability()
+
+
+# -- pprof parsing -----------------------------------------------------------
+
+def _pprof(string_table, samples):
+    """Assemble a minimal gzipped pprof Profile: ``samples`` is a list of
+    ([values], {label_key: label_str}) built against ``string_table``."""
+    def varint(value):
+        out = bytearray()
+        while True:
+            byte = value & 0x7F
+            value >>= 7
+            if value:
+                out.append(byte | 0x80)
+            else:
+                out.append(byte)
+                return bytes(out)
+
+    def field(number, payload):
+        if isinstance(payload, int):
+            return varint(number << 3) + varint(payload)
+        return varint((number << 3) | 2) + varint(len(payload)) + payload
+
+    index = {value: i for i, value in enumerate(string_table)}
+    body = b""
+    for values, labels in samples:
+        sample = b"".join(field(2, value) for value in values)
+        for key, value in labels.items():
+            label = field(1, index[key]) + field(2, index[value])
+            sample += field(3, label)
+        body += field(2, sample)
+    for string in string_table:
+        body += field(6, string.encode())
+    return gzip.compress(body)
+
+
+def test_parse_sums_buffer_samples_per_device():
+    table = ["", "kind", "buffer", "executable", "device", "TPU_0", "TPU_1"]
+    profile = _pprof(table, [
+        ([1, 1000], {"kind": "buffer", "device": "TPU_0"}),
+        ([2, 2000], {"kind": "buffer", "device": "TPU_0"}),
+        ([1, 512], {"kind": "buffer", "device": "TPU_1"}),
+        ([1, 9999], {"kind": "executable"}),        # host code: excluded
+    ])
+    parsed = parse_device_memory_profile(profile)
+    assert parsed == {
+        "TPU_0": {"liveBytes": 3000, "allocations": 3},
+        "TPU_1": {"liveBytes": 512, "allocations": 1},
+    }
+
+
+def test_parse_real_jax_profile_and_gauge_export():
+    """Against the REAL jax exporter on CPU: a live buffer of known size
+    must show up in the per-device summary and the hbm gauge family."""
+    import jax.numpy as jnp
+
+    anchor = jnp.ones((256, 256), jnp.float32)      # 256 KiB live buffer
+    summary = device_memory_summary(registry=get_registry())
+    assert summary["devices"], "no devices in the memory profile"
+    assert summary["totalLiveBytes"] >= anchor.nbytes
+    rendered = get_registry().render()
+    assert "tpuhive_device_hbm_live_bytes{" in rendered
+    del anchor
+
+
+# -- capture single-flight ---------------------------------------------------
+
+def test_capture_writes_artifact_on_cpu(tmp_path):
+    result = capture_trace(str(tmp_path / "profiles"), duration_s=0.05)
+    assert result["files"], "no profiler artifact written"
+    assert result["bytes"] > 0
+    assert any(name.endswith(".xplane.pb") for name in result["files"])
+    assert result["durationS"] >= 0.05
+
+
+def test_capture_is_single_flight(tmp_path):
+    """A capture racing another must 409 (ProfileInFlightError), never
+    interleave with it — the XLA profiler is process-wide."""
+    entered = threading.Event()
+    release = threading.Event()
+
+    def slow_sleep(_duration):
+        entered.set()
+        assert release.wait(timeout=10)
+
+    results = {}
+
+    def first():
+        results["first"] = capture_trace(str(tmp_path / "a"),
+                                         duration_s=0.01, sleep=slow_sleep)
+
+    thread = threading.Thread(target=first)
+    thread.start()
+    assert entered.wait(timeout=10)
+    assert capture_in_flight()
+    with pytest.raises(ProfileInFlightError):
+        capture_trace(str(tmp_path / "b"), duration_s=0.01)
+    release.set()
+    thread.join(timeout=10)
+    assert results["first"]["bytes"] >= 0
+    assert not capture_in_flight()
+
+
+def test_capture_rejects_out_of_bounds_duration(tmp_path):
+    with pytest.raises(ValueError):
+        capture_trace(str(tmp_path), duration_s=0.0)
+    with pytest.raises(ValueError):
+        capture_trace(str(tmp_path), duration_s=99.0, max_duration_s=10.0)
+
+
+# -- endpoints ---------------------------------------------------------------
+
+@pytest.fixture()
+def api(db, config):
+    config.api.secret_key = "test-secret"
+    return Client(ApiApp(url_prefix="api"))
+
+
+@pytest.fixture()
+def admin_headers(api, db):
+    make_user(username="root1", password="SuperSecret42", admin=True)
+    tokens = api.post("/api/user/login", json={
+        "username": "root1", "password": "SuperSecret42"}).get_json()
+    return {"Authorization": f"Bearer {tokens['accessToken']}"}
+
+
+@pytest.fixture()
+def user_headers(api, db):
+    make_user(username="alice", password="SuperSecret42")
+    tokens = api.post("/api/user/login", json={
+        "username": "alice", "password": "SuperSecret42"}).get_json()
+    return {"Authorization": f"Bearer {tokens['accessToken']}"}
+
+
+def test_endpoints_404_while_profiling_disabled(api, config, admin_headers):
+    assert config.profiling.enabled is False       # the shipped default
+    response = api.post("/api/admin/profile", headers=admin_headers,
+                        json={})
+    assert response.status_code == 404
+    assert "profiling is disabled" in response.get_json()["msg"]
+    assert api.get("/api/admin/profile/memory",
+                   headers=admin_headers).status_code == 404
+
+
+def test_endpoints_403_for_non_admin(api, config, user_headers):
+    config.profiling.enabled = True
+    assert api.post("/api/admin/profile", headers=user_headers,
+                    json={}).status_code == 403
+    assert api.get("/api/admin/profile/memory",
+                   headers=user_headers).status_code == 403
+
+
+def test_profile_capture_endpoint_writes_artifact(api, config, tmp_path,
+                                                  admin_headers):
+    config.profiling.enabled = True
+    config.profiling.artifact_dir = str(tmp_path / "profiles")
+    response = api.post("/api/admin/profile", headers=admin_headers,
+                        json={"durationS": 0.05})
+    assert response.status_code == 200, response.get_data(as_text=True)
+    doc = response.get_json()
+    assert doc["artifactDir"] == str(tmp_path / "profiles")
+    assert doc["files"] and doc["bytes"] > 0
+    # the files the response names really exist with real bytes
+    for name in doc["files"]:
+        assert (tmp_path / "profiles" / name).is_file()
+
+
+def test_profile_capture_endpoint_409_when_in_flight(api, config, tmp_path,
+                                                     admin_headers,
+                                                     monkeypatch):
+    from tensorhive_tpu.observability import profiling
+
+    config.profiling.enabled = True
+    config.profiling.artifact_dir = str(tmp_path)
+    monkeypatch.setattr(profiling, "_capture_lock", threading.Lock())
+    profiling._capture_lock.acquire()               # someone else capturing
+    try:
+        response = api.post("/api/admin/profile", headers=admin_headers,
+                            json={"durationS": 0.05})
+        assert response.status_code == 409
+        assert "in flight" in response.get_json()["msg"]
+    finally:
+        profiling._capture_lock.release()
+
+
+def test_profile_capture_endpoint_422_on_bad_duration(api, config, tmp_path,
+                                                      admin_headers):
+    config.profiling.enabled = True
+    config.profiling.artifact_dir = str(tmp_path)
+    config.profiling.max_duration_s = 1.0
+    response = api.post("/api/admin/profile", headers=admin_headers,
+                        json={"durationS": 30.0})
+    assert response.status_code == 422
+    assert "ceiling" in response.get_json()["msg"]
+
+
+def test_memory_endpoint_summary_and_pprof(api, config, admin_headers):
+    config.profiling.enabled = True
+    response = api.get("/api/admin/profile/memory", headers=admin_headers)
+    assert response.status_code == 200
+    doc = response.get_json()
+    assert isinstance(doc["devices"], list)
+    assert doc["totalLiveBytes"] >= 0
+    raw = api.get("/api/admin/profile/memory?format=pprof",
+                  headers=admin_headers)
+    assert raw.status_code == 200
+    assert raw.content_type == "application/octet-stream"
+    gzip.decompress(raw.get_data())                 # valid gzipped pprof
+
+
+def test_hbm_collector_refreshes_gauges_at_scrape(api, config):
+    """With profiling enabled and jax resident, a bare /api/metrics scrape
+    refreshes the live-bytes gauges through the registry collector — no
+    admin call needed for Prometheus to see HBM growth."""
+    import jax.numpy as jnp
+
+    config.profiling.enabled = True
+    anchor = jnp.ones((128, 128), jnp.float32)
+    response = api.get("/api/metrics")
+    assert response.status_code == 200
+    assert "tpuhive_device_hbm_live_bytes{" in response.get_data(
+        as_text=True)
+    del anchor

@@ -1,0 +1,310 @@
+"""Request-ledger tests: the per-request serving trace behind
+``GET /api/admin/requests`` (docs/OBSERVABILITY.md "Request tracing &
+profiling").
+
+Two layers under test:
+
+* the :class:`RequestLedger` container itself — bounded ring, exactly-once
+  finish, cross-thread isolation — with no engine in sight;
+* the SlotEngine integration on a fake clock — every phase duration
+  (queue / prefill / ttft / decode / total) asserted against injected
+  timestamps, rejections and cancels recorded with their outcome, and the
+  ``generate.*`` spans sharing the request_id.
+"""
+import dataclasses
+import threading
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tensorhive_tpu.models.transformer import PRESETS, TransformerLM
+from tensorhive_tpu.observability import (
+    get_request_ledger,
+    get_tracer,
+    reset_observability,
+)
+from tensorhive_tpu.observability.requests import RequestLedger
+from tensorhive_tpu.serving import QueueFullError, RateLimitError
+from tensorhive_tpu.serving.engine import SlotEngine
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device CPU platform"
+)
+
+F32_TINY = dataclasses.replace(PRESETS["tiny"], dtype=jnp.float32,
+                               use_flash=False, remat=False, max_seq_len=128)
+
+
+class FakeClock:
+    def __init__(self, start: float = 1000.0) -> None:
+        self.now = start
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, seconds: float) -> None:
+        self.now += seconds
+
+
+@pytest.fixture(scope="module")
+def params():
+    return TransformerLM.init(jax.random.PRNGKey(0), F32_TINY)
+
+
+@pytest.fixture(autouse=True)
+def clean_ledger():
+    reset_observability()
+    yield
+    reset_observability()
+
+
+def make_engine(params, clock, **kwargs):
+    kwargs.setdefault("slots", 2)
+    kwargs.setdefault("max_len", 96)
+    kwargs.setdefault("queue_depth", 2)
+    return SlotEngine(params, F32_TINY, clock=clock, **kwargs)
+
+
+def drain(engine):
+    while engine.has_work():
+        engine.step()
+
+
+# -- the container alone -----------------------------------------------------
+
+def test_ring_evicts_oldest_at_capacity():
+    ledger = RequestLedger(capacity=3)
+    for index in range(5):
+        record = ledger.begin(f"req-{index}", prompt_tokens=1,
+                              max_new_tokens=1, temperature=0.0)
+        ledger.finish(record, "completed")
+    assert len(ledger) == 3
+    ids = [row["requestId"] for row in ledger.recent()]
+    assert ids == ["req-4", "req-3", "req-2"]       # newest first, 0/1 gone
+    assert ledger.get("req-0") is None              # evicted
+    assert ledger.get("req-4") is not None
+
+
+def test_finish_is_exactly_once():
+    ledger = RequestLedger(capacity=4)
+    record = ledger.begin("req-a", prompt_tokens=1, max_new_tokens=1,
+                          temperature=0.0)
+    ledger.finish(record, "completed")
+    ledger.finish(record, "cancelled")              # racing cancel: ignored
+    rows = ledger.recent()
+    assert len(rows) == 1
+    assert rows[0]["outcome"] == "completed"
+
+
+def test_set_capacity_rebounds_and_keeps_newest():
+    ledger = RequestLedger(capacity=8)
+    for index in range(6):
+        record = ledger.begin(f"req-{index}", prompt_tokens=1,
+                              max_new_tokens=1, temperature=0.0)
+        ledger.finish(record, "completed")
+    ledger.set_capacity(2)
+    assert [row["requestId"] for row in ledger.recent()] == ["req-5",
+                                                             "req-4"]
+
+
+def test_cross_thread_begin_finish_isolation():
+    """Concurrent begin/finish from many threads: every id unique, every
+    record lands exactly once, the ring bound holds."""
+    ledger = RequestLedger(capacity=64)
+    errors = []
+
+    def worker():
+        try:
+            for _ in range(25):
+                request_id = ledger.new_request_id()
+                record = ledger.begin(request_id, prompt_tokens=2,
+                                      max_new_tokens=2, temperature=0.0)
+                record.tokens = 2
+                ledger.finish(record, "completed")
+        except Exception as exc:  # pragma: no cover - failure path
+            errors.append(exc)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for thread in threads:
+        thread.start()
+    for thread in threads:
+        thread.join(timeout=10)
+    assert not errors
+    assert len(ledger) == 64                        # 100 finished, ring-bound
+    ids = [row["requestId"] for row in ledger.recent()]
+    assert len(set(ids)) == len(ids)
+    assert not ledger.in_flight()
+
+
+# -- engine integration (fake clock) -----------------------------------------
+
+def test_completed_request_records_every_phase(params):
+    clock = FakeClock()
+    engine = make_engine(params, clock)
+    engine.warmup(prompt_lens=(8,))
+    get_request_ledger().clear()                   # drop warmup noise
+
+    handle = engine.submit(list(range(3, 11)), max_new_tokens=3,
+                           temperature=0.0, user_key="42")
+    clock.advance(0.5)                              # queue wait: 500 ms
+    engine.step()                                   # join + first token
+    clock.advance(0.1)
+    engine.step()
+    clock.advance(0.1)
+    engine.step()
+    drain(engine)
+    assert handle.result(timeout_s=5)["outcome"] == "completed"
+
+    rows = get_request_ledger().recent()
+    assert len(rows) == 1
+    row = rows[0]
+    assert row["requestId"] == handle.request_id
+    assert row["outcome"] == "completed"
+    assert row["promptTokens"] == 8 and row["maxNewTokens"] == 3
+    assert row["userKey"] == "42"
+    assert row["slot"] == 0
+    assert row["kvPages"] == 1                      # ceil((8+3)/16)
+    assert row["queueMs"] == pytest.approx(500.0)
+    assert row["prefillBucket"] == 16
+    assert row["prefillCompile"] in ("hit", "miss")
+    assert row["prefillMs"] is not None             # fake clock: 0.0 exact
+    # fake clock: the join and first step happen at the same instant, so
+    # TTFT is exactly the queue wait
+    assert row["ttftMs"] == pytest.approx(500.0)
+    assert row["decodeMs"] == pytest.approx(200.0)  # 2 gaps x 100 ms
+    assert row["totalMs"] == pytest.approx(700.0)
+    assert row["tokens"] == 3
+    assert row["intertokenP50Ms"] == pytest.approx(100.0)
+    # sane phase ordering — the same invariants the trace smoke gates
+    assert row["queueMs"] <= row["ttftMs"] <= row["totalMs"]
+
+
+def test_phase_spans_share_the_request_id(params):
+    clock = FakeClock()
+    engine = make_engine(params, clock)
+    engine.warmup(prompt_lens=(8,))
+    get_tracer().clear()
+
+    handle = engine.submit(list(range(3, 11)), max_new_tokens=2)
+    drain(engine)
+    assert handle.result(timeout_s=5)["outcome"] == "completed"
+
+    spans = [span for span in get_tracer().recent(kind="generate")
+             if span["attrs"].get("request_id") == handle.request_id]
+    names = {span["name"] for span in spans}
+    assert names == {"generate.queue", "generate.prefill",
+                     "generate.decode"}
+    prefill = next(s for s in spans if s["name"] == "generate.prefill")
+    assert prefill["attrs"]["bucket"] == "16"
+    assert prefill["attrs"]["compile"] in ("hit", "miss")
+
+
+def test_single_token_prompt_has_zero_prefill_not_null(params):
+    engine = make_engine(params, FakeClock())
+    engine.warmup(prompt_lens=(1,))
+    get_request_ledger().clear()
+    handle = engine.submit([5], max_new_tokens=2)
+    drain(engine)
+    assert handle.result(timeout_s=5)["outcome"] == "completed"
+    row = get_request_ledger().recent()[0]
+    assert row["prefillMs"] == 0.0                  # no prefill phase ran
+    assert row["prefillBucket"] is None
+
+
+def test_queue_full_rejection_is_recorded_with_outcome(params):
+    clock = FakeClock()
+    engine = make_engine(params, clock, slots=1, queue_depth=1)
+    engine.warmup(prompt_lens=(4,))
+    get_request_ledger().clear()
+    engine.submit([1, 2, 3], max_new_tokens=4)      # queued
+    with pytest.raises(QueueFullError) as excinfo:
+        engine.submit([4, 5, 6], max_new_tokens=4)
+    assert excinfo.value.request_id                 # quotable on the 429
+    rows = get_request_ledger().recent(outcome="rejected_queue")
+    assert len(rows) == 1
+    row = rows[0]
+    assert row["requestId"] == excinfo.value.request_id
+    assert row["queueMs"] is None                   # never joined
+    assert row["tokens"] == 0
+    drain(engine)
+
+
+def test_rate_limit_rejection_is_recorded_with_outcome(params):
+    engine = make_engine(params, FakeClock(), max_concurrent_per_user=1)
+    engine.warmup(prompt_lens=(4,))
+    get_request_ledger().clear()
+    engine.submit([1, 2, 3], max_new_tokens=4, user_key="u1")
+    with pytest.raises(RateLimitError) as excinfo:
+        engine.submit([1, 2, 3], max_new_tokens=4, user_key="u1")
+    rows = get_request_ledger().recent(outcome="rejected_ratelimit")
+    assert [row["requestId"] for row in rows] == [excinfo.value.request_id]
+    drain(engine)
+
+
+def test_cancel_in_queue_and_mid_decode_record_cancelled(params):
+    clock = FakeClock()
+    engine = make_engine(params, clock, slots=1)
+    engine.warmup(prompt_lens=(4,))
+    get_request_ledger().clear()
+
+    running = engine.submit([1, 2, 3], max_new_tokens=8)
+    queued = engine.submit([4, 5, 6], max_new_tokens=8)
+    engine.step()                                   # running joins the slot
+    queued.cancel()                                 # cancelled while queued
+    clock.advance(0.05)
+    engine.step()
+    running.cancel()                                # cancelled mid-decode
+    drain(engine)
+
+    ledger = get_request_ledger()
+    cancelled = ledger.recent(outcome="cancelled")
+    assert {row["requestId"] for row in cancelled} == {
+        running.request_id, queued.request_id}
+    mid_decode = next(row for row in cancelled
+                      if row["requestId"] == running.request_id)
+    assert mid_decode["tokens"] >= 1                # produced before cancel
+    assert mid_decode["slot"] == 0
+    in_queue = next(row for row in cancelled
+                    if row["requestId"] == queued.request_id)
+    assert in_queue["slot"] is None                 # never placed
+    assert in_queue["ttftMs"] is None
+    assert not ledger.in_flight()
+
+
+def test_in_flight_rows_visible_before_finish(params):
+    engine = make_engine(params, FakeClock())
+    engine.warmup(prompt_lens=(4,))
+    get_request_ledger().clear()
+    handle = engine.submit([1, 2, 3], max_new_tokens=4)
+    rows = get_request_ledger().in_flight()
+    assert [row["requestId"] for row in rows] == [handle.request_id]
+    assert rows[0]["outcome"] is None
+    drain(engine)
+    assert not get_request_ledger().in_flight()
+
+
+def test_queue_wait_histogram_and_p95(params):
+    from tensorhive_tpu.observability import get_registry
+
+    clock = FakeClock()
+    engine = make_engine(params, clock, slots=1)
+    engine.warmup(prompt_lens=(4,))
+    first = engine.submit([1, 2, 3], max_new_tokens=2)
+    clock.advance(2.0)                              # 2 s in the queue
+    drain(engine)
+    assert first.result(timeout_s=5)["outcome"] == "completed"
+    assert engine.queue_wait_p95_s() >= 2.0
+    rendered = get_registry().render()
+    assert "tpuhive_generate_queue_wait_seconds_bucket" in rendered
+
+
+def test_queue_wait_slo_rule_in_default_pack(config):
+    from tensorhive_tpu.observability.alerts import default_rule_pack
+
+    config.generation.queue_wait_slo_s = 0.25
+    rules = {rule.name: rule for rule in default_rule_pack()}
+    assert "generate_queue_wait_slo" in rules
+    assert rules["generate_queue_wait_slo"].threshold == pytest.approx(0.25)
+    # quiet while no engine is installed (serving disabled ≠ alertable)
+    assert rules["generate_queue_wait_slo"].source() is None

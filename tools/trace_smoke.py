@@ -1,0 +1,218 @@
+"""Smoke-test request tracing + on-demand profiling end to end
+(``make trace-smoke``; docs/OBSERVABILITY.md "Request tracing & profiling").
+
+Boots the real daemon surface in-process — WSGI app over a real socket, a
+live GenerationService pump, in-memory DB, profiling enabled into a temp
+artifact dir — then walks the whole diagnosable-serving story over HTTP:
+
+1. stream one authenticated ``POST /api/generate`` request and read its
+   ``X-Request-Id`` from the response header + ``done`` chunk;
+2. ``GET /api/admin/requests`` must show that request with every phase
+   timed and sanely ordered (queue <= ttft <= total, prefill > 0, tokens
+   exact) — and zero new post-warmup recompiles while it ran;
+3. ``GET /api/admin/traces`` must carry the queue/prefill/decode/stream
+   spans labelled with the same request_id;
+4. ``POST /api/admin/profile`` on this CPU backend must produce a
+   non-empty trace artifact on disk (and answer 409 to a concurrent
+   capture);
+5. the ``/api/metrics`` scrape must export the new
+   ``tpuhive_generate_queue_wait_seconds`` histogram and the
+   ``tpuhive_device_hbm_live_bytes`` gauge.
+
+Exit 0 = healthy.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+os.environ.setdefault("TPUHIVE_PYTEST", "1")          # DB goes in-memory
+
+PROBLEMS = []
+
+
+def check(ok: bool, what: str) -> None:
+    status = "ok" if ok else "FAIL"
+    print(f"trace-smoke: {status}: {what}")
+    if not ok:
+        PROBLEMS.append(what)
+
+
+def request(url: str, body=None, headers=None, method=None):
+    """(status, text) over real HTTP; >=400 is a result, not an exception."""
+    data = None if body is None else json.dumps(body).encode()
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers={"Content-Type": "application/json",
+                                          **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, resp.read().decode(), dict(resp.headers)
+    except urllib.error.HTTPError as exc:
+        return exc.code, exc.read().decode(), dict(exc.headers)
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from tensorhive_tpu.config import Config, set_config
+
+    workdir = tempfile.mkdtemp(prefix="tpuhive-trace-smoke-")
+    config = Config(config_dir=Path(workdir))
+    config.api.secret_key = "trace-smoke-secret"
+    config.generation.enabled = True
+    config.generation.slots = 2
+    config.generation.queue_depth = 4
+    config.generation.max_len = 96
+    config.generation.interval_s = 0.01
+    config.profiling.enabled = True
+    config.profiling.artifact_dir = str(Path(workdir) / "profiles")
+    set_config(config)
+
+    from tensorhive_tpu.db.engine import Engine, set_engine
+    from tensorhive_tpu.db.migrations import ensure_schema
+
+    engine_db = Engine(":memory:")
+    ensure_schema(engine_db)
+    set_engine(engine_db)
+
+    from tensorhive_tpu.db.models import User
+
+    admin = User(username="smoke-admin", email="smoke@example.com",
+                 password="SuperSecret42").save()
+    admin.add_role("user")
+    admin.add_role("admin")
+
+    from tensorhive_tpu import serving
+    from tensorhive_tpu.core.services.generation import GenerationService
+
+    generation = GenerationService(config=config)     # builds + warms engine
+    slot_engine = serving.get_engine()
+    assert slot_engine is not None, "engine did not publish"
+    step_execs = slot_engine.step_executable._cache_size()
+    prefill_execs = slot_engine.prefill_executable._cache_size()
+    generation.start()
+
+    from tensorhive_tpu.api.server import APIServer
+
+    server = APIServer()
+    server.config.api.url_hostname = "127.0.0.1"
+    server.config.api.url_port = 0                     # ephemeral
+    port = server.start()
+    base = f"http://127.0.0.1:{port}/api"
+    try:
+        status, body, _ = request(f"{base}/user/login", body={
+            "username": "smoke-admin", "password": "SuperSecret42"})
+        check(status == 200, f"admin login over HTTP (got {status})")
+        auth = {"Authorization": "Bearer " + json.loads(body)["accessToken"]}
+
+        # -- 1: one streamed generation, id on header + done chunk ---------
+        new_tokens = 6
+        status, body, headers = request(f"{base}/generate", body={
+            "promptTokens": [3, 4, 5, 6, 7, 8, 9, 10],
+            "maxNewTokens": new_tokens, "temperature": 0}, headers=auth)
+        check(status == 200, f"POST /generate streamed (got {status})")
+        request_id = headers.get("X-Request-Id")
+        check(bool(request_id), "X-Request-Id response header present")
+        lines = [json.loads(line) for line in body.strip().splitlines()]
+        done = lines[-1]
+        check(done.get("outcome") == "completed",
+              f"stream completed (got {done})")
+        check(done.get("requestId") == request_id,
+              "done chunk requestId matches the response header")
+
+        # -- 2: the ledger has the request, phases sanely ordered ----------
+        status, body, _ = request(f"{base}/admin/requests", headers=auth)
+        check(status == 200, f"GET /admin/requests (got {status})")
+        rows = [row for row in json.loads(body)["requests"]
+                if row["requestId"] == request_id]
+        check(len(rows) == 1, "exactly one ledger row for the request")
+        if rows:
+            row = rows[0]
+            check(row["outcome"] == "completed", "ledger outcome completed")
+            check(row["tokens"] == new_tokens,
+                  f"ledger token count {row['tokens']} == {new_tokens}")
+            phases_present = all(
+                row[key] is not None for key in
+                ("queueMs", "prefillMs", "ttftMs", "decodeMs", "totalMs"))
+            check(phases_present, f"every phase timed: {row}")
+            if phases_present:
+                check(row["queueMs"] <= row["ttftMs"] <= row["totalMs"],
+                      f"queue {row['queueMs']} <= ttft {row['ttftMs']} <= "
+                      f"total {row['totalMs']}")
+                check(row["prefillMs"] > 0,
+                      f"prefill > 0 (got {row['prefillMs']})")
+            check(row["prefillBucket"] == 16 and
+                  row["prefillCompile"] == "hit",
+                  f"prefill bucket 16 reused a warmed executable: {row}")
+
+        check(slot_engine.step_executable._cache_size() == step_execs
+              and slot_engine.prefill_executable._cache_size()
+              == prefill_execs,
+              "zero new post-warmup recompiles while the request ran")
+
+        # -- 3: spans share the request_id ---------------------------------
+        status, body, _ = request(f"{base}/admin/traces?kind=generate",
+                                  headers=auth)
+        check(status == 200, f"GET /admin/traces (got {status})")
+        names = {span["name"] for span in json.loads(body)["spans"]
+                 if span["attrs"].get("request_id") == request_id}
+        check({"generate.queue", "generate.prefill", "generate.decode",
+               "generate.stream"} <= names,
+              f"queue/prefill/decode/stream spans share the id (got "
+              f"{sorted(names)})")
+
+        # -- 4: a profile capture writes a real artifact -------------------
+        status, body, _ = request(f"{base}/admin/profile",
+                                  body={"durationS": 0.2}, headers=auth)
+        check(status == 200, f"POST /admin/profile (got {status}: {body})")
+        if status == 200:
+            doc = json.loads(body)
+            check(doc["files"] and doc["bytes"] > 0,
+                  f"non-empty trace artifact ({doc['files']}, "
+                  f"{doc['bytes']} bytes)")
+            on_disk = [Path(doc["artifactDir"]) / name
+                       for name in doc["files"]]
+            check(all(path.is_file() and path.stat().st_size >= 0
+                      for path in on_disk)
+                  and any(path.stat().st_size > 0 for path in on_disk),
+                  "artifact files exist on disk with real bytes")
+
+        status, body, _ = request(f"{base}/admin/profile/memory",
+                                  headers=auth)
+        check(status == 200, f"GET /admin/profile/memory (got {status})")
+        if status == 200:
+            doc = json.loads(body)
+            check(doc["totalLiveBytes"] > 0,
+                  f"live device bytes visible ({doc['totalLiveBytes']})")
+
+        # -- 5: new histogram + HBM gauge in the scrape --------------------
+        status, scrape, _ = request(f"{base}/metrics")
+        check(status == 200, f"GET /metrics (got {status})")
+        check("tpuhive_generate_queue_wait_seconds_bucket" in scrape,
+              "queue-wait histogram in the exposition")
+        check("tpuhive_device_hbm_live_bytes{" in scrape,
+              "per-device HBM gauge in the exposition")
+    finally:
+        server.stop()
+        generation.shutdown()
+        generation.join(timeout=5)
+
+    if PROBLEMS:
+        print(f"trace-smoke: {len(PROBLEMS)} problem(s)", file=sys.stderr)
+        return 1
+    print("trace-smoke: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
