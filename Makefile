@@ -2,7 +2,7 @@
 # mypy + flake8 per .circleci/config.yml:33-38): the dependency-free AST
 # lint + thivelint analyzer always run; mypy/ruff run when installed
 # (absent from this image).
-.PHONY: check lint analysis test bench probe metrics-smoke decode-smoke alerts-smoke chaos-smoke serving-smoke serving-mesh-smoke trace-smoke
+.PHONY: check lint analysis test bench probe metrics-smoke decode-smoke alerts-smoke chaos-smoke serving-smoke serving-mesh-smoke trace-smoke prefix-smoke
 
 check: lint analysis
 	@command -v ruff >/dev/null 2>&1 && ruff check . || echo "ruff not installed; skipped (tools/lint.py covered the always-on subset)"
@@ -70,6 +70,14 @@ serving-mesh-smoke:
 # "Request tracing & profiling")
 trace-smoke:
 	python tools/trace_smoke.py
+
+# radix prefix cache + chunked prefill on the CPU tiny model: cache-hit
+# TTFT below miss TTFT at equal tokens, shared-prefix fan-in admits
+# strictly > 2.5x the contiguous concurrency at equal HBM, the running
+# batch emits a token every tick while a long prompt chunk-prefills, zero
+# post-warmup recompiles (docs/SERVING.md "Prefix cache & chunked prefill")
+prefix-smoke:
+	python tools/prefix_smoke.py
 
 probe:
 	$(MAKE) -C tensorhive_tpu/native

@@ -437,9 +437,14 @@ def bench_generate_serving():
         assert all(handle.done for handle in handles)
         return busy
 
+    # prefix_cache off for every legacy block: serial replays the same
+    # prompts batched reruns, and list(range(...)) prompts are prefixes of
+    # each other — hits would silently turn the batching/layout/kernel
+    # numbers into caching numbers. The prefix_cache block below measures
+    # the cache on its own terms.
     engine = SlotEngine(params, config, slots=slots, max_len=max_len,
                         queue_depth=2 * slots, paged=True,
-                        page_size=page_size)
+                        page_size=page_size, prefix_cache="off")
     engine.warmup(prompt_lens=prompt_lens)
 
     # serial: one request at a time through the same engine — the
@@ -508,7 +513,8 @@ def bench_generate_serving():
     comparison["paged_kernel"] = kernel_block
     kernel_engine = SlotEngine(params, config, slots=slots, max_len=max_len,
                                queue_depth=2 * slots, paged=True,
-                               page_size=page_size, paged_kernel="on")
+                               page_size=page_size, paged_kernel="on",
+                               prefix_cache="off")
     kernel_block["dispatch"] = kernel_engine.stats()["pagedKernel"]
     kernel_engine.warmup(prompt_lens=prompt_lens)
     kernel_s, kernel_recompiles = batched_run(kernel_engine)
@@ -534,7 +540,8 @@ def bench_generate_serving():
     probe_len = prompt_lens[0]
     paged_pool = SlotEngine(params, config, slots=slots, max_len=max_len,
                             queue_depth=len(prompt_lens), paged=True,
-                            page_size=page_size, kv_pages=equal_hbm_pages)
+                            page_size=page_size, kv_pages=equal_hbm_pages,
+                            prefix_cache="off")
     paged_pool.warmup(prompt_lens=(probe_len,))
     small_contig = SlotEngine(params, config, slots=contig_capacity_slots,
                               max_len=max_len,
@@ -572,6 +579,7 @@ def bench_generate_serving():
         meshed = SlotEngine(params, config, slots=dp * slots,
                             max_len=max_len, queue_depth=2 * dp * slots,
                             paged=True, page_size=page_size,
+                            prefix_cache="off",
                             mesh=serving_mesh(dp=dp, tp=1))
         meshed.warmup(prompt_lens=prompt_lens)
         compiles_before = meshed.step_executable._cache_size()
@@ -593,6 +601,93 @@ def bench_generate_serving():
                 2),
         })
         _log(f"  mesh_scaling: {mesh_block}")
+
+    # radix prefix cache + chunked prefill (docs/SERVING.md "Prefix cache
+    # & chunked prefill"): hit vs miss TTFT at equal tokens, the cached-
+    # token fraction the hits skipped, and the equal-HBM concurrency
+    # uplift over the PR 7 prefix-less pool when requests share one long
+    # system prompt. Progressive-install like every block above: the dict
+    # lands in the result BEFORE the first engine exists.
+    # CPU cap 64: the PR 7 comparison pool prefills the WHOLE prompt, and
+    # this image's old-JAX flash path only lowers at bucket widths <= 64
+    # (the PR 6 use_flash caveat); on real TPU the prompt runs long
+    system_len = max(page_size * 2,
+                     min(max_len - new_tokens - 16,
+                         1024 if jax.default_backend() == "tpu" else 64))
+    prefix_block = {"system_prompt_tokens": system_len,
+                    "prefill_chunk_tokens": 64}
+    result["prefix_cache"] = prefix_block
+    system = list(range(1, system_len + 1))
+    prefix_engine = SlotEngine(params, config, slots=slots, max_len=max_len,
+                               queue_depth=2 * slots, page_size=page_size,
+                               prefill_chunk_tokens=64)
+    prefix_engine.warmup(prompt_lens=(system_len + 1,))
+    compiles_before = prefix_engine.step_executable._cache_size()
+    cold = prefix_engine.submit(system + [7], max_new_tokens=new_tokens)
+    drain(prefix_engine)
+    warm = prefix_engine.submit(system + [7], max_new_tokens=new_tokens)
+    drain(prefix_engine)
+    cold_ttft = cold.result(timeout_s=30)["ttftS"]
+    warm_ttft = warm.result(timeout_s=30)["ttftS"]
+    prefix_block.update({
+        "miss_ttft_ms": round(cold_ttft * 1e3, 2),
+        "hit_ttft_ms": round(warm_ttft * 1e3, 2),
+        "hit_vs_miss_ttft": round(cold_ttft / max(warm_ttft, 1e-9), 2),
+    })
+    # fan-in: shared-prefix storm at the PR 7 paged pool's equal HBM
+    fan_prompts = [system + [9 + i] for i in range(len(prompt_lens))]
+    fan_handles = [prefix_engine.submit(prompt, max_new_tokens=new_tokens)
+                   for prompt in fan_prompts]
+    drain(prefix_engine)
+    assert all(handle.done for handle in fan_handles)
+    from tensorhive_tpu.observability import get_request_ledger as _ledger
+
+    fan_rows = _ledger().recent(limit=len(fan_prompts), outcome="completed")
+    cached_fraction = [row["cachedTokens"] / row["promptTokens"]
+                       for row in fan_rows
+                       if row["cachedTokens"] is not None]
+    # measured NOW: the jit caches are process-global, and the comparison
+    # pools below have different shapes (their compiles are not this
+    # engine's recompiles)
+    prefix_recompiles = (prefix_engine.step_executable._cache_size()
+                         - compiles_before)
+    pages_per_request = -(-(system_len + 1 + new_tokens) // page_size)
+    tight_pages = 2 * pages_per_request
+    busy = {}
+    for label, prefix_mode in (("prefix", "auto"), ("pr7", "off")):
+        pool = SlotEngine(params, config, slots=slots, max_len=max_len,
+                          queue_depth=2 * slots, page_size=page_size,
+                          kv_pages=tight_pages, prefix_cache=prefix_mode,
+                          prefill_chunk_tokens=64)
+        pool.warmup(prompt_lens=(system_len + 1,))
+        if prefix_mode == "auto":       # warm the tree before the storm
+            drain_handle = pool.submit(system + [3],
+                                       max_new_tokens=new_tokens)
+            drain(pool)
+            assert drain_handle.done
+        handles = [pool.submit(prompt, max_new_tokens=new_tokens)
+                   for prompt in fan_prompts]
+        peak = 0
+        while pool.has_work():
+            pool.step()
+            peak = max(peak, pool.stats()["slotsBusy"])
+        assert all(handle.done for handle in handles)
+        busy[label] = peak
+    prefix_block.update({
+        "cached_token_fraction_mean": (
+            round(sum(cached_fraction) / len(cached_fraction), 3)
+            if cached_fraction else None),
+        "equal_hbm_kv_pages": tight_pages,
+        "max_concurrent_prefix": busy["prefix"],
+        "max_concurrent_pr7": busy["pr7"],
+        "concurrency_uplift_vs_pr7": round(
+            busy["prefix"] / max(1, busy["pr7"]), 2),
+        "recompiles": prefix_recompiles,
+        "stats": {key: prefix_engine.stats()[key]
+                  for key in ("prefixHits", "prefixMisses", "prefixHitRate",
+                              "cachedPages")},
+    })
+    _log(f"  prefix_cache: {prefix_block}")
     return result
 
 

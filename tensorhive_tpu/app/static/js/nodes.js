@@ -253,6 +253,11 @@ async function refreshServing() {
       servingBadge("KV pages · " + stats.pagedKernel,
                    stats.kvPagesFree + "/" + stats.kvPagesTotal,
                    stats.kvPagesFree === 0)}
+    ${stats.prefixCache !== "on" ? "" :
+      servingBadge("prefix cache",
+                   (stats.prefixHitRate == null ? "–" :
+                    (100 * stats.prefixHitRate).toFixed(0) + "% hit") +
+                   " · " + stats.cachedPages + " pg", false)}
     ${servingBadge("TTFT p50/p95",
                    ms(stats.ttftP50Ms) + " / " + ms(stats.ttftP95Ms), false)}
     ${servingBadge("inter-token p50",

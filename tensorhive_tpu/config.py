@@ -195,6 +195,18 @@ class GenerationConfig:
                                      # auto = pallas on real TPU, XLA page
                                      # gather elsewhere; on/off force a
                                      # dispatch (docs/SERVING.md)
+    prefix_cache: str = "auto"       # radix shared-prefix page cache
+                                     # (docs/SERVING.md "Prefix cache &
+                                     # chunked prefill"): auto = on for the
+                                     # paged layout; off = byte-identical
+                                     # PR 7-10 rollback; on requires paged
+    prefix_min_tokens: int = 32      # shortest cached prefix worth a
+                                     # shared grant (whole pages only)
+    prefill_chunk_tokens: int = 256  # per-tick prefill budget: long
+                                     # prompts split into chunks this size
+                                     # interleaved with decode steps; 0 =
+                                     # one chunk per prompt (prefix-cache
+                                     # engines only)
     queue_depth: int = 32
     max_new_tokens: int = 128        # per-request cap
     top_k: int = 0                   # 0 = no top-k sampling filter
@@ -465,6 +477,9 @@ enabled = false
 # page_size = 16
 # kv_pages = 0        # 0 = equal HBM to the contiguous layout
 # paged_kernel = "auto"  # fused decode kernel: auto|on|off
+# prefix_cache = "auto"  # radix shared-prefix page cache: auto|on|off
+# prefix_min_tokens = 32
+# prefill_chunk_tokens = 256  # per-tick prefill budget (chunked prefill)
 # queue_depth = 32
 # max_new_tokens = 128
 # max_concurrent_per_user = 4

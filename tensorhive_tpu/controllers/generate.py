@@ -57,6 +57,15 @@ STATS_SCHEMA = obj(
     pagedKernel=s("string", nullable=True),
     kvPagesTotal=s("integer", nullable=True),
     kvPagesFree=s("integer", nullable=True),
+    #: radix prefix cache (docs/SERVING.md "Prefix cache & chunked
+    #: prefill"): "on"/"off", lifetime hit rate and retained page count —
+    #: the serving-strip prefix badge renders these
+    prefixCache=s("string"),
+    prefixHits=s("integer"),
+    prefixMisses=s("integer"),
+    prefixHitRate=s("number", nullable=True),
+    cachedPages=s("integer", nullable=True),
+    prefillChunkTokens=s("integer", nullable=True),
     requestsCompleted=s("integer"),
     tokensEmitted=s("integer"),
     steps=s("integer"),

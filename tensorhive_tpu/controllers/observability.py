@@ -156,6 +156,11 @@ REQUEST_RECORD_SCHEMA = obj(
     slot=s("integer", nullable=True),
     kvPages=s("integer", nullable=True),
     queueMs=s("number", nullable=True),
+    #: prompt tokens the prefix cache let prefill skip (docs/SERVING.md
+    #: "Prefix cache & chunked prefill"; null = prefix cache off)
+    cachedTokens=s("integer", nullable=True),
+    #: prefill chunks dispatched (0 = full-prefix hit; null = legacy path)
+    prefillChunks=s("integer", nullable=True),
     prefillBucket=s("integer", nullable=True),
     prefillCompile=s("string", nullable=True),
     prefillMs=s("number", nullable=True),

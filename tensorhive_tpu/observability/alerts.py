@@ -613,6 +613,18 @@ def default_rule_pack(monitoring_interval_s: Optional[float] = None,
                         "for pages to be released; raise kv_pages or shed "
                         "long-context load (docs/SERVING.md)"),
         AlertRule(
+            name="prefix_cache_thrash", severity="warning",
+            kind="increase",
+            metric="tpuhive_generate_prefix_evictions_total",
+            op=">", threshold=64.0, window_s=300.0,
+            for_s=alert_interval_s,
+            description="prefix-cache pages are being evicted faster than "
+                        "the shared-prefix working set can stay warm — "
+                        "admissions keep reclaiming what the next hit "
+                        "needs; raise kv_pages or shorten prompts "
+                        "(docs/SERVING.md 'Prefix cache & chunked "
+                        "prefill')"),
+        AlertRule(
             name="generate_slot_leak", severity="critical",
             kind="threshold", op=">", threshold=0.0,
             for_s=alert_interval_s,

@@ -68,6 +68,12 @@ class RequestRecord:
     slot: Optional[int] = None
     kv_pages: Optional[int] = None         # pages granted (paged engines)
     queue_ms: Optional[float] = None
+    #: prompt tokens the prefix cache let prefill skip (None: prefix cache
+    #: off or the engine predates it; 0: a full miss)
+    cached_tokens: Optional[int] = None
+    #: prefill chunks dispatched (None: legacy whole-prompt prefill path;
+    #: 0: full-prefix hit, nothing to prefill)
+    prefill_chunks: Optional[int] = None
     prefill_bucket: Optional[int] = None
     #: "hit" (bucket executable reused) or "miss" (compiled) — joins the
     #: ``tpuhive_decode_compile_total`` fingerprint story per request
@@ -103,6 +109,8 @@ class RequestRecord:
             "slot": self.slot,
             "kvPages": self.kv_pages,
             "queueMs": ms(self.queue_ms),
+            "cachedTokens": self.cached_tokens,
+            "prefillChunks": self.prefill_chunks,
             "prefillBucket": self.prefill_bucket,
             "prefillCompile": self.prefill_compile,
             "prefillMs": ms(self.prefill_ms),

@@ -34,6 +34,15 @@ Design, in the order the constraints forced it:
   ``_prefill_bucket``) and then advances the whole running batch one token,
   interleaving prefill and decode work on the same chip instead of
   dedicating it to either phase.
+* **Shared prefixes cost once.** The paged layout defaults to the radix
+  prefix cache (``serving/prefix_cache.py`` — ``[generation_service]
+  prefix_cache``): admission grants matched prefix pages SHARED
+  (refcounted) and charges only the unique suffix, prefill skips to the
+  first uncached position through a start-offset chunked executable, and
+  long prompts advance ONE ``prefill_chunk_tokens`` chunk per tick so a
+  join can never stall the running batch's inter-token latency
+  (docs/SERVING.md "Prefix cache & chunked prefill"). ``prefix_cache=off``
+  is a byte-identical rollback to the PR 7-10 whole-prompt prefill path.
 * **Mesh-aware, single-chip by default.** An optional serving mesh
   (``parallel/mesh.py::serving_mesh``; ``[generation_service]
   mesh_dp``/``mesh_tp``) shards params over tp via the SAME
@@ -96,7 +105,8 @@ from ..observability import (
 )
 from ..ops.paged_attention import resolve_paged_kernel
 from . import QueueFullError, RateLimitError
-from .paging import PagePool
+from .paging import TRASH_PAGE, PagePool
+from .prefix_cache import PrefixCache
 
 # -- metrics (registered once at import; one exposition surface) -------------
 _REQUESTS = get_registry().counter(
@@ -149,6 +159,29 @@ _SLOT_PAGES = get_registry().gauge(
 _MESH_DEVICES = get_registry().gauge(
     "tpuhive_generate_mesh_devices",
     "Devices in the serving mesh (dp x tp; 1 = single-chip engine).")
+_PREFIX_HITS = get_registry().counter(
+    "tpuhive_generate_prefix_hits_total",
+    "Admitted requests whose prompt matched cached prefix pages (>= "
+    "prefix_min_tokens skipped at prefill; docs/SERVING.md 'Prefix "
+    "cache & chunked prefill').")
+_PREFIX_MISSES = get_registry().counter(
+    "tpuhive_generate_prefix_misses_total",
+    "Admitted requests that paid a full private prefill (no usable "
+    "cached prefix).")
+_PREFIX_CACHED_PAGES = get_registry().gauge(
+    "tpuhive_generate_prefix_cached_pages",
+    "KV pages currently retained by the radix prefix cache (evictable "
+    "under pool pressure once no slot shares them).")
+_PREFIX_EVICTIONS = get_registry().counter(
+    "tpuhive_generate_prefix_evictions_total",
+    "Prefix-cache pages evicted under pool pressure — fast growth is the "
+    "prefix_cache_thrash alert signal (docs/OBSERVABILITY.md).")
+_PREFILL_CHUNKS = get_registry().histogram(
+    "tpuhive_generate_prefill_chunks",
+    "Prefill chunks dispatched per admitted request (0 = full prefix hit; "
+    "long prompts split across scheduler ticks so decode latency stays "
+    "flat — docs/SERVING.md 'Prefix cache & chunked prefill').",
+    buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0))
 
 
 # -- device functions ---------------------------------------------------------
@@ -408,6 +441,110 @@ _paged_serving_prefill = functools.partial(
     donate_argnames=("cache",))(_paged_prefill_body)
 
 
+def _chunk_attend(q, k_ctx, v_ctx, q_positions):
+    """Attention for a prefill chunk that does NOT start at position 0:
+    queries [1, W, H, Dh] against the slot's whole gathered page run
+    [K, Hkv, Dh] (cached prefix + earlier chunks + this chunk's own writes,
+    laid out in logical order), masked to ``key_pos <= q_pos``.
+
+    Mirrors :func:`~tensorhive_tpu.ops.flash_attention.reference_attention`
+    term for term — GQA expanded with ``jnp.repeat``, f32 scores/probs, the
+    same scale — except the causal ``tril`` becomes a positional mask (the
+    chunk's queries sit at ``start + w``, its keys at absolute logical
+    positions). Entries past the query position hold trash-page garbage or
+    not-yet-written cells; the mask sends them to NEG_INF, the softmax
+    underflows them to exactly 0.0, and 0.0 x finite garbage contributes
+    exact zeros — the same argument that makes the paged decode gather
+    f32-exact against the contiguous cache (models/decode._paged_attend)."""
+    from ..ops.flash_attention import NEG_INF
+
+    if k_ctx.shape[1] != q.shape[2]:
+        group = q.shape[2] // k_ctx.shape[1]
+        k_ctx = jnp.repeat(k_ctx, group, axis=1)
+        v_ctx = jnp.repeat(v_ctx, group, axis=1)
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bqhd,khd->bhqk", q.astype(jnp.float32),
+                        k_ctx.astype(jnp.float32)) * scale
+    key_positions = jax.lax.iota(jnp.int32, k_ctx.shape[0])
+    mask = (key_positions[None, None, None, :]
+            <= q_positions[None, None, :, None])
+    scores = jnp.where(mask, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,khd->bqhd", probs, v_ctx.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def _paged_chunk_prefill_body(params, head, cache, page_table_row, start,
+                              real_len, config: TransformerConfig):
+    """Prefill ONE CHUNK of a joining prompt, starting mid-sequence.
+
+    The workhorse of the prefix cache and of chunked prefill
+    (docs/SERVING.md "Prefix cache & chunked prefill"): ``head`` is
+    [1, W] holding prompt positions ``start .. start + real_len - 1``
+    (W a power-of-two bucket, the tail zero-padded), and ``start`` is a
+    TRACED operand — a cache hit prefills only the uncached suffix, and a
+    long prompt runs through this executable once per scheduler tick, so
+    neither the skip offset nor the chunk count ever mints a new shape.
+
+    Differences from :func:`_paged_prefill_body` (which remains the
+    ``prefix_cache=off`` byte-identical rollback path):
+
+    * K/V writes scatter to ``(page_table_row[(start + w) // ps],
+      (start + w) % ps)`` — the page indices beyond this chunk are never
+      touched, padded positions route out of bounds and drop.
+    * attention CANNOT be a pure within-window pass: queries at
+      ``start + w`` must see positions ``0 .. start + w``, whose K/V live
+      in the slot's pages (shared prefix pages a previous request
+      computed, or this request's own earlier chunks). Writes land first,
+      then the whole row gathers into logical order and
+      :func:`_chunk_attend` applies the positional causal mask.
+
+    A chunk that starts at 0 with ``real_len`` covering the whole head is
+    mathematically the full prefill — the two bodies agree f32-exactly
+    (pinned by the tri-equality tests running both paths against
+    ``decode.generate``)."""
+    dtype = config.dtype
+    batch, width = head.shape
+    x = params["tok_embed"].astype(dtype)[head]
+    chunk_offsets = jnp.arange(width, dtype=jnp.int32)
+    global_positions = start + chunk_offsets                    # [W]
+    positions = jnp.broadcast_to(global_positions, (batch, width))
+    num_physical = cache.k.shape[1]
+    page_size = cache.k.shape[2]
+    valid = chunk_offsets < real_len
+    pages = jnp.where(valid, page_table_row[global_positions // page_size],
+                      num_physical)                    # OOB -> dropped
+    page_offsets = global_positions % page_size
+    window = page_table_row.shape[0] * page_size
+    cache_k, cache_v = cache.k, cache.v
+
+    def attend(q, k, v, layer):
+        nonlocal cache_k, cache_v
+        layer_k = cache_k[layer].at[pages, page_offsets].set(
+            k[0].astype(cache_k.dtype), mode="drop")
+        layer_v = cache_v[layer].at[pages, page_offsets].set(
+            v[0].astype(cache_v.dtype), mode="drop")
+        cache_k = jax.lax.dynamic_update_slice(
+            cache_k, layer_k[None], (layer, 0, 0, 0, 0))
+        cache_v = jax.lax.dynamic_update_slice(
+            cache_v, layer_v[None], (layer, 0, 0, 0, 0))
+        # gather AFTER the writes: within-chunk causality comes from the
+        # positional mask, exactly like the decode step's write-then-attend
+        ctx_k = layer_k[page_table_row].reshape(window, *layer_k.shape[2:])
+        ctx_v = layer_v[page_table_row].reshape(window, *layer_v.shape[2:])
+        return _chunk_attend(q, ctx_k, ctx_v, global_positions)
+
+    for layer_index, block in enumerate(params["blocks"]):
+        x = TransformerLM.block_forward(x, block, config, positions, attend,
+                                        layer_index=layer_index)
+    return KVCache(k=cache_k, v=cache_v)
+
+
+_paged_chunk_serving_prefill = functools.partial(
+    jax.jit, static_argnames=("config",),
+    donate_argnames=("cache",))(_paged_chunk_prefill_body)
+
+
 # -- request plumbing ---------------------------------------------------------
 
 #: handle event kinds
@@ -502,6 +639,20 @@ class _Request:
 class _Slot:
     request: _Request
     joined_ts: float
+    #: tokens the prefix cache let this request skip (0 = full miss)
+    cached_tokens: int = 0
+    #: next prompt position to prefill; == prefill_target once armed
+    prefill_next: int = 0
+    #: last prompt position exclusive (prompt_len - 1; the final token
+    #: goes through the decode step, as everywhere)
+    prefill_target: int = 0
+    #: False while chunks are still being dispatched — the slot is held
+    #: out of the decode batch (active stays False) until armed
+    prefill_done: bool = True
+    prefill_chunks: int = 0
+    prefill_ms: float = 0.0
+    prefill_started_ts: float = 0.0
+    prefill_compile: Optional[str] = None
 
 
 class SlotEngine:
@@ -529,6 +680,9 @@ class SlotEngine:
         page_size: int = 16,
         kv_pages: int = 0,
         paged_kernel: str = "auto",
+        prefix_cache: str = "auto",
+        prefix_min_tokens: int = 32,
+        prefill_chunk_tokens: int = 256,
         mesh=None,
         clock: Callable[[], float] = time.monotonic,
     ) -> None:
@@ -636,6 +790,11 @@ class SlotEngine:
             shape = (config.n_layers, self._pool.physical_pages,
                      self.page_size, config.kv_heads, config.d_head)
         else:
+            if prefix_cache == "on":
+                raise ValueError(
+                    "prefix_cache=on needs the paged cache layout (pages "
+                    "are the sharing unit); set paged=true or prefix_cache="
+                    "auto/off")
             self.page_size = None
             self._pool = None
             self.paged_kernel = None
@@ -680,6 +839,35 @@ class SlotEngine:
         self._temps = np.zeros(self.capacity, np.float32)
         self._key = self._operand(jax.random.PRNGKey(0))
 
+        # -- radix prefix cache + chunked prefill (docs/SERVING.md "Prefix
+        # cache & chunked prefill"). auto = on for the paged layout (the
+        # shared-prefix capacity/TTFT lever is the default serving story),
+        # off for contiguous (no pages, nothing to share). "off" is the
+        # byte-identical PR 7-10 rollback: the legacy whole-prompt prefill
+        # executable, untouched fingerprints, refcounts all 1.
+        if prefix_cache not in ("auto", "on", "off"):
+            raise ValueError(
+                f"prefix_cache must be auto|on|off, got {prefix_cache!r}")
+        if prefill_chunk_tokens < 0:
+            raise ValueError(
+                f"prefill_chunk_tokens must be >= 0, got "
+                f"{prefill_chunk_tokens}")
+        self.prefix_cache = ("on" if self.paged and prefix_cache != "off"
+                             else "off")
+        self.prefix_min_tokens = max(0, int(prefix_min_tokens))
+        #: per-chunk position budget; 0 = one chunk per prompt (the
+        #: executable still handles the start offset for cache hits)
+        self.prefill_chunk_tokens = int(prefill_chunk_tokens)
+        #: the new-subsystem dispatch switch: prefix on routes ALL prefills
+        #: (miss included, start=0) through the chunked executable so one
+        #: code path serves hit/miss/chunked; off keeps the legacy pair
+        self._use_chunk_prefill = self.prefix_cache == "on"
+        self._prefix = (PrefixCache(self._pool,
+                                    min_tokens=self.prefix_min_tokens)
+                        if self._use_chunk_prefill else None)
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+
         _QUEUE_CAPACITY.set(self.queue_depth)
         _SLOTS_TOTAL.set(self.capacity)
         _QUEUE_DEPTH.set(0)
@@ -690,6 +878,8 @@ class SlotEngine:
             _KV_PAGES_FREE.set(self._pool.free_pages)
             for index in range(self.capacity):
                 _SLOT_PAGES.labels(slot=str(index)).set(0)
+        if self._prefix is not None:
+            _PREFIX_CACHED_PAGES.set(0)
 
     @property
     def num_devices(self) -> int:
@@ -721,6 +911,8 @@ class SlotEngine:
 
     @property
     def prefill_executable(self):
+        if self._use_chunk_prefill:
+            return _paged_chunk_serving_prefill
         return _paged_serving_prefill if self.paged else _serving_prefill
 
     # -- admission --------------------------------------------------------
@@ -801,10 +993,18 @@ class SlotEngine:
         shortest-remaining running sequence to free its slot at the observed
         inter-token p50. Paged with ``needed_pages``: the wait is for PAGES,
         not a slot — walk running sequences in completion order accumulating
-        the pages each will release on top of the current free count, and
-        answer the completion time at which ``needed_pages`` fit (a
+        the pages each will make available on top of the current headroom,
+        and answer the completion time at which ``needed_pages`` fit (a
         long-context request correctly waits for several short ones, not
-        just the first)."""
+        just the first).
+
+        With the prefix cache on, pages can be SHARED, and a leaving slot
+        frees only pages whose refcount drops to 0 — so the walk simulates
+        per-page slot refcounts and counts a page exactly when its LAST
+        holder completes (it is then free outright, or cache-retained and
+        therefore evictable on demand — either way available to admission).
+        Summing ``owned_count`` would over-promise: two sharers' departures
+        must not count the same page twice."""
         per_token = self._intertoken_hist.quantile(0.5) or 0.05
         running = [
             (slot.request.max_new_tokens - len(slot.request.generated), index)
@@ -812,13 +1012,20 @@ class SlotEngine:
         if not running:
             return 1.0
         if self.paged and needed_pages is not None:
-            free = self._pool.free_pages
-            if free < needed_pages:
+            available = self._pool.free_pages
+            if self._prefix is not None:
+                # cache-only pages are evictable the moment admission asks
+                available += self._pool.cached_only_pages()
+            if available < needed_pages:
+                slot_refs = self._pool.slot_ref_counts()
                 eta_tokens = 0
                 for remaining, index in sorted(running):
-                    free += self._pool.owned_count(index)
+                    for page in self._pool.owned_pages(index):
+                        slot_refs[page] -= 1
+                        if slot_refs[page] == 0:
+                            available += 1      # net-releasable NOW
                     eta_tokens = remaining
-                    if free >= needed_pages:
+                    if available >= needed_pages:
                         break
                 return max(1.0, round(eta_tokens * per_token, 1))
         return max(1.0, round(min(r for r, _ in running) * per_token, 1))
@@ -845,9 +1052,15 @@ class SlotEngine:
                 slot is not None for slot in self._slots)
 
     def step(self) -> int:
-        """One scheduler iteration: admit joins, then advance the running
-        batch one token. Returns the number of active slots stepped."""
+        """One scheduler iteration: admit joins, advance every in-progress
+        prefill by ONE chunk, then advance the running batch one token —
+        FlexNPU-style phase co-location, with the chunk budget
+        (``prefill_chunk_tokens``) bounding how much prefill work any tick
+        can insert between two decode steps, so a 4k-token join can never
+        stall the running batch's inter-token latency. Returns the number
+        of active slots stepped."""
         self._admit()
+        self._advance_prefills()
         return self._decode_step()
 
     def pump(self, budget_s: Optional[float] = None,
@@ -868,16 +1081,37 @@ class SlotEngine:
     def warmup(self, prompt_lens: Sequence[int] = ()) -> None:
         """Compile the step executable and the prefill executable for each
         bucket the given prompt lengths map to (plus the smallest bucket),
-        so steady-state traffic never pays a compile."""
-        buckets = {_prefill_bucket(max(1, length - 1), self.max_len - 1)
-                   for length in prompt_lens} or {
-                       _prefill_bucket(1, self.max_len - 1)}
-        for width in sorted(buckets):
-            # real_len 0: every write is masked (contiguous) or dropped
-            # (paged — slot 0's table row still points at the trash page),
-            # so warmup compiles without touching any page
-            self._dispatch_prefill(np.zeros((1, width), np.int32),
-                                   slot=0, real_len=0)
+        so steady-state traffic never pays a compile.
+
+        With the prefix cache on, the chunked executable's widths are
+        warmed instead: each prompt length expands to its chunk sequence
+        (``prefill_chunk_tokens``-sized pieces + the bucketed tail), plus
+        the floor bucket — cache-hit suffixes are usually short, and a hit
+        must never pay the compile the miss path was warmed out of."""
+        if self._use_chunk_prefill:
+            widths = {_prefill_bucket(1, self.max_len - 1)}
+            for length in prompt_lens:
+                remaining = max(1, length - 1)
+                while remaining > 0:
+                    chunk = min(remaining,
+                                self.prefill_chunk_tokens or remaining)
+                    widths.add(_prefill_bucket(chunk, self.max_len - 1))
+                    remaining -= chunk
+            for width in sorted(widths):
+                # real_len 0: every write routes out of bounds and drops —
+                # warmup compiles without touching any page
+                self._dispatch_chunk_prefill(np.zeros((1, width), np.int32),
+                                             slot=0, start=0, real_len=0)
+        else:
+            buckets = {_prefill_bucket(max(1, length - 1), self.max_len - 1)
+                       for length in prompt_lens} or {
+                           _prefill_bucket(1, self.max_len - 1)}
+            for width in sorted(buckets):
+                # real_len 0: every write is masked (contiguous) or dropped
+                # (paged — slot 0's table row still points at the trash
+                # page), so warmup compiles without touching any page
+                self._dispatch_prefill(np.zeros((1, width), np.int32),
+                                       slot=0, real_len=0)
         chosen, self._cache, self._key = self._run_step()
         np.asarray(chosen)      # force the compile before traffic arrives
 
@@ -907,6 +1141,29 @@ class SlotEngine:
                               (fn, self.config, self.capacity,
                                self.max_len, width)
                               + self._mesh_fingerprint())
+
+    def _count_chunk_prefill_compile(self, width: int) -> str:
+        fn = self._fingerprint_fn("serving_paged_chunk_prefill")
+        return _count_compile(fn,
+                              (fn, self.config,
+                               self._pool.num_pages, self.page_size,
+                               self._pool.max_pages_per_slot, width)
+                              + self._mesh_fingerprint())
+
+    def _dispatch_chunk_prefill(self, head, slot: int, start: int,
+                                real_len: int) -> str:
+        """Run one prefill chunk (positions ``start .. start+real_len-1``)
+        through the slot's page-table row. ``start``/``real_len``/the row
+        are traced operands: one executable per bucket width serves every
+        skip offset, chunk boundary and page assignment. Returns the
+        compile fingerprint event ("hit"/"miss") for the request ledger."""
+        compile_event = self._count_chunk_prefill_compile(head.shape[1])
+        self._cache = _paged_chunk_serving_prefill(
+            self.params, self._operand(head), self._cache,
+            self._operand(self._pool.page_table[slot]),
+            self._operand(np.int32(start)),
+            self._operand(np.int32(real_len)), self.config)
+        return compile_event
 
     def _dispatch_prefill(self, head, slot: int, real_len: int) -> str:
         """Run the joining sequence's trunk pass through whichever cache
@@ -954,11 +1211,21 @@ class SlotEngine:
                             self._pool.max_pages_per_slot, self.top_k,
                             self._kernel_interpret)
                            + self._mesh_fingerprint())
+            page_table = self._pool.page_table
+            if self._use_chunk_prefill:
+                # a mid-prefill slot's row already points at REAL pages
+                # (shared prefix pages above all), but the step writes
+                # K/V for every slot at its frozen position — route
+                # inactive rows to the trash page so that scribble can
+                # never land on a page another sequence reads. Same
+                # dtype/shape, traced value only: no fingerprint change.
+                page_table = page_table.copy()
+                page_table[~self._active] = TRASH_PAGE
             return _paged_serving_step(
                 self.params, self._operand(self._tokens),
                 self._operand(self._positions), self._operand(self._active),
                 self._operand(self._temps),
-                self._operand(self._pool.page_table),
+                self._operand(page_table),
                 self._cache, self._key,
                 config=self.config, top_k=self.top_k,
                 use_kernel=self._use_kernel,
@@ -989,10 +1256,31 @@ class SlotEngine:
                     _QUEUE_DEPTH.set(len(self._pending))
                     return joined
                 request = self._pending[0]
+                cached_tokens = 0
                 if self.paged:
                     needed = self._pool.pages_for(
                         len(request.prompt) + request.max_new_tokens)
-                    if not self._pool.assign(free, needed):
+                    if self._prefix is not None:
+                        # charge only the unique suffix: matched prefix
+                        # pages are granted shared (refcount bump, read-
+                        # only), fresh pages cover the rest — and pool
+                        # pressure first reclaims LRU cache-only pages
+                        # (eviction never touches a page a slot holds)
+                        cached_tokens, shared = self._prefix.match(
+                            request.prompt)
+                        fresh = needed - len(shared)
+                        shortfall = fresh - self._pool.free_pages
+                        if shortfall > 0:
+                            evicted = self._prefix.evict(shortfall)
+                            if evicted:
+                                _PREFIX_EVICTIONS.inc(evicted)
+                                _PREFIX_CACHED_PAGES.set(
+                                    self._prefix.cached_pages)
+                        granted = self._pool.assign_shared(free, shared,
+                                                           fresh)
+                    else:
+                        granted = self._pool.assign(free, needed)
+                    if not granted:
                         # head-of-line waits for pages. Strict FIFO on
                         # purpose: letting smaller requests overtake would
                         # starve long-context requests under sustained
@@ -1000,12 +1288,20 @@ class SlotEngine:
                         # anything that can NEVER fit)
                         _QUEUE_DEPTH.set(len(self._pending))
                         return joined
+                    if self._prefix is not None:
+                        if cached_tokens > 0:
+                            self.prefix_hits += 1
+                            _PREFIX_HITS.inc()
+                        else:
+                            self.prefix_misses += 1
+                            _PREFIX_MISSES.inc()
                     _KV_PAGES_FREE.set(self._pool.free_pages)
                     _SLOT_PAGES.labels(slot=str(free)).set(needed)
                 self._pending.popleft()
                 joined_ts = self.clock()
                 self._slots[free] = _Slot(request=request,
-                                          joined_ts=joined_ts)
+                                          joined_ts=joined_ts,
+                                          cached_tokens=cached_tokens)
                 # the queue phase closes HERE, separately from TTFT: the
                 # queue share is what admission tuning moves, the prefill
                 # share is what bucket/kernel work moves
@@ -1018,6 +1314,8 @@ class SlotEngine:
                     record.slot = free
                     if self.paged:
                         record.kv_pages = needed
+                    if self._prefix is not None:
+                        record.cached_tokens = cached_tokens
                 get_tracer().record_span(
                     "generate.queue", kind="generate",
                     start_ts=request.submitted_wall,
@@ -1040,10 +1338,27 @@ class SlotEngine:
     def _join(self, slot: int, request: _Request) -> None:
         """Prefill the prompt head into the slot row and arm the per-slot
         operands; the first decode step after this emits the request's
-        first token."""
+        first token.
+
+        Prefix-cache engines instead SCHEDULE the prefill: the slot starts
+        parked (active False, its page-table row masked to the trash page
+        in the step operand) at the first uncached position, and
+        :meth:`_advance_prefills` — called in this same tick, right after
+        admission — dispatches one chunk per tick until the slot arms. A
+        full-prefix hit arms immediately: zero chunks, zero prefill."""
         prompt = request.prompt
         prompt_len = len(prompt)
         record = request.record
+        if self._use_chunk_prefill:
+            state = self._slots[slot]
+            state.prefill_target = prompt_len - 1
+            state.prefill_next = min(state.cached_tokens,
+                                     state.prefill_target)
+            state.prefill_done = False
+            state.prefill_started_ts = self.clock()
+            if state.prefill_next >= state.prefill_target:
+                self._finish_prefill(slot, state)
+            return
         if prompt_len > 1:
             width = _prefill_bucket(prompt_len - 1, self.max_len - 1)
             head = np.zeros((1, width), np.int32)
@@ -1075,11 +1390,107 @@ class SlotEngine:
             self._temps[slot] = request.temperature
             self._active[slot] = True
 
+    def _advance_prefills(self) -> None:
+        """Dispatch ONE prefill chunk for every slot still mid-prefill —
+        the per-tick budget that keeps a long joining prompt from wedging
+        the running decode batch (docs/SERVING.md "Prefix cache & chunked
+        prefill"). Cancels are honored here too, so a cancel mid-chunk
+        frees the slot (and its net-releasable pages) without ever arming."""
+        if not self._use_chunk_prefill:
+            return      # legacy paths prefill whole prompts inside _join
+        with self._lock:
+            pending = [(index, slot) for index, slot in enumerate(self._slots)
+                       if slot is not None and not slot.prefill_done]
+        for index, state in pending:
+            if state.request.cancelled:
+                with self._lock:
+                    if self._slots[index] is state:
+                        self._free_slot_locked(index)
+                        self._finish_locked(state.request,
+                                            outcome="cancelled")
+                continue
+            self._advance_prefill_slot(index, state)
+
+    def _advance_prefill_slot(self, index: int, state: _Slot) -> None:
+        """One chunk of ``state``'s prompt through the chunked executable:
+        positions ``prefill_next .. prefill_next + chunk - 1``, width
+        bucketed, start/length traced. Pages wholly covered by dispatched
+        chunks are adopted into the radix tree immediately — every later
+        reader is dispatched after this chunk on the same pump thread and
+        chains through the donated cache, so 'dispatched' is exactly the
+        sharing-safety line (prefix_cache.py module docstring)."""
+        request = state.request
+        prompt = request.prompt
+        start = state.prefill_next
+        remaining = state.prefill_target - start
+        length = min(remaining, self.prefill_chunk_tokens or remaining)
+        width = _prefill_bucket(length, self.max_len - 1)
+        head = np.zeros((1, width), np.int32)
+        head[0, :length] = prompt[start:start + length]
+        started = self.clock()
+        event = self._dispatch_chunk_prefill(head, index, start, length)
+        state.prefill_ms += (self.clock() - started) * 1e3
+        state.prefill_chunks += 1
+        if state.prefill_compile != "miss":
+            # a single missed chunk marks the whole request "miss" — the
+            # ledger field answers "did this request pay a compile"
+            state.prefill_compile = event
+        record = request.record
+        if record is not None and record.prefill_bucket is None:
+            record.prefill_bucket = width
+        state.prefill_next = start + length
+        with self._lock:
+            if self._slots[index] is state and self._prefix is not None:
+                self._prefix.insert(prompt, self._pool.page_table[index],
+                                    state.prefill_next)
+                _PREFIX_CACHED_PAGES.set(self._prefix.cached_pages)
+        if state.prefill_next >= state.prefill_target:
+            self._finish_prefill(index, state)
+
+    def _finish_prefill(self, index: int, state: _Slot) -> None:
+        """Arm a slot whose prefill (possibly zero chunks — a full-prefix
+        hit) is fully dispatched: the next decode step emits its first
+        token. Closes the ledger's prefill phase and the prefill span."""
+        request = state.request
+        record = request.record
+        now = self.clock()
+        if record is not None:
+            record.prefill_ms = state.prefill_ms
+            record.prefill_compile = state.prefill_compile
+            record.prefill_chunks = state.prefill_chunks
+        _PREFILL_CHUNKS.observe(state.prefill_chunks)
+        if state.prefill_chunks > 0:
+            get_tracer().record_span(
+                "generate.prefill", kind="generate",
+                start_ts=request.wall(state.prefill_started_ts),
+                duration_s=now - state.prefill_started_ts,
+                request_id=request.request_id, slot=index,
+                bucket=(record.prefill_bucket if record is not None
+                        else None),
+                compile=state.prefill_compile,
+                chunks=state.prefill_chunks,
+                cached_tokens=state.cached_tokens)
+        with self._lock:
+            if self._slots[index] is not state:
+                return                       # cancelled and freed meanwhile
+            if request.cancelled:
+                self._free_slot_locked(index)
+                self._finish_locked(request, outcome="cancelled")
+                return
+            state.prefill_done = True
+            self._tokens[index] = request.prompt[-1]
+            self._positions[index] = state.prefill_target
+            self._temps[index] = request.temperature
+            self._active[index] = True
+
     def _decode_step(self) -> int:
         with self._lock:
+            # slots still chunk-prefilling are parked (active False): they
+            # join the batch only once armed, so a half-prefilled sequence
+            # can never consume a decode token
             stepped = [(index, slot.request)
                        for index, slot in enumerate(self._slots)
-                       if slot is not None]
+                       if slot is not None and bool(self._active[index])]
         if not stepped:
             return 0
         chosen, self._cache, self._key = self._run_step()
@@ -1233,6 +1644,17 @@ class SlotEngine:
                 "pagedKernel": self.paged_kernel,
                 "kvPagesTotal": self._pool.num_pages if self.paged else None,
                 "kvPagesFree": self._pool.free_pages if self.paged else None,
+                "prefixCache": self.prefix_cache,
+                "prefixHits": self.prefix_hits,
+                "prefixMisses": self.prefix_misses,
+                "prefixHitRate": (
+                    round(self.prefix_hits
+                          / (self.prefix_hits + self.prefix_misses), 4)
+                    if self.prefix_hits + self.prefix_misses else None),
+                "cachedPages": (self._prefix.cached_pages
+                                if self._prefix is not None else None),
+                "prefillChunkTokens": (self.prefill_chunk_tokens
+                                       if self._use_chunk_prefill else None),
                 "requestsCompleted": self.completed_requests,
                 "tokensEmitted": self.emitted_tokens,
                 "steps": self.steps,
@@ -1256,8 +1678,14 @@ class SlotEngine:
 
     def kv_page_saturation(self) -> Optional[float]:
         """Pool-fill fraction, 1.0 = exhausted (None for the contiguous
-        engine — no pool, nothing to alert on)."""
+        engine — no pool, nothing to alert on). Pages held ONLY by the
+        prefix cache do not count as used: they are evictable the moment
+        admission needs them, and alerting on a deliberately-full cache
+        would make a healthy warm cache look like exhaustion."""
         if not self.paged:
             return None
         with self._lock:
-            return self._pool.saturation()
+            used = self._pool.used_pages
+            if self._prefix is not None:
+                used -= self._pool.cached_only_pages()
+            return used / self._pool.num_pages

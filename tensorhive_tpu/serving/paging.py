@@ -9,6 +9,16 @@ request actually needs (``ceil((prompt + max_new) / page_size)``), handed
 out from a host-side free list at admission and recycled the moment the
 slot leaves.
 
+Pages are REFCOUNTED (PR 11): the prefix cache
+(:mod:`tensorhive_tpu.serving.prefix_cache`) maps shared prompt prefixes to
+physical page runs, so one page can back many slots at once — each slot's
+grant and each radix-tree node holds one reference, and a page returns to
+the free list only when the last reference drops. A slot leaving therefore
+frees only its *net-releasable* pages; pages still shared with other slots
+(or retained by the tree for future joiners) stay allocated. With no
+sharing in play every refcount is 1 and the pool behaves exactly like the
+PR 7 allocator — the ``prefix_cache=off`` rollback contract.
+
 Everything here is host-side numpy — deliberately jax-free, like the
 package root: the allocator is pure bookkeeping that tests exercise without
 a device, and the engine ships its ``page_table`` array to the device as a
@@ -32,7 +42,7 @@ its single pump thread, the same discipline as the per-slot operand arrays.
 """
 from __future__ import annotations
 
-from typing import List
+from typing import Dict, List, Sequence
 
 import numpy as np
 
@@ -41,7 +51,7 @@ TRASH_PAGE = 0
 
 
 class PagePool:
-    """Fixed-size page allocator + per-slot page tables.
+    """Fixed-size page allocator + per-slot page tables + refcounts.
 
     ``num_pages`` usable pages (physical indices ``1..num_pages`` — index 0
     is the trash page), each covering ``page_size`` consecutive token
@@ -52,6 +62,11 @@ class PagePool:
     point at the trash page (they are masked out of attention by the
     ``<= position`` mask long before they could matter, because a slot's
     position never enters a page that was not assigned first).
+
+    A page is either FREE (refcount 0, on the free list) or LIVE (refcount
+    = number of slot grants + at most one prefix-cache reference holding
+    it). The invariant ``free_pages + live_pages == num_pages`` holds after
+    every operation (pinned by the prefix-cache churn property test).
     """
 
     def __init__(self, num_pages: int, page_size: int, slots: int,
@@ -87,6 +102,9 @@ class PagePool:
             range(self.trash_pages + self.num_pages - 1,
                   self.trash_pages - 1, -1))
         self._owned: List[List[int]] = [[] for _ in range(self.slots)]
+        #: per physical page: slot grants + prefix-cache references
+        self._refcounts = np.zeros(self.trash_pages + self.num_pages,
+                                   np.int32)
         self.page_table = np.full((self.slots, self.max_pages_per_slot),
                                   TRASH_PAGE, np.int32)
 
@@ -110,8 +128,42 @@ class PagePool:
     def used_pages(self) -> int:
         return self.num_pages - len(self._free)
 
+    @property
+    def live_pages(self) -> int:
+        """Pages with at least one reference (slot grant or prefix-cache
+        retention) — the complement of the free list."""
+        return int((self._refcounts > 0).sum())
+
+    def refcount(self, page: int) -> int:
+        return int(self._refcounts[page])
+
     def owned_count(self, slot: int) -> int:
         return len(self._owned[slot])
+
+    def owned_pages(self, slot: int) -> List[int]:
+        """The slot's granted pages in logical order (a copy)."""
+        return list(self._owned[slot])
+
+    def slot_ref_counts(self) -> Dict[int, int]:
+        """page -> number of slots currently holding a grant on it. A live
+        page absent from this map is held only by the prefix cache, i.e.
+        evictable the moment admission needs it."""
+        counts: Dict[int, int] = {}
+        for owned in self._owned:
+            for page in owned:
+                counts[page] = counts.get(page, 0) + 1
+        return counts
+
+    def cached_only_pages(self) -> int:
+        """Live pages held ONLY by the prefix cache (no slot grant) — the
+        evictable headroom admission can reclaim under pressure."""
+        slot_held = set()
+        for owned in self._owned:
+            slot_held.update(owned)
+        return int(sum(1 for page in range(self.trash_pages,
+                                           self.physical_pages)
+                       if self._refcounts[page] > 0
+                       and page not in slot_held))
 
     def saturation(self) -> float:
         """Fraction of the pool in use — 1.0 is the kv_pages_exhausted
@@ -120,32 +172,90 @@ class PagePool:
 
     # -- allocation --------------------------------------------------------
     def assign(self, slot: int, pages: int) -> bool:
-        """Move ``pages`` pages from the free list to ``slot`` and fill its
-        page-table row. Returns False (taking nothing) when the pool cannot
-        satisfy the request — partial grants would deadlock admission.
-        Raises on a slot that already holds pages (a free-slot invariant
-        violation, never load)."""
+        """Move ``pages`` fresh pages from the free list to ``slot`` and
+        fill its page-table row. Returns False (taking nothing) when the
+        pool cannot satisfy the request — partial grants would deadlock
+        admission. Raises on a slot that already holds pages (a free-slot
+        invariant violation, never load)."""
         if not 0 < pages <= self.max_pages_per_slot:
             raise ValueError(
                 f"pages must be in [1, {self.max_pages_per_slot}], "
                 f"got {pages}")
+        return self.assign_shared(slot, (), pages)
+
+    def assign_shared(self, slot: int, shared: Sequence[int],
+                      fresh: int) -> bool:
+        """Grant ``slot`` a run of already-live ``shared`` pages (a prefix-
+        cache hit: each gains one reference, its K/V is read-only to this
+        slot) followed by ``fresh`` pages popped from the free list (the
+        request's private suffix — the first page it will ever WRITE is
+        always private, the copy-on-write rule of docs/SERVING.md "Prefix
+        cache"). Returns False taking nothing when the free list cannot
+        cover ``fresh``; raises on invariant violations (occupied slot,
+        oversize grant, sharing a page nobody holds)."""
+        total = len(shared) + fresh
+        if not 0 < total <= self.max_pages_per_slot:
+            raise ValueError(
+                f"total pages must be in [1, {self.max_pages_per_slot}], "
+                f"got {total}")
         if self._owned[slot]:
             raise ValueError(
                 f"slot {slot} already owns {len(self._owned[slot])} pages; "
                 "release before reassigning")
-        if pages > len(self._free):
+        for page in shared:
+            if not (self.trash_pages <= page < self.physical_pages):
+                raise ValueError(f"shared page {page} is not a usable page")
+            if self._refcounts[page] < 1:
+                raise ValueError(
+                    f"shared page {page} has no live reference — sharing a "
+                    "free page would read recycled garbage")
+        if fresh > len(self._free):
             return False
-        granted = [self._free.pop() for _ in range(pages)]
-        self._owned[slot] = granted
-        self.page_table[slot, :pages] = granted
+        granted = [self._free.pop() for _ in range(fresh)]
+        for page in shared:
+            self._refcounts[page] += 1
+        for page in granted:
+            self._refcounts[page] = 1
+        row = list(shared) + granted
+        self._owned[slot] = row
+        self.page_table[slot, :len(row)] = row
         return True
 
     def release(self, slot: int) -> int:
-        """Return ``slot``'s pages to the free list and point its whole
+        """Drop ``slot``'s reference on each granted page, returning pages
+        whose refcount hits 0 to the free list, and point the whole
         page-table row back at the trash page; idempotent (releasing an
-        empty slot is a no-op returning 0)."""
+        empty slot is a no-op returning 0). Returns the NET number of pages
+        actually freed — shared pages survive their sharers, so Retry-After
+        estimates must use this, not the grant size (docs/SERVING.md)."""
         granted = self._owned[slot]
         self._owned[slot] = []
-        self._free.extend(reversed(granted))
+        freed = 0
+        for page in reversed(granted):
+            self._refcounts[page] -= 1
+            if self._refcounts[page] == 0:
+                self._free.append(page)
+                freed += 1
         self.page_table[slot, :] = TRASH_PAGE
-        return len(granted)
+        return freed
+
+    # -- prefix-cache references -------------------------------------------
+    def cache_ref(self, page: int) -> None:
+        """Add the prefix cache's retention reference to a LIVE page (the
+        tree only ever adopts pages some slot just filled)."""
+        if self._refcounts[page] < 1:
+            raise ValueError(
+                f"page {page} is free — the prefix cache can only retain "
+                "pages a slot currently holds")
+        self._refcounts[page] += 1
+
+    def cache_unref(self, page: int) -> bool:
+        """Drop the prefix cache's reference (eviction); returns True when
+        that freed the page back to the pool."""
+        if self._refcounts[page] < 1:
+            raise ValueError(f"page {page} has no reference to drop")
+        self._refcounts[page] -= 1
+        if self._refcounts[page] == 0:
+            self._free.append(page)
+            return True
+        return False

@@ -1,0 +1,221 @@
+"""Radix prefix cache: shared-prompt page reuse for the paged slot engine.
+
+At production fan-in most generate requests open with the same long system
+prompt, yet the PR 7 engine charges every admit the FULL page count and
+re-prefills the whole prompt (ROADMAP item 1 — "the single biggest
+capacity *and* latency lever left in the data plane"). This module is the
+host side of closing that gap: a radix tree over token-id prefixes maps
+"prompt prefix -> physical page run" so that
+
+* **admission charges only the unique suffix** — matched pages are granted
+  SHARED (``PagePool.assign_shared`` bumps their refcount; the joiner never
+  writes them), and only ``pages_for(prompt + max_new) - matched`` fresh
+  pages come off the free list;
+* **prefill skips straight to the first uncached position** — the engine's
+  chunked prefill executable takes the start offset as a traced operand,
+  so a 4k-token prompt whose first 4k-ε tokens are cached prefills ε
+  positions (docs/SERVING.md "Prefix cache & chunked prefill");
+* **pool pressure evicts LRU, never a referenced page** — tree nodes whose
+  page no slot holds (refcount 1 = cache-only) are reclaimed leaf-first in
+  least-recently-matched order when admission runs short.
+
+Granularity and the copy-on-write rule: the sharing unit is one FULL page
+(``page_size`` positions). K/V at position ``p`` depends only on tokens
+``0..p`` at the same positions, so a page is reusable exactly when the
+whole token prefix through its last position matches — the tree therefore
+keys each edge on a page-sized token tuple. A request whose prompt
+diverges (or merely ends) MID-page never writes the shared page: the match
+stops at the last fully-matched page boundary, the divergent page is
+realized as a freshly-allocated private page, and its positions are
+recomputed by the prefill chunk (copy-by-recompute: at most
+``page_size - 1`` positions, cheaper than a device page copy and — more
+importantly — it keeps the executable set fixed, so COW can never
+recompile). Writes to shared pages are impossible by construction, which
+is what lets refcount bookkeeping alone guarantee isolation.
+
+Readiness: a page enters the tree only after the prefill chunk covering
+its last position has been DISPATCHED. All executables chain through the
+one donated cache buffer on the single pump thread, so any later-dispatched
+reader observes the writer's output — "dispatched" is the exact safety
+line, and it lets a burst of identical prompts share pages the first
+request is still computing ticks ahead of them.
+
+Like :mod:`tensorhive_tpu.serving.paging`, this module is deliberately
+jax-free host bookkeeping: the property tests churn joins/leaves/cancels/
+evictions over it without a device. The engine serializes all calls under
+its own lock (match/insert/evict mutate LRU stamps and refcounts).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .paging import PagePool
+
+
+class _Node:
+    """One cached page: the radix-tree edge for its ``page_size``-token
+    chunk. Children key on the NEXT page's token tuple — edges are
+    page-granular, so path compression would never merge anything and the
+    'radix tree' is a trie whose edge labels are page-sized token runs."""
+
+    __slots__ = ("tokens", "page", "parent", "children", "last_used")
+
+    def __init__(self, tokens: Tuple[int, ...], page: int,
+                 parent: Optional["_Node"], last_used: int) -> None:
+        self.tokens = tokens
+        self.page = page
+        self.parent = parent
+        self.children: Dict[Tuple[int, ...], "_Node"] = {}
+        self.last_used = last_used
+
+
+class PrefixCache:
+    """Radix tree over token-id prefixes -> physical page runs.
+
+    Holds one :class:`~tensorhive_tpu.serving.paging.PagePool` reference
+    per cached page (``cache_ref``), so cached pages survive their
+    computing slot's departure and are reclaimable (``evict``) the moment
+    no slot shares them. ``min_tokens`` gates matching (a 16-token hit is
+    not worth the shared-grant bookkeeping on a 4k prompt), never
+    insertion — short prefixes still seed the tree for longer ones.
+    """
+
+    def __init__(self, pool: PagePool, min_tokens: int = 0) -> None:
+        self.pool = pool
+        self.page_size = pool.page_size
+        self.min_tokens = max(0, int(min_tokens))
+        self._root: Dict[Tuple[int, ...], _Node] = {}
+        self._nodes = 0
+        self._tick = 0          # monotonic LRU stamp (no wall clock needed)
+        self.evictions = 0      # lifetime pages evicted (the thrash signal)
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def cached_pages(self) -> int:
+        """Pages currently retained by the tree (the
+        ``tpuhive_generate_prefix_cached_pages`` gauge)."""
+        return self._nodes
+
+    def evictable_pages(self) -> int:
+        """Cached pages no slot currently shares — reclaimable headroom."""
+        return sum(1 for node in self._iter_nodes()
+                   if self.pool.refcount(node.page) == 1)
+
+    def _iter_nodes(self):
+        stack = list(self._root.values())
+        while stack:
+            node = stack.pop()
+            yield node
+            stack.extend(node.children.values())
+
+    # -- the cacheable span of a prompt ------------------------------------
+    def cacheable_tokens(self, prompt_len: int) -> int:
+        """How many leading tokens of a ``prompt_len`` prompt are ever
+        shareable: whole pages only, and never the page holding position
+        ``prompt_len - 1`` — the first decode step writes there, and a
+        shared page must never be written (the COW rule)."""
+        return ((max(0, prompt_len - 1)) // self.page_size) * self.page_size
+
+    # -- match -------------------------------------------------------------
+    def match(self, prompt: Sequence[int]) -> Tuple[int, List[int]]:
+        """Longest cached prefix of ``prompt``: ``(cached_tokens, pages)``.
+
+        ``cached_tokens`` is a multiple of ``page_size`` capped at
+        :meth:`cacheable_tokens`; ``pages`` is the physical run backing it,
+        in logical order, suitable for ``PagePool.assign_shared``. Every
+        node on the path gets an LRU touch. Matches shorter than
+        ``min_tokens`` report a miss (0, []) — the caller then pays a full
+        private prefill, exactly as if the tree were empty."""
+        limit_pages = self.cacheable_tokens(len(prompt)) // self.page_size
+        children = self._root
+        pages: List[int] = []
+        for index in range(limit_pages):
+            key = tuple(prompt[index * self.page_size:
+                               (index + 1) * self.page_size])
+            node = children.get(key)
+            if node is None:
+                break
+            self._tick += 1
+            node.last_used = self._tick
+            pages.append(node.page)
+            children = node.children
+        cached = len(pages) * self.page_size
+        if cached < self.min_tokens:
+            return 0, []
+        return cached, pages
+
+    # -- insert ------------------------------------------------------------
+    def insert(self, prompt: Sequence[int], row_pages: Sequence[int],
+               upto_tokens: int) -> int:
+        """Adopt the fully-dispatched pages of a prompt into the tree.
+
+        ``row_pages`` is the slot's page-table row (logical order);
+        ``upto_tokens`` is how far prefill has been dispatched — only pages
+        wholly inside ``min(upto_tokens, cacheable_tokens)`` are adopted.
+        Nodes already present keep their existing page (first writer wins:
+        both copies hold identical K/V, so the later one simply stays
+        private to its slot and dies with it). Returns newly-adopted page
+        count."""
+        span = min(int(upto_tokens), self.cacheable_tokens(len(prompt)))
+        children = self._root
+        parent: Optional[_Node] = None
+        adopted = 0
+        for index in range(span // self.page_size):
+            key = tuple(prompt[index * self.page_size:
+                               (index + 1) * self.page_size])
+            node = children.get(key)
+            if node is None:
+                page = int(row_pages[index])
+                self.pool.cache_ref(page)
+                self._tick += 1
+                node = _Node(key, page, parent, self._tick)
+                children[key] = node
+                self._nodes += 1
+                adopted += 1
+            else:
+                self._tick += 1
+                node.last_used = self._tick
+            parent = node
+            children = node.children
+        return adopted
+
+    # -- eviction ----------------------------------------------------------
+    def evict(self, need_pages: int) -> int:
+        """Free up to ``need_pages`` pages by dropping LRU cache-only
+        leaves (refcount 1: no slot shares them — a referenced page is
+        never evicted, pinned by the churn property test). Evicting a leaf
+        can expose its parent as the next candidate, so long dead branches
+        unwind fully. Returns pages actually freed."""
+        freed = 0
+        while freed < need_pages:
+            victim: Optional[_Node] = None
+            for node in self._iter_nodes():
+                if node.children:
+                    continue                      # interior: children first
+                if self.pool.refcount(node.page) != 1:
+                    continue                      # a slot still shares it
+                if victim is None or node.last_used < victim.last_used:
+                    victim = node
+            if victim is None:
+                break
+            self._detach(victim)
+            if self.pool.cache_unref(victim.page):
+                freed += 1
+            self.evictions += 1
+        return freed
+
+    def _detach(self, node: _Node) -> None:
+        siblings = (node.parent.children if node.parent is not None
+                    else self._root)
+        siblings.pop(node.tokens, None)
+        self._nodes -= 1
+
+    def clear(self) -> int:
+        """Drop every cached page (engine teardown); returns pages freed."""
+        freed = 0
+        for node in list(self._iter_nodes()):
+            if self.pool.cache_unref(node.page):
+                freed += 1
+        self._root = {}
+        self._nodes = 0
+        return freed

@@ -200,6 +200,17 @@ def test_requests_strip_renders_ledger_fields():
     assert "req.prefillCompile" in source
 
 
+def test_serving_strip_renders_prefix_cache_badge():
+    """The prefix-cache badge (docs/SERVING.md "Prefix cache & chunked
+    prefill") must render from the exact ``prefixCache``/``prefixHitRate``/
+    ``cachedPages`` fields ``GET /generate/stats`` exports, and hide when
+    the cache is off (the PR 7-10 rollback serves no prefix stats)."""
+    source = (STATIC_DIR / "js" / "nodes.js").read_text()
+    assert 'stats.prefixCache !== "on"' in source   # hidden on rollback
+    assert "stats.prefixHitRate" in source
+    assert "stats.cachedPages" in source
+
+
 def test_serving_strip_renders_mesh_badge():
     """The multi-chip badge (docs/SERVING.md "Multi-chip serving") must
     render from the exact ``meshShape``/``numDevices`` fields

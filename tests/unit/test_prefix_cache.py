@@ -1,0 +1,434 @@
+"""Prefix-cache tests: refcounted page sharing must be INVISIBLE to
+outputs and LEAK-FREE under churn.
+
+Two halves, like test_paging.py:
+
+* **Host bookkeeping** (no device): the refcounted PagePool + radix
+  PrefixCache under a seeded random churn of joins/leaves/cancels over
+  shared, divergent and identical prompts — refcounts never leak
+  (``free + live == pool size`` after every step), eviction never frees a
+  page any slot references, and matches always return page runs consistent
+  with the tokens that built them.
+* **Engine exactness**: hit-path, COW mid-page divergence, chunked
+  prefill, cancel-mid-chunk and slot reuse after eviction all pinned
+  f32-exact against ``decode.generate`` — sharing is an allocation detail,
+  never a behavior. Plus the zero-recompile contract across hits/misses/
+  chunks, the net-releasable Retry-After, stats/metrics and the rollback.
+"""
+import dataclasses
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorhive_tpu.models import decode
+from tensorhive_tpu.models.transformer import PRESETS, TransformerLM
+from tensorhive_tpu.serving import set_engine
+from tensorhive_tpu.serving.engine import SlotEngine
+from tensorhive_tpu.serving.paging import TRASH_PAGE, PagePool
+from tensorhive_tpu.serving.prefix_cache import PrefixCache
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device CPU platform"
+)
+
+F32_TINY = dataclasses.replace(PRESETS["tiny"], dtype=jnp.float32,
+                               use_flash=False, remat=False, max_seq_len=128)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return TransformerLM.init(jax.random.PRNGKey(0), F32_TINY)
+
+
+def make_engine(params, **kwargs):
+    kwargs.setdefault("slots", 4)
+    kwargs.setdefault("max_len", 96)
+    kwargs.setdefault("queue_depth", 16)
+    return SlotEngine(params, F32_TINY, **kwargs)
+
+
+def drain(engine):
+    while engine.has_work():
+        engine.step()
+
+
+def reference_tokens(params, prompt, new_tokens):
+    out = decode.generate(params, F32_TINY,
+                          jnp.asarray([prompt], jnp.int32),
+                          max_new_tokens=new_tokens, temperature=0.0)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+# -- host-side bookkeeping ---------------------------------------------------
+
+def test_refcounted_assign_shared_and_release():
+    pool = PagePool(num_pages=8, page_size=4, slots=3, max_pages_per_slot=4)
+    assert pool.assign(0, 3)
+    run = pool.owned_pages(0)
+    # slot 1 shares the first two pages, adds one private
+    assert pool.assign_shared(1, run[:2], 1)
+    assert pool.refcount(run[0]) == 2 and pool.refcount(run[1]) == 2
+    assert pool.free_pages == 8 - 4
+    # slot 0 leaves: only its private third page frees (net-releasable 1)
+    assert pool.release(0) == 1
+    assert pool.refcount(run[0]) == 1     # slot 1 still holds them
+    assert pool.free_pages == 5
+    assert all(page == TRASH_PAGE for page in pool.page_table[0])
+    # slot 1 leaves: everything frees
+    assert pool.release(1) == 3
+    assert pool.free_pages == 8
+    assert pool.live_pages == 0
+
+
+def test_sharing_a_free_page_is_an_invariant_violation():
+    pool = PagePool(num_pages=4, page_size=4, slots=2, max_pages_per_slot=2)
+    with pytest.raises(ValueError):
+        pool.assign_shared(0, [1], 1)     # page 1 is free, nobody holds it
+    assert pool.assign(0, 1)
+    page = pool.owned_pages(0)[0]
+    pool.cache_ref(page)
+    assert pool.release(0) == 0           # cache retention keeps it live
+    assert pool.cache_unref(page) is True
+    assert pool.free_pages == 4
+
+
+def test_match_insert_cow_boundary():
+    """Matches are whole pages only and never include the page holding the
+    prompt's last position — the COW rule: the first page a request writes
+    is always private."""
+    pool = PagePool(num_pages=8, page_size=4, slots=2, max_pages_per_slot=4)
+    cache = PrefixCache(pool, min_tokens=0)
+    prompt = list(range(10, 23))          # 13 tokens, target 12 -> 3 pages
+    assert cache.cacheable_tokens(len(prompt)) == 12
+    assert pool.assign(0, 4)
+    row = pool.owned_pages(0)
+    assert cache.insert(prompt, row, upto_tokens=12) == 3
+    assert cache.cached_pages == 3
+    # identical prompt: full cacheable match
+    cached, pages = cache.match(prompt)
+    assert cached == 12 and pages == row[:3]
+    # divergence MID page 2 (position 6): only page 0 matches
+    divergent = prompt[:6] + [99] * 7
+    cached, pages = cache.match(divergent)
+    assert cached == 4 and pages == row[:1]
+    # page-aligned prompt: the page holding the last position is excluded
+    aligned = prompt[:8]                  # target 7 -> one full page only
+    cached, pages = cache.match(aligned)
+    assert cached == 4 and pages == row[:1]
+    # min_tokens gates matching, never insertion
+    fussy = PrefixCache(pool, min_tokens=8)
+    assert fussy.match(prompt[:6] + [99] * 7) == (0, [])
+
+
+def test_eviction_is_lru_leaf_only_and_never_referenced():
+    pool = PagePool(num_pages=8, page_size=4, slots=2, max_pages_per_slot=4)
+    cache = PrefixCache(pool, min_tokens=0)
+    old = [1, 2, 3, 4, 5, 6, 7, 8, 9]     # 2 cacheable pages
+    new = [9, 8, 7, 6, 5, 4, 3, 2, 1]
+    assert pool.assign(0, 3)
+    cache.insert(old, pool.owned_pages(0), upto_tokens=8)
+    slot0_pages = pool.owned_pages(0)
+    assert pool.release(0) == 1            # 2 stay cached
+    assert pool.assign(0, 3)
+    cache.insert(new, pool.owned_pages(0), upto_tokens=8)
+    cache.match(new)                       # LRU: new is fresher than old
+    assert pool.release(0) == 1
+    assert cache.cached_pages == 4 and pool.free_pages == 4
+    # slot 1 shares OLD's prefix: those pages become unevictable
+    cached, pages = cache.match(old)
+    assert cached == 8
+    assert pool.assign_shared(1, pages, 1)
+    freed = cache.evict(10)                # ask for more than reclaimable
+    # only NEW's two pages could go (old's are slot-referenced)
+    assert freed == 2
+    assert cache.cached_pages == 2
+    assert all(pool.refcount(page) == 2 for page in pages)
+    assert cache.match(new) == (0, [])     # evicted
+    assert cache.match(old)[0] == 8        # retained
+    assert pool.free_pages + pool.live_pages == pool.num_pages
+
+
+def test_seeded_churn_never_leaks_and_never_frees_referenced():
+    """The satellite property test: a seeded random storm of joins (shared
+    / divergent / identical prompts), leaves, cancels (a cancel IS a leave
+    at this layer — pages return refcounted either way) and pressure
+    evictions. After EVERY step: free + live == pool size, every
+    slot-referenced page is live, and eviction never freed a page a slot
+    still references."""
+    rng = random.Random(1234)
+    page_size = 4
+    pool = PagePool(num_pages=24, page_size=page_size, slots=6,
+                    max_pages_per_slot=6)
+    cache = PrefixCache(pool, min_tokens=0)
+    base = [rng.randrange(1, 50) for _ in range(20)]
+
+    def prompt_for(kind):
+        # max_pages_per_slot is 6 and every join asks pages_for(len + 4),
+        # so prompts stay <= 20 tokens
+        if kind == "identical":
+            return list(base)
+        if kind == "shared":                      # shared head, own tail
+            cut = rng.choice((4, 8, 12, 16))
+            return base[:cut] + [rng.randrange(50, 99)
+                                 for _ in range(rng.randrange(1, 21 - cut))]
+        return [rng.randrange(100, 199)           # fully divergent
+                for _ in range(rng.randrange(2, 21))]
+
+    slots = {}
+
+    def audit():
+        assert pool.free_pages + pool.live_pages == pool.num_pages
+        for slot, (prompt, pages) in slots.items():
+            assert pool.owned_pages(slot) == pages
+            for page in pages:
+                assert pool.refcount(page) >= 1, "freed while referenced"
+        # cached pages are live by definition
+        assert cache.cached_pages == sum(
+            1 for node in cache._iter_nodes())
+        free_set = set(pool._free)
+        for node in cache._iter_nodes():
+            assert node.page not in free_set, "cached page on the free list"
+
+    for step in range(400):
+        action = rng.random()
+        free_slots = [s for s in range(pool.slots) if s not in slots]
+        if action < 0.55 and free_slots:
+            slot = rng.choice(free_slots)
+            prompt = prompt_for(rng.choice(("identical", "shared",
+                                            "divergent")))
+            needed = pool.pages_for(len(prompt) + 4)
+            cached, shared = cache.match(prompt)
+            fresh = needed - len(shared)
+            shortfall = fresh - pool.free_pages
+            if shortfall > 0:
+                cache.evict(shortfall)
+            if pool.assign_shared(slot, shared, fresh):
+                slots[slot] = (prompt, pool.owned_pages(slot))
+                # prefill "dispatches" immediately at this layer
+                cache.insert(prompt, pool.owned_pages(slot),
+                             cache.cacheable_tokens(len(prompt)))
+        elif slots:
+            slot = rng.choice(sorted(slots))      # leave OR cancel
+            del slots[slot]
+            pool.release(slot)
+        if rng.random() < 0.1:
+            cache.evict(rng.randrange(1, 4))
+        audit()
+
+    # full teardown drains everything back
+    for slot in sorted(slots):
+        pool.release(slot)
+    cache.clear()
+    assert pool.free_pages == pool.num_pages
+    assert pool.live_pages == 0
+
+
+# -- engine exactness --------------------------------------------------------
+
+SYSTEM_PROMPT = [(13 * j) % F32_TINY.vocab_size or 1 for j in range(48)]
+
+
+def test_hit_path_and_chunked_prefill_match_generate(params):
+    """The acceptance tri-equality: warm the cache with one request, then
+    shared-prefix requests (full hits AND suffix hits), chunked prefill
+    (chunk far smaller than the prompt) and a mid-page divergence all emit
+    tokens IDENTICAL to ``decode.generate`` — f32 greedy, exact."""
+    engine = make_engine(params, prefill_chunk_tokens=16)
+    warm = engine.submit(SYSTEM_PROMPT + [3, 4], max_new_tokens=4)
+    drain(engine)
+    assert (warm.result(timeout_s=5)["tokens"]
+            == reference_tokens(params, SYSTEM_PROMPT + [3, 4], 4))
+    assert engine.stats()["cachedPages"] == 3      # 48 tokens / 16
+
+    followers = [SYSTEM_PROMPT + [10 + i] for i in range(3)]   # suffix hits
+    followers.append(SYSTEM_PROMPT[:20] + [7, 9, 11, 2])       # COW mid-page
+    followers.append(SYSTEM_PROMPT + list(range(30, 45)))      # hit + chunks
+    handles = [engine.submit(prompt, max_new_tokens=5)
+               for prompt in followers]
+    drain(engine)
+    for prompt, handle in zip(followers, handles):
+        assert (handle.result(timeout_s=5)["tokens"]
+                == reference_tokens(params, prompt, 5))
+    stats = engine.stats()
+    assert stats["prefixHits"] >= 4
+    assert stats["prefixHitRate"] is not None and stats["prefixHitRate"] > 0
+
+
+def test_interleaved_chunked_prefill_does_not_disturb_decode(params):
+    """A long prompt chunk-prefilling must not change a running request's
+    tokens (cross-slot isolation through the masked step table), and the
+    running batch keeps emitting a token EVERY tick while chunks land."""
+    engine = make_engine(params, prefill_chunk_tokens=8)
+    runner = engine.submit([5, 6, 7], max_new_tokens=20)
+    engine.step()
+    long_prompt = [(7 * j) % F32_TINY.vocab_size or 2 for j in range(80)]
+    joiner = engine.submit(long_prompt, max_new_tokens=3)
+    before = len(runner._request.generated)
+    for _ in range(5):                    # 5 ticks of chunking
+        engine.step()
+    # decode never stalled: one token per tick regardless of the chunking
+    assert len(runner._request.generated) == before + 5
+    drain(engine)
+    assert (runner.result(timeout_s=5)["tokens"]
+            == reference_tokens(params, [5, 6, 7], 20))
+    assert (joiner.result(timeout_s=5)["tokens"]
+            == reference_tokens(params, long_prompt, 3))
+    from tensorhive_tpu.observability import get_request_ledger
+
+    row = [r for r in get_request_ledger().recent()
+           if r["requestId"] == joiner.request_id][0]
+    assert row["prefillChunks"] == 10     # ceil(79 / 8)
+    assert row["cachedTokens"] == 0
+
+
+def test_slot_reuse_after_eviction_is_exact(params):
+    """Pages evicted from the tree and reissued to a new request must
+    decode exactly like a fresh engine — eviction is just release."""
+    engine = make_engine(params, slots=2, kv_pages=6, page_size=16,
+                         prefill_chunk_tokens=0)
+    first = [(3 * j) % F32_TINY.vocab_size or 1 for j in range(40)]
+    second = [(5 * j) % F32_TINY.vocab_size or 1 for j in range(40)]
+    third = [(11 * j) % F32_TINY.vocab_size or 1 for j in range(40)]
+    for prompt in (first, second, third):   # 3 pages each; pool of 8 must
+        handle = engine.submit(prompt, max_new_tokens=4)   # evict to admit
+        drain(engine)
+        assert (handle.result(timeout_s=5)["tokens"]
+                == reference_tokens(params, prompt, 4))
+    assert engine._prefix.evictions > 0
+    # and the evicted prefix readmits cleanly as a miss
+    again = engine.submit(first, max_new_tokens=4)
+    drain(engine)
+    assert (again.result(timeout_s=5)["tokens"]
+            == reference_tokens(params, first, 4))
+    pool = engine._pool
+    assert pool.free_pages + pool.live_pages == pool.num_pages
+
+
+def test_cancel_mid_chunk_frees_and_reuses_cleanly(params):
+    engine = make_engine(params, slots=1, prefill_chunk_tokens=16)
+    long_prompt = [(7 * j) % F32_TINY.vocab_size or 2 for j in range(80)]
+    cancelled = engine.submit(long_prompt, max_new_tokens=4)
+    engine.step()                          # chunk 1 of 5 dispatched
+    cancelled.cancel()
+    engine.step()
+    assert cancelled.result(timeout_s=5)["outcome"] == "cancelled"
+    assert engine.stats()["slotsBusy"] == 0
+    stats = engine.stats()
+    assert (stats["kvPagesFree"] + stats["cachedPages"]
+            == stats["kvPagesTotal"])
+    follow_up = engine.submit(long_prompt, max_new_tokens=4)
+    drain(engine)
+    assert (follow_up.result(timeout_s=5)["tokens"]
+            == reference_tokens(params, long_prompt, 4))
+
+
+def test_zero_recompiles_across_hits_misses_and_chunks(params):
+    """Hits (start offset varies), misses, chunk boundaries and COW
+    divergences are all traced-operand changes: after a warmup covering
+    the chunk widths, the jit cache must not grow."""
+    engine = make_engine(params, prefill_chunk_tokens=16)
+    engine.warmup(prompt_lens=(50, 80, 8))
+    step_execs = engine.step_executable._cache_size()
+    prefill_execs = engine.prefill_executable._cache_size()
+    prompts = [SYSTEM_PROMPT + [3, 4],
+               SYSTEM_PROMPT + [9],                      # full hit
+               SYSTEM_PROMPT + list(range(20, 50)),      # hit + chunks
+               SYSTEM_PROMPT[:20] + [7] * 10,            # COW divergence
+               [(7 * j) % F32_TINY.vocab_size or 2 for j in range(80)],
+               [5]]                                      # no prefill at all
+    handles = []
+    for prompt in prompts:
+        handles.append(engine.submit(prompt, max_new_tokens=4))
+        engine.step()
+    drain(engine)
+    assert all(h.result(timeout_s=5)["outcome"] == "completed"
+               for h in handles)
+    assert engine.step_executable._cache_size() == step_execs
+    assert engine.prefill_executable._cache_size() == prefill_execs
+
+
+def test_retry_after_counts_net_releasable_pages(params):
+    """Two slots sharing a prefix: the first completion frees only its
+    PRIVATE pages (the shared run survives in its sharer + the tree), so a
+    big ask must quote the LATER completion's ETA — over-promising on
+    shared pages is the satellite bug this pins."""
+    engine = make_engine(params, slots=2, kv_pages=8, page_size=16,
+                         queue_depth=2)
+    shared = SYSTEM_PROMPT[:32]
+    short = engine.submit(shared + [1], max_new_tokens=4)    # 2 shared+1
+    engine.step()                          # joins + inserts 2 shared pages
+    long = engine.submit(shared + [2], max_new_tokens=16)    # shares them
+    engine.step()
+    # short: 2 of 4 tokens left; long: 15 of 16. free = 8 - 3 - 2 = 3,
+    # the 2 shared pages slot-referenced twice each (plus the tree)
+    for _ in range(3):
+        engine._intertoken_hist.observe(2.0)
+    with engine._lock:
+        # 4-page ask: short's completion nets ONE page (its private page;
+        # the shared run survives in long + the tree) on top of 3 free
+        eta_small = engine._retry_after_locked(needed_pages=4)
+        # 6-page ask: only long's completion releases the shared pages —
+        # counting short's grant size (3) instead of its net release (1)
+        # would have over-promised the earlier ETA here
+        eta_large = engine._retry_after_locked(needed_pages=6)
+    assert eta_large > eta_small
+    del short, long
+    drain(engine)
+
+
+def test_stats_metrics_and_rollback(params, config):
+    from tensorhive_tpu.observability import get_registry
+    from tensorhive_tpu.observability.alerts import default_rule_pack
+
+    engine = make_engine(params, prefill_chunk_tokens=16)
+    warm = engine.submit(SYSTEM_PROMPT + [3], max_new_tokens=2)
+    drain(engine)
+    assert warm.result(timeout_s=5)["outcome"] == "completed"
+    hit = engine.submit(SYSTEM_PROMPT + [4], max_new_tokens=2)
+    drain(engine)
+    assert hit.result(timeout_s=5)["outcome"] == "completed"
+    stats = engine.stats()
+    assert stats["prefixCache"] == "on"
+    assert stats["prefixHits"] == 1 and stats["prefixMisses"] == 1
+    assert stats["prefixHitRate"] == pytest.approx(0.5)
+    assert stats["cachedPages"] == 3
+    assert stats["prefillChunkTokens"] == 16
+    rendered = get_registry().render()
+    assert "tpuhive_generate_prefix_hits_total" in rendered
+    assert "tpuhive_generate_prefix_misses_total" in rendered
+    assert "tpuhive_generate_prefix_cached_pages 3" in rendered
+    assert "tpuhive_generate_prefill_chunks_bucket" in rendered
+    # a cache-full pool is NOT exhaustion: cached-only pages are evictable
+    assert engine.kv_page_saturation() == 0.0
+
+    rules = {rule.name: rule for rule in default_rule_pack()}
+    assert "prefix_cache_thrash" in rules
+    assert rules["prefix_cache_thrash"].metric == (
+        "tpuhive_generate_prefix_evictions_total")
+
+    # ledger rows carry the new fields
+    from tensorhive_tpu.observability import get_request_ledger
+    row = [r for r in get_request_ledger().recent()
+           if r["requestId"] == hit.request_id][0]
+    assert row["cachedTokens"] == 48
+    assert row["prefillChunks"] == 0       # full-prefix hit
+
+    # rollback: prefix_cache=off is the PR 7-10 engine — legacy prefill
+    # executable, legacy fingerprints, no prefix stats
+    rollback = make_engine(params, prefix_cache="off")
+    assert rollback.prefill_executable.__wrapped__.__name__ == (
+        "_paged_prefill_body")
+    stats = rollback.stats()
+    assert stats["prefixCache"] == "off"
+    assert stats["cachedPages"] is None
+    assert stats["prefillChunkTokens"] is None
+    handle = rollback.submit(SYSTEM_PROMPT + [3], max_new_tokens=2)
+    drain(rollback)
+    assert (handle.result(timeout_s=5)["tokens"]
+            == reference_tokens(params, SYSTEM_PROMPT + [3], 2))
+    with pytest.raises(ValueError, match="prefix_cache"):
+        make_engine(params, paged=False, prefix_cache="on")
+    set_engine(None)

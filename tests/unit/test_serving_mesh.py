@@ -217,7 +217,10 @@ def test_zero_recompiles_with_reuse_and_recycling(params, dp, tp):
     outcomes = [handle.result(timeout_s=5)["outcome"] for handle in handles]
     assert outcomes.count("completed") == 5
     assert outcomes[3] == "cancelled"
-    assert engine.stats()["kvPagesFree"] == engine.stats()["kvPagesTotal"]
+    # pages drained back: on the free list, or retained by the prefix
+    # cache for future shared-prefix joiners — nothing leaked either way
+    stats = engine.stats()
+    assert stats["kvPagesFree"] + stats["cachedPages"] == stats["kvPagesTotal"]
     assert engine.step_executable._cache_size() == step_execs
     assert engine.prefill_executable._cache_size() == prefill_execs
 

@@ -85,8 +85,15 @@ def main() -> int:
     failures = []
     config = PRESETS["tiny"]
     params = TransformerLM.init(jax.random.PRNGKey(0), config)
+    # prefix_cache off HERE on purpose: the serial phase runs the same
+    # prompts the batched storm replays, and cache hits would inflate the
+    # batched-vs-serial ratio into a caching number — tools/prefix_smoke.py
+    # is the gate for the prefix-cache story (scenario 5 below keeps the
+    # default-on path, exercising tree retention + eviction under the
+    # kernel dispatch with distinct prompts)
     engine = SlotEngine(params, config, slots=SLOTS, max_len=MAX_LEN,
-                        queue_depth=SLOTS, max_new_tokens_cap=64)
+                        queue_depth=SLOTS, max_new_tokens_cap=64,
+                        prefix_cache="off")
     engine.warmup(prompt_lens=PROMPT_LENS)
 
     def prompts():
