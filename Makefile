@@ -2,7 +2,7 @@
 # mypy + flake8 per .circleci/config.yml:33-38): the dependency-free AST
 # lint + thivelint analyzer always run; mypy/ruff run when installed
 # (absent from this image).
-.PHONY: check lint analysis test bench probe metrics-smoke decode-smoke alerts-smoke chaos-smoke serving-smoke serving-mesh-smoke trace-smoke prefix-smoke
+.PHONY: check lint analysis analysis-fast test bench probe metrics-smoke decode-smoke alerts-smoke chaos-smoke serving-smoke serving-mesh-smoke trace-smoke prefix-smoke
 
 check: lint analysis
 	@command -v ruff >/dev/null 2>&1 && ruff check . || echo "ruff not installed; skipped (tools/lint.py covered the always-on subset)"
@@ -12,10 +12,19 @@ lint:
 	python tools/lint.py
 
 # the multi-pass static analyzer (docs/STATIC_ANALYSIS.md): lock discipline,
-# exception hygiene, blocking calls, JAX host-sync — `lint` is an alias that
-# runs the same passes; this target exists for the pinned CI gate order
+# exception hygiene, blocking calls, JAX host-sync, plus the flow-aware
+# families (TH-JIT recompile hazards, TH-DON donation discipline, TH-REF
+# refcount pairing) and the TH-X cross-artifact contract pass — `lint` is
+# an alias that runs the same passes; this target exists for the pinned CI
+# gate order
 analysis:
 	python -m tools.analysis
+
+# pre-commit speed: analyze only files changed vs HEAD (staged + unstaged +
+# untracked). Cross-artifact contracts (TH-X) still run — a docs drift must
+# not slip through a code-only diff. The full walk stays the CI gate.
+analysis-fast:
+	python -m tools.analysis --changed-only
 
 test:
 	python -m pytest tests/ -q

@@ -1333,7 +1333,7 @@ class SlotEngine:
                 self._finish_locked(request, outcome="cancelled")
             else:
                 kept.append(request)
-        self._pending = kept  # thive: disable=TH-C — caller holds the lock (_locked suffix)
+        self._pending = kept
 
     def _join(self, slot: int, request: _Request) -> None:
         """Prefill the prompt head into the slot row and arm the per-slot
@@ -1544,16 +1544,16 @@ class SlotEngine:
             self._finish_locked(request, outcome="completed")
 
     def _free_slot_locked(self, index: int) -> None:
-        self._slots[index] = None  # thive: disable=TH-C — caller holds the lock (_locked suffix)
-        self._active[index] = False  # thive: disable=TH-C — caller holds the lock (_locked suffix)
+        self._slots[index] = None
+        self._active[index] = False
         if self.paged:
             # the pages go back to the pool NOW (they may be reassigned on
             # the very next _admit), so the parked slot must stop writing
             # through them: release() points the whole page-table row at
             # the trash page and the position resets to 0 — parked writes
             # land at (trash, 0) forever, never on a recycled page
-            self._pool.release(index)  # thive: disable=TH-C — caller holds the lock (_locked suffix)
-            self._positions[index] = 0  # thive: disable=TH-C — caller holds the lock (_locked suffix)
+            self._pool.release(index)
+            self._positions[index] = 0
             _KV_PAGES_FREE.set(self._pool.free_pages)
             _SLOT_PAGES.labels(slot=str(index)).set(0)
         # (contiguous) position stays frozen: the parked slot's masked
@@ -1571,9 +1571,9 @@ class SlotEngine:
         if request.user_key:
             remaining = self._user_active.get(request.user_key, 1) - 1
             if remaining <= 0:
-                self._user_active.pop(request.user_key, None)  # thive: disable=TH-C — caller holds the lock (_locked suffix)
+                self._user_active.pop(request.user_key, None)
             else:
-                self._user_active[request.user_key] = remaining  # thive: disable=TH-C — caller holds the lock (_locked suffix)
+                self._user_active[request.user_key] = remaining
         record = request.record
         if record is not None:
             if (request.first_token_ts is not None

@@ -423,8 +423,12 @@ class TestBaseline:
 
     @staticmethod
     def _run(target, baseline):
+        # --select scopes to the module family under test: project rules
+        # (TH-X) run against the real repo regardless of the path list,
+        # and their findings are waived by the CHECKED-IN baseline, not
+        # the fixture baseline this test injects
         argv = [sys.executable, "-m", "tools.analysis", "--format=json",
-                str(target)]
+                "--select=TH-E", str(target)]
         if baseline is not None:
             argv += ["--baseline", str(baseline)]
         else:
@@ -448,6 +452,680 @@ class TestBaseline:
         baseline = Baseline([waiver_for(finding, reason="justified")])
         assert baseline.waives(finding)
         assert baseline.unused() == []
+
+
+# -- TH-JIT: recompile hazards (flow-aware) -----------------------------------
+
+class TestJitRecompile:
+    def test_loop_varying_static_arg_flagged(self):
+        findings = findings_for("""
+            import functools
+            import jax
+
+            def _step(x, width):
+                return x * width
+
+            step = functools.partial(
+                jax.jit, static_argnames=("width",))(_step)
+
+            def serve(requests):
+                out = []
+                for request in requests:
+                    width = len(request)
+                    out.append(step(request, width))
+                return out
+            """, relpath=MODEL, rule="TH-JIT")
+        assert len(findings) == 1
+        assert "static position 'width'" in findings[0].message
+        assert "recompile" in findings[0].message
+
+    def test_constant_static_arg_in_loop_not_flagged(self):
+        # false-positive guard: a module constant (or loop-invariant name)
+        # in static position compiles once, exactly as intended
+        findings = findings_for("""
+            import jax
+
+            def _step(x, width):
+                return x * width
+
+            step = jax.jit(_step, static_argnames=("width",))
+            WIDTH = 16
+
+            def serve(requests):
+                out = []
+                for request in requests:
+                    out.append(step(request, WIDTH))
+                return out
+            """, relpath=MODEL, rule="TH-JIT")
+        assert findings == []
+
+    def test_host_branch_on_traced_param_flagged(self):
+        findings = findings_for("""
+            import jax
+
+            @jax.jit
+            def step(x, flag):
+                if flag:
+                    return x * 2
+                return x
+            """, relpath=MODEL, rule="TH-JIT")
+        assert len(findings) == 1
+        assert "traced parameter 'flag'" in findings[0].message
+
+    def test_static_none_and_shape_branches_not_flagged(self):
+        # false-positive guards: branching on a STATIC param, an
+        # `is None` identity test, and `.shape` access are all
+        # trace-time facts — the executable set stays fixed
+        findings = findings_for("""
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("flag",))
+            def step(x, flag, top_k):
+                if flag and top_k is not None:
+                    return x * 2
+                if x.shape[0] == 1:
+                    return x + 1
+                return x
+            """, relpath=MODEL, rule="TH-JIT")
+        assert findings == []
+
+    def test_serving_dispatch_without_fingerprint_seam_flagged(self):
+        findings = findings_for("""
+            import jax
+
+            def _body(x):
+                return x
+
+            step = jax.jit(_body)
+
+            def dispatch(x):
+                return step(x)
+            """, relpath="tensorhive_tpu/serving/fixture.py", rule="TH-JIT")
+        assert len(findings) == 1
+        assert "_count_compile" in findings[0].message
+
+    def test_serving_dispatch_with_seam_not_flagged(self):
+        findings = findings_for("""
+            import jax
+
+            def _body(x):
+                return x
+
+            step = jax.jit(_body)
+
+            def _count_compile(fn, key):
+                return "hit"
+
+            def dispatch(x):
+                _count_compile("step", ("step",))
+                return step(x)
+            """, relpath="tensorhive_tpu/serving/fixture.py", rule="TH-JIT")
+        assert findings == []
+
+
+# -- TH-DON: donation discipline ----------------------------------------------
+
+class TestDonation:
+    def test_donated_param_missing_from_return_path_flagged(self):
+        findings = findings_for("""
+            import functools
+            import jax
+
+            def _body(params, tokens, cache):
+                k = cache.k
+                if tokens is None:
+                    return params
+                return tokens, k
+
+            run = functools.partial(
+                jax.jit, donate_argnames=("cache",))(_body)
+            """, relpath=MODEL, rule="TH-DON")
+        assert len(findings) == 1
+        assert "does not flow into this return" in findings[0].message
+        # the compliant return (tokens, k) is NOT flagged: k is tainted
+        # through `k = cache.k`
+        assert findings[0].line == 8
+
+    def test_whole_carry_return_not_flagged(self):
+        # false-positive guard: PR 3's prescribed shape — every return
+        # carries the donated value (directly or derived)
+        findings = findings_for("""
+            import jax
+
+            def _body(tokens, cache):
+                cache_k = cache.k
+                updated = cache_k + 1
+                return tokens, updated
+
+            run = jax.jit(_body, donate_argnames=("cache",))
+            """, relpath=MODEL, rule="TH-DON")
+        assert findings == []
+
+    def test_use_after_donate_flagged(self):
+        findings = findings_for("""
+            import jax
+
+            def _body(x, cache):
+                return x, cache
+
+            run = jax.jit(_body, donate_argnames=("cache",))
+
+            def drive(x, cache):
+                out, _ = run(x, cache)
+                return out, cache.k
+            """, relpath=MODEL, rule="TH-DON")
+        assert len(findings) == 1
+        assert "read after being passed in donated position" in \
+            findings[0].message
+
+    def test_rebound_result_and_return_dispatch_not_flagged(self):
+        # false-positive guards: the canonical rebind-over-the-operand
+        # idiom, and a `return wrapper(...)` dispatch (nothing after it
+        # is reachable)
+        findings = findings_for("""
+            import jax
+
+            def _body(x, cache):
+                return x, cache
+
+            run = jax.jit(_body, donate_argnames=("cache",))
+
+            def drive(x, cache):
+                out, cache = run(x, cache)
+                return out, cache.k
+
+            def drive_tail(x, cache):
+                return run(x, cache)
+            """, relpath=MODEL, rule="TH-DON")
+        assert findings == []
+
+
+# -- TH-REF: refcount pairing + the _locked convention ------------------------
+
+class TestRefcountPairing:
+    def test_unpaired_acquire_flagged(self):
+        findings = findings_for("""
+            class Engine:
+                def admit(self, slot, pages):
+                    self.pool.assign(slot, pages)
+            """, rule="TH-REF")
+        assert len(findings) == 1
+        assert "never calls self.pool.release()" in findings[0].message
+
+    def test_paired_acquire_and_resource_class_not_flagged(self):
+        # false-positive guards: a class pairing grant with release, and
+        # the resource's own implementation (defines release itself)
+        findings = findings_for("""
+            class Engine:
+                def admit(self, slot, pages):
+                    self.pool.assign_shared(slot, (), pages)
+
+                def leave(self, slot):
+                    self.pool.release(slot)
+
+            class PagePool:
+                def assign(self, slot, pages):
+                    return self.assign_shared(slot, (), pages)
+
+                def assign_shared(self, slot, shared, fresh):
+                    return True
+
+                def release(self, slot):
+                    return 0
+            """, rule="TH-REF")
+        assert findings == []
+
+    def test_early_return_between_acquire_and_release_flagged(self):
+        findings = findings_for("""
+            def grant(pool, slot, pages, bad):
+                pool.assign(slot, pages)
+                if bad:
+                    return None
+                pool.release(slot)
+            """, rule="TH-REF")
+        assert len(findings) == 1
+        assert "early return" in findings[0].message
+
+    def test_release_in_finally_not_flagged(self):
+        # false-positive guard: finally runs on every return path
+        findings = findings_for("""
+            def grant(pool, slot, pages, bad):
+                pool.assign(slot, pages)
+                try:
+                    if bad:
+                        return None
+                    return pool.page_table
+                finally:
+                    pool.release(slot)
+            """, rule="TH-REF")
+        assert findings == []
+
+    def test_swallowed_exception_leak_flagged(self):
+        findings = findings_for("""
+            def grant(pool, slot, pages):
+                try:
+                    pool.cache_ref(pages[0])
+                    record(pages)
+                except Exception:
+                    log.exception("grant failed")
+                    return None
+                pool.cache_unref(pages[0])
+            """, rule="TH-REF")
+        assert len(findings) == 1
+        assert "exception path leaks" in findings[0].message
+
+    def test_locked_method_acquiring_own_lock_flagged(self):
+        findings = findings_for("""
+            import threading
+
+            class Engine:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def _free_locked(self, slot):
+                    with self._lock:
+                        self.busy = slot
+            """, rule="TH-REF")
+        assert len(findings) == 1
+        assert "deadlock" in findings[0].message
+
+    def test_locked_call_without_lock_flagged_under_lock_not(self):
+        findings = findings_for("""
+            import threading
+
+            class Engine:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def _free_locked(self, slot):
+                    self.busy = slot
+
+                def bad(self, slot):
+                    self._free_locked(slot)
+
+                def good(self, slot):
+                    with self._lock:
+                        self._free_locked(slot)
+
+                def _chain_locked(self, slot):
+                    self._free_locked(slot)
+            """, rule="TH-REF")
+        assert len(findings) == 1
+        assert findings[0].line == 12
+        assert "_locked suffix is the caller-holds-the-lock" in \
+            findings[0].message
+
+    def test_locked_convention_silences_th_c(self):
+        # the other side of the contract: TH-C treats writes inside a
+        # *_locked method as guarded (serving/engine.py dropped its inline
+        # suppressions on exactly this shape)
+        findings = findings_for("""
+            import threading
+
+            class Engine:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.slots = {}
+
+                def free(self, slot):
+                    with self._lock:
+                        self._free_slot_locked(slot)
+
+                def _free_slot_locked(self, slot):
+                    self.slots.pop(slot, None)
+            """, rule="TH-C")
+        assert findings == []
+
+
+# -- TH-X: cross-artifact contracts -------------------------------------------
+
+class TestCrossArtifact:
+    """Drives the project rule against a synthetic mini-repo so each
+    contract edge can be broken one drift at a time."""
+
+    @staticmethod
+    def build_repo(root, *, metrics_py=None, observability_md=None,
+                   serving_md=None, nodes_js=None, schema_py=None,
+                   alerts_py=None, config_py=None):
+        (root / "tensorhive_tpu" / "controllers").mkdir(parents=True)
+        (root / "tensorhive_tpu" / "observability").mkdir()
+        (root / "tensorhive_tpu" / "app" / "static" / "js").mkdir(
+            parents=True)
+        (root / "docs").mkdir()
+        (root / "tensorhive_tpu" / "metrics_mod.py").write_text(
+            metrics_py if metrics_py is not None else textwrap.dedent("""
+                REQS = get_registry().counter(
+                    "tpuhive_demo_requests_total", "Requests.")
+                DEPTH = get_registry().gauge(
+                    "tpuhive_demo_queue_depth", "Queue depth.")
+                """))
+        (root / "tensorhive_tpu" / "config.py").write_text(
+            config_py if config_py is not None else textwrap.dedent("""
+                import dataclasses
+
+                @dataclasses.dataclass
+                class GenerationConfig:
+                    enabled: bool = False
+                    slots: int = 8
+
+                @dataclasses.dataclass
+                class ProfilingConfig:
+                    enabled: bool = False
+                """))
+        (root / "tensorhive_tpu" / "controllers" / "generate.py").write_text(
+            schema_py if schema_py is not None else textwrap.dedent("""
+                STATS_SCHEMA = obj(
+                    required=["enabled"],
+                    enabled=s("boolean"),
+                    slots=s("integer"),
+                )
+                """))
+        (root / "tensorhive_tpu" / "observability" / "alerts.py").write_text(
+            alerts_py if alerts_py is not None else textwrap.dedent("""
+                def default_rules():
+                    return [AlertRule(name="demo_down", severity="critical")]
+                """))
+        (root / "tensorhive_tpu" / "app" / "static" / "js"
+         / "nodes.js").write_text(
+            nodes_js if nodes_js is not None
+            else 'const s = stats.slots + stats.enabled;\n')
+        (root / "docs" / "OBSERVABILITY.md").write_text(
+            observability_md if observability_md is not None
+            else textwrap.dedent("""
+                | Metric | Kind | Where |
+                |---|---|---|
+                | `tpuhive_demo_requests_total` | counter | demo |
+                | `tpuhive_demo_queue_depth` | gauge | demo |
+
+                | Rule | Severity | Signal |
+                |---|---|---|
+                | `demo_down` | critical | demo |
+
+                ```toml
+                [profiling]
+                enabled = false
+                ```
+                """))
+        (root / "docs" / "SERVING.md").write_text(
+            serving_md if serving_md is not None else textwrap.dedent("""
+                ## Configuration
+
+                | Key | Default | Meaning |
+                |---|---|---|
+                | `enabled` | false | run the pump |
+                | `slots` | 8 | slot-pool size |
+                """))
+        return root
+
+    @staticmethod
+    def check(root, rule: str = "TH-X"):
+        from tools.analysis.rules.contracts import CrossArtifactRule
+        return [f for f in CrossArtifactRule().check_project(root)
+                if f.rule == rule]
+
+    def test_consistent_repo_is_clean(self, tmp_path):
+        assert self.check(self.build_repo(tmp_path)) == []
+
+    def test_metric_without_docs_row_flagged(self, tmp_path):
+        # TH-X must be bidirectional: delete the gauge's docs row...
+        root = self.build_repo(tmp_path, observability_md=textwrap.dedent("""
+            | Metric | Kind | Where |
+            |---|---|---|
+            | `tpuhive_demo_requests_total` | counter | demo |
+
+            | Rule | Severity | Signal |
+            |---|---|---|
+            | `demo_down` | critical | demo |
+
+            enabled = false
+            """))
+        findings = self.check(root)
+        assert len(findings) == 1
+        assert "tpuhive_demo_queue_depth has no row" in findings[0].message
+        assert findings[0].path == "tensorhive_tpu/metrics_mod.py"
+
+    def test_docs_row_without_metric_flagged(self, tmp_path):
+        # ...and a docs row whose metric the code no longer registers
+        # must be caught from the other direction, at the docs line
+        root = self.build_repo(tmp_path, observability_md=textwrap.dedent("""
+            | Metric | Kind | Where |
+            |---|---|---|
+            | `tpuhive_demo_requests_total` | counter | demo |
+            | `tpuhive_demo_queue_depth` | gauge | demo |
+            | `tpuhive_demo_ghost_total` | counter | deleted metric |
+
+            | Rule | Severity | Signal |
+            |---|---|---|
+            | `demo_down` | critical | demo |
+
+            enabled = false
+            """))
+        findings = self.check(root)
+        assert len(findings) == 1
+        assert "tpuhive_demo_ghost_total" in findings[0].message
+        assert findings[0].path == "docs/OBSERVABILITY.md"
+
+    def test_shorthand_docs_rows_expand(self, tmp_path):
+        # `tpuhive_demo_requests_total` / `_queue_depth` rows expand
+        # against the row's full names before either direction fires
+        root = self.build_repo(tmp_path, observability_md=textwrap.dedent("""
+            | Metric | Kind | Where |
+            |---|---|---|
+            | `tpuhive_demo_requests_total` / `_queue_depth` | mixed | demo |
+
+            | Rule | Severity | Signal |
+            |---|---|---|
+            | `demo_down` | critical | demo |
+
+            enabled = false
+            """))
+        assert self.check(root) == []
+
+    def test_metric_naming_rules_enforced(self, tmp_path):
+        root = self.build_repo(tmp_path, metrics_py=textwrap.dedent("""
+            REQS = get_registry().counter(
+                "tpuhive_demo_requests", "Counter missing _total.")
+            CAP = get_registry().gauge(
+                "tpuhive_demo_capacity_total", "Gauge claiming _total.")
+            """), observability_md=textwrap.dedent("""
+            | Metric | Kind | Where |
+            |---|---|---|
+            | `tpuhive_demo_requests` | counter | demo |
+            | `tpuhive_demo_capacity_total` | gauge | demo |
+
+            | Rule | Severity | Signal |
+            |---|---|---|
+            | `demo_down` | critical | demo |
+
+            enabled = false
+            """))
+        messages = [f.message for f in self.check(root)]
+        assert len(messages) == 2
+        assert any("must end _total" in m for m in messages)
+        assert any("suffix reserved for counters" in m for m in messages)
+
+    def test_config_knob_without_docs_row_flagged(self, tmp_path):
+        root = self.build_repo(tmp_path, serving_md=textwrap.dedent("""
+            ## Configuration
+
+            | Key | Default | Meaning |
+            |---|---|---|
+            | `enabled` | false | run the pump |
+            """))
+        findings = self.check(root)
+        assert len(findings) == 1
+        assert "knob 'slots' has no row" in findings[0].message
+        assert findings[0].path == "tensorhive_tpu/config.py"
+
+    def test_docs_config_row_without_field_flagged(self, tmp_path):
+        root = self.build_repo(tmp_path, serving_md=textwrap.dedent("""
+            ## Configuration
+
+            | Key | Default | Meaning |
+            |---|---|---|
+            | `enabled` | false | run the pump |
+            | `slots` | 8 | slot-pool size |
+            | `turbo_mode` | true | removed in the great rewrite |
+            """))
+        findings = self.check(root)
+        assert len(findings) == 1
+        assert "turbo_mode" in findings[0].message
+        assert findings[0].path == "docs/SERVING.md"
+
+    def test_undocumented_profiling_knob_flagged(self, tmp_path):
+        root = self.build_repo(tmp_path, config_py=textwrap.dedent("""
+            import dataclasses
+
+            @dataclasses.dataclass
+            class GenerationConfig:
+                enabled: bool = False
+                slots: int = 8
+
+            @dataclasses.dataclass
+            class ProfilingConfig:
+                enabled: bool = False
+                secret_knob: int = 3
+            """))
+        findings = self.check(root)
+        assert len(findings) == 1
+        assert "secret_knob" in findings[0].message
+
+    def test_ui_fragment_outside_stats_schema_flagged(self, tmp_path):
+        root = self.build_repo(
+            tmp_path, nodes_js='badge(stats.slots, stats.ghostField);\n')
+        findings = self.check(root)
+        assert len(findings) == 1
+        assert "stats.ghostField" in findings[0].message
+        assert findings[0].path.endswith("nodes.js")
+
+    def test_alert_pack_vs_rule_table_bidirectional(self, tmp_path):
+        root = self.build_repo(tmp_path, alerts_py=textwrap.dedent("""
+            def default_rules():
+                return [AlertRule(name="demo_down", severity="critical"),
+                        AlertRule(name="undocumented_rule",
+                                  severity="warning")]
+            """), observability_md=textwrap.dedent("""
+            | Metric | Kind | Where |
+            |---|---|---|
+            | `tpuhive_demo_requests_total` | counter | demo |
+            | `tpuhive_demo_queue_depth` | gauge | demo |
+
+            | Rule | Severity | Signal |
+            |---|---|---|
+            | `demo_down` | critical | demo |
+            | `ghost_rule` | warning | table row without a pack rule |
+
+            enabled = false
+            """))
+        messages = [f.message for f in self.check(root)]
+        assert len(messages) == 2
+        assert any("'undocumented_rule'" in m and "no row" in m
+                   for m in messages)
+        assert any("'ghost_rule'" in m and "no rule by that name" in m
+                   for m in messages)
+
+
+# -- satellite CLI surfaces ----------------------------------------------------
+
+class TestSarifOutput:
+    def test_sarif_payload_carries_findings(self):
+        # inside the repo so the defect-family scopes apply (tmp_path
+        # fixtures resolve to absolute paths outside every scope)
+        target = REPO / "tensorhive_tpu" / "_sarif_fixture.py"
+        target.write_text(textwrap.dedent("""
+            def g():
+                return 0
+
+
+            def f():
+                try:
+                    g()
+                except Exception:
+                    pass
+            """))
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-m", "tools.analysis", "--format=sarif",
+                 "--select=TH-E", "--baseline", "/nonexistent/baseline.json",
+                 str(target)],
+                capture_output=True, text=True, timeout=120, cwd=REPO)
+            assert proc.returncode == 1, proc.stdout + proc.stderr
+            sarif = json.loads(proc.stdout)
+            assert sarif["version"] == "2.1.0"
+            run = sarif["runs"][0]
+            assert run["tool"]["driver"]["name"] == "thivelint"
+            assert [r["ruleId"] for r in run["results"]] == ["TH-E"]
+            location = run["results"][0]["locations"][0]["physicalLocation"]
+            assert location["artifactLocation"]["uri"] == \
+                "tensorhive_tpu/_sarif_fixture.py"
+            assert location["region"]["startLine"] == 9
+            assert any(rule["id"] == "TH-E"
+                       for rule in run["tool"]["driver"]["rules"])
+        finally:
+            target.unlink(missing_ok=True)
+
+
+class TestChangedOnly:
+    def test_changed_files_scopes_to_git_diff(self, tmp_path):
+        import subprocess as sp
+
+        from tools.analysis.engine import changed_files
+
+        def git(*argv):
+            sp.run(["git", "-c", "user.email=t@t", "-c", "user.name=t",
+                    *argv], cwd=tmp_path, check=True, capture_output=True)
+
+        git("init", "-q")
+        package = tmp_path / "tensorhive_tpu"
+        package.mkdir()
+        (package / "stable.py").write_text("STABLE = 1\n")
+        (package / "touched.py").write_text("X = 1\n")
+        git("add", "-A")
+        git("commit", "-q", "-m", "seed")
+        (package / "touched.py").write_text("X = 2\n")
+        (package / "fresh.py").write_text("Y = 1\n")
+        (tmp_path / "untracked_elsewhere.txt").write_text("not python\n")
+        assert changed_files(tmp_path) == [
+            "tensorhive_tpu/fresh.py", "tensorhive_tpu/touched.py"]
+
+
+class TestStaleBaselineGate:
+    def test_stale_waiver_fails_full_gate_and_refresh_prunes(self, tmp_path):
+        checked_in = json.loads(
+            (REPO / "tools" / "analysis" / "baseline.json").read_text())
+        bogus = {"rule": "TH-E", "path": "tensorhive_tpu/deleted_module.py",
+                 "contains": "except Exception",
+                 "reason": "the module this waived was deleted long ago"}
+        baseline = tmp_path / "baseline.json"
+        baseline.write_text(json.dumps(
+            {"version": 1,
+             "waivers": checked_in["waivers"] + [bogus]}))
+
+        # the FULL default gate treats a matching-nothing waiver as drift
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.analysis",
+             "--baseline", str(baseline)],
+            capture_output=True, text=True, timeout=300, cwd=REPO)
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "stale waivers fail the gate" in proc.stderr
+        assert "--refresh-baseline" in proc.stderr
+
+        # --refresh-baseline prunes exactly the stale entry and exits 0
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.analysis",
+             "--baseline", str(baseline), "--refresh-baseline"],
+            capture_output=True, text=True, timeout=300, cwd=REPO)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        pruned = json.loads(baseline.read_text())
+        assert pruned["waivers"] == checked_in["waivers"]
+
+        # and the pruned baseline now passes the full gate outright
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.analysis",
+             "--baseline", str(baseline)],
+            capture_output=True, text=True, timeout=300, cwd=REPO)
+        assert proc.returncode == 0, proc.stdout + proc.stderr
 
 
 # -- repo-level invariants -----------------------------------------------------

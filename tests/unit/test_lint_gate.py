@@ -35,8 +35,10 @@ def test_full_analyzer_is_clean():
 
 
 def test_analyzer_runs_all_new_passes():
-    """The four defect-family passes are registered and actually run (a
-    refactor that silently drops a pass must fail here, not in review)."""
+    """Every defect-family pass is registered and actually runs (a
+    refactor that silently drops a pass must fail here, not in review) —
+    the PR 2 families plus the flow-aware ones and the cross-artifact
+    contract pass."""
     proc = subprocess.run(
         [sys.executable, "-m", "tools.analysis", "--format=json",
          "tensorhive_tpu/observability"],
@@ -44,7 +46,11 @@ def test_analyzer_runs_all_new_passes():
     )
     assert proc.returncode == 0, f"\n{proc.stdout}{proc.stderr}"
     report = json.loads(proc.stdout)
-    assert {"TH-B", "TH-C", "TH-E", "TH-J"} <= set(report["rules"])
+    assert {"TH-B", "TH-C", "TH-E", "TH-J",
+            "TH-JIT", "TH-DON", "TH-REF", "TH-X"} <= set(report["rules"])
+    # the JSON trend artifact carries per-rule counts for cross-commit
+    # trending (active/suppressed/waived buckets)
+    assert set(report["rule_counts"]) == {"active", "suppressed", "waived"}
 
 
 def test_lint_gate_covers_observability_package():

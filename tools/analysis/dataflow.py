@@ -1,0 +1,365 @@
+"""Shared intra-module dataflow: the layer the flow-aware passes stand on.
+
+The original passes (TH-C/TH-E/TH-B/TH-J) are per-statement pattern
+matchers; the serving data plane's invariants (PRs 6-11) live one level up
+— in how values FLOW: which callable a name is bound to (``_serving_step =
+functools.partial(jax.jit, ...)(_step_body)``), which of a jit wrapper's
+parameters are static vs traced vs donated, and where the wrapper is
+actually called. This module computes that once per
+:class:`~tools.analysis.engine.ModuleContext` (cached on the context, so
+every flow-aware rule shares ONE pass, the same economy as the shared AST):
+
+* **jit-wrapper recognition** — every way this repo spells a jitted
+  function: ``@jax.jit`` / ``@jit(...)`` decorators,
+  ``@functools.partial(jax.jit, static_argnames=...)`` decorators,
+  ``name = jax.jit(fn, ...)`` and
+  ``name = functools.partial(jax.jit, ...)(fn)`` assignments. Keyword
+  values for ``static_argnames``/``static_argnums``/``donate_argnames``/
+  ``donate_argnums`` are resolved through module-level constants
+  (``static_argnames=_GENERATE_STATICS`` follows the assignment).
+* **call-site indexing** — every ``ast.Call`` in the module keyed by the
+  callee's terminal name (``f(...)`` -> ``f``, ``self._pool.release(...)``
+  -> ``release``), so rules ask "where is this wrapper invoked" without
+  re-walking.
+* **module constants** — flat map of module-level ``NAME = <literal>``
+  bindings, the conservative constant universe for "does a non-constant
+  flow into a static position".
+
+Everything here is lexical and module-flat, like the rest of the gate:
+imports are not chased, attribute receivers are matched by source text.
+That is the deliberate precision/recall trade the analyzer has made since
+PR 2 — rules built on this layer keep the same contract.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+JIT_NAMES = {"jit", "pmap"}
+PARTIAL_NAMES = {"partial"}
+
+
+def _terminal_name(func: ast.AST) -> Optional[str]:
+    """``f`` for ``f(...)``, ``attr`` for ``x.y.attr(...)``."""
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def dotted_source(node: ast.AST) -> Optional[str]:
+    """Best-effort dotted spelling of a Name/Attribute chain
+    (``self._pool.page_table`` -> that exact string); None for anything
+    with calls/subscripts in the chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _is_jit_callable(node: ast.AST) -> bool:
+    """``jit`` / ``jax.jit`` / ``pmap`` as a bare callable reference."""
+    name = _terminal_name(node) if isinstance(node, (ast.Name, ast.Attribute)) \
+        else None
+    return name in JIT_NAMES
+
+
+@dataclasses.dataclass
+class JitWrapper:
+    """One jitted callable the module defines, however it was spelled."""
+    name: str                       # the bound / decorated name
+    lineno: int
+    target: Optional[str]           # the wrapped plain function's name
+    static_argnames: Set[str]
+    static_argnums: Set[int]
+    donate_argnames: Set[str]
+    donate_argnums: Set[int]
+
+    def has_donation(self) -> bool:
+        return bool(self.donate_argnames or self.donate_argnums)
+
+
+class Dataflow:
+    """The shared per-module flow facts. Build via ``Dataflow(module)``
+    where ``module`` is a :class:`ModuleContext` (duck-typed: only
+    ``tree``/``parents``/``ancestors`` are used)."""
+
+    def __init__(self, module) -> None:
+        self.module = module
+        tree = module.tree
+        #: module-flat function index (nested defs included, first wins)
+        self.functions: Dict[str, ast.FunctionDef] = {}
+        #: module-level NAME -> literal value (constants only)
+        self.constants: Dict[str, object] = {}
+        #: callee terminal name -> call nodes
+        self.calls: Dict[str, List[ast.Call]] = {}
+        self.jit_wrappers: Dict[str, JitWrapper] = {}
+        if tree is None:
+            return
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions.setdefault(node.name, node)
+            elif isinstance(node, ast.Call):
+                name = _terminal_name(node.func)
+                if name is not None:
+                    self.calls.setdefault(name, []).append(node)
+        for stmt in tree.body:        # module level only: constant universe
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                target = stmt.targets[0]
+                if isinstance(target, ast.Name):
+                    try:
+                        self.constants[target.id] = ast.literal_eval(
+                            stmt.value)
+                    except (ValueError, SyntaxError):
+                        pass
+        self._collect_wrappers(tree)
+
+    # -- jit wrapper recognition ------------------------------------------
+    def _collect_wrappers(self, tree: ast.AST) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                wrapper = self._wrapper_from_decorators(node)
+                if wrapper is not None:
+                    self.jit_wrappers[wrapper.name] = wrapper
+            elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+                target = node.targets[0]
+                if not isinstance(target, ast.Name):
+                    continue
+                wrapper = self._wrapper_from_expr(node.value, target.id,
+                                                  node.lineno)
+                if wrapper is not None:
+                    self.jit_wrappers[wrapper.name] = wrapper
+
+    def _wrapper_from_decorators(self, fn) -> Optional[JitWrapper]:
+        for decorator in fn.decorator_list:
+            info = self._jit_call_info(decorator)
+            if info is not None:
+                statics, static_nums, donated, donate_nums = info
+                return JitWrapper(fn.name, fn.lineno, fn.name, statics,
+                                  static_nums, donated, donate_nums)
+        return None
+
+    def _jit_call_info(self, node: ast.AST):
+        """(static_argnames, static_argnums, donate_argnames,
+        donate_argnums) when ``node`` is a jit application; None otherwise.
+        Recognizes bare ``jax.jit``, ``jax.jit(**kw)`` and
+        ``functools.partial(jax.jit, **kw)``."""
+        if _is_jit_callable(node):
+            return set(), set(), set(), set()
+        if not isinstance(node, ast.Call):
+            return None
+        func = node.func
+        if _is_jit_callable(func):
+            return self._jit_kwargs(node)
+        if (_terminal_name(func) in PARTIAL_NAMES and node.args
+                and _is_jit_callable(node.args[0])):
+            return self._jit_kwargs(node)
+        return None
+
+    def _jit_kwargs(self, call: ast.Call):
+        statics: Set[str] = set()
+        static_nums: Set[int] = set()
+        donated: Set[str] = set()
+        donate_nums: Set[int] = set()
+        for kw in call.keywords:
+            value = self._resolve_literal(kw.value)
+            if value is None:
+                continue
+            names = {v for v in _as_tuple(value) if isinstance(v, str)}
+            nums = {v for v in _as_tuple(value) if isinstance(v, int)}
+            if kw.arg == "static_argnames":
+                statics |= names
+            elif kw.arg == "static_argnums":
+                static_nums |= nums
+            elif kw.arg == "donate_argnames":
+                donated |= names
+            elif kw.arg == "donate_argnums":
+                donate_nums |= nums
+        return statics, static_nums, donated, donate_nums
+
+    def _wrapper_from_expr(self, value: ast.AST, bound: str,
+                           lineno: int) -> Optional[JitWrapper]:
+        """``bound = jax.jit(f, ...)`` or
+        ``bound = functools.partial(jax.jit, ...)(f)``."""
+        if not isinstance(value, ast.Call):
+            return None
+        # partial(jax.jit, **kw)(f): outer call's func is the partial call
+        if isinstance(value.func, ast.Call):
+            info = self._jit_call_info(value.func)
+            if info is not None and value.args:
+                target = value.args[0]
+                if isinstance(target, ast.Name):
+                    return JitWrapper(bound, lineno, target.id, *info)
+            return None
+        # jax.jit(f, **kw)
+        if _is_jit_callable(value.func) and value.args:
+            target = value.args[0]
+            statics, static_nums, donated, donate_nums = self._jit_kwargs(
+                value)
+            target_name = (target.id if isinstance(target, ast.Name)
+                           else None)
+            return JitWrapper(bound, lineno, target_name, statics,
+                              static_nums, donated, donate_nums)
+        return None
+
+    def _resolve_literal(self, node: ast.AST):
+        """Literal value of an expression, following one module-constant
+        hop (``static_argnames=_GENERATE_STATICS``)."""
+        try:
+            return ast.literal_eval(node)
+        except (ValueError, SyntaxError):
+            pass
+        if isinstance(node, ast.Name):
+            return self.constants.get(node.id)
+        return None
+
+    # -- queries -----------------------------------------------------------
+    def call_sites(self, name: str) -> List[ast.Call]:
+        return self.calls.get(name, [])
+
+    def target_function(self, wrapper: JitWrapper) -> Optional[ast.AST]:
+        if wrapper.target is None:
+            return None
+        return self.functions.get(wrapper.target)
+
+    def target_params(self, wrapper: JitWrapper) -> List[str]:
+        fn = self.target_function(wrapper)
+        if fn is None:
+            return []
+        args = fn.args
+        return [a.arg for a in [*args.posonlyargs, *args.args]]
+
+    def static_params(self, wrapper: JitWrapper) -> Set[str]:
+        params = self.target_params(wrapper)
+        names = set(wrapper.static_argnames)
+        for num in wrapper.static_argnums:
+            if 0 <= num < len(params):
+                names.add(params[num])
+        return names
+
+    def donated_params(self, wrapper: JitWrapper) -> Set[str]:
+        params = self.target_params(wrapper)
+        names = set(wrapper.donate_argnames)
+        for num in wrapper.donate_argnums:
+            if 0 <= num < len(params):
+                names.add(params[num])
+        return names
+
+    def static_positions(self, wrapper: JitWrapper) -> Dict[int, str]:
+        """positional index -> static param name at call sites."""
+        params = self.target_params(wrapper)
+        return {index: name for index, name in enumerate(params)
+                if name in self.static_params(wrapper)}
+
+    def donated_positions(self, wrapper: JitWrapper) -> Dict[int, str]:
+        params = self.target_params(wrapper)
+        return {index: name for index, name in enumerate(params)
+                if name in self.donated_params(wrapper)}
+
+    # -- scope helpers ------------------------------------------------------
+    def enclosing_function(self, node: ast.AST):
+        for ancestor in self.module.ancestors(node):
+            if isinstance(ancestor, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return ancestor
+        return None
+
+    def enclosing_loops(self, node: ast.AST) -> List[ast.AST]:
+        """Innermost-first For/While ancestors within the enclosing fn."""
+        loops: List[ast.AST] = []
+        for ancestor in self.module.ancestors(node):
+            if isinstance(ancestor, (ast.For, ast.While)):
+                loops.append(ancestor)
+            if isinstance(ancestor, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                break
+        return loops
+
+    @staticmethod
+    def bound_in(scope: ast.AST) -> Set[str]:
+        """Names assigned anywhere inside ``scope`` (loop targets, plain
+        and augmented assignments, with/as, tuple unpacking)."""
+        bound: Set[str] = set()
+
+        def targets_of(node: ast.AST):
+            if isinstance(node, ast.Name):
+                bound.add(node.id)
+            elif isinstance(node, (ast.Tuple, ast.List)):
+                for element in node.elts:
+                    targets_of(element)
+            elif isinstance(node, ast.Starred):
+                targets_of(node.value)
+
+        for node in ast.walk(scope):
+            if isinstance(node, ast.Assign):
+                for target in node.targets:
+                    targets_of(target)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets_of(node.target)
+            elif isinstance(node, ast.For):
+                targets_of(node.target)
+            elif isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    if item.optional_vars is not None:
+                        targets_of(item.optional_vars)
+            elif isinstance(node, ast.NamedExpr):
+                targets_of(node.target)
+        return bound
+
+    def _branch_index(self, structure: ast.AST,
+                      node: ast.AST) -> Optional[int]:
+        """Which branch list of an If/Try holds ``node`` (body=0,
+        orelse=1, then handlers/finalbody); None when node is elsewhere
+        (e.g. the test expression)."""
+        chain = {id(node)} | {id(a) for a in self.module.ancestors(node)}
+        if isinstance(structure, ast.If):
+            branches: List[Sequence[ast.AST]] = [structure.body,
+                                                 structure.orelse]
+        elif isinstance(structure, ast.Try):
+            branches = [structure.body, structure.orelse,
+                        structure.finalbody]
+            branches += [handler.body for handler in structure.handlers]
+        else:
+            return None
+        for index, branch in enumerate(branches):
+            if any(id(stmt) in chain for stmt in branch):
+                return index
+        return None
+
+    def same_branch(self, anchor: ast.AST, other: ast.AST) -> bool:
+        """False when ``other`` sits in the opposite arm of an ``if``
+        (or try) that contains ``anchor`` — then-vs-else are mutually
+        exclusive paths, so a lexically-later read there is never
+        reachable after the anchor executes."""
+        for ancestor in self.module.ancestors(anchor):
+            if isinstance(ancestor, (ast.If, ast.Try)):
+                mine = self._branch_index(ancestor, anchor)
+                theirs = self._branch_index(ancestor, other)
+                if mine is not None and theirs is not None and mine != theirs:
+                    return False
+        return True
+
+
+def _as_tuple(value) -> Tuple:
+    if isinstance(value, (tuple, list, set, frozenset)):
+        return tuple(value)
+    return (value,)
+
+
+def call_argument(call: ast.Call, position: int,
+                  name: str) -> Optional[ast.AST]:
+    """The expression passed for parameter ``name`` (positional index
+    ``position``) at this call site, or None when omitted."""
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    if position < len(call.args):
+        arg = call.args[position]
+        if isinstance(arg, ast.Starred):
+            return None
+        return arg
+    return None

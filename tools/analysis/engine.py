@@ -73,6 +73,24 @@ class Rule:
         raise NotImplementedError
 
 
+class ProjectRule(Rule):
+    """A pass over the repository as a whole rather than one module —
+    cross-artifact contracts (code + docs + UI together, e.g. TH-X) live
+    here. Runs once per :func:`run` regardless of the file list (the
+    contracts hold whether or not their artifacts are in the walk set,
+    and ``--changed-only`` must not let a docs drift slip through).
+    Per-line suppressions don't apply (findings may target non-Python
+    artifacts); the waiver baseline does."""
+
+    project = True
+
+    def check(self, module: "ModuleContext") -> List[Finding]:
+        return []
+
+    def check_project(self, root: Path) -> List[Finding]:
+        raise NotImplementedError
+
+
 _RULES: Dict[str, Rule] = {}
 
 
@@ -128,6 +146,18 @@ class ModuleContext:
             self.tree = None
             self.syntax_error = exc
         self._parents: Optional[Dict[int, ast.AST]] = None
+        self._dataflow = None
+
+    @property
+    def dataflow(self):
+        """Shared intra-module dataflow facts (jit wrappers, call-site
+        index, module constants — tools/analysis/dataflow.py), built once
+        on first use and reused by every flow-aware rule."""
+        if self._dataflow is None:
+            from .dataflow import Dataflow
+
+            self._dataflow = Dataflow(self)
+        return self._dataflow
 
     @classmethod
     def from_file(cls, path: Path) -> "ModuleContext":
@@ -229,6 +259,38 @@ def iter_sources(args: Sequence[str]) -> List[Path]:
     return files
 
 
+def changed_files(root: Optional[Path] = None) -> Optional[List[str]]:
+    """Repo-relative paths touched vs HEAD (staged + unstaged + untracked),
+    for ``--changed-only`` pre-commit runs. None when git is unavailable —
+    the caller falls back to the full walk, never to a silent skip."""
+    import subprocess
+
+    root = root or REPO_ROOT
+    paths: Set[str] = set()
+    commands = (
+        ["git", "diff", "--name-only", "HEAD"],
+        ["git", "ls-files", "--others", "--exclude-standard"],
+    )
+    for argv in commands:
+        try:
+            proc = subprocess.run(argv, cwd=root, capture_output=True,
+                                  text=True, timeout=30)
+        except (OSError, subprocess.SubprocessError):
+            return None
+        if proc.returncode != 0:
+            return None
+        paths.update(line.strip() for line in proc.stdout.splitlines()
+                     if line.strip())
+    default_dirs = tuple(t for t in DEFAULT_TARGETS
+                         if not t.endswith(".py"))
+    default_files = tuple(t for t in DEFAULT_TARGETS if t.endswith(".py"))
+    return sorted(
+        p for p in paths
+        if p.endswith(".py") and (root / p).exists()
+        and (p in default_files
+             or any(p.startswith(d + "/") for d in default_dirs)))
+
+
 def analyze_source(source: str, relpath: str,
                    rules: Optional[Sequence[Rule]] = None) -> List[Finding]:
     """Run rules over an in-memory module; suppressions honored, baseline
@@ -251,8 +313,11 @@ def _check_module(module: ModuleContext, rules: Sequence[Rule]) -> List[Finding]
 
 
 def run(paths: Sequence[str], baseline_path: Optional[Path] = None,
-        rule_ids: Optional[Sequence[str]] = None) -> Dict[str, object]:
-    """Analyze files; returns the full report dict (see keys below)."""
+        rule_ids: Optional[Sequence[str]] = None,
+        root: Optional[Path] = None) -> Dict[str, object]:
+    """Analyze files; returns the full report dict (see keys below).
+    Project rules (cross-artifact contracts) run once against ``root``
+    regardless of the file list."""
     rules = all_rules()
     if rule_ids:
         wanted = set(rule_ids)
@@ -260,6 +325,8 @@ def run(paths: Sequence[str], baseline_path: Optional[Path] = None,
         if unknown:
             raise SystemExit(f"unknown rule ids: {sorted(unknown)}")
         rules = [rule for rule in rules if rule.id in wanted]
+    module_rules = [r for r in rules if not getattr(r, "project", False)]
+    project_rules = [r for r in rules if getattr(r, "project", False)]
     baseline = Baseline.load(baseline_path or DEFAULT_BASELINE)
     files = iter_sources(paths)
     active: List[Finding] = []
@@ -267,13 +334,27 @@ def run(paths: Sequence[str], baseline_path: Optional[Path] = None,
     waived: List[Finding] = []
     for path in files:
         module = ModuleContext.from_file(path)
-        for finding in _check_module(module, rules):
+        for finding in _check_module(module, module_rules):
             if module.suppressed(finding):
                 suppressed.append(finding)
             elif baseline.waives(finding):
                 waived.append(finding)
             else:
                 active.append(finding)
+    for rule in project_rules:
+        for finding in sorted(rule.check_project(root or REPO_ROOT),
+                              key=lambda f: (f.path, f.line, f.rule)):
+            if baseline.waives(finding):
+                waived.append(finding)
+            else:
+                active.append(finding)
+    counts: Dict[str, Dict[str, int]] = {}
+    for bucket, findings in (("active", active), ("suppressed", suppressed),
+                             ("waived", waived)):
+        per_rule: Dict[str, int] = {}
+        for finding in findings:
+            per_rule[finding.rule] = per_rule.get(finding.rule, 0) + 1
+        counts[bucket] = dict(sorted(per_rule.items()))
     return {
         "files": len(files),
         "rules": [rule.id for rule in rules],
@@ -281,7 +362,62 @@ def run(paths: Sequence[str], baseline_path: Optional[Path] = None,
         "suppressed": suppressed,
         "waived": waived,
         "unused_waivers": baseline.unused(),
+        "rule_counts": counts,
     }
+
+
+def to_sarif(report: Dict[str, object]) -> Dict[str, object]:
+    """SARIF 2.1.0 payload for CI diff annotation (active findings only —
+    suppressed/waived findings are the gate's business, not the diff's)."""
+    rules_meta = []
+    for rule in all_rules():
+        if rule.id in report["rules"]:
+            rules_meta.append({
+                "id": rule.id,
+                "name": rule.title or rule.id,
+                "shortDescription": {"text": rule.title or rule.id},
+                "fullDescription": {"text": rule.rationale or rule.title},
+            })
+    results = []
+    for finding in report["findings"]:
+        results.append({
+            "ruleId": finding.rule,
+            "level": "error",
+            "message": {"text": finding.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": finding.path},
+                    "region": {"startLine": max(1, finding.line)},
+                },
+            }],
+        })
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "thivelint",
+                "informationUri": "docs/STATIC_ANALYSIS.md",
+                "rules": rules_meta,
+            }},
+            "results": results,
+        }],
+    }
+
+
+def refresh_baseline(path: Path, unused: List[Dict[str, str]]) -> int:
+    """Drop stale waivers from the baseline file; returns how many."""
+    if not unused or not path.exists():
+        return 0
+    data = json.loads(path.read_text())
+    stale = {json.dumps(entry, sort_keys=True) for entry in unused}
+    kept = [entry for entry in data.get("waivers", [])
+            if json.dumps(entry, sort_keys=True) not in stale]
+    dropped = len(data.get("waivers", [])) - len(kept)
+    data["waivers"] = kept
+    path.write_text(json.dumps(data, indent=2) + "\n")
+    return dropped
 
 
 def main(argv: Optional[Sequence[str]] = None, prog: str = "analysis") -> int:
@@ -289,11 +425,19 @@ def main(argv: Optional[Sequence[str]] = None, prog: str = "analysis") -> int:
         prog=prog, description="thivelint: the repo's multi-pass static gate")
     parser.add_argument("paths", nargs="*",
                         help="files/dirs to analyze (default: repo gate set)")
-    parser.add_argument("--format", choices=("text", "json"), default="text")
+    parser.add_argument("--format", choices=("text", "json", "sarif"),
+                        default="text")
     parser.add_argument("--baseline", type=Path, default=DEFAULT_BASELINE,
                         help="waiver baseline JSON (default: checked-in)")
     parser.add_argument("--select", default="",
                         help="comma-separated rule ids to run (default: all)")
+    parser.add_argument("--changed-only", action="store_true",
+                        help="analyze only files changed vs HEAD (pre-commit "
+                             "speed; the full walk remains the CI gate). "
+                             "Cross-artifact project rules still run.")
+    parser.add_argument("--refresh-baseline", action="store_true",
+                        help="prune waivers that no longer match any "
+                             "finding from the baseline file")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule catalog and exit")
     options = parser.parse_args(argv)
@@ -301,12 +445,31 @@ def main(argv: Optional[Sequence[str]] = None, prog: str = "analysis") -> int:
     if options.list_rules:
         for rule in all_rules():
             scope = ", ".join(rule.scope) if rule.scope else "everywhere"
-            print(f"{rule.id}: {rule.title} [{scope}]")
+            kind = " (project)" if getattr(rule, "project", False) else ""
+            print(f"{rule.id}: {rule.title} [{scope}]{kind}")
         return 0
+
+    paths = list(options.paths)
+    if options.changed_only:
+        if paths:
+            raise SystemExit(f"{prog}: --changed-only and explicit paths "
+                             "are mutually exclusive")
+        changed = changed_files()
+        if changed is None:
+            print(f"{prog}: git unavailable; falling back to the full walk",
+                  file=sys.stderr)
+        else:
+            if not changed:
+                print(f"{prog}: no changed python files; project-rule "
+                      "contracts still checked", file=sys.stderr)
+            # an empty change set must NOT fall back to the full walk
+            # (iter_sources treats [] as "default targets"); a non-path
+            # sentinel yields zero module files while project rules run
+            paths = changed or ["__no_changed_files__"]
 
     selected = [token.strip() for token in options.select.split(",")
                 if token.strip()]
-    report = run(options.paths, baseline_path=options.baseline,
+    report = run(paths, baseline_path=options.baseline,
                  rule_ids=selected or None)
     findings: List[Finding] = report["findings"]  # type: ignore[assignment]
 
@@ -316,13 +479,38 @@ def main(argv: Optional[Sequence[str]] = None, prog: str = "analysis") -> int:
             payload[key] = [f.to_dict() for f in report[key]]
         json.dump(payload, sys.stdout, indent=2)
         sys.stdout.write("\n")
+    elif options.format == "sarif":
+        json.dump(to_sarif(report), sys.stdout, indent=2)
+        sys.stdout.write("\n")
     else:
         for finding in findings:
             print(finding.render())
-    for entry in report["unused_waivers"]:
-        print(f"{prog}: warning: unused baseline waiver {entry['rule']} "
-              f"{entry['path']!r} ({entry['reason']})", file=sys.stderr)
+
+    stale = report["unused_waivers"]
+    stale_fails = False
+    if stale and options.refresh_baseline:
+        dropped = refresh_baseline(options.baseline, stale)
+        print(f"{prog}: pruned {dropped} stale waiver(s) from "
+              f"{options.baseline}", file=sys.stderr)
+    elif stale:
+        # a waiver that matches nothing is drift: the code it justified is
+        # gone (or moved), so the justification is dead weight that would
+        # silently re-waive a future regression. On the FULL default gate
+        # (no path/select narrowing — where "matches nothing" is a fact,
+        # not an artifact of scoping) that fails the run.
+        full_gate = not options.paths and not selected \
+            and not options.changed_only
+        for entry in stale:
+            level = "error" if full_gate else "warning"
+            print(f"{prog}: {level}: unused baseline waiver {entry['rule']} "
+                  f"{entry['path']!r} ({entry['reason']})", file=sys.stderr)
+        if full_gate:
+            print(f"{prog}: stale waivers fail the gate — run "
+                  f"`python -m tools.analysis --refresh-baseline` to prune "
+                  "them (or restore the code they justified)",
+                  file=sys.stderr)
+            stale_fails = True
     print(f"{prog}: {report['files']} files, {len(findings)} problems "
           f"({len(report['suppressed'])} suppressed, "
           f"{len(report['waived'])} waived)", file=sys.stderr)
-    return 1 if findings else 0
+    return 1 if findings or stale_fails else 0

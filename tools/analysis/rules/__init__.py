@@ -1,3 +1,13 @@
 """Rule passes — importing this package registers every rule with the
 engine registry (one module per defect family)."""
-from . import blocking, concurrency, exceptions, jax_sync, legacy  # noqa: F401
+from . import (  # noqa: F401
+    blocking,
+    concurrency,
+    contracts,
+    donation,
+    exceptions,
+    jax_flow,
+    jax_sync,
+    legacy,
+    refcount,
+)

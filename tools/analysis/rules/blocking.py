@@ -56,7 +56,7 @@ class BlockingCallRule(Rule):
     title = "blocking call without timeout in API handler / service tick"
     rationale = ("A handler or tick that blocks without a deadline turns one "
                  "slow host into a stalled control plane.")
-    scope = ("tensorhive_tpu/", "tools/")
+    scope = ("tensorhive_tpu/", "tools/", "tests/")
 
     def check(self, module: ModuleContext) -> List[Finding]:
         findings: List[Finding] = []

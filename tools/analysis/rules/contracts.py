@@ -1,0 +1,390 @@
+"""TH-X: cross-artifact contracts — code, docs and UI checked together.
+
+Every observable surface this repo ships is a three-way contract: a metric
+is registered in Python, documented in docs/OBSERVABILITY.md, and (for the
+serving strip) rendered by the dashboard. Nothing enforced any edge of
+that triangle until now — a renamed metric silently orphans its docs row,
+a new config knob ships undocumented, the dashboard renders a stats field
+the API stopped sending. This pass parses all the artifacts in one run
+(it is a :class:`~tools.analysis.engine.ProjectRule` — repo-scoped, runs
+even under ``--changed-only``) and checks:
+
+* **metric naming + docs rows, bidirectionally** — every
+  ``get_registry().counter/gauge/histogram("tpuhive_*")`` registration
+  must follow the documented naming rule (counters end ``_total``;
+  nothing else may claim that suffix) and have a row in
+  docs/OBSERVABILITY.md's tables; every ``tpuhive_*`` name referenced
+  from a docs table row must resolve to a registered metric. Doc rows use
+  suffix shorthand (``tpuhive_service_ticks_total`` / ``_tick_failures_
+  total``); a shorthand resolves if ANY underscore-boundary prefix of a
+  full name in the same row completes it to a registered metric.
+* **config knob docs rows** — every field of ``GenerationConfig``
+  (``[generation_service]``) has a ``| `knob` |`` row in docs/SERVING.md,
+  every ``ProfilingConfig`` (``[profiling]``) knob appears in
+  docs/OBSERVABILITY.md; reverse direction: every key row of SERVING.md's
+  config table names a real field.
+* **stats schema vs the dashboard** — every ``stats.<key>`` fragment
+  nodes.js renders must be a key of ``STATS_SCHEMA``
+  (controllers/generate.py) — the exact drift the ui-contract tests pin
+  one field at a time, enforced for the whole surface.
+* **alert pack vs rule table** — every ``AlertRule(name=...)`` in the
+  default pack has a row in a documented rule table, and every rule-table
+  row names a rule the pack actually ships.
+
+Findings target the artifact that drifted (the registration line, the
+config field line, the docs row line, the nodes.js line). Inline
+suppression does not apply (non-Python artifacts); deliberate exceptions
+are baseline waivers with written reasons — see the capacity-gauge
+waivers in tools/analysis/baseline.json for the worked example.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, List, Sequence, Set, Tuple
+
+from ..engine import Finding, ProjectRule, register
+
+METRIC_KINDS = {"counter", "gauge", "histogram"}
+SEVERITIES = {"critical", "warning", "info"}
+
+FULL_METRIC_RE = re.compile(r"tpuhive_[a-z0-9][a-z0-9_]*")
+SHORT_METRIC_RE = re.compile(r"`(_[a-z0-9][a-z0-9_]*)(?:\{[^}]*\})?`")
+STATS_REF_RE = re.compile(r"\bstats\.([A-Za-z0-9_]+)")
+ROW_KEY_RE = re.compile(r"^\|\s*`([a-z0-9_]+)`\s*\|")
+
+
+class RegisteredMetric:
+    __slots__ = ("name", "kind", "path", "line")
+
+    def __init__(self, name: str, kind: str, path: str, line: int) -> None:
+        self.name = name
+        self.kind = kind
+        self.path = path
+        self.line = line
+
+
+def collect_metrics(root: Path) -> List[RegisteredMetric]:
+    """Every ``.counter/.gauge/.histogram("tpuhive_*")`` registration under
+    ``tensorhive_tpu/`` (AST-exact: literal first argument only)."""
+    metrics: List[RegisteredMetric] = []
+    package = root / "tensorhive_tpu"
+    if not package.is_dir():
+        return metrics
+    for path in sorted(package.rglob("*.py")):
+        try:
+            tree = ast.parse(path.read_text())
+        except SyntaxError:
+            continue        # TH-SYNTAX owns unparseable files
+        relpath = path.relative_to(root).as_posix()
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in METRIC_KINDS):
+                continue
+            if not (node.args and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, str)):
+                continue
+            name = node.args[0].value
+            if name.startswith("tpuhive_"):
+                metrics.append(RegisteredMetric(name, node.func.attr,
+                                                relpath, node.lineno))
+    return metrics
+
+
+def _doc_table_rows(text: str) -> List[Tuple[int, str]]:
+    return [(lineno, line) for lineno, line in
+            enumerate(text.splitlines(), start=1)
+            if line.lstrip().startswith("|")]
+
+
+def _underscore_prefixes(name: str) -> List[str]:
+    parts = name.split("_")
+    return ["_".join(parts[:i]) for i in range(1, len(parts))]
+
+
+def documented_metric_names(text: str) -> Set[str]:
+    """Full names + every underscore-boundary shorthand expansion found in
+    the doc's table rows (over-generates on purpose: lenient toward the
+    code→docs direction, exact enough for docs→code)."""
+    documented: Set[str] = set()
+    for _, row in _doc_table_rows(text):
+        fulls = FULL_METRIC_RE.findall(row)
+        documented.update(fulls)
+        for short in SHORT_METRIC_RE.findall(row):
+            for full in fulls:
+                for prefix in _underscore_prefixes(full):
+                    documented.add(prefix + short)
+    return documented
+
+
+def doc_metric_references(text: str) -> List[Tuple[int, str, Sequence[str]]]:
+    """(line, token, row-full-names) for every metric reference in table
+    rows — full names verbatim, shorthands as their ``_suffix`` token."""
+    refs: List[Tuple[int, str, Sequence[str]]] = []
+    for lineno, row in _doc_table_rows(text):
+        fulls = FULL_METRIC_RE.findall(row)
+        for full in fulls:
+            refs.append((lineno, full, fulls))
+        for short in SHORT_METRIC_RE.findall(row):
+            refs.append((lineno, short, fulls))
+    return refs
+
+
+def dataclass_fields(tree: ast.AST, class_name: str) -> List[Tuple[str, int]]:
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == class_name:
+            return [(stmt.target.id, stmt.lineno) for stmt in node.body
+                    if isinstance(stmt, ast.AnnAssign)
+                    and isinstance(stmt.target, ast.Name)]
+    return []
+
+
+def stats_schema_keys(tree: ast.AST) -> Set[str]:
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "STATS_SCHEMA"
+                and isinstance(node.value, ast.Call)):
+            return {kw.arg for kw in node.value.keywords
+                    if kw.arg is not None and kw.arg != "required"}
+    return set()
+
+
+def alert_pack_rules(tree: ast.AST) -> List[Tuple[str, int]]:
+    rules: List[Tuple[str, int]] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and ((isinstance(node.func, ast.Name)
+                      and node.func.id == "AlertRule")
+                     or (isinstance(node.func, ast.Attribute)
+                         and node.func.attr == "AlertRule"))):
+            continue
+        for kw in node.keywords:
+            if kw.arg == "name" and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str):
+                rules.append((kw.value.value, node.lineno))
+    return rules
+
+
+def doc_rule_rows(text: str) -> List[Tuple[int, str]]:
+    """(line, rule-name) for rule-pack table rows: ``| `name` | severity |``
+    where the second cell is a severity word."""
+    rows: List[Tuple[int, str]] = []
+    for lineno, row in _doc_table_rows(text):
+        cells = [cell.strip() for cell in row.strip().strip("|").split("|")]
+        if len(cells) < 2 or cells[1] not in SEVERITIES:
+            continue
+        match = re.fullmatch(r"`([a-z0-9_]+)`", cells[0])
+        if match:
+            rows.append((lineno, match.group(1)))
+    return rows
+
+
+def serving_config_rows(text: str) -> List[Tuple[int, str]]:
+    """Key rows of the FIRST table after the ``## Configuration`` heading
+    in docs/SERVING.md (the ``[generation_service]`` knob table)."""
+    rows: List[Tuple[int, str]] = []
+    in_section = False
+    in_table = False
+    for lineno, line in enumerate(text.splitlines(), start=1):
+        if line.startswith("## "):
+            if in_table:
+                break
+            in_section = line.strip() == "## Configuration"
+            continue
+        if not in_section:
+            continue
+        if line.lstrip().startswith("|"):
+            in_table = True
+            match = ROW_KEY_RE.match(line.strip())
+            if match:
+                rows.append((lineno, match.group(1)))
+        elif in_table:
+            break               # first table ended
+    return rows
+
+
+class CrossArtifactRule(ProjectRule):
+    id = "TH-X"
+    title = "cross-artifact contract drift (code vs docs vs dashboard)"
+    rationale = ("Metrics, config knobs, stats fields and alert rules are "
+                 "contracts between code, docs and the UI; any edge "
+                 "drifting silently strands the other two.")
+
+    def check_project(self, root: Path) -> List[Finding]:
+        findings: List[Finding] = []
+        findings.extend(self._check_metrics(root))
+        findings.extend(self._check_config_knobs(root))
+        findings.extend(self._check_stats_schema(root))
+        findings.extend(self._check_alert_rules(root))
+        return findings
+
+    # -- metrics ------------------------------------------------------------
+    def _check_metrics(self, root: Path) -> List[Finding]:
+        doc_path = root / "docs" / "OBSERVABILITY.md"
+        metrics = collect_metrics(root)
+        if not metrics or not doc_path.exists():
+            return []
+        findings: List[Finding] = []
+        registered = {m.name for m in metrics}
+        kinds: Dict[str, str] = {m.name: m.kind for m in metrics}
+        doc_text = doc_path.read_text()
+        documented = documented_metric_names(doc_text)
+        for metric in metrics:
+            if metric.kind == "counter" and not metric.name.endswith("_total"):
+                findings.append(Finding(
+                    self.id, metric.path, metric.line,
+                    f"counter {metric.name} must end _total "
+                    "(docs/OBSERVABILITY.md naming rule: "
+                    "tpuhive_<subsystem>_<what>_<unit>)"))
+            if metric.kind != "counter" and metric.name.endswith("_total"):
+                findings.append(Finding(
+                    self.id, metric.path, metric.line,
+                    f"{metric.kind} {metric.name} ends _total, the suffix "
+                    "reserved for counters — rate()/increase() over a "
+                    "gauge silently lies on dashboards"))
+            if metric.name not in documented:
+                findings.append(Finding(
+                    self.id, metric.path, metric.line,
+                    f"registered metric {metric.name} has no row in "
+                    "docs/OBSERVABILITY.md — every exported series needs "
+                    "its operator contract documented"))
+        doc_rel = doc_path.relative_to(root).as_posix()
+        seen: Set[Tuple[int, str]] = set()
+        for lineno, token, fulls in doc_metric_references(doc_text):
+            if token.startswith("tpuhive_"):
+                resolved = token in registered
+            else:
+                resolved = any(prefix + token in registered
+                               for full in fulls
+                               for prefix in _underscore_prefixes(full))
+            if not resolved and (lineno, token) not in seen:
+                seen.add((lineno, token))
+                findings.append(Finding(
+                    self.id, doc_rel, lineno,
+                    f"docs row references metric {token!r} but no such "
+                    "metric is registered — the docs drifted from the "
+                    "code (or the row's shorthand no longer expands to a "
+                    "real name)"))
+        _ = kinds
+        return findings
+
+    # -- config knobs --------------------------------------------------------
+    def _check_config_knobs(self, root: Path) -> List[Finding]:
+        config_path = root / "tensorhive_tpu" / "config.py"
+        serving_doc = root / "docs" / "SERVING.md"
+        observability_doc = root / "docs" / "OBSERVABILITY.md"
+        if not config_path.exists():
+            return []
+        try:
+            tree = ast.parse(config_path.read_text())
+        except SyntaxError:
+            return []
+        findings: List[Finding] = []
+        config_rel = config_path.relative_to(root).as_posix()
+        if serving_doc.exists():
+            text = serving_doc.read_text()
+            fields = dataclass_fields(tree, "GenerationConfig")
+            field_names = {name for name, _ in fields}
+            for name, lineno in fields:
+                if not re.search(r"\|\s*`" + re.escape(name) + r"`\s*\|",
+                                 text):
+                    findings.append(Finding(
+                        self.id, config_rel, lineno,
+                        f"[generation_service] knob {name!r} has no row in "
+                        "docs/SERVING.md's configuration table"))
+            doc_rel = serving_doc.relative_to(root).as_posix()
+            for lineno, key in serving_config_rows(text):
+                if key not in field_names:
+                    findings.append(Finding(
+                        self.id, doc_rel, lineno,
+                        f"docs/SERVING.md documents [generation_service] "
+                        f"knob {key!r} but GenerationConfig has no such "
+                        "field — the docs drifted from config.py"))
+        if observability_doc.exists():
+            text = observability_doc.read_text()
+            for name, lineno in dataclass_fields(tree, "ProfilingConfig"):
+                row = re.search(r"\|\s*`" + re.escape(name) + r"`\s*\|",
+                                text)
+                snippet = re.search(
+                    r"^\s*#?\s*" + re.escape(name) + r"\s*=", text,
+                    flags=re.MULTILINE)
+                if not row and not snippet:
+                    findings.append(Finding(
+                        self.id, config_rel, lineno,
+                        f"[profiling] knob {name!r} is not documented in "
+                        "docs/OBSERVABILITY.md (neither a table row nor "
+                        "the config snippet)"))
+        return findings
+
+    # -- stats schema vs dashboard ------------------------------------------
+    def _check_stats_schema(self, root: Path) -> List[Finding]:
+        schema_path = root / "tensorhive_tpu" / "controllers" / "generate.py"
+        ui_path = root / "tensorhive_tpu" / "app" / "static" / "js" / \
+            "nodes.js"
+        if not schema_path.exists() or not ui_path.exists():
+            return []
+        try:
+            tree = ast.parse(schema_path.read_text())
+        except SyntaxError:
+            return []
+        keys = stats_schema_keys(tree)
+        if not keys:
+            return []
+        findings: List[Finding] = []
+        ui_rel = ui_path.relative_to(root).as_posix()
+        for lineno, line in enumerate(ui_path.read_text().splitlines(),
+                                      start=1):
+            for key in STATS_REF_RE.findall(line):
+                if key not in keys:
+                    findings.append(Finding(
+                        self.id, ui_rel, lineno,
+                        f"nodes.js renders stats.{key} but STATS_SCHEMA "
+                        "(controllers/generate.py) has no such key — the "
+                        "dashboard fragment would render undefined"))
+        return findings
+
+    # -- alert pack vs rule table -------------------------------------------
+    def _check_alert_rules(self, root: Path) -> List[Finding]:
+        alerts_path = root / "tensorhive_tpu" / "observability" / "alerts.py"
+        docs = [root / "docs" / "OBSERVABILITY.md",
+                root / "docs" / "SERVING.md"]
+        docs = [d for d in docs if d.exists()]
+        if not alerts_path.exists() or not docs:
+            return []
+        try:
+            tree = ast.parse(alerts_path.read_text())
+        except SyntaxError:
+            return []
+        pack = alert_pack_rules(tree)
+        if not pack:
+            return []
+        pack_names = {name for name, _ in pack}
+        documented: Set[str] = set()
+        row_refs: List[Tuple[Path, int, str]] = []
+        for doc in docs:
+            for lineno, name in doc_rule_rows(doc.read_text()):
+                documented.add(name)
+                row_refs.append((doc, lineno, name))
+        findings: List[Finding] = []
+        alerts_rel = alerts_path.relative_to(root).as_posix()
+        for name, lineno in pack:
+            if name not in documented:
+                findings.append(Finding(
+                    self.id, alerts_rel, lineno,
+                    f"alert rule {name!r} ships in the default pack but "
+                    "has no row in the documented rule table "
+                    "(docs/OBSERVABILITY.md)"))
+        for doc, lineno, name in row_refs:
+            if name not in pack_names:
+                findings.append(Finding(
+                    self.id, doc.relative_to(root).as_posix(), lineno,
+                    f"rule table documents {name!r} but the default alert "
+                    "pack ships no rule by that name — the docs drifted "
+                    "from observability/alerts.py"))
+        return findings
+
+
+register(CrossArtifactRule())

@@ -1,0 +1,253 @@
+"""TH-DON: donation discipline around jit wrappers (flow-aware).
+
+Donation is XLA input-output aliasing: a ``donate_argnames`` buffer is
+reused for an output of the SAME shape — which only happens when the
+donated value actually flows into the return. Two failure shapes, both
+learned the hard way in PR 3 (CHANGES.md: "returning tokens alone left the
+cache donation unusable"):
+
+* **donated-but-not-returned** — the jit target has a return path through
+  which no value derived from the donated parameter flows. XLA cannot
+  alias, the donation buys nothing (and jax warns at runtime, where nobody
+  is looking); worse, the caller's buffer is still dead afterward. Taint
+  is propagated through assignments (tuple unpacking and closure
+  ``nonlocal`` rebinding included), so ``cache_k, cache_v = cache.k,
+  cache.v`` keeps the cache tainted through the body.
+* **use-after-donate** — a call site passes a buffer in donated position
+  and then reads the same name again on a reachable path without rebinding
+  it first. The donated buffer is DEAD after dispatch; reading it is a
+  runtime error on real backends. The canonical safe shape — rebinding the
+  result over the operand, ``self._cache = step(..., self._cache, ...)``
+  — is recognized and exempt, as are reads in mutually-exclusive branches
+  (``dataflow.same_branch``).
+
+Resolution is via the shared dataflow layer: every wrapper spelling the
+repo uses (partial-jit assignments, direct ``jax.jit`` calls, decorators)
+is recognized; wrappers whose target function lives elsewhere are not
+chased (lexical, like the whole gate).
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from ..dataflow import Dataflow, JitWrapper, call_argument, dotted_source
+from ..engine import Finding, ModuleContext, Rule, register
+
+
+def _assign_targets(node: ast.AST) -> List[ast.AST]:
+    if isinstance(node, ast.Assign):
+        return list(node.targets)
+    if isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        return [node.target]
+    if isinstance(node, ast.NamedExpr):
+        return [node.target]
+    return []
+
+
+def _flat_names(node: ast.AST) -> List[str]:
+    names: List[str] = []
+    if isinstance(node, ast.Name):
+        names.append(node.id)
+    elif isinstance(node, (ast.Tuple, ast.List)):
+        for element in node.elts:
+            names.extend(_flat_names(element))
+    elif isinstance(node, ast.Starred):
+        names.extend(_flat_names(node.value))
+    return names
+
+
+def _reads(node: ast.AST, tainted: Set[str]) -> bool:
+    for sub in ast.walk(node):
+        if (isinstance(sub, ast.Name) and isinstance(sub.ctx, ast.Load)
+                and sub.id in tainted):
+            return True
+    return False
+
+
+class DonationRule(Rule):
+    id = "TH-DON"
+    title = "donated buffer not aliased into a return / used after donation"
+    rationale = ("A donated operand must flow into every return path "
+                 "(donation = input-output aliasing) and must never be "
+                 "read again after the dispatch that consumed it.")
+    scope = ("tensorhive_tpu/", "tools/", "bench.py")
+
+    def check(self, module: ModuleContext) -> List[Finding]:
+        flow = module.dataflow
+        findings: List[Finding] = []
+        for wrapper in flow.jit_wrappers.values():
+            if not wrapper.has_donation():
+                continue
+            findings.extend(self._check_return_aliasing(module, flow,
+                                                        wrapper))
+            findings.extend(self._check_use_after_donate(module, flow,
+                                                         wrapper))
+        return findings
+
+    # -- every return path must carry the donated value --------------------
+    def _check_return_aliasing(self, module: ModuleContext, flow: Dataflow,
+                               wrapper: JitWrapper) -> List[Finding]:
+        fn = flow.target_function(wrapper)
+        if fn is None:
+            return []
+        findings: List[Finding] = []
+        for param in sorted(flow.donated_params(wrapper)):
+            tainted = self._taint(fn, param)
+            for ret in ast.walk(fn):
+                if not isinstance(ret, ast.Return):
+                    continue
+                if flow.enclosing_function(ret) is not fn:
+                    continue
+                if ret.value is not None and _reads(ret.value, tainted):
+                    continue
+                findings.append(Finding(
+                    self.id, module.relpath, ret.lineno,
+                    f"donated parameter {param!r} of jit target "
+                    f"{fn.name}() does not flow into this return — XLA "
+                    "cannot alias the buffer and the donation is wasted; "
+                    "return the updated value (PR 3's whole-carry rule)"))
+        return findings
+
+    @staticmethod
+    def _taint(fn: ast.AST, param: str) -> Set[str]:
+        """Names derived from ``param`` via assignments, to fixpoint."""
+        tainted: Set[str] = {param}
+        changed = True
+        while changed:
+            changed = False
+            for node in ast.walk(fn):
+                targets = _assign_targets(node)
+                if not targets:
+                    continue
+                value = getattr(node, "value", None)
+                if value is None or not _reads(value, tainted):
+                    continue
+                for target in targets:
+                    for name in _flat_names(target):
+                        if name not in tainted:
+                            tainted.add(name)
+                            changed = True
+        return tainted
+
+    # -- no reads after a donating dispatch --------------------------------
+    def _check_use_after_donate(self, module: ModuleContext, flow: Dataflow,
+                                wrapper: JitWrapper) -> List[Finding]:
+        donated_positions = flow.donated_positions(wrapper)
+        if not donated_positions:
+            return []
+        findings: List[Finding] = []
+        for call in flow.call_sites(wrapper.name):
+            fn = flow.enclosing_function(call)
+            if fn is None:
+                continue
+            for position, param in sorted(donated_positions.items()):
+                arg = call_argument(call, position, param)
+                if arg is None:
+                    continue
+                spelled = dotted_source(arg)
+                if spelled is None:
+                    continue    # derived expression: nothing nameable dies
+                if self._rebound_from_result(module, call, spelled):
+                    continue
+                if self._dispatched_in_return(module, flow, call, fn):
+                    continue    # `return wrapper(...)`: nothing after is
+                                # reachable on this path
+                later = self._later_read(module, flow, fn, call, spelled)
+                if later is not None:
+                    findings.append(Finding(
+                        self.id, module.relpath, later.lineno,
+                        f"{spelled} is read after being passed in donated "
+                        f"position {param!r} to {wrapper.name}() (line "
+                        f"{call.lineno}) — the buffer is dead after "
+                        "dispatch; rebind it from the call's result "
+                        "first"))
+        return findings
+
+    @staticmethod
+    def _dispatched_in_return(module: ModuleContext, flow: Dataflow,
+                              call: ast.Call, fn: ast.AST) -> bool:
+        for ancestor in module.ancestors(call):
+            if ancestor is fn:
+                return False
+            if isinstance(ancestor, ast.Return):
+                return True
+        return False
+
+    @staticmethod
+    def _rebound_from_result(module: ModuleContext, call: ast.Call,
+                             spelled: str) -> bool:
+        """``x = wrapper(..., x, ...)`` (possibly through tuple targets):
+        the donated operand is immediately replaced by the result."""
+        parent = module.parents.get(id(call))
+        while isinstance(parent, (ast.Tuple, ast.List)):
+            parent = module.parents.get(id(parent))
+        if not isinstance(parent, (ast.Assign, ast.AnnAssign,
+                                   ast.AugAssign, ast.NamedExpr)):
+            return False
+        for target in _assign_targets(parent):
+            stack = [target]
+            while stack:
+                node = stack.pop()
+                if isinstance(node, (ast.Tuple, ast.List)):
+                    stack.extend(node.elts)
+                elif isinstance(node, ast.Starred):
+                    stack.append(node.value)
+                elif dotted_source(node) == spelled:
+                    return True
+        return False
+
+    def _later_read(self, module: ModuleContext, flow: Dataflow,
+                    fn: ast.AST, call: ast.Call,
+                    spelled: str) -> Optional[ast.AST]:
+        """First reachable read of ``spelled`` after the call, unless a
+        rebinding comes first. Lexical line order, branch-pruned; a
+        rebind wins a same-line tie (``x = f(x)``-shaped statements)."""
+        events = []
+        in_call = {id(sub) for sub in ast.walk(call)}
+        for node in ast.walk(fn):
+            lineno = getattr(node, "lineno", None)
+            if lineno is None or lineno <= call.lineno:
+                continue
+            # a multi-line call's own arguments sit on later lines than
+            # the call node; they are the dispatch, not a later read
+            if id(node) in in_call:
+                continue
+            if flow.enclosing_function(node) is not fn:
+                continue
+            if not flow.same_branch(call, node):
+                continue
+            if any(spelled in self._target_chains(target)
+                   for target in _assign_targets(node)):
+                events.append((lineno, 0, "rebind", node))
+            elif (dotted_source(node) == spelled
+                  and isinstance(getattr(node, "ctx", None), ast.Load)):
+                # any read counts — x.k on a dead donated x is still a
+                # use-after-free of the whole buffer
+                events.append((lineno, 1, "read", node))
+        for _, _, kind, node in sorted(events, key=lambda e: (e[0], e[1])):
+            if kind == "rebind":
+                return None
+            return node
+        return None
+
+    @staticmethod
+    def _target_chains(target: ast.AST) -> Set[str]:
+        """Dotted spellings of every flat element of an assignment
+        target (tuple/list unpacking included)."""
+        chains: Set[str] = set()
+        stack = [target]
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.Tuple, ast.List)):
+                stack.extend(node.elts)
+            elif isinstance(node, ast.Starred):
+                stack.append(node.value)
+            else:
+                spelled = dotted_source(node)
+                if spelled is not None:
+                    chains.add(spelled)
+        return chains
+
+
+register(DonationRule())

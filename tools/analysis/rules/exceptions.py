@@ -73,7 +73,7 @@ class ExceptionHygieneRule(Rule):
     rationale = ("except Exception: pass makes production failures "
                  "undiagnosable; broad handlers must log, re-raise, count a "
                  "metric, or consume the exception value.")
-    scope = ("tensorhive_tpu/", "tools/", "bench.py")
+    scope = ("tensorhive_tpu/", "tools/", "tests/", "bench.py")
 
     def check(self, module: ModuleContext) -> List[Finding]:
         findings: List[Finding] = []

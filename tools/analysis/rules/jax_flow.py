@@ -1,0 +1,186 @@
+"""TH-JIT: recompile hazards around jit wrappers (flow-aware).
+
+The serving data plane's whole performance story rests on "one executable,
+forever": per-slot state, page tables and positions are TRACED operands;
+everything shape- or dispatch-determining is STATIC and constant for the
+engine's lifetime (serving/engine.py). The quiet way to lose that is at a
+CALL SITE — a value that varies per request or per iteration flowing into a
+static position mints a new executable per distinct value, and nothing
+crashes: latency just collapses one compile at a time. Three shapes, all
+resolved through the shared dataflow layer (``ModuleContext.dataflow`` —
+``jax.jit(f, ...)`` / ``functools.partial(jax.jit, ...)(f)`` assignments
+and jit decorators are recognized alike):
+
+* **loop-varying static argument** — a call to a known jit wrapper inside
+  a ``for``/``while`` loop passing a name that is (re)bound inside that
+  loop in a static position. One recompile per distinct value; inside a
+  request loop, one per request.
+* **host branch on a traced parameter** — ``if``/``while`` on a
+  non-static parameter inside a jit target's body either raises
+  ``TracerBoolConversionError`` or silently bakes one branch into the
+  compiled program. ``x is None`` tests and ``.shape``/``.dtype``/
+  ``.ndim``/``.size`` accesses are trace-time facts and exempt.
+* **unfingerprinted serving dispatch** — in ``tensorhive_tpu/serving/``,
+  every direct call to a jit wrapper must sit in a function that also
+  routes through the ``_count_compile`` fingerprint seam
+  (``tpuhive_decode_compile_total`` — docs/OBSERVABILITY.md): a dispatch
+  the counter cannot see is a recompile the zero-recompile gates cannot
+  catch.
+
+Lexical and module-flat like the rest of the gate: wrappers called through
+locals/imports are not chased.
+"""
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from ..dataflow import Dataflow, JitWrapper, call_argument
+from ..engine import Finding, ModuleContext, Rule, register
+
+#: attribute reads on a traced value that are trace-time constants
+SHAPE_ATTRS = {"shape", "dtype", "ndim", "size"}
+
+#: functions in serving/ that ARE the fingerprint seam (calling one of
+#: these before the dispatch satisfies the contract)
+COMPILE_SEAM_MARKERS = ("count_compile", "count_prefill_compile",
+                        "count_chunk_prefill_compile")
+
+
+def _reads_seam(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            func = node.func
+            name = (func.id if isinstance(func, ast.Name)
+                    else func.attr if isinstance(func, ast.Attribute)
+                    else "")
+            if any(marker in name for marker in COMPILE_SEAM_MARKERS):
+                return True
+    return False
+
+
+class JitRecompileRule(Rule):
+    id = "TH-JIT"
+    title = "recompile hazard at a jit wrapper (static-arg flow / traced branch / unfingerprinted dispatch)"
+    rationale = ("A per-iteration value in a static position or a host "
+                 "branch on a traced param silently mints one executable "
+                 "per distinct value — the zero-recompile contract dies "
+                 "without a crash.")
+    scope = ("tensorhive_tpu/", "tools/", "bench.py")
+
+    def check(self, module: ModuleContext) -> List[Finding]:
+        flow = module.dataflow
+        findings: List[Finding] = []
+        for wrapper in flow.jit_wrappers.values():
+            findings.extend(self._check_traced_branches(module, flow,
+                                                        wrapper))
+            findings.extend(self._check_call_sites(module, flow, wrapper))
+        if module.relpath.startswith("tensorhive_tpu/serving/"):
+            findings.extend(self._check_fingerprint_seam(module, flow))
+        return findings
+
+    # -- loop-varying static args ------------------------------------------
+    def _check_call_sites(self, module: ModuleContext, flow: Dataflow,
+                          wrapper: JitWrapper) -> List[Finding]:
+        static_positions = flow.static_positions(wrapper)
+        if not static_positions:
+            return []
+        findings: List[Finding] = []
+        for call in flow.call_sites(wrapper.name):
+            loops = flow.enclosing_loops(call)
+            if not loops:
+                continue
+            loop_bound: Set[str] = set()
+            for loop in loops:
+                loop_bound |= Dataflow.bound_in(loop)
+            for position, param in static_positions.items():
+                arg = call_argument(call, position, param)
+                if isinstance(arg, ast.Name) and arg.id in loop_bound:
+                    findings.append(Finding(
+                        self.id, module.relpath, call.lineno,
+                        f"loop-varying value {arg.id!r} flows into static "
+                        f"position {param!r} of jit-wrapped "
+                        f"{wrapper.name}() — one recompile per distinct "
+                        "value; make it a traced operand or hoist it out "
+                        "of the loop"))
+        return findings
+
+    # -- host branches on traced params ------------------------------------
+    def _check_traced_branches(self, module: ModuleContext, flow: Dataflow,
+                               wrapper: JitWrapper) -> List[Finding]:
+        fn = flow.target_function(wrapper)
+        if fn is None:
+            return []
+        params = flow.target_params(wrapper)
+        statics = flow.static_params(wrapper)
+        traced = [p for p in params if p not in statics and p != "self"]
+        if not traced:
+            return []
+        findings: List[Finding] = []
+        seen: Set[tuple] = set()
+        for node in ast.walk(fn):
+            if not isinstance(node, (ast.If, ast.While)):
+                continue
+            # branches inside nested defs belong to the nested function
+            # (helpers are dispatched traced, e.g. closure attends)
+            if flow.enclosing_function(node) is not fn:
+                continue
+            for name in self._traced_reads(module, node.test, traced):
+                if (node.lineno, name) in seen:
+                    continue
+                seen.add((node.lineno, name))
+                findings.append(Finding(
+                    self.id, module.relpath, node.lineno,
+                    f"host-Python branch on traced parameter {name!r} "
+                    f"inside jit target {fn.name}() — fails to trace or "
+                    "bakes one branch into the executable; use jnp.where/"
+                    "lax.cond, or declare it static"))
+        return findings
+
+    def _traced_reads(self, module: ModuleContext, test: ast.AST,
+                      traced: List[str]) -> List[str]:
+        names: List[str] = []
+        for node in ast.walk(test):
+            if not (isinstance(node, ast.Name)
+                    and isinstance(node.ctx, ast.Load)
+                    and node.id in traced):
+                continue
+            parent = module.parents.get(id(node))
+            # trace-time facts: x.shape / x.dtype / x is None / x is not y
+            if isinstance(parent, ast.Attribute) and \
+                    parent.attr in SHAPE_ATTRS:
+                continue
+            if isinstance(parent, ast.Compare) and \
+                    all(isinstance(op, (ast.Is, ast.IsNot))
+                        for op in parent.ops):
+                continue
+            # len(x) on a traced array is a shape fact too
+            if (isinstance(parent, ast.Call)
+                    and isinstance(parent.func, ast.Name)
+                    and parent.func.id == "len"):
+                continue
+            names.append(node.id)
+        return names
+
+    # -- serving fingerprint seam ------------------------------------------
+    def _check_fingerprint_seam(self, module: ModuleContext,
+                                flow: Dataflow) -> List[Finding]:
+        findings: List[Finding] = []
+        for wrapper in flow.jit_wrappers.values():
+            for call in flow.call_sites(wrapper.name):
+                fn = flow.enclosing_function(call)
+                if fn is None:
+                    continue        # module-level warmup/bench dispatch
+                if _reads_seam(fn):
+                    continue
+                findings.append(Finding(
+                    self.id, module.relpath, call.lineno,
+                    f"serving dispatch of jit-wrapped {wrapper.name}() in "
+                    f"{fn.name}() is not routed through the _count_compile "
+                    "fingerprint seam — its compiles are invisible to "
+                    "tpuhive_decode_compile_total and the zero-recompile "
+                    "gates"))
+        return findings
+
+
+register(JitRecompileRule())

@@ -72,7 +72,10 @@ class JaxHostSyncRule(Rule):
     rationale = ("Device->host conversions inside jitted functions break "
                  "tracing; inside eval loops they serialize async dispatch "
                  "to one blocking round-trip per batch.")
-    scope = ("tensorhive_tpu/",)
+    #: the jitted-purity half runs everywhere jax code lives (tools smoke
+    #: scripts and tests define real jitted fns too); the loop half stays
+    #: scoped to LOOP_SCOPES where loop bodies plausibly hold device values
+    scope = ("tensorhive_tpu/", "tools/", "tests/", "bench.py")
 
     def check(self, module: ModuleContext) -> List[Finding]:
         findings: List[Finding] = []

@@ -1,0 +1,338 @@
+"""TH-REF: refcounted-resource pairing and the ``_locked`` convention.
+
+The paged serving engine's correctness hangs on exact pairing: every
+``PagePool.assign``/``assign_shared`` grant is undone by ``release``, every
+prefix-tree ``cache_ref`` retention by ``cache_unref`` — an unpaired
+acquire leaks pages until admission starves (the "free+live == pool_size"
+invariant the churn property tests pin), and the bug class costs exactly
+what a memory leak costs: nothing fails, capacity just evaporates. Checks,
+on the lexical receiver spelling (``self._pool``, ``pool`` — not chased
+through aliases):
+
+* **unpaired acquire** — a class (or module top level) that calls an
+  acquire method on some receiver but never the paired release on the same
+  receiver. Classes that *define* the paired release are the resource
+  itself, not a holder, and are exempt (``PagePool.assign`` calling
+  ``self.assign_shared`` is implementation, not holding).
+* **early return between acquire and release** — inside one function that
+  both acquires and releases a receiver, a ``return`` between the two
+  leaks the grant on that path; a release in a ``finally`` that encloses
+  the return is recognized as covering it.
+* **swallowed-exception leak** — an acquire inside a ``try`` whose broad
+  handler neither releases, re-raises, nor returns the resource: the
+  failure path keeps the grant with nobody holding it.
+
+The ``_locked`` suffix is this codebase's caller-holds-the-lock contract
+(serving/engine.py): a method named ``*_locked`` asserts its caller
+already holds the instance lock. Two violations:
+
+* a ``*_locked`` method that ACQUIRES the class lock itself — instant
+  deadlock on a plain ``threading.Lock`` the moment the contract is
+  honored by the caller;
+* a call to ``self.*_locked(...)`` from outside any ``with self.<lock>:``
+  block and outside another ``*_locked`` method — the contract broken at
+  the call site, i.e. unguarded mutation of guarded state.
+
+(TH-C consumes the same convention from the other side: writes inside a
+``*_locked`` method count as guarded.)
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..dataflow import dotted_source
+from ..engine import Finding, ModuleContext, Rule, register
+from .concurrency import _is_lock_value, _self_attr
+
+#: acquire method name -> the release method that must pair with it
+PAIRS = {
+    "assign": "release",
+    "assign_shared": "release",
+    "cache_ref": "cache_unref",
+}
+RELEASES = set(PAIRS.values())
+BROAD_TYPES = {"Exception", "BaseException"}
+
+
+def _method_call(node: ast.Call) -> Optional[Tuple[str, str]]:
+    """(receiver-spelling, method) for ``recv.method(...)`` calls."""
+    func = node.func
+    if not isinstance(func, ast.Attribute):
+        return None
+    receiver = dotted_source(func.value)
+    if receiver is None:
+        return None
+    return receiver, func.attr
+
+
+class RefcountPairingRule(Rule):
+    id = "TH-REF"
+    title = "unpaired resource acquire / leak path / _locked convention break"
+    rationale = ("Page grants and cache retentions must pair exactly — an "
+                 "unpaired acquire or a leaking early-return/except path "
+                 "bleeds pool capacity with no failure; _locked methods "
+                 "must be called with the lock actually held.")
+    scope = ("tensorhive_tpu/", "tools/")
+
+    def check(self, module: ModuleContext) -> List[Finding]:
+        if module.tree is None:
+            return []
+        findings: List[Finding] = []
+        findings.extend(self._check_pairing(module))
+        findings.extend(self._check_leak_paths(module))
+        findings.extend(self._check_locked_convention(module))
+        return findings
+
+    # -- scope grouping -----------------------------------------------------
+    def _owner_of(self, module: ModuleContext,
+                  node: ast.AST) -> Optional[ast.ClassDef]:
+        return module.nearest_class(node)
+
+    def _defined_methods(self, cls: Optional[ast.ClassDef]) -> Set[str]:
+        if cls is None:
+            return set()
+        return {stmt.name for stmt in cls.body
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+    # -- unpaired acquires --------------------------------------------------
+    def _check_pairing(self, module: ModuleContext) -> List[Finding]:
+        # owner (class node id or None) -> {method: [(receiver, call)]}
+        acquires: Dict[Optional[int], List[Tuple[str, str, ast.Call]]] = {}
+        releases: Dict[Optional[int], Set[Tuple[str, str]]] = {}
+        owners: Dict[Optional[int], Optional[ast.ClassDef]] = {}
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            spelled = _method_call(node)
+            if spelled is None:
+                continue
+            receiver, method = spelled
+            cls = self._owner_of(module, node)
+            key = id(cls) if cls is not None else None
+            owners[key] = cls
+            if method in PAIRS:
+                acquires.setdefault(key, []).append((receiver, method, node))
+            if method in RELEASES:
+                releases.setdefault(key, set()).add((receiver, method))
+        findings: List[Finding] = []
+        for key, sites in acquires.items():
+            cls = owners.get(key)
+            defined = self._defined_methods(cls)
+            for receiver, method, call in sites:
+                release = PAIRS[method]
+                if release in defined:
+                    continue    # the resource's own implementation
+                if (receiver, release) in releases.get(key, set()):
+                    continue
+                where = f"class {cls.name}" if cls is not None else "module"
+                findings.append(Finding(
+                    self.id, module.relpath, call.lineno,
+                    f"{receiver}.{method}() acquires a refcounted resource "
+                    f"but {where} never calls {receiver}.{release}() — "
+                    "the grant can never be returned (pool capacity "
+                    "leaks)"))
+        return findings
+
+    # -- early returns / swallowed exceptions between acquire and release --
+    def _check_leak_paths(self, module: ModuleContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for fn in ast.walk(module.tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            calls = []
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Call):
+                    spelled = _method_call(node)
+                    if spelled is not None:
+                        calls.append((spelled[0], spelled[1], node))
+            for receiver, method, acquire in calls:
+                if method not in PAIRS:
+                    continue
+                release = PAIRS[method]
+                release_sites = [c for r, m, c in calls
+                                 if r == receiver and m == release]
+                if release_sites:
+                    findings.extend(self._early_returns(
+                        module, fn, receiver, method, acquire,
+                        release_sites))
+                findings.extend(self._swallowed_paths(
+                    module, fn, receiver, method, release, acquire, calls))
+        return findings
+
+    def _early_returns(self, module: ModuleContext, fn: ast.AST,
+                       receiver: str, method: str, acquire: ast.Call,
+                       release_sites: List[ast.Call]) -> List[Finding]:
+        last_release = max(c.lineno for c in release_sites)
+        in_finally = any(self._in_enclosing_finally(module, c, fn)
+                         for c in release_sites)
+        findings: List[Finding] = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Return):
+                continue
+            if not acquire.lineno < node.lineno < last_release:
+                continue
+            if module.dataflow.enclosing_function(node) is not fn:
+                continue
+            if not module.dataflow.same_branch(acquire, node):
+                continue
+            if in_finally:
+                continue        # finally runs on every return path
+            findings.append(Finding(
+                self.id, module.relpath, node.lineno,
+                f"early return between {receiver}.{method}() (line "
+                f"{acquire.lineno}) and {receiver}.{PAIRS[method]}() "
+                f"(line {last_release}) leaks the grant on this path — "
+                "release in a finally:, or before returning"))
+        return findings
+
+    def _swallowed_paths(self, module: ModuleContext, fn: ast.AST,
+                         receiver: str, method: str, release: str,
+                         acquire: ast.Call, calls) -> List[Finding]:
+        findings: List[Finding] = []
+        for ancestor in module.ancestors(acquire):
+            if isinstance(ancestor, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                break
+            if not isinstance(ancestor, ast.Try):
+                continue
+            if ancestor.finalbody and any(
+                    c.lineno for r, m, c in calls
+                    if r == receiver and m == release
+                    and self._inside(module, c, ancestor.finalbody)):
+                continue        # finally releases: every path covered
+            # only flag when the acquire is in the TRY BODY (not a handler)
+            if self._branch_of_try(module, acquire, ancestor) != "body":
+                continue
+            for handler in ancestor.handlers:
+                if not self._is_broad(handler):
+                    continue
+                handled = [(r, m) for r, m, c in calls
+                           if self._inside(module, c, handler.body)]
+                if (receiver, release) in handled:
+                    continue
+                if any(isinstance(n, ast.Raise)
+                       for stmt in handler.body for n in ast.walk(stmt)):
+                    continue
+                findings.append(Finding(
+                    self.id, module.relpath, handler.lineno,
+                    f"broad except swallows failures after "
+                    f"{receiver}.{method}() (line {acquire.lineno}) "
+                    f"without calling {receiver}.{release}() — the "
+                    "exception path leaks the grant"))
+        return findings
+
+    @staticmethod
+    def _is_broad(handler: ast.ExceptHandler) -> bool:
+        if handler.type is None:
+            return True
+        if isinstance(handler.type, ast.Name):
+            return handler.type.id in BROAD_TYPES
+        if isinstance(handler.type, ast.Tuple):
+            return any(isinstance(e, ast.Name) and e.id in BROAD_TYPES
+                       for e in handler.type.elts)
+        return False
+
+    @staticmethod
+    def _inside(module: ModuleContext, node: ast.AST, stmts) -> bool:
+        chain = {id(node)} | {id(a) for a in module.ancestors(node)}
+        return any(id(stmt) in chain for stmt in stmts)
+
+    def _branch_of_try(self, module: ModuleContext, node: ast.AST,
+                       try_node: ast.Try) -> Optional[str]:
+        if self._inside(module, node, try_node.body):
+            return "body"
+        if self._inside(module, node, try_node.orelse):
+            return "orelse"
+        if self._inside(module, node, try_node.finalbody):
+            return "finally"
+        return "handler"
+
+    def _in_enclosing_finally(self, module: ModuleContext, node: ast.AST,
+                              fn: ast.AST) -> bool:
+        for ancestor in module.ancestors(node):
+            if ancestor is fn:
+                break
+            if isinstance(ancestor, ast.Try) and \
+                    self._inside(module, node, ancestor.finalbody):
+                return True
+        return False
+
+    # -- the _locked convention --------------------------------------------
+    def _check_locked_convention(self, module: ModuleContext) -> List[Finding]:
+        findings: List[Finding] = []
+        for cls in ast.walk(module.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            lock_attrs = self._lock_attrs(module, cls)
+            if not lock_attrs:
+                continue
+            findings.extend(self._check_class_locked(module, cls,
+                                                     lock_attrs))
+        return findings
+
+    def _lock_attrs(self, module: ModuleContext,
+                    cls: ast.ClassDef) -> Set[str]:
+        attrs: Set[str] = set()
+        for node in ast.walk(cls):
+            if module.nearest_class(node) is not cls:
+                continue
+            if isinstance(node, ast.Assign) and _is_lock_value(node.value):
+                for target in node.targets:
+                    attr = _self_attr(target)
+                    if attr is not None:
+                        attrs.add(attr)
+        return attrs
+
+    def _check_class_locked(self, module: ModuleContext, cls: ast.ClassDef,
+                            lock_attrs: Set[str]) -> List[Finding]:
+        findings: List[Finding] = []
+        for node in ast.walk(cls):
+            if module.nearest_class(node) is not cls:
+                continue
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name.endswith("_locked"):
+                for sub in ast.walk(node):
+                    if isinstance(sub, (ast.With, ast.AsyncWith)):
+                        for item in sub.items:
+                            attr = _self_attr(item.context_expr)
+                            if attr in lock_attrs:
+                                findings.append(Finding(
+                                    self.id, module.relpath, sub.lineno,
+                                    f"{node.name}() acquires self.{attr} "
+                                    "— its _locked suffix promises the "
+                                    "caller already holds it (deadlock "
+                                    "on a non-reentrant Lock)"))
+            if isinstance(node, ast.Call):
+                func = node.func
+                if not (isinstance(func, ast.Attribute)
+                        and func.attr.endswith("_locked")
+                        and isinstance(func.value, ast.Name)
+                        and func.value.id == "self"):
+                    continue
+                if self._lock_held(module, node, lock_attrs):
+                    continue
+                findings.append(Finding(
+                    self.id, module.relpath, node.lineno,
+                    f"self.{func.attr}() called without holding "
+                    f"{'/'.join('self.' + a for a in sorted(lock_attrs))} "
+                    "— the _locked suffix is the caller-holds-the-lock "
+                    "contract (wrap the call in `with self._lock:` or "
+                    "call from another _locked method)"))
+        return findings
+
+    @staticmethod
+    def _lock_held(module: ModuleContext, node: ast.AST,
+                   lock_attrs: Set[str]) -> bool:
+        for ancestor in module.ancestors(node):
+            if isinstance(ancestor, (ast.With, ast.AsyncWith)):
+                for item in ancestor.items:
+                    if _self_attr(item.context_expr) in lock_attrs:
+                        return True
+            if isinstance(ancestor, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return ancestor.name.endswith("_locked")
+            if isinstance(ancestor, ast.ClassDef):
+                break
+        return False
+
+
+register(RefcountPairingRule())
