@@ -2,7 +2,7 @@
 # mypy + flake8 per .circleci/config.yml:33-38): the dependency-free AST
 # lint + thivelint analyzer always run; mypy/ruff run when installed
 # (absent from this image).
-.PHONY: check lint analysis analysis-fast test bench probe metrics-smoke decode-smoke alerts-smoke chaos-smoke serving-smoke serving-mesh-smoke trace-smoke prefix-smoke
+.PHONY: check lint analysis analysis-fast test bench probe metrics-smoke decode-smoke alerts-smoke chaos-smoke serving-smoke serving-mesh-smoke trace-smoke prefix-smoke spec-smoke
 
 check: lint analysis
 	@command -v ruff >/dev/null 2>&1 && ruff check . || echo "ruff not installed; skipped (tools/lint.py covered the always-on subset)"
@@ -87,6 +87,14 @@ trace-smoke:
 # post-warmup recompiles (docs/SERVING.md "Prefix cache & chunked prefill")
 prefix-smoke:
 	python tools/prefix_smoke.py
+
+# speculative decoding lane over a real socket: the spec-on stream must be
+# token-identical to the spec-off stream, acceptance counters scrapeable,
+# ledger rows carrying draftTokens/acceptedTokens, zero post-warmup
+# recompiles across speculative ticks (docs/SERVING.md "Speculative
+# decoding")
+spec-smoke:
+	python tools/spec_smoke.py
 
 probe:
 	$(MAKE) -C tensorhive_tpu/native

@@ -258,6 +258,11 @@ async function refreshServing() {
                    (stats.prefixHitRate == null ? "–" :
                     (100 * stats.prefixHitRate).toFixed(0) + "% hit") +
                    " · " + stats.cachedPages + " pg", false)}
+    ${stats.speculative !== "on" ? "" :
+      servingBadge("spec ×" + stats.specTokens,
+                   (stats.specAcceptanceRate == null ? "–" :
+                    (100 * stats.specAcceptanceRate).toFixed(0) +
+                    "% accept"), false)}
     ${servingBadge("TTFT p50/p95",
                    ms(stats.ttftP50Ms) + " / " + ms(stats.ttftP95Ms), false)}
     ${servingBadge("inter-token p50",

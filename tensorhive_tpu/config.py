@@ -207,6 +207,19 @@ class GenerationConfig:
                                      # interleaved with decode steps; 0 =
                                      # one chunk per prompt (prefix-cache
                                      # engines only)
+    speculative: str = "auto"        # draft-model speculative decoding
+                                     # (docs/SERVING.md "Speculative
+                                     # decoding"): auto = on only on real
+                                     # TPU; off = byte-identical rollback.
+                                     # Greedy output is token-identical to
+                                     # non-speculative either way
+    draft_preset: str = ""           # draft model preset (must share the
+                                     # vocab); "" = self-draft from the
+                                     # target's first draft_layers layers
+    draft_layers: int = 0            # self-draft depth (0 = half the
+                                     # target's layers, min 1)
+    spec_tokens: int = 4             # draft tokens proposed + verified in
+                                     # one batched pass per tick
     queue_depth: int = 32
     max_new_tokens: int = 128        # per-request cap
     top_k: int = 0                   # 0 = no top-k sampling filter
@@ -480,6 +493,10 @@ enabled = false
 # prefix_cache = "auto"  # radix shared-prefix page cache: auto|on|off
 # prefix_min_tokens = 32
 # prefill_chunk_tokens = 256  # per-tick prefill budget (chunked prefill)
+# speculative = "auto"  # draft-lane speculative decoding: auto|on|off
+# draft_preset = ""     # "" = self-draft from truncated target layers
+# draft_layers = 0      # self-draft depth (0 = half the target's layers)
+# spec_tokens = 4       # draft proposals verified per tick
 # queue_depth = 32
 # max_new_tokens = 128
 # max_concurrent_per_user = 4

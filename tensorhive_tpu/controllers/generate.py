@@ -66,6 +66,14 @@ STATS_SCHEMA = obj(
     prefixHitRate=s("number", nullable=True),
     cachedPages=s("integer", nullable=True),
     prefillChunkTokens=s("integer", nullable=True),
+    #: speculative decoding lane (docs/SERVING.md "Speculative decoding"):
+    #: "on"/"off", the per-tick proposal depth, and the lifetime draft
+    #: acceptance counters/rate the serving-strip spec badge renders
+    speculative=s("string"),
+    specTokens=s("integer", nullable=True),
+    specProposed=s("integer"),
+    specAccepted=s("integer"),
+    specAcceptanceRate=s("number", nullable=True),
     requestsCompleted=s("integer"),
     tokensEmitted=s("integer"),
     steps=s("integer"),

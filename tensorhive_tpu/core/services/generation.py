@@ -227,6 +227,10 @@ def build_engine(config: Config):
         prefix_cache=generation.prefix_cache,
         prefix_min_tokens=generation.prefix_min_tokens,
         prefill_chunk_tokens=generation.prefill_chunk_tokens,
+        speculative=generation.speculative,
+        draft_preset=generation.draft_preset,
+        draft_layers=generation.draft_layers,
+        spec_tokens=generation.spec_tokens,
         mesh=mesh,
         queue_depth=generation.queue_depth,
         top_k=generation.top_k or None,

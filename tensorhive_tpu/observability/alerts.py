@@ -463,6 +463,20 @@ def _serving_kv_page_saturation() -> Optional[float]:
     return engine.kv_page_saturation()
 
 
+def _serving_spec_acceptance() -> Optional[float]:
+    """Lifetime draft-token acceptance rate of the speculative lane (None
+    while no engine is installed, the lane is off, or too few tokens have
+    been proposed to judge — engine.spec_acceptance_rate debounces). A low
+    rate means draft compute is being spent without shortening decode
+    (docs/SERVING.md 'Speculative decoding')."""
+    from ..serving import get_engine
+
+    engine = get_engine()
+    if engine is None:
+        return None
+    return engine.spec_acceptance_rate()
+
+
 def _serving_stalled_slot_counter(
         leak_after_s: float) -> Callable[[], Optional[float]]:
     """Source callable: busy slots that have emitted nothing for
@@ -624,6 +638,17 @@ def default_rule_pack(monitoring_interval_s: Optional[float] = None,
                         "needs; raise kv_pages or shorten prompts "
                         "(docs/SERVING.md 'Prefix cache & chunked "
                         "prefill')"),
+        AlertRule(
+            name="spec_acceptance_low", severity="warning",
+            kind="threshold", op="<", threshold=0.1,
+            for_s=2 * alert_interval_s,
+            source=_serving_spec_acceptance,
+            description="the speculative draft lane's acceptance rate is "
+                        "under 10% — draft passes are being paid without "
+                        "shortening decode; lower spec_tokens, deepen "
+                        "draft_layers / pick a better draft_preset, or "
+                        "set speculative=off (docs/SERVING.md "
+                        "'Speculative decoding')"),
         AlertRule(
             name="generate_slot_leak", severity="critical",
             kind="threshold", op=">", threshold=0.0,

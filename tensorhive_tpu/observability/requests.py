@@ -82,6 +82,13 @@ class RequestRecord:
     ttft_ms: Optional[float] = None
     decode_ms: Optional[float] = None      # first token -> last token
     total_ms: Optional[float] = None
+    #: speculative decoding lane (docs/SERVING.md "Speculative decoding"):
+    #: draft tokens proposed for this request / accepted by the batched
+    #: verify (None: lane off or the request predates it; acceptance
+    #: measures draft agreement per verify, emission may truncate shorter
+    #: at EOS or the max_new budget)
+    draft_tokens: Optional[int] = None
+    accepted_tokens: Optional[int] = None
     tokens: int = 0
     finished_ts: Optional[float] = None
     #: raw inter-token gaps (ms); bounded by max_new_tokens <= the engine cap
@@ -117,6 +124,11 @@ class RequestRecord:
             "ttftMs": ms(self.ttft_ms),
             "decodeMs": ms(self.decode_ms),
             "totalMs": ms(self.total_ms),
+            "draftTokens": self.draft_tokens,
+            "acceptedTokens": self.accepted_tokens,
+            "acceptanceRate": (round(self.accepted_tokens
+                                     / self.draft_tokens, 4)
+                               if self.draft_tokens else None),
             "tokens": self.tokens,
             "intertokenP50Ms": self.intertoken_p50_ms(),
         }

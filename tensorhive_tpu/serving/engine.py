@@ -107,6 +107,13 @@ from ..ops.paged_attention import resolve_paged_kernel
 from . import QueueFullError, RateLimitError
 from .paging import TRASH_PAGE, PagePool
 from .prefix_cache import PrefixCache
+from .speculative import (
+    SpeculativeLane,
+    _paged_spec_verify,
+    _spec_verify,
+    build_draft,
+    resolve_speculative,
+)
 
 # -- metrics (registered once at import; one exposition surface) -------------
 _REQUESTS = get_registry().counter(
@@ -182,6 +189,15 @@ _PREFILL_CHUNKS = get_registry().histogram(
     "long prompts split across scheduler ticks so decode latency stays "
     "flat — docs/SERVING.md 'Prefix cache & chunked prefill').",
     buckets=(1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0))
+_SPEC_PROPOSED = get_registry().counter(
+    "tpuhive_generate_spec_proposed_total",
+    "Draft tokens proposed to the speculative verify pass (greedy slots "
+    "only; docs/SERVING.md 'Speculative decoding').")
+_SPEC_ACCEPTED = get_registry().counter(
+    "tpuhive_generate_spec_accepted_total",
+    "Draft tokens the target's batched verify accepted — "
+    "accepted/proposed is the acceptance rate the spec_acceptance_low "
+    "alert watches.")
 
 
 # -- device functions ---------------------------------------------------------
@@ -683,6 +699,10 @@ class SlotEngine:
         prefix_cache: str = "auto",
         prefix_min_tokens: int = 32,
         prefill_chunk_tokens: int = 256,
+        speculative: str = "auto",
+        draft_preset: str = "",
+        draft_layers: int = 0,
+        spec_tokens: int = 4,
         mesh=None,
         clock: Callable[[], float] = time.monotonic,
     ) -> None:
@@ -868,6 +888,37 @@ class SlotEngine:
         self.prefix_hits = 0
         self.prefix_misses = 0
 
+        # -- speculative decoding lane (docs/SERVING.md "Speculative
+        # decoding"). auto = on only on real TPU (the CPU draft overhead
+        # makes speculation a slowdown there — resolve_speculative); off is
+        # a byte-identical rollback: serving/speculative.py is never
+        # imported into the dispatch path, the PR 6-11 executables keep
+        # their fingerprints, and the stats/ledger spec fields read
+        # off/None. With the lane on, the legacy step executable is never
+        # dispatched: every tick is draft-propose + batched verify, and a
+        # zero-accepted tick emits exactly the one token the legacy step
+        # would have (the token-identity contract test_speculative.py pins).
+        if spec_tokens < 1:
+            raise ValueError(f"spec_tokens must be >= 1, got {spec_tokens}")
+        self.spec_tokens = int(spec_tokens)
+        self.speculative = resolve_speculative(speculative)
+        self._spec = None
+        if self.speculative == "on":
+            draft_params, draft_config, shares = build_draft(
+                self.params, config, draft_preset=draft_preset,
+                draft_layers=draft_layers)
+            self._spec = SpeculativeLane(self, draft_params, draft_config,
+                                         shares)
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        #: per-slot tokens accepted since the draft lane last caught up
+        #: (the right-aligned propose window; [] while the slot is free)
+        self._spec_windows: List[List[int]] = [[] for _ in
+                                               range(self.capacity)]
+        #: per-slot last legal write position (prompt + max_new - 1); -1
+        #: for free slots so speculative writes to them always drop
+        self._pos_limits = np.full(self.capacity, -1, np.int32)
+
         _QUEUE_CAPACITY.set(self.queue_depth)
         _SLOTS_TOTAL.set(self.capacity)
         _QUEUE_DEPTH.set(0)
@@ -906,7 +957,10 @@ class SlotEngine:
         """The jitted step function this engine dispatches —
         ``.step_executable._cache_size()`` is the recompile ground truth
         the smoke gate and tests assert on (paged and contiguous engines
-        use different executables)."""
+        use different executables; a speculative engine's "step" is the
+        batched verify pass, the legacy step never runs)."""
+        if self._spec is not None:
+            return _paged_spec_verify if self.paged else _spec_verify
         return _paged_serving_step if self.paged else _serving_step
 
     @property
@@ -914,6 +968,15 @@ class SlotEngine:
         if self._use_chunk_prefill:
             return _paged_chunk_serving_prefill
         return _paged_serving_prefill if self.paged else _serving_prefill
+
+    @property
+    def spec_draft_executable(self):
+        """The draft lane's jitted propose function (None with the lane
+        off) — the other half of the speculative zero-recompile ground
+        truth (draft prefill mirrors ride ``prefill_executable``)."""
+        if self._spec is None:
+            return None
+        return self._spec.propose_executable
 
     # -- admission --------------------------------------------------------
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
@@ -1102,6 +1165,9 @@ class SlotEngine:
                 # warmup compiles without touching any page
                 self._dispatch_chunk_prefill(np.zeros((1, width), np.int32),
                                              slot=0, start=0, real_len=0)
+                if self._spec is not None:
+                    self._spec.chunk_prefill(np.zeros((1, width), np.int32),
+                                             0, 0, 0)
         else:
             buckets = {_prefill_bucket(max(1, length - 1), self.max_len - 1)
                        for length in prompt_lens} or {
@@ -1112,6 +1178,23 @@ class SlotEngine:
                 # page), so warmup compiles without touching any page
                 self._dispatch_prefill(np.zeros((1, width), np.int32),
                                        slot=0, real_len=0)
+                if self._spec is not None:
+                    self._spec.prefill(np.zeros((1, width), np.int32), 0, 0)
+        if self._spec is not None:
+            # a speculative engine's steady state is propose + verify, not
+            # the legacy step — warm exactly those (fresh-engine state:
+            # empty windows, limits -1, so every speculative write drops)
+            with self._lock:
+                window, lens, limits, page_table = \
+                    self._spec_operands_locked()
+            proposals = np.asarray(self._spec.propose(
+                window, lens, self._positions, limits, page_table))
+            verify_window = np.concatenate(
+                [self._tokens[:, None], proposals], axis=1)
+            greedy, _ = self._run_spec_verify(verify_window, limits,
+                                              page_table)
+            np.asarray(greedy)  # force the compile before traffic arrives
+            return
         chosen, self._cache, self._key = self._run_step()
         np.asarray(chosen)      # force the compile before traffic arrives
 
@@ -1302,6 +1385,10 @@ class SlotEngine:
                 self._slots[free] = _Slot(request=request,
                                           joined_ts=joined_ts,
                                           cached_tokens=cached_tokens)
+                # last legal write position for the speculative window
+                # (free slots sit at -1 so their speculative writes drop)
+                self._pos_limits[free] = (len(request.prompt)
+                                          + request.max_new_tokens - 1)
                 # the queue phase closes HERE, separately from TTFT: the
                 # queue share is what admission tuning moves, the prefill
                 # share is what bucket/kernel work moves
@@ -1366,6 +1453,10 @@ class SlotEngine:
             started = self.clock()
             compile_event = self._dispatch_prefill(head, slot,
                                                    prompt_len - 1)
+            if self._spec is not None:
+                # mirror the prompt into the draft lane's K/V — same head,
+                # same slot/table row, draft params (speculative.py)
+                self._spec.prefill(head, slot, prompt_len - 1)
             # host dispatch time: the device work itself drains inside the
             # first decode step (jax is async), which TTFT captures — a
             # block_until_ready here would serialize joins against the
@@ -1389,6 +1480,8 @@ class SlotEngine:
             self._positions[slot] = prompt_len - 1
             self._temps[slot] = request.temperature
             self._active[slot] = True
+            # the draft's first catch-up window: just the current token
+            self._spec_windows[slot] = [int(prompt[-1])]
 
     def _advance_prefills(self) -> None:
         """Dispatch ONE prefill chunk for every slot still mid-prefill —
@@ -1429,6 +1522,11 @@ class SlotEngine:
         head[0, :length] = prompt[start:start + length]
         started = self.clock()
         event = self._dispatch_chunk_prefill(head, index, start, length)
+        if self._spec is not None:
+            # mirror the chunk into the draft lane BEFORE the radix tree
+            # adopts its pages below — a cached page must carry both
+            # lanes' K/V for its tokens (speculative.py)
+            self._spec.chunk_prefill(head, index, start, length)
         state.prefill_ms += (self.clock() - started) * 1e3
         state.prefill_chunks += 1
         if state.prefill_compile != "miss":
@@ -1482,8 +1580,152 @@ class SlotEngine:
             self._positions[index] = state.prefill_target
             self._temps[index] = request.temperature
             self._active[index] = True
+            self._spec_windows[index] = [int(request.prompt[-1])]
+
+    # -- speculative tick (docs/SERVING.md "Speculative decoding") ---------
+
+    def _spec_operands_locked(self):
+        """Host operands for the two speculative dispatches: the
+        right-aligned catch-up window (tokens accepted since the draft
+        last ran, ending at each slot's current token), per-slot write
+        limits, and — paged — the step page table with inactive rows
+        masked to the trash page (the chunk-prefill discipline: a parked
+        or freed slot's speculative writes must never land on a real or
+        shared page)."""
+        width = self.spec_tokens + 1
+        window = np.zeros((self.capacity, width), np.int32)
+        lens = np.zeros(self.capacity, np.int32)
+        for index in range(self.capacity):
+            if not self._active[index]:
+                continue
+            tokens = (self._spec_windows[index]
+                      or [int(self._tokens[index])])[-width:]
+            lens[index] = len(tokens)
+            window[index, width - len(tokens):] = tokens
+        limits = self._pos_limits.copy()
+        page_table = None
+        if self.paged:
+            page_table = self._pool.page_table.copy()
+            page_table[~self._active] = TRASH_PAGE
+        return window, lens, limits, page_table
+
+    def _run_spec_verify(self, verify_window, limits, page_table):
+        """Dispatch the batched target verify over ``[S, k+1]`` window
+        tokens (current token + draft proposals); reassigns the donated
+        cache/key and returns the device greedy/chosen arrays."""
+        fn = self._fingerprint_fn("serving_spec_verify")
+        _count_compile(fn,
+                       (fn, self.config, self.capacity, self.spec_tokens,
+                        self.top_k,
+                        (self._pool.num_pages, self.page_size,
+                         self._pool.max_pages_per_slot) if self.paged
+                        else (self.max_len,))
+                       + self._mesh_fingerprint())
+        if self.paged:
+            greedy, chosen, self._cache, key = _paged_spec_verify(
+                self.params, self._operand(verify_window),
+                self._operand(self._positions), self._operand(self._active),
+                self._operand(self._temps), self._operand(limits),
+                self._operand(page_table), self._cache, self._key,
+                config=self.config, top_k=self.top_k)
+        else:
+            greedy, chosen, self._cache, key = _spec_verify(
+                self.params, self._operand(verify_window),
+                self._operand(self._positions), self._operand(self._active),
+                self._operand(self._temps), self._operand(limits),
+                self._cache, self._key,
+                config=self.config, top_k=self.top_k)
+        if self.mesh is not None:
+            # same PRNG-key re-pin as _run_step: GSPMD may hand the key
+            # back labelled over a size-1 axis, which would miss the
+            # replicated-key executable once
+            key = jax.device_put(key, self._replicated)
+        self._key = key
+        return greedy, chosen
+
+    def _spec_decode_step(self) -> int:
+        """One speculative tick: draft catch-up + k proposals, ONE batched
+        target verify over all k+1 positions, then longest-matching-prefix
+        acceptance as pure slot arithmetic. Greedy slots emit the target's
+        own greedy tokens (matched proposals + the bonus token — identical
+        to k+1 legacy steps by construction); sampled slots emit exactly
+        the verify pass's one ``_choose_next`` token. Rollback is nothing
+        but "don't advance past the last accepted token": rejected
+        positions hold stale K/V that the next tick's writes overwrite
+        before anything attends them, in both lanes."""
+        with self._lock:
+            stepped = [(index, slot.request)
+                       for index, slot in enumerate(self._slots)
+                       if slot is not None and bool(self._active[index])]
+            if not stepped:
+                return 0
+            window, lens, limits, page_table = self._spec_operands_locked()
+        proposals = np.asarray(self._spec.propose(
+            window, lens, self._positions, limits, page_table))
+        verify_window = np.concatenate(
+            [self._tokens[:, None], proposals], axis=1)
+        greedy_dev, chosen_dev = self._run_spec_verify(verify_window, limits,
+                                                       page_table)
+        greedy = np.asarray(greedy_dev)
+        chosen = np.asarray(chosen_dev)
+        now = self.clock()
+        with self._lock:
+            self.steps += 1
+            _BATCH_EFFICIENCY.observe(len(stepped) / self.capacity)
+            for index, request in stepped:
+                if self._slots[index] is None or (
+                        self._slots[index].request is not request):
+                    continue        # freed between snapshot and apply
+                if self._temps[index] > 0.0:
+                    # sampled slots don't speculate: one categorical token
+                    # per tick, proposals discarded and not counted
+                    emitted = [int(chosen[index])]
+                    proposed = matched = 0
+                else:
+                    matched = 0
+                    while (matched < self.spec_tokens
+                           and int(proposals[index, matched])
+                           == int(greedy[index, matched])):
+                        matched += 1
+                    emitted = [int(greedy[index, j])
+                               for j in range(matched + 1)]
+                    proposed = self.spec_tokens
+                if proposed:
+                    self.spec_proposed += proposed
+                    self.spec_accepted += matched
+                    _SPEC_PROPOSED.inc(proposed)
+                    # inc(0) still materializes the series: an all-rollback
+                    # engine must scrape accepted=0, not an absent family
+                    _SPEC_ACCEPTED.inc(matched)
+                    record = request.record
+                    if record is not None:
+                        record.draft_tokens = (record.draft_tokens
+                                               or 0) + proposed
+                        record.accepted_tokens = (record.accepted_tokens
+                                                  or 0) + matched
+                consumed: List[int] = []
+                for token in emitted:
+                    # EOS inside the accepted run, the max_new budget and a
+                    # pending cancel all truncate HERE, token by token —
+                    # the same _apply_token_locked the legacy step uses, so
+                    # the emitted stream can never outrun what the
+                    # non-speculative path would have produced
+                    self._tokens[index] = token
+                    self._positions[index] += 1
+                    self._apply_token_locked(index, request, token, now)
+                    consumed.append(token)
+                    if self._slots[index] is None or request.finished:
+                        break
+                if (self._slots[index] is not None
+                        and self._slots[index].request is request):
+                    # next tick's draft catch-up window = what was accepted
+                    self._spec_windows[index] = consumed
+            _SLOTS_BUSY.set(self._busy_locked())
+        return len(stepped)
 
     def _decode_step(self) -> int:
+        if self._spec is not None:
+            return self._spec_decode_step()
         with self._lock:
             # slots still chunk-prefilling are parked (active False): they
             # join the batch only once armed, so a half-prefilled sequence
@@ -1546,6 +1788,10 @@ class SlotEngine:
     def _free_slot_locked(self, index: int) -> None:
         self._slots[index] = None
         self._active[index] = False
+        self._spec_windows[index] = []
+        # speculative writes to a freed slot must drop (contiguous keeps
+        # its position frozen, so the limit is the only guard there)
+        self._pos_limits[index] = -1
         if self.paged:
             # the pages go back to the pool NOW (they may be reassigned on
             # the very next _admit), so the parked slot must stop writing
@@ -1655,6 +1901,14 @@ class SlotEngine:
                                 if self._prefix is not None else None),
                 "prefillChunkTokens": (self.prefill_chunk_tokens
                                        if self._use_chunk_prefill else None),
+                "speculative": self.speculative,
+                "specTokens": (self.spec_tokens if self._spec is not None
+                               else None),
+                "specProposed": self.spec_proposed,
+                "specAccepted": self.spec_accepted,
+                "specAcceptanceRate": (
+                    round(self.spec_accepted / self.spec_proposed, 4)
+                    if self.spec_proposed else None),
                 "requestsCompleted": self.completed_requests,
                 "tokensEmitted": self.emitted_tokens,
                 "steps": self.steps,
@@ -1675,6 +1929,19 @@ class SlotEngine:
     def queue_saturation(self) -> float:
         with self._lock:
             return len(self._pending) / self.queue_depth
+
+    def spec_acceptance_rate(self,
+                             min_proposed: int = 64) -> Optional[float]:
+        """Lifetime draft-token acceptance rate — the spec_acceptance_low
+        alert signal. None while the lane is off OR fewer than
+        ``min_proposed`` tokens have been proposed (a handful of unlucky
+        early ticks must not page anyone)."""
+        if self._spec is None:
+            return None
+        with self._lock:
+            if self.spec_proposed < min_proposed:
+                return None
+            return self.spec_accepted / self.spec_proposed
 
     def kv_page_saturation(self) -> Optional[float]:
         """Pool-fill fraction, 1.0 = exhausted (None for the contiguous

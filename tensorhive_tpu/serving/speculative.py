@@ -1,0 +1,641 @@
+"""Speculative decoding lane: draft proposals, batched verify, exact accept.
+
+Decode through the slot engine is one token per active slot per tick — the
+per-request latency floor is one full target-model pass per token. This
+module adds the classic speculative-decoding trade (ROADMAP item 2): a
+small DRAFT model proposes ``spec_tokens`` (k) greedy continuations per
+slot per tick from its own KV lane, and the TARGET model verifies all
+``k + 1`` positions in ONE batched window pass — when the draft is right,
+one target pass emits several tokens; when it is wrong, the tick degrades
+to exactly the one token the non-speculative step would have emitted.
+
+Exactness is draft-INDEPENDENT, by construction: the verify pass computes
+the target's greedy token at every window position given the true prefix,
+and acceptance is longest-matching-prefix arithmetic over (proposal,
+target-greedy) pairs — the emitted stream is always the target's own
+greedy tokens, so greedy speculative output is token-identical to greedy
+non-speculative output no matter how good or bad the draft is (the hard
+gate tools/spec_smoke.py and tests/unit/test_speculative.py pin, across
+paged/contiguous layouts and under a dp x tp mesh). A bad draft costs
+throughput, never correctness.
+
+Design, in the order the constraints forced it:
+
+* **The draft lane rides the engine's page table.** The draft KV cache is
+  a SECOND physical array (``[draft_layers, pages, page_size, kv_heads,
+  d_head]``) indexed by the SAME per-slot page tables as the target cache:
+  no second allocator, no second accounting, and the PR 11 pool invariant
+  (free + live == pool size) holds with the lane on by construction.
+  Shared prefix pages carry BOTH lanes' K/V — the draft prefill mirrors
+  every target prefill chunk through the same table row, so a radix-tree
+  hit skips the cached positions in both lanes at once.
+* **Catch-up makes rollback free for the draft.** Each tick the draft
+  first re-processes the tokens ACCEPTED last tick (a right-aligned
+  ``[S, k+1]`` window ending at the slot's current position) — overwriting
+  whatever speculative K/V it wrote while proposing — and only then rolls
+  k fresh proposals. By induction the draft lane's K/V below the current
+  position always encodes the true accepted stream, so rejected proposals
+  need no scrub pass in either lane: both lanes "roll back" by pure
+  position arithmetic, exactly like the engine's parked-slot argument
+  (stale cells sit at positions > position, masked until rewritten).
+* **Verify reuses the chunk-prefill attend seam.** The verify executable
+  is the PR 11 chunked-prefill trunk generalized from ``[1, W]`` to
+  ``[S, W]``: write the window's K/V through the page-table rows, gather
+  each slot's page run into logical order, and attend under the
+  positional causal mask (:func:`_window_attend` is
+  ``models/decode._decode_attend`` generalized from one query to W —
+  same grouped einsum, same mask constant, same f32 softmax — so the
+  window pass and the single-token step cannot drift).
+* **Everything traced, two fingerprints.** Window tokens/lengths,
+  positions, per-slot write limits and page tables are operands; only the
+  window width (``spec_tokens + 1``) and the configs are static. The two
+  new executables are fingerprinted ``serving_spec_draft`` (catch-up +
+  propose, plus the draft-lane prefill mirrors) and ``serving_spec_verify``
+  through the ``_count_compile`` seam, so the zero-recompile gates see
+  them and TH-JIT polices the dispatches.
+* **Sampled slots don't speculate.** Exact speculative SAMPLING needs
+  rejection-sampling bookkeeping this lane does not ship; a slot with
+  temperature > 0 takes exactly one token per tick from the verify pass's
+  first position (sampled with the same ``_choose_next`` semantics as the
+  legacy step — note the PRNG stream advances once per TICK, not once per
+  token, so sampled streams differ from the non-speculative path; greedy
+  is unaffected). Draft work for sampled slots is discarded and not
+  counted in the acceptance metrics.
+* **The draft is free when self-drafting.** With no ``draft_preset``
+  configured the draft is the target's own first ``draft_layers`` layers
+  (embedding/head/final-norm shared by reference — zero extra param HBM);
+  ``draft_layers = n_layers`` makes the draft exactly the target (100%
+  acceptance — the full-accept test lever), a separate preset gives an
+  independent draft that must share the tokenizer/vocab.
+
+``speculative = off`` is a byte-identical rollback: the engine never
+imports this module, dispatches the PR 6-11 executables with untouched
+fingerprints, and the stats/ledger speculative fields read off/None
+(docs/SERVING.md "Speculative decoding").
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.decode import (
+    KVCache,
+    _count_compile,
+    _decode_attend,
+    _paged_attend,
+)
+from ..models.transformer import (
+    TransformerConfig,
+    TransformerLM,
+    _rmsnorm,
+)
+
+
+def resolve_speculative(mode: str) -> str:
+    """Resolve the ``speculative = auto|on|off`` knob once at engine
+    construction (the ``paged_kernel`` pattern): ``auto`` enables the lane
+    only on a real TPU backend, where the batched verify is cheap relative
+    to the draft's extra passes — on CPU the draft overhead routinely makes
+    speculation a slowdown (bench records it honestly), so auto stays off
+    there and enabling is an explicit operator decision."""
+    if mode not in ("auto", "on", "off"):
+        raise ValueError(f"speculative must be auto|on|off, got {mode!r}")
+    if mode != "auto":
+        return mode
+    return "on" if jax.default_backend() == "tpu" else "off"
+
+
+def build_draft(params, config: TransformerConfig, draft_preset: str = "",
+                draft_layers: int = 0) -> Tuple[dict, TransformerConfig,
+                                                bool]:
+    """Build the draft model: ``(draft_params, draft_config, shares_target)``.
+
+    Self-draft (no preset): the draft IS the target truncated to its first
+    ``draft_layers`` blocks (default half, min 1) — embedding, final norm
+    and LM head are the SAME arrays (and, under a mesh, already carry the
+    target's shardings), so the lane costs zero extra parameter HBM and its
+    proposals are correlated with the target by construction.
+    ``draft_layers = n_layers`` degenerates to draft == target (always
+    accepts — the deterministic full-accept lever the tests use).
+
+    A named ``draft_preset`` builds an independent model that must share
+    the tokenizer/vocab (the proposals are token ids the target verifies);
+    its params are random-init — serving a trained draft rides the same
+    checkpoint story as the target (not wired yet; acceptance with init
+    params is honest noise, and exactness never depends on it)."""
+    if draft_preset:
+        from ..models.transformer import PRESETS
+
+        if draft_preset not in PRESETS:
+            raise ValueError(
+                f"draft_preset {draft_preset!r} unknown; choose from "
+                f"{sorted(PRESETS)}")
+        base = PRESETS[draft_preset]
+        if base.vocab_size != config.vocab_size:
+            raise ValueError(
+                f"draft_preset {draft_preset!r} has vocab "
+                f"{base.vocab_size}, the target serves {config.vocab_size} "
+                "— speculative proposals are token ids, the tokenizers "
+                "must match")
+        draft_config = dataclasses.replace(
+            base, dtype=config.dtype, use_flash=config.use_flash,
+            remat=config.remat,
+            max_seq_len=max(base.max_seq_len, config.max_seq_len),
+            causal=True)
+        draft_params = TransformerLM.init(jax.random.PRNGKey(7),
+                                          draft_config)
+        return draft_params, draft_config, False
+    layers = int(draft_layers) or max(1, config.n_layers // 2)
+    if not 1 <= layers <= config.n_layers:
+        raise ValueError(
+            f"draft_layers must be in [1, {config.n_layers}], got {layers}")
+    draft_config = dataclasses.replace(config, n_layers=layers)
+    draft_params = {
+        "tok_embed": params["tok_embed"],
+        "blocks": list(params["blocks"][:layers]),
+        "final_norm": params["final_norm"],
+        "w_lm_head": params["w_lm_head"],
+    }
+    return draft_params, draft_config, True
+
+
+def _window_attend(q, k_ctx, v_ctx, q_positions):
+    """Attention for a ``[S, W]`` token window against each slot's full
+    logical context: :func:`models/decode._decode_attend` generalized from
+    one query per slot to W — the SAME grouped einsum spec, the same
+    ``-1e30`` mask constant, the same f32 softmax and ``probs.astype(
+    v.dtype)`` product, so at W == 1 this is bit-for-bit the decode attend
+    and the verify window cannot drift from the step path it replaces.
+
+    ``q``: [S, W, H, Dh]; ``k_ctx``/``v_ctx``: [S, K, Hkv, Dh] — the slot's
+    gathered page run (paged) or its contiguous cache row; ``q_positions``:
+    [S, W] absolute positions. The mask attends key position p from query
+    position w only when ``p <= w``; cells past the query hold stale or
+    trash K/V, sent to -1e30 and exp-underflowed to exactly 0.0 — the same
+    argument that makes the paged gather and the chunk-prefill attend
+    (engine._chunk_attend) f32-exact."""
+    num_slots, width, heads, d_head = q.shape
+    kv_heads = k_ctx.shape[2]
+    group = heads // kv_heads
+    scale = d_head ** -0.5
+    q_grouped = q.reshape(num_slots, width, kv_heads, group, d_head)
+    scores = jnp.einsum("bqhgd,bkhd->bhgqk", q_grouped, k_ctx,
+                        preferred_element_type=jnp.float32) * scale
+    key_positions = jax.lax.iota(jnp.int32, k_ctx.shape[1])
+    mask = (key_positions[None, None, None, None, :]
+            <= q_positions[:, None, None, :, None])
+    scores = jnp.where(mask, scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", probs.astype(v_ctx.dtype), v_ctx,
+                     preferred_element_type=jnp.float32)
+    return out.reshape(num_slots, width, heads, d_head).astype(q.dtype)
+
+
+def _head_logits(params, x, config: TransformerConfig):
+    """Final norm + LM head over a ``[S, W, D]`` trunk output — the
+    ``_choose_next`` tail generalized to a window (same per-element
+    contraction, so position 0 of this and ``_choose_next``'s own logits
+    agree bit-for-bit)."""
+    x = _rmsnorm(x, params["final_norm"]["scale"])
+    return jnp.einsum("swd,dv->swv", x.astype(config.dtype),
+                      params["w_lm_head"].astype(config.dtype),
+                      preferred_element_type=jnp.float32)
+
+
+# -- draft lane: catch-up + propose ------------------------------------------
+
+def _paged_draft_step(params, token, step_positions, limits, page_tables,
+                      cache_k, cache_v, config: TransformerConfig):
+    """One greedy draft step at traced per-slot positions over the paged
+    draft cache: write the token's K/V through the page-table row (writes
+    past ``limits`` — or through an inactive slot's trash-masked row —
+    route out of bounds and drop), attend via the XLA page gather, argmax.
+    Mirrors ``engine._paged_step_body`` minus sampling."""
+    dtype = config.dtype
+    num_slots = token.shape[0]
+    num_physical = cache_k.shape[1]
+    page_size = cache_k.shape[2]
+    max_pages = page_tables.shape[1]
+    slot_ids = jnp.arange(num_slots)
+    safe = jnp.clip(step_positions, 0, max_pages * page_size - 1)
+    rows = page_tables[slot_ids, safe // page_size]
+    pages = jnp.where(step_positions <= limits, rows, num_physical)
+    offsets = safe % page_size
+    x = params["tok_embed"].astype(dtype)[token][:, None, :]
+    rope_positions = step_positions[:, None]
+
+    def attend(q, k, v, layer):
+        nonlocal cache_k, cache_v
+        layer_k = cache_k[layer].at[pages, offsets].set(
+            k[:, 0].astype(cache_k.dtype), mode="drop")
+        layer_v = cache_v[layer].at[pages, offsets].set(
+            v[:, 0].astype(cache_v.dtype), mode="drop")
+        cache_k = jax.lax.dynamic_update_slice(
+            cache_k, layer_k[None], (layer, 0, 0, 0, 0))
+        cache_v = jax.lax.dynamic_update_slice(
+            cache_v, layer_v[None], (layer, 0, 0, 0, 0))
+        return _paged_attend(q, cache_k[layer], cache_v[layer], page_tables,
+                             step_positions)
+
+    for layer_index, block in enumerate(params["blocks"]):
+        x = TransformerLM.block_forward(x, block, config, rope_positions,
+                                        attend, layer_index=layer_index)
+    logits = _head_logits(params, x, config)[:, 0]
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache_k, cache_v
+
+
+def _paged_draft_propose_body(params, window_tokens, window_lens, positions,
+                              limits, page_tables, cache,
+                              config: TransformerConfig):
+    """Catch up the draft lane on last tick's accepted tokens, then propose
+    ``k = W - 1`` greedy continuations per slot.
+
+    ``window_tokens`` [S, W] is RIGHT-ALIGNED: entry ``W-1`` is the slot's
+    current token at ``positions[s]``, entry ``W-1-j`` the token j
+    positions earlier; only the last ``window_lens[s]`` entries are real
+    (the tokens emitted since the draft last ran — at most k+1 on a full
+    accept, exactly 1 at a fresh join). Phase A writes their K/V through
+    the page table (overwriting last tick's speculative writes — the draft
+    lane's whole rollback) and attends the batched window; its last
+    position's argmax is proposal 1. Phase B rolls k-1 single-token steps,
+    each writing speculative K/V at ``positions + j`` before attending it.
+    Invalid window cells (padding, positions past ``limits``) route out of
+    bounds and drop, so a parked or freed slot's lane is never touched."""
+    dtype = config.dtype
+    num_slots, width = window_tokens.shape
+    cache_k, cache_v = cache.k, cache.v
+    num_physical = cache_k.shape[1]
+    page_size = cache_k.shape[2]
+    max_pages = page_tables.shape[1]
+    window_ctx = max_pages * page_size
+    win = jnp.arange(width, dtype=jnp.int32)
+    global_positions = positions[:, None] - (width - 1) + win[None, :]
+    valid = ((win[None, :] >= width - window_lens[:, None])
+             & (global_positions >= 0)
+             & (global_positions <= limits[:, None]))
+    safe_pos = jnp.clip(global_positions, 0, window_ctx - 1)
+    rows = jnp.take_along_axis(page_tables, safe_pos // page_size, axis=1)
+    pages = jnp.where(valid, rows, num_physical)          # OOB -> dropped
+    offsets = safe_pos % page_size
+    x = params["tok_embed"].astype(dtype)[window_tokens]
+
+    def attend(q, k, v, layer):
+        nonlocal cache_k, cache_v
+        layer_k = cache_k[layer].at[pages, offsets].set(
+            k.astype(cache_k.dtype), mode="drop")
+        layer_v = cache_v[layer].at[pages, offsets].set(
+            v.astype(cache_v.dtype), mode="drop")
+        cache_k = jax.lax.dynamic_update_slice(
+            cache_k, layer_k[None], (layer, 0, 0, 0, 0))
+        cache_v = jax.lax.dynamic_update_slice(
+            cache_v, layer_v[None], (layer, 0, 0, 0, 0))
+        ctx_k = layer_k[page_tables].reshape(num_slots, window_ctx,
+                                             *layer_k.shape[2:])
+        ctx_v = layer_v[page_tables].reshape(num_slots, window_ctx,
+                                             *layer_v.shape[2:])
+        return _window_attend(q, ctx_k, ctx_v, safe_pos)
+
+    for layer_index, block in enumerate(params["blocks"]):
+        x = TransformerLM.block_forward(x, block, config, safe_pos, attend,
+                                        layer_index=layer_index)
+    logits = _head_logits(params, x[:, -1:], config)[:, 0]
+    token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    proposals = [token]
+    for step in range(1, width - 1):
+        token, cache_k, cache_v = _paged_draft_step(
+            params, token, positions + step, limits, page_tables,
+            cache_k, cache_v, config)
+        proposals.append(token)
+    return jnp.stack(proposals, axis=1), KVCache(k=cache_k, v=cache_v)
+
+
+def _draft_step(params, token, step_positions, limits, cache_k, cache_v,
+                config: TransformerConfig):
+    """Contiguous twin of :func:`_paged_draft_step`: the write lands at
+    ``(slot, position)`` of the slot's own cache row (past-limit writes
+    route out of bounds and drop) and the attend is the plain masked
+    decode attend over the row."""
+    dtype = config.dtype
+    num_slots = token.shape[0]
+    max_len = cache_k.shape[2]
+    slot_ids = jnp.arange(num_slots)
+    write_pos = jnp.where(step_positions <= limits, step_positions, max_len)
+    x = params["tok_embed"].astype(dtype)[token][:, None, :]
+    rope_positions = step_positions[:, None]
+
+    def attend(q, k, v, layer):
+        nonlocal cache_k, cache_v
+        layer_k = cache_k[layer].at[slot_ids, write_pos].set(
+            k[:, 0].astype(cache_k.dtype), mode="drop")
+        layer_v = cache_v[layer].at[slot_ids, write_pos].set(
+            v[:, 0].astype(cache_v.dtype), mode="drop")
+        cache_k = jax.lax.dynamic_update_slice(
+            cache_k, layer_k[None], (layer, 0, 0, 0, 0))
+        cache_v = jax.lax.dynamic_update_slice(
+            cache_v, layer_v[None], (layer, 0, 0, 0, 0))
+        return _decode_attend(q, cache_k[layer], cache_v[layer],
+                              step_positions[:, None, None, None, None])
+
+    for layer_index, block in enumerate(params["blocks"]):
+        x = TransformerLM.block_forward(x, block, config, rope_positions,
+                                        attend, layer_index=layer_index)
+    logits = _head_logits(params, x, config)[:, 0]
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache_k, cache_v
+
+
+def _draft_propose_body(params, window_tokens, window_lens, positions,
+                        limits, cache, config: TransformerConfig):
+    """Contiguous twin of :func:`_paged_draft_propose_body`: same window
+    layout and phases, writes scattered into each slot's cache row and the
+    attend context IS the row (no gather)."""
+    dtype = config.dtype
+    num_slots, width = window_tokens.shape
+    cache_k, cache_v = cache.k, cache.v
+    max_len = cache_k.shape[2]
+    slot_ids = jnp.arange(num_slots)
+    win = jnp.arange(width, dtype=jnp.int32)
+    global_positions = positions[:, None] - (width - 1) + win[None, :]
+    valid = ((win[None, :] >= width - window_lens[:, None])
+             & (global_positions >= 0)
+             & (global_positions <= limits[:, None]))
+    safe_pos = jnp.clip(global_positions, 0, max_len - 1)
+    write_pos = jnp.where(valid, safe_pos, max_len)       # OOB -> dropped
+    x = params["tok_embed"].astype(dtype)[window_tokens]
+
+    def attend(q, k, v, layer):
+        nonlocal cache_k, cache_v
+        layer_k = cache_k[layer].at[slot_ids[:, None], write_pos].set(
+            k.astype(cache_k.dtype), mode="drop")
+        layer_v = cache_v[layer].at[slot_ids[:, None], write_pos].set(
+            v.astype(cache_v.dtype), mode="drop")
+        cache_k = jax.lax.dynamic_update_slice(
+            cache_k, layer_k[None], (layer, 0, 0, 0, 0))
+        cache_v = jax.lax.dynamic_update_slice(
+            cache_v, layer_v[None], (layer, 0, 0, 0, 0))
+        return _window_attend(q, layer_k, layer_v, safe_pos)
+
+    for layer_index, block in enumerate(params["blocks"]):
+        x = TransformerLM.block_forward(x, block, config, safe_pos, attend,
+                                        layer_index=layer_index)
+    logits = _head_logits(params, x[:, -1:], config)[:, 0]
+    token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    proposals = [token]
+    for step in range(1, width - 1):
+        token, cache_k, cache_v = _draft_step(
+            params, token, positions + step, limits, cache_k, cache_v,
+            config)
+        proposals.append(token)
+    return jnp.stack(proposals, axis=1), KVCache(k=cache_k, v=cache_v)
+
+
+_paged_spec_draft = functools.partial(
+    jax.jit, static_argnames=("config",),
+    donate_argnames=("cache",))(_paged_draft_propose_body)
+_spec_draft = functools.partial(
+    jax.jit, static_argnames=("config",),
+    donate_argnames=("cache",))(_draft_propose_body)
+
+
+# -- target verify ------------------------------------------------------------
+
+def _paged_spec_verify_body(params, window_tokens, positions, active, temps,
+                            limits, page_tables, cache, key,
+                            config: TransformerConfig, top_k: Optional[int]):
+    """Verify all ``k + 1`` window positions in one batched target pass.
+
+    ``window_tokens`` [S, W] is LEFT-ALIGNED: entry 0 is the slot's current
+    token at ``positions[s]``, entries 1..k the draft proposals at the k
+    positions after it. Every position's K/V is written through the page
+    table (positions past ``limits`` drop — near the end of a request's
+    budget the tail of the window is discarded host-side anyway), the
+    whole page run gathers into logical order, and :func:`_window_attend`
+    applies the positional causal mask — the chunk-prefill seam batched
+    over slots. Returns the target's greedy token at EVERY window position
+    (``greedy[s, j]`` is the token for position ``positions[s] + j + 1``
+    given the true prefix plus proposals 1..j — exactly what the
+    sequential step path would emit, which is the whole identity
+    argument), plus the ``_choose_next`` pick for position 0 (greedy slots
+    get argmax; sampled slots get their one categorical token per tick)."""
+    from .engine import _choose_next
+
+    dtype = config.dtype
+    num_slots, width = window_tokens.shape
+    cache_k, cache_v = cache.k, cache.v
+    num_physical = cache_k.shape[1]
+    page_size = cache_k.shape[2]
+    max_pages = page_tables.shape[1]
+    window_ctx = max_pages * page_size
+    win = jnp.arange(width, dtype=jnp.int32)
+    global_positions = positions[:, None] + win[None, :]
+    writable = global_positions <= limits[:, None]
+    safe_pos = jnp.clip(global_positions, 0, window_ctx - 1)
+    rows = jnp.take_along_axis(page_tables, safe_pos // page_size, axis=1)
+    pages = jnp.where(writable, rows, num_physical)       # OOB -> dropped
+    offsets = safe_pos % page_size
+    x = params["tok_embed"].astype(dtype)[window_tokens]
+
+    def attend(q, k, v, layer):
+        nonlocal cache_k, cache_v
+        layer_k = cache_k[layer].at[pages, offsets].set(
+            k.astype(cache_k.dtype), mode="drop")
+        layer_v = cache_v[layer].at[pages, offsets].set(
+            v.astype(cache_v.dtype), mode="drop")
+        cache_k = jax.lax.dynamic_update_slice(
+            cache_k, layer_k[None], (layer, 0, 0, 0, 0))
+        cache_v = jax.lax.dynamic_update_slice(
+            cache_v, layer_v[None], (layer, 0, 0, 0, 0))
+        ctx_k = layer_k[page_tables].reshape(num_slots, window_ctx,
+                                             *layer_k.shape[2:])
+        ctx_v = layer_v[page_tables].reshape(num_slots, window_ctx,
+                                             *layer_v.shape[2:])
+        return _window_attend(q, ctx_k, ctx_v, safe_pos)
+
+    for layer_index, block in enumerate(params["blocks"]):
+        x = TransformerLM.block_forward(x, block, config, safe_pos, attend,
+                                        layer_index=layer_index)
+    chosen, key = _choose_next(params, x[:, :1], window_tokens[:, 0],
+                               active, temps, key, config, top_k)
+    greedy = jnp.argmax(_head_logits(params, x, config),
+                        axis=-1).astype(jnp.int32)
+    return greedy, chosen, KVCache(k=cache_k, v=cache_v), key
+
+
+def _spec_verify_body(params, window_tokens, positions, active, temps,
+                      limits, cache, key, config: TransformerConfig,
+                      top_k: Optional[int]):
+    """Contiguous twin of :func:`_paged_spec_verify_body`: window K/V
+    scatters into each slot's cache row (past-limit and freed-slot writes
+    drop — a freed contiguous slot's limit is -1, so verify never touches
+    its row) and the attend context is the row itself."""
+    from .engine import _choose_next
+
+    dtype = config.dtype
+    num_slots, width = window_tokens.shape
+    cache_k, cache_v = cache.k, cache.v
+    max_len = cache_k.shape[2]
+    slot_ids = jnp.arange(num_slots)
+    win = jnp.arange(width, dtype=jnp.int32)
+    global_positions = positions[:, None] + win[None, :]
+    writable = global_positions <= limits[:, None]
+    safe_pos = jnp.clip(global_positions, 0, max_len - 1)
+    write_pos = jnp.where(writable, safe_pos, max_len)    # OOB -> dropped
+    x = params["tok_embed"].astype(dtype)[window_tokens]
+
+    def attend(q, k, v, layer):
+        nonlocal cache_k, cache_v
+        layer_k = cache_k[layer].at[slot_ids[:, None], write_pos].set(
+            k.astype(cache_k.dtype), mode="drop")
+        layer_v = cache_v[layer].at[slot_ids[:, None], write_pos].set(
+            v.astype(cache_v.dtype), mode="drop")
+        cache_k = jax.lax.dynamic_update_slice(
+            cache_k, layer_k[None], (layer, 0, 0, 0, 0))
+        cache_v = jax.lax.dynamic_update_slice(
+            cache_v, layer_v[None], (layer, 0, 0, 0, 0))
+        return _window_attend(q, layer_k, layer_v, safe_pos)
+
+    for layer_index, block in enumerate(params["blocks"]):
+        x = TransformerLM.block_forward(x, block, config, safe_pos, attend,
+                                        layer_index=layer_index)
+    chosen, key = _choose_next(params, x[:, :1], window_tokens[:, 0],
+                               active, temps, key, config, top_k)
+    greedy = jnp.argmax(_head_logits(params, x, config),
+                        axis=-1).astype(jnp.int32)
+    return greedy, chosen, KVCache(k=cache_k, v=cache_v), key
+
+
+_paged_spec_verify = functools.partial(
+    jax.jit, static_argnames=("config", "top_k"),
+    donate_argnames=("cache",))(_paged_spec_verify_body)
+_spec_verify = functools.partial(
+    jax.jit, static_argnames=("config", "top_k"),
+    donate_argnames=("cache",))(_spec_verify_body)
+
+
+# -- the lane -----------------------------------------------------------------
+
+class SpeculativeLane:
+    """The draft side of the speculative engine: draft params/config, the
+    draft KV cache (same layout family and page tables as the target's),
+    and the dispatchers that mirror the engine's prefills and roll the
+    per-tick proposals. Device calls follow the engine's discipline: only
+    the pump thread dispatches, every donated buffer is reassigned from
+    the output, and every dispatch is fingerprinted through
+    ``_count_compile`` (family ``serving_spec_draft``)."""
+
+    def __init__(self, engine, draft_params, draft_config: TransformerConfig,
+                 shares_target: bool) -> None:
+        self._engine = engine
+        self.draft_config = draft_config
+        self.shares_target = shares_target
+        if engine.paged:
+            shape = (draft_config.n_layers, engine._pool.physical_pages,
+                     engine.page_size, draft_config.kv_heads,
+                     draft_config.d_head)
+        else:
+            shape = (draft_config.n_layers, engine.capacity, engine.max_len,
+                     draft_config.kv_heads, draft_config.d_head)
+        cache = KVCache(k=jnp.zeros(shape, draft_config.dtype),
+                        v=jnp.zeros(shape, draft_config.dtype))
+        self.params = draft_params
+        if engine.mesh is not None:
+            from jax.sharding import NamedSharding
+
+            from ..parallel.mesh import (
+                serving_cache_spec,
+                serving_rules,
+                tree_shardings,
+            )
+
+            rules = serving_rules(draft_config, engine.mesh_tp)
+            if not shares_target:
+                # a preset draft's fresh params need their own shardings;
+                # self-draft params ARE the target's leaves, already placed
+                self.params = jax.device_put(
+                    draft_params,
+                    tree_shardings(engine.mesh, draft_params, rules))
+            sharding = NamedSharding(engine.mesh, serving_cache_spec(rules))
+            cache = jax.device_put(cache, KVCache(k=sharding, v=sharding))
+        self.cache = cache
+
+    # -- fingerprints ------------------------------------------------------
+    def _count_compile_draft(self, kind: str, *shape_bits) -> str:
+        engine = self._engine
+        fn = engine._fingerprint_fn("serving_spec_draft")
+        if engine.paged:
+            pool = (engine._pool.num_pages, engine.page_size,
+                    engine._pool.max_pages_per_slot)
+        else:
+            pool = (engine.capacity, engine.max_len)
+        return _count_compile(fn, (fn, kind, self.draft_config, pool,
+                                   *shape_bits) + engine._mesh_fingerprint())
+
+    # -- prefill mirrors ---------------------------------------------------
+    def prefill(self, head, slot: int, real_len: int) -> None:
+        """Mirror one legacy whole-prompt prefill into the draft lane:
+        same head tokens, same slot/table row, the DRAFT params/config/
+        cache — the shared jitted prefill bodies compile one extra
+        executable per bucket for the draft config (warmed like the
+        target's) and the lane's K/V for the prompt lands in the same
+        pages the target's did."""
+        engine = self._engine
+        from .engine import _paged_serving_prefill, _serving_prefill
+
+        self._count_compile_draft("prefill", head.shape[1])
+        if engine.paged:
+            self.cache = _paged_serving_prefill(
+                self.params, engine._operand(head), self.cache,
+                engine._operand(engine._pool.page_table[slot]),
+                engine._operand(np.int32(real_len)), self.draft_config)
+        else:
+            self.cache = _serving_prefill(
+                self.params, engine._operand(head), self.cache,
+                engine._operand(np.int32(slot)),
+                engine._operand(np.int32(real_len)), self.draft_config)
+
+    def chunk_prefill(self, head, slot: int, start: int,
+                      real_len: int) -> None:
+        """Mirror one chunked prefill (prefix-cache path) into the draft
+        lane — dispatched right after the target's chunk and BEFORE the
+        radix tree adopts the chunk's pages, so a page entering the tree
+        always carries both lanes' K/V for its tokens."""
+        engine = self._engine
+        from .engine import _paged_chunk_serving_prefill
+
+        self._count_compile_draft("chunk_prefill", head.shape[1])
+        self.cache = _paged_chunk_serving_prefill(
+            self.params, engine._operand(head), self.cache,
+            engine._operand(engine._pool.page_table[slot]),
+            engine._operand(np.int32(start)),
+            engine._operand(np.int32(real_len)), self.draft_config)
+
+    # -- propose -----------------------------------------------------------
+    def propose(self, window, lens, positions, limits, page_table):
+        """Catch up on last tick's accepted tokens and roll ``spec_tokens``
+        proposals per slot; returns the device array of proposals
+        ``[S, k]`` (the engine syncs it once per tick)."""
+        engine = self._engine
+        self._count_compile_draft("propose", window.shape[1])
+        if engine.paged:
+            proposals, self.cache = _paged_spec_draft(
+                self.params, engine._operand(window), engine._operand(lens),
+                engine._operand(positions), engine._operand(limits),
+                engine._operand(page_table), self.cache,
+                config=self.draft_config)
+        else:
+            proposals, self.cache = _spec_draft(
+                self.params, engine._operand(window), engine._operand(lens),
+                engine._operand(positions), engine._operand(limits),
+                self.cache, config=self.draft_config)
+        return proposals
+
+    @property
+    def propose_executable(self):
+        """The jitted propose function this lane dispatches —
+        ``._cache_size()`` is the draft side of the zero-recompile ground
+        truth (the prefill mirrors ride the engine's prefill executables)."""
+        return _paged_spec_draft if self._engine.paged else _spec_draft

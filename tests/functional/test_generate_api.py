@@ -234,6 +234,13 @@ def test_generate_stats_snapshot(api, pump, user_headers):
     # the attend dispatch the engine resolved from the paged_kernel knob
     # ("auto" off-TPU -> the XLA gather reference) — the KV badge renders it
     assert doc["pagedKernel"] == "xla"
+    # the speculative-lane badge fields (docs/SERVING.md "Speculative
+    # decoding"): "auto" resolves off on the CPU backend, so the rollback
+    # shape is what this fixture pins — off, no window depth, no rate
+    assert doc["speculative"] == "off"
+    assert doc["specTokens"] is None
+    assert doc["specProposed"] == 0 and doc["specAccepted"] == 0
+    assert doc["specAcceptanceRate"] is None
 
 
 def test_generate_disabled_answers_503(api, user_headers):

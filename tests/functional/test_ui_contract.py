@@ -211,6 +211,18 @@ def test_serving_strip_renders_prefix_cache_badge():
     assert "stats.cachedPages" in source
 
 
+def test_serving_strip_renders_spec_badge():
+    """The speculative-lane badge (docs/SERVING.md "Speculative decoding")
+    must render from the exact ``speculative``/``specTokens``/
+    ``specAcceptanceRate`` fields ``GET /generate/stats`` exports, and
+    hide on the ``speculative=off`` rollback (which serves no spec
+    stats)."""
+    source = (STATIC_DIR / "js" / "nodes.js").read_text()
+    assert 'stats.speculative !== "on"' in source   # hidden on rollback
+    assert '"spec ×" + stats.specTokens' in source
+    assert "stats.specAcceptanceRate" in source
+
+
 def test_serving_strip_renders_mesh_badge():
     """The multi-chip badge (docs/SERVING.md "Multi-chip serving") must
     render from the exact ``meshShape``/``numDevices`` fields

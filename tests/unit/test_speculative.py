@@ -1,0 +1,465 @@
+"""Speculative-lane tests: draft proposals may be arbitrarily wrong, the
+emitted stream may NEVER be.
+
+The contract under test (docs/SERVING.md "Speculative decoding") has two
+halves, and the draft-quality levers make both deterministic:
+
+* **Exactness is draft-independent** — greedy spec-on output must be
+  token-identical to spec-off and to ``decode.generate`` in f32, for a
+  correlated self-draft (mixed accept/rollback), a full-depth self-draft
+  (``draft_layers = n_layers`` ⇒ the draft IS the target ⇒ acceptance
+  exactly 1.0, the full-accept path), an independent random draft (heavy
+  rollback) and an adversarial propose stub (guaranteed zero-accept every
+  tick) — across paged/contiguous layouts, prefix-cache hits, page-boundary
+  acceptance runs and a 2x2 mesh.
+* **Rollback is pure arithmetic** — no scrub pass, no recompile, no page
+  leak: the zero-recompile counters cover accept/rollback cycles, the
+  seeded churn holds the PR 11 pool invariant with the lane on (the draft
+  lane rides the same page tables), and ``speculative=off`` is a
+  fingerprint-identical rollback.
+"""
+import dataclasses
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorhive_tpu.models import decode
+from tensorhive_tpu.models.transformer import PRESETS, TransformerLM
+from tensorhive_tpu.serving import QueueFullError, set_engine
+from tensorhive_tpu.serving.engine import SlotEngine
+from tensorhive_tpu.serving.speculative import (
+    build_draft,
+    resolve_speculative,
+)
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device CPU platform"
+)
+
+F32_TINY = dataclasses.replace(PRESETS["tiny"], dtype=jnp.float32,
+                               use_flash=False, remat=False, max_seq_len=128)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return TransformerLM.init(jax.random.PRNGKey(0), F32_TINY)
+
+
+def make_engine(params, **kwargs):
+    kwargs.setdefault("slots", 4)
+    kwargs.setdefault("max_len", 96)
+    kwargs.setdefault("queue_depth", 8)
+    kwargs.setdefault("speculative", "on")
+    return SlotEngine(params, F32_TINY, **kwargs)
+
+
+def drain(engine):
+    while engine.has_work():
+        engine.step()
+
+
+def reference_tokens(params, prompt, new_tokens):
+    out = decode.generate(params, F32_TINY,
+                          jnp.asarray([prompt], jnp.int32),
+                          max_new_tokens=new_tokens, temperature=0.0)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+MIXED_PROMPTS = [list(range(3, 11)),        # len 8
+                 [5],                       # len 1 -> no prefill
+                 list(range(1, 21)),        # len 20
+                 list(range(2, 14))]        # len 12
+MIXED_NEWS = [6, 9, 4, 7]
+
+
+def run_mixed(engine):
+    handles = []
+    for prompt, new in zip(MIXED_PROMPTS, MIXED_NEWS):
+        handles.append(engine.submit(prompt, max_new_tokens=new))
+        engine.step()                       # join mid-batch, not en masse
+    drain(engine)
+    return [handle.result(timeout_s=5)["tokens"] for handle in handles]
+
+
+# -- exactness ---------------------------------------------------------------
+
+@pytest.mark.parametrize("paged", [True, False])
+@pytest.mark.parametrize("draft_layers", [0, 2])
+def test_spec_on_matches_generate_exactly(params, paged, draft_layers):
+    """Greedy spec-on == decode.generate, token for token, with joins and
+    leaves mid-batch — for the half-depth self-draft (mixed accept and
+    rollback ticks) AND the full-depth draft (every tick a full accept),
+    on both cache layouts."""
+    engine = make_engine(params, paged=paged, draft_layers=draft_layers)
+    outputs = run_mixed(engine)
+    for prompt, new, tokens in zip(MIXED_PROMPTS, MIXED_NEWS, outputs):
+        assert tokens == reference_tokens(params, prompt, new)
+    if draft_layers == 2:
+        # draft == target: the batched verify must agree with the draft's
+        # own argmax at every proposal — acceptance is exactly 1.0 and
+        # multi-token emission makes ticks < emitted tokens
+        stats = engine.stats()
+        assert stats["specAcceptanceRate"] == 1.0
+        assert stats["steps"] < stats["tokensEmitted"]
+
+
+def test_spec_matches_spec_off_engine(params):
+    """The operational identity the smoke gates over a socket: the same
+    prompts through a spec-on and a spec-off engine emit identical
+    streams."""
+    on = run_mixed(make_engine(params))
+    off = run_mixed(make_engine(params, speculative="off"))
+    assert on == off
+
+
+def test_independent_draft_heavy_rollback_is_exact(params):
+    """A draft_preset draft has its OWN random params — proposals are
+    noise, nearly every tick rolls back — and the output must not care."""
+    engine = make_engine(params, draft_preset="tiny")
+    assert engine._spec is not None
+    assert not engine._spec.shares_target
+    outputs = run_mixed(engine)
+    for prompt, new, tokens in zip(MIXED_PROMPTS, MIXED_NEWS, outputs):
+        assert tokens == reference_tokens(params, prompt, new)
+
+
+def test_adversarial_zero_accept_every_tick(params):
+    """Deterministic all-rollback: a propose stub that always gets the
+    FIRST proposal wrong (one off from the known reference continuation)
+    forces matched == 0 every tick — the engine must degrade to exactly
+    one legacy-identical token per tick with zero accepted."""
+    engine = make_engine(params, slots=1)
+    prompt, new = list(range(3, 11)), 6
+    ref = reference_tokens(params, prompt, new)
+    lane = engine._spec
+    original = lane.propose
+
+    def wrong_propose(window, lens, positions, limits, page_table):
+        proposals = np.asarray(original(window, lens, positions, limits,
+                                        page_table)).copy()
+        slot = engine._slots[0]
+        if slot is not None:
+            done = len(slot.request.generated)
+            if done < len(ref):
+                proposals[0, 0] = (ref[done] + 1) % F32_TINY.vocab_size
+        return proposals
+
+    lane.propose = wrong_propose
+    handle = engine.submit(prompt, max_new_tokens=new)
+    drain(engine)
+    assert handle.result(timeout_s=5)["tokens"] == ref
+    assert engine.spec_accepted == 0
+    assert engine.spec_proposed == new * engine.spec_tokens
+    assert engine.stats()["steps"] == new   # one token per tick, like legacy
+
+
+def test_acceptance_across_page_boundaries(params):
+    """Full-accept runs sweeping every alignment against page_size=4 with
+    spec_tokens=3 (ticks emit up to exactly one page of tokens): accepted
+    lengths land ON page boundaries (accepted % page_size == 0) and
+    straddle them, and every alignment stays token-identical."""
+    for prompt_len in range(4, 10):
+        prompt = [(5 * j) % F32_TINY.vocab_size or 1
+                  for j in range(prompt_len)]
+        engine = make_engine(params, slots=1, page_size=4, spec_tokens=3,
+                             draft_layers=2)
+        handle = engine.submit(prompt, max_new_tokens=8)
+        drain(engine)
+        assert (handle.result(timeout_s=5)["tokens"]
+                == reference_tokens(params, prompt, 8))
+        assert engine.stats()["specAcceptanceRate"] == 1.0
+
+
+def test_prefix_cache_hit_with_spec_is_exact(params):
+    """The draft lane mirrors every prefill chunk through the same page
+    tables, so a radix-tree hit (and a mid-page COW divergence) must stay
+    exact with the lane on — both lanes' K/V ride the shared pages."""
+    engine = make_engine(params, prefix_cache="on", prefix_min_tokens=8,
+                         prefill_chunk_tokens=16)
+    system = [(3 * j) % F32_TINY.vocab_size or 1 for j in range(40)]
+    for tail in ([7], [7], [9]):            # miss, identical hit, divergent
+        handle = engine.submit(system + tail, max_new_tokens=6)
+        drain(engine)
+        assert (handle.result(timeout_s=5)["tokens"]
+                == reference_tokens(params, system + tail, 6))
+    assert engine.stats()["prefixHits"] >= 1
+
+
+def test_spec_on_2x2_mesh_matches_generate(params):
+    """The hard gate's mesh leg: the speculative executables are pure XLA
+    (window writes + gathers), so GSPMD shards them off the cache's
+    NamedSharding — and the tokens must not notice."""
+    from tensorhive_tpu.parallel.mesh import serving_mesh
+
+    engine = make_engine(params, mesh=serving_mesh(dp=2, tp=2))
+    outputs = run_mixed(engine)
+    for prompt, new, tokens in zip(MIXED_PROMPTS, MIXED_NEWS, outputs):
+        assert tokens == reference_tokens(params, prompt, new)
+
+
+# -- rollback edge cases -----------------------------------------------------
+
+def test_eos_inside_speculative_tail(params):
+    """EOS emitted mid-accepted-run must truncate the emission exactly
+    where the legacy path would stop, free the slot and drop the rest of
+    the accepted tail."""
+    prompt = list(range(3, 11))
+    eos = reference_tokens(params, prompt, 3)[1]     # greedy token #2
+    engine = make_engine(params, slots=2, draft_layers=2, eos_token=eos)
+    handle = engine.submit(prompt, max_new_tokens=50)
+    drain(engine)
+    summary = handle.result(timeout_s=5)
+    assert summary["outcome"] == "completed"
+    assert summary["tokens"] == reference_tokens(params, prompt, 3)[:2]
+    assert engine.stats()["slotsBusy"] == 0
+
+
+def test_cancel_mid_spec_tick_frees_and_reuses(params):
+    """A cancel landing between ticks is honored at the next verify apply:
+    the slot frees without emitting, its pages recycle, and the reused
+    slot is clean."""
+    engine = make_engine(params, slots=1)
+    handle = engine.submit([1, 2, 3, 4], max_new_tokens=50)
+    engine.step()
+    engine.step()
+    handle.cancel()
+    engine.step()
+    assert handle.result(timeout_s=5)["outcome"] == "cancelled"
+    assert engine.stats()["slotsBusy"] == 0
+    follow_up = engine.submit([9, 8, 7], max_new_tokens=4)
+    drain(engine)
+    assert (follow_up.result(timeout_s=5)["tokens"]
+            == reference_tokens(params, [9, 8, 7], 4))
+
+
+def test_slot_reuse_after_heavy_rollback(params):
+    """Rejected verify writes leave stale K/V beyond the final accepted
+    position; a new occupant of the same slot (and the same recycled
+    pages) must still equal a fresh engine."""
+    engine = make_engine(params, slots=1, draft_preset="tiny")
+    first = list(range(1, 41))
+    engine.submit(first, max_new_tokens=8)
+    drain(engine)
+    second = [9, 8, 7, 6, 5]
+    handle = engine.submit(second, max_new_tokens=8)
+    drain(engine)
+    assert (handle.result(timeout_s=5)["tokens"]
+            == reference_tokens(params, second, 8))
+
+
+def test_sampled_slots_advance_one_token_per_tick(params):
+    """temperature > 0 disables speculation for that slot: it completes
+    with valid tokens, one per tick, and contributes nothing to the
+    acceptance counters."""
+    engine = make_engine(params, slots=2)
+    handle = engine.submit(list(range(3, 11)), max_new_tokens=5,
+                           temperature=0.8)
+    drain(engine)
+    summary = handle.result(timeout_s=5)
+    assert summary["outcome"] == "completed"
+    assert len(summary["tokens"]) == 5
+    assert all(0 <= t < F32_TINY.vocab_size for t in summary["tokens"])
+    assert engine.spec_proposed == 0        # sampled slots never count
+
+
+def test_spec_churn_keeps_page_accounting_exact(params):
+    """The PR 11 churn property with the lane ON: a seeded storm of
+    shared-prefix / divergent / identical joins, cancels and page-pressure
+    queue waits — after EVERY scheduler tick, free + live == pool size
+    (the draft lane rides the same page tables, so speculation must not
+    perturb the allocator at all), and cache-retained pages stay a subset
+    of live."""
+    rng = random.Random(7)
+    engine = make_engine(params, slots=3, kv_pages=18, page_size=8,
+                         queue_depth=16, prefix_cache="on",
+                         prefix_min_tokens=8, prefill_chunk_tokens=16)
+    engine.warmup(prompt_lens=(24,))
+    base = [(3 * j) % F32_TINY.vocab_size or 1 for j in range(24)]
+    pool = engine._pool
+    live = []
+    for _ in range(120):
+        roll = rng.random()
+        if roll < 0.4 and len(live) < 8:
+            kind = rng.random()
+            if kind < 0.4:
+                prompt = base + [rng.randrange(1, 500)]
+            elif kind < 0.7:
+                prompt = (base[:rng.choice((8, 16))]
+                          + [rng.randrange(1, 500)
+                             for _ in range(rng.randrange(1, 6))])
+            else:
+                prompt = [rng.randrange(1, 500)
+                          for _ in range(rng.randrange(2, 20))]
+            try:
+                live.append(engine.submit(
+                    prompt, max_new_tokens=rng.randrange(1, 6)))
+            except QueueFullError:
+                pass
+        elif live and roll < 0.5:
+            rng.choice(live).cancel()
+        engine.step()
+        assert pool.free_pages + pool.live_pages == pool.num_pages
+        assert pool.cached_only_pages() <= pool.live_pages
+        live = [handle for handle in live if not handle.done]
+    while engine.has_work():
+        engine.step()
+        assert pool.free_pages + pool.live_pages == pool.num_pages
+
+
+# -- compile discipline ------------------------------------------------------
+
+@pytest.mark.parametrize("paged", [True, False])
+def test_zero_recompiles_across_accept_rollback_cycles(params, paged):
+    """Accept counts, rollbacks, window contents, page assignment and slot
+    placement are all traced-operand changes: after warmup, the verify,
+    draft-propose and prefill executables must not grow across a mixed
+    storm (greedy + sampled, every bucket, joins mid-batch)."""
+    engine = make_engine(params, paged=paged)
+    lens = (8, 20, 28, 40, 1, 56)
+    engine.warmup(prompt_lens=lens)
+    step_execs = engine.step_executable._cache_size()
+    draft_execs = engine.spec_draft_executable._cache_size()
+    prefill_execs = engine.prefill_executable._cache_size()
+    handles = []
+    for index, plen in enumerate(lens):
+        prompt = [(3 * index + j) % F32_TINY.vocab_size or 1
+                  for j in range(plen)]
+        handles.append(engine.submit(
+            prompt, max_new_tokens=5,
+            temperature=0.0 if index % 2 == 0 else 0.7))
+        engine.step()
+    drain(engine)
+    assert all(h.result(timeout_s=5)["outcome"] == "completed"
+               for h in handles)
+    assert engine.step_executable._cache_size() == step_execs
+    assert engine.spec_draft_executable._cache_size() == draft_execs
+    assert engine.prefill_executable._cache_size() == prefill_execs
+
+
+def test_speculative_off_is_fingerprint_identical_rollback(params):
+    """speculative=off (and auto on this CPU backend) must never mint a
+    serving_spec_* fingerprint, must keep the legacy step executable, and
+    must serve off/None speculative stats — byte-identical PR 6-11
+    behavior."""
+    assert resolve_speculative("auto") == "off"     # CPU backend
+    before = set(decode._compile_seen)
+    engine = make_engine(params, speculative="auto")
+    engine.warmup(prompt_lens=(8,))
+    handle = engine.submit([1, 2, 3], max_new_tokens=3)
+    drain(engine)
+    assert handle.result(timeout_s=5)["outcome"] == "completed"
+    minted = set(decode._compile_seen) - before
+    assert not any("spec" in str(fingerprint[0]) for fingerprint in minted)
+    assert engine._spec is None
+    assert engine.spec_draft_executable is None
+    assert engine.step_executable.__wrapped__.__name__ == "_paged_step_body"
+    stats = engine.stats()
+    assert stats["speculative"] == "off"
+    assert stats["specTokens"] is None
+    assert stats["specAcceptanceRate"] is None
+
+
+def test_spec_fingerprints_are_counted(params):
+    """The two new executables land in the compile counter under the
+    serving_spec_{draft,verify} families (TH-JIT's seam contract made
+    observable)."""
+    before = set(decode._compile_seen)
+    # a shape no other test uses, so the fingerprint tuples are fresh even
+    # though _compile_seen is process-global
+    engine = make_engine(params, slots=3, spec_tokens=2)
+    engine.warmup(prompt_lens=(8,))
+    minted = {fingerprint[0] for fingerprint
+              in set(decode._compile_seen) - before}
+    assert "serving_spec_draft" in minted
+    assert "serving_spec_verify" in minted
+
+
+# -- wiring ------------------------------------------------------------------
+
+def test_stats_metrics_ledger_and_alert(params, config):
+    from tensorhive_tpu.observability import (
+        get_registry,
+        get_request_ledger,
+    )
+    from tensorhive_tpu.observability.alerts import (
+        _serving_spec_acceptance,
+        default_rule_pack,
+    )
+
+    engine = make_engine(params, slots=2, draft_layers=2)
+    handle = engine.submit(list(range(3, 11)), max_new_tokens=6)
+    drain(engine)
+    assert handle.result(timeout_s=5)["outcome"] == "completed"
+    stats = engine.stats()
+    assert stats["speculative"] == "on"
+    assert stats["specTokens"] == 4
+    assert stats["specProposed"] > 0
+    assert stats["specAccepted"] == stats["specProposed"]
+    assert stats["specAcceptanceRate"] == 1.0
+
+    row = [r for r in get_request_ledger().recent()
+           if r["requestId"] == handle.request_id][0]
+    assert row["draftTokens"] > 0
+    assert row["acceptedTokens"] == row["draftTokens"]
+    assert row["acceptanceRate"] == 1.0
+
+    rendered = get_registry().render()
+    assert "tpuhive_generate_spec_proposed_total" in rendered
+    assert "tpuhive_generate_spec_accepted_total" in rendered
+
+    # alert source: silent with no engine, silent below the proposal
+    # debounce, live once enough tokens have been judged
+    set_engine(None)
+    assert _serving_spec_acceptance() is None
+    off = make_engine(params, speculative="off")
+    set_engine(off)
+    try:
+        assert _serving_spec_acceptance() is None    # lane off: no signal
+        set_engine(engine)
+        assert engine.spec_acceptance_rate(min_proposed=1) == 1.0
+        engine.spec_proposed, engine.spec_accepted = 200, 10
+        assert _serving_spec_acceptance() == pytest.approx(0.05)
+    finally:
+        set_engine(None)
+
+    rules = {rule.name: rule for rule in default_rule_pack()}
+    assert "spec_acceptance_low" in rules
+    assert rules["spec_acceptance_low"].op == "<"
+    assert rules["spec_acceptance_low"].threshold == pytest.approx(0.1)
+
+
+def test_draft_validation_and_self_draft_sharing(params):
+    with pytest.raises(ValueError, match="spec_tokens"):
+        make_engine(params, spec_tokens=0)
+    with pytest.raises(ValueError, match="speculative"):
+        make_engine(params, speculative="maybe")
+    with pytest.raises(ValueError, match="vocab"):
+        make_engine(params, draft_preset="t2t-base")   # vocab 32k != 512
+    with pytest.raises(ValueError, match="draft_layers"):
+        build_draft(params, F32_TINY, draft_layers=3)  # tiny has 2 layers
+    # self-draft shares leaves by reference: zero extra parameter HBM
+    draft_params, draft_config, shares = build_draft(params, F32_TINY)
+    assert shares
+    assert draft_config.n_layers == 1                  # half of 2
+    assert draft_params["tok_embed"] is params["tok_embed"]
+    assert draft_params["blocks"][0] is params["blocks"][0]
+
+
+def test_generation_service_wires_spec_config(config, db):
+    """build_engine threads the four [generation_service] knobs through."""
+    from tensorhive_tpu.core.services.generation import build_engine
+
+    config.generation.enabled = True
+    config.generation.slots = 2
+    config.generation.max_len = 64
+    config.generation.speculative = "on"
+    config.generation.spec_tokens = 3
+    config.generation.draft_layers = 2
+    engine = build_engine(config)
+    assert engine.speculative == "on"
+    assert engine.spec_tokens == 3
+    assert engine._spec is not None
+    assert engine._spec.draft_config.n_layers == 2

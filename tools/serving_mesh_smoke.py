@@ -60,6 +60,8 @@ def serving_config(mesh_dp: int, mesh_tp: int) -> Config:
     cfg.generation.mesh_tp = mesh_tp
     cfg.generation.queue_depth = 2 * len(PROMPT_LENS)
     cfg.generation.use_flash = False
+    # legacy mesh contracts measure sharding, never speculation
+    cfg.generation.speculative = "off"
     return cfg
 
 

@@ -1,0 +1,222 @@
+"""Smoke-test the speculative decoding lane end to end (``make spec-smoke``;
+docs/SERVING.md "Speculative decoding").
+
+Boots the real daemon surface — WSGI app over a real socket, a live
+GenerationService pump, in-memory DB — around a SPECULATIVE engine, then
+proves the lane's operational contract over HTTP:
+
+1. stream one authenticated ``POST /api/generate`` request through the
+   spec-on engine and record its tokens;
+2. the request's ledger row must carry the acceptance fields
+   (``draftTokens`` ≥ one tick of proposals, ``acceptedTokens``/
+   ``acceptanceRate`` present), and ``/api/generate/stats`` must report
+   the lane on with its window depth;
+3. the ``/api/metrics`` scrape must export the
+   ``tpuhive_generate_spec_{proposed,accepted}_total`` counters;
+4. ZERO post-warmup recompiles across the speculative ticks (verify,
+   draft-propose and prefill executables all fingerprint-stable);
+5. swap in a ``speculative="off"`` engine built from the SAME params and
+   stream the SAME prompt: the two streams must be **token-identical** —
+   the hard gate that makes speculation a pure latency trade, never a
+   behavior change.
+
+Engines run the f32 tiny config (like the unit suite): the identity gate
+is an exactness statement, and bf16 batched-vs-sequential accumulation
+can flip greedy near-ties on untrained weights (the PR 3 caveat).
+
+Exit 0 = healthy.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+os.environ.setdefault("TPUHIVE_PYTEST", "1")          # DB goes in-memory
+
+PROMPT = [3, 4, 5, 6, 7, 8, 9, 10]
+NEW_TOKENS = 8
+SPEC_TOKENS = 4
+
+PROBLEMS = []
+
+
+def check(ok: bool, what: str) -> None:
+    status = "ok" if ok else "FAIL"
+    print(f"spec-smoke: {status}: {what}")
+    if not ok:
+        PROBLEMS.append(what)
+
+
+def request(url: str, body=None, headers=None, method=None):
+    """(status, text, headers) over real HTTP; >=400 is a result."""
+    data = None if body is None else json.dumps(body).encode()
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers={"Content-Type": "application/json",
+                                          **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return resp.status, resp.read().decode(), dict(resp.headers)
+    except urllib.error.HTTPError as exc:
+        return exc.code, exc.read().decode(), dict(exc.headers)
+
+
+def stream_tokens(base: str, auth: dict):
+    status, body, headers = request(f"{base}/generate", body={
+        "promptTokens": PROMPT, "maxNewTokens": NEW_TOKENS,
+        "temperature": 0}, headers=auth)
+    check(status == 200, f"POST /generate streamed (got {status})")
+    lines = [json.loads(line) for line in body.strip().splitlines()]
+    done = lines[-1]
+    check(done.get("outcome") == "completed",
+          f"stream completed (got {done})")
+    return done.get("tokens"), headers.get("X-Request-Id")
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from tensorhive_tpu.config import Config, set_config
+
+    config = Config(config_dir=Path("/tmp/tpuhive-spec-smoke"))
+    config.api.secret_key = "spec-smoke-secret"
+    config.generation.enabled = True
+    config.generation.interval_s = 0.01
+    set_config(config)
+
+    from tensorhive_tpu.db.engine import Engine, set_engine as set_db
+    from tensorhive_tpu.db.migrations import ensure_schema
+
+    engine_db = Engine(":memory:")
+    ensure_schema(engine_db)
+    set_db(engine_db)
+
+    from tensorhive_tpu.db.models import User
+
+    admin = User(username="smoke-admin", email="smoke@example.com",
+                 password="SuperSecret42").save()
+    admin.add_role("user")
+    admin.add_role("admin")
+
+    from tensorhive_tpu.models.transformer import PRESETS, TransformerLM
+    from tensorhive_tpu.core.services.generation import GenerationService
+    from tensorhive_tpu.serving.engine import SlotEngine
+
+    f32_tiny = dataclasses.replace(PRESETS["tiny"], dtype=jnp.float32,
+                                   use_flash=False, remat=False,
+                                   max_seq_len=128)
+    params = TransformerLM.init(jax.random.PRNGKey(0), f32_tiny)
+
+    def build(speculative: str) -> SlotEngine:
+        engine = SlotEngine(params, f32_tiny, slots=2, max_len=96,
+                            queue_depth=4, speculative=speculative,
+                            spec_tokens=SPEC_TOKENS)
+        engine.warmup(prompt_lens=(len(PROMPT),))
+        return engine
+
+    spec_engine = build("on")
+    check(spec_engine.stats()["speculative"] == "on",
+          "speculative engine resolved on")
+    step_execs = spec_engine.step_executable._cache_size()
+    draft_execs = spec_engine.spec_draft_executable._cache_size()
+    prefill_execs = spec_engine.prefill_executable._cache_size()
+
+    generation = GenerationService(config=config, engine=spec_engine)
+    generation.start()
+
+    from tensorhive_tpu.api.server import APIServer
+
+    server = APIServer()
+    server.config.api.url_hostname = "127.0.0.1"
+    server.config.api.url_port = 0                     # ephemeral
+    port = server.start()
+    base = f"http://127.0.0.1:{port}/api"
+    off_service = None
+    try:
+        status, body, _ = request(f"{base}/user/login", body={
+            "username": "smoke-admin", "password": "SuperSecret42"})
+        check(status == 200, f"admin login over HTTP (got {status})")
+        auth = {"Authorization": "Bearer " + json.loads(body)["accessToken"]}
+
+        # -- 1: spec-on stream ---------------------------------------------
+        spec_tokens_out, request_id = stream_tokens(base, auth)
+        check(bool(request_id), "X-Request-Id header present")
+        check(isinstance(spec_tokens_out, list)
+              and len(spec_tokens_out) == NEW_TOKENS,
+              f"spec-on stream emitted {NEW_TOKENS} tokens")
+
+        # -- 2: ledger row carries acceptance fields; stats show the lane --
+        status, body, _ = request(f"{base}/admin/requests", headers=auth)
+        check(status == 200, f"GET /admin/requests (got {status})")
+        rows = [row for row in json.loads(body)["requests"]
+                if row["requestId"] == request_id]
+        check(len(rows) == 1, "exactly one ledger row for the request")
+        if rows:
+            row = rows[0]
+            check((row["draftTokens"] or 0) >= SPEC_TOKENS,
+                  f"ledger draftTokens >= one tick of proposals ({row})")
+            check(row["acceptedTokens"] is not None
+                  and "acceptanceRate" in row,
+                  "ledger carries acceptedTokens/acceptanceRate")
+        status, body, _ = request(f"{base}/generate/stats", headers=auth)
+        check(status == 200, f"GET /generate/stats (got {status})")
+        stats = json.loads(body)
+        check(stats["speculative"] == "on"
+              and stats["specTokens"] == SPEC_TOKENS,
+              f"stats report the lane on at depth {SPEC_TOKENS}")
+        check(stats["specProposed"] >= SPEC_TOKENS,
+              f"stats count proposals ({stats['specProposed']})")
+
+        # -- 3: acceptance counters in the scrape --------------------------
+        status, scrape, _ = request(f"{base}/metrics")
+        check(status == 200, f"GET /metrics (got {status})")
+        check("tpuhive_generate_spec_proposed_total" in scrape,
+              "spec proposed counter in the exposition")
+        check("tpuhive_generate_spec_accepted_total" in scrape,
+              "spec accepted counter in the exposition")
+
+        # -- 4: zero post-warmup recompiles across speculative ticks -------
+        check(spec_engine.step_executable._cache_size() == step_execs
+              and spec_engine.spec_draft_executable._cache_size()
+              == draft_execs
+              and spec_engine.prefill_executable._cache_size()
+              == prefill_execs,
+              "zero new executables while the speculative request ran")
+
+        # -- 5: spec-off stream must be token-identical --------------------
+        generation.shutdown()
+        generation.join(timeout=5)
+        off_engine = build("off")
+        off_service = GenerationService(config=config, engine=off_engine)
+        off_service.start()
+        off_tokens_out, _ = stream_tokens(base, auth)
+        check(off_tokens_out == spec_tokens_out,
+              "spec-on stream token-identical to spec-off stream "
+              f"({spec_tokens_out} vs {off_tokens_out})")
+    finally:
+        server.stop()
+        generation.shutdown()
+        generation.join(timeout=5)
+        if off_service is not None:
+            off_service.shutdown()
+            off_service.join(timeout=5)
+
+    if PROBLEMS:
+        print(f"spec-smoke: {len(PROBLEMS)} problem(s)", file=sys.stderr)
+        return 1
+    print("spec-smoke: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
