@@ -2,7 +2,7 @@
 # mypy + flake8 per .circleci/config.yml:33-38): the dependency-free AST
 # lint + thivelint analyzer always run; mypy/ruff run when installed
 # (absent from this image).
-.PHONY: check lint analysis analysis-fast test bench probe metrics-smoke decode-smoke alerts-smoke chaos-smoke serving-smoke serving-mesh-smoke trace-smoke prefix-smoke spec-smoke
+.PHONY: check lint analysis analysis-fast test bench probe metrics-smoke decode-smoke alerts-smoke chaos-smoke serving-smoke serving-mesh-smoke trace-smoke prefix-smoke spec-smoke serving-chaos-smoke
 
 check: lint analysis
 	@command -v ruff >/dev/null 2>&1 && ruff check . || echo "ruff not installed; skipped (tools/lint.py covered the always-on subset)"
@@ -95,6 +95,15 @@ prefix-smoke:
 # decoding")
 spec-smoke:
 	python tools/spec_smoke.py
+
+# serving data-plane chaos (docs/ROBUSTNESS.md "Serving data plane"):
+# seeded ServingFaultPlan over a real socket — kill a step mid-stream ->
+# the client gets the terminal error chunk within its deadline (zero hung
+# streams), the supervisor auto-restores token-identically, a forced
+# crash loop trips the breaker (503 + reason, engine_crash_loop fires)
+# and recovery resolves it, drain/resume close and reopen admission
+serving-chaos-smoke:
+	python tools/serving_chaos_smoke.py
 
 probe:
 	$(MAKE) -C tensorhive_tpu/native

@@ -755,6 +755,80 @@ def bench_generate_serving():
         "zero_recompile_verdict": spec_recompiles == 0,
     })
     _log(f"  speculative: {spec_block}")
+
+    # serving data-plane fault recovery (docs/ROBUSTNESS.md "Serving data
+    # plane"): time-to-restore after an injected fatal fault through the
+    # real GenerationService supervisor, requests failed-fast vs hung
+    # (hung must be 0 — every stream ends terminally), and post-restore
+    # token identity. Progressive-install like every block above, so the
+    # robustness envelope gets a trend line like every perf lever.
+    from tensorhive_tpu import serving as _serving
+    from tensorhive_tpu.config import Config as _Config
+    from tensorhive_tpu.core.services.generation import GenerationService
+    from tensorhive_tpu.serving.faults import ServingFaultPlan
+
+    fault_block = {"seed": 42}
+    result["fault_recovery"] = fault_block
+    plan = ServingFaultPlan(seed=42)
+    fault_config = _Config(config_dir=Path("/tmp/tpuhive-bench-fault"))
+    fault_config.generation.interval_s = 0.01
+    fault_config.generation.transient_backoff_s = 0.0
+
+    def fault_factory():
+        engine = SlotEngine(params, config, slots=slots, max_len=max_len,
+                            queue_depth=2 * slots, page_size=page_size,
+                            prefix_cache="off", speculative="off",
+                            fault_plan=plan)
+        engine.warmup(prompt_lens=(prompt_lens[0],))
+        return engine
+
+    service = GenerationService(config=fault_config, engine=fault_factory(),
+                                engine_factory=fault_factory)
+    try:
+        first_engine = service.engine
+        probe_prompt = prompts()[0]
+        healthy = first_engine.submit(probe_prompt,
+                                      max_new_tokens=new_tokens)
+        while not healthy.done:
+            service.do_run()
+        reference_tokens = healthy.result(timeout_s=30)["tokens"]
+
+        # storm, make partial progress, then kill a step mid-flight
+        handles = [first_engine.submit(prompt, max_new_tokens=new_tokens)
+                   for prompt in prompts()]
+        service.do_run()
+        plan.fail_next("step", 1)
+        fault_armed = time.perf_counter()
+        while service.engine is first_engine or service.engine is None:
+            service.do_run()                 # fail fast + rebuild + warmup
+        fault_block["restore_s"] = round(
+            time.perf_counter() - fault_armed, 3)
+        completed = failed_fast = hung = 0
+        for handle in handles:
+            try:
+                handle.result(timeout_s=1)
+                completed += 1
+            except RuntimeError:
+                failed_fast += 1             # terminal error chunk
+            except TimeoutError:
+                hung += 1                    # the outcome that must be 0
+        fault_block.update({
+            "requests_completed_before_fault": completed,
+            "requests_failed_fast": failed_fast,
+            "requests_hung": hung,
+        })
+        verify = service.engine.submit(probe_prompt,
+                                       max_new_tokens=new_tokens)
+        while not verify.done:
+            service.do_run()
+        fault_block["post_restore_token_identity"] = (
+            verify.result(timeout_s=30)["tokens"] == reference_tokens)
+        fault_block["engine_restarts"] = \
+            _serving.get_serving_state()["restarts"]
+    finally:
+        service.shutdown()
+        _serving.set_engine(None)
+    _log(f"  fault_recovery: {fault_block}")
     return result
 
 

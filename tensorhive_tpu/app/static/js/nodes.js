@@ -242,6 +242,8 @@ async function refreshServing() {
   const ms = v => v == null ? "–" : v.toFixed(1) + "ms";
   el.innerHTML = `<div class="card"><div class="row">
     <h3 style="margin:0">Serving</h3>
+    ${!stats.draining ? "" :
+      servingBadge("draining", "admission closed", true)}
     ${servingBadge("queue", stats.queueDepth + "/" + stats.queueCapacity,
                    stats.queueDepth >= stats.queueCapacity)}
     ${servingBadge("slots", stats.slotsBusy + "/" + stats.slots,
@@ -270,9 +272,25 @@ async function refreshServing() {
     <span class="muted">${stats.tokensEmitted} tokens ·
       ${stats.requestsCompleted} requests</span>
     <span style="flex:1"></span>
+    <button class="ghost" onclick="toggleDrain(${stats.draining})"
+      title="admin: drain stops admission (503 + Retry-After) while
+             in-flight requests finish; resume reopens it">
+      ${stats.draining ? "resume" : "drain"}</button>
     <button class="ghost" onclick="probeGenerate()"
       title="stream a tiny generation through POST /generate">probe</button>
   </div></div>`;
+}
+
+/* graceful drain / resume (admin; docs/ROBUSTNESS.md "Serving data
+   plane"): drain closes admission with an honest Retry-After while
+   in-flight requests finish, resume reopens it */
+async function toggleDrain(draining) {
+  const action = draining ? "resume" : "drain";
+  try {
+    const doc = await api("/admin/generate/" + action, { json: {} });
+    toast(action + ": " + doc.inFlight + " request(s) in flight");
+    refreshServing();
+  } catch (e) { toast(e.message, true); }
 }
 
 /* recent-requests strip (admin): the request-scoped view behind the serving

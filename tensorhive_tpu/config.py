@@ -237,6 +237,31 @@ class GenerationConfig:
     slot_leak_after_s: float = 60.0  # silent-busy-slot alert threshold
     request_ledger_size: int = 256   # bounded per-request trace ring
                                      # (GET /api/admin/requests)
+    # -- data-plane fault tolerance (docs/ROBUSTNESS.md "Serving data
+    # plane"): per-request deadlines, the engine supervisor's restart
+    # budget and the graceful-drain bound
+    default_deadline_s: float = 120.0  # per-request wall budget (queue +
+                                       # prefill + decode) when the body
+                                       # omits deadlineS; 0 = no deadline
+    max_deadline_s: float = 600.0    # ceiling for per-request deadlineS
+                                     # overrides (422 past it)
+    transient_retries: int = 3       # transient pump failures retried
+                                     # against the SAME engine per
+                                     # incident before escalating to the
+                                     # fatal fail-fast + rebuild path
+    transient_backoff_s: float = 0.05  # base backoff between transient
+                                       # retries (doubles per retry)
+    restart_budget: int = 3          # engine rebuilds allowed within
+                                     # restart_window_s before the
+                                     # crash-loop breaker trips (503 with
+                                     # the reason)
+    restart_window_s: float = 60.0   # sliding window the budget counts in
+    restart_cooldown_s: float = 30.0  # crash-loop breaker cooldown before
+                                      # one probe rebuild is allowed
+    drain_timeout_s: float = 10.0    # shutdown drain bound: in-flight
+                                     # requests get this long to finish
+                                     # before being failed fast with a
+                                     # terminal chunk
 
 
 @dataclasses.dataclass

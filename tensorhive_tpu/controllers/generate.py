@@ -25,7 +25,7 @@ from werkzeug.wrappers import Response
 
 from ..api.app import RequestContext, json_body, route
 from ..api.schema import arr, obj, s
-from ..serving import AdmissionError, get_engine
+from ..serving import AdmissionError, EngineDrainingError, get_engine
 from ..utils.exceptions import ForbiddenError
 
 #: streaming media type: one JSON object per line, flushed per token
@@ -36,6 +36,11 @@ GENERATE_BODY = obj(
     promptTokens=arr(s("integer")),
     maxNewTokens=s("integer"),
     temperature=s("number"),
+    #: per-request deadline override (seconds, capped by
+    #: [generation_service] max_deadline_s; omitted = default_deadline_s).
+    #: Expiry ends the stream with an honest outcome=timeout done chunk —
+    #: in queue, mid-prefill or mid-decode (docs/ROBUSTNESS.md)
+    deadlineS=s("number"),
 )
 
 STATS_SCHEMA = obj(
@@ -45,6 +50,10 @@ STATS_SCHEMA = obj(
     slotsBusy=s("integer"),
     queueDepth=s("integer"),
     queueCapacity=s("integer"),
+    #: drain mode (docs/ROBUSTNESS.md "Serving data plane"): admission is
+    #: closed (503 + Retry-After) while in-flight requests finish — the
+    #: serving-strip draining badge renders this
+    draining=s("boolean"),
     maxSeqLen=s("integer"),
     #: serving mesh layout "dp x tp" (docs/SERVING.md "Multi-chip
     #: serving"); "1x1" = single-chip engine
@@ -95,10 +104,29 @@ def _unavailable_msg() -> str:
                "([generation_service] in config.toml)")
 
 
-def _service_unavailable() -> Response:
-    return Response(
-        json.dumps({"msg": _unavailable_msg()}),
+#: Retry-After on 503s when the supervisor gave no sharper hint: long
+#: enough for an operator restart, short enough that clients re-probe
+DEFAULT_UNAVAILABLE_RETRY_AFTER_S = 30
+
+
+def _service_unavailable(msg: Optional[str] = None,
+                         retry_after_s: Optional[float] = None) -> Response:
+    """503 with the stored unavailability reason AND an honest Retry-After:
+    a restart in progress advertises the supervisor's hint (seconds until
+    the rebuild or the crash-loop cooldown expires), anything else the
+    conservative default — clients should re-probe, not give up
+    (docs/ROBUSTNESS.md 'Serving data plane')."""
+    from ..serving import get_serving_state
+
+    if retry_after_s is None:
+        retry_after_s = (get_serving_state()["retry_after_s"]
+                         or DEFAULT_UNAVAILABLE_RETRY_AFTER_S)
+    response = Response(
+        json.dumps({"msg": msg or _unavailable_msg(),
+                    "retryAfterS": round(float(retry_after_s), 1)}),
         status=503, content_type="application/json")
+    response.headers["Retry-After"] = str(max(1, int(retry_after_s)))
+    return response
 
 
 def _rejection(exc: AdmissionError) -> Response:
@@ -139,7 +167,8 @@ def _check_restriction_gate(context: RequestContext) -> None:
                   403: obj(required=["msg"], msg=s("string")),
                   429: obj(required=["msg"], msg=s("string"),
                            retryAfterS=s("number")),
-                  503: obj(required=["msg"], msg=s("string"))})
+                  503: obj(required=["msg"], msg=s("string"),
+                           retryAfterS=s("number"))})
 def post_generate(context: RequestContext) -> Response:
     """Submit one prompt to the continuous-batching engine and stream its
     tokens. Response lines: ``{"token": n}`` per generated token, then one
@@ -153,16 +182,24 @@ def post_generate(context: RequestContext) -> Response:
     prompt = body["promptTokens"]
     max_new = int(body.get("maxNewTokens") or 16)
     temperature = float(body.get("temperature") or 0.0)
+    deadline_raw = body.get("deadlineS")
+    deadline_s = None if deadline_raw is None else float(deadline_raw)
     from ..config import get_config
 
     timeout_s = get_config().generation.stream_timeout_s
     try:
-        # submit() validates prompt/length/temperature (ValueError -> 422
-        # via the standard mapping is NOT available here since ValueError
-        # isn't typed; map explicitly)
+        # submit() validates prompt/length/temperature/deadline
+        # (ValueError -> 422 via the standard mapping is NOT available
+        # here since ValueError isn't typed; map explicitly)
         handle = engine.submit(prompt, max_new_tokens=max_new,
                                temperature=temperature,
-                               user_key=str(context.user_id))
+                               user_key=str(context.user_id),
+                               deadline_s=deadline_s)
+    except EngineDrainingError as exc:
+        # a drain is not load shedding: the plane is deliberately going
+        # quiet, so the answer is 503 (with the drain ETA), not 429
+        return _service_unavailable(msg=str(exc),
+                                    retry_after_s=exc.retry_after_s)
     except AdmissionError as exc:
         return _rejection(exc)
     except ValueError as exc:
@@ -226,3 +263,52 @@ def get_generate_stats(context: RequestContext):
     stats: Dict[str, Optional[float]] = {"enabled": True}
     stats.update(engine.stats())
     return stats
+
+
+DRAIN_SCHEMA = obj(
+    required=["draining", "inFlight"],
+    draining=s("boolean"),
+    #: requests still queued or running (what the drain is waiting on)
+    inFlight=s("integer"),
+    #: the Retry-After estimate new requests are being answered with
+    retryAfterS=s("number"),
+)
+
+
+@route("/admin/generate/drain", ["POST"], auth="admin", tag="generate",
+       summary="Drain the serving plane (stop admission, finish in-flight)",
+       responses={200: DRAIN_SCHEMA,
+                  503: obj(required=["msg"], msg=s("string"),
+                           retryAfterS=s("number"))})
+def post_generate_drain(context: RequestContext):
+    """Graceful drain (docs/ROBUSTNESS.md "Serving data plane"): admission
+    closes — new ``POST /api/generate`` requests answer 503 with an honest
+    Retry-After — while everything queued or running keeps finishing
+    through the live pump. ``draining`` surfaces in ``/api/generate/stats``
+    and flips ``/api/readyz`` so orchestrators stop routing here.
+    Idempotent; ``POST /api/admin/generate/resume`` reopens admission."""
+    engine = get_engine()
+    if engine is None:
+        return _service_unavailable()
+    engine.drain()
+    stats = engine.stats()
+    return {"draining": True,
+            "inFlight": stats["slotsBusy"] + stats["queueDepth"],
+            "retryAfterS": engine.drain_retry_after()}
+
+
+@route("/admin/generate/resume", ["POST"], auth="admin", tag="generate",
+       summary="Reopen admission after a drain",
+       responses={200: DRAIN_SCHEMA,
+                  503: obj(required=["msg"], msg=s("string"),
+                           retryAfterS=s("number"))})
+def post_generate_resume(context: RequestContext):
+    """Undo a drain: admission reopens immediately. Idempotent."""
+    engine = get_engine()
+    if engine is None:
+        return _service_unavailable()
+    engine.resume()
+    stats = engine.stats()
+    return {"draining": False,
+            "inFlight": stats["slotsBusy"] + stats["queueDepth"],
+            "retryAfterS": 0.0}

@@ -19,36 +19,83 @@ Boot-time failure policy: a configured checkpoint that cannot be served
 crash the whole daemon NOR silently fall back to random init params — the
 service comes up with no engine, records the reason, and the API answers
 503 carrying it (docs/SERVING.md "Loading checkpoints").
+
+Run-time failure policy (the engine supervisor, docs/ROBUSTNESS.md
+"Serving data plane"): a pump tick that raises is classified transient vs
+fatal (``serving/faults.py::classify_failure`` — fatal by default, because
+a failure inside a dispatch may have consumed the donated KV cache).
+Transient failures retry the tick against the SAME engine with bounded
+exponential backoff; a fatal failure FAILS FAST — every in-flight stream
+gets a terminal ``{"error": ...}`` chunk and an ``outcome=failed`` ledger
+row, streams never hang — then the engine is rebuilt (fresh cache,
+checkpoint reload, same config) under a capped restart budget. Exhausting
+the budget inside the window trips a crash-loop breaker: the plane
+un-publishes with a 503 reason (exactly like the checkpoint-load path) and
+one probe rebuild is allowed per cooldown. ``shutdown()`` rides the drain
+path: admission stops with an honest Retry-After, in-flight requests get
+``drain_timeout_s`` to finish, stragglers are failed fast — a restart is
+never a silent EOF.
 """
 from __future__ import annotations
 
 import dataclasses
 import logging
-from typing import Optional
+import time
+from typing import Callable, List, Optional
 
 from ...config import Config, get_config
+from ...observability import get_registry
 from ...serving import CheckpointLoadError
+from ...serving.faults import TRANSIENT, classify_failure
 from .base import Service
 
 log = logging.getLogger(__name__)
 
+_ENGINE_RESTARTS = get_registry().counter(
+    "tpuhive_generate_engine_restarts_total",
+    "Successful serving-engine rebuilds after a fatal data-plane failure "
+    "(fresh cache + checkpoint reload; docs/ROBUSTNESS.md 'Serving data "
+    "plane').")
+_STEP_FAILURES = get_registry().counter(
+    "tpuhive_generate_step_failures_total",
+    "Generation pump failures by classified kind: transient (tick retried "
+    "against the same engine) or fatal (fail-fast + engine rebuild).",
+    labels=("kind",))
+
 
 class GenerationService(Service):
     def __init__(self, config: Optional[Config] = None,
-                 engine: Optional[object] = None) -> None:
+                 engine: Optional[object] = None,
+                 engine_factory: Optional[Callable[[], object]] = None,
+                 ) -> None:
         config = config or get_config()
         super().__init__(interval_s=config.generation.interval_s)
         self.generation_config = config.generation
         # ~90% duty cycle: pump inside the interval, leave a sliver for the
         # run-loop's interruptible wait so stop() is honored promptly
         self._pump_budget_s = max(0.001, self.interval_s * 0.9)
+        # -- supervisor state (docs/ROBUSTNESS.md "Serving data plane") ----
+        #: consecutive transient pump failures in the current incident
+        self._transient_streak = 0
+        #: monotonic stamps of rebuild attempts inside the sliding window
+        self._restart_attempts: List[float] = []
+        #: crash-loop breaker: no rebuilds before this monotonic stamp
+        #: (None = closed); set when the budget is exhausted in-window
+        self._breaker_open_until: Optional[float] = None
+        self._engine_factory = engine_factory
         from ... import serving
 
+        # a fresh supervisor owns the plane from a clean slate: the restart
+        # counter and the crash-loop flag describe THIS supervisor's era
+        serving.update_serving_state(supervisor_active=True, restarts=0,
+                                     crash_loop=False, retry_after_s=None)
         if engine is not None:
             self.engine = engine
         else:
+            if self._engine_factory is None:
+                self._engine_factory = lambda: build_engine(config)
             try:
-                self.engine = build_engine(config)
+                self.engine = self._engine_factory()
             except CheckpointLoadError as exc:
                 # the daemon stays up (monitoring/scheduling are unaffected)
                 # and the serving plane 503s with the reason — an operator
@@ -63,17 +110,150 @@ class GenerationService(Service):
 
     def do_run(self) -> None:
         if self.engine is None:
+            self._maybe_rebuild()
             return
-        self.engine.pump(budget_s=self._pump_budget_s,
-                         should_stop=lambda: self.stopped)
+        try:
+            self.engine.pump(budget_s=self._pump_budget_s,
+                             should_stop=lambda: self.stopped)
+            self._transient_streak = 0
+        except Exception as exc:    # noqa: BLE001 - the supervisor's seam
+            self._handle_pump_failure(exc)
 
-    def shutdown(self) -> None:
-        # un-publish before stopping so the controller 503s new requests
-        # instead of queueing onto a pump that will never run again
+    # -- supervisor --------------------------------------------------------
+    def _handle_pump_failure(self, exc: BaseException) -> None:
+        """Classify one pump failure and act: transient → bounded-backoff
+        retry against the same engine; fatal (or transient budget spent) →
+        fail-fast every in-flight stream, then rebuild under the restart
+        budget."""
+        kind = classify_failure(exc)
+        _STEP_FAILURES.labels(kind=kind).inc()
+        generation = self.generation_config
+        if (kind == TRANSIENT
+                and self._transient_streak < generation.transient_retries):
+            self._transient_streak += 1
+            backoff = (generation.transient_backoff_s
+                       * 2 ** (self._transient_streak - 1))
+            log.warning(
+                "generation pump transient failure "
+                "(retry %d/%d after %.3fs): %s",
+                self._transient_streak, generation.transient_retries,
+                backoff, exc)
+            if backoff > 0:
+                self.wait(backoff)      # interruptible by shutdown
+            return
+        self._transient_streak = 0
+        log.error("generation pump fatal failure (%s): failing fast and "
+                  "rebuilding the engine", type(exc).__name__, exc_info=exc)
+        self._fail_fast(exc)
+        self._maybe_rebuild()
+
+    def _fail_fast(self, exc: BaseException) -> None:
+        """Un-publish the dead engine and finish every in-flight request
+        with a terminal error chunk + ``outcome=failed`` ledger row —
+        streams must NEVER hang on a dead device."""
         from ... import serving
 
-        if self.engine is not None and serving.get_engine() is self.engine:
+        engine = self.engine
+        self.engine = None
+        if serving.get_engine() is engine:
             serving.set_engine(None)
+        serving.set_unavailable_reason(
+            f"serving engine failed ({type(exc).__name__}: {exc}); "
+            "restart in progress")
+        serving.update_serving_state(
+            retry_after_s=max(1.0, 2 * self.interval_s))
+        failed = engine.fail_all_inflight(
+            f"engine fault ({type(exc).__name__}: {exc}); the engine is "
+            "restarting — retry the request")
+        if failed:
+            log.warning("failed fast %d in-flight generation request(s)",
+                        failed)
+
+    def _maybe_rebuild(self) -> None:
+        """Attempt an engine rebuild, rate-limited by the restart budget:
+        at most ``restart_budget`` attempts per ``restart_window_s``.
+        Exhausting it trips the crash-loop breaker — the plane stays
+        un-published with the reason until ``restart_cooldown_s`` elapses,
+        then ONE probe era (a fresh budget) is allowed, exactly like the
+        transport breaker's half-open state."""
+        from ... import serving
+
+        if self._engine_factory is None:
+            return      # injected engine without a factory: nothing to do
+        generation = self.generation_config
+        now = time.monotonic()
+        if self._breaker_open_until is not None:
+            if now < self._breaker_open_until:
+                return
+            self._breaker_open_until = None
+            self._restart_attempts.clear()      # half-open: fresh budget
+        window = float(generation.restart_window_s)
+        self._restart_attempts = [stamp for stamp in self._restart_attempts
+                                  if now - stamp < window]
+        if len(self._restart_attempts) >= generation.restart_budget:
+            cooldown = float(generation.restart_cooldown_s)
+            self._breaker_open_until = now + cooldown
+            reason = (f"serving engine crash loop: "
+                      f"{len(self._restart_attempts)} restarts in "
+                      f"{window:g}s; breaker open, next rebuild attempt in "
+                      f"{cooldown:g}s")
+            log.error(reason)
+            serving.set_unavailable_reason(reason)
+            serving.update_serving_state(crash_loop=True,
+                                         retry_after_s=cooldown)
+            return
+        self._restart_attempts.append(now)
+        try:
+            engine = self._engine_factory()
+        except Exception as exc:    # noqa: BLE001 - rebuild failures are
+            # the crash-loop signal, not a reason to kill the daemon
+            log.error("generation engine rebuild failed: %s", exc,
+                      exc_info=True)
+            serving.set_unavailable_reason(
+                f"engine rebuild failed ({type(exc).__name__}: {exc}); "
+                "retrying")
+            return
+        self.engine = engine
+        _ENGINE_RESTARTS.inc()
+        restarts = serving.get_serving_state()["restarts"] + 1
+        serving.update_serving_state(restarts=restarts)
+        # publishing clears the unavailability reason, the crash-loop flag
+        # and the Retry-After hint — the engine IS the recovery signal
+        serving.set_engine(engine)
+        log.info("generation engine restored (rebuild #%d)", restarts)
+
+    def shutdown(self) -> None:
+        """Stop via the drain path: admission closes (503 + Retry-After at
+        the edge) while in-flight requests get ``drain_timeout_s`` to
+        finish; stragglers are failed fast with a terminal chunk — a
+        restart never leaves a stream on a silent EOF."""
+        from ... import serving
+
+        engine = self.engine
+        if engine is not None:
+            engine.drain()
+            deadline = time.monotonic() + max(
+                0.0, float(self.generation_config.drain_timeout_s))
+            while engine.has_work() and time.monotonic() < deadline:
+                if self.is_alive():
+                    # the pump thread is live and keeps draining; just wait
+                    time.sleep(min(self.interval_s, 0.05))
+                else:
+                    # no pump running (pre-start shutdown, tests): drive
+                    # the drain ourselves
+                    engine.pump(budget_s=self._pump_budget_s)
+            if engine.has_work():
+                failed = engine.fail_all_inflight(
+                    "server shutting down: the drain timeout expired "
+                    "before this request finished — retry")
+                log.warning("drain timeout: failed fast %d in-flight "
+                            "generation request(s)", failed)
+        # un-publish before stopping the pump so the controller 503s new
+        # requests instead of queueing onto a pump that will never run
+        if engine is not None and serving.get_engine() is engine:
+            serving.set_engine(None)
+        serving.update_serving_state(supervisor_active=False,
+                                     crash_loop=False, retry_after_s=None)
         super().shutdown()
 
 
@@ -232,6 +412,8 @@ def build_engine(config: Config):
         draft_layers=generation.draft_layers,
         spec_tokens=generation.spec_tokens,
         mesh=mesh,
+        default_deadline_s=generation.default_deadline_s,
+        max_deadline_s=generation.max_deadline_s,
         queue_depth=generation.queue_depth,
         top_k=generation.top_k or None,
         eos_token=None if generation.eos_token < 0 else generation.eos_token,

@@ -477,6 +477,20 @@ def _serving_spec_acceptance() -> Optional[float]:
     return engine.spec_acceptance_rate()
 
 
+def _engine_crash_loop() -> Optional[float]:
+    """Source callable: 1.0 while the generation supervisor's crash-loop
+    breaker is open (restart budget exhausted — the plane is 503ing with
+    the reason), 0.0 while supervised and healthy, None when no supervisor
+    owns this process's serving plane (docs/ROBUSTNESS.md 'Serving data
+    plane')."""
+    from ..serving import get_serving_state
+
+    state = get_serving_state()
+    if not state["supervisor_active"]:
+        return None
+    return 1.0 if state["crash_loop"] else 0.0
+
+
 def _serving_stalled_slot_counter(
         leak_after_s: float) -> Callable[[], Optional[float]]:
     """Source callable: busy slots that have emitted nothing for
@@ -657,6 +671,26 @@ def default_rule_pack(monitoring_interval_s: Optional[float] = None,
             description="a busy serving slot has emitted nothing for "
                         "slot_leak_after_s — occupancy without progress "
                         "starves admission (docs/SERVING.md)"),
+        AlertRule(
+            name="engine_crash_loop", severity="critical",
+            kind="threshold", op=">", threshold=0.0, for_s=0.0,
+            source=_engine_crash_loop,
+            description="the serving engine's restart budget is exhausted "
+                        "— the crash-loop breaker is open and /api/generate "
+                        "is 503ing with the reason until a cooldown-gated "
+                        "rebuild succeeds (docs/ROBUSTNESS.md 'Serving "
+                        "data plane')"),
+        AlertRule(
+            name="generate_deadline_timeouts", severity="warning",
+            kind="increase",
+            metric="tpuhive_generate_deadline_timeouts_total",
+            op=">", threshold=0.0, window_s=300.0,
+            for_s=0.0,
+            description="generation requests hit their per-request "
+                        "deadline in the last 5 minutes (queue, prefill or "
+                        "mid-decode) — capacity is short of the latency "
+                        "budget; add slots/pages or shed load "
+                        "(docs/ROBUSTNESS.md 'Serving data plane')"),
     ]
 
 

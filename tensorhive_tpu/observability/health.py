@@ -93,6 +93,33 @@ def check_transport_breakers(transport_manager) -> Dict:
     return _component("transport", True)
 
 
+def check_serving() -> Optional[Dict]:
+    """The serving data plane, when this process has one: draining or a
+    crash-looped/unavailable engine means generate traffic must not be
+    routed here (docs/ROBUSTNESS.md "Serving data plane"). Returns None —
+    component omitted — when no supervisor owns a serving plane and
+    nothing is draining (processes that never serve stay unaffected)."""
+    from ..serving import get_engine, get_serving_state, \
+        get_unavailable_reason
+
+    engine = get_engine()
+    state = get_serving_state()
+    if engine is not None:
+        if getattr(engine, "draining", False):
+            stats = engine.stats()
+            in_flight = stats["slotsBusy"] + stats["queueDepth"]
+            return _component(
+                "serving", False,
+                f"draining ({in_flight} request(s) still in flight)")
+        return _component("serving", True)
+    if not state["supervisor_active"]:
+        return None
+    reason = get_unavailable_reason() or "engine not published"
+    if state["crash_loop"]:
+        return _component("serving", False, f"crash loop: {reason}")
+    return _component("serving", False, f"engine unavailable: {reason}")
+
+
 def check_probe_freshness(now: float, interval_s: float) -> Dict:
     """Telemetry freshness off the registry gauge the probe layer stamps
     after every round — no scrape round-trip, same truth Prometheus sees."""
@@ -142,5 +169,8 @@ def readiness(manager=None, now: Optional[float] = None,
     if (manager is not None and getattr(manager.config, "hosts", None)
             and getattr(manager, "transport_manager", None) is not None):
         components.append(check_transport_breakers(manager.transport_manager))
+    serving_component = check_serving()
+    if serving_component is not None:
+        components.append(serving_component)
     ready = all(component["ok"] for component in components)
     return ready, components

@@ -42,8 +42,11 @@ from typing import Deque, Dict, List, Optional
 DEFAULT_CAPACITY = 256
 
 #: terminal outcomes a record can carry (mirrors
-#: ``tpuhive_generate_requests_total{outcome=...}``)
-OUTCOMES = ("completed", "cancelled", "failed",
+#: ``tpuhive_generate_requests_total{outcome=...}``). ``failed`` is the
+#: supervisor's fail-fast path (engine fault → terminal error chunk);
+#: ``timeout`` is a per-request deadline expiring in queue, mid-prefill or
+#: mid-decode (docs/ROBUSTNESS.md "Serving data plane").
+OUTCOMES = ("completed", "cancelled", "failed", "timeout",
             "rejected_queue", "rejected_ratelimit")
 
 

@@ -42,6 +42,14 @@ class RateLimitError(AdmissionError):
     """Per-user concurrency cap exceeded — the API layer answers 429."""
 
 
+class EngineDrainingError(AdmissionError):
+    """The engine is draining (admin drain or shutdown in progress): no new
+    admissions while in-flight requests finish. The API layer answers 503
+    with an honest Retry-After — unlike the 429s above, this is not load
+    shedding; the plane is deliberately going quiet
+    (docs/ROBUSTNESS.md "Serving data plane")."""
+
+
 class CheckpointLoadError(Exception):
     """A configured ``[generation_service] checkpoint_path`` could not be
     served (missing, unreadable, or params shaped for a different model
@@ -53,17 +61,55 @@ class CheckpointLoadError(Exception):
 __all__ = [
     "AdmissionError",
     "CheckpointLoadError",
+    "EngineDrainingError",
     "QueueFullError",
     "RateLimitError",
     "get_engine",
+    "get_serving_state",
     "get_unavailable_reason",
     "set_engine",
     "set_unavailable_reason",
+    "update_serving_state",
 ]
 
 _engine: Optional["SlotEngine"] = None
 _unavailable_reason: Optional[str] = None
 _engine_lock = threading.Lock()
+
+#: supervisor lifecycle state (docs/ROBUSTNESS.md "Serving data plane"),
+#: published by GenerationService and read by the controller's 503 path
+#: (retry_after_s), the engine_crash_loop alert source and /api/readyz.
+#: Jax-free on purpose, like everything else in this package root.
+_serving_state = {
+    #: a GenerationService supervisor owns this process's serving plane —
+    #: readyz only reports a serving component while this is True (or a
+    #: drain is in progress on a harness-installed engine)
+    "supervisor_active": False,
+    #: the restart budget was exhausted inside the window: the breaker is
+    #: open and the plane 503s until a cooldown-gated rebuild succeeds
+    "crash_loop": False,
+    #: successful engine rebuilds since the supervisor started
+    "restarts": 0,
+    #: honest Retry-After hint for the 503 path (seconds); None = use the
+    #: controller's default
+    "retry_after_s": None,
+}
+
+
+def get_serving_state() -> dict:
+    """Snapshot of the supervisor lifecycle state (copy; see module var)."""
+    with _engine_lock:
+        return dict(_serving_state)
+
+
+def update_serving_state(**updates) -> None:
+    """Merge supervisor lifecycle updates (unknown keys rejected — the
+    state is a contract between the supervisor and its readers)."""
+    with _engine_lock:
+        for key, value in updates.items():
+            if key not in _serving_state:
+                raise KeyError(f"unknown serving state key {key!r}")
+            _serving_state[key] = value
 
 
 def get_engine() -> Optional["SlotEngine"]:
@@ -76,12 +122,15 @@ def get_engine() -> Optional["SlotEngine"]:
 def set_engine(engine: Optional["SlotEngine"]) -> None:
     """Install (or with None: clear) the process-wide engine — called by
     GenerationService at boot and by tests/smokes for isolation. Installing
-    a real engine clears any recorded unavailability reason."""
+    a real engine clears any recorded unavailability reason and the
+    crash-loop flag (a published engine IS the recovery signal)."""
     global _engine, _unavailable_reason
     with _engine_lock:
         _engine = engine
         if engine is not None:
             _unavailable_reason = None
+            _serving_state["crash_loop"] = False
+            _serving_state["retry_after_s"] = None
 
 
 def get_unavailable_reason() -> Optional[str]:

@@ -104,7 +104,8 @@ from ..observability import (
     get_tracer,
 )
 from ..ops.paged_attention import resolve_paged_kernel
-from . import QueueFullError, RateLimitError
+from . import EngineDrainingError, QueueFullError, RateLimitError
+from .faults import ServingFaultPlan
 from .paging import TRASH_PAGE, PagePool
 from .prefix_cache import PrefixCache
 from .speculative import (
@@ -119,7 +120,7 @@ from .speculative import (
 _REQUESTS = get_registry().counter(
     "tpuhive_generate_requests_total",
     "Generation requests by outcome: completed, rejected_queue, "
-    "rejected_ratelimit, cancelled, failed.",
+    "rejected_ratelimit, cancelled, timeout, failed.",
     labels=("outcome",))
 _TOKENS = get_registry().counter(
     "tpuhive_generate_tokens_total",
@@ -198,6 +199,13 @@ _SPEC_ACCEPTED = get_registry().counter(
     "Draft tokens the target's batched verify accepted — "
     "accepted/proposed is the acceptance rate the spec_acceptance_low "
     "alert watches.")
+_DEADLINE_TIMEOUTS = get_registry().counter(
+    "tpuhive_generate_deadline_timeouts_total",
+    "Requests whose per-request deadline expired, by phase: queue (never "
+    "reached a slot), prefill (mid-chunk), decode (truncated mid-"
+    "generation). Every timeout still ends its stream with a terminal "
+    "chunk (docs/ROBUSTNESS.md 'Serving data plane').",
+    labels=("phase",))
 
 
 # -- device functions ---------------------------------------------------------
@@ -643,6 +651,9 @@ class _Request:
     generated: List[int] = dataclasses.field(default_factory=list)
     first_token_ts: Optional[float] = None
     last_token_ts: Optional[float] = None
+    #: engine-clock stamp past which the request times out (queue, prefill
+    #: or mid-decode); None = no deadline (docs/ROBUSTNESS.md)
+    deadline_ts: Optional[float] = None
     cancelled: bool = False
     finished: bool = False
 
@@ -704,6 +715,9 @@ class SlotEngine:
         draft_layers: int = 0,
         spec_tokens: int = 4,
         mesh=None,
+        default_deadline_s: float = 0.0,
+        max_deadline_s: float = 600.0,
+        fault_plan: Optional[ServingFaultPlan] = None,
         clock: Callable[[], float] = time.monotonic,
     ) -> None:
         if not config.causal:
@@ -726,6 +740,25 @@ class SlotEngine:
         self.max_concurrent_per_user = int(max_concurrent_per_user)
         self.paged = bool(paged)
         self.clock = clock
+        # -- fault tolerance (docs/ROBUSTNESS.md "Serving data plane") -----
+        if default_deadline_s < 0 or max_deadline_s <= 0:
+            raise ValueError(
+                f"deadlines must be positive (default_deadline_s >= 0), got "
+                f"default={default_deadline_s} max={max_deadline_s}")
+        if default_deadline_s > max_deadline_s:
+            raise ValueError(
+                f"default_deadline_s={default_deadline_s} exceeds "
+                f"max_deadline_s={max_deadline_s}")
+        #: per-request wall budget applied when submit() gets no override;
+        #: 0 = no deadline (the pre-PR 14 behavior, byte-identical)
+        self.default_deadline_s = float(default_deadline_s)
+        self.max_deadline_s = float(max_deadline_s)
+        #: deterministic fault injection seam: every device dispatch
+        #: consults the plan first (serving/faults.py); None in production
+        self.fault_plan = fault_plan
+        #: drain mode: admission refused (EngineDrainingError -> 503 +
+        #: Retry-After at the API edge) while in-flight requests finish
+        self._draining = False
 
         # -- serving mesh (docs/SERVING.md "Multi-chip serving") -----------
         # mesh=None is the single-chip engine, byte-identical to PR 6-8:
@@ -981,9 +1014,32 @@ class SlotEngine:
     # -- admission --------------------------------------------------------
     def submit(self, prompt: Sequence[int], max_new_tokens: int,
                temperature: float = 0.0,
-               user_key: Optional[str] = None) -> GenerationHandle:
+               user_key: Optional[str] = None,
+               deadline_s: Optional[float] = None) -> GenerationHandle:
         """Queue one request; raises ``ValueError`` on malformed input,
-        ``RateLimitError``/``QueueFullError`` on admission failure."""
+        ``RateLimitError``/``QueueFullError`` on admission failure,
+        ``EngineDrainingError`` while the engine is draining.
+
+        ``deadline_s`` overrides the engine's ``default_deadline_s`` wall
+        budget (capped by ``max_deadline_s``); the deadline binds in queue,
+        mid-prefill and mid-decode — a request past it finishes with an
+        honest ``timeout`` outcome and a terminal stream chunk, never an
+        eternal wait (docs/ROBUSTNESS.md "Serving data plane")."""
+        if self._draining:
+            # checked before any ledger record is minted: a drain is an
+            # operator action, not admission-control signal worth a row
+            raise EngineDrainingError(
+                "engine is draining: in-flight requests are finishing, no "
+                "new admissions; retry after the drain completes",
+                retry_after_s=self.drain_retry_after())
+        if deadline_s is not None:
+            deadline_s = float(deadline_s)
+            if not 0.0 < deadline_s <= self.max_deadline_s:
+                raise ValueError(
+                    f"deadline_s must be in (0, {self.max_deadline_s:g}], "
+                    f"got {deadline_s:g}")
+        elif self.default_deadline_s > 0:
+            deadline_s = self.default_deadline_s
         prompt = [int(token) for token in prompt]
         if not prompt:
             raise ValueError("prompt must contain at least one token")
@@ -1009,12 +1065,15 @@ class SlotEngine:
                     f"{self._pool.num_pages}; shorten the prompt or "
                     "max_new_tokens")
         ledger = get_request_ledger()
+        submitted_ts = self.clock()
         request = _Request(prompt=prompt, max_new_tokens=int(max_new_tokens),
                            temperature=float(temperature),
                            user_key=str(user_key) if user_key else None,
-                           submitted_ts=self.clock(),
+                           submitted_ts=submitted_ts,
                            request_id=ledger.new_request_id(),
-                           submitted_wall=time.time())
+                           submitted_wall=time.time(),
+                           deadline_ts=(submitted_ts + deadline_s
+                                        if deadline_s else None))
         request.record = ledger.begin(
             request.request_id, prompt_tokens=len(prompt),
             max_new_tokens=request.max_new_tokens,
@@ -1097,6 +1156,38 @@ class SlotEngine:
         with self._lock:
             if not request.finished:
                 request.cancelled = True
+
+    # -- drain (docs/ROBUSTNESS.md "Serving data plane") -------------------
+    @property
+    def draining(self) -> bool:
+        return self._draining
+
+    def drain(self) -> None:
+        """Stop admitting new requests; everything queued or running keeps
+        finishing through the normal pump. ``submit()`` raises
+        ``EngineDrainingError`` (503 + Retry-After at the API edge) until
+        :meth:`resume`. Idempotent."""
+        self._draining = True
+
+    def resume(self) -> None:
+        """Re-open admission after a drain. Idempotent."""
+        self._draining = False
+
+    def drain_retry_after(self) -> float:
+        """Honest Retry-After while draining: the estimated time for every
+        in-flight request to finish at the observed inter-token p50 — the
+        slowest running slot plus the queued work amortized over the slot
+        pool (an estimate, floor 1 s; exact completion depends on EOS)."""
+        with self._lock:
+            per_token = self._intertoken_hist.quantile(0.5) or 0.05
+            running = [slot.request.max_new_tokens
+                       - len(slot.request.generated)
+                       for slot in self._slots if slot is not None]
+            queued = sum(request.max_new_tokens
+                         for request in self._pending)
+            tokens_left = (max(running) if running else 0) + (
+                queued / max(1, self.capacity))
+            return max(1.0, round(tokens_left * per_token, 1))
 
     def _record_rejection_locked(self, request: _Request,
                                  outcome: str) -> None:
@@ -1199,6 +1290,14 @@ class SlotEngine:
         np.asarray(chosen)      # force the compile before traffic arrives
 
     # -- internals --------------------------------------------------------
+    def _fault_point(self, kind: str) -> None:
+        """Fault-injection seam: consulted BEFORE every device dispatch
+        (serving/faults.py) — an injected fault therefore never leaves a
+        half-donated cache, which is what makes transient classification
+        honest for injected faults. A no-op without a plan."""
+        if self.fault_plan is not None:
+            self.fault_plan.before_dispatch(kind)
+
     def _fingerprint_fn(self, base: str) -> str:
         """Compile-counter fn name: mesh engines get a ``serving_mesh_*``
         variant (docs/OBSERVABILITY.md) so operators can tell the sharded
@@ -1240,6 +1339,7 @@ class SlotEngine:
         are traced operands: one executable per bucket width serves every
         skip offset, chunk boundary and page assignment. Returns the
         compile fingerprint event ("hit"/"miss") for the request ledger."""
+        self._fault_point("prefill")
         compile_event = self._count_chunk_prefill_compile(head.shape[1])
         self._cache = _paged_chunk_serving_prefill(
             self.params, self._operand(head), self._cache,
@@ -1254,6 +1354,7 @@ class SlotEngine:
         a traced operand (the executable never sees the slot index);
         contiguous passes the traced slot index. Returns the compile
         fingerprint event ("hit"/"miss") for the request ledger."""
+        self._fault_point("prefill")
         compile_event = self._count_prefill_compile(head.shape[1])
         if self.paged:
             self._cache = _paged_serving_prefill(
@@ -1280,6 +1381,7 @@ class SlotEngine:
         return chosen, cache, key
 
     def _run_step_dispatch(self):
+        self._fault_point("step")
         if self.paged:
             # the kernel dispatch gets its own fingerprint so operators can
             # tell WHICH paged step compiled (docs/OBSERVABILITY.md); page
@@ -1414,10 +1516,18 @@ class SlotEngine:
             joined += 1
 
     def _drop_cancelled_pending_locked(self) -> None:
+        """Cancelled requests leave the queue; so do deadline-expired ones
+        (a head-of-line request waiting for pages must time out honestly
+        instead of waiting forever — the queue-phase deadline)."""
+        now = self.clock()
         kept: Deque[_Request] = collections.deque()
         for request in self._pending:
             if request.cancelled:
                 self._finish_locked(request, outcome="cancelled")
+            elif (request.deadline_ts is not None
+                    and now >= request.deadline_ts):
+                _DEADLINE_TIMEOUTS.labels(phase="queue").inc()
+                self._finish_locked(request, outcome="timeout")
             else:
                 kept.append(request)
         self._pending = kept
@@ -1451,12 +1561,29 @@ class SlotEngine:
             head = np.zeros((1, width), np.int32)
             head[0, :prompt_len - 1] = prompt[:-1]
             started = self.clock()
-            compile_event = self._dispatch_prefill(head, slot,
-                                                   prompt_len - 1)
-            if self._spec is not None:
-                # mirror the prompt into the draft lane's K/V — same head,
-                # same slot/table row, draft params (speculative.py)
-                self._spec.prefill(head, slot, prompt_len - 1)
+            try:
+                compile_event = self._dispatch_prefill(head, slot,
+                                                       prompt_len - 1)
+                if self._spec is not None:
+                    # mirror the prompt into the draft lane's K/V — same
+                    # head, same slot/table row, draft params
+                    # (speculative.py)
+                    self._spec.prefill(head, slot, prompt_len - 1)
+            except Exception:
+                # a failed whole-prompt prefill must not wedge the slot:
+                # this path runs once per admission (unlike the chunked
+                # path, which naturally re-dispatches), so free the slot
+                # and requeue the request at the HEAD before letting the
+                # failure propagate to the supervisor — a transient retry
+                # then re-admits it cleanly, in order
+                with self._lock:
+                    if self._slots[slot] is not None and \
+                            self._slots[slot].request is request:
+                        self._free_slot_locked(slot)
+                    self._pending.appendleft(request)
+                    _QUEUE_DEPTH.set(len(self._pending))
+                    _SLOTS_BUSY.set(self._busy_locked())
+                raise
             # host dispatch time: the device work itself drains inside the
             # first decode step (jax is async), which TTFT captures — a
             # block_until_ready here would serialize joins against the
@@ -1501,6 +1628,18 @@ class SlotEngine:
                         self._free_slot_locked(index)
                         self._finish_locked(state.request,
                                             outcome="cancelled")
+                continue
+            deadline = state.request.deadline_ts
+            if deadline is not None and self.clock() >= deadline:
+                # a deadline expiring mid-prefill frees the slot (and its
+                # net-releasable pages) exactly like a cancel, with the
+                # honest outcome
+                with self._lock:
+                    if self._slots[index] is state:
+                        _DEADLINE_TIMEOUTS.labels(phase="prefill").inc()
+                        self._free_slot_locked(index)
+                        self._finish_locked(state.request,
+                                            outcome="timeout")
                 continue
             self._advance_prefill_slot(index, state)
 
@@ -1613,6 +1752,7 @@ class SlotEngine:
         """Dispatch the batched target verify over ``[S, k+1]`` window
         tokens (current token + draft proposals); reassigns the donated
         cache/key and returns the device greedy/chosen arrays."""
+        self._fault_point("verify")
         fn = self._fingerprint_fn("serving_spec_verify")
         _count_compile(fn,
                        (fn, self.config, self.capacity, self.spec_tokens,
@@ -1660,6 +1800,12 @@ class SlotEngine:
             if not stepped:
                 return 0
             window, lens, limits, page_table = self._spec_operands_locked()
+        # the "step" fault point covers the draft propose half of the spec
+        # tick (the batched verify has its own "verify" point), so a
+        # fault-plan "step" schedule hits speculative engines too; outside
+        # the lock like every dispatch — an injected slow dispatch must not
+        # block submitters
+        self._fault_point("step")
         proposals = np.asarray(self._spec.propose(
             window, lens, self._positions, limits, page_table))
         verify_window = np.concatenate(
@@ -1784,6 +1930,14 @@ class SlotEngine:
         if hit_eos or len(request.generated) >= request.max_new_tokens:
             self._free_slot_locked(index)
             self._finish_locked(request, outcome="completed")
+        elif (request.deadline_ts is not None
+                and now >= request.deadline_ts):
+            # mid-decode deadline: truncate AFTER delivering this token —
+            # the stream ends with a terminal done chunk carrying the
+            # honest "timeout" reason and whatever was generated
+            _DEADLINE_TIMEOUTS.labels(phase="decode").inc()
+            self._free_slot_locked(index)
+            self._finish_locked(request, outcome="timeout")
 
     def _free_slot_locked(self, index: int) -> None:
         self._slots[index] = None
@@ -1806,7 +1960,12 @@ class SlotEngine:
         # writes keep landing on one already-consumed coordinate of its own
         # row (see module docstring)
 
-    def _finish_locked(self, request: _Request, outcome: str) -> None:
+    def _finish_locked(self, request: _Request, outcome: str,
+                       error: Optional[str] = None) -> None:
+        """Terminal bookkeeping, exactly once per request. With ``error``
+        the handle gets an ERROR event (the stream's ``{"error": ...}``
+        terminal chunk — the supervisor's fail-fast path); otherwise a DONE
+        summary carrying ``outcome`` (completed/cancelled/timeout)."""
         if request.finished:
             return
         request.finished = True
@@ -1841,15 +2000,44 @@ class SlotEngine:
                 request_id=request.request_id,
                 tokens=len(request.generated), outcome=outcome)
         if request.handle is not None:
-            request.handle._push(DONE, {
-                "requestId": request.request_id,
-                "tokens": list(request.generated),
-                "outcome": outcome,
-                "ttftS": (round(request.first_token_ts - request.submitted_ts,
-                                6)
-                          if request.first_token_ts is not None else None),
-                "durationS": round(now - request.submitted_ts, 6),
-            })
+            if error is not None:
+                request.handle._push(ERROR, error)
+            else:
+                request.handle._push(DONE, {
+                    "requestId": request.request_id,
+                    "tokens": list(request.generated),
+                    "outcome": outcome,
+                    "ttftS": (round(request.first_token_ts
+                                    - request.submitted_ts, 6)
+                              if request.first_token_ts is not None
+                              else None),
+                    "durationS": round(now - request.submitted_ts, 6),
+                })
+
+    def fail_all_inflight(self, message: str) -> int:
+        """Fail-fast every queued and running request with a terminal
+        ``{"error": ...}`` chunk and an ``outcome=failed`` ledger row — the
+        supervisor calls this the moment a pump failure is classified
+        fatal, BEFORE rebuilding the engine, so no stream ever hangs
+        waiting on a dead device (docs/ROBUSTNESS.md "Serving data
+        plane"). Returns how many requests were failed. Safe to call on a
+        half-wedged engine: touches only host bookkeeping."""
+        with self._lock:
+            failed = 0
+            for request in list(self._pending):
+                self._finish_locked(request, outcome="failed", error=message)
+                failed += 1
+            self._pending.clear()
+            for index, slot in enumerate(self._slots):
+                if slot is None:
+                    continue
+                self._free_slot_locked(index)
+                self._finish_locked(slot.request, outcome="failed",
+                                    error=message)
+                failed += 1
+            _QUEUE_DEPTH.set(0)
+            _SLOTS_BUSY.set(0)
+            return failed
 
     # -- introspection ----------------------------------------------------
     def _busy_locked(self) -> int:
@@ -1882,6 +2070,7 @@ class SlotEngine:
                 "slotsBusy": busy,
                 "queueDepth": len(self._pending),
                 "queueCapacity": self.queue_depth,
+                "draining": self._draining,
                 "maxSeqLen": self.max_len,
                 "meshShape": self.mesh_shape,
                 "numDevices": self.num_devices,

@@ -248,6 +248,87 @@ def test_generate_disabled_answers_503(api, user_headers):
     response = api.post("/api/generate", headers=user_headers, json={
         "promptTokens": [1, 2, 3], "maxNewTokens": 2})
     assert response.status_code == 503
+    # ISSUE 14 satellite: every 503 carries an honest Retry-After so
+    # clients re-probe instead of giving up (docs/ROBUSTNESS.md)
+    assert int(response.headers["Retry-After"]) >= 1
     stats = api.get("/api/generate/stats", headers=user_headers)
     assert stats.status_code == 503
     assert stats.get_json()["enabled"] is False
+
+
+def test_generate_503_carries_stored_reason_and_restart_hint(api,
+                                                             user_headers):
+    """ISSUE 14 satellite: the 503 body carries the stored unavailability
+    reason AND the supervisor's Retry-After hint while a restart is in
+    progress (restart-in-progress -> honest retry hint)."""
+    from tensorhive_tpu.serving import (
+        set_unavailable_reason,
+        update_serving_state,
+    )
+
+    set_engine(None)
+    set_unavailable_reason("serving engine failed (DeviceLostError: gone); "
+                           "restart in progress")
+    update_serving_state(retry_after_s=2.0)
+    try:
+        response = api.post("/api/generate", headers=user_headers, json={
+            "promptTokens": [1, 2, 3], "maxNewTokens": 2})
+        assert response.status_code == 503
+        body = response.get_json()
+        assert "restart in progress" in body["msg"]
+        assert body["retryAfterS"] == pytest.approx(2.0)
+        assert response.headers["Retry-After"] == "2"
+    finally:
+        set_unavailable_reason(None)
+        update_serving_state(retry_after_s=None)
+
+
+def test_admin_drain_stops_admission_then_resume_reopens(api, engine, pump,
+                                                         user_headers,
+                                                         admin_headers):
+    """POST /api/admin/generate/drain closes admission (503 + Retry-After,
+    draining surfaced in stats and readyz) while in-flight requests
+    finish; resume reopens. Admin-gated."""
+    assert api.post("/api/admin/generate/drain",
+                    headers=user_headers).status_code == 403
+    doc = api.post("/api/admin/generate/drain",
+                   headers=admin_headers).get_json()
+    assert doc["draining"] is True
+    try:
+        response = api.post("/api/generate", headers=user_headers, json={
+            "promptTokens": [1, 2, 3], "maxNewTokens": 2})
+        assert response.status_code == 503
+        assert "draining" in response.get_json()["msg"]
+        assert int(response.headers["Retry-After"]) >= 1
+        stats = api.get("/api/generate/stats",
+                        headers=user_headers).get_json()
+        assert stats["draining"] is True
+        ready = api.get("/api/readyz")
+        assert ready.status_code == 503
+        assert any(c["component"] == "serving" and not c["ok"]
+                   for c in ready.get_json()["components"])
+    finally:
+        doc = api.post("/api/admin/generate/resume",
+                       headers=admin_headers).get_json()
+    assert doc["draining"] is False
+    response = api.post("/api/generate", headers=user_headers, json={
+        "promptTokens": [1, 2, 3], "maxNewTokens": 2})
+    assert response.status_code == 200
+    assert _stream_lines(response)[-1]["outcome"] == "completed"
+    assert api.get("/api/readyz").status_code == 200
+
+
+def test_generate_deadline_override(api, pump, user_headers):
+    """deadlineS rides the POST body: over max_deadline_s is 422, a sane
+    override completes normally."""
+    from tensorhive_tpu.config import get_config
+
+    over = get_config().generation.max_deadline_s + 1
+    response = api.post("/api/generate", headers=user_headers, json={
+        "promptTokens": [1, 2, 3], "maxNewTokens": 2, "deadlineS": over})
+    assert response.status_code == 422
+    assert "deadline" in response.get_json()["msg"]
+    response = api.post("/api/generate", headers=user_headers, json={
+        "promptTokens": [1, 2, 3], "maxNewTokens": 2, "deadlineS": 30})
+    assert response.status_code == 200
+    assert _stream_lines(response)[-1]["outcome"] == "completed"

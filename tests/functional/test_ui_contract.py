@@ -73,6 +73,11 @@ UI_CALLS = {
     ("GET", "/admin/services"): 'api("/admin/services")',
     ("GET", "/generate/stats"): 'api("/generate/stats")',
     ("POST", "/generate"): 'fetch(API + "/generate"',
+    # drain/resume share the serving-strip toggle (like enqueue/dequeue)
+    ("POST", "/admin/generate/drain"):
+        'api("/admin/generate/" + action, { json: {} })',
+    ("POST", "/admin/generate/resume"):
+        'api("/admin/generate/" + action, { json: {} })',
     ("GET", "/admin/traces"): 'api("/admin/traces',
     ("GET", "/admin/requests"): 'api("/admin/requests',
     ("POST", "/admin/profile"): 'api("/admin/profile", { json: {} })',
@@ -221,6 +226,16 @@ def test_serving_strip_renders_spec_badge():
     assert 'stats.speculative !== "on"' in source   # hidden on rollback
     assert '"spec ×" + stats.specTokens' in source
     assert "stats.specAcceptanceRate" in source
+
+
+def test_serving_strip_renders_draining_badge():
+    """The drain badge + toggle (docs/ROBUSTNESS.md "Serving data plane")
+    must render from the exact ``draining`` field ``GET /generate/stats``
+    exports, and hide while admission is open."""
+    source = (STATIC_DIR / "js" / "nodes.js").read_text()
+    assert '!stats.draining ? ""' in source          # hidden while open
+    assert "toggleDrain(${stats.draining})" in source
+    assert '"/admin/generate/" + action' in source
 
 
 def test_serving_strip_renders_mesh_badge():

@@ -1,0 +1,563 @@
+"""Serving data-plane fault tolerance (docs/ROBUSTNESS.md "Serving data
+plane"): the ServingFaultPlan seam, the supervisor's transient-vs-fatal
+policy, fail-fast terminal chunks (the ledger's ``failed`` outcome,
+exactly once), per-request deadlines in every phase, graceful drain, and
+shutdown-through-drain.
+
+Everything host-side runs on a fake clock or a seeded plan; recovery
+exactness is pinned against ``decode.generate`` in f32 like every other
+serving suite — a rebuilt engine is not allowed to be "approximately"
+the engine that died.
+"""
+import dataclasses
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorhive_tpu.models import decode
+from tensorhive_tpu.models.transformer import PRESETS, TransformerLM
+from tensorhive_tpu.serving import (
+    EngineDrainingError,
+    get_engine,
+    get_serving_state,
+    set_engine,
+    update_serving_state,
+)
+from tensorhive_tpu.serving.engine import SlotEngine
+from tensorhive_tpu.serving.faults import (
+    FATAL,
+    TRANSIENT,
+    DeviceLostError,
+    ServingFaultPlan,
+    TransientDispatchError,
+    classify_failure,
+)
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device CPU platform"
+)
+
+F32_TINY = dataclasses.replace(PRESETS["tiny"], dtype=jnp.float32,
+                               use_flash=False, remat=False, max_seq_len=128)
+
+
+class FakeClock:
+    def __init__(self, start: float = 1000.0) -> None:
+        self.now = start
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, seconds: float) -> None:
+        self.now += seconds
+
+
+@pytest.fixture(scope="module")
+def params():
+    return TransformerLM.init(jax.random.PRNGKey(0), F32_TINY)
+
+
+def make_engine(params, clock=None, **kwargs):
+    kwargs.setdefault("slots", 2)
+    kwargs.setdefault("max_len", 96)
+    kwargs.setdefault("queue_depth", 8)
+    return SlotEngine(params, F32_TINY, clock=clock or FakeClock(),
+                      **kwargs)
+
+
+def drain(engine):
+    while engine.has_work():
+        engine.step()
+
+
+def reference_tokens(params, prompt, new_tokens):
+    out = decode.generate(params, F32_TINY,
+                          jnp.asarray([prompt], jnp.int32),
+                          max_new_tokens=new_tokens, temperature=0.0)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+# -- fault plan --------------------------------------------------------------
+
+def test_fault_plan_fail_next_exact_counts():
+    plan = ServingFaultPlan()
+    plan.fail_next("step", 2)
+    for _ in range(2):
+        with pytest.raises(DeviceLostError):
+            plan.before_dispatch("step")
+    plan.before_dispatch("step")                      # consumed: healthy
+    plan.before_dispatch("prefill")                   # other kinds untouched
+    assert plan.dispatches == {"step": 3, "prefill": 1, "verify": 0}
+    assert plan.faults_injected == {"step": 2, "prefill": 0, "verify": 0}
+
+
+def test_fault_plan_seeded_probability_is_deterministic():
+    def outcomes(plan):
+        result = []
+        for _ in range(64):
+            try:
+                plan.before_dispatch("step")
+                result.append(True)
+            except DeviceLostError:
+                result.append(False)
+        return result
+
+    first = outcomes(ServingFaultPlan(seed=7, fail_probability=0.3))
+    second = outcomes(ServingFaultPlan(seed=7, fail_probability=0.3))
+    assert first == second
+    assert not all(first) and any(first)              # the coin really flips
+
+
+def test_fault_plan_slow_dispatch_uses_injected_sleeper():
+    sleeps = []
+    plan = ServingFaultPlan(sleeper=sleeps.append)
+    plan.slow_next("verify", 2, seconds=0.25)
+    plan.before_dispatch("verify")
+    plan.before_dispatch("verify")
+    plan.before_dispatch("verify")
+    assert sleeps == [0.25, 0.25]
+    assert plan.faults_injected["verify"] == 0        # slow is not a fault
+
+
+def test_fault_plan_device_lost_until_cleared():
+    plan = ServingFaultPlan()
+    plan.set_device_lost(True)
+    for kind in ("step", "prefill", "verify"):
+        with pytest.raises(DeviceLostError):
+            plan.before_dispatch(kind)
+    plan.set_device_lost(False)
+    plan.before_dispatch("step")
+    with pytest.raises(ValueError):
+        plan.fail_next("decode")                      # unknown kind
+
+
+def test_classify_failure_fatal_by_default():
+    assert classify_failure(TransientDispatchError("x")) == TRANSIENT
+    assert classify_failure(DeviceLostError("x")) == FATAL
+    assert classify_failure(ValueError("x")) == FATAL
+    assert classify_failure(RuntimeError("RESOURCE_EXHAUSTED: hbm")) == FATAL
+
+
+# -- fail-fast: the ledger's failed outcome, exactly once --------------------
+
+def test_fail_all_inflight_terminal_chunks_and_failed_ledger_rows(params):
+    """ISSUE 14 satellite: the documented ``failed`` outcome is reachable —
+    a mid-decode fault fails fast every queued AND running request with a
+    terminal error chunk and exactly one outcome=failed ledger row; slots
+    and pages all return to the pool."""
+    from tensorhive_tpu.observability import get_request_ledger
+
+    plan = ServingFaultPlan()
+    engine = make_engine(params, slots=2, fault_plan=plan)
+    running = [engine.submit([1, 2, 3, 4], max_new_tokens=20),
+               engine.submit([5, 6, 7], max_new_tokens=20)]
+    engine.step()
+    engine.step()                          # both mid-decode, tokens emitted
+    queued = engine.submit([8, 9], max_new_tokens=4)
+    plan.fail_next("step", 1)
+    with pytest.raises(DeviceLostError):
+        engine.step()
+    failed = engine.fail_all_inflight("engine fault (test)")
+    assert failed == 3
+    for handle in running:
+        collected = []
+        with pytest.raises(RuntimeError, match="engine fault"):
+            for token in handle.tokens(timeout_s=1):
+                collected.append(token)
+        assert len(collected) >= 1         # tokens streamed before the fault
+    with pytest.raises(RuntimeError, match="engine fault"):
+        queued.result(timeout_s=1)
+    rows = get_request_ledger().recent(outcome="failed")
+    failed_ids = [row["requestId"] for row in rows]
+    for handle in running + [queued]:
+        assert failed_ids.count(handle.request_id) == 1   # exactly once
+    mid_decode = next(row for row in rows
+                      if row["requestId"] == running[0].request_id)
+    assert mid_decode["tokens"] >= 1
+    # everything returned to the pool; a later fail_all is a no-op
+    stats = engine.stats()
+    assert stats["slotsBusy"] == 0 and stats["queueDepth"] == 0
+    assert stats["kvPagesFree"] + (stats["cachedPages"] or 0) \
+        == stats["kvPagesTotal"]
+    assert engine.fail_all_inflight("again") == 0
+    running[0].cancel()                    # post-failure cancel: no-op
+    assert get_request_ledger().recent(
+        outcome="failed")[0]["requestId"] in failed_ids
+
+
+def test_legacy_prefill_fault_requeues_request_then_recovers(params):
+    """A whole-prompt prefill dispatch failure (prefix_cache=off path) must
+    requeue the request at the head — a retry admits it cleanly and the
+    output is still exact."""
+    plan = ServingFaultPlan()
+    engine = make_engine(params, prefix_cache="off", fault_plan=plan)
+    prompt = list(range(3, 12))
+    plan.fail_next("prefill", 1)
+    handle = engine.submit(prompt, max_new_tokens=5)
+    with pytest.raises(DeviceLostError):
+        engine.step()
+    assert engine.stats()["slotsBusy"] == 0           # slot freed
+    assert engine.stats()["queueDepth"] == 1          # requeued at head
+    drain(engine)                                     # retry succeeds
+    summary = handle.result(timeout_s=5)
+    assert summary["outcome"] == "completed"
+    assert summary["tokens"] == reference_tokens(params, prompt, 5)
+
+
+def test_chunk_prefill_fault_retries_same_chunk(params):
+    """The chunked prefill path is naturally resumable: a failed chunk
+    dispatch re-runs on the next tick and the output stays exact."""
+    plan = ServingFaultPlan()
+    engine = make_engine(params, prefill_chunk_tokens=4, fault_plan=plan)
+    prompt = list(range(1, 18))
+    handle = engine.submit(prompt, max_new_tokens=4)
+    engine.step()                                     # chunk 1 dispatched
+    plan.fail_next("prefill", 1)
+    with pytest.raises(DeviceLostError):
+        engine.step()                                 # chunk 2 fails
+    drain(engine)                                     # chunk 2 retried
+    summary = handle.result(timeout_s=5)
+    assert summary["outcome"] == "completed"
+    assert summary["tokens"] == reference_tokens(params, prompt, 4)
+
+
+# -- the supervisor ----------------------------------------------------------
+
+@pytest.fixture()
+def supervised(config, params):
+    """A GenerationService over a plan-wired engine factory, plus cleanup
+    of the process-wide serving state."""
+    from tensorhive_tpu.core.services.generation import GenerationService
+
+    config.generation.interval_s = 0.05
+    config.generation.transient_backoff_s = 0.0
+    config.generation.restart_budget = 2
+    config.generation.restart_window_s = 60.0
+    config.generation.restart_cooldown_s = 0.05
+    plan = ServingFaultPlan()
+
+    def factory():
+        return make_engine(params, fault_plan=plan)
+
+    service = GenerationService(config=config, engine=factory(),
+                                engine_factory=factory)
+    yield service, plan
+    service.shutdown()
+    set_engine(None)
+
+
+def pump_until_done(service, handle, ticks=50):
+    for _ in range(ticks):
+        if handle.done:
+            return
+        service.do_run()
+    raise AssertionError("handle never finished")
+
+
+def test_supervisor_rebuilds_engine_after_fatal_fault(config, params,
+                                                      supervised):
+    """The tentpole contract: a fatal pump failure fails fast (terminal
+    error chunk + failed row), the engine is rebuilt, and the next request
+    through the REBUILT engine is token-identical to decode.generate."""
+    service, plan = supervised
+    first = service.engine
+    doomed = first.submit([1, 2, 3, 4], max_new_tokens=8)
+    plan.fail_next("step", 1)
+    service.do_run()                       # fatal -> fail fast -> rebuild
+    with pytest.raises(RuntimeError, match="restarting"):
+        doomed.result(timeout_s=1)         # terminal chunk, no hang
+    rebuilt = get_engine()
+    assert rebuilt is not None and rebuilt is not first
+    assert service.engine is rebuilt
+    assert get_serving_state()["restarts"] == 1
+    assert get_serving_state()["crash_loop"] is False
+    prompt = list(range(5, 13))
+    handle = rebuilt.submit(prompt, max_new_tokens=6)
+    pump_until_done(service, handle)
+    assert handle.result(timeout_s=5)["tokens"] == reference_tokens(
+        params, prompt, 6)
+
+
+def test_supervisor_retries_transient_fault_on_same_engine(supervised):
+    service, plan = supervised
+    engine = service.engine
+    handle = engine.submit([1, 2, 3], max_new_tokens=4)
+    plan.fail_next("step", 2, TransientDispatchError)
+    service.do_run()                       # transient retry 1 (no rebuild)
+    service.do_run()                       # transient retry 2
+    pump_until_done(service, handle)
+    assert service.engine is engine        # never rebuilt
+    assert get_serving_state()["restarts"] == 0
+    assert handle.result(timeout_s=5)["outcome"] == "completed"
+
+
+def test_supervisor_escalates_exhausted_transient_budget(config, params,
+                                                         supervised):
+    """More consecutive transient failures than transient_retries escalate
+    to the fatal path: fail fast + rebuild."""
+    service, plan = supervised
+    first = service.engine
+    budget = config.generation.transient_retries
+    handle = first.submit([1, 2, 3], max_new_tokens=4)
+    plan.fail_next("step", budget + 1, TransientDispatchError)
+    for _ in range(budget + 1):
+        service.do_run()
+    with pytest.raises(RuntimeError):
+        handle.result(timeout_s=1)
+    assert get_engine() is not first       # rebuilt
+
+
+def test_crash_loop_trips_breaker_then_recovers(config, params, supervised):
+    """Exhausting the restart budget trips the crash-loop breaker: the
+    plane un-publishes with the reason (503 path), the alert source goes
+    to 1.0, and after the cooldown a probe rebuild recovers — output
+    token-identical to decode.generate."""
+    from tensorhive_tpu import serving
+    from tensorhive_tpu.observability.alerts import _engine_crash_loop
+
+    service, plan = supervised
+    plan.set_device_lost(True)
+    # budget=2: two rebuilds succeed (each next engine dies on first work),
+    # the third fatal trips the breaker
+    for _ in range(3):
+        engine = get_engine()
+        assert engine is not None
+        handle = engine.submit([1, 2, 3], max_new_tokens=4)
+        service.do_run()
+        with pytest.raises(RuntimeError):
+            handle.result(timeout_s=1)     # every stream ends terminally
+    assert get_engine() is None
+    state = get_serving_state()
+    assert state["crash_loop"] is True
+    assert _engine_crash_loop() == 1.0
+    reason = serving.get_unavailable_reason()
+    assert reason and "crash loop" in reason
+    assert state["retry_after_s"] == pytest.approx(
+        config.generation.restart_cooldown_s)
+    service.do_run()                       # breaker open: no rebuild yet
+    assert get_engine() is None
+    # the platform restores the device; the cooldown elapses; the probe
+    # rebuild succeeds and the loop resolves
+    plan.set_device_lost(False)
+    time.sleep(config.generation.restart_cooldown_s + 0.01)
+    service.do_run()
+    rebuilt = get_engine()
+    assert rebuilt is not None
+    assert get_serving_state()["crash_loop"] is False
+    assert _engine_crash_loop() == 0.0
+    prompt = [7, 8, 9, 10]
+    handle = rebuilt.submit(prompt, max_new_tokens=5)
+    pump_until_done(service, handle)
+    assert handle.result(timeout_s=5)["tokens"] == reference_tokens(
+        params, prompt, 5)
+
+
+def test_crash_loop_source_none_without_supervisor():
+    from tensorhive_tpu.observability.alerts import _engine_crash_loop
+
+    update_serving_state(supervisor_active=False, crash_loop=False)
+    assert _engine_crash_loop() is None
+
+
+def test_default_rule_pack_gains_fault_rules(config):
+    from tensorhive_tpu.observability.alerts import default_rule_pack
+
+    rules = {rule.name: rule for rule in default_rule_pack()}
+    assert "engine_crash_loop" in rules
+    assert rules["engine_crash_loop"].severity == "critical"
+    assert "generate_deadline_timeouts" in rules
+    assert (rules["generate_deadline_timeouts"].metric
+            == "tpuhive_generate_deadline_timeouts_total")
+
+
+# -- deadlines ---------------------------------------------------------------
+
+def test_queue_deadline_times_out_head_of_line_wait(params):
+    """A queued request past its deadline gets an honest outcome=timeout
+    done chunk instead of waiting forever — the head-of-line page-wait
+    case."""
+    from tensorhive_tpu.observability import get_request_ledger
+
+    clock = FakeClock()
+    engine = make_engine(params, slots=1, clock=clock,
+                         default_deadline_s=10.0)
+    # the running request gets a generous explicit override so only the
+    # QUEUED one can expire
+    running = engine.submit([1, 2, 3], max_new_tokens=50, deadline_s=600.0)
+    engine.step()                          # occupies the only slot
+    waiting = engine.submit([4, 5, 6], max_new_tokens=4)
+    clock.advance(11.0)
+    engine.step()
+    summary = waiting.result(timeout_s=1)  # terminal chunk, zero tokens
+    assert summary["outcome"] == "timeout"
+    assert summary["tokens"] == []
+    row = get_request_ledger().recent(outcome="timeout")[0]
+    assert row["requestId"] == waiting.request_id
+    assert not running.done                # the running request unaffected
+    running.cancel()
+    drain(engine)
+
+
+def test_mid_decode_deadline_truncates_with_timeout_reason(params):
+    clock = FakeClock()
+    engine = make_engine(params, slots=1, clock=clock,
+                         default_deadline_s=1.0)
+    handle = engine.submit([1, 2, 3, 4], max_new_tokens=50)
+    engine.step()                          # first token inside the budget
+    clock.advance(1.5)                     # ...then the deadline passes
+    engine.step()                          # this token is the last
+    summary = handle.result(timeout_s=1)
+    assert summary["outcome"] == "timeout"
+    assert 0 < len(summary["tokens"]) < 50
+    assert engine.stats()["slotsBusy"] == 0
+    # the freed slot serves the next request exactly
+    follow_up = engine.submit([9, 8, 7], max_new_tokens=4, deadline_s=600)
+    drain(engine)
+    assert (follow_up.result(timeout_s=5)["tokens"]
+            == reference_tokens(params, [9, 8, 7], 4))
+
+
+def test_mid_prefill_deadline_frees_slot(params):
+    clock = FakeClock()
+    engine = make_engine(params, clock=clock, prefill_chunk_tokens=4,
+                         default_deadline_s=5.0)
+    handle = engine.submit(list(range(1, 20)), max_new_tokens=4)
+    engine.step()                          # admitted, chunk 1 dispatched
+    clock.advance(6.0)
+    engine.step()                          # deadline check before chunk 2
+    assert handle.result(timeout_s=1)["outcome"] == "timeout"
+    stats = engine.stats()
+    assert stats["slotsBusy"] == 0
+    assert stats["kvPagesFree"] + (stats["cachedPages"] or 0) \
+        == stats["kvPagesTotal"]
+
+
+def test_deadline_override_validation(params):
+    engine = make_engine(params, default_deadline_s=10.0,
+                         max_deadline_s=60.0)
+    with pytest.raises(ValueError):
+        engine.submit([1, 2], max_new_tokens=4, deadline_s=61.0)
+    with pytest.raises(ValueError):
+        engine.submit([1, 2], max_new_tokens=4, deadline_s=0.0)
+    with pytest.raises(ValueError):
+        engine.submit([1, 2], max_new_tokens=4, deadline_s=-5.0)
+    handle = engine.submit([1, 2], max_new_tokens=4, deadline_s=30.0)
+    drain(engine)
+    assert handle.result(timeout_s=5)["outcome"] == "completed"
+
+
+def test_no_deadline_by_default(params):
+    """default_deadline_s=0 (the constructor default) keeps the pre-PR 14
+    behavior: requests never time out on the engine clock."""
+    clock = FakeClock()
+    engine = make_engine(params, clock=clock)
+    handle = engine.submit([1, 2, 3], max_new_tokens=4)
+    clock.advance(1e6)
+    drain(engine)
+    assert handle.result(timeout_s=5)["outcome"] == "completed"
+
+
+# -- drain -------------------------------------------------------------------
+
+def test_drain_blocks_admission_while_inflight_finish(params):
+    engine = make_engine(params)
+    handle = engine.submit([1, 2, 3, 4], max_new_tokens=5)
+    engine.step()
+    engine.drain()
+    assert engine.stats()["draining"] is True
+    with pytest.raises(EngineDrainingError) as excinfo:
+        engine.submit([5, 6], max_new_tokens=4)
+    assert excinfo.value.retry_after_s >= 1.0
+    drain(engine)                          # in-flight keeps finishing
+    assert handle.result(timeout_s=5)["outcome"] == "completed"
+    engine.resume()
+    assert engine.stats()["draining"] is False
+    follow_up = engine.submit([5, 6], max_new_tokens=4)
+    drain(engine)
+    assert follow_up.result(timeout_s=5)["outcome"] == "completed"
+
+
+def test_service_shutdown_drains_inflight_to_completion(config, params):
+    """ISSUE 14 satellite: shutdown() rides the drain path — an in-flight
+    generator receives its DONE chunk, never a silent EOF."""
+    from tensorhive_tpu.core.services.generation import GenerationService
+
+    config.generation.interval_s = 0.05
+    config.generation.drain_timeout_s = 30.0
+    engine = make_engine(params)
+    service = GenerationService(config=config, engine=engine)
+    try:
+        handle = engine.submit([1, 2, 3, 4], max_new_tokens=4)
+        service.shutdown()                 # no pump thread: shutdown pumps
+        summary = handle.result(timeout_s=1)
+        assert summary["outcome"] == "completed"
+        assert summary["tokens"] == reference_tokens(params, [1, 2, 3, 4], 4)
+        assert get_engine() is None        # un-published after the drain
+        assert get_serving_state()["supervisor_active"] is False
+    finally:
+        service.shutdown()
+        set_engine(None)
+
+
+def test_service_shutdown_fails_stragglers_at_drain_timeout(config, params):
+    from tensorhive_tpu.core.services.generation import GenerationService
+
+    config.generation.interval_s = 0.05
+    config.generation.drain_timeout_s = 0.0       # nothing gets to finish
+    engine = make_engine(params)
+    service = GenerationService(config=config, engine=engine)
+    try:
+        handle = engine.submit([1, 2, 3, 4], max_new_tokens=4)
+        service.shutdown()
+        with pytest.raises(RuntimeError, match="shutting down"):
+            handle.result(timeout_s=1)     # terminal chunk, not silence
+    finally:
+        service.shutdown()
+        set_engine(None)
+
+
+def test_build_engine_wires_deadline_knobs(config, db):
+    from tensorhive_tpu.core.services.generation import build_engine
+
+    config.generation.enabled = True
+    config.generation.slots = 2
+    config.generation.max_len = 64
+    config.generation.default_deadline_s = 7.5
+    config.generation.max_deadline_s = 42.0
+    engine = build_engine(config)
+    assert engine.default_deadline_s == 7.5
+    assert engine.max_deadline_s == 42.0
+    with pytest.raises(ValueError):
+        engine.submit([1, 2], max_new_tokens=4, deadline_s=43.0)
+
+
+def test_readyz_serving_component_tracks_drain_and_crash_loop(db, params):
+    from tensorhive_tpu.observability.health import check_serving, readiness
+
+    assert check_serving() is None         # no serving plane: omitted
+    engine = make_engine(params)
+    set_engine(engine)
+    try:
+        assert check_serving() == {"component": "serving", "ok": True}
+        engine.drain()
+        component = check_serving()
+        assert component["ok"] is False and "draining" in component["reason"]
+        ready, components = readiness(manager=None)
+        assert not ready
+        assert any(c["component"] == "serving" and not c["ok"]
+                   for c in components)
+        engine.resume()
+        assert check_serving()["ok"] is True
+    finally:
+        set_engine(None)
+    # crash loop with no engine published: supervised processes stay
+    # not-ready with the reason until the probe rebuild succeeds
+    update_serving_state(supervisor_active=True, crash_loop=True)
+    try:
+        component = check_serving()
+        assert component["ok"] is False and "crash loop" in component["reason"]
+    finally:
+        update_serving_state(supervisor_active=False, crash_loop=False)

@@ -1,0 +1,336 @@
+"""Smoke-test the serving data plane's fault tolerance end to end
+(``make serving-chaos-smoke``; docs/ROBUSTNESS.md "Serving data plane").
+
+Boots the real daemon surface — WSGI app over a real socket, a live
+GenerationService pump, in-memory DB — around an engine wired to a seeded
+:class:`ServingFaultPlan`, then proves the resilience contract over HTTP:
+
+1. a healthy streamed ``POST /api/generate`` request is **token-identical**
+   to ``decode.generate`` (the baseline the recovery gates compare to);
+2. kill a decode step mid-stream: the client's NDJSON stream ends with the
+   terminal ``{"error": ...}`` chunk within the request deadline — zero
+   hung streams — and the failed request lands in the ledger with
+   ``outcome=failed``;
+3. the supervisor auto-restores: the engine re-publishes within the
+   restart budget and the next request completes **token-identical** to
+   the pre-fault baseline;
+4. the ``/api/metrics`` scrape carries the restart/failure counters
+   (``tpuhive_generate_engine_restarts_total``,
+   ``_step_failures_total{kind="fatal"}``,
+   ``_requests_total{outcome="failed"}``);
+5. a forced crash loop (persistent device-lost) exhausts the restart
+   budget: ``POST /api/generate`` answers **503 + Retry-After** with the
+   crash-loop reason and the ``engine_crash_loop`` alert FIRES in the
+   scrape;
+6. clearing the outage + the breaker cooldown recovers: the alert
+   RESOLVES, and generation is again token-identical to the baseline;
+7. graceful drain over the admin endpoint: admission 503s with
+   Retry-After while draining, resume reopens it.
+
+Engines run the f32 tiny config (like the unit suite): token identity is
+an exactness statement. Exit 0 = healthy.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+os.environ.setdefault("TPUHIVE_PYTEST", "1")          # DB goes in-memory
+
+SEED = 42
+PROMPT = [3, 4, 5, 6, 7, 8, 9, 10]
+NEW_TOKENS = 8
+DEADLINE_S = 6.0
+RESTART_BUDGET = 2
+COOLDOWN_S = 0.3
+
+PROBLEMS = []
+
+
+def check(ok: bool, what: str) -> None:
+    status = "ok" if ok else "FAIL"
+    print(f"serving-chaos-smoke: {status}: {what}")
+    if not ok:
+        PROBLEMS.append(what)
+
+
+def request(url: str, body=None, headers=None, method=None):
+    """(status, text, headers) over real HTTP; >=400 is a result."""
+    data = None if body is None else json.dumps(body).encode()
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers={"Content-Type": "application/json",
+                                          **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return resp.status, resp.read().decode(), dict(resp.headers)
+    except urllib.error.HTTPError as exc:
+        return exc.code, exc.read().decode(), dict(exc.headers)
+
+
+def stream_request(base: str, auth: dict, max_new: int,
+                   on_line=None):
+    """Stream one generate request line by line (the NDJSON contract);
+    returns the parsed lines. ``on_line(index, parsed)`` fires per line —
+    the mid-stream kill hook."""
+    req = urllib.request.Request(
+        f"{base}/generate",
+        data=json.dumps({"promptTokens": PROMPT, "maxNewTokens": max_new,
+                         "temperature": 0}).encode(),
+        headers={"Content-Type": "application/json", **auth})
+    lines = []
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        while True:
+            raw = resp.readline()
+            if not raw:
+                break
+            parsed = json.loads(raw)
+            lines.append(parsed)
+            if on_line is not None:
+                on_line(len(lines) - 1, parsed)
+    return lines
+
+
+def wait_for(predicate, timeout_s: float = 10.0, interval_s: float = 0.02):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval_s)
+    return False
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tensorhive_tpu.config import Config, set_config
+
+    config = Config(config_dir=Path("/tmp/tpuhive-serving-chaos-smoke"))
+    config.api.secret_key = "serving-chaos-secret"
+    config.generation.enabled = True
+    config.generation.interval_s = 0.01
+    config.generation.default_deadline_s = DEADLINE_S
+    config.generation.transient_backoff_s = 0.0
+    config.generation.restart_budget = RESTART_BUDGET
+    config.generation.restart_window_s = 60.0
+    config.generation.restart_cooldown_s = COOLDOWN_S
+    config.generation.drain_timeout_s = 5.0
+    set_config(config)
+
+    from tensorhive_tpu.db.engine import Engine, set_engine as set_db
+    from tensorhive_tpu.db.migrations import ensure_schema
+
+    engine_db = Engine(":memory:")
+    ensure_schema(engine_db)
+    set_db(engine_db)
+
+    from tensorhive_tpu.db.models import User
+
+    admin = User(username="smoke-admin", email="smoke@example.com",
+                 password="SuperSecret42").save()
+    admin.add_role("user")
+    admin.add_role("admin")
+
+    from tensorhive_tpu import serving
+    from tensorhive_tpu.core.services.generation import GenerationService
+    from tensorhive_tpu.models import decode
+    from tensorhive_tpu.models.transformer import PRESETS, TransformerLM
+    from tensorhive_tpu.observability.alerts import get_alert_engine
+    from tensorhive_tpu.serving.engine import SlotEngine
+    from tensorhive_tpu.serving.faults import ServingFaultPlan
+
+    f32_tiny = dataclasses.replace(PRESETS["tiny"], dtype=jnp.float32,
+                                   use_flash=False, remat=False,
+                                   max_seq_len=128)
+    params = TransformerLM.init(jax.random.PRNGKey(0), f32_tiny)
+    reference = np.asarray(decode.generate(
+        params, f32_tiny, jnp.asarray([PROMPT], jnp.int32),
+        max_new_tokens=NEW_TOKENS, temperature=0.0))[0, len(PROMPT):].tolist()
+
+    plan = ServingFaultPlan(seed=SEED)
+    print(f"serving-chaos-smoke: seed={SEED}")
+
+    def factory():
+        engine = SlotEngine(params, f32_tiny, slots=2, max_len=96,
+                            queue_depth=4,
+                            default_deadline_s=DEADLINE_S,
+                            fault_plan=plan)
+        engine.warmup(prompt_lens=(len(PROMPT),))
+        return engine
+
+    generation = GenerationService(config=config, engine=factory(),
+                                   engine_factory=factory)
+    generation.start()
+
+    from tensorhive_tpu.api.server import APIServer
+
+    server = APIServer()
+    server.config.api.url_hostname = "127.0.0.1"
+    server.config.api.url_port = 0                     # ephemeral
+    port = server.start()
+    base = f"http://127.0.0.1:{port}/api"
+    try:
+        status, body, _ = request(f"{base}/user/login", body={
+            "username": "smoke-admin", "password": "SuperSecret42"})
+        check(status == 200, f"admin login over HTTP (got {status})")
+        auth = {"Authorization": "Bearer " + json.loads(body)["accessToken"]}
+
+        def engine_published():
+            return serving.get_engine() is not None
+
+        # -- 1: healthy baseline, token-identical to decode.generate -------
+        lines = stream_request(base, auth, NEW_TOKENS)
+        done = lines[-1]
+        check(done.get("outcome") == "completed",
+              f"baseline stream completed ({done})")
+        check(done.get("tokens") == reference,
+              "baseline tokens identical to decode.generate "
+              f"({done.get('tokens')} vs {reference})")
+
+        # -- 2: kill a step mid-stream: terminal error chunk, no hang ------
+        kill_state = {"armed_at": None}
+
+        def kill_mid_stream(index, parsed):
+            if index == 1 and "token" in parsed:
+                plan.fail_next("step", 1)
+                kill_state["armed_at"] = time.monotonic()
+
+        lines = stream_request(base, auth, max_new=24,
+                               on_line=kill_mid_stream)
+        terminal_s = time.monotonic() - kill_state["armed_at"]
+        killed = lines[-1]
+        check("error" in killed,
+              f"mid-stream kill ended with a terminal error chunk "
+              f"({killed})")
+        check(terminal_s < DEADLINE_S,
+              f"terminal chunk within the deadline "
+              f"({terminal_s:.3f}s < {DEADLINE_S:g}s — zero hung streams)")
+        check(sum(1 for line in lines if "token" in line) >= 2,
+              "tokens streamed before the injected fault")
+
+        status, body, _ = request(
+            f"{base}/admin/requests?outcome=failed", headers=auth)
+        check(status == 200 and len(json.loads(body)["requests"]) >= 1,
+              "killed request ledgered with outcome=failed")
+
+        # -- 3: auto-restore; next request token-identical -----------------
+        check(wait_for(engine_published, timeout_s=10.0),
+              "engine auto-restored within the budget")
+        lines = stream_request(base, auth, NEW_TOKENS)
+        check(lines[-1].get("tokens") == reference,
+              "post-restore tokens identical to decode.generate")
+
+        # -- 4: restart/failure counters in the scrape ---------------------
+        status, scrape, _ = request(f"{base}/metrics")
+        check(status == 200, f"GET /metrics (got {status})")
+
+        def counter_at_least(name, minimum):
+            for line in scrape.splitlines():
+                if line.startswith(name) and not line.startswith("#"):
+                    if float(line.rsplit(" ", 1)[1]) >= minimum:
+                        return True
+            return False
+
+        check(counter_at_least(
+            "tpuhive_generate_engine_restarts_total", 1),
+            "engine_restarts_total >= 1 in the scrape")
+        check(counter_at_least(
+            'tpuhive_generate_step_failures_total{kind="fatal"}', 1),
+            'step_failures_total{kind="fatal"} >= 1 in the scrape')
+        check(counter_at_least(
+            'tpuhive_generate_requests_total{outcome="failed"}', 1),
+            'requests_total{outcome="failed"} >= 1 in the scrape')
+
+        # -- 5: forced crash loop trips the breaker ------------------------
+        plan.set_device_lost(True)
+        for attempt in range(RESTART_BUDGET + 1):
+            if not wait_for(engine_published, timeout_s=5.0):
+                break
+            lines = stream_request(base, auth, max_new=4)
+            check("error" in lines[-1],
+                  f"crash-loop round {attempt}: stream ended terminally")
+        check(wait_for(
+            lambda: serving.get_serving_state()["crash_loop"],
+            timeout_s=5.0), "crash-loop breaker tripped")
+        status, body, headers = request(f"{base}/generate", body={
+            "promptTokens": PROMPT, "maxNewTokens": 4, "temperature": 0},
+            headers=auth)
+        check(status == 503, f"crash loop answers 503 (got {status})")
+        check("crash loop" in json.loads(body).get("msg", ""),
+              "503 body names the crash loop")
+        check(int(headers.get("Retry-After", 0)) >= 1,
+              "503 carries an honest Retry-After")
+        get_alert_engine().evaluate()
+        status, scrape, _ = request(f"{base}/metrics")
+        check('tpuhive_alerts_firing{rule="engine_crash_loop"'
+              in scrape.replace("severity=\"critical\",", "")
+              or 'rule="engine_crash_loop"' in scrape,
+              "engine_crash_loop gauge exported")
+        firing = [line for line in scrape.splitlines()
+                  if 'rule="engine_crash_loop"' in line]
+        check(any(line.endswith(" 1") or line.endswith(" 1.0")
+                  for line in firing),
+              f"engine_crash_loop FIRING in the scrape ({firing})")
+
+        # -- 6: recovery resolves the loop ---------------------------------
+        plan.set_device_lost(False)
+        time.sleep(COOLDOWN_S + 0.05)
+        check(wait_for(engine_published, timeout_s=10.0),
+              "engine recovered after the cooldown probe")
+        get_alert_engine().evaluate()
+        status, scrape, _ = request(f"{base}/metrics")
+        firing = [line for line in scrape.splitlines()
+                  if 'rule="engine_crash_loop"' in line]
+        check(any(line.endswith(" 0") or line.endswith(" 0.0")
+                  for line in firing),
+              f"engine_crash_loop RESOLVED in the scrape ({firing})")
+        lines = stream_request(base, auth, NEW_TOKENS)
+        check(lines[-1].get("tokens") == reference,
+              "post-recovery tokens identical to decode.generate")
+
+        # -- 7: graceful drain over the admin endpoint ---------------------
+        status, body, _ = request(f"{base}/admin/generate/drain",
+                                  body={}, headers=auth)
+        check(status == 200 and json.loads(body)["draining"] is True,
+              f"drain accepted (got {status})")
+        status, body, headers = request(f"{base}/generate", body={
+            "promptTokens": PROMPT, "maxNewTokens": 4, "temperature": 0},
+            headers=auth)
+        check(status == 503 and "draining" in json.loads(body)["msg"],
+              f"draining answers 503 with the reason (got {status})")
+        check(int(headers.get("Retry-After", 0)) >= 1,
+              "draining 503 carries Retry-After")
+        status, body, _ = request(f"{base}/admin/generate/resume",
+                                  body={}, headers=auth)
+        check(status == 200 and json.loads(body)["draining"] is False,
+              f"resume accepted (got {status})")
+        lines = stream_request(base, auth, NEW_TOKENS)
+        check(lines[-1].get("outcome") == "completed",
+              "admission reopened after resume")
+    finally:
+        server.stop()
+        generation.shutdown()
+        generation.join(timeout=10)
+
+    if PROBLEMS:
+        print(f"serving-chaos-smoke: {len(PROBLEMS)} problem(s)",
+              file=sys.stderr)
+        return 1
+    print("serving-chaos-smoke: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
