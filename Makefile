@@ -2,7 +2,7 @@
 # mypy + flake8 per .circleci/config.yml:33-38): the dependency-free AST
 # lint + thivelint analyzer always run; mypy/ruff run when installed
 # (absent from this image).
-.PHONY: check lint analysis analysis-fast test bench probe metrics-smoke decode-smoke alerts-smoke chaos-smoke serving-smoke serving-mesh-smoke trace-smoke prefix-smoke spec-smoke serving-chaos-smoke
+.PHONY: check lint analysis analysis-fast test bench probe metrics-smoke decode-smoke alerts-smoke chaos-smoke serving-smoke serving-mesh-smoke trace-smoke prefix-smoke spec-smoke serving-chaos-smoke quant-smoke
 
 check: lint analysis
 	@command -v ruff >/dev/null 2>&1 && ruff check . || echo "ruff not installed; skipped (tools/lint.py covered the always-on subset)"
@@ -104,6 +104,14 @@ spec-smoke:
 # and recovery resolves it, drain/resume close and reopen admission
 serving-chaos-smoke:
 	python tools/serving_chaos_smoke.py
+
+# int8 KV pages over a real socket (docs/SERVING.md "Quantized KV pages"):
+# a kv_quant=on stream's greedy tokens must match the f32 reference at the
+# gated rate, the int8 pool must admit >= 1.8x the f32 pool's concurrent
+# sequences at EQUAL HBM bytes, zero post-warmup recompiles across page
+# assignment + scale updates, kv_bytes gauges scrapeable
+quant-smoke:
+	python tools/quant_smoke.py
 
 probe:
 	$(MAKE) -C tensorhive_tpu/native

@@ -445,7 +445,7 @@ def bench_generate_serving():
     engine = SlotEngine(params, config, slots=slots, max_len=max_len,
                         queue_depth=2 * slots, paged=True,
                         page_size=page_size, prefix_cache="off",
-                        speculative="off")
+                        speculative="off", kv_quant="off")
     engine.warmup(prompt_lens=prompt_lens)
 
     # serial: one request at a time through the same engine — the
@@ -492,7 +492,7 @@ def bench_generate_serving():
     # paged vs contiguous: same slot count and workload, both layouts
     contiguous = SlotEngine(params, config, slots=slots, max_len=max_len,
                             queue_depth=2 * slots, paged=False,
-                            speculative="off")
+                            speculative="off", kv_quant="off")
     contiguous.warmup(prompt_lens=prompt_lens)
     contiguous_s, contiguous_recompiles = batched_run(contiguous)
     comparison = {
@@ -516,7 +516,7 @@ def bench_generate_serving():
     kernel_engine = SlotEngine(params, config, slots=slots, max_len=max_len,
                                queue_depth=2 * slots, paged=True,
                                page_size=page_size, paged_kernel="on",
-                               prefix_cache="off", speculative="off")
+                               prefix_cache="off", speculative="off", kv_quant="off")
     kernel_block["dispatch"] = kernel_engine.stats()["pagedKernel"]
     kernel_engine.warmup(prompt_lens=prompt_lens)
     kernel_s, kernel_recompiles = batched_run(kernel_engine)
@@ -543,12 +543,12 @@ def bench_generate_serving():
     paged_pool = SlotEngine(params, config, slots=slots, max_len=max_len,
                             queue_depth=len(prompt_lens), paged=True,
                             page_size=page_size, kv_pages=equal_hbm_pages,
-                            prefix_cache="off", speculative="off")
+                            prefix_cache="off", speculative="off", kv_quant="off")
     paged_pool.warmup(prompt_lens=(probe_len,))
     small_contig = SlotEngine(params, config, slots=contig_capacity_slots,
                               max_len=max_len,
                               queue_depth=len(prompt_lens), paged=False,
-                              speculative="off")
+                              speculative="off", kv_quant="off")
     small_contig.warmup(prompt_lens=(probe_len,))
     paged_busy = max_concurrent(paged_pool, len(prompt_lens), probe_len)
     contig_busy = max_concurrent(small_contig, len(prompt_lens), probe_len)
@@ -582,7 +582,7 @@ def bench_generate_serving():
         meshed = SlotEngine(params, config, slots=dp * slots,
                             max_len=max_len, queue_depth=2 * dp * slots,
                             paged=True, page_size=page_size,
-                            prefix_cache="off", speculative="off",
+                            prefix_cache="off", speculative="off", kv_quant="off",
                             mesh=serving_mesh(dp=dp, tp=1))
         meshed.warmup(prompt_lens=prompt_lens)
         compiles_before = meshed.step_executable._cache_size()
@@ -623,7 +623,7 @@ def bench_generate_serving():
     system = list(range(1, system_len + 1))
     prefix_engine = SlotEngine(params, config, slots=slots, max_len=max_len,
                                queue_depth=2 * slots, page_size=page_size,
-                               prefill_chunk_tokens=64, speculative="off")
+                               prefill_chunk_tokens=64, speculative="off", kv_quant="off")
     prefix_engine.warmup(prompt_lens=(system_len + 1,))
     compiles_before = prefix_engine.step_executable._cache_size()
     cold = prefix_engine.submit(system + [7], max_new_tokens=new_tokens)
@@ -661,7 +661,7 @@ def bench_generate_serving():
         pool = SlotEngine(params, config, slots=slots, max_len=max_len,
                           queue_depth=2 * slots, page_size=page_size,
                           kv_pages=tight_pages, prefix_cache=prefix_mode,
-                          prefill_chunk_tokens=64, speculative="off")
+                          prefill_chunk_tokens=64, speculative="off", kv_quant="off")
         pool.warmup(prompt_lens=(system_len + 1,))
         if prefix_mode == "auto":       # warm the tree before the storm
             drain_handle = pool.submit(system + [3],
@@ -731,7 +731,7 @@ def bench_generate_serving():
 
     spec_off = SlotEngine(params, spec_config, slots=slots, max_len=max_len,
                           queue_depth=2 * slots, page_size=page_size,
-                          prefix_cache="off", speculative="off")
+                          prefix_cache="off", speculative="off", kv_quant="off")
     spec_off.warmup(prompt_lens=prompt_lens)
     off_s, off_tokens, _ = spec_storm(spec_off)
     spec_block["spec_off_tokens_per_sec"] = round(total_tokens / off_s, 1)
@@ -739,7 +739,7 @@ def bench_generate_serving():
     spec_on = SlotEngine(params, spec_config, slots=slots, max_len=max_len,
                          queue_depth=2 * slots, page_size=page_size,
                          prefix_cache="off", speculative="on",
-                         spec_tokens=spec_tokens)
+                         kv_quant="off", spec_tokens=spec_tokens)
     spec_on.warmup(prompt_lens=prompt_lens)
     on_s, on_tokens, spec_recompiles = spec_storm(spec_on)
     spec_stats = spec_on.stats()
@@ -755,6 +755,102 @@ def bench_generate_serving():
         "zero_recompile_verdict": spec_recompiles == 0,
     })
     _log(f"  speculative: {spec_block}")
+
+    # int8 KV pages (docs/SERVING.md "Quantized KV pages"): quant-on vs
+    # quant-off tokens/s through otherwise-identical f32 engines, max
+    # concurrent sequences at EQUAL HBM BYTES (int8 pages vs f32 pages on
+    # the same byte budget), the greedy token match rate vs the f32
+    # engine, the simulated int8-KV perplexity delta with its explicit
+    # gate, and the zero-recompile verdict across page assignment + scale
+    # updates. Progressive-install like every block above. f32 twins on
+    # purpose (the speculative block's rationale): the match rate is a
+    # numerics statement and must not be confounded with bf16
+    # accumulation-order flips.
+    from tensorhive_tpu.ops import kv_quant as _kvq
+
+    ppl_delta_gate = 0.02
+    quant_block = {"page_size": page_size, "dtype": "float32",
+                   "perplexity_delta_gate": ppl_delta_gate}
+    result["kv_quant"] = quant_block
+    q_off = SlotEngine(params, spec_config, slots=slots, max_len=max_len,
+                       queue_depth=2 * slots, page_size=page_size,
+                       prefix_cache="off", speculative="off",
+                       kv_quant="off")
+    q_off.warmup(prompt_lens=prompt_lens)
+    q_off_s, q_off_tokens, _ = spec_storm(q_off)
+    quant_block["quant_off_tokens_per_sec"] = round(total_tokens / q_off_s,
+                                                    1)
+    q_on = SlotEngine(params, spec_config, slots=slots, max_len=max_len,
+                      queue_depth=2 * slots, page_size=page_size,
+                      prefix_cache="off", speculative="off", kv_quant="on")
+    q_on.warmup(prompt_lens=prompt_lens)
+    q_on_s, q_on_tokens, q_recompiles = spec_storm(q_on)
+    flat_on = [token for tokens in q_on_tokens for token in tokens]
+    flat_off = [token for tokens in q_off_tokens for token in tokens]
+    match_rate = (sum(a == b for a, b in zip(flat_on, flat_off))
+                  / max(1, len(flat_off)))
+    quant_block.update({
+        "quant_on_tokens_per_sec": round(total_tokens / q_on_s, 1),
+        "quant_vs_off_tokens": round(q_off_s / q_on_s, 2),
+        "greedy_token_match_rate": round(match_rate, 4),
+        "kv_bytes_per_token_on": q_on.stats()["kvBytesPerToken"],
+        "kv_bytes_per_token_off": q_off.stats()["kvBytesPerToken"],
+        "quant_recompiles": q_recompiles,
+        "zero_recompile_verdict": q_recompiles == 0,
+    })
+
+    # concurrency at EQUAL HBM BYTES: an f32 pool sized for ~2 concurrent
+    # probes vs an int8 pool holding the identical byte budget
+    probe_len = prompt_lens[0]
+    probe_pages = -(-(probe_len + new_tokens) // page_size)
+    f32_pages = 2 * probe_pages
+    layer_f32 = _kvq.page_bytes(page_size, config.kv_heads, config.d_head,
+                                4)
+    layer_q = _kvq.quant_page_bytes(page_size, config.kv_heads,
+                                    config.d_head)
+    quant_pages = f32_pages * layer_f32 // layer_q
+    hbm_pool_f32 = SlotEngine(params, spec_config, slots=slots,
+                              max_len=max_len,
+                              queue_depth=len(prompt_lens),
+                              page_size=page_size, kv_pages=f32_pages,
+                              prefix_cache="off", speculative="off",
+                              kv_quant="off")
+    hbm_pool_f32.warmup(prompt_lens=(probe_len,))
+    hbm_pool_q = SlotEngine(params, spec_config, slots=slots,
+                            max_len=max_len, queue_depth=len(prompt_lens),
+                            page_size=page_size, kv_pages=quant_pages,
+                            prefix_cache="off", speculative="off",
+                            kv_quant="on")
+    hbm_pool_q.warmup(prompt_lens=(probe_len,))
+    busy_f32 = max_concurrent(hbm_pool_f32, len(prompt_lens), probe_len)
+    busy_q = max_concurrent(hbm_pool_q, len(prompt_lens), probe_len)
+    quant_block.update({
+        "equal_hbm_bytes": f32_pages * layer_f32 * config.n_layers,
+        "equal_hbm_pages_f32": f32_pages,
+        "equal_hbm_pages_int8": quant_pages,
+        "max_concurrent_f32": busy_f32,
+        "max_concurrent_int8": busy_q,
+        "concurrency_at_equal_hbm": round(busy_q / max(1, busy_f32), 2),
+    })
+
+    # perplexity delta: teacher-forced CE with K/V routed through the
+    # per-(page, kv_head) int8 round trip vs the identical f32 path
+    # (ops/kv_quant.sim_kv_loss) — gated, not just recorded
+    eval_tokens = jax.random.randint(jax.random.PRNGKey(11), (4, 65), 0,
+                                     config.vocab_size)
+    loss_ref = float(_kvq.sim_kv_loss(params, spec_config, eval_tokens,
+                                      page_size, quantized=False))
+    loss_q = float(_kvq.sim_kv_loss(params, spec_config, eval_tokens,
+                                    page_size, quantized=True))
+    ppl_ref, ppl_q = math.exp(loss_ref), math.exp(loss_q)
+    ppl_delta = (ppl_q - ppl_ref) / ppl_ref
+    quant_block.update({
+        "perplexity_f32": round(ppl_ref, 3),
+        "perplexity_int8_kv": round(ppl_q, 3),
+        "perplexity_delta": round(ppl_delta, 5),
+        "perplexity_delta_within_gate": bool(ppl_delta <= ppl_delta_gate),
+    })
+    _log(f"  kv_quant: {quant_block}")
 
     # serving data-plane fault recovery (docs/ROBUSTNESS.md "Serving data
     # plane"): time-to-restore after an injected fatal fault through the
@@ -777,7 +873,7 @@ def bench_generate_serving():
     def fault_factory():
         engine = SlotEngine(params, config, slots=slots, max_len=max_len,
                             queue_depth=2 * slots, page_size=page_size,
-                            prefix_cache="off", speculative="off",
+                            prefix_cache="off", speculative="off", kv_quant="off",
                             fault_plan=plan)
         engine.warmup(prompt_lens=(prompt_lens[0],))
         return engine

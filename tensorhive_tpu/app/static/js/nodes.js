@@ -255,6 +255,9 @@ async function refreshServing() {
       servingBadge("KV pages · " + stats.pagedKernel,
                    stats.kvPagesFree + "/" + stats.kvPagesTotal,
                    stats.kvPagesFree === 0)}
+    ${stats.kvQuant !== "on" ? "" :
+      servingBadge("int8 KV",
+                   stats.kvBytesPerToken + " B/token", false)}
     ${stats.prefixCache !== "on" ? "" :
       servingBadge("prefix cache",
                    (stats.prefixHitRate == null ? "–" :

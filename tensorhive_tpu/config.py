@@ -195,6 +195,12 @@ class GenerationConfig:
                                      # auto = pallas on real TPU, XLA page
                                      # gather elsewhere; on/off force a
                                      # dispatch (docs/SERVING.md)
+    kv_quant: str = "auto"           # int8 KV pages with per-(page,
+                                     # kv_head) scales (docs/SERVING.md
+                                     # "Quantized KV pages"): auto = on
+                                     # for the paged layout — same HBM,
+                                     # ~2x (bf16) / ~4x (f32) the pages;
+                                     # off = byte-identical f32 rollback
     prefix_cache: str = "auto"       # radix shared-prefix page cache
                                      # (docs/SERVING.md "Prefix cache &
                                      # chunked prefill"): auto = on for the
@@ -515,6 +521,7 @@ enabled = false
 # page_size = 16
 # kv_pages = 0        # 0 = equal HBM to the contiguous layout
 # paged_kernel = "auto"  # fused decode kernel: auto|on|off
+# kv_quant = "auto"   # int8 KV pages + per-page scales: auto|on|off
 # prefix_cache = "auto"  # radix shared-prefix page cache: auto|on|off
 # prefix_min_tokens = 32
 # prefill_chunk_tokens = 256  # per-tick prefill budget (chunked prefill)

@@ -66,6 +66,12 @@ STATS_SCHEMA = obj(
     pagedKernel=s("string", nullable=True),
     kvPagesTotal=s("integer", nullable=True),
     kvPagesFree=s("integer", nullable=True),
+    #: int8 KV pages (docs/SERVING.md "Quantized KV pages"): "on"/"off",
+    #: and the per-token KV HBM cost across layers (payload + amortized
+    #: scale side-arrays; null for the contiguous layout) — the
+    #: serving-strip quant badge renders these
+    kvQuant=s("string"),
+    kvBytesPerToken=s("number", nullable=True),
     #: radix prefix cache (docs/SERVING.md "Prefix cache & chunked
     #: prefill"): "on"/"off", lifetime hit rate and retained page count —
     #: the serving-strip prefix badge renders these

@@ -404,6 +404,7 @@ def build_engine(config: Config):
         page_size=generation.page_size,
         kv_pages=generation.kv_pages * mesh_dp,
         paged_kernel=generation.paged_kernel,
+        kv_quant=generation.kv_quant,
         prefix_cache=generation.prefix_cache,
         prefix_min_tokens=generation.prefix_min_tokens,
         prefill_chunk_tokens=generation.prefill_chunk_tokens,

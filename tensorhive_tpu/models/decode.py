@@ -46,6 +46,20 @@ class KVCache(NamedTuple):
     v: jax.Array          # [layers, B, max_len, H, Dh]
 
 
+class QuantKVCache(NamedTuple):
+    """Int8-quantized paged KV cache (``kv_quant = on``): same page layout
+    as :class:`KVCache`'s paged form but one byte per cell, with per-(page,
+    kv_head) f32 scales in side-arrays indexed by the SAME physical page
+    ids the page tables resolve (ops/kv_quant.py; docs/SERVING.md
+    "Quantized KV pages"). The serving bodies branch on the cache pytree's
+    type at trace time, so ``kv_quant=off`` engines never trace a single
+    quantization op — the byte-identical rollback contract."""
+    k: jax.Array          # [layers, pages, page_size, Hkv, Dh] int8
+    v: jax.Array          # [layers, pages, page_size, Hkv, Dh] int8
+    k_scale: jax.Array    # [layers, pages, Hkv] f32
+    v_scale: jax.Array    # [layers, pages, Hkv] f32
+
+
 def init_cache(config: TransformerConfig, batch: int,
                max_len: Optional[int] = None) -> KVCache:
     """Cache is [layers, B, max_len, KV_HEADS, Dh] — with GQA the cache is
@@ -83,7 +97,8 @@ def _decode_attend(q, k_cache, v_cache, position):
 def _paged_attend(q, k_pages, v_pages, page_table, positions,
                   use_kernel: bool = False,
                   interpret: Optional[bool] = None,
-                  mesh=None, shard_heads: bool = False):
+                  mesh=None, shard_heads: bool = False,
+                  k_scales=None, v_scales=None):
     """Paged-cache decode attention, two dispatches behind one signature
     (the ``use_flash`` pattern — serving/engine.py prefill):
 
@@ -129,28 +144,61 @@ def _paged_attend(q, k_pages, v_pages, page_table, positions,
     against the full page pool, page tables/positions replicated; without
     it (the GQA replication guard, tp not dividing both head counts) the
     kernel runs fully replicated — correct, and the cache layout the
-    engine picks for the kernel dispatch matches these specs."""
+    engine picks for the kernel dispatch matches these specs.
+
+    ``k_scales``/``v_scales`` ([num_pages, Hkv] f32, ``kv_quant = on``):
+    the pages are int8 and attention consumes ``dequant(stored)`` — the
+    gather path dequantizes the gathered run (ops/kv_quant.py), the
+    kernel dequantizes per page in VMEM right after the DMA with the
+    scales riding as scalar-prefetch operands, so the int8 read also
+    halves-or-quarters the decode step's HBM traffic (docs/SERVING.md
+    "Quantized KV pages")."""
     if use_kernel:
         from ..ops.paged_attention import paged_attention
 
-        kernel = functools.partial(paged_attention, interpret=interpret)
+        kernel = functools.partial(paged_attention, interpret=interpret,
+                                   k_scales=k_scales, v_scales=v_scales)
         if mesh is not None:
             from jax.experimental.shard_map import shard_map
             from jax.sharding import PartitionSpec as P
 
             head_spec = (P(None, None, "tp", None) if shard_heads else P())
+            if k_scales is not None:
+                # scales shard like their pages' kv_heads axis: split over
+                # tp exactly when the K/V head axis is, replicated otherwise
+                scale_spec = P(None, "tp") if shard_heads else P()
+
+                def quant_kernel(q, k, v, table, positions, ks, vs):
+                    return paged_attention(q, k, v, table, positions,
+                                           interpret=interpret,
+                                           k_scales=ks, v_scales=vs)
+
+                return shard_map(
+                    quant_kernel, mesh=mesh,
+                    in_specs=(head_spec, head_spec, head_spec, P(), P(),
+                              scale_spec, scale_spec),
+                    out_specs=head_spec, check_rep=False,
+                )(q, k_pages, v_pages, page_table, positions,
+                  k_scales, v_scales)
             return shard_map(
                 kernel, mesh=mesh,
                 in_specs=(head_spec, head_spec, head_spec, P(), P()),
                 out_specs=head_spec, check_rep=False,
             )(q, k_pages, v_pages, page_table, positions)
-        return paged_attention(q, k_pages, v_pages, page_table, positions,
-                               interpret=interpret)
+        return kernel(q, k_pages, v_pages, page_table, positions)
     num_slots, max_pages = page_table.shape
     page_size = k_pages.shape[1]
     window = max_pages * page_size
-    k = k_pages[page_table].reshape(num_slots, window, *k_pages.shape[2:])
-    v = v_pages[page_table].reshape(num_slots, window, *v_pages.shape[2:])
+    if k_scales is not None:
+        from ..ops.kv_quant import dequant_gather
+
+        k = dequant_gather(k_pages, k_scales, page_table, q.dtype)
+        v = dequant_gather(v_pages, v_scales, page_table, q.dtype)
+    else:
+        k = k_pages[page_table].reshape(num_slots, window,
+                                        *k_pages.shape[2:])
+        v = v_pages[page_table].reshape(num_slots, window,
+                                        *v_pages.shape[2:])
     return _decode_attend(q, k, v,
                           positions[:, None, None, None, None])
 

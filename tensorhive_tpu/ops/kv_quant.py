@@ -1,0 +1,270 @@
+"""Int8 KV-page quantization: the arithmetic behind ``kv_quant = on``.
+
+The KV cache is the dominant HBM consumer of the serving data plane (PR 7
+paging, PR 11 prefix sharing and PR 13 speculation all multiply *sequences
+per chip*, but every cached cell is still ``config.dtype``). This module
+quantizes paged K/V to **int8 with one f32 scale per (physical page,
+kv_head)**, so the same HBM holds strictly more pages — the scale
+side-arrays ride in the cache pytree (``models/decode.QuantKVCache``),
+indexed by the SAME physical page ids the page tables resolve, and shard
+like their pages under a serving mesh (docs/SERVING.md "Quantized KV
+pages").
+
+Quantization scheme, in the order the constraints forced it:
+
+* **Symmetric int8, scale = amax / 127 per (page, kv_head).** One scale
+  per page keeps the side-array tiny (``2 * kv_heads * 4`` bytes per page
+  against ``2 * page_size * kv_heads * d_head`` payload bytes) and lets
+  the fused pallas kernel dequantize a whole page in VMEM right after its
+  DMA — the page is the DMA unit, so the scale granularity matches the
+  bandwidth granularity.
+* **Running-max scales, rescale-on-write.** A page fills incrementally
+  (decode writes one position per step), so its amax is not known up
+  front. Every write takes ``new_scale = max(old_scale, amax(written) /
+  127)``: the scale only ever grows, and when it grows the page's already-
+  stored values are dequantized and requantized onto the new grid. When
+  the scale does NOT grow, requantization is exactly idempotent
+  (``round(q * s / s) == q``), so untouched bytes never drift — the only
+  error a rescale adds is the coarser grid any per-page scheme would have
+  needed anyway.
+* **Offset-0 writes reset the running max.** Freed pages go back to the
+  pool with their scale rows untouched (scrubbing them would cost a
+  device dispatch per release); inheriting a stale scale would make a
+  recycled page quantize coarser than a fresh one — history leaking into
+  values. A page's offset-0 cell is written exactly when a new ownership
+  life begins (sequential decode entering the page, a prefill/COW chunk
+  restarting at the page boundary) or when a catch-up window rewrites the
+  page's whole live prefix, so any write touching offset 0 REBASES the
+  running max at zero: recycled pages behave byte-identically to fresh
+  ones, which is what pins slot-recycle ≡ fresh-engine token identity
+  under quantization.
+* **Dequantize-on-read, everywhere.** Attention always consumes
+  ``dequant(stored)``: the XLA gather path dequantizes the gathered page
+  run, the pallas kernel dequantizes per page in VMEM (scales ride as
+  scalar-prefetch operands, so int8 K/V also HALVES vs bf16 — quarters
+  vs f32 — the decode step's HBM read), and the chunk-prefill/speculative
+  window passes attend the requantized merge below. A prefix-cache hit
+  therefore reads byte-for-byte what the original writer stored, which is
+  what pins hit ≡ miss token identity under quantization.
+* **Writes never touch pages they do not own.** :func:`row_merge`
+  scatters back only pages an in-window write actually landed on
+  (everything else drops) — a chunk that starts past the shared-prefix
+  boundary cannot requantize a shared page, so the PR 11 COW rule holds
+  bit-for-bit under quantization.
+
+Scales are values, never shapes: every array here is a traced operand of
+the enclosing jit, so page assignment and scale updates keep the
+zero-recompile contract (the ``serving_paged_*_q`` fingerprints).
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+#: symmetric int8 grid: stored values live in [-127, 127] (no -128, so
+#: negation round-trips and the grid is symmetric around 0)
+INT8_MAX = 127.0
+#: scale floor — an all-zero page quantizes/dequantizes exactly instead of
+#: dividing by zero
+SCALE_FLOOR = 1e-8
+
+
+def resolve_kv_quant(mode: str, paged: bool) -> str:
+    """Resolve the ``[generation_service] kv_quant = auto|on|off`` knob at
+    engine construction (the ``paged_kernel``/``speculative`` pattern):
+    ``auto`` = on for the paged layout (pages are the quantization unit —
+    the int8 capacity story IS the default serving story), off for the
+    contiguous rollback layout; ``on`` requires paging."""
+    if mode not in ("auto", "on", "off"):
+        raise ValueError(f"kv_quant must be auto|on|off, got {mode!r}")
+    if mode == "on" and not paged:
+        raise ValueError(
+            "kv_quant=on needs the paged cache layout (the page is the "
+            "quantization/scale unit); set paged=true or kv_quant=auto/off")
+    return "on" if paged and mode != "off" else "off"
+
+
+# -- byte accounting (per layer, per page) ------------------------------------
+
+def page_bytes(page_size: int, kv_heads: int, d_head: int,
+               itemsize: int) -> int:
+    """HBM bytes one layer of one unquantized page costs (K + V)."""
+    return 2 * page_size * kv_heads * d_head * int(itemsize)
+
+
+def quant_page_bytes(page_size: int, kv_heads: int, d_head: int) -> int:
+    """HBM bytes one layer of one int8 page costs: K + V payload at one
+    byte per cell, plus the two f32 scale rows ([kv_heads] each)."""
+    return 2 * page_size * kv_heads * d_head + 2 * kv_heads * 4
+
+
+# -- write primitives ---------------------------------------------------------
+
+def _requant(values, scales):
+    """Snap ``values`` onto the int8 grid of ``scales`` (broadcast-ready)."""
+    q = jnp.round(values / scales)
+    return jnp.clip(q, -INT8_MAX, INT8_MAX).astype(jnp.int8)
+
+
+def step_write(pages_i8: jax.Array, scales: jax.Array, page_ids: jax.Array,
+               offsets: jax.Array, values: jax.Array
+               ) -> Tuple[jax.Array, jax.Array]:
+    """Quantize-on-write for the decode step: one position per slot.
+
+    ``pages_i8`` [P, ps, Hkv, Dh] int8, ``scales`` [P, Hkv] f32 (ONE
+    layer's pages + scale row), ``page_ids``/``offsets`` [S], ``values``
+    [S, Hkv, Dh]. Each touched page is dequantized, the new position
+    inserted, the running-max scale updated, and the WHOLE page
+    requantized and scattered back — out-of-range ``page_ids`` (the
+    speculative draft's past-limit routing) drop. Duplicate page ids only
+    ever name the trash page (parked slots), where any winner is garbage
+    by construction."""
+    num_slots = page_ids.shape[0]
+    slot = jnp.arange(num_slots)
+    cur_q = pages_i8[page_ids]                          # [S, ps, Hkv, Dh]
+    cur_s = scales[page_ids]                            # [S, Hkv]
+    vals = values.astype(jnp.float32)
+    deq = cur_q.astype(jnp.float32) * cur_s[:, None, :, None]
+    deq = deq.at[slot, offsets].set(vals)
+    # offset-0 writes begin a page's ownership life: rebase the running
+    # max so a recycled page cannot inherit its previous owner's scale
+    base_s = jnp.where((offsets == 0)[:, None], 0.0, cur_s)
+    new_s = jnp.maximum(base_s, jnp.maximum(
+        jnp.max(jnp.abs(vals), axis=-1) / INT8_MAX, SCALE_FLOOR))
+    q = _requant(deq, new_s[:, None, :, None])
+    pages_i8 = pages_i8.at[page_ids].set(q, mode="drop")
+    scales = scales.at[page_ids].set(new_s, mode="drop")
+    return pages_i8, scales
+
+
+def row_merge(pages_i8: jax.Array, scales: jax.Array, rows: jax.Array,
+              values: jax.Array, logical_pos: jax.Array, valid: jax.Array,
+              dtype) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Quantize-on-write for a window of positions through page-table rows
+    (chunked prefill, the speculative verify/propose windows).
+
+    ``rows`` [B, mp] physical page ids (one slot's row, or the whole step
+    table); ``values`` [B, W, Hkv, Dh]; ``logical_pos`` [B, W] pre-clipped
+    logical positions; ``valid`` [B, W] masks cells that must not write
+    (padding, past-limit). Returns ``(pages_i8, scales, ctx)`` where
+    ``ctx`` [B, mp * ps, Hkv, Dh] is the post-write DEQUANTIZED logical
+    context — exactly ``dequant(stored)``, including this window's own
+    freshly-requantized cells, so the attend sees what any later reader
+    will read (the hit ≡ miss identity argument).
+
+    Only pages a valid write landed on are scattered back (the rest
+    drop): shared prefix pages and other slots' pages are untouchable by
+    construction, preserving the COW rule under quantization."""
+    num_physical, ps = pages_i8.shape[0], pages_i8.shape[1]
+    hkv, dh = pages_i8.shape[2], pages_i8.shape[3]
+    num_rows, mp = rows.shape
+    b_idx = jnp.arange(num_rows)[:, None]
+    row_q = pages_i8[rows]                              # [B, mp, ps, Hkv, Dh]
+    row_s = scales[rows]                                # [B, mp, Hkv]
+    deq = row_q.astype(jnp.float32) * row_s[:, :, None, :, None]
+    flat = deq.reshape(num_rows, mp * ps, hkv, dh)
+    vals = values.astype(jnp.float32)
+    write_idx = jnp.where(valid, logical_pos, mp * ps)  # OOB -> dropped
+    flat = flat.at[b_idx, write_idx].set(vals, mode="drop")
+    page_idx = jnp.where(valid, logical_pos // ps, mp)  # OOB -> dropped
+    v_amax = jnp.max(jnp.abs(vals), axis=-1)            # [B, W, Hkv]
+    amax_upd = jnp.zeros((num_rows, mp, hkv), jnp.float32).at[
+        b_idx, page_idx].max(v_amax, mode="drop")
+    touched = jnp.zeros((num_rows, mp), jnp.int32).at[
+        b_idx, page_idx].add(valid.astype(jnp.int32), mode="drop") > 0
+    # pages whose offset-0 cell this window writes begin (or fully rewrite)
+    # an ownership life: rebase their running max at zero — the recycled-
+    # page determinism rule of step_write, window-shaped
+    reset_idx = jnp.where(valid & (logical_pos % ps == 0), page_idx, mp)
+    reset = jnp.zeros((num_rows, mp), jnp.int32).at[
+        b_idx, reset_idx].add(1, mode="drop") > 0
+    base_s = jnp.where(reset[..., None], 0.0, row_s)
+    new_s = jnp.maximum(base_s, jnp.maximum(amax_upd / INT8_MAX,
+                                            SCALE_FLOOR))
+    merged = flat.reshape(num_rows, mp, ps, hkv, dh)
+    q_new = _requant(merged, new_s[:, :, None, :, None])
+    write_rows = jnp.where(touched, rows, num_physical)  # OOB -> dropped
+    pages_i8 = pages_i8.at[write_rows].set(q_new, mode="drop")
+    scales = scales.at[write_rows].set(new_s, mode="drop")
+    requant = q_new.astype(jnp.float32) * new_s[:, :, None, :, None]
+    ctx_pages = jnp.where(touched[:, :, None, None, None], requant, deq)
+    ctx = ctx_pages.reshape(num_rows, mp * ps, hkv, dh).astype(dtype)
+    return pages_i8, scales, ctx
+
+
+# -- read primitive -----------------------------------------------------------
+
+def dequant_gather(pages_i8: jax.Array, scales: jax.Array,
+                   page_table: jax.Array, dtype) -> jax.Array:
+    """Gather each slot's page run into logical order and dequantize:
+    [S, mp] table over [P, ps, Hkv, Dh] int8 pages + [P, Hkv] scales ->
+    [S, mp * ps, Hkv, Dh] in the compute dtype — the quantized analog of
+    the XLA gather in ``models/decode._paged_attend``."""
+    gathered = pages_i8[page_table]                   # [S, mp, ps, Hkv, Dh]
+    gathered_s = scales[page_table]                   # [S, mp, Hkv]
+    deq = gathered.astype(jnp.float32) * gathered_s[:, :, None, :, None]
+    num_slots, mp = page_table.shape
+    return deq.reshape(num_slots, mp * pages_i8.shape[1],
+                       *pages_i8.shape[2:]).astype(dtype)
+
+
+# -- quality probe ------------------------------------------------------------
+
+def sim_kv_loss(params, config, tokens: jax.Array, page_size: int,
+                quantized: bool = True) -> jax.Array:
+    """Teacher-forced mean next-token CE with K/V routed through per-(page,
+    kv_head) int8 quantization before attention — the perplexity-delta
+    probe the bench ``kv_quant`` block gates on (``quantized=False`` is
+    the f32 reference through the IDENTICAL code path, so the delta
+    isolates quantization and nothing else).
+
+    The simulation quantizes each page with its final amax where serving
+    grows scales incrementally; the incremental path only ever uses
+    finer-or-equal grids for early positions, so this bounds the steady-
+    state cost honestly. ``tokens`` is [B, L+1] (inputs + shifted targets,
+    the ``TransformerLM.loss`` convention)."""
+    from ..models.transformer import TransformerLM
+    from .flash_attention import reference_attention
+
+    def page_requant(kv):
+        # [B, S, Hkv, Dh] -> per (page of page_size positions, kv_head)
+        # symmetric int8 round trip
+        batch, seq, hkv, dh = kv.shape
+        pages = -(-seq // page_size)
+        padded = jnp.pad(kv.astype(jnp.float32),
+                         ((0, 0), (0, pages * page_size - seq),
+                          (0, 0), (0, 0)))
+        paged = padded.reshape(batch, pages, page_size, hkv, dh)
+        scale = jnp.maximum(
+            jnp.max(jnp.abs(paged), axis=(2, 4)) / INT8_MAX, SCALE_FLOOR)
+        q = _requant(paged, scale[:, :, None, :, None])
+        deq = q.astype(jnp.float32) * scale[:, :, None, :, None]
+        return deq.reshape(batch, pages * page_size, hkv, dh
+                           )[:, :seq].astype(kv.dtype)
+
+    def attend(q, k, v, layer):
+        if quantized:
+            k, v = page_requant(k), page_requant(v)
+        return reference_attention(q, k, v, causal=True)
+
+    inputs, targets = tokens[:, :-1], tokens[:, 1:]
+    dtype = config.dtype
+    batch, width = inputs.shape
+    x = params["tok_embed"].astype(dtype)[inputs]
+    positions = jnp.broadcast_to(jnp.arange(width, dtype=jnp.int32),
+                                 (batch, width))
+    for layer_index, block in enumerate(params["blocks"]):
+        x = TransformerLM.block_forward(x, block, config, positions, attend,
+                                        layer_index=layer_index)
+    from ..models.transformer import _rmsnorm
+
+    x = _rmsnorm(x, params["final_norm"]["scale"])
+    logits = jnp.einsum("bld,dv->blv", x.astype(dtype),
+                        params["w_lm_head"].astype(dtype),
+                        preferred_element_type=jnp.float32)
+    logprobs = jax.nn.log_softmax(logits, axis=-1)
+    picked = jnp.take_along_axis(logprobs, targets[..., None],
+                                 axis=-1)[..., 0]
+    return -jnp.mean(picked)

@@ -38,6 +38,15 @@ Mechanics (the idiom of ``ops/flash_attention.py``, adapted to paging):
   ``[group, page_size]`` tiles with no expanded copy, mirroring the
   ``b // group`` index maps of the flash kernels.
 
+Int8 pages (``kv_quant = on`` — docs/SERVING.md "Quantized KV pages"):
+the same grid and index maps run over one-byte K/V blocks, with the
+per-(page, kv_head) f32 scales riding as two extra scalar-prefetch
+operands and each block dequantized in VMEM right after its DMA — the
+page's HBM read is the int8 payload, so decode bandwidth drops with the
+footprint. ``resolve_paged_kernel``'s ``auto`` keeps the XLA gather under
+quantization (interpret-mode correct, on-TPU unbenched); ``on`` forces
+the int8 kernel.
+
 Numerics: the online-softmax recurrence rescales partial sums by
 ``exp(m_old - m_new)`` where the gather path subtracts one global max — the
 same math at different accumulation order, so kernel output is within a few
@@ -82,7 +91,7 @@ def kernel_fits(page_size: int, kv_heads: int, d_head: int, heads: int,
 
 def resolve_paged_kernel(mode: str, *, page_size: int, kv_heads: int,
                          d_head: int, heads: int, dtype,
-                         mesh_devices: int = 1) -> str:
+                         mesh_devices: int = 1, quant: bool = False) -> str:
     """Resolve the ``[generation_service] paged_kernel`` knob to the
     dispatch actually used: ``"pallas"`` or ``"xla"``.
 
@@ -98,7 +107,10 @@ def resolve_paged_kernel(mode: str, *, page_size: int, kv_heads: int,
     call instead is correct — the mesh parity tests pin it token-identical
     under ``on`` — but its multi-chip TPU performance is unbenched, so
     auto does not pick it sight unseen; docs/SERVING.md "Multi-chip
-    serving"). ``on`` remains the explicit operator override."""
+    serving"). ``quant`` (``kv_quant = on``) follows the same policy: the
+    int8 kernel is pinned correct in interpret mode but its on-TPU
+    performance is unbenched, so ``auto`` keeps the XLA gather and ``on``
+    remains the explicit operator override."""
     if mode not in ("auto", "on", "off"):
         raise ValueError(
             f"paged_kernel must be auto|on|off, got {mode!r}")
@@ -107,18 +119,30 @@ def resolve_paged_kernel(mode: str, *, page_size: int, kv_heads: int,
     if mode == "off":
         return "xla"
     if (jax.default_backend() == "tpu" and mesh_devices == 1
+            and not quant
             and kernel_fits(page_size, kv_heads, d_head, heads, dtype)):
         return "pallas"
     return "xla"
 
 
-def _decode_kernel(page_table_ref, positions_ref, q_ref, k_ref, v_ref,
-                   out_ref, acc_ref, m_ref, l_ref, *, page_size: int,
-                   kv_heads: int):
+def _decode_kernel(*refs, page_size: int, kv_heads: int,
+                   quant: bool = False):
     """Grid (slots, pages), pages innermost. Blocks: q/out [1, H, Dh] per
     slot; k/v [1, page_size, Hkv, Dh] — ONE physical page, selected by the
     index map through the prefetched page table. Scratch (f32): acc
-    [H, Dh], m/l [H, 128] (lane-replicated row stats, the flash layout)."""
+    [H, Dh], m/l [H, 128] (lane-replicated row stats, the flash layout).
+
+    ``quant`` (``kv_quant = on``): K/V blocks are int8 and two extra
+    scalar-prefetch operands carry the per-(page, kv_head) f32 scales —
+    the block is dequantized here in VMEM right after its DMA, so the
+    page's HBM read is the one-byte payload (docs/SERVING.md "Quantized
+    KV pages")."""
+    if quant:
+        (page_table_ref, positions_ref, k_scale_ref, v_scale_ref,
+         q_ref, k_ref, v_ref, out_ref, acc_ref, m_ref, l_ref) = refs
+    else:
+        (page_table_ref, positions_ref, q_ref, k_ref, v_ref,
+         out_ref, acc_ref, m_ref, l_ref) = refs
     slot = pl.program_id(0)
     page = pl.program_id(1)
     last_page = pl.num_programs(1) - 1
@@ -141,10 +165,24 @@ def _decode_kernel(page_table_ref, positions_ref, q_ref, k_ref, v_ref,
         logical = page * page_size + jax.lax.broadcasted_iota(
             jnp.int32, (1, page_size), 1)
         visible = logical <= position                   # [1, page_size]
+        if quant:
+            # the resident block is the page the index map CLAMPED to —
+            # recompute its physical id the same way so the right scale
+            # row dequantizes it
+            live = jnp.maximum(position, 0) // page_size
+            phys = page_table_ref[slot, jnp.minimum(page, live)]
+
+            def kv_head(ref, scale_ref, h):
+                block = ref[0, :, h, :]                 # [page_size, Dh]
+                return block.astype(jnp.float32) * scale_ref[phys, h]
+        else:
+            def kv_head(ref, scale_ref, h):
+                return ref[0, :, h, :]
         # per-kv-head 2D dots (kv_heads is static, the loop unrolls): input
         # dtype on the MXU, f32 accumulation — _online_softmax_block's rule
         scores = jnp.concatenate([
-            jnp.dot(q[h * group:(h + 1) * group], k_ref[0, :, h, :].T,
+            jnp.dot(q[h * group:(h + 1) * group],
+                    kv_head(k_ref, k_scale_ref if quant else None, h).T,
                     preferred_element_type=jnp.float32)
             for h in range(kv_heads)], axis=0) * scale  # [H, page_size]
         scores = jnp.where(visible, scores, NEG_INF)
@@ -157,9 +195,11 @@ def _decode_kernel(page_table_ref, positions_ref, q_ref, k_ref, v_ref,
         new_max = jnp.maximum(m_prev, block_max)
         correction = jnp.exp(m_prev - new_max)
         probs = jnp.exp(scores - new_max[:, None])      # [H, page_size] f32
+        v_dtype = jnp.float32 if quant else v_ref.dtype
         acc_ref[...] = acc_ref[...] * correction[:, None] + jnp.concatenate([
-            jnp.dot(probs[h * group:(h + 1) * group].astype(v_ref.dtype),
-                    v_ref[0, :, h, :], preferred_element_type=jnp.float32)
+            jnp.dot(probs[h * group:(h + 1) * group].astype(v_dtype),
+                    kv_head(v_ref, v_scale_ref if quant else None, h),
+                    preferred_element_type=jnp.float32)
             for h in range(kv_heads)], axis=0)
         row_sum = l_prev * correction + jnp.sum(probs, axis=-1)
         m_ref[...] = jnp.broadcast_to(new_max[:, None], m_ref.shape)
@@ -179,24 +219,33 @@ def paged_attention(
     page_table: jax.Array,      # [S, max_pages_per_slot] int32
     positions: jax.Array,       # [S] int32 — attend to logical <= position
     interpret: Optional[bool] = None,
+    k_scales: Optional[jax.Array] = None,   # [num_physical, Hkv] f32
+    v_scales: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Paged decode attention with zero gathered intermediate: the attended
     output of :func:`~tensorhive_tpu.models.decode._paged_attend`'s gather
     path, computed by streaming each slot's pages from their physical
     locations. ``page_table``/``positions`` are values, never shapes —
-    callers inside a jit keep the zero-recompile contract."""
+    callers inside a jit keep the zero-recompile contract.
+
+    ``k_scales``/``v_scales`` switch the kernel to its int8 variant
+    (``kv_quant = on``): K/V pages arrive as one-byte payloads and the
+    scales ride as two extra scalar-prefetch operands, dequantized
+    per-page in VMEM after the DMA — the decode read's HBM traffic is the
+    int8 bytes, not a widened copy."""
     from jax.experimental.pallas import tpu as pltpu
 
     num_slots, _, heads, d_head = q.shape
     page_size, kv_heads = k_pages.shape[1], k_pages.shape[2]
     max_pages = page_table.shape[1]
+    quant = k_scales is not None
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
 
-    def q_map(slot, page, table, positions):
+    def q_map(slot, page, *prefetched):
         return (slot, 0, 0)
 
-    def kv_map(slot, page, table, positions):
+    def kv_map(slot, page, table, positions, *scales):
         # clamp to the slot's last live page: blocks past the boundary
         # re-select the resident block, so the pipeline fetches nothing
         # for them (pallas only issues a DMA when the index changes) —
@@ -205,7 +254,7 @@ def paged_attention(
         return (table[slot, jnp.minimum(page, live)], 0, 0, 0)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=4 if quant else 2,
         grid=(num_slots, max_pages),
         in_specs=[
             pl.BlockSpec((1, heads, d_head), q_map),
@@ -219,12 +268,15 @@ def paged_attention(
             pltpu.VMEM((heads, 128), jnp.float32),
         ],
     )
+    operands = [page_table.astype(jnp.int32), positions.astype(jnp.int32)]
+    if quant:
+        operands += [k_scales.astype(jnp.float32),
+                     v_scales.astype(jnp.float32)]
     out = pl.pallas_call(
         functools.partial(_decode_kernel, page_size=page_size,
-                          kv_heads=kv_heads),
+                          kv_heads=kv_heads, quant=quant),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((num_slots, heads, d_head), q.dtype),
         interpret=interpret,
-    )(page_table.astype(jnp.int32), positions.astype(jnp.int32),
-      q[:, 0], k_pages, v_pages)
+    )(*operands, q[:, 0], k_pages, v_pages)
     return out[:, None]

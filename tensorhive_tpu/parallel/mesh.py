@@ -239,3 +239,12 @@ def serving_cache_spec(rules: MeshRules) -> P:
     chips, the kv_heads axis follows the same GQA-guarded rule as wk/wv,
     and layers/positions/d_head stay unsharded."""
     return normalized_spec(None, "dp", None, rules.kv_heads, None)
+
+
+def serving_scale_spec(rules: MeshRules) -> P:
+    """PartitionSpec for the int8 KV cache's per-page scale side-arrays
+    (``[layers, pages, kv_heads]``, ``kv_quant = on`` —
+    docs/SERVING.md "Quantized KV pages"): scales shard exactly like the
+    pages they describe — pages over dp, kv_heads GQA-guarded over tp —
+    so a shard always holds the scales for the pages it holds."""
+    return normalized_spec(None, "dp", rules.kv_heads)

@@ -43,6 +43,15 @@ Design, in the order the constraints forced it:
   join can never stall the running batch's inter-token latency
   (docs/SERVING.md "Prefix cache & chunked prefill"). ``prefix_cache=off``
   is a byte-identical rollback to the PR 7-10 whole-prompt prefill path.
+* **Pages are int8 by default.** The paged cache quantizes K/V to int8
+  with one f32 scale per (physical page, kv_head) in side-arrays behind
+  the same page tables (``[generation_service] kv_quant``, auto = on for
+  paged layouts; ops/kv_quant.py) — the same HBM holds ~2x (bf16) / ~4x
+  (f32) the pages, and page-bound admission converts that straight into
+  concurrent sequences. Scales are traced operands in the donated cache
+  pytree, so scale updates never recompile (``serving_paged_*_q``
+  fingerprints); ``kv_quant=off`` rolls back byte-identically to the
+  full-precision cache (docs/SERVING.md "Quantized KV pages").
 * **Mesh-aware, single-chip by default.** An optional serving mesh
   (``parallel/mesh.py::serving_mesh``; ``[generation_service]
   mesh_dp``/``mesh_tp``) shards params over tp via the SAME
@@ -87,11 +96,13 @@ import numpy as np
 
 from ..models.decode import (
     KVCache,
+    QuantKVCache,
     _count_compile,
     _decode_attend,
     _paged_attend,
     _prefill_bucket,
 )
+from ..ops import kv_quant as kvq
 from ..models.transformer import (
     TransformerConfig,
     TransformerLM,
@@ -164,6 +175,17 @@ _SLOT_PAGES = get_registry().gauge(
     "tpuhive_generate_slot_kv_pages",
     "KV pages currently owned by each slot (0 when free or contiguous).",
     labels=("slot",))
+_KV_BYTES_CAPACITY = get_registry().gauge(
+    "tpuhive_generate_kv_bytes_capacity",
+    "KV-cache HBM the paged pool can hold across all layers (payload + "
+    "int8 scale side-arrays when kv_quant is on) — with _used, the "
+    "bytes-level view of the int8 capacity doubling (docs/SERVING.md "
+    "'Quantized KV pages').")
+_KV_BYTES_USED = get_registry().gauge(
+    "tpuhive_generate_kv_bytes_used",
+    "KV-cache HBM currently backing granted pages across all layers — "
+    "used/capacity is the byte-level pool fill the kv_quant sizing "
+    "story is measured in.")
 _MESH_DEVICES = get_registry().gauge(
     "tpuhive_generate_mesh_devices",
     "Devices in the serving mesh (dp x tp; 1 = single-chip engine).")
@@ -316,22 +338,42 @@ def _paged_step_body(params, tokens, positions, active, temps, page_tables,
     which no live sequence's page table ever references — the paged
     equivalent of the contiguous engine's "parked writes land in the
     parked slot's own row" argument.
+
+    With the int8 cache (``cache`` is a :class:`QuantKVCache` —
+    ``kv_quant = on``) each write quantizes onto its page's running-max
+    scale (ops/kv_quant.py) and the attend dequantizes through both
+    dispatches; the branch is picked by the cache PYTREE TYPE at trace
+    time, so ``kv_quant=off`` engines trace the identical computation they
+    always did (the byte-identical rollback).
     """
     dtype = config.dtype
     x = params["tok_embed"].astype(dtype)[tokens][:, None, :]     # [S,1,D]
     rope_positions = positions[:, None]                           # [S,1]
     cache_k, cache_v = cache.k, cache.v
+    quant = isinstance(cache, QuantKVCache)
+    scale_k = cache.k_scale if quant else None
+    scale_v = cache.v_scale if quant else None
     page_size = cache_k.shape[2]
     slot_ids = jnp.arange(tokens.shape[0])
     pages = page_tables[slot_ids, positions // page_size]         # [S]
     offsets = positions % page_size                               # [S]
 
     def attend(q, k, v, layer):
-        nonlocal cache_k, cache_v
-        layer_k = cache_k[layer].at[pages, offsets].set(
-            k[:, 0].astype(cache_k.dtype))
-        layer_v = cache_v[layer].at[pages, offsets].set(
-            v[:, 0].astype(cache_v.dtype))
+        nonlocal cache_k, cache_v, scale_k, scale_v
+        if quant:
+            layer_k, layer_ks = kvq.step_write(
+                cache_k[layer], scale_k[layer], pages, offsets, k[:, 0])
+            layer_v, layer_vs = kvq.step_write(
+                cache_v[layer], scale_v[layer], pages, offsets, v[:, 0])
+            scale_k = jax.lax.dynamic_update_slice(
+                scale_k, layer_ks[None], (layer, 0, 0))
+            scale_v = jax.lax.dynamic_update_slice(
+                scale_v, layer_vs[None], (layer, 0, 0))
+        else:
+            layer_k = cache_k[layer].at[pages, offsets].set(
+                k[:, 0].astype(cache_k.dtype))
+            layer_v = cache_v[layer].at[pages, offsets].set(
+                v[:, 0].astype(cache_v.dtype))
         cache_k = jax.lax.dynamic_update_slice(
             cache_k, layer_k[None], (layer, 0, 0, 0, 0))
         cache_v = jax.lax.dynamic_update_slice(
@@ -339,13 +381,18 @@ def _paged_step_body(params, tokens, positions, active, temps, page_tables,
         return _paged_attend(q, cache_k[layer], cache_v[layer], page_tables,
                              positions, use_kernel=use_kernel,
                              interpret=interpret, mesh=mesh,
-                             shard_heads=shard_heads)
+                             shard_heads=shard_heads,
+                             k_scales=scale_k[layer] if quant else None,
+                             v_scales=scale_v[layer] if quant else None)
 
     for layer_index, block in enumerate(params["blocks"]):
         x = TransformerLM.block_forward(x, block, config, rope_positions,
                                         attend, layer_index=layer_index)
     chosen, key = _choose_next(params, x, tokens, active, temps, key,
                                config, top_k)
+    if quant:
+        return chosen, QuantKVCache(k=cache_k, v=cache_v, k_scale=scale_k,
+                                    v_scale=scale_v), key
     return chosen, KVCache(k=cache_k, v=cache_v), key
 
 
@@ -439,13 +486,31 @@ def _paged_prefill_body(params, head, cache, page_table_row, real_len,
                       num_physical)                       # OOB -> dropped
     offsets = token_index % page_size
     cache_k, cache_v = cache.k, cache.v
+    quant = isinstance(cache, QuantKVCache)
+    scale_k = cache.k_scale if quant else None
+    scale_v = cache.v_scale if quant else None
 
     def attend(q, k, v, layer):
-        nonlocal cache_k, cache_v
-        layer_k = cache_k[layer].at[pages, offsets].set(
-            k[0].astype(cache_k.dtype), mode="drop")
-        layer_v = cache_v[layer].at[pages, offsets].set(
-            v[0].astype(cache_v.dtype), mode="drop")
+        nonlocal cache_k, cache_v, scale_k, scale_v
+        if quant:
+            # quantize-on-write through the row (ops/kv_quant.row_merge);
+            # the prompt attends its own UNWRITTEN k/v below, exactly like
+            # the f32 path, so only storage changes here
+            layer_k, layer_ks, _ = kvq.row_merge(
+                cache_k[layer], scale_k[layer], page_table_row[None],
+                k, token_index[None], valid[None], dtype)
+            layer_v, layer_vs, _ = kvq.row_merge(
+                cache_v[layer], scale_v[layer], page_table_row[None],
+                v, token_index[None], valid[None], dtype)
+            scale_k = jax.lax.dynamic_update_slice(
+                scale_k, layer_ks[None], (layer, 0, 0))
+            scale_v = jax.lax.dynamic_update_slice(
+                scale_v, layer_vs[None], (layer, 0, 0))
+        else:
+            layer_k = cache_k[layer].at[pages, offsets].set(
+                k[0].astype(cache_k.dtype), mode="drop")
+            layer_v = cache_v[layer].at[pages, offsets].set(
+                v[0].astype(cache_v.dtype), mode="drop")
         cache_k = jax.lax.dynamic_update_slice(
             cache_k, layer_k[None], (layer, 0, 0, 0, 0))
         cache_v = jax.lax.dynamic_update_slice(
@@ -457,6 +522,9 @@ def _paged_prefill_body(params, head, cache, page_table_row, real_len,
     for layer_index, block in enumerate(params["blocks"]):
         x = TransformerLM.block_forward(x, block, config, positions, attend,
                                         layer_index=layer_index)
+    if quant:
+        return QuantKVCache(k=cache_k, v=cache_v, k_scale=scale_k,
+                            v_scale=scale_v)
     return KVCache(k=cache_k, v=cache_v)
 
 
@@ -540,10 +608,35 @@ def _paged_chunk_prefill_body(params, head, cache, page_table_row, start,
                       num_physical)                    # OOB -> dropped
     page_offsets = global_positions % page_size
     window = page_table_row.shape[0] * page_size
+    safe_logical = jnp.clip(global_positions, 0, window - 1)
     cache_k, cache_v = cache.k, cache.v
+    quant = isinstance(cache, QuantKVCache)
+    scale_k = cache.k_scale if quant else None
+    scale_v = cache.v_scale if quant else None
 
     def attend(q, k, v, layer):
-        nonlocal cache_k, cache_v
+        nonlocal cache_k, cache_v, scale_k, scale_v
+        if quant:
+            # merge-quantize-requantize through the row (ops/kv_quant.
+            # row_merge), then attend the DEQUANTIZED post-write context —
+            # the chunk sees byte-for-byte what any later reader (a
+            # prefix-cache hit above all) will dequantize, which is what
+            # pins hit == miss token identity under int8
+            layer_k, layer_ks, ctx_k = kvq.row_merge(
+                cache_k[layer], scale_k[layer], page_table_row[None],
+                k, safe_logical[None], valid[None], dtype)
+            layer_v, layer_vs, ctx_v = kvq.row_merge(
+                cache_v[layer], scale_v[layer], page_table_row[None],
+                v, safe_logical[None], valid[None], dtype)
+            scale_k = jax.lax.dynamic_update_slice(
+                scale_k, layer_ks[None], (layer, 0, 0))
+            scale_v = jax.lax.dynamic_update_slice(
+                scale_v, layer_vs[None], (layer, 0, 0))
+            cache_k = jax.lax.dynamic_update_slice(
+                cache_k, layer_k[None], (layer, 0, 0, 0, 0))
+            cache_v = jax.lax.dynamic_update_slice(
+                cache_v, layer_v[None], (layer, 0, 0, 0, 0))
+            return _chunk_attend(q, ctx_k[0], ctx_v[0], global_positions)
         layer_k = cache_k[layer].at[pages, page_offsets].set(
             k[0].astype(cache_k.dtype), mode="drop")
         layer_v = cache_v[layer].at[pages, page_offsets].set(
@@ -561,6 +654,9 @@ def _paged_chunk_prefill_body(params, head, cache, page_table_row, start,
     for layer_index, block in enumerate(params["blocks"]):
         x = TransformerLM.block_forward(x, block, config, positions, attend,
                                         layer_index=layer_index)
+    if quant:
+        return QuantKVCache(k=cache_k, v=cache_v, k_scale=scale_k,
+                            v_scale=scale_v)
     return KVCache(k=cache_k, v=cache_v)
 
 
@@ -707,6 +803,7 @@ class SlotEngine:
         page_size: int = 16,
         kv_pages: int = 0,
         paged_kernel: str = "auto",
+        kv_quant: str = "auto",
         prefix_cache: str = "auto",
         prefix_min_tokens: int = 32,
         prefill_chunk_tokens: int = 256,
@@ -739,6 +836,12 @@ class SlotEngine:
         self.max_new_tokens_cap = int(max_new_tokens_cap)
         self.max_concurrent_per_user = int(max_concurrent_per_user)
         self.paged = bool(paged)
+        # -- int8 KV pages (docs/SERVING.md "Quantized KV pages"): auto =
+        # on for the paged layout (the page is the quantization unit);
+        # off = the byte-identical f32/bf16 rollback — the legacy
+        # executables with their legacy fingerprints, never a quant op
+        self.kv_quant = kvq.resolve_kv_quant(kv_quant, self.paged)
+        self._quant = self.kv_quant == "on"
         self.clock = clock
         # -- fault tolerance (docs/ROBUSTNESS.md "Serving data plane") -----
         if default_deadline_s < 0 or max_deadline_s <= 0:
@@ -820,14 +923,39 @@ class SlotEngine:
                 paged_kernel, page_size=self.page_size,
                 kv_heads=config.kv_heads, d_head=config.d_head,
                 heads=config.n_heads, dtype=config.dtype,
-                mesh_devices=self.mesh_dp * self.mesh_tp)
+                mesh_devices=self.mesh_dp * self.mesh_tp,
+                quant=self._quant)
             self._use_kernel = self.paged_kernel == "pallas"
             self._kernel_interpret = jax.default_backend() != "tpu"
             max_pages_per_slot = -(-self.max_len // self.page_size)
+            #: HBM one page costs across all layers (payload + the int8
+            #: scale side-arrays when quantized) — the byte-accounting
+            #: unit behind the kv_bytes gauges and kvBytesPerToken
+            self._page_hbm_bytes = config.n_layers * (
+                kvq.quant_page_bytes(self.page_size, config.kv_heads,
+                                     config.d_head)
+                if self._quant else
+                kvq.page_bytes(self.page_size, config.kv_heads,
+                               config.d_head,
+                               jnp.dtype(config.dtype).itemsize))
             #: 0 = the contiguous engine's HBM at the same slot count — the
-            #: rollback-neutral default; serving more sequences at equal
-            #: HBM means raising ``slots`` while keeping ``kv_pages``
-            num_pages = int(kv_pages) or self.capacity * max_pages_per_slot
+            #: rollback-neutral default; with kv_quant on the SAME byte
+            #: budget holds more int8 pages (the capacity-doubling story:
+            #: 2x vs bf16, ~4x vs f32, minus the scale side-array), so the
+            #: default pool converts that headroom into pages outright
+            if kv_pages:
+                num_pages = int(kv_pages)
+            else:
+                num_pages = self.capacity * max_pages_per_slot
+                if self._quant:
+                    dtype_page = kvq.page_bytes(
+                        self.page_size, config.kv_heads, config.d_head,
+                        jnp.dtype(config.dtype).itemsize)
+                    num_pages = (num_pages * dtype_page
+                                 // kvq.quant_page_bytes(
+                                     self.page_size, config.kv_heads,
+                                     config.d_head))
+                    num_pages -= num_pages % self.mesh_dp
             if num_pages % self.mesh_dp:
                 raise ValueError(
                     f"kv_pages={num_pages} must be divisible by mesh "
@@ -853,6 +981,7 @@ class SlotEngine:
             self.paged_kernel = None
             self._use_kernel = False
             self._kernel_interpret = False
+            self._page_hbm_bytes = None
             if self.capacity % self.mesh_dp:
                 raise ValueError(
                     f"slots={self.capacity} must be divisible by mesh "
@@ -867,25 +996,46 @@ class SlotEngine:
         self._kernel_shard_heads = (
             self.mesh is not None and self._rules.heads == "tp"
             and self._rules.kv_heads == "tp")
-        self._cache = KVCache(k=jnp.zeros(shape, config.dtype),
-                              v=jnp.zeros(shape, config.dtype))
+        if self._quant:
+            # int8 payload + per-(page, kv_head) f32 scale side-arrays,
+            # indexed by the same physical page ids the tables resolve
+            scale_shape = (config.n_layers, shape[1], config.kv_heads)
+            self._cache = QuantKVCache(
+                k=jnp.zeros(shape, jnp.int8),
+                v=jnp.zeros(shape, jnp.int8),
+                k_scale=jnp.zeros(scale_shape, jnp.float32),
+                v_scale=jnp.zeros(scale_shape, jnp.float32))
+        else:
+            self._cache = KVCache(k=jnp.zeros(shape, config.dtype),
+                                  v=jnp.zeros(shape, config.dtype))
         if self.mesh is not None:
             from jax.sharding import NamedSharding
 
-            from ..parallel.mesh import normalized_spec
+            from ..parallel.mesh import normalized_spec, serving_scale_spec
 
             cache_spec = self._cache_spec
+            scale_spec = serving_scale_spec(self._rules)
             if self._use_kernel:
                 # page tables hold GLOBAL physical indices, so the kernel's
                 # shard_map needs every shard to hold the whole page pool:
                 # pages replicate (no dp sharding) and the kv_heads axis
-                # shards only when the head-aligned split applies
+                # shards only when the head-aligned split applies — the
+                # scale side-arrays follow their pages
                 cache_spec = normalized_spec(
                     None, None, None,
                     "tp" if self._kernel_shard_heads else None, None)
+                scale_spec = normalized_spec(
+                    None, None, "tp" if self._kernel_shard_heads else None)
             sharding = NamedSharding(self.mesh, cache_spec)
-            self._cache = jax.device_put(
-                self._cache, KVCache(k=sharding, v=sharding))
+            if self._quant:
+                scale_sharding = NamedSharding(self.mesh, scale_spec)
+                self._cache = jax.device_put(
+                    self._cache, QuantKVCache(
+                        k=sharding, v=sharding,
+                        k_scale=scale_sharding, v_scale=scale_sharding))
+            else:
+                self._cache = jax.device_put(
+                    self._cache, KVCache(k=sharding, v=sharding))
         self._tokens = np.zeros(self.capacity, np.int32)
         self._positions = np.zeros(self.capacity, np.int32)
         self._active = np.zeros(self.capacity, bool)
@@ -960,6 +1110,9 @@ class SlotEngine:
         if self.paged:
             _KV_PAGES_TOTAL.set(self._pool.num_pages)
             _KV_PAGES_FREE.set(self._pool.free_pages)
+            _KV_BYTES_CAPACITY.set(self._pool.num_pages
+                                   * self._page_hbm_bytes)
+            _KV_BYTES_USED.set(self._pool.used_pages * self._page_hbm_bytes)
             for index in range(self.capacity):
                 _SLOT_PAGES.labels(slot=str(index)).set(0)
         if self._prefix is not None:
@@ -1300,9 +1453,12 @@ class SlotEngine:
 
     def _fingerprint_fn(self, base: str) -> str:
         """Compile-counter fn name: mesh engines get a ``serving_mesh_*``
-        variant (docs/OBSERVABILITY.md) so operators can tell the sharded
-        executables from the single-chip ones — and the rollback test can
-        assert a 1x1 config never mints a mesh fingerprint."""
+        variant and int8 engines a ``*_q`` suffix (docs/OBSERVABILITY.md)
+        so operators can tell WHICH executables compiled — and the
+        rollback tests can assert a 1x1 / kv_quant=off config never mints
+        a mesh or quant fingerprint."""
+        if self._quant:
+            base = base + "_q"
         if self.mesh is None:
             return base
         return base.replace("serving_", "serving_mesh_", 1)
@@ -1481,6 +1637,8 @@ class SlotEngine:
                             self.prefix_misses += 1
                             _PREFIX_MISSES.inc()
                     _KV_PAGES_FREE.set(self._pool.free_pages)
+                    _KV_BYTES_USED.set(self._pool.used_pages
+                                       * self._page_hbm_bytes)
                     _SLOT_PAGES.labels(slot=str(free)).set(needed)
                 self._pending.popleft()
                 joined_ts = self.clock()
@@ -1955,6 +2113,8 @@ class SlotEngine:
             self._pool.release(index)
             self._positions[index] = 0
             _KV_PAGES_FREE.set(self._pool.free_pages)
+            _KV_BYTES_USED.set(self._pool.used_pages
+                               * self._page_hbm_bytes)
             _SLOT_PAGES.labels(slot=str(index)).set(0)
         # (contiguous) position stays frozen: the parked slot's masked
         # writes keep landing on one already-consumed coordinate of its own
@@ -2079,6 +2239,10 @@ class SlotEngine:
                 "pagedKernel": self.paged_kernel,
                 "kvPagesTotal": self._pool.num_pages if self.paged else None,
                 "kvPagesFree": self._pool.free_pages if self.paged else None,
+                "kvQuant": self.kv_quant,
+                "kvBytesPerToken": (
+                    round(self._page_hbm_bytes / self.page_size, 1)
+                    if self.paged else None),
                 "prefixCache": self.prefix_cache,
                 "prefixHits": self.prefix_hits,
                 "prefixMisses": self.prefix_misses,

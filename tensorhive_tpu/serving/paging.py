@@ -39,6 +39,18 @@ pages satisfy any ``n``-page request regardless of allocation history
 (pinned by test_paging.py's churn test). Concurrency: the pool is NOT
 internally locked — the engine mutates it only under its own lock / from
 its single pump thread, the same discipline as the per-slot operand arrays.
+
+Quantized engines (``kv_quant = on`` — docs/SERVING.md "Quantized KV
+pages") pair every physical page with a per-kv-head f32 scale row in the
+cache pytree's side-arrays (``ops/kv_quant.py``), indexed by the SAME
+physical ids this allocator hands out; the allocator itself is unchanged —
+a page is a page whatever its cells are made of, so refcounts, sharing and
+the churn invariant carry over verbatim. ``release`` deliberately does NOT
+scrub scales (that would cost a device dispatch per leave): the quantizer's
+offset-0 rebase rule makes a recycled page behave byte-identically to a
+fresh one anyway. Byte-level accounting (the ``tpuhive_generate_kv_bytes_
+capacity`` / ``_used`` gauges) lives with the engine, which knows the cell
+width; this module keeps counting pages.
 """
 from __future__ import annotations
 

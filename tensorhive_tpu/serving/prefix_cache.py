@@ -33,6 +33,14 @@ importantly — it keeps the executable set fixed, so COW can never
 recompile). Writes to shared pages are impossible by construction, which
 is what lets refcount bookkeeping alone guarantee isolation.
 
+Quantized engines (``kv_quant = on``) change nothing here: a cached page
+carries int8 K/V plus its per-(page, kv_head) scales (ops/kv_quant.py),
+the COW rule already guarantees no sharer ever writes it — and since a
+window write can only requantize pages it actually wrote, a shared page's
+bytes AND scales are frozen while referenced, which is what makes a hit
+read byte-for-byte what the miss stored (hit ≡ miss token identity, pinned
+under quantization in tests/unit/test_kv_quant.py).
+
 Readiness: a page enters the tree only after the prefill chunk covering
 its last position has been DISPATCHED. All executables chain through the
 one donated cache buffer on the single pump thread, so any later-dispatched

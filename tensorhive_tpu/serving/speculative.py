@@ -85,10 +85,12 @@ import numpy as np
 
 from ..models.decode import (
     KVCache,
+    QuantKVCache,
     _count_compile,
     _decode_attend,
     _paged_attend,
 )
+from ..ops import kv_quant as kvq
 from ..models.transformer import (
     TransformerConfig,
     TransformerLM,
@@ -210,12 +212,16 @@ def _head_logits(params, x, config: TransformerConfig):
 # -- draft lane: catch-up + propose ------------------------------------------
 
 def _paged_draft_step(params, token, step_positions, limits, page_tables,
-                      cache_k, cache_v, config: TransformerConfig):
+                      cache_k, cache_v, config: TransformerConfig,
+                      scale_k=None, scale_v=None):
     """One greedy draft step at traced per-slot positions over the paged
     draft cache: write the token's K/V through the page-table row (writes
     past ``limits`` — or through an inactive slot's trash-masked row —
     route out of bounds and drop), attend via the XLA page gather, argmax.
-    Mirrors ``engine._paged_step_body`` minus sampling."""
+    Mirrors ``engine._paged_step_body`` minus sampling — including the
+    int8 branch (``scale_k``/``scale_v`` present), which quantizes the
+    write onto its page's running-max scale and attends the dequantized
+    gather (ops/kv_quant.py)."""
     dtype = config.dtype
     num_slots = token.shape[0]
     num_physical = cache_k.shape[1]
@@ -226,27 +232,41 @@ def _paged_draft_step(params, token, step_positions, limits, page_tables,
     rows = page_tables[slot_ids, safe // page_size]
     pages = jnp.where(step_positions <= limits, rows, num_physical)
     offsets = safe % page_size
+    quant = scale_k is not None
     x = params["tok_embed"].astype(dtype)[token][:, None, :]
     rope_positions = step_positions[:, None]
 
     def attend(q, k, v, layer):
-        nonlocal cache_k, cache_v
-        layer_k = cache_k[layer].at[pages, offsets].set(
-            k[:, 0].astype(cache_k.dtype), mode="drop")
-        layer_v = cache_v[layer].at[pages, offsets].set(
-            v[:, 0].astype(cache_v.dtype), mode="drop")
+        nonlocal cache_k, cache_v, scale_k, scale_v
+        if quant:
+            layer_k, layer_ks = kvq.step_write(
+                cache_k[layer], scale_k[layer], pages, offsets, k[:, 0])
+            layer_v, layer_vs = kvq.step_write(
+                cache_v[layer], scale_v[layer], pages, offsets, v[:, 0])
+            scale_k = jax.lax.dynamic_update_slice(
+                scale_k, layer_ks[None], (layer, 0, 0))
+            scale_v = jax.lax.dynamic_update_slice(
+                scale_v, layer_vs[None], (layer, 0, 0))
+        else:
+            layer_k = cache_k[layer].at[pages, offsets].set(
+                k[:, 0].astype(cache_k.dtype), mode="drop")
+            layer_v = cache_v[layer].at[pages, offsets].set(
+                v[:, 0].astype(cache_v.dtype), mode="drop")
         cache_k = jax.lax.dynamic_update_slice(
             cache_k, layer_k[None], (layer, 0, 0, 0, 0))
         cache_v = jax.lax.dynamic_update_slice(
             cache_v, layer_v[None], (layer, 0, 0, 0, 0))
         return _paged_attend(q, cache_k[layer], cache_v[layer], page_tables,
-                             step_positions)
+                             step_positions,
+                             k_scales=scale_k[layer] if quant else None,
+                             v_scales=scale_v[layer] if quant else None)
 
     for layer_index, block in enumerate(params["blocks"]):
         x = TransformerLM.block_forward(x, block, config, rope_positions,
                                         attend, layer_index=layer_index)
     logits = _head_logits(params, x, config)[:, 0]
-    return jnp.argmax(logits, axis=-1).astype(jnp.int32), cache_k, cache_v
+    return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
+            cache_k, cache_v, scale_k, scale_v)
 
 
 def _paged_draft_propose_body(params, window_tokens, window_lens, positions,
@@ -269,6 +289,9 @@ def _paged_draft_propose_body(params, window_tokens, window_lens, positions,
     dtype = config.dtype
     num_slots, width = window_tokens.shape
     cache_k, cache_v = cache.k, cache.v
+    quant = isinstance(cache, QuantKVCache)
+    scale_k = cache.k_scale if quant else None
+    scale_v = cache.v_scale if quant else None
     num_physical = cache_k.shape[1]
     page_size = cache_k.shape[2]
     max_pages = page_tables.shape[1]
@@ -285,19 +308,31 @@ def _paged_draft_propose_body(params, window_tokens, window_lens, positions,
     x = params["tok_embed"].astype(dtype)[window_tokens]
 
     def attend(q, k, v, layer):
-        nonlocal cache_k, cache_v
-        layer_k = cache_k[layer].at[pages, offsets].set(
-            k.astype(cache_k.dtype), mode="drop")
-        layer_v = cache_v[layer].at[pages, offsets].set(
-            v.astype(cache_v.dtype), mode="drop")
+        nonlocal cache_k, cache_v, scale_k, scale_v
+        if quant:
+            layer_k, layer_ks, ctx_k = kvq.row_merge(
+                cache_k[layer], scale_k[layer], page_tables,
+                k, safe_pos, valid, dtype)
+            layer_v, layer_vs, ctx_v = kvq.row_merge(
+                cache_v[layer], scale_v[layer], page_tables,
+                v, safe_pos, valid, dtype)
+            scale_k = jax.lax.dynamic_update_slice(
+                scale_k, layer_ks[None], (layer, 0, 0))
+            scale_v = jax.lax.dynamic_update_slice(
+                scale_v, layer_vs[None], (layer, 0, 0))
+        else:
+            layer_k = cache_k[layer].at[pages, offsets].set(
+                k.astype(cache_k.dtype), mode="drop")
+            layer_v = cache_v[layer].at[pages, offsets].set(
+                v.astype(cache_v.dtype), mode="drop")
+            ctx_k = layer_k[page_tables].reshape(num_slots, window_ctx,
+                                                 *layer_k.shape[2:])
+            ctx_v = layer_v[page_tables].reshape(num_slots, window_ctx,
+                                                 *layer_v.shape[2:])
         cache_k = jax.lax.dynamic_update_slice(
             cache_k, layer_k[None], (layer, 0, 0, 0, 0))
         cache_v = jax.lax.dynamic_update_slice(
             cache_v, layer_v[None], (layer, 0, 0, 0, 0))
-        ctx_k = layer_k[page_tables].reshape(num_slots, window_ctx,
-                                             *layer_k.shape[2:])
-        ctx_v = layer_v[page_tables].reshape(num_slots, window_ctx,
-                                             *layer_v.shape[2:])
         return _window_attend(q, ctx_k, ctx_v, safe_pos)
 
     for layer_index, block in enumerate(params["blocks"]):
@@ -307,10 +342,13 @@ def _paged_draft_propose_body(params, window_tokens, window_lens, positions,
     token = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     proposals = [token]
     for step in range(1, width - 1):
-        token, cache_k, cache_v = _paged_draft_step(
+        token, cache_k, cache_v, scale_k, scale_v = _paged_draft_step(
             params, token, positions + step, limits, page_tables,
-            cache_k, cache_v, config)
+            cache_k, cache_v, config, scale_k=scale_k, scale_v=scale_v)
         proposals.append(token)
+    if quant:
+        return jnp.stack(proposals, axis=1), QuantKVCache(
+            k=cache_k, v=cache_v, k_scale=scale_k, v_scale=scale_v)
     return jnp.stack(proposals, axis=1), KVCache(k=cache_k, v=cache_v)
 
 
@@ -426,6 +464,9 @@ def _paged_spec_verify_body(params, window_tokens, positions, active, temps,
     dtype = config.dtype
     num_slots, width = window_tokens.shape
     cache_k, cache_v = cache.k, cache.v
+    quant = isinstance(cache, QuantKVCache)
+    scale_k = cache.k_scale if quant else None
+    scale_v = cache.v_scale if quant else None
     num_physical = cache_k.shape[1]
     page_size = cache_k.shape[2]
     max_pages = page_tables.shape[1]
@@ -440,19 +481,31 @@ def _paged_spec_verify_body(params, window_tokens, positions, active, temps,
     x = params["tok_embed"].astype(dtype)[window_tokens]
 
     def attend(q, k, v, layer):
-        nonlocal cache_k, cache_v
-        layer_k = cache_k[layer].at[pages, offsets].set(
-            k.astype(cache_k.dtype), mode="drop")
-        layer_v = cache_v[layer].at[pages, offsets].set(
-            v.astype(cache_v.dtype), mode="drop")
+        nonlocal cache_k, cache_v, scale_k, scale_v
+        if quant:
+            layer_k, layer_ks, ctx_k = kvq.row_merge(
+                cache_k[layer], scale_k[layer], page_tables,
+                k, safe_pos, writable, dtype)
+            layer_v, layer_vs, ctx_v = kvq.row_merge(
+                cache_v[layer], scale_v[layer], page_tables,
+                v, safe_pos, writable, dtype)
+            scale_k = jax.lax.dynamic_update_slice(
+                scale_k, layer_ks[None], (layer, 0, 0))
+            scale_v = jax.lax.dynamic_update_slice(
+                scale_v, layer_vs[None], (layer, 0, 0))
+        else:
+            layer_k = cache_k[layer].at[pages, offsets].set(
+                k.astype(cache_k.dtype), mode="drop")
+            layer_v = cache_v[layer].at[pages, offsets].set(
+                v.astype(cache_v.dtype), mode="drop")
+            ctx_k = layer_k[page_tables].reshape(num_slots, window_ctx,
+                                                 *layer_k.shape[2:])
+            ctx_v = layer_v[page_tables].reshape(num_slots, window_ctx,
+                                                 *layer_v.shape[2:])
         cache_k = jax.lax.dynamic_update_slice(
             cache_k, layer_k[None], (layer, 0, 0, 0, 0))
         cache_v = jax.lax.dynamic_update_slice(
             cache_v, layer_v[None], (layer, 0, 0, 0, 0))
-        ctx_k = layer_k[page_tables].reshape(num_slots, window_ctx,
-                                             *layer_k.shape[2:])
-        ctx_v = layer_v[page_tables].reshape(num_slots, window_ctx,
-                                             *layer_v.shape[2:])
         return _window_attend(q, ctx_k, ctx_v, safe_pos)
 
     for layer_index, block in enumerate(params["blocks"]):
@@ -462,6 +515,9 @@ def _paged_spec_verify_body(params, window_tokens, positions, active, temps,
                                active, temps, key, config, top_k)
     greedy = jnp.argmax(_head_logits(params, x, config),
                         axis=-1).astype(jnp.int32)
+    if quant:
+        return greedy, chosen, QuantKVCache(
+            k=cache_k, v=cache_v, k_scale=scale_k, v_scale=scale_v), key
     return greedy, chosen, KVCache(k=cache_k, v=cache_v), key
 
 
@@ -539,8 +595,20 @@ class SpeculativeLane:
         else:
             shape = (draft_config.n_layers, engine.capacity, engine.max_len,
                      draft_config.kv_heads, draft_config.d_head)
-        cache = KVCache(k=jnp.zeros(shape, draft_config.dtype),
-                        v=jnp.zeros(shape, draft_config.dtype))
+        if engine._quant:
+            # the draft lane quantizes like the target lane: its own int8
+            # pages + scale side-arrays behind the SAME page tables, so the
+            # kv_quant capacity math covers both lanes' HBM equally
+            scale_shape = (draft_config.n_layers, shape[1],
+                           draft_config.kv_heads)
+            cache = QuantKVCache(
+                k=jnp.zeros(shape, jnp.int8),
+                v=jnp.zeros(shape, jnp.int8),
+                k_scale=jnp.zeros(scale_shape, jnp.float32),
+                v_scale=jnp.zeros(scale_shape, jnp.float32))
+        else:
+            cache = KVCache(k=jnp.zeros(shape, draft_config.dtype),
+                            v=jnp.zeros(shape, draft_config.dtype))
         self.params = draft_params
         if engine.mesh is not None:
             from jax.sharding import NamedSharding
@@ -559,7 +627,17 @@ class SpeculativeLane:
                     draft_params,
                     tree_shardings(engine.mesh, draft_params, rules))
             sharding = NamedSharding(engine.mesh, serving_cache_spec(rules))
-            cache = jax.device_put(cache, KVCache(k=sharding, v=sharding))
+            if engine._quant:
+                from ..parallel.mesh import serving_scale_spec
+
+                scale_sharding = NamedSharding(engine.mesh,
+                                               serving_scale_spec(rules))
+                cache = jax.device_put(cache, QuantKVCache(
+                    k=sharding, v=sharding,
+                    k_scale=scale_sharding, v_scale=scale_sharding))
+            else:
+                cache = jax.device_put(cache,
+                                       KVCache(k=sharding, v=sharding))
         self.cache = cache
 
     # -- fingerprints ------------------------------------------------------

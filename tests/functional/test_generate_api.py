@@ -37,6 +37,7 @@ def params():
 def engine(params):
     engine = SlotEngine(params, F32_TINY, slots=2, max_len=96,
                         queue_depth=2, max_new_tokens_cap=32,
+                        kv_quant="off",
                         max_concurrent_per_user=1)
     set_engine(engine)
     yield engine
@@ -234,6 +235,12 @@ def test_generate_stats_snapshot(api, pump, user_headers):
     # the attend dispatch the engine resolved from the paged_kernel knob
     # ("auto" off-TPU -> the XLA gather reference) — the KV badge renders it
     assert doc["pagedKernel"] == "xla"
+    # the int8-KV badge fields (docs/SERVING.md "Quantized KV pages"):
+    # the fixture pins kv_quant="off", the rollback shape — off, with the
+    # full-precision per-token byte cost still reported
+    assert doc["kvQuant"] == "off"
+    assert doc["kvBytesPerToken"] is not None
+    assert doc["kvBytesPerToken"] > 0
     # the speculative-lane badge fields (docs/SERVING.md "Speculative
     # decoding"): "auto" resolves off on the CPU backend, so the rollback
     # shape is what this fixture pins — off, no window depth, no rate

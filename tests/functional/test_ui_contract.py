@@ -228,6 +228,16 @@ def test_serving_strip_renders_spec_badge():
     assert "stats.specAcceptanceRate" in source
 
 
+def test_serving_strip_renders_quant_badge():
+    """The int8-KV badge (docs/SERVING.md "Quantized KV pages") must
+    render from the exact ``kvQuant``/``kvBytesPerToken`` fields
+    ``GET /generate/stats`` exports, and hide on the ``kv_quant=off``
+    rollback (which serves full-precision pages)."""
+    source = (STATIC_DIR / "js" / "nodes.js").read_text()
+    assert 'stats.kvQuant !== "on"' in source        # hidden on rollback
+    assert 'stats.kvBytesPerToken + " B/token"' in source
+
+
 def test_serving_strip_renders_draining_badge():
     """The drain badge + toggle (docs/ROBUSTNESS.md "Serving data plane")
     must render from the exact ``draining`` field ``GET /generate/stats``

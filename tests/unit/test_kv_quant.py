@@ -1,0 +1,436 @@
+"""Int8 KV pages tests (docs/SERVING.md "Quantized KV pages").
+
+The contract under test, layer by layer:
+
+* **Quantizer arithmetic** (ops/kv_quant.py): symmetric int8 round trips
+  inside the half-LSB bound, same-scale requantization is exactly
+  idempotent, the running max only grows mid-life, offset-0 writes rebase
+  it (the recycled-page determinism rule), and ``row_merge`` can never
+  scatter into a page the window did not write — the COW-safety property
+  the prefix cache's shared pages rely on.
+* **Engine semantics**: ``kv_quant`` resolves auto→on for paged layouts
+  and refuses contiguous; quant-on engines are deterministic, agree with
+  the f32 engine at the gated greedy match rate, never recompile across
+  page assignment + scale updates + recycling, and mint ``*_q``
+  fingerprints — while ``kv_quant=off`` is a fingerprint-identical
+  rollback that never traces a quant op.
+* **Interplay** (the satellite matrix): prefix-cache hit ≡ miss, slot
+  recycle ≡ fresh engine, the speculative lane, and the 2x2 mesh — each
+  parametrized over quant on/off, with the off arm pinned f32-exact
+  against ``decode.generate`` and the on arm pinned deterministic (int8
+  is lossy vs f32 but NEVER vs itself).
+* **Accounting**: equal-HBM pool sizing (kv_pages=0 converts the f32 byte
+  budget into ~4x int8 pages), the kv_bytes gauges, stats fields, and the
+  pool invariant under a seeded quant-on churn.
+"""
+import dataclasses
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorhive_tpu.models import decode
+from tensorhive_tpu.models.transformer import PRESETS, TransformerLM
+from tensorhive_tpu.ops import kv_quant as kvq
+from tensorhive_tpu.serving import QueueFullError
+from tensorhive_tpu.serving.engine import SlotEngine
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device CPU platform"
+)
+
+F32_TINY = dataclasses.replace(PRESETS["tiny"], dtype=jnp.float32,
+                               use_flash=False, remat=False, max_seq_len=128)
+#: 24 tokens — long enough that the random-init tiny model's greedy
+#: margins are not one-ULP ties on every step (tools/quant_smoke.py
+#: documents the short-prompt decorrelation effect)
+PROMPT = list(range(3, 27))
+NEW_TOKENS = 12
+#: deterministic greedy agreement on this image/seed is 1.0; the gate
+#: leaves margin for jax drift without accepting a broken quantizer
+MATCH_RATE_GATE = 0.75
+
+
+@pytest.fixture(scope="module")
+def params():
+    return TransformerLM.init(jax.random.PRNGKey(0), F32_TINY)
+
+
+def make_engine(params, **kwargs):
+    kwargs.setdefault("slots", 4)
+    kwargs.setdefault("max_len", 96)
+    kwargs.setdefault("queue_depth", 8)
+    return SlotEngine(params, F32_TINY, **kwargs)
+
+
+def drain(engine):
+    while engine.has_work():
+        engine.step()
+
+
+def run_one(engine, prompt=None, new_tokens=NEW_TOKENS):
+    handle = engine.submit(prompt or PROMPT, max_new_tokens=new_tokens)
+    drain(engine)
+    return handle.result(timeout_s=30)["tokens"]
+
+
+def reference_tokens(params, prompt, new_tokens):
+    out = decode.generate(params, F32_TINY,
+                          jnp.asarray([prompt], jnp.int32),
+                          max_new_tokens=new_tokens, temperature=0.0)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+# -- quantizer arithmetic ----------------------------------------------------
+
+def test_resolve_kv_quant():
+    assert kvq.resolve_kv_quant("auto", paged=True) == "on"
+    assert kvq.resolve_kv_quant("auto", paged=False) == "off"
+    assert kvq.resolve_kv_quant("off", paged=True) == "off"
+    assert kvq.resolve_kv_quant("on", paged=True) == "on"
+    with pytest.raises(ValueError):
+        kvq.resolve_kv_quant("on", paged=False)
+    with pytest.raises(ValueError):
+        kvq.resolve_kv_quant("maybe", paged=True)
+
+
+def test_step_write_roundtrip_and_idempotence():
+    pages = jnp.zeros((3, 4, 2, 8), jnp.int8)       # [P, ps, Hkv, Dh]
+    scales = jnp.zeros((3, 2), jnp.float32)
+    rng = np.random.default_rng(7)
+    vals = jnp.asarray(rng.normal(size=(1, 2, 8)), jnp.float32)
+    pages, scales = kvq.step_write(pages, scales,
+                                   jnp.asarray([1]), jnp.asarray([0]), vals)
+    deq = (np.asarray(pages[1, 0], np.float32)
+           * np.asarray(scales[1])[:, None])
+    # half-LSB bound: |x - dequant(quant(x))| <= scale / 2
+    bound = np.asarray(scales[1])[:, None] / 2 + 1e-7
+    assert np.all(np.abs(deq - np.asarray(vals[0])) <= bound)
+    # same values, same offset: bytes and scales must not drift
+    before_pages, before_scales = np.asarray(pages), np.asarray(scales)
+    pages, scales = kvq.step_write(pages, scales,
+                                   jnp.asarray([1]), jnp.asarray([0]), vals)
+    np.testing.assert_array_equal(before_pages, np.asarray(pages))
+    np.testing.assert_array_equal(before_scales, np.asarray(scales))
+
+
+def test_step_write_running_max_grows_and_offset0_rebases():
+    pages = jnp.zeros((2, 4, 1, 4), jnp.int8)
+    scales = jnp.zeros((2, 1), jnp.float32)
+    big = jnp.full((1, 1, 4), 100.0, jnp.float32)
+    small = jnp.full((1, 1, 4), 1.0, jnp.float32)
+    page, off0, off1 = jnp.asarray([1]), jnp.asarray([0]), jnp.asarray([1])
+    pages, scales = kvq.step_write(pages, scales, page, off0, big)
+    big_scale = float(scales[1, 0])
+    # a smaller mid-life write keeps the running max
+    pages, scales = kvq.step_write(pages, scales, page, off1, small)
+    assert float(scales[1, 0]) == big_scale
+    # ...but an offset-0 write begins a new life: the stale scale must not
+    # leak into the page's next owner (recycled == fresh determinism)
+    pages, scales = kvq.step_write(pages, scales, page, off0, small)
+    assert float(scales[1, 0]) == pytest.approx(1.0 / 127.0)
+
+
+def test_step_write_oob_page_drops():
+    pages = jnp.ones((2, 4, 1, 4), jnp.int8)
+    scales = jnp.ones((2, 1), jnp.float32)
+    out_pages, out_scales = kvq.step_write(
+        pages, scales, jnp.asarray([2]), jnp.asarray([0]),
+        jnp.full((1, 1, 4), 9.0, jnp.float32))
+    np.testing.assert_array_equal(np.asarray(pages), np.asarray(out_pages))
+    np.testing.assert_array_equal(np.asarray(scales),
+                                  np.asarray(out_scales))
+
+
+def test_row_merge_never_touches_unwritten_pages():
+    """The COW-safety property: a window whose writes all land in page 1
+    of the row must leave page 0 (a shared prefix page in real traffic)
+    byte-identical, scale included."""
+    rng = np.random.default_rng(3)
+    pages = jnp.asarray(rng.integers(-127, 128, (4, 4, 2, 8)), jnp.int8)
+    scales = jnp.asarray(rng.uniform(0.01, 0.1, (4, 2)), jnp.float32)
+    rows = jnp.asarray([[2, 1, 0, 0]])              # page 2 shared, 1 mine
+    vals = jnp.asarray(rng.normal(size=(1, 3, 2, 8)), jnp.float32)
+    logical = jnp.asarray([[4, 5, 6]])              # all inside row page 1
+    valid = jnp.ones((1, 3), bool)
+    out_pages, out_scales, ctx = kvq.row_merge(pages, scales, rows, vals,
+                                               logical, valid, jnp.float32)
+    np.testing.assert_array_equal(np.asarray(pages[2]),
+                                  np.asarray(out_pages[2]))
+    np.testing.assert_array_equal(np.asarray(scales[2]),
+                                  np.asarray(out_scales[2]))
+    # the written page changed and the ctx reflects exactly the stored
+    # post-write dequantization at the written positions
+    deq = (np.asarray(out_pages[1], np.float32)
+           * np.asarray(out_scales[1])[None, :, None])
+    np.testing.assert_allclose(np.asarray(ctx[0, 4:7]), deq[0:3],
+                               rtol=0, atol=1e-7)
+
+
+def test_row_merge_invalid_cells_do_not_write():
+    pages = jnp.zeros((3, 4, 1, 4), jnp.int8)
+    scales = jnp.zeros((3, 1), jnp.float32)
+    rows = jnp.asarray([[1, 2]])
+    vals = jnp.full((1, 2, 1, 4), 50.0, jnp.float32)
+    logical = jnp.asarray([[0, 4]])
+    valid = jnp.asarray([[False, False]])           # warmup shape: no-op
+    out_pages, out_scales, _ = kvq.row_merge(pages, scales, rows, vals,
+                                             logical, valid, jnp.float32)
+    np.testing.assert_array_equal(np.asarray(pages), np.asarray(out_pages))
+    np.testing.assert_array_equal(np.asarray(scales),
+                                  np.asarray(out_scales))
+
+
+def test_page_byte_accounting():
+    f32 = kvq.page_bytes(16, 4, 16, 4)
+    int8 = kvq.quant_page_bytes(16, 4, 16)
+    assert int8 < f32 // 3                  # ~4x minus the scale overhead
+    assert int8 == 2 * 16 * 4 * 16 + 2 * 4 * 4
+
+
+def test_sim_kv_loss_delta_is_small(params):
+    tokens = jax.random.randint(jax.random.PRNGKey(1), (2, 33), 0,
+                                F32_TINY.vocab_size)
+    ref = float(kvq.sim_kv_loss(params, F32_TINY, tokens, 16,
+                                quantized=False))
+    quant = float(kvq.sim_kv_loss(params, F32_TINY, tokens, 16,
+                                  quantized=True))
+    assert abs(quant - ref) / ref < 0.02    # the bench gate's bound
+
+
+# -- engine semantics --------------------------------------------------------
+
+def test_auto_on_for_paged_and_equal_hbm_pool(params):
+    quant = make_engine(params)             # kv_quant defaults to auto
+    f32 = make_engine(params, kv_quant="off")
+    assert quant.kv_quant == "on" and f32.kv_quant == "off"
+    stats_on, stats_off = quant.stats(), f32.stats()
+    assert stats_on["kvQuant"] == "on" and stats_off["kvQuant"] == "off"
+    assert stats_on["kvBytesPerToken"] < stats_off["kvBytesPerToken"] / 3
+    # kv_pages=0 converts the f32 byte budget into int8 pages: strictly
+    # more pages, never more bytes
+    assert quant._pool.num_pages > 3 * f32._pool.num_pages
+    assert (quant._pool.num_pages * quant._page_hbm_bytes
+            <= f32._pool.num_pages * f32._page_hbm_bytes)
+
+
+def test_contiguous_quant_on_refused(params):
+    with pytest.raises(ValueError, match="kv_quant=on needs the paged"):
+        make_engine(params, paged=False, kv_quant="on")
+    # auto quietly resolves off for the contiguous rollback layout
+    engine = make_engine(params, paged=False)
+    assert engine.kv_quant == "off"
+    assert engine.stats()["kvBytesPerToken"] is None
+
+
+def test_quant_greedy_match_rate_and_determinism(params):
+    f32_tokens = run_one(make_engine(params, kv_quant="off"))
+    assert f32_tokens == reference_tokens(params, PROMPT, NEW_TOKENS)
+    quant_tokens = run_one(make_engine(params))
+    matches = sum(a == b for a, b in zip(quant_tokens, f32_tokens))
+    assert matches / NEW_TOKENS >= MATCH_RATE_GATE
+    # int8 is lossy vs f32 but NEVER vs itself: a twin engine replays the
+    # identical stream
+    assert run_one(make_engine(params)) == quant_tokens
+
+
+def test_quant_zero_recompiles_across_assignment_and_recycling(params):
+    engine = make_engine(params, slots=2)
+    engine.warmup(prompt_lens=(len(PROMPT), 30))
+    steps = engine.step_executable._cache_size()
+    prefills = engine.prefill_executable._cache_size()
+    for offset in range(3):                 # fresh pages + recycled pages
+        run_one(engine, [5 + offset] * 30, 8)
+    cancelled = engine.submit([9] * 30, max_new_tokens=8)
+    cancelled.cancel()
+    drain(engine)
+    run_one(engine)
+    assert engine.step_executable._cache_size() == steps
+    assert engine.prefill_executable._cache_size() == prefills
+
+
+def test_quant_fingerprints_counted(params):
+    before = set(decode._compile_seen)
+    engine = make_engine(params, slots=3)   # fresh shape -> fresh tuples
+    engine.warmup(prompt_lens=(8,))
+    run_one(engine, [4, 5, 6], 2)
+    minted = {fingerprint[0] for fingerprint
+              in set(decode._compile_seen) - before}
+    assert "serving_paged_step_q" in minted
+    assert "serving_paged_chunk_prefill_q" in minted
+
+
+def test_quant_off_is_fingerprint_identical_rollback(params):
+    """kv_quant=off must never mint a *_q fingerprint and must dispatch
+    the untouched legacy executables — byte-identical PR 7-14 behavior
+    (the speculative=off pin, quant-shaped)."""
+    before = set(decode._compile_seen)
+    engine = make_engine(params, kv_quant="off")
+    engine.warmup(prompt_lens=(8,))
+    handle = engine.submit([1, 2, 3], max_new_tokens=3)
+    drain(engine)
+    assert handle.result(timeout_s=5)["outcome"] == "completed"
+    minted = set(decode._compile_seen) - before
+    assert not any(str(fingerprint[0]).endswith("_q")
+                   for fingerprint in minted)
+    assert isinstance(engine._cache, decode.KVCache)
+    assert engine.step_executable.__wrapped__.__name__ == "_paged_step_body"
+
+
+def test_kernel_dispatch_matches_gather_under_quant(params):
+    kernel = make_engine(params, paged_kernel="on")
+    gather = make_engine(params, paged_kernel="off")
+    assert kernel.stats()["pagedKernel"] == "pallas"
+    assert run_one(kernel) == run_one(gather)
+
+
+def test_bytes_gauges_track_pool(params):
+    from tensorhive_tpu.observability import get_registry
+
+    engine = make_engine(params, slots=2)
+
+    def gauge(name):
+        return get_registry().get(name).labels()._value
+
+    assert (gauge("tpuhive_generate_kv_bytes_capacity")
+            == engine._pool.num_pages * engine._page_hbm_bytes)
+    assert gauge("tpuhive_generate_kv_bytes_used") == 0
+    handle = engine.submit(PROMPT, max_new_tokens=4)
+    engine.step()
+    assert (gauge("tpuhive_generate_kv_bytes_used")
+            == engine._pool.used_pages * engine._page_hbm_bytes) \
+        and gauge("tpuhive_generate_kv_bytes_used") > 0
+    drain(engine)
+    assert handle.done
+    # prefix-cache retention keeps hit pages live; used tracks the pool
+    assert (gauge("tpuhive_generate_kv_bytes_used")
+            == engine._pool.used_pages * engine._page_hbm_bytes)
+
+
+# -- interplay matrix (the satellite suites, quant on/off) -------------------
+
+@pytest.mark.parametrize("kv_quant", ["on", "off"])
+def test_prefix_hit_matches_miss(params, kv_quant):
+    """A cache-hit request reads byte-for-byte what the miss stored
+    (quantized or not), so hit tokens == miss tokens exactly — the COW
+    copy-by-recompute plus, under int8, the dequant(stored) attend."""
+    engine = make_engine(params, kv_quant=kv_quant)
+    # 40 tokens: cacheable span 32 >= the default prefix_min_tokens, so
+    # the second identical prompt is a real tree hit
+    prompt = list(range(3, 43))
+    miss = run_one(engine, prompt)
+    assert engine.stats()["prefixMisses"] >= 1
+    hit = run_one(engine, prompt)
+    assert engine.stats()["prefixHits"] >= 1
+    assert hit == miss
+    if kv_quant == "off":
+        assert miss == reference_tokens(params, prompt, NEW_TOKENS)
+
+
+@pytest.mark.parametrize("kv_quant", ["on", "off"])
+def test_slot_recycle_matches_fresh_engine(params, kv_quant):
+    """Recycled pages must behave like fresh ones — under int8 that is
+    the offset-0 scale-rebase rule (a stale scale leaking into a page's
+    next owner would make output depend on allocation history)."""
+    churned = make_engine(params, slots=2, prefix_cache="off",
+                          kv_quant=kv_quant)
+    for offset in range(3):
+        run_one(churned, [5 + offset] * 30, 8)
+    cancelled = churned.submit([9] * 30, max_new_tokens=8)
+    cancelled.cancel()
+    drain(churned)
+    fresh = make_engine(params, slots=2, prefix_cache="off",
+                        kv_quant=kv_quant)
+    assert run_one(churned) == run_one(fresh)
+
+
+@pytest.mark.parametrize("kv_quant", ["on", "off"])
+def test_speculative_accept_rollback(params, kv_quant):
+    """The speculative lane over quantized pages: off stays token-exact vs
+    the non-speculative engine (the PR 13 identity); on is deterministic
+    and the acceptance machinery advances. (Under int8 the verify window's
+    page requantization grouping differs from the step path's, so spec-on
+    is NOT pinned identical to spec-off — docs/SERVING.md records the
+    caveat.)"""
+    spec = make_engine(params, speculative="on", spec_tokens=4,
+                       kv_quant=kv_quant)
+    tokens = run_one(spec)
+    assert len(tokens) == NEW_TOKENS
+    assert spec.stats()["specProposed"] > 0
+    if kv_quant == "off":
+        plain = make_engine(params, speculative="off", kv_quant="off")
+        assert tokens == run_one(plain)
+    else:
+        twin = make_engine(params, speculative="on", spec_tokens=4,
+                           kv_quant="on")
+        assert run_one(twin) == tokens
+
+
+@pytest.mark.parametrize("kv_quant", ["on", "off"])
+def test_mesh_2x2_matches_single_chip(params, kv_quant):
+    from tensorhive_tpu.parallel.mesh import serving_mesh
+
+    single = make_engine(params, kv_quant=kv_quant)
+    meshed = make_engine(params, kv_quant=kv_quant,
+                         mesh=serving_mesh(dp=2, tp=2))
+    single_tokens = run_one(single)
+    steps = meshed.step_executable._cache_size()
+    assert run_one(meshed) == single_tokens
+    run_one(meshed, [7] * 40, 6)            # second join: page reassignment
+    assert meshed.step_executable._cache_size() - steps <= 1  # first compile
+    if kv_quant == "on":
+        minted = {fingerprint[0] for fingerprint in decode._compile_seen}
+        assert "serving_mesh_paged_step_q" in minted
+
+
+def test_seeded_churn_quant_on_preserves_pool_invariant(params):
+    """The satellite churn: seeded joins (shared/divergent prompts),
+    completions and cancels through a quant-on prefix-cache engine —
+    free + live == pool_size after every scheduler tick, with live
+    covering both slot grants and cache retention (the PR 11 invariant,
+    int8 pages under it)."""
+    rng = random.Random(99)
+    engine = make_engine(params, slots=3, queue_depth=6)
+    pool = engine._pool
+    base = PROMPT
+    handles = []
+    for step in range(120):
+        roll = rng.random()
+        if roll < 0.4:
+            cut = rng.choice((8, 16))
+            prompt = base[:cut] + [rng.randrange(200, 400)
+                                   for _ in range(rng.randrange(1, 8))]
+            try:
+                handles.append(engine.submit(
+                    prompt, max_new_tokens=rng.randrange(1, 8)))
+            except QueueFullError:
+                pass                        # queue full: fine, keep churning
+        elif roll < 0.5 and handles:
+            handles.pop(rng.randrange(len(handles))).cancel()
+        engine.step()
+        assert pool.free_pages + pool.live_pages == pool.num_pages
+    drain(engine)
+    assert pool.free_pages + pool.live_pages == pool.num_pages
+    for handle in handles:
+        assert handle.done
+
+
+# -- config plumbing ---------------------------------------------------------
+
+def test_build_engine_wires_kv_quant(tmp_path):
+    from tensorhive_tpu.config import Config
+    from tensorhive_tpu.core.services.generation import build_engine
+
+    config = Config(config_dir=tmp_path)
+    config.generation.enabled = True
+    config.generation.preset = "tiny"
+    config.generation.slots = 2
+    config.generation.max_len = 64
+    config.generation.use_flash = False
+    config.generation.speculative = "off"
+    config.generation.kv_quant = "off"
+    assert build_engine(config).stats()["kvQuant"] == "off"
+    config.generation.kv_quant = "on"
+    assert build_engine(config).stats()["kvQuant"] == "on"

@@ -64,6 +64,9 @@ def make_engine(params, clock=None, **kwargs):
     kwargs.setdefault("slots", 2)
     kwargs.setdefault("max_len", 96)
     kwargs.setdefault("queue_depth", 8)
+    # legacy exactness suites pin the f32 cache; kv_quant coverage
+    # lives in tests/unit/test_kv_quant.py
+    kwargs.setdefault("kv_quant", "off")
     return SlotEngine(params, F32_TINY, clock=clock or FakeClock(),
                       **kwargs)
 

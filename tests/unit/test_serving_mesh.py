@@ -54,6 +54,9 @@ def make_engine(params, dp=1, tp=1, config=F32_TINY, **kwargs):
     kwargs.setdefault("max_len", 96)
     kwargs.setdefault("queue_depth", 8)
     mesh = serving_mesh(dp=dp, tp=tp) if dp * tp > 1 else None
+    # legacy exactness suites pin the f32 cache; kv_quant coverage
+    # lives in tests/unit/test_kv_quant.py
+    kwargs.setdefault("kv_quant", "off")
     return SlotEngine(params, config, mesh=mesh, **kwargs)
 
 

@@ -53,6 +53,9 @@ def make_engine(params, **kwargs):
     kwargs.setdefault("max_len", 96)
     kwargs.setdefault("queue_depth", 8)
     kwargs.setdefault("speculative", "on")
+    # legacy exactness suites pin the f32 cache; kv_quant coverage
+    # lives in tests/unit/test_kv_quant.py
+    kwargs.setdefault("kv_quant", "off")
     return SlotEngine(params, F32_TINY, **kwargs)
 
 

@@ -90,7 +90,8 @@ def main() -> int:
     knob_tokens = {}
     for knob in ("on", "off", "auto"):
         engine = SlotEngine(f32_params, f32_config, slots=2, max_len=64,
-                            queue_depth=4, page_size=16, paged_kernel=knob)
+                            queue_depth=4, page_size=16, paged_kernel=knob,
+                            kv_quant="off")
         dispatch = engine.stats()["pagedKernel"]
         if dispatch != expected_dispatch[knob]:
             failures.append(

@@ -85,7 +85,7 @@ def main() -> int:
     # -- 1: hit-path TTFT < miss-path TTFT at equal tokens -----------------
     engine = SlotEngine(params, config, slots=4, max_len=MAX_LEN,
                         queue_depth=2 * FANIN, page_size=PAGE_SIZE,
-                        prefill_chunk_tokens=64, speculative="off")
+                        prefill_chunk_tokens=64, speculative="off", kv_quant="off")
     engine.warmup(prompt_lens=(SYSTEM_TOKENS + 1,))
     step_execs = engine.step_executable._cache_size()
     prefill_execs = engine.prefill_executable._cache_size()
@@ -118,7 +118,7 @@ def main() -> int:
     prefix_pool = SlotEngine(params, config, slots=FANIN, max_len=MAX_LEN,
                              queue_depth=2 * FANIN, page_size=PAGE_SIZE,
                              kv_pages=EQUAL_HBM_PAGES,
-                             prefill_chunk_tokens=64, speculative="off")
+                             prefill_chunk_tokens=64, speculative="off", kv_quant="off")
     prefix_pool.warmup(prompt_lens=(SYSTEM_TOKENS + 1,))
     pool_step_execs = prefix_pool.step_executable._cache_size()
     pool_prefill_execs = prefix_pool.prefill_executable._cache_size()
@@ -139,7 +139,7 @@ def main() -> int:
 
     contiguous = SlotEngine(params, config, slots=CONTIG_SLOTS,
                             max_len=MAX_LEN, queue_depth=2 * FANIN,
-                            paged=False, speculative="off")
+                            paged=False, speculative="off", kv_quant="off")
     contiguous.warmup(prompt_lens=(SYSTEM_TOKENS + 1,))
     contig_handles = [contiguous.submit(system + [20 + i],
                                         max_new_tokens=NEW_TOKENS)
@@ -166,7 +166,7 @@ def main() -> int:
     # its join stalls the tick by one whole-prompt prefill
     rollback = SlotEngine(params, config, slots=2, max_len=MAX_LEN,
                           queue_depth=4, page_size=PAGE_SIZE,
-                          prefix_cache="off", speculative="off")
+                          prefix_cache="off", speculative="off", kv_quant="off")
     rollback.warmup(prompt_lens=(SYSTEM_TOKENS + 1,))
     runner = rollback.submit([5, 6, 7], max_new_tokens=40)
     rollback.step()
@@ -181,7 +181,7 @@ def main() -> int:
 
     chunked = SlotEngine(params, config, slots=2, max_len=MAX_LEN,
                          queue_depth=4, page_size=PAGE_SIZE,
-                         prefill_chunk_tokens=16, speculative="off")
+                         prefill_chunk_tokens=16, speculative="off", kv_quant="off")
     chunked.warmup(prompt_lens=(SYSTEM_TOKENS + 1,))
     runner = chunked.submit([5, 6, 7], max_new_tokens=40)
     chunked.step()

@@ -164,7 +164,7 @@ def main() -> int:
 
     def factory():
         engine = SlotEngine(params, f32_tiny, slots=2, max_len=96,
-                            queue_depth=4,
+                            queue_depth=4, kv_quant="off",
                             default_deadline_s=DEADLINE_S,
                             fault_plan=plan)
         engine.warmup(prompt_lens=(len(PROMPT),))

@@ -62,6 +62,7 @@ def serving_config(mesh_dp: int, mesh_tp: int) -> Config:
     cfg.generation.use_flash = False
     # legacy mesh contracts measure sharding, never speculation
     cfg.generation.speculative = "off"
+    cfg.generation.kv_quant = "off"
     return cfg
 
 

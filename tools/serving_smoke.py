@@ -93,7 +93,7 @@ def main() -> int:
     # kernel dispatch with distinct prompts)
     engine = SlotEngine(params, config, slots=SLOTS, max_len=MAX_LEN,
                         queue_depth=SLOTS, max_new_tokens_cap=64,
-                        prefix_cache="off", speculative="off")
+                        prefix_cache="off", speculative="off", kv_quant="off")
     engine.warmup(prompt_lens=PROMPT_LENS)
 
     def prompts():
@@ -173,7 +173,7 @@ def main() -> int:
     paged = SlotEngine(params, config, slots=SLOTS, max_len=MAX_LEN,
                        queue_depth=LONG_REQUESTS, paged=True,
                        page_size=PAGE_SIZE, kv_pages=OVERCOMMIT_PAGES,
-                       paged_kernel="on", speculative="off")
+                       paged_kernel="on", speculative="off", kv_quant="off")
     if paged.stats()["pagedKernel"] != "pallas":
         failures.append("paged_kernel='on' did not dispatch the pallas "
                         "kernel — scenario 5 must exercise the fused path")
@@ -195,7 +195,7 @@ def main() -> int:
 
     contiguous = SlotEngine(params, config, slots=CONTIG_SLOTS,
                             max_len=MAX_LEN, queue_depth=LONG_REQUESTS,
-                            paged=False, speculative="off")
+                            paged=False, speculative="off", kv_quant="off")
     contiguous.warmup(prompt_lens=(LONG_PROMPT,))
     contiguous_handles = [contiguous.submit(prompt,
                                             max_new_tokens=LONG_NEW)

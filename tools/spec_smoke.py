@@ -120,7 +120,7 @@ def main() -> int:
     def build(speculative: str) -> SlotEngine:
         engine = SlotEngine(params, f32_tiny, slots=2, max_len=96,
                             queue_depth=4, speculative=speculative,
-                            spec_tokens=SPEC_TOKENS)
+                            kv_quant="off", spec_tokens=SPEC_TOKENS)
         engine.warmup(prompt_lens=(len(PROMPT),))
         return engine
 

@@ -74,6 +74,7 @@ def main() -> int:
     config.generation.queue_depth = 4
     config.generation.max_len = 96
     config.generation.interval_s = 0.01
+    config.generation.kv_quant = "off"
     config.profiling.enabled = True
     config.profiling.artifact_dir = str(Path(workdir) / "profiles")
     set_config(config)
