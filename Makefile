@@ -2,7 +2,7 @@
 # mypy + flake8 per .circleci/config.yml:33-38): the dependency-free AST
 # lint + thivelint analyzer always run; mypy/ruff run when installed
 # (absent from this image).
-.PHONY: check lint analysis analysis-fast test bench probe metrics-smoke decode-smoke alerts-smoke chaos-smoke serving-smoke serving-mesh-smoke trace-smoke prefix-smoke spec-smoke serving-chaos-smoke quant-smoke
+.PHONY: check lint analysis analysis-fast test bench probe metrics-smoke decode-smoke alerts-smoke chaos-smoke serving-smoke serving-mesh-smoke trace-smoke prefix-smoke spec-smoke serving-chaos-smoke quant-smoke history-smoke
 
 check: lint analysis
 	@command -v ruff >/dev/null 2>&1 && ruff check . || echo "ruff not installed; skipped (tools/lint.py covered the always-on subset)"
@@ -112,6 +112,15 @@ serving-chaos-smoke:
 # assignment + scale updates, kv_bytes gauges scrapeable
 quant-smoke:
 	python tools/quant_smoke.py
+
+# time-aware telemetry over a real socket (docs/OBSERVABILITY.md "History,
+# SLOs & flight recorder"): a 0.05 s HistoryService must land >= 2
+# queue-depth samples served by /api/admin/history, the SLO engine must
+# export a tpuhive_slo_burn_rate gauge once traffic flowed, the live
+# flightrec ring must stamp the served work, and one injected fatal must
+# leave exactly one crash dump whose last tick shows the fault
+history-smoke:
+	python tools/history_smoke.py
 
 probe:
 	$(MAKE) -C tensorhive_tpu/native

@@ -925,6 +925,123 @@ def bench_generate_serving():
         service.shutdown()
         _serving.set_engine(None)
     _log(f"  fault_recovery: {fault_block}")
+
+    # observability overhead (docs/OBSERVABILITY.md "History, SLOs &
+    # flight recorder"): the telemetry tax. Same batched storm with the
+    # flight recorder stamping every tick AND the history store sampled
+    # at an aggressive cadence vs both off — the on-path must cost <= 2%
+    # tokens/s (best-of-3 per variant tames CPU noise), the recorder must
+    # land exactly one ring write per tick, and the history store must
+    # stay inside its series x max_points memory bound.
+    from tensorhive_tpu.observability.history import (
+        MetricsHistory as _History,
+        default_series as _default_series,
+    )
+    from tensorhive_tpu.serving.flight_recorder import FlightRecorder
+
+    # 0.25 s sampling is still 20x the production default (5 s)
+    obs_block = {"pairs": 5, "history_sample_interval_s": 0.25}
+    result["observability_overhead"] = obs_block
+    obs_history = _History(_default_series(fault_config.generation),
+                           retention_s=3600.0, max_points=720)
+    obs_engine = SlotEngine(params, config, slots=slots, max_len=max_len,
+                            queue_depth=2 * slots, page_size=page_size,
+                            prefix_cache="off", speculative="off",
+                            kv_quant="off")
+    obs_engine.warmup(prompt_lens=prompt_lens)
+    obs_recorder = FlightRecorder(capacity=4096)
+
+    def telemetry_storm(recorder):
+        """One batched storm on the SHARED warm engine (the recorder is a
+        plain attribute, so on/off swaps measure instrumentation, not
+        engine construction); instrumented storms also run a sampler
+        THREAD — the production architecture (HistoryService is its own
+        daemon), so the pump path pays the recorder writes plus the
+        sampler's GIL share, never inline registry scans."""
+        obs_engine.flight_recorder = recorder
+        stop = threading.Event()
+        worker = None
+        if recorder is not None:
+            def sampler():
+                while not stop.is_set():
+                    obs_history.sample()
+                    stop.wait(obs_block["history_sample_interval_s"])
+
+            worker = threading.Thread(target=sampler, daemon=True)
+            worker.start()
+        ticks = 0
+        started = time.perf_counter()
+        handles = [obs_engine.submit(prompt, max_new_tokens=new_tokens)
+                   for prompt in prompts()]
+        while obs_engine.has_work():
+            obs_engine.step()
+            ticks += 1
+        elapsed = time.perf_counter() - started
+        if worker is not None:
+            stop.set()
+            worker.join(timeout=5)
+        assert all(handle.done for handle in handles)
+        return total_tokens / elapsed, ticks
+
+    # paired storms with alternating order + median-of-pairs: shared-CPU
+    # noise is several percent run to run, far above the recorder's true
+    # cost, so a best-of-N difference would gate on the scheduler's mood
+    telemetry_storm(None)                        # warm lap, discarded
+    off_best = on_best = 0.0
+    instrumented_ticks = 0
+    paired = []
+    for pair in range(obs_block["pairs"]):
+        first_on = bool(pair % 2)
+        for on_now in (first_on, not first_on):
+            tps, ticks = telemetry_storm(obs_recorder if on_now else None)
+            if on_now:
+                on_best = max(on_best, tps)
+                on_tps = tps
+                instrumented_ticks += ticks
+            else:
+                off_best = max(off_best, tps)
+                off_tps = tps
+        paired.append(1.0 - on_tps / off_tps)
+    paired.sort()
+    measured = paired[len(paired) // 2]
+
+    # the deterministic gate: per-tick record() cost against the mean tick
+    # the ring itself measured, plus the sampler's duty cycle — the two
+    # real taxes, free of storm-to-storm noise
+    scratch = FlightRecorder(capacity=1024)
+    started = time.perf_counter()
+    for _ in range(1000):
+        scratch.record(duration_s=0.001, admitted=1, decode_slots=8,
+                       slots_busy=8, queue_depth=2, pages_free=4)
+    record_cost_s = (time.perf_counter() - started) / 1000
+    started = time.perf_counter()
+    for _ in range(20):
+        obs_history.sample()
+    sample_cost_s = (time.perf_counter() - started) / 20
+    ticks_recorded = obs_recorder.snapshot()
+    mean_tick_s = (sum(t["durationS"] for t in ticks_recorded)
+                   / len(ticks_recorded))
+    instrumentation = (record_cost_s / mean_tick_s
+                       + sample_cost_s / obs_block["history_sample_interval_s"])
+    obs_block.update({
+        "tokens_per_sec_off": round(off_best, 1),
+        "tokens_per_sec_on": round(on_best, 1),
+        "measured_overhead_pct": round(100.0 * measured, 2),
+        "record_cost_us": round(1e6 * record_cost_s, 2),
+        "sample_cost_us": round(1e6 * sample_cost_s, 2),
+        "mean_tick_ms": round(1e3 * mean_tick_s, 3),
+        "instrumentation_cost_pct": round(100.0 * instrumentation, 3),
+        "overhead_within_gate": bool(instrumentation <= 0.02),
+        "recorder_writes_per_tick": round(
+            obs_recorder.recorded / instrumented_ticks, 4),
+        "history_points_retained": obs_history.points_retained(),
+        "history_points_bound":
+            len(obs_history.series_names()) * obs_history.max_points,
+        "history_within_bound": bool(
+            obs_history.points_retained()
+            <= len(obs_history.series_names()) * obs_history.max_points),
+    })
+    _log(f"  observability_overhead: {obs_block}")
     return result
 
 

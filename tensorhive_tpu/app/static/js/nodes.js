@@ -93,7 +93,9 @@ function renderNodes(main) {
   const refresh = async () => {
     try {
       if (isAdmin()) {
-        refreshServiceHealth(); refreshAlerts(); refreshRecentRequests();
+        refreshAlerts(); refreshRecentRequests();
+        await refreshHistory();       // sparkline data for the strips below
+        refreshServiceHealth();
       }
       refreshServing();
       const infra = await api("/nodes/metrics");
@@ -113,6 +115,37 @@ function renderNodes(main) {
   };
   refresh();
   state.timers.push(setInterval(refresh, NODES_POLL_MS));
+}
+
+/* server-side metrics history (admin, GET /admin/history): downsampled
+   min/mean/max windows from the in-process ring TSDB — unlike the
+   client-side chipHistory ring above, these survive page reloads and
+   cover the whole retention window (docs/OBSERVABILITY.md "History,
+   SLOs & flight recorder") */
+const HISTORY_SERIES = [
+  "tpuhive_generate_queue_depth",
+  "tpuhive_generate_slots_busy",
+  "tpuhive_process_resident_memory_bytes",
+];
+let metricsHistory = {};                      // series -> [window points]
+
+async function refreshHistory() {
+  try {
+    const doc = await api("/admin/history?series=" +
+                          encodeURIComponent(HISTORY_SERIES.join(",")));
+    metricsHistory = doc.series || {};
+  } catch (e) { metricsHistory = {}; }  // [history] disabled (404) or down
+}
+
+/* one series from the store as a sparkline, peak-normalized (sparkline()
+   clamps to 0-100); empty until two windows exist so strips never show a
+   meaningless single-point line */
+function historySpark(name, cls, title) {
+  const points = metricsHistory[name] || [];
+  if (points.length < 2) return "";
+  const peak = Math.max(...points.map(p => p.max), 1e-9);
+  return `<span class="spark-wrap" title="${esc(title)} · peak ${peak}">
+    ${sparkline(points.map(p => (100 * p.mean) / peak), cls)}</span>`;
 }
 
 /* daemon service health strip (admin): tick p50/p95/max + liveness per
@@ -149,6 +182,8 @@ async function refreshServiceHealth() {
   el.innerHTML = `<div class="card"><div class="row">
     <h3 style="margin:0">Services</h3>
     ${services.map(svcBadge).join("")}
+    ${historySpark("tpuhive_process_resident_memory_bytes", "hbm",
+                   "manager RSS over the history window")}
     <button class="ghost" onclick="openTracesDialog()">traces</button>
     <button class="ghost" onclick="captureProfile()"
       title="capture a jax.profiler trace to the artifact dir (404 while [profiling] is disabled)">profile</button>
@@ -246,8 +281,12 @@ async function refreshServing() {
       servingBadge("draining", "admission closed", true)}
     ${servingBadge("queue", stats.queueDepth + "/" + stats.queueCapacity,
                    stats.queueDepth >= stats.queueCapacity)}
+    ${historySpark("tpuhive_generate_queue_depth", "",
+                   "queue depth over the history window")}
     ${servingBadge("slots", stats.slotsBusy + "/" + stats.slots,
                    stats.slotsBusy >= stats.slots && stats.queueDepth > 0)}
+    ${historySpark("tpuhive_generate_slots_busy", "",
+                   "busy slots over the history window")}
     ${stats.numDevices <= 1 ? "" :
       servingBadge("mesh " + stats.meshShape,
                    stats.numDevices + " devices", false)}
@@ -281,7 +320,50 @@ async function refreshServing() {
       ${stats.draining ? "resume" : "drain"}</button>
     <button class="ghost" onclick="probeGenerate()"
       title="stream a tiny generation through POST /generate">probe</button>
+    ${!isAdmin() ? "" : `<button class="ghost" onclick="openFlightRecorder()"
+      title="per-tick engine black box + crash dumps from fatal faults
+             (404 while flight_recorder is disabled)">flight rec</button>`}
   </div></div>`;
+}
+
+/* flight recorder drilldown (admin; docs/OBSERVABILITY.md "History, SLOs
+   & flight recorder"): the live per-tick ring the engine stamps, plus the
+   crash dumps the supervisor wrote on fatal classifications — the
+   post-mortem view that outlives the engine itself */
+async function openFlightRecorder() {
+  let ring, dumps = [];
+  try { ring = await api("/admin/flightrec?limit=40"); }
+  catch (e) { return toast(e.message, true); }   // 404 = recorder off
+  try { dumps = (await api("/admin/flightrec/dumps")).dumps || []; }
+  catch (e) {}
+  const dialog = document.getElementById("chip-dialog");
+  if (!dialog) return;
+  delete dialog.dataset.uid;
+  dialog.innerHTML = `<h3 style="margin-top:0">Flight recorder</h3>
+    <p class="muted">${ring.engineUp
+      ? ring.recorded + " ticks recorded · ring capacity " + ring.capacity
+      : "engine down — live ring unavailable; crash dumps below"}</p>
+    ${(ring.ticks || []).length ? `<table>
+      <tr><th>tick</th><th>ms</th><th>admit</th><th>chunks</th><th>decode</th>
+        <th>busy</th><th>queue</th><th>pages</th><th>compiles</th><th>faults</th></tr>
+      ${ring.ticks.slice().reverse().map(t => `<tr><td>${t.tick}</td>
+        <td>${(1000 * t.durationS).toFixed(2)}</td><td>${t.admitted}</td>
+        <td>${t.prefillChunks}</td><td>${t.decodeSlots}</td>
+        <td>${t.slotsBusy}</td><td>${t.queueDepth}</td><td>${t.pagesFree}</td>
+        <td>${t.compiles}</td>
+        <td>${t.faults ? "⚠ " + t.faults : 0}</td></tr>`).join("")}
+    </table>` : ""}
+    <h4 style="margin-bottom:.3rem">Crash dumps</h4>
+    ${dumps.length ? `<table>
+      <tr><th>file</th><th>reason</th><th>ticks</th><th>in flight</th></tr>
+      ${dumps.map(d => `<tr><td class="kv">${esc(d.file)}</td>
+        <td>${esc(d.reason || "")}</td><td>${d.ticks}</td>
+        <td>${d.inFlight}</td></tr>`).join("")}</table>`
+      : '<p class="muted">none — no fatal engine faults recorded</p>'}
+    <div class="row" style="margin-top:.8rem">
+      <button class="ghost" onclick="this.closest('dialog').close()">Close</button>
+    </div>`;
+  dialog.showModal();
 }
 
 /* graceful drain / resume (admin; docs/ROBUSTNESS.md "Serving data

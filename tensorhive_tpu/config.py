@@ -268,6 +268,41 @@ class GenerationConfig:
                                      # requests get this long to finish
                                      # before being failed fast with a
                                      # terminal chunk
+    # -- flight recorder (docs/OBSERVABILITY.md "History, SLOs & flight
+    # recorder"): per-tick black box + crash dumps on fatal classification
+    flight_recorder: bool = True     # false = byte-identical rollback (no
+                                     # ring, no dumps, step() untouched)
+    flightrec_ticks: int = 512       # bounded per-tick ring capacity
+    flightrec_dumps: int = 8         # crash dumps kept under
+                                     # {config_dir}/flightrec before pruning
+
+
+@dataclasses.dataclass
+class HistoryConfig:
+    """In-process metrics history (docs/OBSERVABILITY.md "History, SLOs &
+    flight recorder"). The HistoryService samples an allowlist of registry
+    series into a fixed-memory ring; memory is bounded by ``max_points``
+    windows per series regardless of ``retention_s``. When disabled the
+    service never starts and ``/api/admin/history`` answers 404."""
+    enabled: bool = True
+    sample_interval_s: float = 5.0   # HistoryService tick
+    retention_s: float = 3600.0      # lookback served by /api/admin/history
+    max_points: int = 720            # downsample windows per series; window
+                                     # width = retention_s / max_points
+    series: str = ""                 # comma-separated series specs replacing
+                                     # the shipped allowlist ("" = default)
+
+
+@dataclasses.dataclass
+class SloConfig:
+    """SLO objectives + burn-rate evaluation (docs/OBSERVABILITY.md
+    "History, SLOs & flight recorder"). Evaluated off the history store;
+    disabled = the ``tpuhive_slo_*`` gauges never appear and the burn-rate
+    alert rules stay quiet (source None)."""
+    enabled: bool = True
+    budget_window_s: float = 3600.0  # window error budget is measured over
+    availability_target: float = 0.999  # availability objective target
+    latency_target: float = 0.99     # queue_wait / ttft objective target
 
 
 @dataclasses.dataclass
@@ -350,6 +385,8 @@ class Config:
     job_scheduling: JobSchedulingConfig = dataclasses.field(default_factory=JobSchedulingConfig)
     alerting: AlertingConfig = dataclasses.field(default_factory=AlertingConfig)
     generation: GenerationConfig = dataclasses.field(default_factory=GenerationConfig)
+    history: HistoryConfig = dataclasses.field(default_factory=HistoryConfig)
+    slo: SloConfig = dataclasses.field(default_factory=SloConfig)
     profiling: ProfilingConfig = dataclasses.field(default_factory=ProfilingConfig)
     ssh: SshConfig = dataclasses.field(default_factory=SshConfig)
     hosts: Dict[str, HostConfig] = dataclasses.field(default_factory=dict)
@@ -373,6 +410,11 @@ class Config:
             config_dir=str(self.config_dir)))
 
     @property
+    def flightrec_dir(self) -> Path:
+        """Where the supervisor writes flight-recorder crash dumps."""
+        return Path(self.config_dir) / "flightrec"
+
+    @property
     def slices(self) -> Dict[str, List[HostConfig]]:
         """Group hosts by slice label, ordered by worker_index."""
         groups: Dict[str, List[HostConfig]] = {}
@@ -394,6 +436,8 @@ _SECTION_MAP = {
     "job_scheduling_service": "job_scheduling",
     "alerting_service": "alerting",
     "generation_service": "generation",
+    "history": "history",
+    "slo": "slo",
     "profiling": "profiling",
     "ssh": "ssh",
 }
@@ -536,6 +580,26 @@ enabled = false
 # ttft_slo_s = 2.0
 # queue_wait_slo_s = 1.0
 # request_ledger_size = 256   # GET /api/admin/requests ring bound
+# flight_recorder = true      # per-tick black box + crash dumps on fatal
+# flightrec_ticks = 512       # bounded tick-ring capacity
+# flightrec_dumps = 8         # crash dumps kept in {{config_dir}}/flightrec
+
+[history]
+# in-process metrics history ring (docs/OBSERVABILITY.md "History, SLOs &
+# flight recorder"); GET /api/admin/history answers 404 while disabled
+enabled = true
+# sample_interval_s = 5.0
+# retention_s = 3600.0
+# max_points = 720      # memory bound per series, independent of retention
+# series = ""           # comma-separated allowlist ("" = shipped default)
+
+[slo]
+# burn-rate SLO engine over the history store; disabled = no
+# tpuhive_slo_* gauges and the slo_burn_* alert rules stay quiet
+enabled = true
+# budget_window_s = 3600.0
+# availability_target = 0.999
+# latency_target = 0.99
 
 [profiling]
 # on-demand jax.profiler captures via POST /api/admin/profile and the

@@ -306,3 +306,182 @@ def get_alerts(context: RequestContext) -> Dict:
     value, the firing subset, and the bounded transition history ring —
     the same state the `tpuhive_alerts_firing` gauges export."""
     return get_alert_engine().dump()
+
+
+def _history_config():
+    """The [history] config, or a 404 while the subsystem is disabled —
+    same contract as the profiling endpoints: a surface the operator
+    turned off does not exist."""
+    from ..config import get_config
+
+    config = get_config()
+    if not config.history.enabled:
+        raise NotFoundError(
+            "metrics history is disabled on this manager ([history] "
+            "enabled in config.toml; docs/OBSERVABILITY.md)")
+    return config
+
+
+def _float_arg(context: RequestContext, name: str):
+    raw = context.request.args.get(name)
+    if raw is None or raw == "":
+        return None
+    try:
+        return float(raw)
+    except ValueError:
+        raise ValidationError(f"query param {name!r} must be a number, "
+                              f"got {raw!r}")
+
+
+HISTORY_POINT_SCHEMA = obj(
+    required=["ts", "min", "mean", "max", "last", "count"],
+    ts=s("number"),
+    min=s("number"),
+    mean=s("number"),
+    max=s("number"),
+    last=s("number"),
+    count=s("integer"),
+)
+
+
+@route("/admin/history", ["GET"], auth="admin",
+       summary="Downsampled metrics history (ring TSDB over the registry)",
+       tag="observability",
+       query={"series": s("string"), "since": s("number"),
+              "step": s("number")},
+       responses={200: obj(required=["retentionS", "windowS", "series"],
+                           retentionS=s("number"),
+                           windowS=s("number"),
+                           sampleIntervalS=s("number"),
+                           series={"type": "object",
+                                   "additionalProperties": True}),
+                  404: obj(required=["msg"], msg=s("string"))})
+def get_history(context: RequestContext) -> Dict:
+    """Per-series min/mean/max/last windows oldest-first from the
+    in-process history store (docs/OBSERVABILITY.md "History, SLOs &
+    flight recorder"). ``?series=`` is a comma-separated allowlist-spec
+    filter (default: everything sampled), ``?since=`` a unix-seconds
+    floor, ``?step=`` re-buckets into coarser windows. 404 while
+    ``[history]`` is disabled."""
+    from ..observability.history import get_metrics_history
+
+    config = _history_config()
+    raw = context.request.args.get("series")
+    series = None
+    if raw:
+        series = [part.strip() for part in raw.split(",") if part.strip()]
+    history = get_metrics_history()
+    try:
+        data = history.query(series=series,
+                             since=_float_arg(context, "since"),
+                             step=_float_arg(context, "step"))
+    except ValueError as exc:
+        raise ValidationError(str(exc))
+    return {
+        "retentionS": history.retention_s,
+        "windowS": history.window_s,
+        "sampleIntervalS": config.history.sample_interval_s,
+        "series": data,
+    }
+
+
+FLIGHTREC_TICK_SCHEMA = obj(
+    required=["tick", "ts", "durationS"],
+    tick=s("integer"),
+    ts=s("number"),
+    durationS=s("number"),
+    admitted=s("integer"),
+    prefillChunks=s("integer"),
+    decodeSlots=s("integer"),
+    slotsBusy=s("integer"),
+    queueDepth=s("integer"),
+    pagesFree=s("integer"),
+    compiles=s("integer"),
+    faults=s("integer"),
+)
+
+
+def _flightrec_enabled():
+    """404 while the flight recorder is configured off — the live-ring and
+    dump endpoints describe a subsystem that does not exist then."""
+    from ..config import get_config
+
+    config = get_config()
+    if not config.generation.flight_recorder:
+        raise NotFoundError(
+            "the serving flight recorder is disabled on this manager "
+            "([generation_service] flight_recorder in config.toml; "
+            "docs/OBSERVABILITY.md)")
+    return config
+
+
+@route("/admin/flightrec", ["GET"], auth="admin",
+       summary="Live per-tick flight-recorder ring of the serving engine",
+       tag="observability",
+       query={"limit": s("integer")},
+       responses={200: obj(required=["engineUp", "capacity", "recorded",
+                                     "ticks"],
+                           engineUp=s("boolean"),
+                           capacity=s("integer"),
+                           recorded=s("integer"),
+                           ticks=arr(FLIGHTREC_TICK_SCHEMA)),
+                  404: obj(required=["msg"], msg=s("string"))})
+def get_flightrec(context: RequestContext) -> Dict:
+    """The engine's in-memory tick ring oldest-first (``?limit=`` keeps
+    the newest N); ``engineUp=false`` with an empty ring while no engine
+    is published (crashed or serving disabled) — the post-mortem for that
+    case is ``GET /api/admin/flightrec/dumps``. 404 while
+    ``flight_recorder`` is configured off."""
+    from ..serving import get_engine
+
+    _flightrec_enabled()
+    engine = get_engine()
+    recorder = getattr(engine, "flight_recorder", None)
+    if recorder is None:
+        return {"engineUp": False, "capacity": 0, "recorded": 0,
+                "ticks": []}
+    return {
+        "engineUp": True,
+        "capacity": recorder.capacity,
+        "recorded": recorder.recorded,
+        "ticks": recorder.snapshot(int_arg(context, "limit")),
+    }
+
+
+@route("/admin/flightrec/dumps", ["GET"], auth="admin",
+       summary="Flight-recorder crash dumps written on fatal engine faults",
+       tag="observability",
+       query={"file": s("string")},
+       responses={200: obj(dumps=arr(obj(
+                               required=["file"],
+                               file=s("string"),
+                               writtenTs=s("number"),
+                               reason=s("string"),
+                               ticks=s("integer"),
+                               inFlight=s("integer"),
+                               firingAlerts=s("integer"))),
+                           schemaVersion=s("integer"),
+                           writtenTs=s("number"),
+                           reason=s("string"),
+                           ticks=arr(FLIGHTREC_TICK_SCHEMA),
+                           inFlight=arr({"type": "object",
+                                         "additionalProperties": True}),
+                           firingAlerts=arr(s("string"))),
+                  404: obj(required=["msg"], msg=s("string"))})
+def get_flightrec_dumps(context: RequestContext) -> Dict:
+    """Without ``?file=``: newest-first summaries of the crash dumps under
+    ``{config_dir}/flightrec`` (the supervisor writes one per fatal
+    classification, pruned past ``flightrec_dumps``). With ``?file=``: the
+    full dump — last-N-tick timeline, the in-flight ledger rows at the
+    moment of death, and the alerts firing then."""
+    from ..serving.flight_recorder import list_crash_dumps, load_crash_dump
+
+    config = _flightrec_enabled()
+    directory = str(config.flightrec_dir)
+    name = context.request.args.get("file")
+    if name:
+        dump = load_crash_dump(directory, name)
+        if dump is None:
+            raise NotFoundError(f"no crash dump named {name!r}")
+        return dump
+    return {"dumps": list_crash_dumps(directory)}

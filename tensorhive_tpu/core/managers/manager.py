@@ -96,6 +96,10 @@ def instantiate_services_from_config(config: Config) -> List[Service]:
         from ..services.generation import GenerationService
 
         services.append(GenerationService(config=config))
+    if config.history.enabled:
+        from ..services.history import HistoryService
+
+        services.append(HistoryService(config=config))
     if config.alerting.enabled:
         # alerting starts LAST (start order == list order): its service_down
         # rule has for_s=0, so every other daemon must be alive before the

@@ -71,6 +71,8 @@ class GenerationService(Service):
         config = config or get_config()
         super().__init__(interval_s=config.generation.interval_s)
         self.generation_config = config.generation
+        #: where fatal failures leave flight-recorder crash dumps
+        self._flightrec_dir = str(config.flightrec_dir)
         # ~90% duty cycle: pump inside the interval, leave a sliver for the
         # run-loop's interruptible wait so stop() is honored promptly
         self._pump_budget_s = max(0.001, self.interval_s * 0.9)
@@ -162,12 +164,41 @@ class GenerationService(Service):
             "restart in progress")
         serving.update_serving_state(
             retry_after_s=max(1.0, 2 * self.interval_s))
+        # crash dump BEFORE failing the streams: the in-flight ledger rows
+        # must show what was actually running when the fault hit
+        self._write_crash_dump(engine, exc)
         failed = engine.fail_all_inflight(
             f"engine fault ({type(exc).__name__}: {exc}); the engine is "
             "restarting — retry the request")
         if failed:
             log.warning("failed fast %d in-flight generation request(s)",
                         failed)
+
+    def _write_crash_dump(self, engine, exc: BaseException) -> None:
+        """Best-effort post-mortem: snapshot the flight-recorder ring, the
+        in-flight ledger rows and the firing alerts into
+        ``{config_dir}/flightrec/`` (docs/OBSERVABILITY.md "History, SLOs
+        & flight recorder"). Quietly a no-op when the recorder is off, and
+        NEVER allowed to block the fail-fast path."""
+        recorder = getattr(engine, "flight_recorder", None)
+        if recorder is None:
+            return
+        try:
+            from ...observability import get_request_ledger
+            from ...observability.alerts import get_alert_engine
+            from ...serving.flight_recorder import write_crash_dump
+
+            path = write_crash_dump(
+                self._flightrec_dir,
+                reason=f"{type(exc).__name__}: {exc}",
+                recorder=recorder,
+                inflight=get_request_ledger().in_flight(),
+                alerts=get_alert_engine().firing(),
+                max_dumps=self.generation_config.flightrec_dumps)
+            log.error("flight-recorder crash dump written: %s", path)
+        except Exception:   # noqa: BLE001 - the post-mortem must never
+            # out-crash the recovery
+            log.exception("flight-recorder crash dump failed")
 
     def _maybe_rebuild(self) -> None:
         """Attempt an engine rebuild, rate-limited by the restart budget:
@@ -333,6 +364,21 @@ def load_checkpoint_params(path: str, model_config):
     return step, params
 
 
+def build_flight_recorder(generation):
+    """Per-tick black box for the engine (docs/OBSERVABILITY.md "History,
+    SLOs & flight recorder"); None — the byte-identical unrecorded step()
+    path — when ``flight_recorder`` is off."""
+    if not generation.flight_recorder:
+        return None
+    if generation.flightrec_ticks < 1:
+        raise ValueError(
+            f"[generation_service] flightrec_ticks must be >= 1, got "
+            f"{generation.flightrec_ticks}")
+    from ...serving.flight_recorder import FlightRecorder
+
+    return FlightRecorder(capacity=generation.flightrec_ticks)
+
+
 def build_engine(config: Config):
     """Construct the slot engine from ``[generation_service]`` config and
     warm its executables so the first request never pays a compile.
@@ -420,6 +466,7 @@ def build_engine(config: Config):
         eos_token=None if generation.eos_token < 0 else generation.eos_token,
         max_new_tokens_cap=generation.max_new_tokens,
         max_concurrent_per_user=generation.max_concurrent_per_user,
+        flight_recorder=build_flight_recorder(generation),
     )
     engine.warmup(prompt_lens=(16, max_len // 2))
     log.info("generation engine ready: preset=%s slots=%d max_len=%d "

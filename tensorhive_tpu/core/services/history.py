@@ -1,0 +1,42 @@
+"""HistoryService: sample the metrics-history ring and refresh SLO gauges.
+
+Deliberately thin, like AlertingService: all storage and arithmetic live in
+tensorhive_tpu/observability/history.py and observability/slo.py
+(deterministically testable with a fake clock); subclassing
+:class:`Service` buys the tick histogram, the overrun counter and the
+liveness stamps, so the sampler is itself covered by the ``service_down``
+rule like any other daemon. SLO gauge refresh rides the same tick so the
+``tpuhive_slo_*`` series stay current even when nothing scrapes
+``/api/metrics`` (the scrape-time collector in slo.py covers the other
+direction).
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+from ...config import Config, get_config
+from ...observability.history import MetricsHistory, get_metrics_history
+from .base import Service
+
+log = logging.getLogger(__name__)
+
+
+class HistoryService(Service):
+    def __init__(self, config: Optional[Config] = None,
+                 history: Optional[MetricsHistory] = None) -> None:
+        config = config or get_config()
+        super().__init__(interval_s=config.history.sample_interval_s)
+        self._history = history
+        self._slo_enabled = config.slo.enabled
+
+    def do_run(self) -> None:
+        history = self._history if self._history is not None \
+            else get_metrics_history()
+        now = time.time()
+        history.sample(now)
+        if self._slo_enabled:
+            from ...observability.slo import get_slo_engine
+
+            get_slo_engine().evaluate(now)

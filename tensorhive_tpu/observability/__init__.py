@@ -73,6 +73,13 @@ def reset_observability() -> None:
     from .alerts import set_alert_engine
 
     set_alert_engine(None)
+    # same lazy-rebuild contract for the history store and SLO engine:
+    # their allowlist/objectives derive from config, which tests swap
+    from .history import set_metrics_history
+    from .slo import set_slo_engine
+
+    set_metrics_history(None)
+    set_slo_engine(None)
 
 
 __all__ = [

@@ -508,6 +508,24 @@ def _serving_stalled_slot_counter(
     return _stalled_slot_count
 
 
+def _slo_fast_burn() -> Optional[float]:
+    """Source callable: worst fast-pair (5m AND 1h) SLO burn rate across
+    the default objectives (observability/slo.py). None — the rule stays
+    quiet — while [slo] is disabled or no traffic has landed in the
+    history windows yet (no traffic is not a breach)."""
+    from .slo import fast_burn_signal
+
+    return fast_burn_signal()
+
+
+def _slo_slow_burn() -> Optional[float]:
+    """Source callable: worst slow-pair (30m AND 6h) SLO burn rate —
+    slow-window counterpart of :func:`_slo_fast_burn`."""
+    from .slo import slow_burn_signal
+
+    return slow_burn_signal()
+
+
 def default_rule_pack(monitoring_interval_s: Optional[float] = None,
                       alert_interval_s: float = 5.0) -> List[AlertRule]:
     """The signals the registry already records (docs/OBSERVABILITY.md),
@@ -691,6 +709,24 @@ def default_rule_pack(monitoring_interval_s: Optional[float] = None,
                         "mid-decode) — capacity is short of the latency "
                         "budget; add slots/pages or shed load "
                         "(docs/ROBUSTNESS.md 'Serving data plane')"),
+        AlertRule(
+            name="slo_burn_fast", severity="critical",
+            kind="threshold", op=">=", threshold=14.4, for_s=0.0,
+            source=_slo_fast_burn,
+            description="an SLO's error budget is burning >= 14.4x over "
+                        "BOTH the 5m and 1h windows — at this rate a "
+                        "30-day budget is gone in ~2 days; page now "
+                        "(docs/OBSERVABILITY.md 'History, SLOs & flight "
+                        "recorder')"),
+        AlertRule(
+            name="slo_burn_slow", severity="warning",
+            kind="threshold", op=">=", threshold=6.0, for_s=0.0,
+            source=_slo_slow_burn,
+            description="an SLO's error budget is burning >= 6x over "
+                        "BOTH the 30m and 6h windows — a sustained slow "
+                        "leak that exhausts the budget well before the "
+                        "window rolls (docs/OBSERVABILITY.md 'History, "
+                        "SLOs & flight recorder')"),
     ]
 
 

@@ -1,0 +1,472 @@
+"""In-process metrics history: a fixed-memory ring TSDB over the registry.
+
+Every observability layer before this one is point-in-time: a scrape
+(PR 1), an alert evaluation (PR 4) or a ledger dump (PR 10) answers "what
+is true NOW". The autoscaling loop ROADMAP item 1 plans ("scale-out on
+*sustained* queue-wait SLO breach") and the SLO burn-rate engine
+(:mod:`.slo`) both need *windowed* history — retained samples, not
+instantaneous gauges. This module is that substrate:
+
+* :class:`MetricsHistory` — a thread-safe ring store sampling a
+  configurable **allowlist** of registry series (never the whole registry:
+  per-slot gauges and histogram buckets would multiply without bound).
+  Samples land in time-aligned windows holding ``min/mean/max/last`` plus
+  the window's first value, so memory is ``max_points`` windows per series
+  — bounded by construction and *independent of retention*: a longer
+  ``retention_s`` coarsens the windows instead of growing the store.
+* series specs — one string names one series::
+
+      tpuhive_generate_queue_depth                    # family (children sum)
+      tpuhive_generate_requests_total{outcome=failed} # one labeled child
+      tpuhive_generate_ttft_seconds:count             # histogram count
+      tpuhive_generate_ttft_seconds:sum               # histogram sum
+      tpuhive_generate_ttft_seconds:le:2.0            # observations <= bound
+                                                      # (snaps up to the
+                                                      # nearest bucket bound)
+
+  The ``:le:`` form is what lets the SLO engine read "good events" straight
+  off a latency histogram (the same cumulative-bucket model PromQL's
+  ``histogram_quantile`` uses).
+* :func:`MetricsHistory.increase` — counter-reset-aware growth over a
+  lookback window (the PR 4 ``increase`` rule semantics: a value drop means
+  the process restarted, so the post-reset value counts from zero) — the
+  primitive burn rates are computed from.
+
+Reading never *creates* registry children (a typo'd allowlist entry must
+not mint empty series into every scrape) and sampling takes one lock per
+call, far off any hot path — the :class:`~tensorhive_tpu.core.services
+.history.HistoryService` daemon drives it every ``[history]
+sample_interval_s`` seconds. Queryable at ``GET /api/admin/history``
+(docs/OBSERVABILITY.md "History, SLOs & flight recorder").
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from bisect import bisect_left
+from collections import deque
+from typing import Deque, Dict, List, Optional, Sequence, Tuple
+
+from .metrics import Counter, Gauge, Histogram, MetricsRegistry
+
+log = logging.getLogger(__name__)
+
+#: shipped retention / resolution: one hour of history in 720 windows of
+#: 5 s each — a few hundred bytes per series per window, so even a few
+#: dozen allowlisted series stay well under a megabyte
+DEFAULT_RETENTION_S = 3600.0
+DEFAULT_MAX_POINTS = 720
+
+_MODES = ("value", "count", "sum", "le")
+
+
+class SeriesSpec:
+    """One parsed allowlist entry (see the module docstring grammar)."""
+
+    __slots__ = ("raw", "name", "labels", "mode", "bound")
+
+    def __init__(self, raw: str, name: str, labels: Dict[str, str],
+                 mode: str, bound: Optional[float]) -> None:
+        self.raw = raw
+        self.name = name
+        self.labels = labels
+        self.mode = mode
+        self.bound = bound
+
+
+def parse_series(spec: str) -> SeriesSpec:
+    """Parse ``name[{k=v,...}][:count|:sum|:le:<bound>]``; raises
+    ``ValueError`` on malformed specs so a config typo fails loudly at
+    boot instead of silently recording nothing."""
+    raw = spec.strip()
+    rest = raw
+    labels: Dict[str, str] = {}
+    if "{" in rest:
+        if not rest.rstrip(":countsumle.0123456789").endswith("}") \
+                and "}" not in rest:
+            raise ValueError(f"series spec {raw!r}: unterminated labels")
+        head, _, tail = rest.partition("{")
+        body, closed, suffix = tail.partition("}")
+        if not closed:
+            raise ValueError(f"series spec {raw!r}: unterminated labels")
+        for pair in body.split(","):
+            if not pair.strip():
+                continue
+            key, eq, value = pair.partition("=")
+            if not eq or not key.strip():
+                raise ValueError(
+                    f"series spec {raw!r}: labels must be k=v pairs")
+            labels[key.strip()] = value.strip().strip('"')
+        rest = head + suffix
+    name, _, mode_part = rest.partition(":")
+    name = name.strip()
+    if not name:
+        raise ValueError(f"series spec {raw!r}: empty metric name")
+    mode, bound = "value", None
+    if mode_part:
+        pieces = mode_part.split(":")
+        mode = pieces[0]
+        if mode not in _MODES or mode == "value":
+            raise ValueError(
+                f"series spec {raw!r}: unknown mode {mode!r} "
+                "(count|sum|le:<bound>)")
+        if mode == "le":
+            if len(pieces) != 2:
+                raise ValueError(
+                    f"series spec {raw!r}: le needs exactly one bound")
+            try:
+                bound = float(pieces[1])
+            except ValueError:
+                raise ValueError(
+                    f"series spec {raw!r}: le bound {pieces[1]!r} is not "
+                    "a number") from None
+        elif len(pieces) != 1:
+            raise ValueError(f"series spec {raw!r}: trailing garbage")
+    return SeriesSpec(raw, name, labels, mode, bound)
+
+
+def read_series(registry: MetricsRegistry,
+                spec: SeriesSpec) -> Optional[float]:
+    """Current value of one series, or None while it has no signal (family
+    unregistered, no matching children, histogram mode on a non-histogram).
+    Matching children are summed; label filters are subset matches —
+    exactly the AlertEngine read semantics, and like it this never creates
+    children."""
+    family = registry.get(spec.name)
+    if family is None:
+        return None
+    total = 0.0
+    matched = False
+    for label_values, child in family.children():
+        labels = dict(zip(family.label_names, label_values))
+        if any(labels.get(k) != v for k, v in spec.labels.items()):
+            continue
+        if isinstance(child, Histogram):
+            if spec.mode == "sum":
+                total += child.sum
+            elif spec.mode == "le":
+                counts, _, count, _ = child.snapshot()
+                index = bisect_left(child.buckets, spec.bound)
+                if index >= len(child.buckets):
+                    total += count      # bound past +Inf: everything counts
+                else:
+                    total += sum(counts[:index + 1])
+            else:                       # "value" and "count" both read count
+                total += child.count
+        elif isinstance(child, (Counter, Gauge)):
+            if spec.mode != "value":
+                return None     # :count/:sum/:le only mean something on a
+                                # histogram — a mismatched spec is no signal
+            total += child.value
+        else:               # pragma: no cover - no other child kinds exist
+            continue
+        matched = True
+    return total if matched else None
+
+
+class _Window:
+    """One downsample window: min/mean/max/last plus the first value (the
+    increase() baseline inside the window)."""
+
+    __slots__ = ("start", "first", "last", "vmin", "vmax", "vsum", "count")
+
+    def __init__(self, start: float, value: float) -> None:
+        self.start = start
+        self.first = value
+        self.last = value
+        self.vmin = value
+        self.vmax = value
+        self.vsum = value
+        self.count = 1
+
+    def add(self, value: float) -> None:
+        self.last = value
+        if value < self.vmin:
+            self.vmin = value
+        if value > self.vmax:
+            self.vmax = value
+        self.vsum += value
+        self.count += 1
+
+
+class MetricsHistory:
+    """Thread-safe fixed-memory history over an allowlist of registry
+    series. ``sample(now)`` is driven by the HistoryService (or a fake
+    clock in tests); readers get consistent snapshots under the same
+    lock."""
+
+    def __init__(self, series: Sequence[str],
+                 registry: Optional[MetricsRegistry] = None,
+                 retention_s: float = DEFAULT_RETENTION_S,
+                 max_points: int = DEFAULT_MAX_POINTS) -> None:
+        if retention_s <= 0:
+            raise ValueError(f"retention_s must be > 0, got {retention_s}")
+        if max_points < 1:
+            raise ValueError(f"max_points must be >= 1, got {max_points}")
+        if registry is None:
+            from . import get_registry
+
+            registry = get_registry()
+        self._registry = registry
+        self.retention_s = float(retention_s)
+        self.max_points = int(max_points)
+        #: window width: retention spread over the point budget — the
+        #: memory-bound-independent-of-retention invariant
+        self.window_s = self.retention_s / self.max_points
+        self._specs: List[SeriesSpec] = []
+        seen = set()
+        for raw in series:
+            spec = parse_series(raw)
+            if spec.raw in seen:
+                continue
+            seen.add(spec.raw)
+            self._specs.append(spec)
+        self._lock = threading.Lock()
+        self._data: Dict[str, Deque[_Window]] = {
+            spec.raw: deque(maxlen=self.max_points) for spec in self._specs}
+        self.samples_taken = 0
+
+    # -- writing ------------------------------------------------------------
+    def sample(self, now: Optional[float] = None) -> int:
+        """Read every allowlisted series once and fold each value into its
+        time-aligned window; evicts windows past retention. Returns how
+        many series produced a value this pass."""
+        if now is None:
+            now = time.time()
+        # refresh collector-fed gauges (process RSS, alert firing state)
+        # exactly like render() does, so sampling doesn't depend on scrape
+        # traffic to materialize those series
+        self._registry._run_collectors()
+        readings = [(spec, read_series(self._registry, spec))
+                    for spec in self._specs]
+        sampled = 0
+        start = (now // self.window_s) * self.window_s
+        cutoff = now - self.retention_s
+        with self._lock:
+            for spec, value in readings:
+                if value is None:
+                    continue
+                sampled += 1
+                windows = self._data[spec.raw]
+                if windows and windows[-1].start >= start:
+                    # same window (or a clock step backwards): fold in
+                    windows[-1].add(value)
+                else:
+                    windows.append(_Window(start, value))
+                while windows and windows[0].start + self.window_s < cutoff:
+                    windows.popleft()
+            self.samples_taken += 1
+            points = sum(len(w) for w in self._data.values())
+        _SAMPLES_TOTAL.inc()
+        _SERIES_GAUGE.set(float(sampled))
+        _POINTS_GAUGE.set(float(points))
+        return sampled
+
+    # -- reading ------------------------------------------------------------
+    def series_names(self) -> List[str]:
+        return [spec.raw for spec in self._specs]
+
+    def query(self, series: Optional[Sequence[str]] = None,
+              since: Optional[float] = None,
+              step: Optional[float] = None) -> Dict[str, List[Dict]]:
+        """Downsampled points per series, oldest first. ``since`` drops
+        windows ending before it; ``step`` re-buckets into coarser windows
+        (clamped to at least the native window width). Unknown-but-
+        well-formed series answer an empty list — the allowlist is the
+        contract, not the query."""
+        if series is None:
+            wanted = [spec.raw for spec in self._specs]
+        else:
+            wanted = [parse_series(raw).raw for raw in series]
+        width = self.window_s if step is None else max(float(step),
+                                                      self.window_s)
+        result: Dict[str, List[Dict]] = {}
+        with self._lock:
+            for raw in wanted:
+                windows = self._data.get(raw)
+                if windows is None:
+                    result[raw] = []
+                    continue
+                buckets: List[_Window] = []
+                for window in windows:
+                    if since is not None and \
+                            window.start + self.window_s <= since:
+                        continue
+                    start = (window.start // width) * width
+                    if buckets and buckets[-1].start == start:
+                        merged = buckets[-1]
+                        merged.last = window.last
+                        merged.vmin = min(merged.vmin, window.vmin)
+                        merged.vmax = max(merged.vmax, window.vmax)
+                        merged.vsum += window.vsum
+                        merged.count += window.count
+                    else:
+                        clone = _Window(start, window.first)
+                        clone.last = window.last
+                        clone.vmin = window.vmin
+                        clone.vmax = window.vmax
+                        clone.vsum = window.vsum
+                        clone.count = window.count
+                        buckets.append(clone)
+                result[raw] = [{
+                    "ts": round(b.start, 3),
+                    "min": b.vmin,
+                    "mean": b.vsum / b.count,
+                    "max": b.vmax,
+                    "last": b.last,
+                    "count": b.count,
+                } for b in buckets]
+        return result
+
+    def latest(self, series: str) -> Optional[float]:
+        with self._lock:
+            windows = self._data.get(series)
+            if not windows:
+                return None
+            return windows[-1].last
+
+    def increase(self, series: str, window_s: float,
+                 now: Optional[float] = None) -> Optional[float]:
+        """Counter growth over the lookback window, counter-reset aware
+        (PR 4 ``increase`` semantics: a drop means a restart, so the
+        post-reset value itself counts as growth from zero). Baseline is
+        the newest sample at or before the window start; with no sample
+        that old, the oldest in-window first-value anchors instead. None
+        while the series has no samples at all."""
+        if now is None:
+            now = time.time()
+        cutoff = now - float(window_s)
+        with self._lock:
+            windows = self._data.get(series)
+            if not windows:
+                return None
+            baseline: Optional[float] = None
+            values: List[float] = []
+            for window in windows:
+                if window.start + self.window_s <= cutoff:
+                    baseline = window.last
+                    continue
+                values.append(window.first)
+                values.append(window.last)
+        if not values:
+            return 0.0 if baseline is not None else None
+        total = 0.0
+        prev = baseline if baseline is not None else values[0]
+        for value in values:
+            if value >= prev:
+                total += value - prev
+            else:                       # counter reset: count from zero
+                total += value
+            prev = value
+        return total
+
+    def points_retained(self) -> int:
+        with self._lock:
+            return sum(len(w) for w in self._data.values())
+
+    def clear(self) -> None:
+        with self._lock:
+            for windows in self._data.values():
+                windows.clear()
+            self.samples_taken = 0
+
+
+# -- default allowlist --------------------------------------------------------
+
+def default_series(generation=None) -> List[str]:
+    """The shipped allowlist: the serving SLO signals (queue depth, slot
+    occupancy, pages, request outcomes, the queue-wait/TTFT good-event
+    buckets the :mod:`.slo` objectives read) plus service liveness
+    counters — the sustained-signal set the future autoscaler consumes.
+    ``generation`` supplies the SLO thresholds the ``:le:`` bounds snap
+    to (defaults match GenerationConfig)."""
+    ttft_slo_s = getattr(generation, "ttft_slo_s", 2.0)
+    queue_wait_slo_s = getattr(generation, "queue_wait_slo_s", 1.0)
+    return [
+        "tpuhive_generate_queue_depth",
+        "tpuhive_generate_slots_busy",
+        "tpuhive_generate_kv_pages_free",
+        "tpuhive_generate_tokens_total",
+        "tpuhive_generate_requests_total{outcome=completed}",
+        "tpuhive_generate_requests_total{outcome=cancelled}",
+        "tpuhive_generate_requests_total{outcome=failed}",
+        "tpuhive_generate_requests_total{outcome=timeout}",
+        f"tpuhive_generate_queue_wait_seconds:le:{queue_wait_slo_s:g}",
+        "tpuhive_generate_queue_wait_seconds:count",
+        f"tpuhive_generate_ttft_seconds:le:{ttft_slo_s:g}",
+        "tpuhive_generate_ttft_seconds:count",
+        "tpuhive_service_ticks_total",
+        "tpuhive_service_tick_failures_total",
+        "tpuhive_process_resident_memory_bytes",
+    ]
+
+
+# -- process-wide store -------------------------------------------------------
+_history: Optional[MetricsHistory] = None
+_history_lock = threading.Lock()
+
+
+def get_metrics_history() -> MetricsHistory:
+    """Process-wide history store (what the HistoryService samples and
+    ``GET /api/admin/history`` serves); built lazily so the allowlist and
+    retention read the materialized config."""
+    global _history
+    with _history_lock:
+        if _history is None:
+            retention_s = DEFAULT_RETENTION_S
+            max_points = DEFAULT_MAX_POINTS
+            series: Optional[List[str]] = None
+            generation = None
+            try:
+                from ..config import get_config
+
+                config = get_config()
+                retention_s = config.history.retention_s
+                max_points = config.history.max_points
+                generation = config.generation
+                if config.history.series.strip():
+                    series = [part for part in
+                              config.history.series.split(",")
+                              if part.strip()]
+            except Exception:
+                # bare library use: the shipped defaults, like the alert
+                # pack's fallback posture
+                log.warning("metrics history: config unavailable, using "
+                            "shipped defaults", exc_info=True)
+            if series is None:
+                series = default_series(generation)
+            _history = MetricsHistory(series, retention_s=retention_s,
+                                      max_points=max_points)
+        return _history
+
+
+def set_metrics_history(history: Optional[MetricsHistory]) -> None:
+    """Replace (or with None: drop, to be lazily rebuilt) the process-wide
+    store — test isolation and custom allowlists."""
+    global _history
+    with _history_lock:
+        _history = history
+
+
+# -- self-metrics -------------------------------------------------------------
+
+def _register_exports() -> Tuple[object, object, object]:
+    from . import get_registry
+
+    registry = get_registry()
+    samples = registry.counter(
+        "tpuhive_history_samples_total",
+        "Sampling passes the metrics-history store has taken.")
+    series = registry.gauge(
+        "tpuhive_history_series",
+        "Allowlisted series that produced a value in the last sampling "
+        "pass (series without signal yet are skipped, not stored).")
+    points = registry.gauge(
+        "tpuhive_history_points",
+        "Downsample windows currently retained across all series — "
+        "bounded by series x max_points regardless of retention_s.")
+    return samples, series, points
+
+
+_SAMPLES_TOTAL, _SERIES_GAUGE, _POINTS_GAUGE = _register_exports()

@@ -1,0 +1,361 @@
+"""SLO objectives and Google-SRE multi-window burn-rate evaluation.
+
+An :class:`SloObjective` is a declarative target ratio over good/total
+event series (history-store specs, :mod:`.history` grammar): "99% of
+requests wait in queue <= ``queue_wait_slo_s``" is *good* =
+``queue_wait_seconds:le:1`` over *total* = ``queue_wait_seconds:count``.
+The engine evaluates objectives off :class:`~.history.MetricsHistory`
+windows — never raw instantaneous gauges — with the SRE-workbook
+multi-window multi-burn-rate recipe:
+
+===========  ==================  =========  ========
+severity     windows (AND)       burn rate  action
+===========  ==================  =========  ========
+page         5m **and** 1h       >= 14.4    ``slo_burn_fast`` (critical)
+warn         30m **and** 6h      >= 6.0     ``slo_burn_slow`` (warning)
+===========  ==================  =========  ========
+
+A burn rate of 1.0 spends exactly the error budget over the budget
+window; 14.4 exhausts a 30-day budget in ~2 days. The short window makes
+the alert resolve quickly once the breach stops; the AND with the long
+window keeps one bad scrape from paging. Both signals feed the PR 4
+AlertEngine as ``source`` rules (None while ``[slo]`` is disabled or no
+traffic has landed, which keeps the rules quiet rather than firing on
+absence) and are exported as::
+
+    tpuhive_slo_error_budget_remaining{objective}
+    tpuhive_slo_burn_rate{objective,window}
+
+— the exact sustained-breach signal ROADMAP item 1's autoscaler consumes.
+Objective names in :func:`default_objective_pack` are part of the TH-X
+docs contract (docs/OBSERVABILITY.md objective table).
+"""
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .history import MetricsHistory, get_metrics_history, parse_series
+
+log = logging.getLogger(__name__)
+
+#: multi-window pairs (short AND long, seconds) and their burn thresholds —
+#: straight from the SRE workbook's 99.9%/30d recipe, which transfers to
+#: any budget window because burn rate is budget-relative
+FAST_WINDOWS: Tuple[float, float] = (300.0, 3600.0)
+SLOW_WINDOWS: Tuple[float, float] = (1800.0, 21600.0)
+FAST_BURN = 14.4
+SLOW_BURN = 6.0
+
+
+def window_label(seconds: float) -> str:
+    """Human window label for the ``window`` gauge label ("5m", "1h")."""
+    seconds = float(seconds)
+    if seconds >= 3600.0 and seconds % 3600.0 == 0:
+        return f"{int(seconds // 3600)}h"
+    if seconds >= 60.0 and seconds % 60.0 == 0:
+        return f"{int(seconds // 60)}m"
+    return f"{seconds:g}s"
+
+
+@dataclass(frozen=True)
+class SloObjective:
+    """One service-level objective: ``target`` fraction of events must be
+    good. ``good``/``total`` are history-series specs; multiple specs sum
+    (availability counts completed+cancelled as good). Events, not time:
+    an idle service spends no budget."""
+
+    name: str
+    target: float
+    good: Tuple[str, ...]
+    total: Tuple[str, ...]
+    description: str = ""
+
+    def __post_init__(self) -> None:
+        if not self.name:
+            raise ValueError("SloObjective needs a name")
+        if not 0.0 < self.target < 1.0:
+            raise ValueError(
+                f"objective {self.name!r}: target must be in (0, 1), "
+                f"got {self.target}")
+        if not self.good or not self.total:
+            raise ValueError(
+                f"objective {self.name!r}: good and total series required")
+        for spec in (*self.good, *self.total):
+            parse_series(spec)      # malformed specs fail at construction
+
+
+class SloEngine:
+    """Evaluates objectives against the history store. Stateless between
+    calls (all state lives in the history windows), so evaluation order
+    and frequency don't affect results — a property the exactly-once
+    alert tests lean on."""
+
+    def __init__(self, objectives: Sequence[SloObjective],
+                 history: Optional[MetricsHistory] = None,
+                 budget_window_s: float = 3600.0) -> None:
+        if budget_window_s <= 0:
+            raise ValueError(
+                f"budget_window_s must be > 0, got {budget_window_s}")
+        names = [o.name for o in objectives]
+        if len(names) != len(set(names)):
+            raise ValueError(f"duplicate objective names in {names}")
+        self.objectives: Tuple[SloObjective, ...] = tuple(objectives)
+        self._history = history
+        self.budget_window_s = float(budget_window_s)
+
+    @property
+    def history(self) -> MetricsHistory:
+        return self._history if self._history is not None \
+            else get_metrics_history()
+
+    # -- arithmetic ---------------------------------------------------------
+    def _sum_increase(self, specs: Sequence[str], window_s: float,
+                      now: float) -> Optional[float]:
+        values = [self.history.increase(spec, window_s, now)
+                  for spec in specs]
+        values = [v for v in values if v is not None]
+        return sum(values) if values else None
+
+    def bad_fraction(self, objective: SloObjective, window_s: float,
+                     now: Optional[float] = None) -> Optional[float]:
+        """Fraction of events in the window that were bad; None while the
+        window holds no events (no traffic is not a breach)."""
+        if now is None:
+            now = time.time()
+        total = self._sum_increase(objective.total, window_s, now)
+        if total is None or total <= 0.0:
+            return None
+        good = self._sum_increase(objective.good, window_s, now) or 0.0
+        return min(1.0, max(0.0, 1.0 - good / total))
+
+    def burn_rate(self, objective: SloObjective, window_s: float,
+                  now: Optional[float] = None) -> Optional[float]:
+        """How fast the error budget burns: bad fraction over the budget
+        the target allows. 1.0 = exactly on budget."""
+        bad = self.bad_fraction(objective, window_s, now)
+        if bad is None:
+            return None
+        return bad / (1.0 - objective.target)
+
+    def budget_remaining(self, objective: SloObjective,
+                         now: Optional[float] = None) -> Optional[float]:
+        """Error budget left over the budget window: 1.0 = untouched,
+        0.0 = spent, negative = overspent."""
+        burn = self.burn_rate(objective, self.budget_window_s, now)
+        if burn is None:
+            return None
+        return 1.0 - burn
+
+    def _multiwindow_burn(self, objective: SloObjective,
+                          windows: Tuple[float, float],
+                          now: float) -> Optional[float]:
+        # the AND of the pair: both windows must burn, so the signal is
+        # the smaller of the two (one quiet window keeps it low)
+        rates = [self.burn_rate(objective, w, now) for w in windows]
+        if any(r is None for r in rates):
+            return None
+        return min(rates)       # type: ignore[type-var]
+
+    def fast_burn(self, now: Optional[float] = None) -> Optional[float]:
+        """Worst fast-pair (5m AND 1h) burn across objectives — the
+        ``slo_burn_fast`` alert source. None while nothing has signal."""
+        return self._worst(FAST_WINDOWS, now)
+
+    def slow_burn(self, now: Optional[float] = None) -> Optional[float]:
+        """Worst slow-pair (30m AND 6h) burn across objectives — the
+        ``slo_burn_slow`` alert source."""
+        return self._worst(SLOW_WINDOWS, now)
+
+    def _worst(self, windows: Tuple[float, float],
+               now: Optional[float]) -> Optional[float]:
+        if now is None:
+            now = time.time()
+        rates = [self._multiwindow_burn(o, windows, now)
+                 for o in self.objectives]
+        rates = [r for r in rates if r is not None]
+        return max(rates) if rates else None
+
+    # -- evaluation / export ------------------------------------------------
+    def evaluate(self, now: Optional[float] = None) -> Dict[str, Dict]:
+        """Compute every objective's budget + per-window burn rates and
+        mirror the non-None values into the ``tpuhive_slo_*`` gauges
+        (labeled children appear only once a signal exists, so a fresh
+        process scrapes no misleading zeros)."""
+        if now is None:
+            now = time.time()
+        result: Dict[str, Dict] = {}
+        for objective in self.objectives:
+            burn_rates: Dict[str, Optional[float]] = {}
+            for window_s in sorted(set(FAST_WINDOWS + SLOW_WINDOWS)):
+                label = window_label(window_s)
+                burn = self.burn_rate(objective, window_s, now)
+                burn_rates[label] = burn
+                if burn is not None:
+                    _BURN_GAUGE.labels(objective=objective.name,
+                                       window=label).set(burn)
+            remaining = self.budget_remaining(objective, now)
+            if remaining is not None:
+                _BUDGET_GAUGE.labels(objective=objective.name).set(remaining)
+            result[objective.name] = {
+                "target": objective.target,
+                "description": objective.description,
+                "budgetRemaining": remaining,
+                "burnRates": burn_rates,
+            }
+        return result
+
+
+# -- default pack -------------------------------------------------------------
+
+def default_objective_pack(config=None) -> List[SloObjective]:
+    """The shipped objectives over the serving plane's existing metrics.
+    Latency thresholds come from the ``[generation_service]`` SLO knobs
+    (the same values the PR 4 p95 alerts compare against), with the alert
+    pack's fallback posture when config is unavailable."""
+    ttft_slo_s = 2.0
+    queue_wait_slo_s = 1.0
+    availability_target = 0.999
+    latency_target = 0.99
+    if config is None:
+        try:
+            from ..config import get_config
+
+            config = get_config()
+        except Exception:
+            log.warning("SLO pack: config unavailable, using shipped "
+                        "defaults", exc_info=True)
+            config = None
+    if config is not None:
+        ttft_slo_s = config.generation.ttft_slo_s
+        queue_wait_slo_s = config.generation.queue_wait_slo_s
+        availability_target = config.slo.availability_target
+        latency_target = config.slo.latency_target
+    requests = "tpuhive_generate_requests_total{{outcome={}}}"
+    return [
+        SloObjective(
+            name="queue_wait",
+            target=latency_target,
+            good=(f"tpuhive_generate_queue_wait_seconds:le:"
+                  f"{queue_wait_slo_s:g}",),
+            total=("tpuhive_generate_queue_wait_seconds:count",),
+            description="Requests admitted to a slot within "
+                        "queue_wait_slo_s of submit.",
+        ),
+        SloObjective(
+            name="ttft",
+            target=latency_target,
+            good=(f"tpuhive_generate_ttft_seconds:le:{ttft_slo_s:g}",),
+            total=("tpuhive_generate_ttft_seconds:count",),
+            description="Requests whose first token lands within "
+                        "ttft_slo_s of submit.",
+        ),
+        SloObjective(
+            name="availability",
+            target=availability_target,
+            good=(requests.format("completed"),
+                  requests.format("cancelled")),
+            total=(requests.format("completed"),
+                   requests.format("cancelled"),
+                   requests.format("failed"),
+                   requests.format("timeout")),
+            description="Requests that finish without a server-side "
+                        "failure or deadline timeout (client cancels "
+                        "count as good).",
+        ),
+    ]
+
+
+# -- process-wide engine + alert sources --------------------------------------
+_engine: Optional[SloEngine] = None
+_engine_lock = threading.Lock()
+
+
+def _slo_enabled() -> bool:
+    try:
+        from ..config import get_config
+
+        return bool(get_config().slo.enabled)
+    except Exception:
+        log.debug("SLO: config unavailable, defaulting enabled", exc_info=True)
+        return True     # bare library use: on, matching SloConfig default
+
+
+def get_slo_engine() -> SloEngine:
+    """Process-wide engine over the default objective pack, built lazily
+    from config (same lifecycle as the history store)."""
+    global _engine
+    with _engine_lock:
+        if _engine is None:
+            budget_window_s = 3600.0
+            try:
+                from ..config import get_config
+
+                budget_window_s = get_config().slo.budget_window_s
+            except Exception:
+                log.warning("SLO engine: config unavailable, using default "
+                            "budget window", exc_info=True)
+            _engine = SloEngine(default_objective_pack(),
+                                budget_window_s=budget_window_s)
+        return _engine
+
+
+def set_slo_engine(engine: Optional[SloEngine]) -> None:
+    global _engine
+    with _engine_lock:
+        _engine = engine
+
+
+def fast_burn_signal(now: Optional[float] = None) -> Optional[float]:
+    """AlertRule source for ``slo_burn_fast``: worst fast-pair burn, or
+    None (rule stays quiet) while ``[slo]`` is off or there is no
+    traffic."""
+    if not _slo_enabled():
+        return None
+    return get_slo_engine().fast_burn(now)
+
+
+def slow_burn_signal(now: Optional[float] = None) -> Optional[float]:
+    """AlertRule source for ``slo_burn_slow`` — slow-pair counterpart of
+    :func:`fast_burn_signal`."""
+    if not _slo_enabled():
+        return None
+    return get_slo_engine().slow_burn(now)
+
+
+# -- gauge export -------------------------------------------------------------
+
+def _register_exports():
+    from . import get_registry
+
+    registry = get_registry()
+    budget = registry.gauge(
+        "tpuhive_slo_error_budget_remaining",
+        "Error budget left over [slo] budget_window_s per objective "
+        "(1 = untouched, 0 = spent, negative = overspent).",
+        labels=("objective",))
+    burn = registry.gauge(
+        "tpuhive_slo_burn_rate",
+        "Budget burn rate per objective and lookback window "
+        "(1 = spending exactly the budget; the alert pack pages at "
+        "14.4, warns at 6).",
+        labels=("objective", "window"))
+
+    def _collect_slo_gauges(_registry) -> None:
+        # refresh at scrape time so /api/metrics is current even between
+        # HistoryService ticks; cheap (reads in-memory windows only)
+        if not _slo_enabled():
+            return
+        try:
+            get_slo_engine().evaluate()
+        except Exception:       # pragma: no cover - defensive
+            log.exception("SLO gauge refresh failed")
+
+    registry.register_collector(_collect_slo_gauges)
+    return budget, burn
+
+
+_BUDGET_GAUGE, _BURN_GAUGE = _register_exports()

@@ -97,6 +97,7 @@ import numpy as np
 from ..models.decode import (
     KVCache,
     QuantKVCache,
+    _compile_seen,
     _count_compile,
     _decode_attend,
     _paged_attend,
@@ -816,6 +817,7 @@ class SlotEngine:
         max_deadline_s: float = 600.0,
         fault_plan: Optional[ServingFaultPlan] = None,
         clock: Callable[[], float] = time.monotonic,
+        flight_recorder=None,
     ) -> None:
         if not config.causal:
             raise ValueError("serving needs an autoregressive model; this "
@@ -859,6 +861,10 @@ class SlotEngine:
         #: deterministic fault injection seam: every device dispatch
         #: consults the plan first (serving/faults.py); None in production
         self.fault_plan = fault_plan
+        #: per-tick black box (serving/flight_recorder.py); None keeps
+        #: step() byte-identical to the unrecorded path — the
+        #: [generation_service] flight_recorder=off rollback
+        self.flight_recorder = flight_recorder
         #: drain mode: admission refused (EngineDrainingError -> 503 +
         #: Retry-After at the API edge) while in-flight requests finish
         self._draining = False
@@ -1365,10 +1371,52 @@ class SlotEngine:
         (``prefill_chunk_tokens``) bounding how much prefill work any tick
         can insert between two decode steps, so a 4k-token join can never
         stall the running batch's inter-token latency. Returns the number
-        of active slots stepped."""
-        self._admit()
-        self._advance_prefills()
-        return self._decode_step()
+        of active slots stepped.
+
+        With a flight recorder installed the tick is additionally stamped
+        into the per-tick ring — pure host bookkeeping (counts and clock
+        reads, never a traced operand), recorded in a ``finally`` so the
+        tick that *raises* is the one tick the post-mortem needs most.
+        ``flight_recorder is None`` is the byte-identical unrecorded
+        path."""
+        recorder = self.flight_recorder
+        if recorder is None:
+            self._admit()
+            self._advance_prefills()
+            return self._decode_step()
+        started = self.clock()
+        compiles_before = len(_compile_seen)
+        faults_before = self._faults_injected()
+        admitted = chunks = stepped = 0
+        try:
+            admitted = self._admit()
+            chunks = self._advance_prefills() or 0
+            stepped = self._decode_step()
+            return stepped
+        finally:
+            with self._lock:
+                busy = self._busy_locked()
+                depth = len(self._pending)
+            pages_free = self._pool.free_pages if self.paged else 0
+            recorder.record(
+                duration_s=self.clock() - started,
+                admitted=admitted,
+                prefill_chunks=chunks,
+                decode_slots=stepped,
+                slots_busy=busy,
+                queue_depth=depth,
+                pages_free=pages_free,
+                compiles=len(_compile_seen) - compiles_before,
+                faults=self._faults_injected() - faults_before,
+            )
+
+    def _faults_injected(self) -> int:
+        """Total injections the fault plan has performed (0 without a
+        plan) — the recorder diffs this per tick."""
+        plan = self.fault_plan
+        if plan is None:
+            return 0
+        return sum(plan.faults_injected.values())
 
     def pump(self, budget_s: Optional[float] = None,
              should_stop: Optional[Callable[[], bool]] = None) -> int:
@@ -1768,17 +1816,20 @@ class SlotEngine:
             # the draft's first catch-up window: just the current token
             self._spec_windows[slot] = [int(prompt[-1])]
 
-    def _advance_prefills(self) -> None:
+    def _advance_prefills(self) -> int:
         """Dispatch ONE prefill chunk for every slot still mid-prefill —
         the per-tick budget that keeps a long joining prompt from wedging
         the running decode batch (docs/SERVING.md "Prefix cache & chunked
         prefill"). Cancels are honored here too, so a cancel mid-chunk
-        frees the slot (and its net-releasable pages) without ever arming."""
+        frees the slot (and its net-releasable pages) without ever arming.
+        Returns the number of chunks dispatched (the flight recorder's
+        per-tick prefill count)."""
         if not self._use_chunk_prefill:
-            return      # legacy paths prefill whole prompts inside _join
+            return 0    # legacy paths prefill whole prompts inside _join
         with self._lock:
             pending = [(index, slot) for index, slot in enumerate(self._slots)
                        if slot is not None and not slot.prefill_done]
+        chunks = 0
         for index, state in pending:
             if state.request.cancelled:
                 with self._lock:
@@ -1800,6 +1851,8 @@ class SlotEngine:
                                             outcome="timeout")
                 continue
             self._advance_prefill_slot(index, state)
+            chunks += 1
+        return chunks
 
     def _advance_prefill_slot(self, index: int, state: _Slot) -> None:
         """One chunk of ``state``'s prompt through the chunked executable:

@@ -283,21 +283,34 @@ def test_scheduled_restriction_gates_reservations(api, user, user_headers, db):
 
     resource = make_resource(hostname="vm-0", index=7)
     restriction = make_restriction(user, resources=[resource], end_offset_h=24 * 14)
-    # schedule allowing all days, 00:00-23:59 → reservation inside it passes
+    # schedule allowing all days, 00:00-23:59 → reservation inside it passes.
+    # The reservation is pinned to tomorrow 12:00-13:00 instead of
+    # now+1h..now+2h: the daily windows leave 23:59→00:00 uncovered, so a
+    # now-relative interval taken between 21:59 and 22:59 would cross the
+    # nightly gap and flake.
+    from datetime import timedelta
+
+    from tensorhive_tpu.utils.timeutils import utcnow
+
+    noon = (utcnow() + timedelta(days=1)).replace(
+        hour=12, minute=0, second=0, microsecond=0)
     schedule = make_schedule(days="1234567", hour_start="00:00", hour_end="23:59")
     restriction.add_schedule(schedule)
     ok = api.post("/api/reservations", json={
         "title": "in-window", "resourceId": resource.uid,
-        "start": _iso(1), "end": _iso(2),
+        "start": noon.isoformat() + "Z",
+        "end": (noon + timedelta(hours=1)).isoformat() + "Z",
     }, headers=user_headers)
     assert ok.status_code == 201, ok.get_data(as_text=True)
-    # narrow schedule (one minute a week) → a normal reservation is denied
+    # narrow schedule (30 minutes a week) → a one-hour reservation can
+    # never be fully covered, whatever day/hour the test runs
     schedule.hour_start, schedule.hour_end = "03:00", "03:30"
     schedule.schedule_days = "1"
     schedule.save()
     denied = api.post("/api/reservations", json={
         "title": "outside", "resourceId": resource.uid,
-        "start": _iso(3), "end": _iso(4),
+        "start": (noon + timedelta(hours=2)).isoformat() + "Z",
+        "end": (noon + timedelta(hours=3)).isoformat() + "Z",
     }, headers=user_headers)
     assert denied.status_code == 403
 

@@ -83,6 +83,9 @@ UI_CALLS = {
     ("POST", "/admin/profile"): 'api("/admin/profile", { json: {} })',
     ("GET", "/admin/profile/memory"): 'api("/admin/profile/memory")',
     ("GET", "/admin/alerts"): 'api("/admin/alerts")',
+    ("GET", "/admin/history"): 'api("/admin/history?series="',
+    ("GET", "/admin/flightrec"): 'api("/admin/flightrec?limit=40")',
+    ("GET", "/admin/flightrec/dumps"): 'api("/admin/flightrec/dumps")',
     ("GET", "/metrics"): 'href="/api/metrics"',
     ("GET", "/healthz"): 'href="/api/healthz"',
     ("GET", "/readyz"): 'href="/api/readyz"',

@@ -787,7 +787,8 @@ class TestCrossArtifact:
     @staticmethod
     def build_repo(root, *, metrics_py=None, observability_md=None,
                    serving_md=None, nodes_js=None, schema_py=None,
-                   alerts_py=None, config_py=None):
+                   alerts_py=None, config_py=None, controller_py=None,
+                   slo_py=None):
         (root / "tensorhive_tpu" / "controllers").mkdir(parents=True)
         (root / "tensorhive_tpu" / "observability").mkdir()
         (root / "tensorhive_tpu" / "app" / "static" / "js").mkdir(
@@ -830,6 +831,12 @@ class TestCrossArtifact:
          / "nodes.js").write_text(
             nodes_js if nodes_js is not None
             else 'const s = stats.slots + stats.enabled;\n')
+        if controller_py is not None:
+            (root / "tensorhive_tpu" / "controllers"
+             / "observability.py").write_text(controller_py)
+        if slo_py is not None:
+            (root / "tensorhive_tpu" / "observability"
+             / "slo.py").write_text(slo_py)
         (root / "docs" / "OBSERVABILITY.md").write_text(
             observability_md if observability_md is not None
             else textwrap.dedent("""
@@ -1024,6 +1031,156 @@ class TestCrossArtifact:
                    for m in messages)
         assert any("'ghost_rule'" in m and "no rule by that name" in m
                    for m in messages)
+
+    CONTROLLER = textwrap.dedent("""
+        @route("/admin/demo", ["GET"], auth="admin")
+        def get_demo(context):
+            return respond(context, {})
+        """)
+
+    ENDPOINT_DOC = textwrap.dedent("""
+        ## Endpoints
+
+        | Endpoint | Auth | Payload |
+        |---|---|---|
+        | `GET /api/admin/demo` | admin JWT | demo dump |
+
+        | Metric | Kind | Where |
+        |---|---|---|
+        | `tpuhive_demo_requests_total` | counter | demo |
+        | `tpuhive_demo_queue_depth` | gauge | demo |
+
+        | Rule | Severity | Signal |
+        |---|---|---|
+        | `demo_down` | critical | demo |
+
+        enabled = false
+        """)
+
+    def test_endpoint_contract_clean_when_consistent(self, tmp_path):
+        root = self.build_repo(tmp_path, controller_py=self.CONTROLLER,
+                               observability_md=self.ENDPOINT_DOC)
+        assert self.check(root) == []
+
+    def test_endpoint_without_docs_row_flagged(self, tmp_path):
+        # the controller registers a route the endpoint table never names
+        root = self.build_repo(tmp_path, controller_py=textwrap.dedent("""
+            @route("/admin/demo", ["GET"], auth="admin")
+            def get_demo(context):
+                return respond(context, {})
+
+            @route("/admin/shadow", ["GET"], auth="admin")
+            def get_shadow(context):
+                return respond(context, {})
+            """), observability_md=self.ENDPOINT_DOC)
+        findings = self.check(root)
+        assert len(findings) == 1
+        assert "GET /api/admin/shadow" in findings[0].message
+        assert findings[0].path.endswith("controllers/observability.py")
+
+    def test_docs_endpoint_row_without_route_flagged(self, tmp_path):
+        root = self.build_repo(
+            tmp_path, controller_py=self.CONTROLLER,
+            observability_md=self.ENDPOINT_DOC.replace(
+                "| `GET /api/admin/demo` | admin JWT | demo dump |",
+                "| `GET /api/admin/demo` | admin JWT | demo dump |\n"
+                "| `GET /api/admin/ghost` | admin JWT | removed route |"))
+        findings = self.check(root)
+        assert len(findings) == 1
+        assert "GET /api/admin/ghost" in findings[0].message
+        assert findings[0].path == "docs/OBSERVABILITY.md"
+
+    SLO_PY = textwrap.dedent("""
+        def default_objective_pack():
+            return [SloObjective(name="demo_latency", target=0.99),
+                    SloObjective(name="demo_availability", target=0.999)]
+        """)
+
+    SLO_DOC_ROWS = textwrap.dedent("""
+        | Objective | Target | Good / total |
+        |---|---|---|
+        | `demo_latency` | 99% | fast enough |
+        | `demo_availability` | 99.9% | not failed |
+        """)
+
+    def test_slo_objective_pack_vs_table_bidirectional(self, tmp_path):
+        base = self.build_repo(
+            tmp_path / "clean", slo_py=self.SLO_PY,
+            observability_md=self.ENDPOINT_DOC + self.SLO_DOC_ROWS)
+        assert self.check(base) == []
+
+        drifted = self.build_repo(
+            tmp_path / "drift", slo_py=textwrap.dedent("""
+                def default_objective_pack():
+                    return [SloObjective(name="demo_latency", target=0.99),
+                            SloObjective(name="demo_availability",
+                                         target=0.999),
+                            SloObjective(name="undocumented_obj",
+                                         target=0.9)]
+                """),
+            observability_md=self.ENDPOINT_DOC + self.SLO_DOC_ROWS
+            + "| `ghost_objective` | 95% | row without an objective |\n")
+        messages = [f.message for f in self.check(drifted)]
+        assert len(messages) == 2
+        assert any("'undocumented_obj'" in m and "no row" in m
+                   for m in messages)
+        assert any("'ghost_objective'" in m and "no objective by that name"
+                   in m for m in messages)
+
+    def test_undocumented_history_slo_knob_flagged(self, tmp_path):
+        root = self.build_repo(tmp_path, config_py=textwrap.dedent("""
+            import dataclasses
+
+            @dataclasses.dataclass
+            class GenerationConfig:
+                enabled: bool = False
+                slots: int = 8
+
+            @dataclasses.dataclass
+            class ProfilingConfig:
+                enabled: bool = False
+
+            @dataclasses.dataclass
+            class HistoryConfig:
+                hidden_history_knob: int = 1
+
+            @dataclasses.dataclass
+            class SloConfig:
+                hidden_slo_knob: float = 0.5
+            """))
+        messages = [f.message for f in self.check(root)]
+        assert len(messages) == 2
+        assert any("[history] knob 'hidden_history_knob'" in m
+                   for m in messages)
+        assert any("[slo] knob 'hidden_slo_knob'" in m for m in messages)
+
+    def test_live_gate_catches_deleted_endpoint_and_objective_rows(
+            self, tmp_path):
+        """The delete-a-row proof over the REAL artifacts: copy the repo,
+        delete the history endpoint row and the ttft objective row from
+        docs/OBSERVABILITY.md, and the full gate must exit 1 naming both."""
+        import shutil
+
+        files = subprocess.run(
+            ["git", "ls-files", "--cached", "--others",
+             "--exclude-standard"], cwd=REPO, capture_output=True,
+            text=True, check=True).stdout.splitlines()
+        for rel in files:
+            dst = tmp_path / rel
+            dst.parent.mkdir(parents=True, exist_ok=True)
+            shutil.copy2(REPO / rel, dst)
+        doc = tmp_path / "docs" / "OBSERVABILITY.md"
+        lines = [line for line in doc.read_text().splitlines()
+                 if "`GET /api/admin/history`" not in line
+                 and not line.startswith("| `ttft` |")]
+        doc.write_text("\n".join(lines) + "\n")
+
+        proc = subprocess.run(
+            [sys.executable, "-m", "tools.analysis"],
+            capture_output=True, text=True, timeout=300, cwd=tmp_path)
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "GET /api/admin/history" in proc.stdout
+        assert "'ttft'" in proc.stdout
 
 
 # -- satellite CLI surfaces ----------------------------------------------------

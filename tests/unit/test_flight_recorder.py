@@ -1,0 +1,297 @@
+"""Flight recorder: the serving black box and its crash dumps (PR 16).
+
+Ring arithmetic and dump IO run pure-host (no jax); the engine
+integration pins the contract that matters: with the recorder ON the
+pump stamps every tick — including the tick a fault kills — without
+minting a single post-warmup compile fingerprint, and with it OFF the
+``step()`` path is the original body (byte-identical rollback). The
+supervisor writes exactly one dump per fatal, before failing the
+in-flight streams.
+"""
+import dataclasses
+import json
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tensorhive_tpu.models import decode
+from tensorhive_tpu.models.transformer import PRESETS, TransformerLM
+from tensorhive_tpu.serving import set_engine
+from tensorhive_tpu.serving.engine import SlotEngine
+from tensorhive_tpu.serving.faults import DeviceLostError, ServingFaultPlan
+from tensorhive_tpu.serving.flight_recorder import (
+    FlightRecorder,
+    list_crash_dumps,
+    load_crash_dump,
+    write_crash_dump,
+)
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device CPU platform"
+)
+
+F32_TINY = dataclasses.replace(PRESETS["tiny"], dtype=jnp.float32,
+                               use_flash=False, remat=False, max_seq_len=128)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return TransformerLM.init(jax.random.PRNGKey(0), F32_TINY)
+
+
+def make_engine(params, **kwargs):
+    kwargs.setdefault("slots", 2)
+    kwargs.setdefault("max_len", 96)
+    kwargs.setdefault("queue_depth", 8)
+    kwargs.setdefault("kv_quant", "off")
+    return SlotEngine(params, F32_TINY, **kwargs)
+
+
+def drain(engine):
+    while engine.has_work():
+        engine.step()
+
+
+# -- the ring ----------------------------------------------------------------
+
+def test_ring_records_and_wraps_with_fixed_capacity():
+    recorder = FlightRecorder(capacity=4)
+    for tick in range(10):
+        recorder.record(duration_s=0.001 * tick, admitted=tick, ts=float(tick))
+    assert recorder.recorded == 10
+    assert len(recorder) == 4
+    rows = recorder.snapshot()
+    # oldest-first, only the last `capacity` ticks survive the wrap
+    assert [r["tick"] for r in rows] == [6, 7, 8, 9]
+    assert [r["admitted"] for r in rows] == [6, 7, 8, 9]
+    assert rows[-1]["durationS"] == pytest.approx(0.009)
+
+
+def test_snapshot_limit_and_field_names():
+    recorder = FlightRecorder(capacity=8)
+    recorder.record(duration_s=0.5, admitted=1, prefill_chunks=2,
+                    decode_slots=3, slots_busy=4, queue_depth=5,
+                    pages_free=6, compiles=7, faults=8, ts=1.0)
+    recorder.record(duration_s=0.25, ts=2.0)
+    rows = recorder.snapshot(last_n=1)
+    assert len(rows) == 1 and rows[0]["tick"] == 1
+    full = recorder.snapshot()[0]
+    assert full == {"tick": 0, "ts": 1.0, "durationS": 0.5, "admitted": 1,
+                    "prefillChunks": 2, "decodeSlots": 3, "slotsBusy": 4,
+                    "queueDepth": 5, "pagesFree": 6, "compiles": 7,
+                    "faults": 8}
+
+
+def test_ring_clear_and_capacity_validation():
+    recorder = FlightRecorder(capacity=2)
+    recorder.record(duration_s=0.1, ts=0.0)
+    recorder.clear()
+    assert recorder.recorded == 0 and recorder.snapshot() == []
+    with pytest.raises(ValueError):
+        FlightRecorder(capacity=0)
+
+
+# -- crash dumps -------------------------------------------------------------
+
+def test_write_list_load_dump_roundtrip(tmp_path):
+    recorder = FlightRecorder(capacity=4)
+    recorder.record(duration_s=0.01, faults=1, ts=5.0)
+    path = write_crash_dump(
+        str(tmp_path), reason="DeviceLostError: injected", recorder=recorder,
+        inflight=[{"requestId": "r1", "outcome": None}],
+        alerts=["slo_burn_fast"], now=1_700_000_000.0)
+    dumps = list_crash_dumps(str(tmp_path))
+    assert len(dumps) == 1
+    assert dumps[0]["reason"] == "DeviceLostError: injected"
+    assert (dumps[0]["ticks"], dumps[0]["inFlight"],
+            dumps[0]["firingAlerts"]) == (1, 1, 1)
+    dump = load_crash_dump(str(tmp_path), dumps[0]["file"])
+    assert dump["schemaVersion"] == 1
+    assert dump["ticks"][-1]["faults"] == 1
+    assert dump["inFlight"][0]["requestId"] == "r1"
+    assert dump["firingAlerts"] == ["slo_burn_fast"]
+    with open(path) as handle:          # valid JSON on disk, atomic write
+        assert json.load(handle) == dump
+    assert not list(tmp_path.glob("*.tmp"))
+
+
+def test_dump_names_are_validated_against_traversal(tmp_path):
+    (tmp_path / "secret.txt").write_text("{}")
+    assert load_crash_dump(str(tmp_path), "../secret.txt") is None
+    assert load_crash_dump(str(tmp_path), "secret.txt") is None
+    assert load_crash_dump(str(tmp_path),
+                           "crash-20260101T000000-1.json") is None  # missing
+    assert list_crash_dumps(str(tmp_path)) == []    # non-dump files skipped
+    assert list_crash_dumps(str(tmp_path / "nope")) == []
+
+
+def test_old_dumps_pruned_past_max(tmp_path):
+    recorder = FlightRecorder(capacity=2)
+    for tick in range(5):
+        write_crash_dump(str(tmp_path), reason=f"crash {tick}",
+                         recorder=recorder, max_dumps=3,
+                         now=1_700_000_000.0 + 60.0 * tick)
+    dumps = list_crash_dumps(str(tmp_path))
+    assert len(dumps) == 3
+    assert [d["reason"] for d in dumps] == ["crash 4", "crash 3", "crash 2"]
+
+
+def test_dump_without_recorder_still_writes(tmp_path):
+    write_crash_dump(str(tmp_path), reason="no ring", recorder=None,
+                     now=1_700_000_000.0)
+    dump = load_crash_dump(str(tmp_path),
+                           list_crash_dumps(str(tmp_path))[0]["file"])
+    assert dump["ticks"] == [] and dump["ticksRecorded"] == 0
+
+
+# -- engine integration ------------------------------------------------------
+
+def test_engine_stamps_ticks_without_minting_fingerprints(params):
+    """Recorder ON, paged layout: every pump tick lands one row whose
+    work counts reflect the tick, and serving a request post-warmup
+    mints ZERO new compile fingerprints — the recorder is pure host
+    bookkeeping."""
+    recorder = FlightRecorder(capacity=64)
+    engine = make_engine(params, flight_recorder=recorder)
+    engine.warmup(prompt_lens=(8,))
+    before = set(decode._compile_seen)
+    ticks_before = recorder.recorded
+    handle = engine.submit([1, 2, 3, 4], max_new_tokens=4)
+    drain(engine)
+    assert handle.result(timeout_s=5)["outcome"] == "completed"
+    assert set(decode._compile_seen) == before      # zero recompiles
+    rows = [r for r in recorder.snapshot() if r["tick"] >= ticks_before]
+    assert rows, "serving ticks must be recorded"
+    assert sum(r["admitted"] for r in rows) == 1
+    assert sum(r["decodeSlots"] for r in rows) >= 4
+    assert max(r["slotsBusy"] for r in rows) >= 1
+    assert all(r["faults"] == 0 for r in rows)
+    assert all(r["durationS"] >= 0.0 for r in rows)
+
+
+def test_contiguous_layout_records_too(params):
+    recorder = FlightRecorder(capacity=32)
+    engine = make_engine(params, paged=False, flight_recorder=recorder)
+    engine.warmup(prompt_lens=(8,))
+    before = set(decode._compile_seen)
+    handle = engine.submit([5, 6, 7], max_new_tokens=3)
+    drain(engine)
+    assert handle.result(timeout_s=5)["outcome"] == "completed"
+    assert set(decode._compile_seen) == before
+    rows = recorder.snapshot()
+    # the contiguous rollback has no page pool: pagesFree stays 0
+    assert all(r["pagesFree"] == 0 for r in rows)
+    assert sum(r["admitted"] for r in rows) == 1
+
+
+def test_fault_raising_tick_is_still_recorded(params):
+    plan = ServingFaultPlan()
+    recorder = FlightRecorder(capacity=16)
+    engine = make_engine(params, fault_plan=plan, flight_recorder=recorder)
+    engine.submit([1, 2, 3], max_new_tokens=4)
+    engine.step()                       # admit + prefill + first decode
+    plan.fail_next("step", 1)
+    with pytest.raises(DeviceLostError):
+        engine.step()
+    last = recorder.snapshot()[-1]
+    # the tick that died is in the ring, stamped with its injection
+    assert last["faults"] == 1
+    assert recorder.recorded == 2
+
+
+def test_recorder_off_is_untouched_rollback(params):
+    """flight_recorder=None: no ring, no recording, and serving mints no
+    fingerprints beyond the recorder-on run — the off path is the
+    original step() body."""
+    engine = make_engine(params)
+    assert engine.flight_recorder is None
+    engine.warmup(prompt_lens=(8,))
+    before = set(decode._compile_seen)
+    handle = engine.submit([9, 8, 7], max_new_tokens=3)
+    drain(engine)
+    assert handle.result(timeout_s=5)["outcome"] == "completed"
+    assert set(decode._compile_seen) == before      # fingerprint delta empty
+
+
+# -- supervisor dump-on-fatal ------------------------------------------------
+
+@pytest.fixture()
+def supervised(config, params, db):
+    from tensorhive_tpu.core.services.generation import GenerationService
+
+    config.generation.interval_s = 0.05
+    config.generation.transient_backoff_s = 0.0
+    config.generation.flightrec_dumps = 4
+    plan = ServingFaultPlan()
+
+    def factory():
+        return make_engine(params, fault_plan=plan,
+                           flight_recorder=FlightRecorder(capacity=64))
+
+    service = GenerationService(config=config, engine=factory(),
+                                engine_factory=factory)
+    yield service, plan, config
+    service.shutdown()
+    set_engine(None)
+
+
+def test_fatal_fault_writes_exactly_one_dump_with_inflight_rows(supervised):
+    service, plan, config = supervised
+    doomed = service.engine.submit([1, 2, 3, 4], max_new_tokens=8)
+    plan.fail_next("step", 1)           # the first decode dispatch dies
+    service.do_run()                    # fatal -> dump -> fail fast -> rebuild
+    with pytest.raises(RuntimeError):
+        doomed.result(timeout_s=1)
+    dumps = list_crash_dumps(str(config.flightrec_dir))
+    assert len(dumps) == 1, "exactly one dump per fatal"
+    dump = load_crash_dump(str(config.flightrec_dir), dumps[0]["file"])
+    assert "DeviceLostError" in dump["reason"]
+    assert dump["ticks"][-1]["faults"] == 1
+    # the dump is written BEFORE fail_all_inflight: the doomed request is
+    # an in-flight row (outcome still None), not a failed one
+    inflight = {row["requestId"]: row for row in dump["inFlight"]}
+    assert doomed.request_id in inflight
+    assert inflight[doomed.request_id]["outcome"] is None
+
+    # a second fatal writes a second dump — one per incident, no more
+    service.engine.submit([4, 5, 6], max_new_tokens=8)
+    plan.fail_next("step", 1)
+    service.do_run()
+    assert len(list_crash_dumps(str(config.flightrec_dir))) == 2
+
+
+def test_fatal_without_recorder_writes_no_dump(config, params, db):
+    from tensorhive_tpu.core.services.generation import GenerationService
+
+    config.generation.transient_backoff_s = 0.0
+    config.generation.flight_recorder = False
+    plan = ServingFaultPlan()
+
+    def factory():
+        return make_engine(params, fault_plan=plan)
+
+    service = GenerationService(config=config, engine=factory(),
+                                engine_factory=factory)
+    try:
+        plan.fail_next("step", 1)
+        service.engine.submit([1, 2], max_new_tokens=2)
+        service.do_run()
+        assert list_crash_dumps(str(config.flightrec_dir)) == []
+    finally:
+        service.shutdown()
+        set_engine(None)
+
+
+def test_build_flight_recorder_respects_config(config):
+    from tensorhive_tpu.core.services.generation import build_flight_recorder
+
+    config.generation.flightrec_ticks = 33
+    recorder = build_flight_recorder(config.generation)
+    assert recorder is not None and recorder.capacity == 33
+    config.generation.flight_recorder = False
+    assert build_flight_recorder(config.generation) is None
+    config.generation.flight_recorder = True
+    config.generation.flightrec_ticks = 0
+    with pytest.raises(ValueError):
+        build_flight_recorder(config.generation)

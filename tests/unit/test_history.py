@@ -1,0 +1,284 @@
+"""Unit coverage for the metrics-history ring TSDB (PR 16 tentpole).
+
+Everything runs on an injected fake clock and a private registry. The
+load-bearing properties: downsample windows aggregate min/mean/max/last
+exactly, retention evicts, memory stays bounded by ``max_points`` no
+matter how many samples land, the allowlist filters, and ``increase``
+survives counter resets (the SLO engine's arithmetic substrate).
+"""
+from __future__ import annotations
+
+import pytest
+
+from tensorhive_tpu.observability.history import (
+    DEFAULT_MAX_POINTS,
+    MetricsHistory,
+    default_series,
+    get_metrics_history,
+    parse_series,
+    read_series,
+    set_metrics_history,
+)
+from tensorhive_tpu.observability.metrics import MetricsRegistry
+
+
+def make_history(series, registry, **kwargs):
+    kwargs.setdefault("retention_s", 100.0)
+    kwargs.setdefault("max_points", 10)
+    return MetricsHistory(series, registry=registry, **kwargs)
+
+
+# -- series-spec grammar -----------------------------------------------------
+
+def test_parse_series_grammar():
+    spec = parse_series("tpuhive_x")
+    assert (spec.name, spec.labels, spec.mode) == ("tpuhive_x", {}, "value")
+
+    spec = parse_series('tpuhive_x{outcome=failed, host="a"}')
+    assert spec.labels == {"outcome": "failed", "host": "a"}
+
+    spec = parse_series("tpuhive_x:count")
+    assert spec.mode == "count"
+
+    spec = parse_series("tpuhive_x{outcome=ok}:le:2.5")
+    assert (spec.mode, spec.bound, spec.labels) == (
+        "le", 2.5, {"outcome": "ok"})
+
+
+@pytest.mark.parametrize("bad", [
+    "",                         # empty name
+    ":count",                   # mode without a name
+    "tpuhive_x{outcome}",       # label without =
+    "tpuhive_x{outcome=a",      # unterminated labels
+    "tpuhive_x:quantile",       # unknown mode
+    "tpuhive_x:le",             # le without bound
+    "tpuhive_x:le:abc",         # non-numeric bound
+    "tpuhive_x:count:extra",    # trailing garbage
+])
+def test_parse_series_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        parse_series(bad)
+
+
+def test_read_series_modes_and_label_subset_match():
+    registry = MetricsRegistry()
+    reqs = registry.counter("reqs_total", "", labels=("outcome", "host"))
+    reqs.labels(outcome="ok", host="a").inc(3)
+    reqs.labels(outcome="ok", host="b").inc(4)
+    reqs.labels(outcome="bad", host="a").inc(9)
+    hist = registry.histogram("lat_seconds", "", buckets=(1.0, 2.0, 4.0))
+    for v in (0.5, 1.5, 3.0, 9.0):
+        hist.observe(v)
+
+    # subset label match sums across the unconstrained label
+    assert read_series(registry, parse_series(
+        "reqs_total{outcome=ok}")) == 7.0
+    assert read_series(registry, parse_series("reqs_total")) == 16.0
+    # histogram modes: count, sum, cumulative le (2.0 catches 0.5 + 1.5)
+    assert read_series(registry, parse_series("lat_seconds:count")) == 4.0
+    assert read_series(registry, parse_series("lat_seconds:sum")) == 14.0
+    assert read_series(registry, parse_series("lat_seconds:le:2.0")) == 2.0
+    # a bound between buckets snaps UP to the next bucket bound
+    assert read_series(registry, parse_series("lat_seconds:le:1.5")) == 2.0
+    # a bound past every bucket counts everything (the +Inf bucket)
+    assert read_series(registry, parse_series("lat_seconds:le:100")) == 4.0
+    # no signal: unregistered family, unmatched labels, mismatched mode
+    assert read_series(registry, parse_series("ghost_total")) is None
+    assert read_series(registry, parse_series(
+        "reqs_total{outcome=nope}")) is None
+    assert read_series(registry, parse_series("reqs_total:count")) is None
+
+
+def test_read_series_never_creates_children():
+    registry = MetricsRegistry()
+    reqs = registry.counter("reqs_total", "", labels=("outcome",))
+    reqs.labels(outcome="ok").inc()
+    read_series(registry, parse_series("reqs_total{outcome=ghost}"))
+    assert len(reqs.children()) == 1
+
+
+# -- sampling + downsampling -------------------------------------------------
+
+def test_window_aggregates_min_mean_max_last_exactly():
+    registry = MetricsRegistry()
+    depth = registry.gauge("depth", "")
+    history = make_history(["depth"], registry,
+                           retention_s=100.0, max_points=10)  # 10 s windows
+    for now, value in ((0.0, 4.0), (3.0, 1.0), (6.0, 7.0), (9.0, 2.0)):
+        depth.set(value)
+        assert history.sample(now=now) == 1
+    points = history.query()["depth"]
+    assert len(points) == 1
+    assert points[0] == {"ts": 0.0, "min": 1.0, "mean": 3.5, "max": 7.0,
+                         "last": 2.0, "count": 4}
+
+
+def test_windows_are_time_aligned_and_retention_evicts():
+    registry = MetricsRegistry()
+    depth = registry.gauge("depth", "")
+    history = make_history(["depth"], registry,
+                           retention_s=30.0, max_points=3)    # 10 s windows
+    depth.set(1.0)
+    for now in (0.0, 10.0, 20.0, 30.0, 40.0):
+        history.sample(now=now)
+    points = history.query()["depth"]
+    # windows older than retention are gone; the rest are window-aligned
+    assert [p["ts"] for p in points] == [20.0, 30.0, 40.0]
+    assert history.points_retained() == 3
+
+
+def test_memory_bounded_across_10k_samples():
+    registry = MetricsRegistry()
+    depth = registry.gauge("depth", "")
+    tokens = registry.counter("tok_total", "")
+    history = make_history(["depth", "tok_total"], registry,
+                           retention_s=50.0, max_points=5)
+    for tick in range(10_000):
+        depth.set(float(tick % 17))
+        tokens.inc()
+        history.sample(now=float(tick))
+    # the deque maxlen pins the bound even though eviction-by-retention
+    # would already hold: never more than series x max_points windows
+    assert history.points_retained() <= 2 * 5
+    assert history.samples_taken == 10_000
+    for points in history.query().values():
+        assert len(points) <= 5
+
+
+def test_allowlist_filters_and_silent_series_skip():
+    registry = MetricsRegistry()
+    registry.gauge("listed", "").set(1.0)
+    registry.gauge("unlisted", "").set(9.0)
+    history = make_history(["listed", "never_registered"], registry)
+    assert history.sample(now=0.0) == 1     # only the listed live series
+    result = history.query()
+    assert set(result) == {"listed", "never_registered"}
+    assert result["never_registered"] == []
+    assert "unlisted" not in result
+
+
+def test_duplicate_specs_collapse():
+    registry = MetricsRegistry()
+    registry.gauge("g", "").set(1.0)
+    history = make_history(["g", "g"], registry)
+    assert history.series_names() == ["g"]
+
+
+def test_query_since_and_step_rebucketing():
+    registry = MetricsRegistry()
+    depth = registry.gauge("depth", "")
+    history = make_history(["depth"], registry,
+                           retention_s=100.0, max_points=10)  # 10 s windows
+    for now, value in ((0.0, 1.0), (10.0, 3.0), (20.0, 5.0), (30.0, 7.0)):
+        depth.set(value)
+        history.sample(now=now)
+    # since drops windows that END before it
+    assert [p["ts"] for p in history.query(since=15.0)["depth"]] == \
+        [10.0, 20.0, 30.0]
+    # step=20 merges pairs of native windows; aggregates re-aggregate
+    merged = history.query(step=20.0)["depth"]
+    assert [p["ts"] for p in merged] == [0.0, 20.0]
+    assert merged[0] == {"ts": 0.0, "min": 1.0, "mean": 2.0, "max": 3.0,
+                         "last": 3.0, "count": 2}
+    # a sub-native step clamps to the native window width
+    assert history.query(step=1.0)["depth"] == history.query()["depth"]
+    # unknown-but-well-formed series answer empty, malformed raise
+    assert history.query(series=["ghost"])["ghost"] == []
+    with pytest.raises(ValueError):
+        history.query(series=["bad{spec"])
+
+
+def test_latest_returns_last_sampled_value():
+    registry = MetricsRegistry()
+    depth = registry.gauge("depth", "")
+    history = make_history(["depth"], registry)
+    assert history.latest("depth") is None
+    depth.set(4.0)
+    history.sample(now=0.0)
+    depth.set(6.0)
+    history.sample(now=1.0)
+    assert history.latest("depth") == 6.0
+
+
+def test_sample_refreshes_registry_collectors():
+    registry = MetricsRegistry()
+    gauge = registry.gauge("collected", "")
+    registry.register_collector(lambda reg: gauge.set(42.0))
+    history = make_history(["collected"], registry)
+    assert history.sample(now=0.0) == 1
+    assert history.latest("collected") == 42.0
+
+
+# -- increase (the burn-rate substrate) --------------------------------------
+
+def test_increase_measures_growth_within_window():
+    registry = MetricsRegistry()
+    counter = registry.counter("c_total", "")
+    history = make_history(["c_total"], registry,
+                           retention_s=100.0, max_points=10)
+    counter.inc(10)
+    history.sample(now=0.0)
+    counter.inc(2)
+    history.sample(now=10.0)
+    counter.inc(5)
+    history.sample(now=20.0)
+    # baseline = the t=0 window (fully before the cutoff at t=20-15=5)
+    assert history.increase("c_total", 15.0, now=20.0) == 7.0
+    # whole history in window: growth from the first retained sample
+    assert history.increase("c_total", 1000.0, now=20.0) == 7.0
+    # nothing sampled inside the window: zero growth, not None
+    assert history.increase("c_total", 0.001, now=500.0) == 0.0
+    assert history.increase("ghost", 15.0, now=20.0) is None
+
+
+def test_increase_tolerates_counter_reset():
+    registry = MetricsRegistry()
+    counter = registry.counter("c_total", "")
+    history = make_history(["c_total"], registry,
+                           retention_s=100.0, max_points=10)
+    counter.inc(100)
+    history.sample(now=0.0)
+    registry.get("c_total").reset_values()      # process-restart analog
+    counter.inc(3)
+    history.sample(now=10.0)
+    # 100 -> 3 is a reset: the post-reset value counts from zero (+3),
+    # never -97 — exactly the PR 4 increase-rule semantics
+    assert history.increase("c_total", 1000.0, now=10.0) == 3.0
+
+
+# -- process-wide store lifecycle --------------------------------------------
+
+def test_default_series_tracks_generation_slo_knobs(config):
+    config.generation.queue_wait_slo_s = 0.25
+    series = default_series(config.generation)
+    assert "tpuhive_generate_queue_wait_seconds:le:0.25" in series
+    assert "tpuhive_generate_queue_depth" in series
+
+
+def test_singleton_reads_config_and_resets(config):
+    config.history.retention_s = 120.0
+    config.history.max_points = 12
+    set_metrics_history(None)
+    try:
+        history = get_metrics_history()
+        assert history.retention_s == 120.0
+        assert history.window_s == 10.0
+        assert history is get_metrics_history()
+        config.history.series = "tpuhive_generate_queue_depth, ,"
+        set_metrics_history(None)
+        assert get_metrics_history().series_names() == [
+            "tpuhive_generate_queue_depth"]
+    finally:
+        set_metrics_history(None)
+
+
+def test_constructor_validation():
+    registry = MetricsRegistry()
+    with pytest.raises(ValueError):
+        MetricsHistory(["g"], registry=registry, retention_s=0.0)
+    with pytest.raises(ValueError):
+        MetricsHistory(["g"], registry=registry, max_points=0)
+    with pytest.raises(ValueError):
+        MetricsHistory(["bad{spec"], registry=registry)
+    assert MetricsHistory([], registry=registry).max_points == \
+        DEFAULT_MAX_POINTS

@@ -1,0 +1,299 @@
+"""Unit coverage for the SLO burn-rate engine (PR 16 tentpole).
+
+Everything runs on a fake clock over a private registry + history store:
+budget arithmetic is checked for exactness, the multi-window fast/slow
+signals drive the real AlertEngine through fire-exactly-once /
+resolve-exactly-once, counter resets don't fabricate budget spend, and
+the disabled/no-traffic posture is None (quiet), never zero.
+"""
+from __future__ import annotations
+
+import pytest
+
+from tensorhive_tpu.observability import get_registry
+from tensorhive_tpu.observability.alerts import AlertEngine, AlertRule
+from tensorhive_tpu.observability.history import (
+    MetricsHistory,
+    set_metrics_history,
+)
+from tensorhive_tpu.observability.metrics import MetricsRegistry
+from tensorhive_tpu.observability.slo import (
+    FAST_BURN,
+    SLOW_BURN,
+    SloEngine,
+    SloObjective,
+    default_objective_pack,
+    fast_burn_signal,
+    set_slo_engine,
+    slow_burn_signal,
+    window_label,
+)
+
+
+def make_plane(target=0.99, budget_window_s=600.0):
+    """Private registry + history + one-objective engine with 10 s
+    downsample windows covering the slow pair's 6 h lookback."""
+    registry = MetricsRegistry()
+    good = registry.counter("good_total", "")
+    total = registry.counter("all_total", "")
+    history = MetricsHistory(["good_total", "all_total"],
+                             registry=registry,
+                             retention_s=43200.0, max_points=4320)
+    objective = SloObjective(name="demo", target=target,
+                             good=("good_total",), total=("all_total",))
+    engine = SloEngine([objective], history=history,
+                       budget_window_s=budget_window_s)
+    return registry, good, total, history, objective, engine
+
+
+# -- objective validation ----------------------------------------------------
+
+def test_objective_validation():
+    with pytest.raises(ValueError):
+        SloObjective(name="", target=0.99, good=("g",), total=("t",))
+    for target in (0.0, 1.0, 1.5):
+        with pytest.raises(ValueError):
+            SloObjective(name="x", target=target, good=("g",), total=("t",))
+    with pytest.raises(ValueError):
+        SloObjective(name="x", target=0.99, good=(), total=("t",))
+    with pytest.raises(ValueError):        # malformed spec fails at boot
+        SloObjective(name="x", target=0.99, good=("bad{",), total=("t",))
+    with pytest.raises(ValueError):        # duplicate names
+        SloEngine([SloObjective(name="d", target=0.9, good=("g",),
+                                total=("t",))] * 2)
+    with pytest.raises(ValueError):
+        SloEngine([], budget_window_s=0.0)
+
+
+def test_window_labels():
+    assert [window_label(s) for s in (300.0, 1800.0, 3600.0, 21600.0, 7.5)] \
+        == ["5m", "30m", "1h", "6h", "7.5s"]
+
+
+# -- budget arithmetic exactness ---------------------------------------------
+
+def test_burn_rate_and_budget_arithmetic_exact():
+    _, good, total, history, objective, engine = make_plane(
+        target=0.99, budget_window_s=200.0)
+    # 11 samples at 10 s spacing: each inter-sample gap lands +10 total,
+    # +9 good, so growth over the full span is exactly 100 total / 90 good
+    for tick in range(11):
+        total.inc(10)
+        good.inc(9)
+        history.sample(now=10.0 * tick)
+    now = 100.0
+    assert engine.bad_fraction(objective, 200.0, now) == pytest.approx(0.1)
+    # burn = bad / (1 - target) = 0.1 / 0.01
+    assert engine.burn_rate(objective, 200.0, now) == pytest.approx(10.0)
+    # budget over the 200 s budget window: 1 - 10 = overspent by 9x
+    assert engine.budget_remaining(objective, now) == pytest.approx(-9.0)
+
+
+def test_perfect_traffic_burns_nothing_and_clamps():
+    _, good, total, history, objective, engine = make_plane()
+    for tick in range(10):
+        total.inc(5)
+        good.inc(5)
+        history.sample(now=10.0 * tick)
+    assert engine.burn_rate(objective, 600.0, 90.0) == 0.0
+    assert engine.budget_remaining(objective, 90.0) == 1.0
+    # good > total (misconfigured specs) clamps to 0 bad, never negative
+    good.inc(1000)
+    history.sample(now=100.0)
+    assert engine.bad_fraction(objective, 600.0, 100.0) == 0.0
+
+
+def test_no_traffic_and_unknown_series_mean_none_not_zero():
+    _, _, _, history, objective, engine = make_plane()
+    assert engine.bad_fraction(objective, 300.0, 0.0) is None
+    assert engine.burn_rate(objective, 300.0, 0.0) is None
+    assert engine.budget_remaining(objective, 0.0) is None
+    assert engine.fast_burn(0.0) is None
+    assert engine.slow_burn(0.0) is None
+    # evaluate() reports the None posture without minting gauges
+    report = engine.evaluate(now=0.0)
+    assert report["demo"]["budgetRemaining"] is None
+    assert all(v is None for v in report["demo"]["burnRates"].values())
+
+
+def test_counter_reset_does_not_fabricate_budget_spend():
+    registry, good, total, history, objective, engine = make_plane()
+    for tick in range(5):
+        total.inc(10)
+        good.inc(10)
+        history.sample(now=10.0 * tick)
+    registry.get("good_total").reset_values()   # process-restart analog
+    registry.get("all_total").reset_values()
+    total.inc(10)
+    good.inc(10)
+    history.sample(now=50.0)
+    bad = engine.bad_fraction(objective, 600.0, 50.0)
+    # reset-aware increase counts post-reset values from zero on BOTH
+    # series, so perfect traffic across a restart stays a zero burn
+    assert bad == 0.0
+
+
+def test_budget_remaining_decreases_monotonically_during_breach():
+    _, good, total, history, objective, engine = make_plane(
+        budget_window_s=3600.0)
+    for tick in range(180):                     # 30 min of good traffic
+        total.inc(10)
+        good.inc(10)
+        history.sample(now=10.0 * tick)
+    remaining = []
+    for tick in range(180, 240):                # 10 min of pure failure
+        total.inc(10)                           # good never increments
+        history.sample(now=10.0 * tick)
+        value = engine.budget_remaining(objective, now=10.0 * tick)
+        if value is not None:
+            remaining.append(value)
+    assert remaining, "breach traffic must produce budget readings"
+    assert all(b <= a + 1e-9 for a, b in zip(remaining, remaining[1:]))
+    assert remaining[-1] < remaining[0]
+
+
+# -- multi-window semantics ---------------------------------------------------
+
+def drive(history, good, total, start, end, good_rate, total_rate,
+          engine=None, alert_engine=None, events=None, step=10.0):
+    now = start
+    while now < end:
+        total.inc(total_rate)
+        good.inc(good_rate)
+        history.sample(now=now)
+        if alert_engine is not None:
+            events.extend(alert_engine.evaluate(now=now))
+        now += step
+    return now
+
+
+def test_short_window_alone_does_not_trip_the_fast_pair():
+    """One bad burst breaches the 5m window but the AND with the 1h
+    window keeps the fast signal low — the one-bad-scrape-never-pages
+    property the multi-window recipe exists for."""
+    _, good, total, history, objective, engine = make_plane()
+    drive(history, good, total, 0.0, 3600.0, 10, 10)   # an hour of good
+    # one 5-minute burst of pure failure
+    drive(history, good, total, 3600.0, 3900.0, 0, 10)
+    now = 3890.0
+    fast_short = engine.burn_rate(objective, 300.0, now)
+    fast_long = engine.burn_rate(objective, 3600.0, now)
+    assert fast_short >= FAST_BURN          # short window screams
+    assert fast_long < FAST_BURN            # long window says "blip"
+    assert engine.fast_burn(now) == pytest.approx(min(fast_short,
+                                                      fast_long))
+    assert engine.fast_burn(now) < FAST_BURN
+
+
+def test_fast_burn_alert_fires_exactly_once_and_resolves_exactly_once():
+    """The acceptance scenario: a sustained synthetic breach drives the
+    real AlertEngine through exactly one firing and one resolution via
+    the fast-pair source, on a fully fake clock."""
+    registry, good, total, history, objective, engine = make_plane()
+    clock = {"now": 0.0}
+    alert_engine = AlertEngine([
+        AlertRule(name="slo_burn_fast", severity="critical",
+                  kind="threshold", op=">=", threshold=FAST_BURN,
+                  for_s=0.0,
+                  source=lambda: engine.fast_burn(clock["now"])),
+        AlertRule(name="slo_burn_slow", severity="warning",
+                  kind="threshold", op=">=", threshold=SLOW_BURN,
+                  for_s=0.0,
+                  source=lambda: engine.slow_burn(clock["now"])),
+    ], registry=MetricsRegistry())
+
+    events = []
+
+    def run(start, end, good_rate, total_rate):
+        now = start
+        while now < end:
+            clock["now"] = now
+            total.inc(total_rate)
+            good.inc(good_rate)
+            history.sample(now=now)
+            events.extend(alert_engine.evaluate(now=now))
+            now += 10.0
+
+    run(0.0, 1800.0, 10, 10)            # healthy warm-up: no events
+    assert events == []
+    run(1800.0, 3000.0, 0, 10)          # 20 min of pure failure
+    fast = [e for e in events if e["rule"] == "slo_burn_fast"]
+    assert [e["to"] for e in fast] == ["firing"]
+    run(3000.0, 7200.0, 50, 50)         # heavy good traffic: recovery
+    fast = [e for e in events if e["rule"] == "slo_burn_fast"]
+    assert [e["to"] for e in fast] == ["firing", "resolved"]
+    # no flapping: exactly one firing and one resolution total
+    assert alert_engine.dump()["rules"][0]["firedCount"] == 1
+
+
+def test_worst_objective_wins_across_the_pack():
+    registry = MetricsRegistry()
+    good_a = registry.counter("good_a_total", "")
+    total_a = registry.counter("all_a_total", "")
+    good_b = registry.counter("good_b_total", "")
+    total_b = registry.counter("all_b_total", "")
+    history = MetricsHistory(
+        ["good_a_total", "all_a_total", "good_b_total", "all_b_total"],
+        registry=registry, retention_s=43200.0, max_points=4320)
+    engine = SloEngine([
+        SloObjective(name="healthy", target=0.99,
+                     good=("good_a_total",), total=("all_a_total",)),
+        SloObjective(name="burning", target=0.99,
+                     good=("good_b_total",), total=("all_b_total",)),
+    ], history=history)
+    for tick in range(720):             # 2 h: objective B fails constantly
+        good_a.inc(10)
+        total_a.inc(10)
+        total_b.inc(10)
+        history.sample(now=10.0 * tick)
+    now = 7190.0
+    assert engine.fast_burn(now) == pytest.approx(
+        engine._multiwindow_burn(engine.objectives[1], (300.0, 3600.0),
+                                 now))
+    assert engine.fast_burn(now) >= FAST_BURN
+
+
+# -- gauges + process-wide posture -------------------------------------------
+
+def test_evaluate_exports_gauges_for_live_signals_only():
+    _, good, total, history, objective, engine = make_plane()
+    for tick in range(60):
+        total.inc(10)
+        good.inc(5)
+        history.sample(now=10.0 * tick)
+    engine.evaluate(now=590.0)
+    burn_children = dict(get_registry().get(
+        "tpuhive_slo_burn_rate").children())
+    # 5m and 1h windows have traffic; 6h shares the same samples (they
+    # are all inside it), so every window labels a child for "demo"
+    assert ("demo", "5m") in burn_children
+    budget_children = dict(get_registry().get(
+        "tpuhive_slo_error_budget_remaining").children())
+    assert ("demo",) in budget_children
+
+
+def test_signals_are_none_while_disabled_or_quiet(config):
+    set_metrics_history(None)
+    set_slo_engine(None)
+    try:
+        config.slo.enabled = False
+        assert fast_burn_signal(0.0) is None
+        assert slow_burn_signal(0.0) is None
+        config.slo.enabled = True
+        # enabled but zero traffic: still None (quiet, not firing)
+        assert fast_burn_signal(0.0) is None
+    finally:
+        set_metrics_history(None)
+        set_slo_engine(None)
+
+
+def test_default_objective_pack_reads_config_thresholds(config):
+    config.generation.queue_wait_slo_s = 0.5
+    config.slo.availability_target = 0.95
+    pack = {o.name: o for o in default_objective_pack(config)}
+    assert set(pack) == {"queue_wait", "ttft", "availability"}
+    assert pack["availability"].target == 0.95
+    assert "tpuhive_generate_queue_wait_seconds:le:0.5" in \
+        pack["queue_wait"].good
+    outcomes = " ".join(pack["availability"].total)
+    assert "failed" in outcomes and "timeout" in outcomes

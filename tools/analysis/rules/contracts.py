@@ -20,9 +20,19 @@ even under ``--changed-only``) and checks:
   full name in the same row completes it to a registered metric.
 * **config knob docs rows** — every field of ``GenerationConfig``
   (``[generation_service]``) has a ``| `knob` |`` row in docs/SERVING.md,
-  every ``ProfilingConfig`` (``[profiling]``) knob appears in
+  every ``ProfilingConfig`` (``[profiling]``), ``HistoryConfig``
+  (``[history]``) and ``SloConfig`` (``[slo]``) knob appears in
   docs/OBSERVABILITY.md; reverse direction: every key row of SERVING.md's
   config table names a real field.
+* **observability endpoints, bidirectionally** — every route the
+  observability controller registers has a ``| `METHOD /api/...` |`` row
+  in docs/OBSERVABILITY.md, and every row of its ``## Endpoints`` table
+  names a route some controller actually registers.
+* **SLO objectives vs their table** — every ``SloObjective(name=...)``
+  in the default pack (observability/slo.py) has a row in
+  docs/OBSERVABILITY.md's objective table (first cell the backticked
+  name, second cell the percent target), and every such row names a
+  shipped objective.
 * **stats schema vs the dashboard** — every ``stats.<key>`` fragment
   nodes.js renders must be a key of ``STATS_SCHEMA``
   (controllers/generate.py) — the exact drift the ui-contract tests pin
@@ -168,6 +178,91 @@ def alert_pack_rules(tree: ast.AST) -> List[Tuple[str, int]]:
     return rules
 
 
+ENDPOINT_ROW_RE = re.compile(r"`(GET|POST|PUT|DELETE|PATCH)\s+(/api/\S+)`")
+PERCENT_RE = re.compile(r"^\d+(\.\d+)?\s*%")
+
+
+def controller_routes(path: Path) -> List[Tuple[str, str, int]]:
+    """(method, path, line) for every ``@route("/p", ["GET", ...])``
+    decorator in one controller module (literal args only)."""
+    try:
+        tree = ast.parse(path.read_text())
+    except (OSError, SyntaxError):
+        return []
+    routes: List[Tuple[str, str, int]] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "route"
+                and len(node.args) >= 2
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+                and isinstance(node.args[1], (ast.List, ast.Tuple))):
+            continue
+        for elt in node.args[1].elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                routes.append((elt.value, node.args[0].value, node.lineno))
+    return routes
+
+
+def endpoint_table_rows(text: str) -> List[Tuple[int, str, str]]:
+    """(line, method, path) rows of the FIRST table after the
+    ``## Endpoints`` heading in docs/OBSERVABILITY.md."""
+    rows: List[Tuple[int, str, str]] = []
+    in_section = False
+    in_table = False
+    for lineno, line in enumerate(text.splitlines(), start=1):
+        if line.startswith("## "):
+            if in_table:
+                break
+            in_section = line.strip() == "## Endpoints"
+            continue
+        if not in_section:
+            continue
+        if line.lstrip().startswith("|"):
+            in_table = True
+            match = ENDPOINT_ROW_RE.search(line)
+            if match:
+                rows.append((lineno, match.group(1), match.group(2)))
+        elif in_table:
+            break               # first table ended
+    return rows
+
+
+def slo_objective_names(tree: ast.AST) -> List[Tuple[str, int]]:
+    """Every ``SloObjective(name="...")`` keyword literal — the AST-exact
+    twin of :func:`alert_pack_rules` for the SLO pack."""
+    names: List[Tuple[str, int]] = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and ((isinstance(node.func, ast.Name)
+                      and node.func.id == "SloObjective")
+                     or (isinstance(node.func, ast.Attribute)
+                         and node.func.attr == "SloObjective"))):
+            continue
+        for kw in node.keywords:
+            if kw.arg == "name" and isinstance(kw.value, ast.Constant) \
+                    and isinstance(kw.value.value, str):
+                names.append((kw.value.value, node.lineno))
+    return names
+
+
+def doc_objective_rows(text: str) -> List[Tuple[int, str]]:
+    """(line, objective-name) for SLO objective table rows:
+    ``| `name` | NN% ... |`` — a backticked first cell with a
+    percent-target second cell (the shape that distinguishes the
+    objective table from the rule and metric tables)."""
+    rows: List[Tuple[int, str]] = []
+    for lineno, row in _doc_table_rows(text):
+        cells = [cell.strip() for cell in row.strip().strip("|").split("|")]
+        if len(cells) < 2 or not PERCENT_RE.match(cells[1]):
+            continue
+        match = re.fullmatch(r"`([a-z0-9_]+)`", cells[0])
+        if match:
+            rows.append((lineno, match.group(1)))
+    return rows
+
+
 def doc_rule_rows(text: str) -> List[Tuple[int, str]]:
     """(line, rule-name) for rule-pack table rows: ``| `name` | severity |``
     where the second cell is a severity word."""
@@ -219,6 +314,8 @@ class CrossArtifactRule(ProjectRule):
         findings.extend(self._check_config_knobs(root))
         findings.extend(self._check_stats_schema(root))
         findings.extend(self._check_alert_rules(root))
+        findings.extend(self._check_admin_endpoints(root))
+        findings.extend(self._check_slo_objectives(root))
         return findings
 
     # -- metrics ------------------------------------------------------------
@@ -305,18 +402,21 @@ class CrossArtifactRule(ProjectRule):
                         "field — the docs drifted from config.py"))
         if observability_doc.exists():
             text = observability_doc.read_text()
-            for name, lineno in dataclass_fields(tree, "ProfilingConfig"):
-                row = re.search(r"\|\s*`" + re.escape(name) + r"`\s*\|",
-                                text)
-                snippet = re.search(
-                    r"^\s*#?\s*" + re.escape(name) + r"\s*=", text,
-                    flags=re.MULTILINE)
-                if not row and not snippet:
-                    findings.append(Finding(
-                        self.id, config_rel, lineno,
-                        f"[profiling] knob {name!r} is not documented in "
-                        "docs/OBSERVABILITY.md (neither a table row nor "
-                        "the config snippet)"))
+            for class_name, section in (("ProfilingConfig", "profiling"),
+                                        ("HistoryConfig", "history"),
+                                        ("SloConfig", "slo")):
+                for name, lineno in dataclass_fields(tree, class_name):
+                    row = re.search(r"\|\s*`" + re.escape(name) + r"`\s*\|",
+                                    text)
+                    snippet = re.search(
+                        r"^\s*#?\s*" + re.escape(name) + r"\s*=", text,
+                        flags=re.MULTILINE)
+                    if not row and not snippet:
+                        findings.append(Finding(
+                            self.id, config_rel, lineno,
+                            f"[{section}] knob {name!r} is not documented "
+                            "in docs/OBSERVABILITY.md (neither a table row "
+                            "nor the config snippet)"))
         return findings
 
     # -- stats schema vs dashboard ------------------------------------------
@@ -384,6 +484,78 @@ class CrossArtifactRule(ProjectRule):
                     f"rule table documents {name!r} but the default alert "
                     "pack ships no rule by that name — the docs drifted "
                     "from observability/alerts.py"))
+        return findings
+
+    # -- observability endpoints vs docs table ------------------------------
+    def _check_admin_endpoints(self, root: Path) -> List[Finding]:
+        controllers = root / "tensorhive_tpu" / "controllers"
+        obs_controller = controllers / "observability.py"
+        doc_path = root / "docs" / "OBSERVABILITY.md"
+        if not obs_controller.exists() or not doc_path.exists():
+            return []
+        obs_routes = controller_routes(obs_controller)
+        if not obs_routes:
+            return []
+        text = doc_path.read_text()
+        doc_rows = endpoint_table_rows(text)
+        documented = {(method, path) for _, method, path in doc_rows}
+        findings: List[Finding] = []
+        obs_rel = obs_controller.relative_to(root).as_posix()
+        for method, path, lineno in obs_routes:
+            if (method, "/api" + path) not in documented:
+                findings.append(Finding(
+                    self.id, obs_rel, lineno,
+                    f"observability endpoint {method} /api{path} has no "
+                    "row in docs/OBSERVABILITY.md's endpoint table — "
+                    "every operator surface needs its contract "
+                    "documented"))
+        registered = {(method, "/api" + path)
+                      for controller in sorted(controllers.glob("*.py"))
+                      for method, path, _ in controller_routes(controller)}
+        doc_rel = doc_path.relative_to(root).as_posix()
+        for lineno, method, path in doc_rows:
+            if (method, path) not in registered:
+                findings.append(Finding(
+                    self.id, doc_rel, lineno,
+                    f"endpoint table documents {method} {path} but no "
+                    "controller registers that route — the docs drifted "
+                    "from the code"))
+        return findings
+
+    # -- SLO objective pack vs objective table ------------------------------
+    def _check_slo_objectives(self, root: Path) -> List[Finding]:
+        slo_path = root / "tensorhive_tpu" / "observability" / "slo.py"
+        doc_path = root / "docs" / "OBSERVABILITY.md"
+        if not slo_path.exists() or not doc_path.exists():
+            return []
+        try:
+            tree = ast.parse(slo_path.read_text())
+        except SyntaxError:
+            return []
+        pack = slo_objective_names(tree)
+        if not pack:
+            return []
+        text = doc_path.read_text()
+        rows = doc_objective_rows(text)
+        documented = {name for _, name in rows}
+        pack_names = {name for name, _ in pack}
+        findings: List[Finding] = []
+        slo_rel = slo_path.relative_to(root).as_posix()
+        for name, lineno in pack:
+            if name not in documented:
+                findings.append(Finding(
+                    self.id, slo_rel, lineno,
+                    f"SLO objective {name!r} ships in the default pack "
+                    "but has no row in docs/OBSERVABILITY.md's objective "
+                    "table"))
+        doc_rel = doc_path.relative_to(root).as_posix()
+        for lineno, name in rows:
+            if name not in pack_names:
+                findings.append(Finding(
+                    self.id, doc_rel, lineno,
+                    f"objective table documents {name!r} but the default "
+                    "SLO pack ships no objective by that name — the docs "
+                    "drifted from observability/slo.py"))
         return findings
 
 

@@ -1,0 +1,277 @@
+"""Smoke-test the time-aware telemetry plane end to end
+(``make history-smoke``; docs/OBSERVABILITY.md "History, SLOs & flight
+recorder").
+
+Boots the real daemon surface — WSGI app over a real socket, a live
+GenerationService pump, a HistoryService sampling every 0.05 s, in-memory
+DB — around a flight-recorder-equipped engine wired to a seeded
+:class:`ServingFaultPlan`, then proves the observability contract over
+HTTP:
+
+1. a streamed ``POST /api/generate`` request completes and
+   ``GET /api/admin/history`` answers with **>= 2 samples** of
+   ``tpuhive_generate_queue_depth`` (the ring TSDB is live, windows carry
+   min/mean/max/last/count);
+2. the ``/api/metrics`` scrape carries a ``tpuhive_slo_burn_rate`` gauge —
+   the SLO engine computed a burn over the sampled history (0.0 for
+   healthy traffic, never absent once traffic flowed);
+3. ``GET /api/admin/flightrec`` serves the live tick ring with the served
+   request's work stamped into it;
+4. an injected fatal (``fail_next("step")``) kills the stream terminally,
+   the supervisor restarts the engine, and ``GET
+   /api/admin/flightrec/dumps`` serves **exactly one** crash dump whose
+   last tick shows the fault injection and whose in-flight rows include
+   the doomed request.
+
+Engines run the f32 tiny config (like the unit suite). Exit 0 = healthy.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+import sys
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+os.environ.setdefault("TPUHIVE_PYTEST", "1")          # DB goes in-memory
+
+SEED = 42
+PROMPT = [3, 4, 5, 6, 7, 8, 9, 10]
+NEW_TOKENS = 8
+SAMPLE_INTERVAL_S = 0.05
+
+PROBLEMS = []
+
+
+def check(ok: bool, what: str) -> None:
+    status = "ok" if ok else "FAIL"
+    print(f"history-smoke: {status}: {what}")
+    if not ok:
+        PROBLEMS.append(what)
+
+
+def request(url: str, body=None, headers=None, method=None):
+    """(status, text, headers) over real HTTP; >=400 is a result."""
+    data = None if body is None else json.dumps(body).encode()
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers={"Content-Type": "application/json",
+                                          **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return resp.status, resp.read().decode(), dict(resp.headers)
+    except urllib.error.HTTPError as exc:
+        return exc.code, exc.read().decode(), dict(exc.headers)
+
+
+def stream_request(base: str, auth: dict, max_new: int):
+    """Stream one generate request; returns the parsed NDJSON lines."""
+    req = urllib.request.Request(
+        f"{base}/generate",
+        data=json.dumps({"promptTokens": PROMPT, "maxNewTokens": max_new,
+                         "temperature": 0}).encode(),
+        headers={"Content-Type": "application/json", **auth})
+    lines = []
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        while True:
+            raw = resp.readline()
+            if not raw:
+                break
+            lines.append(json.loads(raw))
+    return lines
+
+
+def wait_for(predicate, timeout_s: float = 10.0, interval_s: float = 0.02):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval_s)
+    return False
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from tensorhive_tpu.config import Config, set_config
+
+    config_dir = Path("/tmp/tpuhive-history-smoke")
+    shutil.rmtree(config_dir, ignore_errors=True)     # stale dumps poison
+    config = Config(config_dir=config_dir)            # the exactly-one gate
+    config.api.secret_key = "history-smoke-secret"
+    config.generation.enabled = True
+    config.generation.interval_s = 0.01
+    config.generation.transient_backoff_s = 0.0
+    config.history.sample_interval_s = SAMPLE_INTERVAL_S
+    set_config(config)
+
+    from tensorhive_tpu.db.engine import Engine, set_engine as set_db
+    from tensorhive_tpu.db.migrations import ensure_schema
+
+    engine_db = Engine(":memory:")
+    ensure_schema(engine_db)
+    set_db(engine_db)
+
+    from tensorhive_tpu.db.models import User
+
+    admin = User(username="smoke-admin", email="smoke@example.com",
+                 password="SuperSecret42").save()
+    admin.add_role("user")
+    admin.add_role("admin")
+
+    from tensorhive_tpu import serving
+    from tensorhive_tpu.core.services.generation import (
+        GenerationService,
+        build_flight_recorder,
+    )
+    from tensorhive_tpu.core.services.history import HistoryService
+    from tensorhive_tpu.models.transformer import PRESETS, TransformerLM
+    from tensorhive_tpu.serving.engine import SlotEngine
+    from tensorhive_tpu.serving.faults import ServingFaultPlan
+
+    f32_tiny = dataclasses.replace(PRESETS["tiny"], dtype=jnp.float32,
+                                   use_flash=False, remat=False,
+                                   max_seq_len=128)
+    params = TransformerLM.init(jax.random.PRNGKey(0), f32_tiny)
+
+    plan = ServingFaultPlan(seed=SEED)
+    print(f"history-smoke: seed={SEED} "
+          f"sample_interval_s={SAMPLE_INTERVAL_S}")
+
+    def factory():
+        engine = SlotEngine(params, f32_tiny, slots=2, max_len=96,
+                            queue_depth=4, kv_quant="off", fault_plan=plan,
+                            flight_recorder=build_flight_recorder(
+                                config.generation))
+        engine.warmup(prompt_lens=(len(PROMPT),))
+        return engine
+
+    generation = GenerationService(config=config, engine=factory(),
+                                   engine_factory=factory)
+    generation.start()
+    history_service = HistoryService(config=config)
+    history_service.start()
+
+    from tensorhive_tpu.api.server import APIServer
+
+    server = APIServer()
+    server.config.api.url_hostname = "127.0.0.1"
+    server.config.api.url_port = 0                     # ephemeral
+    port = server.start()
+    base = f"http://127.0.0.1:{port}/api"
+    try:
+        status, body, _ = request(f"{base}/user/login", body={
+            "username": "smoke-admin", "password": "SuperSecret42"})
+        check(status == 200, f"admin login over HTTP (got {status})")
+        auth = {"Authorization": "Bearer " + json.loads(body)["accessToken"]}
+
+        # -- 1: serve a request, then read its trace out of the TSDB ------
+        lines = stream_request(base, auth, NEW_TOKENS)
+        check(lines[-1].get("outcome") == "completed",
+              f"baseline stream completed ({lines[-1]})")
+        # let the 0.05s sampler land a few post-request passes
+        time.sleep(SAMPLE_INTERVAL_S * 6)
+
+        def depth_samples():
+            status, body, _ = request(
+                f"{base}/admin/history?series=tpuhive_generate_queue_depth",
+                headers=auth)
+            if status != 200:
+                return -1
+            points = json.loads(body)["series"].get(
+                "tpuhive_generate_queue_depth", [])
+            return sum(point["count"] for point in points)
+
+        check(wait_for(lambda: depth_samples() >= 2, timeout_s=5.0),
+              f"history holds >= 2 queue-depth samples "
+              f"(got {depth_samples()})")
+
+        status, body, _ = request(f"{base}/admin/history", headers=auth)
+        payload = json.loads(body)
+        check(status == 200 and payload["sampleIntervalS"] ==
+              SAMPLE_INTERVAL_S,
+              "history endpoint reports the configured sampling cadence")
+        depth_points = payload["series"].get(
+            "tpuhive_generate_queue_depth", [])
+        check(all(set(point) == {"ts", "min", "mean", "max", "last",
+                                 "count"} for point in depth_points),
+              "windows carry min/mean/max/last/count aggregates")
+
+        # -- 2: the SLO engine exported a burn gauge over that history -----
+        # a second request makes the outcome counters GROW between samples
+        # (a counter born mid-run at its final value has no in-window
+        # increase, so the burn stays None until traffic actually flows)
+        lines = stream_request(base, auth, NEW_TOKENS)
+        check(lines[-1].get("outcome") == "completed",
+              "second request completed (burn-rate traffic)")
+
+        def burn_gauge_lines():
+            status, scrape, _ = request(f"{base}/metrics")
+            if status != 200:
+                return []
+            return [line for line in scrape.splitlines()
+                    if line.startswith("tpuhive_slo_burn_rate{")]
+
+        check(wait_for(lambda: len(burn_gauge_lines()) >= 1, timeout_s=5.0),
+              f"tpuhive_slo_burn_rate gauge in the scrape "
+              f"({burn_gauge_lines()[:2]})")
+
+        # -- 3: the live flight-recorder ring shows the served work --------
+        status, body, _ = request(f"{base}/admin/flightrec", headers=auth)
+        ring = json.loads(body)
+        check(status == 200 and ring["engineUp"] and ring["recorded"] >= 1,
+              f"live flightrec ring is up with recorded ticks "
+              f"(got {status}, recorded={ring.get('recorded')})")
+        check(sum(t["admitted"] for t in ring["ticks"]) >= 1,
+              "ring ticks stamp the served request's admission")
+
+        # -- 4: injected fatal -> exactly one crash dump -------------------
+        plan.fail_next("step", 1)
+        lines = stream_request(base, auth, max_new=24)
+        check("error" in lines[-1],
+              f"injected fatal ended the stream terminally ({lines[-1]})")
+        check(wait_for(lambda: serving.get_engine() is not None,
+                       timeout_s=10.0),
+              "engine restarted after the fatal")
+
+        status, body, _ = request(f"{base}/admin/flightrec/dumps",
+                                  headers=auth)
+        dumps = json.loads(body)["dumps"]
+        check(status == 200 and len(dumps) == 1,
+              f"exactly one crash dump after one fatal (got {len(dumps)})")
+        status, body, _ = request(
+            f"{base}/admin/flightrec/dumps?file={dumps[0]['file']}",
+            headers=auth)
+        dump = json.loads(body)
+        check(status == 200 and "DeviceLostError" in dump.get("reason", ""),
+              f"dump names the fatal ({dump.get('reason')})")
+        check(dump["ticks"][-1]["faults"] >= 1,
+              "dump's last tick shows the fault injection")
+        check(len(dump["inFlight"]) >= 1 and
+              all(row["outcome"] is None for row in dump["inFlight"]),
+              "dump snapshots the in-flight rows before fail-fast")
+    finally:
+        server.stop()
+        history_service.shutdown()
+        history_service.join(timeout=10)
+        generation.shutdown()
+        generation.join(timeout=10)
+
+    if PROBLEMS:
+        print(f"history-smoke: {len(PROBLEMS)} problem(s)", file=sys.stderr)
+        return 1
+    print("history-smoke: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
