@@ -2,7 +2,7 @@
 # mypy + flake8 per .circleci/config.yml:33-38): the dependency-free AST
 # lint + thivelint analyzer always run; mypy/ruff run when installed
 # (absent from this image).
-.PHONY: check lint analysis analysis-fast test bench probe metrics-smoke decode-smoke alerts-smoke chaos-smoke serving-smoke serving-mesh-smoke trace-smoke prefix-smoke spec-smoke serving-chaos-smoke quant-smoke history-smoke
+.PHONY: check lint analysis analysis-fast lockcheck test bench probe metrics-smoke decode-smoke alerts-smoke chaos-smoke serving-smoke serving-mesh-smoke trace-smoke prefix-smoke spec-smoke serving-chaos-smoke quant-smoke history-smoke
 
 check: lint analysis
 	@command -v ruff >/dev/null 2>&1 && ruff check . || echo "ruff not installed; skipped (tools/lint.py covered the always-on subset)"
@@ -25,6 +25,16 @@ analysis:
 # not slip through a code-only diff. The full walk stays the CI gate.
 analysis-fast:
 	python -m tools.analysis --changed-only
+
+# the interprocedural deadlock pass alone (docs/STATIC_ANALYSIS.md
+# "TH-LOCK"), then both serving smokes re-run with the runtime lock
+# witness on: zero observed ABBA inversions and every observed order edge
+# must exist in the static graph — a green run is an executable proof the
+# static model over-approximates the program it claims to describe
+lockcheck:
+	python -m tools.analysis --select TH-LOCK
+	TPUHIVE_LOCK_WITNESS=1 python tools/trace_smoke.py
+	TPUHIVE_LOCK_WITNESS=1 python tools/serving_chaos_smoke.py
 
 test:
 	python -m pytest tests/ -q

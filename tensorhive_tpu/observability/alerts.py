@@ -40,7 +40,6 @@ from __future__ import annotations
 
 import json
 import logging
-import threading
 import time
 import urllib.error
 import urllib.request
@@ -58,6 +57,8 @@ from typing import (
 )
 
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry
+
+from ..utils import lockwitness
 
 log = logging.getLogger(__name__)
 
@@ -207,7 +208,7 @@ class AlertEngine:
 
             registry = get_registry()
         self._registry = registry
-        self._lock = threading.Lock()
+        self._lock = lockwitness.Lock("AlertEngine._lock")
         self._states: Dict[str, AlertState] = {
             rule.name: AlertState() for rule in self.rules}
         self._transitions: Deque[Dict] = deque(maxlen=HISTORY_CAPACITY)
@@ -262,11 +263,18 @@ class AlertEngine:
         (``pending -> firing`` and ``firing -> resolved``), in rule order."""
         if now is None:
             now = time.time()
+        # signal reading happens OUTSIDE the engine lock: rule.source()
+        # callables reach into the serving engine, the service manager and
+        # the SLO/history stores — each with locks of its own. Holding
+        # self._lock across those calls couples this engine's lock to code
+        # it does not control (TH-LOCK check (c)); the state machines only
+        # need the snapshot.
+        values = {rule.name: self._read_value(rule) for rule in self.rules}
         notifications: List[Dict] = []
         with self._lock:
             for rule in self.rules:
                 state = self._states[rule.name]
-                value = self._read_value(rule)
+                value = values[rule.name]
                 state.last_value = value
                 breached = self._breached(rule, state, value, now)
                 event = self._advance(rule, state, breached, value, now)
@@ -732,7 +740,8 @@ def default_rule_pack(monitoring_interval_s: Optional[float] = None,
 
 # -- process-wide engine -----------------------------------------------------
 _engine: Optional[AlertEngine] = None
-_engine_lock = threading.Lock()
+_engine_lock = lockwitness.Lock(
+    "tensorhive_tpu.observability.alerts._engine_lock")
 
 
 def get_alert_engine() -> AlertEngine:

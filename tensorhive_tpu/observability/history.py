@@ -42,13 +42,14 @@ sample_interval_s`` seconds. Queryable at ``GET /api/admin/history``
 from __future__ import annotations
 
 import logging
-import threading
 import time
 from bisect import bisect_left
 from collections import deque
 from typing import Deque, Dict, List, Optional, Sequence, Tuple
 
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry
+
+from ..utils import lockwitness
 
 log = logging.getLogger(__name__)
 
@@ -222,7 +223,7 @@ class MetricsHistory:
                 continue
             seen.add(spec.raw)
             self._specs.append(spec)
-        self._lock = threading.Lock()
+        self._lock = lockwitness.Lock("MetricsHistory._lock")
         self._data: Dict[str, Deque[_Window]] = {
             spec.raw: deque(maxlen=self.max_points) for spec in self._specs}
         self.samples_taken = 0
@@ -404,7 +405,8 @@ def default_series(generation=None) -> List[str]:
 
 # -- process-wide store -------------------------------------------------------
 _history: Optional[MetricsHistory] = None
-_history_lock = threading.Lock()
+_history_lock = lockwitness.Lock(
+    "tensorhive_tpu.observability.history._history_lock")
 
 
 def get_metrics_history() -> MetricsHistory:

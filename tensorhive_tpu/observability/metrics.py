@@ -30,6 +30,8 @@ import os
 import threading
 import time
 from bisect import bisect_left
+
+from ..utils import lockwitness
 from typing import (
     Callable,
     Dict,
@@ -158,7 +160,8 @@ class Histogram:
             raise ValueError("histogram needs at least one bucket bound")
         if any(b2 <= b1 for b1, b2 in zip(bounds, bounds[1:])):
             raise ValueError("bucket bounds must be strictly increasing")
-        self._lock = lock or threading.Lock()
+        self._lock = lock or lockwitness.Lock("Histogram._lock",
+                                              export_wait=False)
         self.buckets = bounds
         self._counts = [0] * (len(bounds) + 1)   # +1 for the +Inf bucket
         self._sum = 0.0
@@ -246,7 +249,8 @@ class MetricFamily:
         self.help_text = help_text
         self.label_names = tuple(label_names)
         self.bucket_bounds = tuple(buckets)
-        self._lock = threading.Lock()
+        self._lock = lockwitness.Lock("MetricFamily._lock",
+                                      export_wait=False)
         self._children: Dict[Tuple[str, ...], object] = {}
 
     def labels(self, **labels: str):
@@ -309,7 +313,8 @@ class MetricsRegistry:
     """Thread-safe collection of metric families + Prometheus rendering."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = lockwitness.Lock("MetricsRegistry._lock",
+                                      export_wait=False)
         self._families: Dict[str, MetricFamily] = {}
         self._collectors: List[Callable[["MetricsRegistry"], None]] = []
 

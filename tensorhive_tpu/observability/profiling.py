@@ -27,12 +27,13 @@ from __future__ import annotations
 
 import logging
 import sys
-import threading
 import time
 from pathlib import Path
 from typing import Callable, Dict, Iterator, List, Optional, Tuple
 
 from .metrics import MetricsRegistry
+
+from ..utils import lockwitness
 
 log = logging.getLogger(__name__)
 
@@ -53,7 +54,8 @@ class ProfileUnavailableError(Exception):
 
 # -- trace capture (single-flight) -------------------------------------------
 
-_capture_lock = threading.Lock()
+_capture_lock = lockwitness.Lock(
+    "tensorhive_tpu.observability.profiling._capture_lock")
 
 
 def capture_trace(artifact_dir: str, duration_s: float,

@@ -34,10 +34,11 @@ from __future__ import annotations
 import collections
 import itertools
 import statistics
-import threading
 import time
 from dataclasses import dataclass, field
 from typing import Deque, Dict, List, Optional
+
+from ..utils import lockwitness
 
 DEFAULT_CAPACITY = 256
 
@@ -144,7 +145,7 @@ class RequestLedger:
     def __init__(self, capacity: int = DEFAULT_CAPACITY) -> None:
         if capacity <= 0:
             raise ValueError("capacity must be positive")
-        self._lock = threading.Lock()
+        self._lock = lockwitness.Lock("RequestLedger._lock")
         self._capacity = capacity
         self._finished: Deque[RequestRecord] = collections.deque(
             maxlen=capacity)

@@ -33,12 +33,13 @@ docs contract (docs/OBSERVABILITY.md objective table).
 from __future__ import annotations
 
 import logging
-import threading
 import time
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from .history import MetricsHistory, get_metrics_history, parse_series
+
+from ..utils import lockwitness
 
 log = logging.getLogger(__name__)
 
@@ -271,7 +272,8 @@ def default_objective_pack(config=None) -> List[SloObjective]:
 
 # -- process-wide engine + alert sources --------------------------------------
 _engine: Optional[SloEngine] = None
-_engine_lock = threading.Lock()
+_engine_lock = lockwitness.Lock(
+    "tensorhive_tpu.observability.slo._engine_lock")
 
 
 def _slo_enabled() -> bool:

@@ -23,6 +23,8 @@ from contextlib import contextmanager
 from dataclasses import dataclass, field
 from typing import Deque, Dict, Iterator, List, Optional
 
+from ..utils import lockwitness
+
 DEFAULT_CAPACITY = 512
 
 
@@ -65,7 +67,7 @@ class SpanTracer:
         if capacity <= 0:
             raise ValueError("capacity must be positive")
         self.capacity = capacity
-        self._lock = threading.Lock()
+        self._lock = lockwitness.Lock("SpanTracer._lock")
         self._finished: Deque[Span] = collections.deque(maxlen=capacity)
         self._ids = itertools.count(1)
         self._seq = itertools.count(1)

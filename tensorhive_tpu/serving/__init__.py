@@ -17,6 +17,8 @@ from __future__ import annotations
 import threading
 from typing import TYPE_CHECKING, Optional
 
+from ..utils import lockwitness
+
 if TYPE_CHECKING:  # pragma: no cover - typing only
     from .engine import SlotEngine
 
@@ -74,7 +76,7 @@ __all__ = [
 
 _engine: Optional["SlotEngine"] = None
 _unavailable_reason: Optional[str] = None
-_engine_lock = threading.Lock()
+_engine_lock = lockwitness.Lock("tensorhive_tpu.serving._engine_lock")
 
 #: supervisor lifecycle state (docs/ROBUSTNESS.md "Serving data plane"),
 #: published by GenerationService and read by the controller's 503 path

@@ -86,7 +86,6 @@ import collections
 import dataclasses
 import functools
 import queue as queue_module
-import threading
 import time
 from typing import Callable, Deque, Dict, List, Optional, Sequence
 
@@ -104,6 +103,7 @@ from ..models.decode import (
     _prefill_bucket,
 )
 from ..ops import kv_quant as kvq
+from ..utils import lockwitness
 from ..models.transformer import (
     TransformerConfig,
     TransformerLM,
@@ -902,7 +902,8 @@ class SlotEngine:
             self._cache_spec = None
             self.params = params
 
-        self._lock = threading.Lock()
+        self._lock = lockwitness.Lock("SlotEngine._lock",
+                                      observe_wait=True)
         self._pending: Deque[_Request] = collections.deque()
         self._slots: List[Optional[_Slot]] = [None] * self.capacity
         self._user_active: Dict[str, int] = {}

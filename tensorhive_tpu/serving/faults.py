@@ -38,9 +38,10 @@ Failure taxonomy (what :func:`classify_failure` answers):
 from __future__ import annotations
 
 import random
-import threading
 import time
 from typing import Callable, Dict, Optional, Type
+
+from ..utils import lockwitness
 
 #: dispatch kinds a plan can target (the engine's three device seams)
 DISPATCH_KINDS = ("step", "prefill", "verify")
@@ -119,7 +120,7 @@ class ServingFaultPlan:
         self.exc_class = exc_class
         self._sleeper = sleeper
         self._rng = random.Random(seed)
-        self._lock = threading.Lock()
+        self._lock = lockwitness.Lock("ServingFaultPlan._lock")
         self._fail_next: Dict[str, list] = {kind: [] for kind in
                                             DISPATCH_KINDS}
         self._slow_next: Dict[str, list] = {kind: [] for kind in

@@ -1,0 +1,356 @@
+"""Runtime lock witness: the dynamic half of the TH-LOCK story.
+
+The static analyzer (tools/analysis/rules/locks.py) builds a lock-order
+graph by reading the code; this module builds one by *running* it. Lock
+construction sites opt in by naming their lock through this factory::
+
+    self._lock = lockwitness.Lock("SlotEngine._lock")
+    _engine_lock = lockwitness.Lock("tensorhive_tpu.serving._engine_lock")
+
+With ``TPUHIVE_LOCK_WITNESS=1`` (or :func:`enable` in tests) each named
+lock is wrapped in an instrumented proxy that records, per acquire:
+
+* the **per-thread held-set** — which named locks this thread already
+  holds;
+* the **observed-order graph** — an edge ``A -> B`` whenever ``B`` is
+  acquired while ``A`` is held (same-name re-entry is skipped: lock
+  identity is class-level, matching the static model's granularity);
+* **real inversions, at acquire time** — if the reverse edge ``B -> A``
+  was ever observed, this acquisition completes an ABBA pair: recorded
+  with both threads' context before anything actually deadlocks;
+* **wait / hold statistics** per name, exported as the
+  ``tpuhive_lock_wait_seconds{lock}`` histogram (contended acquires
+  only; ``export_wait=False`` opts the metrics registry's own locks out
+  so the export path cannot recurse into itself).
+
+:func:`dump` writes the observed graph as JSON; ``python -m
+tools.analysis --witness <dump>`` asserts observed edges are a subset of
+the static graph — the chaos/serving smokes run with the witness on, so
+every green run is an executable proof the static model over-approximates
+reality instead of imagining a different program.
+
+**Disabled (the default), the factory returns plain ``threading`` objects
+— byte-identical behavior, no wrapper, no overhead.** The one exception
+is ``observe_wait=True`` (the serving engine lock): a thin always-on
+proxy whose fast path is a single non-blocking try-acquire, timing only
+contended waits, so engine-lock contention is visible in the PR 16
+history/SLO layer in production, not just under the witness.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+_ENV = "TPUHIVE_LOCK_WITNESS"
+
+#: sub-millisecond to second buckets: lock waits live far below request
+#: latencies, and the interesting regressions are 100us -> 10ms creeps
+WAIT_BUCKETS: Tuple[float, ...] = (0.0001, 0.0005, 0.001, 0.005, 0.01,
+                                   0.05, 0.1, 0.5, 1.0)
+
+_forced: Optional[bool] = None
+_wait_family = None
+_wait_family_lock = threading.Lock()
+
+
+def witness_enabled() -> bool:
+    """True when lock construction should produce witnessed proxies."""
+    if _forced is not None:
+        return _forced
+    return os.environ.get(_ENV, "") == "1"
+
+
+def enable() -> None:
+    """Force the witness on for locks constructed after this call (tests;
+    production opts in via the environment before import)."""
+    global _forced
+    _forced = True
+
+
+def disable() -> None:
+    global _forced
+    _forced = None
+
+
+# -- wait-time export ---------------------------------------------------------
+def _wait_histogram():
+    global _wait_family
+    if _wait_family is None:
+        with _wait_family_lock:
+            if _wait_family is None:
+                from ..observability import get_registry
+
+                _wait_family = get_registry().histogram(
+                    "tpuhive_lock_wait_seconds",
+                    "Time spent waiting for a named lock "
+                    "(contended acquires only)",
+                    labels=("lock",), buckets=WAIT_BUCKETS)
+    return _wait_family
+
+
+def observe_wait(name: str, seconds: float) -> None:
+    """One contended-acquire wait for ``name`` into the export histogram.
+    Guarded against reentry: the observation itself takes the registry
+    family lock, which must never observe its own wait."""
+    tls = _state.tls
+    if getattr(tls, "in_observer", False):
+        return
+    tls.in_observer = True
+    try:
+        _wait_histogram().labels(lock=name).observe(seconds)
+    except Exception:  # thive: disable=TH-E
+        pass        # metrics must never take the data plane down
+    finally:
+        tls.in_observer = False
+
+
+# -- witness state ------------------------------------------------------------
+class _WitnessState:
+    """Process-global observed-order graph + per-name statistics. The
+    internal mutex is a plain unnamed lock: a leaf by construction (held
+    only across dict updates, never across user code), so it cannot
+    appear in its own graph."""
+
+    def __init__(self) -> None:
+        self.mutex = threading.Lock()
+        self.tls = threading.local()
+        #: (from name, to name) -> observation count
+        self.edges: Dict[Tuple[str, str], int] = {}
+        self.inversions: List[Dict[str, Any]] = []
+        self.stats: Dict[str, Dict[str, float]] = {}
+
+    # per-thread held stack: [name, id(lock), t_acquired]
+    def held(self) -> List[List[Any]]:
+        stack = getattr(self.tls, "held", None)
+        if stack is None:
+            stack = []
+            self.tls.held = stack
+        return stack
+
+    def reset(self) -> None:
+        with self.mutex:
+            self.edges.clear()
+            self.inversions.clear()
+            self.stats.clear()
+
+    def _stat_locked(self, name: str) -> Dict[str, float]:
+        stat = self.stats.get(name)
+        if stat is None:
+            stat = {"acquisitions": 0, "contended": 0, "wait_total_s": 0.0,
+                    "wait_max_s": 0.0, "hold_total_s": 0.0,
+                    "hold_max_s": 0.0}
+            self.stats[name] = stat
+        return stat
+
+    def record_acquired(self, name: str, lock_id: int, waited: float,
+                        contended: bool) -> None:
+        held = self.held()
+        held_names = [entry[0] for entry in held]
+        now = time.perf_counter()
+        # re-acquiring a lock this thread already holds (reentrant, or the
+        # class-level identity blurring two instances) imposes no NEW
+        # ordering: record stats only, no edges, no inversion — mirroring
+        # the static model, which skips held targets when building edges
+        reacquire = name in held_names
+        with self.mutex:
+            stat = self._stat_locked(name)
+            stat["acquisitions"] += 1
+            if contended:
+                stat["contended"] += 1
+                stat["wait_total_s"] += waited
+                stat["wait_max_s"] = max(stat["wait_max_s"], waited)
+            for other in held_names:
+                if reacquire:
+                    break
+                if (name, other) in self.edges:
+                    # the reverse edge exists: this acquire completes an
+                    # ABBA inversion — record it BEFORE the deadlock can
+                    if (other, name) not in self.edges:
+                        self.inversions.append({
+                            "cycle": [other, name],
+                            "thread": threading.current_thread().name,
+                            "held": list(held_names),
+                            "acquiring": name,
+                        })
+                self.edges[(other, name)] = \
+                    self.edges.get((other, name), 0) + 1
+        held.append([name, lock_id, now])
+
+    def record_released(self, name: str, lock_id: int) -> None:
+        held = self.held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i][1] == lock_id:
+                entry = held.pop(i)
+                hold = time.perf_counter() - entry[2]
+                with self.mutex:
+                    stat = self._stat_locked(name)
+                    stat["hold_total_s"] += hold
+                    stat["hold_max_s"] = max(stat["hold_max_s"], hold)
+                return
+
+    def is_owned(self, lock_id: int) -> bool:
+        return any(entry[1] == lock_id for entry in self.held())
+
+
+_state = _WitnessState()
+
+
+def reset() -> None:
+    """Clear the observed graph and statistics (tests)."""
+    _state.reset()
+
+
+def snapshot() -> Dict[str, Any]:
+    """The witness graph as plain data (stable shape: the comparator and
+    the smokes consume this)."""
+    with _state.mutex:
+        return {
+            "enabled": witness_enabled(),
+            "edges": sorted([a, b, n] for (a, b), n in
+                            _state.edges.items()),
+            "inversions": [dict(inv) for inv in _state.inversions],
+            "locks": {name: dict(stat)
+                      for name, stat in sorted(_state.stats.items())},
+        }
+
+
+def dump(path: str) -> Dict[str, Any]:
+    """Write :func:`snapshot` to ``path`` as JSON; returns the snapshot."""
+    data = snapshot()
+    with open(path, "w") as fh:
+        json.dump(data, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    return data
+
+
+# -- the instrumented lock ----------------------------------------------------
+class _WitnessLock:
+    """A named lock proxy recording held-sets, order edges and wait/hold
+    times. Wraps a plain (or reentrant) ``threading`` lock; context
+    manager, ``acquire(blocking, timeout)`` and ``locked()`` behave like
+    the wrapped object."""
+
+    __slots__ = ("name", "_lock", "_reentrant", "_export")
+
+    def __init__(self, name: str, inner: Any, reentrant: bool,
+                 export: bool) -> None:
+        self.name = name
+        self._lock = inner
+        self._reentrant = reentrant
+        self._export = export
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if self._lock.acquire(False):
+            _state.record_acquired(self.name, id(self), 0.0, False)
+            return True
+        if not blocking:
+            return False
+        start = time.perf_counter()
+        if timeout is not None and timeout >= 0:
+            ok = self._lock.acquire(True, timeout)
+        else:
+            ok = self._lock.acquire(True)
+        if not ok:
+            return False
+        waited = time.perf_counter() - start
+        _state.record_acquired(self.name, id(self), waited, True)
+        if self._export:
+            observe_wait(self.name, waited)
+        return True
+
+    def release(self) -> None:
+        _state.record_released(self.name, id(self))
+        self._lock.release()
+
+    def __enter__(self) -> "_WitnessLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+    # threading.Condition probes ownership through this when wrapping a
+    # foreign lock; the default probe (try-acquire) would misreport a
+    # reentrant inner lock, so answer from the witness held-set
+    def _is_owned(self) -> bool:
+        return _state.is_owned(id(self))
+
+
+class _ObservedLock:
+    """Always-on wait observation for ONE hot lock (the serving engine):
+    no witness graph, no held-set — a non-blocking try on the fast path,
+    a timed wait plus one histogram observation under contention."""
+
+    __slots__ = ("name", "_lock")
+
+    def __init__(self, name: str, inner: Any) -> None:
+        self.name = name
+        self._lock = inner
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if self._lock.acquire(False):
+            return True
+        if not blocking:
+            return False
+        start = time.perf_counter()
+        if timeout is not None and timeout >= 0:
+            ok = self._lock.acquire(True, timeout)
+        else:
+            ok = self._lock.acquire(True)
+        if ok:
+            observe_wait(self.name, time.perf_counter() - start)
+        return ok
+
+    def release(self) -> None:
+        self._lock.release()
+
+    def __enter__(self) -> "_ObservedLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.release()
+
+    def locked(self) -> bool:
+        return self._lock.locked()
+
+
+# -- the factory --------------------------------------------------------------
+# Terminal names deliberately mirror threading.Lock/RLock/Condition so the
+# static lock vocabulary (dataflow.LOCK_FACTORIES) recognizes construction
+# sites unchanged; the name argument is the contract that makes the static
+# and runtime graphs speak about the same lock.
+def Lock(name: Optional[str] = None, *, observe_wait: bool = False,
+         export_wait: bool = True):
+    """A mutex. Plain ``threading.Lock()`` unless the witness is enabled
+    (named proxy) or ``observe_wait=True`` (always-on wait histogram)."""
+    if name and witness_enabled():
+        return _WitnessLock(name, threading.Lock(), False, export_wait)
+    if name and observe_wait:
+        return _ObservedLock(name, threading.Lock())
+    return threading.Lock()
+
+
+def RLock(name: Optional[str] = None, *, observe_wait: bool = False,
+          export_wait: bool = True):
+    if name and witness_enabled():
+        return _WitnessLock(name, threading.RLock(), True, export_wait)
+    if name and observe_wait:
+        return _ObservedLock(name, threading.RLock())
+    return threading.RLock()
+
+
+def Condition(name: Optional[str] = None):
+    """A condition variable. Witnessed, its internal lock is a named
+    proxy: ``wait()`` releases and re-acquires through the proxy, so the
+    held-set stays truthful across waits."""
+    if name and witness_enabled():
+        return threading.Condition(
+            _WitnessLock(name, threading.Lock(), False, True))
+    return threading.Condition()

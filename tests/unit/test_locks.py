@@ -1,0 +1,548 @@
+"""TH-LOCK (tools/analysis/rules/locks.py + callgraph.py): the
+interprocedural deadlock pass and its witness comparator.
+
+Every check gets a deliberately-seeded true-positive mini-repo and a
+known-false-positive guard, driven through the same ``check_project``
+seam the CLI uses. The acceptance fixture proves the PR's headline
+property: deleting one ``with self._lock:`` guard from an otherwise
+clean repo makes TH-LOCK fail naming the inversion. The comparator
+round-trips a runtime witness dump against the static graph.
+"""
+import json
+import textwrap
+from pathlib import Path
+
+from tools.analysis.callgraph import get_callgraph
+from tools.analysis.rules.locks import (LockOrderRule, build_lock_model,
+                                        compare_witness)
+
+
+def build_repo(root: Path, engine_py: str, **extra: str) -> Path:
+    pkg = root / "tensorhive_tpu"
+    pkg.mkdir(parents=True, exist_ok=True)
+    (pkg / "engine_mod.py").write_text(textwrap.dedent(engine_py))
+    for name, source in extra.items():
+        (pkg / f"{name}.py").write_text(textwrap.dedent(source))
+    return root
+
+
+def findings(root: Path):
+    return LockOrderRule().check_project(root)
+
+
+# -- (a) order-inversion cycles ----------------------------------------------
+
+class TestOrderInversion:
+    def test_abba_cycle_flagged(self, tmp_path):
+        root = build_repo(tmp_path, """
+            import threading
+
+            class Pair:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def forward(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def backward(self):
+                    with self._b:
+                        with self._a:
+                            pass
+            """)
+        found = findings(root)
+        cycles = [f for f in found if "lock-order inversion" in f.message]
+        assert len(cycles) == 1, [f.message for f in found]
+        assert "Pair._a" in cycles[0].message
+        assert "Pair._b" in cycles[0].message
+
+    def test_consistent_order_clean(self, tmp_path):
+        root = build_repo(tmp_path, """
+            import threading
+
+            class Pair:
+                def __init__(self):
+                    self._a = threading.Lock()
+                    self._b = threading.Lock()
+
+                def forward(self):
+                    with self._a:
+                        with self._b:
+                            pass
+
+                def also_forward(self):
+                    with self._a:
+                        with self._b:
+                            pass
+            """)
+        assert findings(root) == []
+
+    def test_interprocedural_cycle_across_classes(self, tmp_path):
+        # neither function is wrong alone: the deadlock lives in the
+        # composition (the exact shape TH-LOCK exists for)
+        root = build_repo(tmp_path, """
+            import threading
+
+            class Ledger:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def record(self, engine):
+                    with self._lock:
+                        engine.refresh()
+
+            class Engine:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.ledger = Ledger()
+                    self.depth = 0
+
+                def refresh(self):
+                    with self._lock:
+                        self.depth += 1
+
+                def step(self):
+                    with self._lock:
+                        self.ledger.record(self)
+            """)
+        cycles = [f for f in findings(root)
+                  if "lock-order inversion" in f.message]
+        assert len(cycles) == 1, [f.message for f in findings(root)]
+        assert "Ledger._lock" in cycles[0].message
+        assert "Engine._lock" in cycles[0].message
+
+
+# -- (b) blocking reachable while a lock is held -----------------------------
+
+class TestBlockingUnderLock:
+    def test_direct_sleep_under_lock_flagged(self, tmp_path):
+        root = build_repo(tmp_path, """
+            import threading
+            import time
+
+            class Sleeper:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def tick(self):
+                    with self._lock:
+                        time.sleep(0.1)
+            """)
+        found = findings(root)
+        assert any("time.sleep()" in f.message
+                   and "Sleeper._lock" in f.message for f in found), \
+            [f.message for f in found]
+
+    def test_transitive_sleep_named_with_chain(self, tmp_path):
+        root = build_repo(tmp_path, """
+            import threading
+            import time
+
+            class Sleeper:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def tick(self):
+                    with self._lock:
+                        self._work()
+
+                def _work(self):
+                    time.sleep(0.1)
+            """)
+        found = findings(root)
+        hits = [f for f in found if "time.sleep()" in f.message
+                and "reachable" in f.message]
+        assert hits, [f.message for f in found]
+        assert "Sleeper._work" in hits[0].message      # the via chain
+
+    def test_sleep_outside_lock_clean(self, tmp_path):
+        root = build_repo(tmp_path, """
+            import threading
+            import time
+
+            class Sleeper:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.items = []
+
+                def tick(self):
+                    with self._lock:
+                        snapshot = list(self.items)
+                    time.sleep(0.1)
+                    return snapshot
+            """)
+        assert findings(root) == []
+
+    def test_condition_wait_on_held_lock_exempt(self, tmp_path):
+        # cond.wait() RELEASES the lock it guards: not blocking-under-lock
+        root = build_repo(tmp_path, """
+            import threading
+
+            class Queue:
+                def __init__(self):
+                    self._cond = threading.Condition()
+
+                def take(self):
+                    with self._cond:
+                        self._cond.wait()
+            """)
+        assert findings(root) == []
+
+
+# -- (c) callback / sink invocation under a lock -----------------------------
+
+class TestCallbackUnderLock:
+    def test_source_callable_under_lock_flagged(self, tmp_path):
+        root = build_repo(tmp_path, """
+            import threading
+
+            class AlertEngine:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.rules = []
+
+                def evaluate(self):
+                    with self._lock:
+                        for rule in self.rules:
+                            value = rule.source()
+            """)
+        found = findings(root)
+        assert any("rule.source()" in f.message
+                   and "AlertEngine._lock" in f.message for f in found), \
+            [f.message for f in found]
+
+    def test_snapshot_then_call_outside_clean(self, tmp_path):
+        # the fix shape the real AlertEngine uses: read sources outside,
+        # mutate state under the lock
+        root = build_repo(tmp_path, """
+            import threading
+
+            class AlertEngine:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.rules = []
+                    self.last = {}
+
+                def evaluate(self):
+                    values = [rule.source() for rule in self.rules]
+                    with self._lock:
+                        self.last = dict(enumerate(values))
+            """)
+        assert findings(root) == []
+
+    def test_injected_clock_param_exempt(self, tmp_path):
+        root = build_repo(tmp_path, """
+            import threading
+
+            class Timed:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.t = 0.0
+
+                def stamp(self, clock):
+                    with self._lock:
+                        self.t = clock()
+            """)
+        assert findings(root) == []
+
+
+# -- (d) re-acquisition of a non-reentrant lock ------------------------------
+
+class TestReacquire:
+    def test_nonreentrant_reacquire_through_chain_flagged(self, tmp_path):
+        root = build_repo(tmp_path, """
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def bump(self):
+                    with self._lock:
+                        self.n = self._get() + 1
+
+                def _get(self):
+                    with self._lock:
+                        return self.n
+            """)
+        found = findings(root)
+        hits = [f for f in found if "re-acquires" in f.message]
+        assert hits, [f.message for f in found]
+        assert "Counter._lock" in hits[0].message
+        assert "Counter._get" in hits[0].message
+
+    def test_rlock_reacquire_clean(self, tmp_path):
+        root = build_repo(tmp_path, """
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.RLock()
+                    self.n = 0
+
+                def bump(self):
+                    with self._lock:
+                        self.n = self._get() + 1
+
+                def _get(self):
+                    with self._lock:
+                        return self.n
+            """)
+        assert findings(root) == []
+
+    def test_locked_convention_clean(self, tmp_path):
+        # the shared-vocabulary contract: a *_locked callee runs with the
+        # caller's lock held and must not re-take it
+        root = build_repo(tmp_path, """
+            import threading
+
+            class Counter:
+                def __init__(self):
+                    self._lock = threading.Lock()
+                    self.n = 0
+
+                def bump(self):
+                    with self._lock:
+                        self.n = self._get_locked() + 1
+
+                def _get_locked(self):
+                    return self.n
+            """)
+        assert findings(root) == []
+
+
+# -- the acceptance fixture: delete one guard, get the inversion -------------
+
+GUARDED_ENGINE = """
+    import threading
+
+    class Engine:
+        def __init__(self):
+            self._lock = threading.RLock()
+            self._stats_lock = threading.Lock()
+            self.depth = 0
+            self.totals = {}
+
+        def _read(self):
+            with self._lock:
+                return self.depth
+
+        def step(self):
+            with self._lock:
+                with self._stats_lock:
+                    self.totals["depth"] = self._read()
+
+        def export(self):
+            with self._lock:
+                with self._stats_lock:
+                    return {"depth": self._read()}
+    """
+
+#: GUARDED_ENGINE with export's ``with self._lock:`` guard deleted — the
+#: helper now takes the engine lock UNDER the stats lock
+UNGUARDED_ENGINE = """
+    import threading
+
+    class Engine:
+        def __init__(self):
+            self._lock = threading.RLock()
+            self._stats_lock = threading.Lock()
+            self.depth = 0
+            self.totals = {}
+
+        def _read(self):
+            with self._lock:
+                return self.depth
+
+        def step(self):
+            with self._lock:
+                with self._stats_lock:
+                    self.totals["depth"] = self._read()
+
+        def export(self):
+            with self._stats_lock:
+                return {"depth": self._read()}
+    """
+
+
+class TestGuardDeletion:
+    def test_guarded_repo_is_clean(self, tmp_path):
+        assert findings(build_repo(tmp_path, GUARDED_ENGINE)) == []
+
+    def test_deleting_the_guard_names_the_inversion(self, tmp_path):
+        found = findings(build_repo(tmp_path, UNGUARDED_ENGINE))
+        cycles = [f for f in found if "lock-order inversion" in f.message]
+        assert cycles, [f.message for f in found]
+        assert "Engine._lock" in cycles[0].message
+        assert "Engine._stats_lock" in cycles[0].message
+
+    def test_deleting_the_guard_fails_the_cli_gate(self, tmp_path):
+        # the CLI seam CI uses: exit 1, the finding on stdout
+        from tools.analysis.engine import run
+
+        root = build_repo(tmp_path, UNGUARDED_ENGINE)
+        report = run(["__no_changed_files__"], rule_ids=["TH-LOCK"],
+                     root=root)
+        assert any("lock-order inversion" in f.message
+                   for f in report["findings"])
+
+
+# -- the static/runtime naming contract --------------------------------------
+
+class TestWitnessNames:
+    def test_lockwitness_literal_is_the_witness_name(self, tmp_path):
+        root = build_repo(tmp_path, """
+            from .utils import lockwitness
+
+            _engine_lock = lockwitness.Lock(
+                "tensorhive_tpu.engine_mod._engine_lock")
+
+            class Engine:
+                def __init__(self):
+                    self._lock = lockwitness.Lock("Engine._lock",
+                                                  observe_wait=True)
+            """)
+        model = build_lock_model(root)
+        assert model.witness_names() == {
+            "tensorhive_tpu.engine_mod._engine_lock", "Engine._lock"}
+
+    def test_unnamed_locks_get_the_convention_name(self, tmp_path):
+        root = build_repo(tmp_path, """
+            import threading
+
+            _lock = threading.Lock()
+
+            class Engine:
+                def __init__(self):
+                    self._lock = threading.Lock()
+            """)
+        assert build_lock_model(root).witness_names() == {
+            "tensorhive_tpu.engine_mod._lock", "Engine._lock"}
+
+    def test_constructor_aliasing_reaches_the_family_lock(self, tmp_path):
+        # metrics shape: the child's lock IS the family's lock, so an
+        # acquisition through the child must resolve to the family decl
+        root = build_repo(tmp_path, """
+            import threading
+
+            class Child:
+                def __init__(self, lock=None):
+                    self._lock = lock or threading.Lock()
+
+                def observe(self):
+                    with self._lock:
+                        pass
+
+            class Family:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def make_child(self):
+                    return Child(lock=self._lock)
+            """)
+        cg = get_callgraph(root)
+        targets = {d.witness_name for d in cg.acquire_targets(
+            "tensorhive_tpu/engine_mod.py", "Child", "_lock")}
+        assert targets == {"Child._lock", "Family._lock"}
+
+
+# -- the witness comparator ---------------------------------------------------
+
+class TestWitnessComparator:
+    ENGINE = """
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._stats_lock = threading.Lock()
+
+            def step(self):
+                with self._lock:
+                    with self._stats_lock:
+                        pass
+        """
+
+    @staticmethod
+    def dump(tmp_path, payload) -> Path:
+        path = tmp_path / "witness.json"
+        path.write_text(json.dumps(payload))
+        return path
+
+    def test_observed_subset_passes(self, tmp_path):
+        root = build_repo(tmp_path, self.ENGINE)
+        dump = self.dump(tmp_path, {
+            "enabled": True,
+            "edges": [["Engine._lock", "Engine._stats_lock", 3]],
+            "inversions": [],
+            "locks": {"Engine._lock": {}, "Engine._stats_lock": {}},
+        })
+        ok, lines = compare_witness(dump, root)
+        assert ok, lines
+
+    def test_unknown_lock_name_fails(self, tmp_path):
+        root = build_repo(tmp_path, self.ENGINE)
+        dump = self.dump(tmp_path, {
+            "enabled": True, "edges": [], "inversions": [],
+            "locks": {"Ghost._lock": {}},
+        })
+        ok, lines = compare_witness(dump, root)
+        assert not ok
+        assert any("unknown lock" in line and "Ghost._lock" in line
+                   for line in lines)
+
+    def test_edge_outside_static_graph_fails(self, tmp_path):
+        # the reverse of the only static edge: the analyzer missed a path
+        root = build_repo(tmp_path, self.ENGINE)
+        dump = self.dump(tmp_path, {
+            "enabled": True,
+            "edges": [["Engine._stats_lock", "Engine._lock", 1]],
+            "inversions": [],
+            "locks": {"Engine._lock": {}, "Engine._stats_lock": {}},
+        })
+        ok, lines = compare_witness(dump, root)
+        assert not ok
+        assert any("NOT in the static graph" in line for line in lines)
+
+    def test_recorded_inversion_fails(self, tmp_path):
+        root = build_repo(tmp_path, self.ENGINE)
+        dump = self.dump(tmp_path, {
+            "enabled": True,
+            "edges": [["Engine._lock", "Engine._stats_lock", 1]],
+            "inversions": [{
+                "cycle": ["Engine._stats_lock", "Engine._lock"],
+                "thread": "worker-1",
+                "held": ["Engine._stats_lock"],
+                "acquiring": "Engine._lock"}],
+            "locks": {"Engine._lock": {}, "Engine._stats_lock": {}},
+        })
+        ok, lines = compare_witness(dump, root)
+        assert not ok
+        assert any("ABBA inversion" in line for line in lines)
+
+    def test_real_runtime_dump_round_trips(self, tmp_path):
+        # end to end: enable the witness, run the fixture's lock pattern
+        # for real, dump, compare — the exact loop the smokes run
+        from tensorhive_tpu.utils import lockwitness
+
+        root = build_repo(tmp_path, self.ENGINE)
+        lockwitness.reset()
+        lockwitness.enable()
+        try:
+            a = lockwitness.Lock("Engine._lock")
+            b = lockwitness.Lock("Engine._stats_lock")
+            with a:
+                with b:
+                    pass
+            dump = tmp_path / "observed.json"
+            snapshot = lockwitness.dump(str(dump))
+        finally:
+            lockwitness.disable()
+            lockwitness.reset()
+        assert snapshot["edges"] == [
+            ["Engine._lock", "Engine._stats_lock", 1]]
+        ok, lines = compare_witness(dump, root)
+        assert ok, lines

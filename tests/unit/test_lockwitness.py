@@ -1,0 +1,286 @@
+"""Runtime lock witness (tensorhive_tpu/utils/lockwitness.py).
+
+The factory contract (plain threading objects when disabled — the
+byte-identical-behavior guarantee), the observed-order graph, at-acquire
+ABBA inversion detection (two threads, event-sequenced, zero sleeps),
+hold/wait statistics, reentrant re-acquire semantics, the dump shape the
+comparator consumes, and the witnessed Condition's ownership probe.
+"""
+import json
+import threading
+
+import pytest
+
+from tensorhive_tpu.utils import lockwitness
+
+
+@pytest.fixture(autouse=True)
+def clean_witness():
+    lockwitness.reset()
+    yield
+    lockwitness.disable()
+    lockwitness.reset()
+
+
+def enable():
+    lockwitness.enable()
+
+
+class TestFactoryDisabled:
+    def test_lock_is_plain_threading_object(self):
+        # the acceptance contract: witness off => the factory hands back
+        # the exact stdlib primitive, zero wrapper, zero overhead
+        assert isinstance(lockwitness.Lock("X._lock"),
+                          type(threading.Lock()))
+        assert isinstance(lockwitness.Lock(), type(threading.Lock()))
+
+    def test_rlock_and_condition_plain(self):
+        assert isinstance(lockwitness.RLock("X._lock"),
+                          type(threading.RLock()))
+        assert isinstance(lockwitness.Condition("X._cond"),
+                          threading.Condition)
+        cond = lockwitness.Condition("X._cond")
+        assert not isinstance(cond._lock, lockwitness._WitnessLock)
+
+    def test_observe_wait_returns_observed_proxy(self):
+        lock = lockwitness.Lock("SlotEngine._lock", observe_wait=True)
+        assert isinstance(lock, lockwitness._ObservedLock)
+        with lock:
+            assert lock.locked()
+        assert not lock.locked()
+
+    def test_unnamed_never_proxied(self):
+        enable()
+        assert isinstance(lockwitness.Lock(), type(threading.Lock()))
+
+
+class TestObservedGraph:
+    def test_nested_acquire_records_an_edge(self):
+        enable()
+        a = lockwitness.Lock("A._lock")
+        b = lockwitness.Lock("B._lock")
+        with a:
+            with b:
+                pass
+        snap = lockwitness.snapshot()
+        assert snap["edges"] == [["A._lock", "B._lock", 1]]
+        assert snap["inversions"] == []
+
+    def test_same_name_reentry_skipped(self):
+        # lock identity is class-level: two Histogram instances share one
+        # witness name, nesting them must not invent a self-edge
+        enable()
+        h1 = lockwitness.Lock("Histogram._lock")
+        h2 = lockwitness.Lock("Histogram._lock")
+        with h1:
+            with h2:
+                pass
+        assert lockwitness.snapshot()["edges"] == []
+
+    def test_reentrant_reacquire_adds_no_reverse_edge(self):
+        # holding A then B, re-taking A (RLock) imposes no new ordering:
+        # no B->A edge, no false inversion — mirrors the static model
+        enable()
+        a = lockwitness.RLock("A._lock")
+        b = lockwitness.Lock("B._lock")
+        with a:
+            with b:
+                with a:
+                    pass
+        snap = lockwitness.snapshot()
+        assert snap["edges"] == [["A._lock", "B._lock", 1]]
+        assert snap["inversions"] == []
+
+    def test_held_set_is_per_thread(self):
+        enable()
+        a = lockwitness.Lock("A._lock")
+        b = lockwitness.Lock("B._lock")
+        started = threading.Event()
+        release = threading.Event()
+
+        def holder():
+            with a:
+                started.set()
+                release.wait(5)
+
+        t = threading.Thread(target=holder)
+        t.start()
+        assert started.wait(5)
+        with b:             # this thread holds nothing else: no A->B edge
+            pass
+        release.set()
+        t.join(5)
+        assert lockwitness.snapshot()["edges"] == []
+
+
+class TestInversionDetection:
+    def test_abba_recorded_at_acquire_time(self):
+        # two threads, event-sequenced so the orders never overlap (no
+        # deadlock, no sleeps): t1 establishes A->B, then t2 acquires A
+        # while holding B — the witness must record the inversion at that
+        # acquire, before any actual deadlock is possible
+        enable()
+        a = lockwitness.Lock("A._lock")
+        b = lockwitness.Lock("B._lock")
+        forward_done = threading.Event()
+        failures = []
+
+        def forward():
+            try:
+                with a:
+                    with b:
+                        pass
+            except Exception as exc:            # pragma: no cover
+                failures.append(exc)
+            finally:
+                forward_done.set()
+
+        def backward():
+            if not forward_done.wait(5):        # pragma: no cover
+                failures.append("forward never ran")
+                return
+            with b:
+                with a:
+                    pass
+
+        t1 = threading.Thread(target=forward, name="t-forward")
+        t2 = threading.Thread(target=backward, name="t-backward")
+        t1.start()
+        t2.start()
+        t1.join(5)
+        t2.join(5)
+        assert not failures
+        snap = lockwitness.snapshot()
+        assert len(snap["inversions"]) == 1, snap
+        inv = snap["inversions"][0]
+        assert inv["cycle"] == ["B._lock", "A._lock"]   # held, acquiring
+        assert inv["acquiring"] == "A._lock"
+        assert inv["held"] == ["B._lock"]
+        assert inv["thread"] == "t-backward"
+        # both orders are in the observed graph afterwards
+        assert [["A._lock", "B._lock", 1], ["B._lock", "A._lock", 1]] \
+            == snap["edges"]
+
+    def test_inversion_recorded_once_per_direction(self):
+        enable()
+        a = lockwitness.Lock("A._lock")
+        b = lockwitness.Lock("B._lock")
+        with a:
+            with b:
+                pass
+        for _ in range(3):      # reverse order repeatedly, same thread
+            with b:
+                with a:
+                    pass
+        snap = lockwitness.snapshot()
+        assert len(snap["inversions"]) == 1
+
+
+class TestStatistics:
+    def test_acquisition_and_hold_stats(self):
+        enable()
+        a = lockwitness.Lock("A._lock")
+        for _ in range(3):
+            with a:
+                pass
+        stats = lockwitness.snapshot()["locks"]["A._lock"]
+        assert stats["acquisitions"] == 3
+        assert stats["contended"] == 0
+        assert stats["hold_total_s"] >= 0.0
+        assert stats["hold_max_s"] <= stats["hold_total_s"]
+
+    def test_contended_acquire_counts_and_waits(self):
+        enable()
+        a = lockwitness.Lock("A._lock")
+        held = threading.Event()
+        release = threading.Event()
+
+        def holder():
+            with a:
+                held.set()
+                release.wait(5)
+
+        t = threading.Thread(target=holder)
+        t.start()
+        assert held.wait(5)
+        got = []
+
+        def contender():
+            with a:
+                got.append(True)
+
+        t2 = threading.Thread(target=contender)
+        t2.start()
+        # the contender is now blocked on a; release and let it through
+        release.set()
+        t.join(5)
+        t2.join(5)
+        assert got == [True]
+        stats = lockwitness.snapshot()["locks"]["A._lock"]
+        assert stats["acquisitions"] == 2
+        # the loser MAY win the retry race uncontended; wait stats only
+        # ever grow when contention was actually measured
+        assert stats["wait_total_s"] >= 0.0
+
+
+class TestDumpAndReset:
+    def test_dump_shape_round_trips(self, tmp_path):
+        enable()
+        a = lockwitness.Lock("A._lock")
+        b = lockwitness.Lock("B._lock")
+        with a:
+            with b:
+                pass
+        path = tmp_path / "w.json"
+        returned = lockwitness.dump(str(path))
+        on_disk = json.loads(path.read_text())
+        assert returned == on_disk
+        assert set(on_disk) == {"enabled", "edges", "inversions", "locks"}
+        assert on_disk["enabled"] is True
+        assert on_disk["edges"] == [["A._lock", "B._lock", 1]]
+
+    def test_reset_clears_everything(self):
+        enable()
+        a = lockwitness.Lock("A._lock")
+        with a:
+            pass
+        lockwitness.reset()
+        snap = lockwitness.snapshot()
+        assert snap["edges"] == [] and snap["locks"] == {}
+
+
+class TestWitnessedPrimitives:
+    def test_witness_lock_api_parity(self):
+        enable()
+        lock = lockwitness.Lock("A._lock")
+        assert isinstance(lock, lockwitness._WitnessLock)
+        assert lock.acquire()
+        assert lock.locked()
+        assert not lock.acquire(False)      # non-reentrant, already held
+        lock.release()
+        assert not lock.locked()
+
+    def test_witnessed_condition_wait_notify(self):
+        # the Condition wraps a named witness lock and probes ownership
+        # through the held-set; wait/notify must work end to end
+        enable()
+        cond = lockwitness.Condition("Q._cond")
+        assert isinstance(cond._lock, lockwitness._WitnessLock)
+        ready = threading.Event()
+        got = []
+
+        def consumer():
+            with cond:
+                ready.set()
+                cond.wait(timeout=5)
+                got.append(True)
+
+        t = threading.Thread(target=consumer)
+        t.start()
+        assert ready.wait(5)
+        with cond:
+            cond.notify()
+        t.join(5)
+        assert got == [True]
+        stats = lockwitness.snapshot()["locks"]["Q._cond"]
+        assert stats["acquisitions"] >= 2

@@ -1,0 +1,564 @@
+"""Repo-wide call graph + lock declarations: the interprocedural layer.
+
+The dataflow layer (PR 12) is deliberately module-flat; TH-LOCK needs the
+one fact a flat view cannot give: *who calls whom across the repo while
+holding what*. This module computes, once per root and cached like the
+AST/dataflow contexts:
+
+* **function index** — every module-level function and class method under
+  the runtime package, keyed by a qualified name ``relpath::Class.method``
+  / ``relpath::func``; ``@property`` getters are indexed too (a property
+  read is a call the AST spells as an attribute load).
+* **call resolution** — ``name()`` to the same-module (or unique
+  cross-module) function; ``self.m()`` to the enclosing class's method,
+  then lexical bases; ``ClassName(...)`` to ``__init__``; any other
+  ``recv.m()`` to *every* repo class defining ``m`` (bounded by
+  :data:`ATTR_FANOUT_CAP`). The last rule is a deliberate
+  over-approximation: the witness comparator proves observed behavior is
+  a subset of this model, so resolution must over- rather than
+  under-approximate along real paths.
+* **thread roots, not thread edges** — ``threading.Thread(target=f)`` /
+  ``StoppableThread`` subclasses' ``do_run`` / ``@route`` handlers are
+  recorded as entry points. A ``Thread(target=f)`` call must NOT be a
+  call edge: ``f`` runs on a fresh thread with an empty held-set, so
+  locks held at spawn time do not propagate into it.
+* **lock declarations** — every ``self.X = ...Lock/RLock/Condition(...)``
+  (class lock) and module-level ``NAME = ...Lock(...)``. Each lock gets a
+  *witness name*: the string literal passed to the ``lockwitness`` named
+  factory when present, else the ``Class.attr`` / ``pkg.mod.NAME``
+  convention — the same name the runtime witness records, which is what
+  makes the static and dynamic graphs comparable at all.
+* **lock aliasing through constructors** — ``self._lock = lock`` fed from
+  a constructor parameter (metrics children sharing their family's lock)
+  resolves to the lock objects actually passed at the call sites, so an
+  acquisition of ``Counter._lock`` is understood as an acquisition of
+  ``MetricFamily._lock``.
+
+Like every thivelint layer this is lexical: receivers are matched by
+spelling, imports are not chased. The witness exists precisely to check
+that this trade keeps telling the truth.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import functools
+from pathlib import Path
+from typing import Dict, List, Optional, Set, Tuple
+
+from .dataflow import (REENTRANT_FACTORIES, class_lock_attrs, is_locked_name,
+                       lock_factory_call, lock_factory_name, self_attr)
+from .engine import ModuleContext
+
+#: generic ``recv.m()`` resolves to every repo class defining ``m`` unless
+#: the name is so common the fan-out would wire unrelated subsystems
+ATTR_FANOUT_CAP = 8
+
+#: method names that are overwhelmingly stdlib container/str operations;
+#: resolving them to repo classes that happen to share the name would
+#: invent call edges out of every ``dict.get`` / ``list.append``
+STDLIB_METHOD_NAMES = {
+    "append", "appendleft", "add", "update", "extend", "insert", "remove",
+    "pop", "popitem", "clear", "discard", "setdefault", "get", "items",
+    "keys", "values", "copy", "split", "strip", "lstrip", "rstrip",
+    "startswith", "endswith", "format", "encode", "decode", "lower",
+    "upper", "replace", "count", "index", "sort", "reverse", "write",
+    "read", "readline", "flush", "close", "join", "isoformat", "total",
+    # sqlite cursor/connection API: resolving `conn.execute` to the repo's
+    # own db Engine methods invents call chains from every SQL statement
+    "execute", "executemany", "query",
+}
+
+PROPERTY_DECORATORS = {"property", "cached_property"}
+ENTRYPOINT_KINDS = ("thread target", "service tick", "route handler")
+
+
+def _terminal(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+@dataclasses.dataclass(frozen=True)
+class LockDecl:
+    """One lock object the repo constructs, with its canonical identity."""
+
+    key: str            # "relpath::Class.attr" / "relpath::NAME"
+    witness_name: str   # the name the runtime witness would record
+    relpath: str
+    owner: str          # declaring class name, "" for module-level locks
+    attr: str
+    lineno: int
+    factory: str        # Lock | RLock | Condition
+    named: bool = False         # constructed via the lockwitness factory
+    export_wait: bool = True    # False: export_wait=False at the site
+
+    @property
+    def reentrant(self) -> bool:
+        return self.factory in REENTRANT_FACTORIES
+
+
+@dataclasses.dataclass
+class FunctionInfo:
+    qname: str
+    relpath: str
+    cls: str            # "" for module-level functions
+    name: str
+    node: ast.AST
+    module: ModuleContext
+    is_property: bool = False
+    entrypoint: Optional[str] = None    # one of ENTRYPOINT_KINDS
+
+    @property
+    def display(self) -> str:
+        return f"{self.cls}.{self.name}" if self.cls else self.name
+
+
+def _module_dotted(relpath: str) -> str:
+    parts = relpath[:-3].split("/")
+    if parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts)
+
+
+def _witness_literal(call: ast.Call) -> Optional[str]:
+    if call.args and isinstance(call.args[0], ast.Constant) \
+            and isinstance(call.args[0].value, str):
+        return call.args[0].value
+    for kw in call.keywords:
+        if kw.arg == "name" and isinstance(kw.value, ast.Constant) \
+                and isinstance(kw.value.value, str):
+            return kw.value.value
+    return None
+
+
+def _kw_is_false(call: ast.Call, name: str) -> bool:
+    return any(kw.arg == name and isinstance(kw.value, ast.Constant)
+               and kw.value.value is False for kw in call.keywords)
+
+
+class CallGraph:
+    """The interprocedural view of one repo root. Build via
+    :func:`get_callgraph` (cached); ProjectRules treat instances as
+    read-only."""
+
+    def __init__(self, root: Path, contexts: List[ModuleContext]) -> None:
+        self.root = root
+        self.contexts = contexts
+        self.functions: Dict[str, FunctionInfo] = {}
+        self.locks: Dict[str, LockDecl] = {}
+        #: (relpath, cls, attr) -> own LockDecl for class lock attributes
+        self._class_locks: Dict[Tuple[str, str, str], LockDecl] = {}
+        #: (relpath, name) -> LockDecl for module-level locks
+        self._module_locks: Dict[Tuple[str, str], LockDecl] = {}
+        #: class name -> [(relpath, ClassDef, ModuleContext)]
+        self._classes: Dict[str, List[Tuple[str, ast.ClassDef,
+                                            ModuleContext]]] = {}
+        #: method name -> qnames across every class (incl. properties)
+        self._methods: Dict[str, Set[str]] = {}
+        #: property name -> qnames of @property getters
+        self.properties: Dict[str, Set[str]] = {}
+        #: function name -> qnames of module-level functions
+        self._module_funcs: Dict[str, Set[str]] = {}
+        #: (relpath, name) -> qname for same-module function lookup
+        self._local_funcs: Dict[Tuple[str, str], str] = {}
+        #: (relpath, cls) -> {method name -> qname}
+        self._class_methods: Dict[Tuple[str, str], Dict[str, str]] = {}
+        #: (relpath, cls) -> base class name spellings
+        self._bases: Dict[Tuple[str, str], List[str]] = {}
+        #: lock-attr aliases fed by a constructor parameter:
+        #: (relpath, cls) -> {param name -> attr}
+        self._lock_params: Dict[Tuple[str, str], Dict[str, str]] = {}
+        #: resolved alias targets: (relpath, cls, attr) -> LockDecls passed
+        self._alias_targets: Dict[Tuple[str, str, str], Set[LockDecl]] = {}
+        self.edges: Dict[str, Set[str]] = {}
+
+        for module in contexts:
+            self._index_module(module)
+        for module in contexts:
+            self._collect_locks(module)
+        for module in contexts:
+            self._resolve_aliases(module)
+        for info in list(self.functions.values()):
+            callees = set()
+            for call in ast.walk(info.node):
+                if isinstance(call, ast.Call):
+                    callees.update(self.resolve_call(info, call))
+            self.edges[info.qname] = callees
+
+    # -- indexing -----------------------------------------------------------
+    def _index_module(self, module: ModuleContext) -> None:
+        if module.tree is None:
+            return
+        relpath = module.relpath
+        for node in ast.walk(module.tree):
+            if isinstance(node, ast.ClassDef) \
+                    and module.nearest_class(node) is None:
+                self._classes.setdefault(node.name, []).append(
+                    (relpath, node, module))
+                self._bases[(relpath, node.name)] = [
+                    b.id if isinstance(b, ast.Name) else b.attr
+                    for b in node.bases
+                    if isinstance(b, (ast.Name, ast.Attribute))]
+                self._index_class(module, node)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and module.nearest_class(node) is None \
+                    and self._is_top_level(module, node):
+                qname = f"{relpath}::{node.name}"
+                self.functions[qname] = FunctionInfo(
+                    qname, relpath, "", node.name, node, module)
+                self._module_funcs.setdefault(node.name, set()).add(qname)
+                self._local_funcs[(relpath, node.name)] = qname
+
+    def _is_top_level(self, module: ModuleContext, node: ast.AST) -> bool:
+        for ancestor in module.ancestors(node):
+            if isinstance(ancestor, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return False
+        return True
+
+    def _index_class(self, module: ModuleContext, cls: ast.ClassDef) -> None:
+        relpath = module.relpath
+        methods: Dict[str, str] = {}
+        for node in ast.walk(cls):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if module.nearest_class(node) is not cls:
+                continue
+            qname = f"{relpath}::{cls.name}.{node.name}"
+            is_prop = any(
+                _terminal(d.func if isinstance(d, ast.Call) else d)
+                in PROPERTY_DECORATORS for d in node.decorator_list)
+            entry = None
+            if node.name == "do_run":
+                entry = "service tick"
+            elif any(_terminal(d.func if isinstance(d, ast.Call) else d)
+                     == "route" for d in node.decorator_list):
+                entry = "route handler"
+            info = FunctionInfo(qname, relpath, cls.name, node.name, node,
+                                module, is_property=is_prop, entrypoint=entry)
+            self.functions[qname] = info
+            methods[node.name] = qname
+            self._methods.setdefault(node.name, set()).add(qname)
+            if is_prop:
+                self.properties.setdefault(node.name, set()).add(qname)
+        self._class_methods[(relpath, cls.name)] = methods
+
+    # -- lock declarations --------------------------------------------------
+    def _collect_locks(self, module: ModuleContext) -> None:
+        if module.tree is None:
+            return
+        relpath = module.relpath
+        for stmt in module.tree.body:
+            if not isinstance(stmt, ast.Assign):
+                continue
+            factory = lock_factory_name(stmt.value)
+            if factory is None:
+                continue
+            call = lock_factory_call(stmt.value)
+            for target in stmt.targets:
+                if not isinstance(target, ast.Name):
+                    continue
+                literal = _witness_literal(call)
+                witness = literal or \
+                    f"{_module_dotted(relpath)}.{target.id}"
+                decl = LockDecl(f"{relpath}::{target.id}", witness, relpath,
+                                "", target.id, stmt.lineno, factory,
+                                named=literal is not None,
+                                export_wait=not _kw_is_false(
+                                    call, "export_wait"))
+                self.locks[decl.key] = decl
+                self._module_locks[(relpath, target.id)] = decl
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            if module.nearest_class(node) is not None:
+                continue
+            self._collect_class_locks(module, node)
+
+    def _collect_class_locks(self, module: ModuleContext,
+                             cls: ast.ClassDef) -> None:
+        relpath = module.relpath
+        ctor_params = self._ctor_params(cls)
+        for node in ast.walk(cls):
+            if module.nearest_class(node) is not cls:
+                continue
+            if not isinstance(node, ast.Assign):
+                continue
+            for target in node.targets:
+                attr = self_attr(target)
+                if attr is None:
+                    continue
+                factory = lock_factory_name(node.value)
+                if factory is not None:
+                    call = lock_factory_call(node.value)
+                    literal = _witness_literal(call)
+                    witness = literal or f"{cls.name}.{attr}"
+                    decl = LockDecl(f"{relpath}::{cls.name}.{attr}", witness,
+                                    relpath, cls.name, attr, node.lineno,
+                                    factory, named=literal is not None,
+                                    export_wait=not _kw_is_false(
+                                        call, "export_wait"))
+                    self.locks[decl.key] = decl
+                    self._class_locks[(relpath, cls.name, attr)] = decl
+                # `self._lock = lock` / `self._lock = lock or Lock()`:
+                # the attr may also alias a lock passed by the constructor
+                for name_node in ast.walk(node.value):
+                    if isinstance(name_node, ast.Name) \
+                            and name_node.id in ctor_params:
+                        self._lock_params.setdefault(
+                            (relpath, cls.name), {})[name_node.id] = attr
+
+    @staticmethod
+    def _ctor_params(cls: ast.ClassDef) -> List[str]:
+        for stmt in cls.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and stmt.name == "__init__":
+                args = stmt.args
+                names = [a.arg for a in args.posonlyargs + args.args
+                         + args.kwonlyargs]
+                return [n for n in names if n != "self"]
+        return []
+
+    def _resolve_aliases(self, module: ModuleContext) -> None:
+        """Find constructor calls that pass a known lock into a class whose
+        lock attr aliases a constructor parameter (metrics children)."""
+        if module.tree is None:
+            return
+        for call in ast.walk(module.tree):
+            if not isinstance(call, ast.Call):
+                continue
+            name = _terminal(call.func)
+            if name not in self._classes:
+                continue
+            for relpath, cls, _cls_module in self._classes[name]:
+                params = self._lock_params.get((relpath, name))
+                if not params:
+                    continue
+                bound = self._bind_ctor_args(cls, call)
+                for param, attr in params.items():
+                    expr = bound.get(param)
+                    if expr is None:
+                        continue
+                    decl = self._lock_expr_decl(module, expr)
+                    if decl is not None:
+                        self._alias_targets.setdefault(
+                            (relpath, name, attr), set()).add(decl)
+
+    def _bind_ctor_args(self, cls: ast.ClassDef,
+                        call: ast.Call) -> Dict[str, ast.AST]:
+        params = self._ctor_params(cls)
+        bound: Dict[str, ast.AST] = {}
+        for i, arg in enumerate(call.args):
+            if i < len(params):
+                bound[params[i]] = arg
+        for kw in call.keywords:
+            if kw.arg is not None:
+                bound[kw.arg] = kw.value
+        return bound
+
+    def _lock_expr_decl(self, module: ModuleContext,
+                        expr: ast.AST) -> Optional[LockDecl]:
+        """The LockDecl a constructor-argument expression denotes, when it
+        is spelled ``self.X`` (in a class owning lock X) or a module-level
+        lock name."""
+        attr = self_attr(expr)
+        if attr is not None:
+            cls = module.nearest_class(expr)
+            if cls is not None:
+                return self._class_locks.get(
+                    (module.relpath, cls.name, attr))
+            return None
+        if isinstance(expr, ast.Name):
+            return self._module_locks.get((module.relpath, expr.id))
+        return None
+
+    # -- lock lookups used by TH-LOCK ---------------------------------------
+    def class_lock_decls(self, module: ModuleContext,
+                         cls: ast.ClassDef) -> Dict[str, LockDecl]:
+        """attr -> own LockDecl for every lock attribute of ``cls``."""
+        decls = {}
+        for attr in class_lock_attrs(module, cls):
+            decl = self._class_locks.get((module.relpath, cls.name, attr))
+            if decl is not None:
+                decls[attr] = decl
+        return decls
+
+    def acquire_targets(self, relpath: str, cls: str,
+                        attr: str) -> Set[LockDecl]:
+        """Every lock object an acquisition of ``self.<attr>`` in class
+        ``cls`` may actually lock: its own declaration plus any lock
+        aliased into it through a constructor parameter."""
+        targets: Set[LockDecl] = set()
+        own = self._class_locks.get((relpath, cls, attr))
+        if own is not None:
+            targets.add(own)
+        targets.update(self._alias_targets.get((relpath, cls, attr), set()))
+        return targets
+
+    def module_lock(self, relpath: str, name: str) -> Optional[LockDecl]:
+        return self._module_locks.get((relpath, name))
+
+    def is_lock_attr(self, relpath: str, cls: str, attr: str) -> bool:
+        return (relpath, cls, attr) in self._class_locks \
+            or (relpath, cls, attr) in self._alias_targets
+
+    # -- call resolution ----------------------------------------------------
+    def resolve_call(self, info: FunctionInfo,
+                     call: ast.Call) -> Set[str]:
+        """Qnames ``call`` (inside ``info``) may invoke on the SAME thread.
+        ``Thread(target=...)`` resolves to nothing — the target is a root,
+        recorded via :meth:`thread_target`."""
+        func = call.func
+        if self.thread_target(info, call) is not None:
+            return set()
+        if isinstance(func, ast.Name):
+            return self._resolve_name(info, func.id)
+        if isinstance(func, ast.Attribute):
+            if isinstance(func.value, ast.Name) and func.value.id == "self" \
+                    and info.cls:
+                resolved = self._resolve_self_method(info.relpath, info.cls,
+                                                     func.attr)
+                if resolved:
+                    return resolved
+            return self._resolve_method(func.attr)
+        return set()
+
+    def _resolve_name(self, info: FunctionInfo, name: str) -> Set[str]:
+        local = self._local_funcs.get((info.relpath, name))
+        if local is not None:
+            return {local}
+        if name in self._classes:
+            ctors = set()
+            for relpath, cls, _m in self._classes[name]:
+                ctor = self._class_methods.get((relpath, cls.name),
+                                               {}).get("__init__")
+                if ctor is not None:
+                    ctors.add(ctor)
+            return ctors
+        funcs = self._module_funcs.get(name, set())
+        if len(funcs) == 1:
+            return set(funcs)
+        return set()
+
+    def _resolve_self_method(self, relpath: str, cls: str,
+                             method: str) -> Set[str]:
+        qname = self._class_methods.get((relpath, cls), {}).get(method)
+        if qname is not None:
+            return {qname}
+        for base in self._bases.get((relpath, cls), []):
+            for base_rel, base_cls, _m in self._classes.get(base, []):
+                found = self._resolve_self_method(base_rel, base_cls.name,
+                                                  method)
+                if found:
+                    return found
+        return set()
+
+    def _resolve_method(self, method: str) -> Set[str]:
+        if method in STDLIB_METHOD_NAMES:
+            return set()
+        candidates = set(self._methods.get(method, set()))
+        funcs = self._module_funcs.get(method, set())
+        if len(funcs) == 1:
+            candidates.update(funcs)
+        if 0 < len(candidates) <= ATTR_FANOUT_CAP:
+            return candidates
+        return set()
+
+    def resolve_property_load(self, attr: str) -> Set[str]:
+        """Qnames an attribute LOAD may invoke when ``attr`` names a
+        ``@property`` getter somewhere in the repo (``child.value`` takes
+        the family lock without a single ``ast.Call`` in sight)."""
+        props = self.properties.get(attr, set())
+        if len(props) <= ATTR_FANOUT_CAP:
+            return set(props)
+        return set()
+
+    def thread_target(self, info: FunctionInfo,
+                      call: ast.Call) -> Optional[str]:
+        """The qname spawned by a ``Thread(target=...)`` call, else None."""
+        if _terminal(call.func) not in {"Thread", "StoppableThread"}:
+            return None
+        for kw in call.keywords:
+            if kw.arg != "target":
+                continue
+            value = kw.value
+            if isinstance(value, ast.Name):
+                resolved = self._resolve_name(info, value.id)
+            elif isinstance(value, ast.Attribute) \
+                    and isinstance(value.value, ast.Name) \
+                    and value.value.id == "self" and info.cls:
+                resolved = self._resolve_self_method(info.relpath, info.cls,
+                                                     value.attr)
+            else:
+                resolved = set()
+            for qname in resolved:
+                self.functions[qname].entrypoint = "thread target"
+            return next(iter(resolved), "<unresolved>")
+        return "<unresolved>"
+
+    def convention_locks(self, info: FunctionInfo) -> Set[LockDecl]:
+        """Locks a ``*_locked`` method holds by contract: every lock its
+        class declares (the caller-holds-the-lock convention, shared with
+        TH-C/TH-REF via dataflow.is_locked_name)."""
+        if not info.cls or not is_locked_name(info.name):
+            return set()
+        held: Set[LockDecl] = set()
+        for (relpath, cls, attr), decl in self._class_locks.items():
+            if relpath == info.relpath and cls == info.cls:
+                held.add(decl)
+        return held
+
+
+# -- cached construction ----------------------------------------------------
+SKIP_DIRS = {"tests", "docs", "examples", ".git", "__pycache__", "build",
+             "node_modules", ".claude"}
+
+
+def _walk_sources(root: Path) -> List[Path]:
+    package = root / "tensorhive_tpu"
+    base = package if package.is_dir() else root
+    files = []
+    for path in sorted(base.rglob("*.py")):
+        rel = path.relative_to(root)
+        if any(part in SKIP_DIRS for part in rel.parts):
+            continue
+        files.append(path)
+    return files
+
+
+def _fingerprint(root: Path) -> Tuple[Tuple[str, float, int], ...]:
+    out = []
+    for path in _walk_sources(root):
+        try:
+            stat = path.stat()
+        except OSError:
+            continue
+        out.append((path.as_posix(), stat.st_mtime, stat.st_size))
+    return tuple(out)
+
+
+@functools.lru_cache(maxsize=4)
+def _build(root_str: str,
+           fingerprint: Tuple[Tuple[str, float, int], ...]) -> CallGraph:
+    root = Path(root_str)
+    contexts = []
+    for path in _walk_sources(root):
+        try:
+            relpath = path.relative_to(root).as_posix()
+        except ValueError:
+            relpath = path.as_posix()
+        try:
+            contexts.append(ModuleContext(path.read_text(), relpath,
+                                          path=path))
+        except OSError:
+            continue
+    return CallGraph(root, contexts)
+
+
+def get_callgraph(root: Path) -> CallGraph:
+    """The (cached) call graph for ``root`` — same economy as the shared
+    AST: every ProjectRule in a run sees one build."""
+    root = root.resolve()
+    return _build(str(root), _fingerprint(root))

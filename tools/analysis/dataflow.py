@@ -39,6 +39,89 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 JIT_NAMES = {"jit", "pmap"}
 PARTIAL_NAMES = {"partial"}
 
+# -- the lock vocabulary shared by TH-C / TH-REF / TH-LOCK -------------------
+#
+# Three rule families reason about the same two conventions: "this attribute
+# is a lock" (constructed from threading.Lock/RLock/Condition or the
+# lockwitness named factory, whose functions deliberately reuse the same
+# terminal names) and "a ``*_locked`` method asserts its caller already
+# holds the instance lock". They MUST agree — a method the intraprocedural
+# passes treat as guarded but the interprocedural pass treats as unguarded
+# (or vice versa) silently splits the model. This is the one definition
+# all three import (PR 17 satellite: the convention cannot drift again).
+
+#: constructors that produce a lock object, by terminal callable name —
+#: covers ``threading.Lock()`` and ``lockwitness.Lock("name")`` alike
+LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+
+#: factories whose product a holder may re-acquire without deadlocking
+#: (threading.Condition's default internal lock is an RLock)
+REENTRANT_FACTORIES = {"RLock", "Condition"}
+
+#: the caller-holds-the-lock naming contract (serving/engine.py et al.)
+LOCKED_SUFFIX = "_locked"
+
+
+def is_locked_name(name: str) -> bool:
+    """True when ``name`` claims the caller-holds-the-lock convention."""
+    return name.endswith(LOCKED_SUFFIX)
+
+
+def self_attr(node: ast.AST) -> Optional[str]:
+    """``self.X`` / ``self.X[...]`` -> ``X``; anything else -> None."""
+    while isinstance(node, ast.Subscript):
+        node = node.value
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name) and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def lock_factory_call(node: ast.AST) -> Optional[ast.Call]:
+    """The ``Lock()``/``RLock()``/``Condition()`` construction inside an
+    assigned value (handles ``lock or Lock()``), or None."""
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            func = sub.func
+            name = func.id if isinstance(func, ast.Name) else (
+                func.attr if isinstance(func, ast.Attribute) else None)
+            if name in LOCK_FACTORIES:
+                return sub
+    return None
+
+
+def lock_factory_name(node: ast.AST) -> Optional[str]:
+    """Which factory (``Lock``/``RLock``/``Condition``) constructs the
+    value, or None when the expression builds no lock."""
+    call = lock_factory_call(node)
+    if call is None:
+        return None
+    func = call.func
+    return func.id if isinstance(func, ast.Name) else func.attr
+
+
+def is_lock_value(node: ast.AST) -> bool:
+    return lock_factory_call(node) is not None
+
+
+def class_lock_attrs(module, cls: ast.ClassDef) -> Dict[str, str]:
+    """``{attr: factory}`` for every ``self.<attr> = ...Lock/RLock/
+    Condition(...)`` whose nearest class is ``cls`` (nested classes are
+    their own scope, matching TH-C)."""
+    attrs: Dict[str, str] = {}
+    for node in ast.walk(cls):
+        if module.nearest_class(node) is not cls:
+            continue
+        if isinstance(node, ast.Assign):
+            factory = lock_factory_name(node.value)
+            if factory is None:
+                continue
+            for target in node.targets:
+                attr = self_attr(target)
+                if attr is not None:
+                    attrs[attr] = factory
+    return attrs
+
 
 def _terminal_name(func: ast.AST) -> Optional[str]:
     """``f`` for ``f(...)``, ``attr`` for ``x.y.attr(...)``."""

@@ -440,6 +440,12 @@ def main(argv: Optional[Sequence[str]] = None, prog: str = "analysis") -> int:
                              "finding from the baseline file")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the rule catalog and exit")
+    parser.add_argument("--witness", type=Path, metavar="DUMP",
+                        help="compare a runtime lockwitness JSON dump "
+                             "(TPUHIVE_LOCK_WITNESS=1 run) against the "
+                             "static TH-LOCK graph: observed order edges "
+                             "must be a subset of the model and the run "
+                             "must be inversion-free")
     options = parser.parse_args(argv)
 
     if options.list_rules:
@@ -448,6 +454,15 @@ def main(argv: Optional[Sequence[str]] = None, prog: str = "analysis") -> int:
             kind = " (project)" if getattr(rule, "project", False) else ""
             print(f"{rule.id}: {rule.title} [{scope}]{kind}")
         return 0
+
+    if options.witness is not None:
+        # deferred: rules import this module, so the comparator cannot be
+        # a top-level import here without a cycle
+        from .rules.locks import compare_witness
+        ok, lines = compare_witness(options.witness, REPO_ROOT)
+        for line in lines:
+            print(line, file=sys.stderr)
+        return 0 if ok else 1
 
     paths = list(options.paths)
     if options.changed_only:

@@ -9,5 +9,6 @@ from . import (  # noqa: F401
     jax_flow,
     jax_sync,
     legacy,
+    locks,
     refcount,
 )

@@ -21,9 +21,9 @@ from __future__ import annotations
 import ast
 from typing import Dict, List, Optional, Set, Tuple
 
+from ..dataflow import (class_lock_attrs, is_lock_value, is_locked_name,
+                        self_attr)
 from ..engine import Finding, ModuleContext, Rule, register
-
-LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
 
 #: calls that block the holder for an unbounded / scheduled duration
 BLOCKING_CALLS = {
@@ -41,26 +41,11 @@ MUTATOR_METHODS = {"append", "add", "update", "extend", "insert", "remove",
 CONSTRUCTORS = {"__init__", "__new__"}
 
 
-def _self_attr(node: ast.AST) -> Optional[str]:
-    """``self.X`` / ``self.X[...]`` -> ``X`` (the attribute whose object is
-    mutated); anything else -> None."""
-    while isinstance(node, ast.Subscript):
-        node = node.value
-    if (isinstance(node, ast.Attribute)
-            and isinstance(node.value, ast.Name) and node.value.id == "self"):
-        return node.attr
-    return None
-
-
-def _is_lock_value(node: ast.AST) -> bool:
-    for sub in ast.walk(node):
-        if isinstance(sub, ast.Call):
-            func = sub.func
-            name = func.id if isinstance(func, ast.Name) else (
-                func.attr if isinstance(func, ast.Attribute) else None)
-            if name in LOCK_FACTORIES:
-                return True
-    return False
+# the lock vocabulary (factories, ``self.X`` spelling, the ``_locked``
+# convention) lives in dataflow so TH-C / TH-REF / TH-LOCK share one
+# definition; these aliases keep the historical import surface stable
+_self_attr = self_attr
+_is_lock_value = is_lock_value
 
 
 def _dotted(func: ast.AST) -> Optional[Tuple[str, str]]:
@@ -96,14 +81,7 @@ class LockDisciplineRule(Rule):
                 yield node
 
     def _lock_attrs(self, module: ModuleContext, cls: ast.ClassDef) -> Set[str]:
-        attrs: Set[str] = set()
-        for node in self._class_nodes(module, cls):
-            if isinstance(node, ast.Assign) and _is_lock_value(node.value):
-                for target in node.targets:
-                    attr = _self_attr(target)
-                    if attr is not None:
-                        attrs.add(attr)
-        return attrs
+        return set(class_lock_attrs(module, cls))
 
     def _enclosing_method(self, module: ModuleContext,
                           node: ast.AST) -> Optional[str]:
@@ -148,7 +126,7 @@ class LockDisciplineRule(Rule):
             # (TH-REF enforces the call sites); writes inside such a
             # method are guarded by convention, not by a lexical `with`
             if (self._held_lock(module, node, lock_attrs)
-                    or method.endswith("_locked")):
+                    or is_locked_name(method)):
                 guarded.setdefault(attr, []).append(node.lineno)
             else:
                 unguarded.setdefault(attr, []).append((node.lineno, method))

@@ -1,0 +1,756 @@
+"""TH-LOCK: interprocedural deadlock and lock-order analysis.
+
+TH-C sees one function at a time; the defects that actually hang a
+control plane live in the composition. This family builds per-function
+*lock summaries* — which locks a function acquires (``with self._lock`` /
+``.acquire()``), which calls it makes while holding them, which blocking
+operations it performs — and propagates them over the repo call graph
+(tools/analysis/callgraph.py) into a global lock-acquisition-order graph.
+Four checks:
+
+* **(a) order-inversion cycle** — two (or more) distinct locks acquired
+  in opposite orders on different paths. Each cycle is a potential
+  deadlock the moment both paths run concurrently; the finding names the
+  full cycle with one example site per edge.
+* **(b) blocking call while a lock is held, transitively** — ``time.sleep``,
+  subprocess, transport fan-out without timeout, zero-arg ``.join()`` /
+  ``.wait()``, DB ``.commit()`` reachable through any call chain from a
+  held region. ``cond.wait()`` on the held lock itself is exempt (wait
+  releases it — that is the point of a condition variable).
+* **(c) user-callback / sink invocation under a lock** — calling a
+  configured callable (``rule.source()``, ``sink.notify()``, a callable
+  parameter) while holding a lock hands YOUR lock to code you don't
+  control. The PR 4 "fan out outside the lock" discipline, now checked.
+* **(d) re-acquisition of a non-reentrant Lock through a call chain** —
+  ``self.a()`` -> ``self.b()`` -> ``with self._lock`` while ``a`` already
+  holds it: self-deadlock. Class locks are chased only through
+  ``self.*``-rooted chains (same instance, provably the same lock);
+  module-level locks through any chain (one object).
+
+The ``*_locked`` convention (dataflow.is_locked_name, shared with
+TH-C/TH-REF) is modeled as *caller holds the class lock*: a ``*_locked``
+body's calls count as made under the lock, but the method itself acquires
+nothing.
+
+The static model's honesty is checked at runtime: the lockwitness
+(tensorhive_tpu/utils/lockwitness.py) records the observed-order graph
+under ``TPUHIVE_LOCK_WITNESS=1`` and ``python -m tools.analysis --witness
+<dump>`` asserts observed edges are a subset of this rule's graph.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+from pathlib import Path
+from typing import Dict, List, Optional, Set, Tuple
+
+from ..callgraph import CallGraph, FunctionInfo, LockDecl, get_callgraph
+from ..dataflow import dotted_source, self_attr
+from ..engine import Finding, ProjectRule, register
+
+SUBPROCESS_CALLS = {"run", "call", "check_call", "check_output", "Popen"}
+TRANSPORT_CALLS = {"run_on_all", "check_output"}
+
+#: attribute spellings that invoke configured/user code (alert sources,
+#: notification sinks, generic callbacks)
+CALLBACK_ATTRS = {"source", "notify", "callback"}
+
+#: callable parameters that are injected *time sources*, not user code —
+#: calling the clock under a lock is fine (an injected ``sleep`` is
+#: blocking, not a callback: check (b) owns it)
+TIME_SOURCE_PARAMS = {"clock", "now", "time_source", "timer", "sleep"}
+
+#: the registry family-lock witness name every wait-export observation
+#: ultimately acquires (see lockwitness: the wait histogram's children
+#: share their family's lock)
+WAIT_EXPORT_LOCK = "MetricFamily._lock"
+
+
+@dataclasses.dataclass(frozen=True)
+class BlockingSite:
+    desc: str
+    relpath: str
+    lineno: int
+    receiver: str       # lexical receiver spelling ("" when none)
+    is_wait: bool = False
+
+
+@dataclasses.dataclass(frozen=True)
+class CallbackSite:
+    desc: str
+    relpath: str
+    lineno: int
+
+
+@dataclasses.dataclass(frozen=True)
+class EdgeSite:
+    relpath: str
+    lineno: int
+    holder: str         # function display name where the edge is created
+    via: str            # callee display the acquisition happens through
+
+
+Held = Tuple[Tuple[LockDecl, str], ...]     # ((decl, spelling), ...)
+
+
+@dataclasses.dataclass
+class Summary:
+    info: FunctionInfo
+    direct_acquires: Set[str] = dataclasses.field(default_factory=set)
+    # (node, acquired decl keys, held) for every acquisition site
+    acquire_sites: List[Tuple[ast.AST, Set[str], Held]] = \
+        dataclasses.field(default_factory=list)
+    # (node, callee qnames, held, is_self_call)
+    call_sites: List[Tuple[ast.AST, Set[str], Held, bool]] = \
+        dataclasses.field(default_factory=list)
+    # (node, @property getter qnames, held) — kept apart from call_sites
+    # so check (d) can ignore them: a property NAME match is too weak
+    # evidence for "same instance, same lock" (config.history is not
+    # SloEngine.history)
+    property_sites: List[Tuple[ast.AST, Set[str], Held]] = \
+        dataclasses.field(default_factory=list)
+    blocking: List[Tuple[BlockingSite, Held]] = \
+        dataclasses.field(default_factory=list)
+    callbacks: List[Tuple[CallbackSite, Held]] = \
+        dataclasses.field(default_factory=list)
+    self_callees: Set[str] = dataclasses.field(default_factory=set)
+
+
+class LockModel:
+    """Summaries + fixpoints + the global lock-order graph for one root."""
+
+    def __init__(self, cg: CallGraph) -> None:
+        self.cg = cg
+        self.summaries: Dict[str, Summary] = {}
+        for qname, info in cg.functions.items():
+            self.summaries[qname] = self._summarize(info)
+        # call edges for the fixpoints: the call graph's resolved calls
+        # plus @property loads (a property read is a call in disguise)
+        self.call_edges: Dict[str, Set[str]] = {}
+        for qname, summary in self.summaries.items():
+            callees = set(cg.edges.get(qname, set()))
+            for _node, site_callees, _held, _is_self in summary.call_sites:
+                callees.update(site_callees)
+            for _node, site_callees, _held in summary.property_sites:
+                callees.update(site_callees)
+            self.call_edges[qname] = callees
+        self.eff_acquires = self._propagate(
+            {q: set(s.direct_acquires) for q, s in self.summaries.items()},
+            self.call_edges)
+        self.eff_self_acquires = self._fixpoint_self_acquires()
+        self.eff_blocking = self._fixpoint_sites(
+            lambda s: {site for site, _held in s.blocking})
+        self.eff_callbacks = self._fixpoint_sites(
+            lambda s: {site for site, _held in s.callbacks})
+        #: (from key, to key) -> first example EdgeSite
+        self.edges: Dict[Tuple[str, str], EdgeSite] = {}
+        self._build_edges()
+
+    # -- per-function summaries --------------------------------------------
+    def _summarize(self, info: FunctionInfo) -> Summary:
+        summary = Summary(info)
+        acquire_regions = self._acquire_regions(info)
+        for node in ast.walk(info.node):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    decls, _sp = self._lock_expr(info, item.context_expr)
+                    if decls:
+                        held = self._held_at(info, node, acquire_regions)
+                        summary.direct_acquires.update(
+                            d.key for d in decls)
+                        summary.acquire_sites.append(
+                            (node, {d.key for d in decls}, held))
+            elif isinstance(node, ast.Call):
+                self._summarize_call(info, node, summary, acquire_regions)
+        for _lineno, decls, _end in acquire_regions:
+            summary.direct_acquires.update(d.key for d in decls)
+        self._property_sites(info, summary, acquire_regions)
+        return summary
+
+    def _summarize_call(self, info: FunctionInfo, node: ast.Call,
+                        summary: Summary, acquire_regions) -> None:
+        cg = self.cg
+        func = node.func
+        held = self._held_at(info, node, acquire_regions)
+        held_spellings = {sp for _d, sp in held}
+        attr_name = func.attr if isinstance(func, ast.Attribute) else None
+        receiver = dotted_source(func.value) or "" \
+            if isinstance(func, ast.Attribute) else ""
+
+        # explicit .acquire(): an acquisition site (region handled above)
+        if attr_name == "acquire":
+            decls, _sp = self._lock_expr(info, func.value)
+            if decls:
+                summary.acquire_sites.append(
+                    (node, {d.key for d in decls}, held))
+            return
+        if attr_name == "release":
+            return
+
+        blocking = self._blocking_desc(info, node, receiver)
+        if blocking is not None:
+            summary.blocking.append((blocking, held))
+
+        callback = self._callback_desc(info, node, receiver, attr_name)
+        if callback is not None:
+            summary.callbacks.append((callback, held))
+
+        callees = cg.resolve_call(info, node)
+        is_self = (isinstance(func, ast.Attribute)
+                   and isinstance(func.value, ast.Name)
+                   and func.value.id == "self")
+        if is_self:
+            summary.self_callees.update(callees)
+        if callees:
+            summary.call_sites.append((node, callees, held, is_self))
+
+    def _property_sites(self, info: FunctionInfo, summary: Summary,
+                        acquire_regions) -> None:
+        parents = info.module.parents
+        for node in ast.walk(info.node):
+            if not isinstance(node, ast.Attribute) \
+                    or not isinstance(node.ctx, ast.Load):
+                continue
+            parent = parents.get(id(node))
+            if isinstance(parent, ast.Call) and parent.func is node:
+                continue        # a method call, handled as a call
+            props = self.cg.resolve_property_load(node.attr)
+            if not props:
+                continue
+            held = self._held_at(info, node, acquire_regions)
+            summary.property_sites.append((node, props, held))
+
+    def _acquire_regions(self, info: FunctionInfo):
+        """(start lineno, decls, end lineno) for explicit ``.acquire()``
+        calls, closed by the matching-spelling ``.release()`` (or function
+        end). Lexical lineno ranges — the repo overwhelmingly uses
+        ``with``; this exists so the few explicit acquires aren't
+        invisible."""
+        regions = []
+        releases: Dict[str, int] = {}
+        for node in ast.walk(info.node):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute):
+                spelling = dotted_source(node.func.value) or ""
+                if node.func.attr == "release":
+                    releases[spelling] = max(releases.get(spelling, 0),
+                                             node.lineno)
+        for node in ast.walk(info.node):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "acquire":
+                decls, spelling = self._lock_expr(info, node.func.value)
+                if decls:
+                    end = releases.get(spelling or "", 10 ** 9)
+                    regions.append((node.lineno, decls, end))
+        return regions
+
+    def _lock_expr(self, info: FunctionInfo,
+                   expr: ast.AST) -> Tuple[Set[LockDecl], Optional[str]]:
+        attr = self_attr(expr)
+        if attr is not None and info.cls:
+            decls = self.cg.acquire_targets(info.relpath, info.cls, attr)
+            return decls, f"self.{attr}"
+        if isinstance(expr, ast.Name):
+            decl = self.cg.module_lock(info.relpath, expr.id)
+            if decl is not None:
+                return {decl}, expr.id
+        return set(), None
+
+    def _held_at(self, info: FunctionInfo, node: ast.AST,
+                 acquire_regions) -> Held:
+        held: List[Tuple[LockDecl, str]] = []
+        for ancestor in info.module.ancestors(node):
+            if ancestor is info.node:
+                break
+            if isinstance(ancestor, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                return ()       # nested def: runs with its own held-set
+            if isinstance(ancestor, (ast.With, ast.AsyncWith)):
+                for item in ancestor.items:
+                    decls, spelling = self._lock_expr(info,
+                                                      item.context_expr)
+                    for decl in decls:
+                        held.append((decl, spelling or decl.attr))
+        lineno = getattr(node, "lineno", 0)
+        for start, decls, end in acquire_regions:
+            if start < lineno <= end:
+                for decl in decls:
+                    held.append((decl, f"self.{decl.attr}"))
+        for decl in self.cg.convention_locks(info):
+            held.append((decl, f"self.{decl.attr}"))
+        return tuple(held)
+
+    def _blocking_desc(self, info: FunctionInfo, node: ast.Call,
+                       receiver: str) -> Optional[BlockingSite]:
+        func = node.func
+        rel, line = info.relpath, node.lineno
+        if isinstance(func, ast.Name) and func.id == "sleep":
+            return BlockingSite("sleep() (injected sleep callable)", rel,
+                                line, "")
+        if not isinstance(func, ast.Attribute):
+            return None
+        attr = func.attr
+        has_timeout = any(kw.arg == "timeout" for kw in node.keywords)
+        if receiver == "time" and attr == "sleep":
+            return BlockingSite("time.sleep()", rel, line, receiver)
+        if receiver == "subprocess" and attr in SUBPROCESS_CALLS \
+                and not has_timeout:
+            return BlockingSite(f"subprocess.{attr}() without timeout=",
+                                rel, line, receiver)
+        if attr in TRANSPORT_CALLS and not has_timeout:
+            return BlockingSite(f".{attr}() without timeout=", rel, line,
+                                receiver)
+        if attr == "join" and not node.args and not node.keywords:
+            return BlockingSite(".join() without timeout", rel, line,
+                                receiver)
+        if attr == "wait" and not node.args and not has_timeout:
+            return BlockingSite(".wait() without timeout", rel, line,
+                                receiver, is_wait=True)
+        if attr == "commit" and not node.args:
+            return BlockingSite(".commit()", rel, line, receiver)
+        if attr == "urlopen":
+            return BlockingSite("urlopen()", rel, line, receiver)
+        return None
+
+    def _callback_desc(self, info: FunctionInfo, node: ast.Call,
+                       receiver: str,
+                       attr_name: Optional[str]) -> Optional[CallbackSite]:
+        func = node.func
+        if attr_name in CALLBACK_ATTRS:
+            # notifying a held Condition is lock API, not a user callback
+            decls, _sp = self._lock_expr(info, func.value)
+            if decls:
+                return None
+            return CallbackSite(f"{receiver}.{attr_name}()", info.relpath,
+                                node.lineno)
+        if isinstance(func, ast.Name):
+            params = self._param_names(info)
+            if func.id in params and func.id not in TIME_SOURCE_PARAMS \
+                    and not self.cg.resolve_call(info, node):
+                return CallbackSite(f"{func.id}() (callable parameter)",
+                                    info.relpath, node.lineno)
+        return None
+
+    @staticmethod
+    def _param_names(info: FunctionInfo) -> Set[str]:
+        args = info.node.args
+        return {a.arg for a in args.posonlyargs + args.args
+                + args.kwonlyargs}
+
+    # -- fixpoints ----------------------------------------------------------
+    def _fixpoint_self_acquires(self) -> Dict[str, Set[str]]:
+        """Lock keys reachable through ``self.*``-rooted chains only —
+        the same-instance closure check (d) needs (own-class ``with
+        self.X`` acquires, chased through self-calls)."""
+        eff = {}
+        for qname, summary in self.summaries.items():
+            own = set()
+            for _node, keys, _held in summary.acquire_sites:
+                for key in keys:
+                    decl = self.cg.locks.get(key)
+                    if decl is not None and decl.owner:
+                        own.add(key)
+            eff[qname] = own
+        self_edges = {q: s.self_callees for q, s in self.summaries.items()}
+        return self._propagate(eff, self_edges)
+
+    def _fixpoint_sites(self, direct):
+        """Propagate site-sets (blocking / callback) up the call graph,
+        remembering one ``via`` callee per inherited site for the
+        human-readable chain in the finding."""
+        eff: Dict[str, Dict[object, Optional[str]]] = {
+            q: {site: None for site in direct(s)}
+            for q, s in self.summaries.items()}
+        changed = True
+        while changed:
+            changed = False
+            for qname, callees in self.call_edges.items():
+                mine = eff.setdefault(qname, {})
+                for callee in callees:
+                    for site in eff.get(callee, {}):
+                        if site not in mine:
+                            mine[site] = callee
+                            changed = True
+        return eff
+
+    @staticmethod
+    def _propagate(eff: Dict[str, Set[str]],
+                   edges: Dict[str, Set[str]]) -> Dict[str, Set[str]]:
+        changed = True
+        while changed:
+            changed = False
+            for qname, callees in edges.items():
+                mine = eff.setdefault(qname, set())
+                before = len(mine)
+                for callee in callees:
+                    mine.update(eff.get(callee, set()))
+                if len(mine) != before:
+                    changed = True
+        return eff
+
+    # -- the global order graph --------------------------------------------
+    def _build_edges(self) -> None:
+        for qname, summary in self.summaries.items():
+            info = summary.info
+            for node, keys, held in summary.acquire_sites:
+                held_keys = {d.key for d, _sp in held}
+                for decl_key in keys:
+                    if decl_key in held_keys:
+                        # re-acquiring a lock this thread already holds
+                        # imposes no NEW ordering (the runtime witness
+                        # skips these the same way); check (d) owns the
+                        # non-reentrant variant
+                        continue
+                    for held_decl, _sp in held:
+                        self._add_edge(held_decl.key, decl_key,
+                                       EdgeSite(info.relpath,
+                                                node.lineno,
+                                                info.display, ""))
+            sites = ([(n, c, h) for n, c, h, _s in summary.call_sites]
+                     + summary.property_sites)
+            for node, callees, held in sites:
+                if not held:
+                    continue
+                held_keys = {d.key for d, _sp in held}
+                for callee in callees:
+                    for key in self.eff_acquires.get(callee, set()):
+                        if key in held_keys:
+                            continue    # reentrant re-acquire: no ordering
+                        for held_decl, _sp in held:
+                            self._add_edge(
+                                held_decl.key, key,
+                                EdgeSite(info.relpath, node.lineno,
+                                         info.display,
+                                         self._display(callee)))
+        # wait-export: observing a contended acquire of an exported lock
+        # touches the wait histogram's family lock while the acquired (and
+        # any already-held) witnessed locks are held
+        export_target = None
+        for decl in self.cg.locks.values():
+            if decl.witness_name == WAIT_EXPORT_LOCK:
+                export_target = decl
+                break
+        if export_target is not None:
+            for decl in self.cg.locks.values():
+                if decl.named and decl.key != export_target.key:
+                    self.edges.setdefault(
+                        (decl.key, export_target.key),
+                        EdgeSite(decl.relpath, decl.lineno, "(wait export)",
+                                 "lockwitness wait histogram"))
+
+    def _add_edge(self, from_key: str, to_key: str, site: EdgeSite) -> None:
+        self.edges.setdefault((from_key, to_key), site)
+
+    def _display(self, qname: str) -> str:
+        info = self.cg.functions.get(qname)
+        return info.display if info is not None else qname
+
+    # -- comparator surface -------------------------------------------------
+    def witness_names(self) -> Set[str]:
+        return {decl.witness_name for decl in self.cg.locks.values()}
+
+    def witness_edges(self) -> Set[Tuple[str, str]]:
+        out = set()
+        for (k1, k2) in self.edges:
+            d1, d2 = self.cg.locks.get(k1), self.cg.locks.get(k2)
+            if d1 is not None and d2 is not None \
+                    and d1.witness_name != d2.witness_name:
+                out.add((d1.witness_name, d2.witness_name))
+        return out
+
+    # -- checks -------------------------------------------------------------
+    def findings(self, rule_id: str) -> List[Finding]:
+        findings: List[Finding] = []
+        findings.extend(self._check_cycles(rule_id))
+        findings.extend(self._check_blocking(rule_id))
+        findings.extend(self._check_callbacks(rule_id))
+        findings.extend(self._check_reacquire(rule_id))
+        return findings
+
+    def _lock_name(self, key: str) -> str:
+        decl = self.cg.locks.get(key)
+        return decl.witness_name if decl is not None else key
+
+    # (a) order-inversion cycles
+    def _check_cycles(self, rule_id: str) -> List[Finding]:
+        adjacency: Dict[str, Set[str]] = {}
+        for (k1, k2) in self.edges:
+            adjacency.setdefault(k1, set()).add(k2)
+        findings = []
+        seen_cycles: Set[Tuple[str, ...]] = set()
+        for start in sorted(adjacency):
+            cycle = self._shortest_cycle(adjacency, start)
+            if cycle is None:
+                continue
+            canon = self._canonical(cycle)
+            if canon in seen_cycles:
+                continue
+            seen_cycles.add(canon)
+            parts = []
+            for i, key in enumerate(cycle):
+                nxt = cycle[(i + 1) % len(cycle)]
+                site = self.edges.get((key, nxt))
+                where = f"{site.relpath}:{site.lineno} in {site.holder}" \
+                    if site else "?"
+                via = f" via {site.via}" if site and site.via else ""
+                parts.append(f"{self._lock_name(key)} -> "
+                             f"{self._lock_name(nxt)} ({where}{via})")
+            first = self.edges.get((cycle[0], cycle[1 % len(cycle)]))
+            findings.append(Finding(
+                rule_id, first.relpath if first else "",
+                first.lineno if first else 0,
+                "lock-order inversion (potential deadlock): "
+                + "; ".join(parts)
+                + " — acquire these locks in one global order, or narrow "
+                  "the outer region so the inner lock is taken unheld"))
+        return findings
+
+    @staticmethod
+    def _canonical(cycle: Tuple[str, ...]) -> Tuple[str, ...]:
+        pivot = min(range(len(cycle)), key=lambda i: cycle[i])
+        return cycle[pivot:] + cycle[:pivot]
+
+    @staticmethod
+    def _shortest_cycle(adjacency: Dict[str, Set[str]],
+                        start: str) -> Optional[Tuple[str, ...]]:
+        # BFS back to start
+        frontier = [(n, (start, n)) for n in sorted(adjacency.get(start,
+                                                                  set()))]
+        visited = {start}
+        while frontier:
+            nxt = []
+            for node, path in frontier:
+                if node == start:
+                    return path[:-1]
+                if node in visited:
+                    continue
+                visited.add(node)
+                for succ in sorted(adjacency.get(node, set())):
+                    nxt.append((succ, path + (succ,)))
+            frontier = nxt
+        return None
+
+    # (b) blocking reachable while a lock is held
+    def _check_blocking(self, rule_id: str) -> List[Finding]:
+        findings = []
+        reported: Set[Tuple[str, str, int]] = set()
+        for qname, summary in self.summaries.items():
+            info = summary.info
+            for site, held in summary.blocking:
+                for decl, spelling in held:
+                    if site.is_wait and site.receiver == spelling:
+                        continue    # cond.wait releases the lock it guards
+                    key = (decl.key, site.relpath, site.lineno)
+                    if key in reported:
+                        continue
+                    reported.add(key)
+                    findings.append(Finding(
+                        rule_id, site.relpath, site.lineno,
+                        f"{site.desc} while holding "
+                        f"{self._lock_name(decl.key)} (in {info.display}) "
+                        "stalls every thread contending on the lock"))
+            sites = ([(n, c, h) for n, c, h, _s in summary.call_sites]
+                     + summary.property_sites)
+            for node, callees, held in sites:
+                if not held:
+                    continue
+                for callee in callees:
+                    for site, via in self.eff_blocking.get(callee,
+                                                           {}).items():
+                        for decl, _sp in held:
+                            key = (decl.key, site.relpath, site.lineno)
+                            if key in reported:
+                                continue
+                            reported.add(key)
+                            chain = self._chain(callee, site,
+                                                self.eff_blocking)
+                            findings.append(Finding(
+                                rule_id, info.relpath, node.lineno,
+                                f"{site.desc} at {site.relpath}:"
+                                f"{site.lineno} is reachable while "
+                                f"{info.display} holds "
+                                f"{self._lock_name(decl.key)} "
+                                f"(call chain {chain})"))
+        return findings
+
+    # (c) callback / sink invocation under a lock
+    def _check_callbacks(self, rule_id: str) -> List[Finding]:
+        findings = []
+        reported: Set[Tuple[str, str, int]] = set()
+        for qname, summary in self.summaries.items():
+            info = summary.info
+            for site, held in summary.callbacks:
+                if not held:
+                    continue
+                decl = held[0][0]
+                key = (decl.key, site.relpath, site.lineno)
+                if key in reported:
+                    continue
+                reported.add(key)
+                findings.append(Finding(
+                    rule_id, site.relpath, site.lineno,
+                    f"{site.desc} invoked while holding "
+                    f"{self._lock_name(decl.key)} (in {info.display}) — "
+                    "user/sink code must run outside the lock (snapshot "
+                    "under the lock, call after releasing)"))
+            sites = ([(n, c, h) for n, c, h, _s in summary.call_sites]
+                     + summary.property_sites)
+            for node, callees, held in sites:
+                if not held:
+                    continue
+                for callee in callees:
+                    for site, _via in self.eff_callbacks.get(callee,
+                                                             {}).items():
+                        decl = held[0][0]
+                        key = (decl.key, site.relpath, site.lineno)
+                        if key in reported:
+                            continue
+                        reported.add(key)
+                        chain = self._chain(callee, site,
+                                            self.eff_callbacks)
+                        findings.append(Finding(
+                            rule_id, info.relpath, node.lineno,
+                            f"{site.desc} at {site.relpath}:{site.lineno} "
+                            f"runs under {self._lock_name(decl.key)} held "
+                            f"by {info.display} (call chain {chain}) — "
+                            "hoist the callback out of the locked region"))
+        return findings
+
+    # (d) re-acquisition of a non-reentrant lock through a call chain
+    def _check_reacquire(self, rule_id: str) -> List[Finding]:
+        findings = []
+        reported: Set[Tuple[str, str, int]] = set()
+        for qname, summary in self.summaries.items():
+            info = summary.info
+            for node, keys, held in summary.acquire_sites:
+                for decl_key in keys:
+                    decl = self.cg.locks.get(decl_key)
+                    if decl is None or decl.reentrant:
+                        continue
+                    for held_decl, spelling in held:
+                        if held_decl.key != decl_key:
+                            continue
+                        key = (decl_key, info.relpath, node.lineno)
+                        if key in reported:
+                            continue
+                        reported.add(key)
+                        findings.append(Finding(
+                            rule_id, info.relpath, node.lineno,
+                            f"{self._lock_name(decl_key)} re-acquired "
+                            f"while already held (in {info.display}) — "
+                            "non-reentrant Lock: this self-deadlocks"))
+            for node, callees, held, is_self in summary.call_sites:
+                if not held:
+                    continue
+                for held_decl, _sp in held:
+                    if held_decl.reentrant:
+                        continue
+                    eff = (self.eff_self_acquires if held_decl.owner
+                           else self.eff_acquires)
+                    if held_decl.owner and not is_self:
+                        continue    # other instance: not provably the same
+                    for callee in callees:
+                        if held_decl.key not in eff.get(callee, set()):
+                            continue
+                        key = (held_decl.key, info.relpath, node.lineno)
+                        if key in reported:
+                            continue
+                        reported.add(key)
+                        findings.append(Finding(
+                            rule_id, info.relpath, node.lineno,
+                            f"call chain from {info.display} re-acquires "
+                            f"non-reentrant "
+                            f"{self._lock_name(held_decl.key)} via "
+                            f"{self._display(callee)} while already "
+                            "holding it — self-deadlock (use the _locked "
+                            "convention or split the locked region)"))
+        return findings
+
+    def _chain(self, callee: str, site, eff) -> str:
+        parts = [self._display(callee)]
+        current = callee
+        for _ in range(5):
+            via = eff.get(current, {}).get(site)
+            if via is None:
+                break
+            parts.append(self._display(via))
+            current = via
+        return " -> ".join(parts)
+
+
+def build_lock_model(root: Path) -> LockModel:
+    """The lock model for ``root`` — shared by the TH-LOCK rule, the
+    witness comparator and the tests."""
+    return LockModel(get_callgraph(root))
+
+
+def compare_witness(dump_path: Path, root: Path) -> Tuple[bool, List[str]]:
+    """Check a runtime lockwitness dump against the static model: observed
+    edges must be a subset of the static order graph, every observed name
+    must be a declared lock, and the run must have recorded no inversions.
+    Returns ``(ok, report lines)`` — a failing line means either the model
+    missed a real acquisition path (fix the analyzer, it is unsound) or
+    the program deadlock-ordered differently than the code reads."""
+    with open(dump_path) as fh:
+        data = json.load(fh)
+    model = build_lock_model(root)
+    static_names = model.witness_names()
+    static_edges = model.witness_edges()
+
+    lines: List[str] = []
+    ok = True
+
+    observed_names = set(data.get("locks", {}))
+    for a, b, _count in data.get("edges", []):
+        observed_names.update((a, b))
+    unknown = sorted(observed_names - static_names)
+    for name in unknown:
+        ok = False
+        lines.append(f"witness: unknown lock {name!r}: observed at runtime "
+                     "but never declared through the lockwitness factory in "
+                     "scanned sources — the static model cannot see it")
+
+    observed_edges = {(a, b) for a, b, _count in data.get("edges", [])}
+    escaped = sorted(observed_edges - static_edges)
+    for a, b in escaped:
+        ok = False
+        lines.append(f"witness: observed order {a} -> {b} is NOT in the "
+                     "static graph — the analyzer missed an acquisition "
+                     "path (unsound model; fix tools/analysis before "
+                     "trusting TH-LOCK again)")
+
+    for inv in data.get("inversions", []):
+        ok = False
+        cycle = " -> ".join(inv.get("cycle", []))
+        lines.append(f"witness: runtime ABBA inversion {cycle} "
+                     f"(thread {inv.get('thread')!r} held "
+                     f"{inv.get('held')} while acquiring "
+                     f"{inv.get('acquiring')!r})")
+
+    lines.append(
+        f"witness: {len(observed_edges)} observed edge(s) over "
+        f"{len(observed_names)} lock(s) vs {len(static_edges)} static "
+        f"edge(s) over {len(static_names)} declared name(s): "
+        + ("observed ⊆ static, no inversions — the runtime agrees with "
+           "the model" if ok else "MISMATCH"))
+    return ok, lines
+
+
+class LockOrderRule(ProjectRule):
+    id = "TH-LOCK"
+    title = "interprocedural lock-order / blocking / callback discipline"
+    rationale = ("Deadlocks live in the composition of functions, not in "
+                 "any one of them: lock-order cycles, blocking calls and "
+                 "user callbacks reachable under a lock must be caught "
+                 "across call chains before the fleet multiplies the "
+                 "thread count.")
+    scope = ("tensorhive_tpu/",)
+
+    def check_project(self, root: Path) -> List[Finding]:
+        model = build_lock_model(root)
+        return model.findings(self.id)
+
+
+register(LockOrderRule())

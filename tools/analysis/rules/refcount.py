@@ -41,9 +41,9 @@ from __future__ import annotations
 import ast
 from typing import Dict, List, Optional, Set, Tuple
 
-from ..dataflow import dotted_source
+from ..dataflow import (class_lock_attrs, dotted_source, is_locked_name,
+                        self_attr)
 from ..engine import Finding, ModuleContext, Rule, register
-from .concurrency import _is_lock_value, _self_attr
 
 #: acquire method name -> the release method that must pair with it
 PAIRS = {
@@ -272,16 +272,7 @@ class RefcountPairingRule(Rule):
 
     def _lock_attrs(self, module: ModuleContext,
                     cls: ast.ClassDef) -> Set[str]:
-        attrs: Set[str] = set()
-        for node in ast.walk(cls):
-            if module.nearest_class(node) is not cls:
-                continue
-            if isinstance(node, ast.Assign) and _is_lock_value(node.value):
-                for target in node.targets:
-                    attr = _self_attr(target)
-                    if attr is not None:
-                        attrs.add(attr)
-        return attrs
+        return set(class_lock_attrs(module, cls))
 
     def _check_class_locked(self, module: ModuleContext, cls: ast.ClassDef,
                             lock_attrs: Set[str]) -> List[Finding]:
@@ -290,11 +281,11 @@ class RefcountPairingRule(Rule):
             if module.nearest_class(node) is not cls:
                 continue
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
-                    and node.name.endswith("_locked"):
+                    and is_locked_name(node.name):
                 for sub in ast.walk(node):
                     if isinstance(sub, (ast.With, ast.AsyncWith)):
                         for item in sub.items:
-                            attr = _self_attr(item.context_expr)
+                            attr = self_attr(item.context_expr)
                             if attr in lock_attrs:
                                 findings.append(Finding(
                                     self.id, module.relpath, sub.lineno,
@@ -305,7 +296,7 @@ class RefcountPairingRule(Rule):
             if isinstance(node, ast.Call):
                 func = node.func
                 if not (isinstance(func, ast.Attribute)
-                        and func.attr.endswith("_locked")
+                        and is_locked_name(func.attr)
                         and isinstance(func.value, ast.Name)
                         and func.value.id == "self"):
                     continue
@@ -326,10 +317,10 @@ class RefcountPairingRule(Rule):
         for ancestor in module.ancestors(node):
             if isinstance(ancestor, (ast.With, ast.AsyncWith)):
                 for item in ancestor.items:
-                    if _self_attr(item.context_expr) in lock_attrs:
+                    if self_attr(item.context_expr) in lock_attrs:
                         return True
             if isinstance(ancestor, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                return ancestor.name.endswith("_locked")
+                return is_locked_name(ancestor.name)
             if isinstance(ancestor, ast.ClassDef):
                 break
         return False

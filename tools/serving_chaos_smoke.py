@@ -324,6 +324,27 @@ def main() -> int:
         generation.shutdown()
         generation.join(timeout=10)
 
+    # -- 8 (TPUHIVE_LOCK_WITNESS=1 only): the whole run doubled as a lock
+    # witness — zero observed ABBA inversions, and every observed order
+    # edge must exist in the static TH-LOCK graph (the model's soundness
+    # proof; docs/STATIC_ANALYSIS.md "TH-LOCK")
+    from tensorhive_tpu.utils import lockwitness
+
+    if lockwitness.witness_enabled():
+        dump_path = Path("/tmp/tpuhive-serving-chaos-witness.json")
+        snap = lockwitness.dump(str(dump_path))
+        check(snap["locks"], "witness observed named locks "
+              f"({len(snap['locks'])} names, {len(snap['edges'])} edges)")
+        check(not snap["inversions"],
+              f"zero runtime lock inversions ({snap['inversions']})")
+        from tools.analysis.rules.locks import compare_witness
+
+        ok, lines = compare_witness(
+            dump_path, Path(__file__).resolve().parent.parent)
+        for line in lines:
+            print(f"serving-chaos-smoke: {line}")
+        check(ok, "observed lock-order edges ⊆ static TH-LOCK graph")
+
     if PROBLEMS:
         print(f"serving-chaos-smoke: {len(PROBLEMS)} problem(s)",
               file=sys.stderr)

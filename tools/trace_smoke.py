@@ -208,6 +208,25 @@ def main() -> int:
         generation.shutdown()
         generation.join(timeout=5)
 
+    # -- 6 (TPUHIVE_LOCK_WITNESS=1 only): the traced run doubled as a lock
+    # witness — no ABBA inversions, observed order ⊆ static TH-LOCK graph
+    from tensorhive_tpu.utils import lockwitness
+
+    if lockwitness.witness_enabled():
+        dump_path = Path(workdir) / "lock-witness.json"
+        snap = lockwitness.dump(str(dump_path))
+        check(snap["locks"], "witness observed named locks "
+              f"({len(snap['locks'])} names, {len(snap['edges'])} edges)")
+        check(not snap["inversions"],
+              f"zero runtime lock inversions ({snap['inversions']})")
+        from tools.analysis.rules.locks import compare_witness
+
+        ok, lines = compare_witness(
+            dump_path, Path(__file__).resolve().parent.parent)
+        for line in lines:
+            print(f"trace-smoke: {line}")
+        check(ok, "observed lock-order edges ⊆ static TH-LOCK graph")
+
     if PROBLEMS:
         print(f"trace-smoke: {len(PROBLEMS)} problem(s)", file=sys.stderr)
         return 1
