@@ -2,7 +2,7 @@
 # mypy + flake8 per .circleci/config.yml:33-38): the dependency-free AST
 # lint + thivelint analyzer always run; mypy/ruff run when installed
 # (absent from this image).
-.PHONY: check lint analysis analysis-fast lockcheck test bench probe metrics-smoke decode-smoke alerts-smoke chaos-smoke serving-smoke serving-mesh-smoke trace-smoke prefix-smoke spec-smoke serving-chaos-smoke quant-smoke history-smoke
+.PHONY: check lint analysis analysis-fast lockcheck test bench probe metrics-smoke decode-smoke alerts-smoke chaos-smoke serving-smoke serving-mesh-smoke trace-smoke prefix-smoke spec-smoke serving-chaos-smoke quant-smoke history-smoke tier-smoke
 
 check: lint analysis
 	@command -v ruff >/dev/null 2>&1 && ruff check . || echo "ruff not installed; skipped (tools/lint.py covered the always-on subset)"
@@ -131,6 +131,14 @@ quant-smoke:
 # leave exactly one crash dump whose last tick shows the fault
 history-smoke:
 	python tools/history_smoke.py
+
+# KV-page tiering over a real socket (docs/SERVING.md "KV-page tiering"):
+# a cold miss, pool-pressure demotion to host RAM, then a host-tier hit
+# must emit IDENTICAL tokens with a LOWER TTFT than the miss, the ledger
+# must carry hostHitPages/promoteMs, the host_kv counters and byte gauges
+# must be scrapeable, zero post-warmup recompiles across the round trip
+tier-smoke:
+	python tools/tier_smoke.py
 
 probe:
 	$(MAKE) -C tensorhive_tpu/native

@@ -852,6 +852,108 @@ def bench_generate_serving():
     })
     _log(f"  kv_quant: {quant_block}")
 
+    # KV-page tiering (docs/SERVING.md "KV-page tiering"): cold-miss vs
+    # host-hit TTFT after pool-pressure demotion, the cached-capacity
+    # multiplier the host store buys at EQUAL HBM, and the promote-lane
+    # overlap verdict (decode keeps emitting while a promotion stages).
+    # Progressive-install like every block above.
+    from tensorhive_tpu.models.decode import _compile_seen as _seen
+
+    tier_len = max(3 * page_size,
+                   min(max_len - new_tokens - 16,
+                       1024 if jax.default_backend() == "tpu" else 88))
+    tier_pages = -(-(tier_len + new_tokens) // page_size)
+    tier_block = {"page_size": page_size, "host_kv_bytes": 1 << 22,
+                  "probe_tokens": tier_len, "kv_pages": tier_pages}
+    result["kv_tiering"] = tier_block
+    probe = list(range(1, tier_len + 1))
+    churn_prompt = [(7 * j + 11) % (config.vocab_size - 1) + 1
+                    for j in range(tier_len)]
+    # pool sized to EXACTLY one request: admitting the churn prompt must
+    # evict (and demote) every cacheable page the probe left behind.
+    # Chunk == page_size so a cold miss pays one tick per page while a
+    # host hit promotes them in one DMA + one tail chunk — the same
+    # tick-count structure the tier smoke gates on (a 64-token chunk on
+    # the CPU tiny model makes recompute cheaper than the copy lane's
+    # park/adopt round trip, which would bench the overhead, not the win)
+    tier_engine = SlotEngine(params, config, slots=2, max_len=max_len,
+                             queue_depth=4, page_size=page_size,
+                             kv_pages=tier_pages, prefix_cache="on",
+                             prefill_chunk_tokens=page_size,
+                             speculative="off",
+                             kv_quant="on", host_kv_bytes=1 << 22)
+    tier_engine.warmup(prompt_lens=(tier_len,))
+    cold = tier_engine.submit(probe, max_new_tokens=new_tokens)
+    drain(tier_engine)
+    compiles_before = len(_seen)        # the round trip below must reuse
+    churn_handle = tier_engine.submit(churn_prompt,
+                                      max_new_tokens=new_tokens)
+    drain(tier_engine)                  # evict -> extract -> host store
+    assert churn_handle.done
+    hit = tier_engine.submit(probe, max_new_tokens=new_tokens)
+    drain(tier_engine)
+    cold_summary = cold.result(timeout_s=30)
+    hit_summary = hit.result(timeout_s=30)
+    assert hit_summary["tokens"] == cold_summary["tokens"], \
+        "host-tier promotion changed tokens"
+    tier_stats = tier_engine.stats()
+    tier_recompiles = len(_seen) - compiles_before
+    tier_block.update({
+        "miss_ttft_ms": round(cold_summary["ttftS"] * 1e3, 2),
+        "host_hit_ttft_ms": round(hit_summary["ttftS"] * 1e3, 2),
+        "miss_vs_host_hit_ttft": round(
+            cold_summary["ttftS"] / max(hit_summary["ttftS"], 1e-9), 2),
+        "demotions": tier_engine.host_kv_demotions,
+        "promotions": tier_engine.host_kv_promotions,
+        "host_pages_resident": tier_stats["hostPagesResident"],
+        "host_bytes_used": tier_stats["hostBytesUsed"],
+        # the working set admission can hit WITHOUT recompute at equal
+        # device HBM: device-cached pages plus host-resident spill
+        "cached_capacity_multiplier_at_equal_hbm": round(
+            (tier_stats["cachedPages"] + tier_stats["hostPagesResident"])
+            / max(1, tier_stats["cachedPages"]), 2),
+        "recompiles": tier_recompiles,
+        "zero_recompile_verdict": tier_recompiles == 0,
+    })
+
+    # promote-lane overlap: on a ROOMY pool (store seeded by forced
+    # eviction), a running decode must keep emitting while another slot's
+    # promotion is staged on the copy lane
+    roomy = SlotEngine(params, config, slots=2, max_len=max_len,
+                       queue_depth=4, page_size=page_size,
+                       prefix_cache="on", prefill_chunk_tokens=64,
+                       speculative="off", kv_quant="on",
+                       host_kv_bytes=1 << 22)
+    roomy.warmup(prompt_lens=(tier_len,))
+    seeded = roomy.submit(probe, max_new_tokens=new_tokens)
+    drain(roomy)
+    assert seeded.done
+    with roomy._lock:
+        roomy._prefix.evict(tier_pages)     # spill the probe's pages
+    drain(roomy)                            # adopt into the host store
+    runner_prompt = [(5 * j + 3) % (config.vocab_size - 1) + 1
+                     for j in range(tier_len)]
+    runner = roomy.submit(runner_prompt, max_new_tokens=2 * new_tokens)
+    roomy.step()
+    promoted = roomy.submit(probe, max_new_tokens=new_tokens)
+    overlap_tokens = 0
+    while roomy.has_work():
+        with roomy._lock:
+            promoting = any(
+                state is not None and state.promote_job is not None
+                for state in roomy._slots)
+        runner_tokens = len(runner._request.generated)
+        roomy.step()
+        if promoting:
+            overlap_tokens += (len(runner._request.generated)
+                               - runner_tokens)
+    assert runner.done and promoted.done
+    tier_block.update({
+        "promote_overlap_decode_tokens": overlap_tokens,
+        "promote_overlap_verdict": overlap_tokens > 0,
+    })
+    _log(f"  kv_tiering: {tier_block}")
+
     # serving data-plane fault recovery (docs/ROBUSTNESS.md "Serving data
     # plane"): time-to-restore after an injected fatal fault through the
     # real GenerationService supervisor, requests failed-fast vs hung

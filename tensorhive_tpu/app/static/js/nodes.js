@@ -302,6 +302,11 @@ async function refreshServing() {
                    (stats.prefixHitRate == null ? "–" :
                     (100 * stats.prefixHitRate).toFixed(0) + "% hit") +
                    " · " + stats.cachedPages + " pg", false)}
+    ${stats.hostPagesResident == null ? "" :
+      servingBadge("host tier",
+                   stats.hostPagesResident + " pg · " +
+                   (stats.hostHitRate == null ? "–" :
+                    (100 * stats.hostHitRate).toFixed(0) + "% hit"), false)}
     ${stats.speculative !== "on" ? "" :
       servingBadge("spec ×" + stats.specTokens,
                    (stats.specAcceptanceRate == null ? "–" :

@@ -213,6 +213,14 @@ class GenerationConfig:
                                      # interleaved with decode steps; 0 =
                                      # one chunk per prompt (prefix-cache
                                      # engines only)
+    host_kv_bytes: int = 0           # KV-page tiering (docs/SERVING.md
+                                     # "KV-page tiering"): byte budget of
+                                     # the host-RAM store cold int8 pages
+                                     # spill to on eviction/drain, promoted
+                                     # back by async DMA on a radix hit.
+                                     # Needs paged + kv_quant + the prefix
+                                     # cache; 0 = byte-identical rollback
+                                     # (no store, no copy lane)
     speculative: str = "auto"        # draft-model speculative decoding
                                      # (docs/SERVING.md "Speculative
                                      # decoding"): auto = on only on real
@@ -569,6 +577,8 @@ enabled = false
 # prefix_cache = "auto"  # radix shared-prefix page cache: auto|on|off
 # prefix_min_tokens = 32
 # prefill_chunk_tokens = 256  # per-tick prefill budget (chunked prefill)
+# host_kv_bytes = 0   # KV-page tiering: host-RAM spill budget for cold
+#                     # int8 pages (0 = off; docs/SERVING.md)
 # speculative = "auto"  # draft-lane speculative decoding: auto|on|off
 # draft_preset = ""     # "" = self-draft from truncated target layers
 # draft_layers = 0      # self-draft depth (0 = half the target's layers)

@@ -81,6 +81,13 @@ STATS_SCHEMA = obj(
     prefixHitRate=s("number", nullable=True),
     cachedPages=s("integer", nullable=True),
     prefillChunkTokens=s("integer", nullable=True),
+    #: KV-page tiering (docs/SERVING.md "KV-page tiering"): host-RAM store
+    #: budget, residency and lifetime host hit rate — all null with
+    #: host_kv_bytes=0 (the rollback hides the serving-strip tier badge)
+    hostKvBytes=s("integer", nullable=True),
+    hostPagesResident=s("integer", nullable=True),
+    hostBytesUsed=s("integer", nullable=True),
+    hostHitRate=s("number", nullable=True),
     #: speculative decoding lane (docs/SERVING.md "Speculative decoding"):
     #: "on"/"off", the per-tick proposal depth, and the lifetime draft
     #: acceptance counters/rate the serving-strip spec badge renders

@@ -164,6 +164,11 @@ REQUEST_RECORD_SCHEMA = obj(
     prefillBucket=s("integer", nullable=True),
     prefillCompile=s("string", nullable=True),
     prefillMs=s("number", nullable=True),
+    #: KV-page tiering (docs/SERVING.md "KV-page tiering"): pages promoted
+    #: from the host store instead of recomputed, and the promotion DMA's
+    #: wall share of TTFT (null = host_kv_bytes=0 rollback)
+    hostHitPages=s("integer", nullable=True),
+    promoteMs=s("number", nullable=True),
     ttftMs=s("number", nullable=True),
     decodeMs=s("number", nullable=True),
     totalMs=s("number", nullable=True),

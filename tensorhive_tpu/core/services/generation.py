@@ -454,6 +454,7 @@ def build_engine(config: Config):
         prefix_cache=generation.prefix_cache,
         prefix_min_tokens=generation.prefix_min_tokens,
         prefill_chunk_tokens=generation.prefill_chunk_tokens,
+        host_kv_bytes=generation.host_kv_bytes,
         speculative=generation.speculative,
         draft_preset=generation.draft_preset,
         draft_layers=generation.draft_layers,

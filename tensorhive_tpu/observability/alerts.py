@@ -679,6 +679,19 @@ def default_rule_pack(monitoring_interval_s: Optional[float] = None,
                         "(docs/SERVING.md 'Prefix cache & chunked "
                         "prefill')"),
         AlertRule(
+            name="host_kv_thrash", severity="warning",
+            kind="increase",
+            metric="tpuhive_generate_host_kv_demotions_total",
+            op=">", threshold=64.0, window_s=300.0,
+            for_s=alert_interval_s,
+            description="KV pages are spilling to the host tier faster "
+                        "than the device working set can stay resident — "
+                        "the pool is churning through demote/promote "
+                        "round-trips instead of serving from HBM; raise "
+                        "kv_pages, raise host_kv_bytes, or shed "
+                        "long-context load (docs/SERVING.md 'KV-page "
+                        "tiering')"),
+        AlertRule(
             name="spec_acceptance_low", severity="warning",
             kind="threshold", op="<", threshold=0.1,
             for_s=2 * alert_interval_s,

@@ -83,6 +83,13 @@ class RequestRecord:
     #: ``tpuhive_decode_compile_total`` fingerprint story per request
     prefill_compile: Optional[str] = None
     prefill_ms: Optional[float] = None
+    #: KV-page tiering (docs/SERVING.md "KV-page tiering"): pages promoted
+    #: from the host store instead of recomputed (None: tier off; 0: tier
+    #: on, no host hit) and the promotion DMA's wall share of TTFT — split
+    #: OUT of prefill_ms so slow joins triage to copy bandwidth vs
+    #: recompute honestly
+    host_hit_pages: Optional[int] = None
+    promote_ms: Optional[float] = None
     ttft_ms: Optional[float] = None
     decode_ms: Optional[float] = None      # first token -> last token
     total_ms: Optional[float] = None
@@ -125,6 +132,8 @@ class RequestRecord:
             "prefillBucket": self.prefill_bucket,
             "prefillCompile": self.prefill_compile,
             "prefillMs": ms(self.prefill_ms),
+            "hostHitPages": self.host_hit_pages,
+            "promoteMs": ms(self.promote_ms),
             "ttftMs": ms(self.ttft_ms),
             "decodeMs": ms(self.decode_ms),
             "totalMs": ms(self.total_ms),

@@ -194,6 +194,37 @@ def row_merge(pages_i8: jax.Array, scales: jax.Array, rows: jax.Array,
     return pages_i8, scales, ctx
 
 
+# -- tier transitions (docs/SERVING.md "KV-page tiering") ---------------------
+
+def extract_pages(pages_i8: jax.Array, scales: jax.Array,
+                  page_ids: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Gather whole pages AND their scale rows for demotion to the host
+    tier: ``pages_i8`` [L, P, ps, Hkv, Dh] int8 + ``scales`` [L, P, Hkv]
+    f32 over traced ``page_ids`` [W] -> ([L, W, ps, Hkv, Dh],
+    [L, W, Hkv]). The scales travel WITH the payload — a page's bytes are
+    meaningless without its quantization grid, and a promotion must
+    restore both so a host-tier hit dequantizes byte-for-byte what the
+    original writer stored (the hit ≡ miss identity, now across tiers).
+    Callers pad ``page_ids`` to one fixed width with the trash page and
+    discard the padded lanes host-side, so the demote batch size is a
+    value, never a shape."""
+    return pages_i8[:, page_ids], scales[:, page_ids]
+
+
+def inject_pages(pages_i8: jax.Array, scales: jax.Array,
+                 page_ids: jax.Array, payload: jax.Array,
+                 payload_scales: jax.Array
+                 ) -> Tuple[jax.Array, jax.Array]:
+    """Scatter promoted page payloads + scale rows back into the device
+    cache: the inverse of :func:`extract_pages`, with out-of-range
+    ``page_ids`` dropped (``mode="drop"``) so callers pad the promote
+    batch to one fixed width with an OOB id — like every other padded
+    write in the paged plane, padding must touch no physical page."""
+    pages_i8 = pages_i8.at[:, page_ids].set(payload, mode="drop")
+    scales = scales.at[:, page_ids].set(payload_scales, mode="drop")
+    return pages_i8, scales
+
+
 # -- read primitive -----------------------------------------------------------
 
 def dequant_gather(pages_i8: jax.Array, scales: jax.Array,

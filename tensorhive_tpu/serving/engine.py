@@ -85,9 +85,10 @@ from __future__ import annotations
 import collections
 import dataclasses
 import functools
+import logging
 import queue as queue_module
 import time
-from typing import Callable, Deque, Dict, List, Optional, Sequence
+from typing import Callable, Deque, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -118,7 +119,15 @@ from ..observability import (
 from ..ops.paged_attention import resolve_paged_kernel
 from . import EngineDrainingError, QueueFullError, RateLimitError
 from .faults import ServingFaultPlan
-from .paging import TRASH_PAGE, PagePool
+from .paging import (
+    TRASH_PAGE,
+    HostCopyLane,
+    HostPageEntry,
+    HostPageStore,
+    LaneJob,
+    PagePool,
+    page_content_key,
+)
 from .prefix_cache import PrefixCache
 from .speculative import (
     SpeculativeLane,
@@ -229,6 +238,35 @@ _DEADLINE_TIMEOUTS = get_registry().counter(
     "generation). Every timeout still ends its stream with a terminal "
     "chunk (docs/ROBUSTNESS.md 'Serving data plane').",
     labels=("phase",))
+_HOST_KV_HITS = get_registry().counter(
+    "tpuhive_generate_host_kv_hits_total",
+    "Admitted requests whose prompt extended past the device-cached "
+    "prefix into host-resident pages (>= 1 page promoted by DMA instead "
+    "of recomputed; docs/SERVING.md 'KV-page tiering').")
+_HOST_KV_MISSES = get_registry().counter(
+    "tpuhive_generate_host_kv_misses_total",
+    "Tier-on admissions the host store could not extend (no resident "
+    "continuation past the device match) — hits/(hits+misses) is the "
+    "host hit rate.")
+_HOST_KV_DEMOTIONS = get_registry().counter(
+    "tpuhive_generate_host_kv_demotions_total",
+    "KV pages demoted (spilled) to the host-RAM store when the radix "
+    "tree evicted them or their slot drained — sustained fast growth is "
+    "the host_kv_thrash alert signal (docs/OBSERVABILITY.md).")
+_HOST_KV_PROMOTIONS = get_registry().counter(
+    "tpuhive_generate_host_kv_promotions_total",
+    "KV pages promoted from the host store back into fresh device pages "
+    "on a radix continuation hit (async copy lane; never blocks the "
+    "pump).")
+_HOST_KV_BYTES_USED = get_registry().gauge(
+    "tpuhive_generate_host_kv_bytes_used",
+    "Host RAM currently held by demoted int8 page payloads + scales.")
+_HOST_KV_BYTES_CAPACITY = get_registry().gauge(
+    "tpuhive_generate_host_kv_bytes_capacity",
+    "Byte budget of the host page store ([generation_service] "
+    "host_kv_bytes; 0 = tiering off).")
+
+log = logging.getLogger(__name__)
 
 
 # -- device functions ---------------------------------------------------------
@@ -666,6 +704,46 @@ _paged_chunk_serving_prefill = functools.partial(
     donate_argnames=("cache",))(_paged_chunk_prefill_body)
 
 
+def _page_extract_body(cache, page_ids):
+    """Gather whole int8 pages + scale rows out of the quantized paged
+    cache for DEMOTION to the host tier (docs/SERVING.md "KV-page
+    tiering"). ``page_ids`` is a fixed-width [W] operand (W =
+    max_pages_per_slot) padded with ``TRASH_PAGE`` — padded lanes gather
+    trash-page garbage the host side discards, so any demotion batch
+    size reuses one executable. The cache is NOT donated: this is a pure
+    read, and because all executables chain through the one donated
+    cache buffer on the single pump thread, dispatching the extract
+    BEFORE any overwriting prefill guarantees it reads the pre-overwrite
+    bytes (the same dispatched-order argument the prefix cache's
+    readiness rule rests on)."""
+    k, k_scale = kvq.extract_pages(cache.k, cache.k_scale, page_ids)
+    v, v_scale = kvq.extract_pages(cache.v, cache.v_scale, page_ids)
+    return k, k_scale, v, v_scale
+
+
+_serving_page_extract = jax.jit(_page_extract_body)
+
+
+def _page_inject_body(cache, page_ids, k, k_scale, v, v_scale):
+    """Scatter host-staged int8 pages + scales into freshly-allocated
+    physical pages: the device half of PROMOTION. ``page_ids`` is the
+    same fixed [W] width as the extract, padded with an out-of-range id
+    so ``mode="drop"`` discards the zero payload in unused lanes. The
+    cache IS donated (this write joins the step/prefill dispatch chain
+    in place); byte-identity of a host round-trip is exact because the
+    int8 payload and f32 scales come back untouched — no re-quantization
+    happens in either direction."""
+    new_k, new_ks = kvq.inject_pages(cache.k, cache.k_scale, page_ids,
+                                     k, k_scale)
+    new_v, new_vs = kvq.inject_pages(cache.v, cache.v_scale, page_ids,
+                                     v, v_scale)
+    return QuantKVCache(k=new_k, v=new_v, k_scale=new_ks, v_scale=new_vs)
+
+
+_serving_page_inject = functools.partial(
+    jax.jit, donate_argnames=("cache",))(_page_inject_body)
+
+
 # -- request plumbing ---------------------------------------------------------
 
 #: handle event kinds
@@ -777,6 +855,23 @@ class _Slot:
     prefill_ms: float = 0.0
     prefill_started_ts: float = 0.0
     prefill_compile: Optional[str] = None
+    # -- host tier (docs/SERVING.md "KV-page tiering") --------------------
+    #: pages granted from the host store at admission (0 = no host hit)
+    host_hit_pages: int = 0
+    #: store entries to promote; drained into the copy lane by _join
+    promote_entries: List[HostPageEntry] = dataclasses.field(
+        default_factory=list)
+    #: physical destination pages (the fresh pages right after the
+    #: device-shared run in this slot's page-table row)
+    promote_pages: List[int] = dataclasses.field(default_factory=list)
+    #: prompt tokens covered once the inject lands — prefill resumes here
+    promote_boundary: int = 0
+    #: in-flight HtoD staging job; while set the slot is PARKED exactly
+    #: like mid-chunk-prefill (never enters the decode batch, cancel and
+    #: deadline still fire) so a slow DMA can never stall the pump
+    promote_job: Optional[LaneJob] = None
+    promote_started_ts: float = 0.0
+    promote_ms: float = 0.0
 
 
 class SlotEngine:
@@ -808,6 +903,7 @@ class SlotEngine:
         prefix_cache: str = "auto",
         prefix_min_tokens: int = 32,
         prefill_chunk_tokens: int = 256,
+        host_kv_bytes: int = 0,
         speculative: str = "auto",
         draft_preset: str = "",
         draft_layers: int = 0,
@@ -1078,6 +1174,45 @@ class SlotEngine:
         self.prefix_hits = 0
         self.prefix_misses = 0
 
+        # -- KV-page tiering (docs/SERVING.md "KV-page tiering"). A bounded
+        # host-RAM store catches pages the radix tree would otherwise
+        # discard (eviction victims, drained slots' prefixes) and hands
+        # them back by DMA on the next content hit — re-fill at copy
+        # bandwidth instead of recompute FLOPs. All tier state is host
+        # bookkeeping behind the same traced page tables, so tier
+        # membership can never recompile; host_kv_bytes=0 is the
+        # byte-identical rollback (no store, no lane, no spill hook, and
+        # the extract/inject executables are never compiled).
+        if host_kv_bytes < 0:
+            raise ValueError(
+                f"host_kv_bytes must be >= 0, got {host_kv_bytes}")
+        self.host_kv_bytes = int(host_kv_bytes)
+        if self.host_kv_bytes:
+            if not (self.paged and self._quant
+                    and self._prefix is not None):
+                raise ValueError(
+                    "host_kv_bytes > 0 needs the paged int8 layout with "
+                    "the prefix cache on (pages are the tier unit and the "
+                    "radix key is the content identity); set paged=true, "
+                    "kv_quant=auto/on, prefix_cache=auto/on — or "
+                    "host_kv_bytes=0 to disable tiering")
+            self._host_store: Optional[HostPageStore] = HostPageStore(
+                self.host_kv_bytes)
+            self._host_lane: Optional[HostCopyLane] = HostCopyLane()
+            self._prefix.spill = self._spill_page_locked
+        else:
+            self._host_store = None
+            self._host_lane = None
+        #: (content_key, physical_page) demotion descriptors queued under
+        #: the lock; drained + dispatched OUTSIDE it on the pump thread
+        self._pending_demotes: List[Tuple[bytes, int]] = []
+        #: in-flight DtoH materialization jobs awaiting adoption
+        self._demote_jobs: List[LaneJob] = []
+        self.host_kv_hits = 0
+        self.host_kv_misses = 0
+        self.host_kv_demotions = 0
+        self.host_kv_promotions = 0
+
         # -- speculative decoding lane (docs/SERVING.md "Speculative
         # decoding"). auto = on only on real TPU (the CPU draft overhead
         # makes speculation a slowdown there — resolve_speculative); off is
@@ -1124,6 +1259,9 @@ class SlotEngine:
                 _SLOT_PAGES.labels(slot=str(index)).set(0)
         if self._prefix is not None:
             _PREFIX_CACHED_PAGES.set(0)
+        if self._host_store is not None:
+            _HOST_KV_BYTES_CAPACITY.set(self.host_kv_bytes)
+            _HOST_KV_BYTES_USED.set(0)
 
     @property
     def num_devices(self) -> int:
@@ -1261,7 +1399,8 @@ class SlotEngine:
                     retry_after_s=self._retry_after_locked(
                         needed_pages=(self._pool.pages_for(
                             len(prompt) + max_new_tokens)
-                            if self.paged else None)),
+                            if self.paged else None),
+                        prompt=prompt),
                     request_id=request.request_id)
             if request.user_key:
                 self._user_active[request.user_key] = (
@@ -1270,7 +1409,8 @@ class SlotEngine:
             _QUEUE_DEPTH.set(len(self._pending))
         return handle
 
-    def _retry_after_locked(self, needed_pages: Optional[int] = None) -> float:
+    def _retry_after_locked(self, needed_pages: Optional[int] = None,
+                            prompt: Optional[Sequence[int]] = None) -> float:
         """Honest Retry-After (floor 1 s). Contiguous: time for the
         shortest-remaining running sequence to free its slot at the observed
         inter-token p50. Paged with ``needed_pages``: the wait is for PAGES,
@@ -1286,7 +1426,17 @@ class SlotEngine:
         holder completes (it is then free outright, or cache-retained and
         therefore evictable on demand — either way available to admission).
         Summing ``owned_count`` would over-promise: two sharers' departures
-        must not count the same page twice."""
+        must not count the same page twice.
+
+        With ``prompt`` given, the ask's prefix discounts the page bill:
+        device-cached prefix pages are granted SHARED at admission (they
+        cost no fresh page — physically exact), and with the host tier on,
+        host-resident continuation pages count as zero-cost headroom too.
+        The host half is a latency HINT, not a page identity: a promoted
+        page still occupies a fresh physical page, but its fill is a DMA
+        at copy bandwidth instead of recompute, so by the time this many
+        pages free the retry will mostly ride the tiers — and the probes
+        double as LRU touches that keep the retry's prefix warm."""
         per_token = self._intertoken_hist.quantile(0.5) or 0.05
         running = [
             (slot.request.max_new_tokens - len(slot.request.generated), index)
@@ -1294,6 +1444,18 @@ class SlotEngine:
         if not running:
             return 1.0
         if self.paged and needed_pages is not None:
+            if prompt is not None and self._prefix is not None:
+                _, shared = self._prefix.match(prompt)
+                discount = len(shared)
+                if self._host_store is not None:
+                    limit = (self._prefix.cacheable_tokens(len(prompt))
+                             // self.page_size)
+                    index = len(shared)
+                    while index < limit and page_content_key(
+                            prompt, index, self.page_size) in self._host_store:
+                        discount += 1
+                        index += 1
+                needed_pages = max(1, needed_pages - discount)
             available = self._pool.free_pages
             if self._prefix is not None:
                 # cache-only pages are evictable the moment admission asks
@@ -1362,8 +1524,14 @@ class SlotEngine:
     # -- scheduler --------------------------------------------------------
     def has_work(self) -> bool:
         with self._lock:
-            return bool(self._pending) or any(
-                slot is not None for slot in self._slots)
+            # tier backlog counts: queued demotions still need their
+            # extraction dispatched and in-flight DtoH copies need adopting
+            # into the host store — the pump must keep ticking until the
+            # lane drains, or a trailing spill waits for the next request
+            return (bool(self._pending)
+                    or any(slot is not None for slot in self._slots)
+                    or bool(self._pending_demotes)
+                    or bool(self._demote_jobs))
 
     def step(self) -> int:
         """One scheduler iteration: admit joins, advance every in-progress
@@ -1382,14 +1550,20 @@ class SlotEngine:
         path."""
         recorder = self.flight_recorder
         if recorder is None:
+            if self._host_store is not None:
+                self._pump_host_lane()
             self._admit()
             self._advance_prefills()
             return self._decode_step()
         started = self.clock()
         compiles_before = len(_compile_seen)
         faults_before = self._faults_injected()
+        demotions_before = self.host_kv_demotions
+        promotions_before = self.host_kv_promotions
         admitted = chunks = stepped = 0
         try:
+            if self._host_store is not None:
+                self._pump_host_lane()
             admitted = self._admit()
             chunks = self._advance_prefills() or 0
             stepped = self._decode_step()
@@ -1409,6 +1583,8 @@ class SlotEngine:
                 pages_free=pages_free,
                 compiles=len(_compile_seen) - compiles_before,
                 faults=self._faults_injected() - faults_before,
+                host_demotions=self.host_kv_demotions - demotions_before,
+                host_promotions=self.host_kv_promotions - promotions_before,
             )
 
     def _faults_injected(self) -> int:
@@ -1461,6 +1637,25 @@ class SlotEngine:
                 if self._spec is not None:
                     self._spec.chunk_prefill(np.zeros((1, width), np.int32),
                                              0, 0, 0)
+            if self._host_store is not None:
+                # tier executables: an all-trash-ids extract (reads trash-
+                # page garbage, discarded) and an all-OOB inject with a
+                # zero payload (every write drops) — both fixed-width, so
+                # steady-state demotions/promotions never pay a compile
+                width = self._pool.max_pages_per_slot
+                extracted = self._dispatch_page_extract(
+                    np.full(width, TRASH_PAGE, np.int32))
+                np.asarray(extracted[0])    # force the compile
+                config = self.config
+                payload_shape = (config.n_layers, width, self.page_size,
+                                 config.kv_heads, config.d_head)
+                scale_shape = (config.n_layers, width, config.kv_heads)
+                self._dispatch_page_inject(
+                    np.full(width, self._pool.physical_pages, np.int32),
+                    self._operand(np.zeros(payload_shape, np.int8)),
+                    self._operand(np.zeros(scale_shape, np.float32)),
+                    self._operand(np.zeros(payload_shape, np.int8)),
+                    self._operand(np.zeros(scale_shape, np.float32)))
         else:
             buckets = {_prefill_bucket(max(1, length - 1), self.max_len - 1)
                        for length in prompt_lens} or {
@@ -1552,6 +1747,244 @@ class SlotEngine:
             self._operand(np.int32(start)),
             self._operand(np.int32(real_len)), self.config)
         return compile_event
+
+    # -- KV-page tiering internals (docs/SERVING.md "KV-page tiering") -----
+    #
+    # Thread discipline, because it is what makes the tier lock-free on the
+    # store: the HostPageStore is read/written ONLY on the pump thread; the
+    # copy lane's thread runs nothing but the raw transfers (np.asarray =
+    # DtoH, _operand = HtoD) and publishes through LaneJob.done. Device
+    # dispatches (extract/inject) happen on the pump thread OUTSIDE the
+    # engine lock, like every other dispatch — ordering against the
+    # prefill/step executables comes from dispatch order on the one donated
+    # cache buffer, never from blocking.
+
+    def _page_copy_count_compile(self, base: str) -> str:
+        fn = self._fingerprint_fn(base)
+        return _count_compile(fn,
+                              (fn, self.config,
+                               self._pool.num_pages, self.page_size,
+                               self._pool.max_pages_per_slot)
+                              + self._mesh_fingerprint())
+
+    def _dispatch_page_extract(self, page_ids: np.ndarray):
+        """Gather whole pages + scales for demotion (fixed [W] width,
+        TRASH_PAGE-padded; ``serving_page_extract`` fingerprint). A pure
+        read of the cache — must be dispatched BEFORE any executable that
+        overwrites the extracted pages (see _page_extract_body)."""
+        self._fault_point("extract")
+        self._page_copy_count_compile("serving_page_extract")
+        return _serving_page_extract(self._cache, self._operand(page_ids))
+
+    def _dispatch_page_inject(self, page_ids: np.ndarray, k, k_scale,
+                              v, v_scale) -> None:
+        """Scatter staged host pages into fresh device pages (promotion;
+        ``serving_page_inject`` fingerprint, OOB-padded ids drop). Donates
+        and reassigns the cache, joining the normal dispatch chain."""
+        self._fault_point("inject")
+        self._page_copy_count_compile("serving_page_inject")
+        self._cache = _serving_page_inject(
+            self._cache, self._operand(page_ids), k, k_scale, v, v_scale)
+
+    def _spill_page_locked(self, key: bytes, page: int) -> None:
+        """PrefixCache.evict victim hook (runs under the engine lock,
+        BEFORE the victim's reference drops): queue a demotion descriptor.
+        The payload is extracted by _dispatch_demotions on the pump thread
+        right after the lock releases — before any prefill can be
+        dispatched at the recycled page."""
+        if key not in self._host_store:
+            self._pending_demotes.append((key, page))
+
+    def _queue_slot_demotions_locked(self, index: int, state: _Slot) -> None:
+        """Queue demotions for a draining slot's sole-held prefix pages:
+        fully-dispatched cacheable pages with refcount 1 (the tree never
+        adopted them, or already let go — either way release() is about to
+        net-free them and their K/V would be lost). Shared pages are the
+        tree's to spill when IT evicts them."""
+        prompt = state.request.prompt
+        covered = min(state.prefill_next,
+                      self._prefix.cacheable_tokens(len(prompt)))
+        row = self._pool.owned_pages(index)
+        for page_index in range(covered // self.page_size):
+            page = row[page_index]
+            if self._pool.refcount(page) != 1:
+                continue
+            key = page_content_key(prompt, page_index, self.page_size)
+            if key in self._host_store:
+                continue
+            self._pending_demotes.append((key, page))
+
+    def _probe_host_locked(self, prompt: Sequence[int],
+                           start_pages: int) -> List[HostPageEntry]:
+        """Walk successive content keys past the device match; returns the
+        resident continuation run (LRU-touched). Applies the same
+        prefix_min_tokens worthiness gate as match(): a promotion whose
+        total covered span is below the gate is not worth its DMA."""
+        limit = self._prefix.cacheable_tokens(len(prompt)) // self.page_size
+        entries: List[HostPageEntry] = []
+        index = start_pages
+        while index < limit:
+            entry = self._host_store.get(
+                page_content_key(prompt, index, self.page_size))
+            if entry is None:
+                break
+            entries.append(entry)
+            index += 1
+        if entries and index * self.page_size < self.prefix_min_tokens:
+            entries = []
+        if entries:
+            self.host_kv_hits += 1
+            _HOST_KV_HITS.inc()
+        else:
+            self.host_kv_misses += 1
+            _HOST_KV_MISSES.inc()
+        return entries
+
+    def _pump_host_lane(self) -> None:
+        """Tick the tier's async machinery — FIRST thing in step(), before
+        admission, so completed copies are adopted and queued extractions
+        are dispatched ahead of anything that could overwrite their pages.
+        Everything here is poll-and-dispatch; a copy still in flight is
+        simply picked up on a later tick (the never-blocks-the-pump
+        contract, pinned by the fake-clock test)."""
+        self._dispatch_demotions()
+        self._adopt_demotions()
+        self._adopt_promotions()
+
+    def _dispatch_demotions(self) -> None:
+        """Drain queued demotion descriptors and dispatch their page
+        extractions (pump thread, outside the lock), then hand the device
+        results to the lane for DtoH materialization."""
+        with self._lock:
+            pending, self._pending_demotes = self._pending_demotes, []
+        if not pending:
+            return
+        width = self._pool.max_pages_per_slot
+        for start in range(0, len(pending), width):
+            group = pending[start:start + width]
+            page_ids = np.full(width, TRASH_PAGE, np.int32)
+            for offset, (_, page) in enumerate(group):
+                page_ids[offset] = page
+            extracted = self._dispatch_page_extract(page_ids)
+            keys = [key for key, _ in group]
+            self._demote_jobs.append(self._host_lane.submit(
+                functools.partial(self._materialize_demotion, keys,
+                                  extracted)))
+
+    @staticmethod
+    def _materialize_demotion(keys: List[bytes], extracted):
+        """(copy lane thread) Pull the extracted pages to host RAM —
+        np.asarray blocks on the device result, which is exactly the work
+        the lane exists to keep off the pump."""
+        k, k_scale, v, v_scale = (np.asarray(array) for array in extracted)
+        return keys, k, k_scale, v, v_scale
+
+    def _adopt_demotions(self) -> None:
+        """Adopt completed DtoH jobs into the host store (pump thread —
+        the store's single-writer discipline)."""
+        still_running: List[LaneJob] = []
+        for job in self._demote_jobs:
+            if not job.done:
+                still_running.append(job)
+                continue
+            if job.error is not None:
+                log.warning("host-kv demotion dropped: %s", job.error)
+                continue
+            keys, k, k_scale, v, v_scale = job.result
+            adopted = 0
+            for offset, key in enumerate(keys):
+                # per-page copies: a view into the [L, W, ...] batch would
+                # pin the whole transfer buffer and lie to byte accounting
+                if self._host_store.put(key,
+                                        k[:, offset].copy(),
+                                        v[:, offset].copy(),
+                                        k_scale[:, offset].copy(),
+                                        v_scale[:, offset].copy()):
+                    adopted += 1
+            if adopted:
+                with self._lock:
+                    self.host_kv_demotions += adopted
+                _HOST_KV_DEMOTIONS.inc(adopted)
+            _HOST_KV_BYTES_USED.set(self._host_store.bytes_used)
+        self._demote_jobs = still_running
+
+    def _stage_promotion(self, entries: List[HostPageEntry]):
+        """(copy lane thread) Assemble the promotion run into the fixed
+        [W]-wide payload and ship it to the device — the HtoD half of the
+        tier. Unused lanes stay zero; their inject ids are OOB and drop."""
+        config = self.config
+        width = self._pool.max_pages_per_slot
+        k = np.zeros((config.n_layers, width, self.page_size,
+                      config.kv_heads, config.d_head), np.int8)
+        v = np.zeros_like(k)
+        k_scale = np.zeros((config.n_layers, width, config.kv_heads),
+                           np.float32)
+        v_scale = np.zeros_like(k_scale)
+        for offset, entry in enumerate(entries):
+            k[:, offset] = entry.k
+            v[:, offset] = entry.v
+            k_scale[:, offset] = entry.k_scale
+            v_scale[:, offset] = entry.v_scale
+        return (self._operand(k), self._operand(k_scale),
+                self._operand(v), self._operand(v_scale))
+
+    def _adopt_promotions(self) -> None:
+        """Poll parked slots' staging jobs; for each completed one,
+        dispatch the inject and resume the slot's prefill past
+        promote_boundary. Slot frees happen only on this pump thread, so
+        the identity check under the lock stays valid through the
+        dispatch that follows it."""
+        with self._lock:
+            parked = [(index, state)
+                      for index, state in enumerate(self._slots)
+                      if state is not None and state.promote_job is not None]
+        for index, state in parked:
+            job = state.promote_job
+            if not job.done:
+                continue
+            if job.error is not None:
+                log.warning("host-kv promotion failed (slot %d): %s — "
+                            "falling back to recompute", index, job.error)
+                with self._lock:
+                    if self._slots[index] is state:
+                        state.promote_job = None
+                        state.promote_entries = []
+                        state.promote_pages = []
+                        state.promote_boundary = 0
+                        state.host_hit_pages = 0
+                continue
+            with self._lock:
+                if self._slots[index] is not state:
+                    continue        # cancelled + freed while the DMA ran
+            width = self._pool.max_pages_per_slot
+            page_ids = np.full(width, self._pool.physical_pages, np.int32)
+            page_ids[:len(state.promote_pages)] = state.promote_pages
+            k, k_scale, v, v_scale = job.result
+            self._dispatch_page_inject(page_ids, k, k_scale, v, v_scale)
+            promoted = len(state.promote_pages)
+            now = self.clock()
+            finish = False
+            with self._lock:
+                if self._slots[index] is not state:
+                    continue
+                state.promote_job = None
+                state.promote_entries = []
+                state.promote_ms = (now - state.promote_started_ts) * 1e3
+                state.prefill_next = max(state.prefill_next,
+                                         min(state.promote_boundary,
+                                             state.prefill_target))
+                self.host_kv_promotions += promoted
+                # injected pages are fully-dispatched content — adopt them
+                # into the radix tree so the NEXT identical prompt hits on
+                # device without touching the store at all
+                self._prefix.insert(state.request.prompt,
+                                    self._pool.page_table[index],
+                                    state.promote_boundary)
+                _PREFIX_CACHED_PAGES.set(self._prefix.cached_pages)
+                finish = state.prefill_next >= state.prefill_target
+            _HOST_KV_PROMOTIONS.inc(promoted)
+            if finish:
+                self._finish_prefill(index, state)
 
     def _dispatch_prefill(self, head, slot: int, real_len: int) -> str:
         """Run the joining sequence's trunk pass through whichever cache
@@ -1678,6 +2111,7 @@ class SlotEngine:
                         # anything that can NEVER fit)
                         _QUEUE_DEPTH.set(len(self._pending))
                         return joined
+                    host_entries: List[HostPageEntry] = []
                     if self._prefix is not None:
                         if cached_tokens > 0:
                             self.prefix_hits += 1
@@ -1685,6 +2119,14 @@ class SlotEngine:
                         else:
                             self.prefix_misses += 1
                             _PREFIX_MISSES.inc()
+                        if self._host_store is not None:
+                            # the host tier can only EXTEND the device
+                            # match: probe the store for successive
+                            # content keys past the shared run — hits are
+                            # promoted into this slot's first fresh pages
+                            # by DMA instead of recomputed
+                            host_entries = self._probe_host_locked(
+                                request.prompt, len(shared))
                     _KV_PAGES_FREE.set(self._pool.free_pages)
                     _KV_BYTES_USED.set(self._pool.used_pages
                                        * self._page_hbm_bytes)
@@ -1694,6 +2136,19 @@ class SlotEngine:
                 self._slots[free] = _Slot(request=request,
                                           joined_ts=joined_ts,
                                           cached_tokens=cached_tokens)
+                if self.paged and host_entries:
+                    # the promoted run lands in the fresh pages right
+                    # after the shared run (logical order); allocation is
+                    # unchanged — promotion replaces the FILL (recompute
+                    # -> DMA), not the pages
+                    state = self._slots[free]
+                    row = self._pool.owned_pages(free)
+                    state.promote_entries = host_entries
+                    state.promote_pages = row[
+                        len(shared):len(shared) + len(host_entries)]
+                    state.promote_boundary = (
+                        (len(shared) + len(host_entries)) * self.page_size)
+                    state.host_hit_pages = len(host_entries)
                 # last legal write position for the speculative window
                 # (free slots sit at -1 so their speculative writes drop)
                 self._pos_limits[free] = (len(request.prompt)
@@ -1719,6 +2174,13 @@ class SlotEngine:
                     request_id=request.request_id, slot=free)
                 _QUEUE_DEPTH.set(len(self._pending))
                 _SLOTS_BUSY.set(self._busy_locked())
+            if self._host_store is not None:
+                # evict() above may have queued spill descriptors for the
+                # pages it reclaimed; dispatch their extractions NOW —
+                # before this join's prefill chunks (or a later join in
+                # this same loop) can be dispatched against the recycled
+                # pages, the extract must already be in the dispatch chain
+                self._dispatch_demotions()
             self._join(free, request)
             joined += 1
 
@@ -1760,6 +2222,17 @@ class SlotEngine:
                                      state.prefill_target)
             state.prefill_done = False
             state.prefill_started_ts = self.clock()
+            if state.promote_entries:
+                # host-tier hit: PARK the slot (exactly like mid-chunk-
+                # prefill) and stage the HtoD copy on the async lane — the
+                # pump thread never waits on the DMA; _pump_host_lane
+                # adopts the staged payload at a later tick, dispatches
+                # the inject, and resumes prefill past promote_boundary
+                state.promote_started_ts = self.clock()
+                state.promote_job = self._host_lane.submit(
+                    functools.partial(self._stage_promotion,
+                                      list(state.promote_entries)))
+                return
             if state.prefill_next >= state.prefill_target:
                 self._finish_prefill(slot, state)
             return
@@ -1851,6 +2324,11 @@ class SlotEngine:
                         self._finish_locked(state.request,
                                             outcome="timeout")
                 continue
+            if state.promote_job is not None:
+                # parked mid-promote: the copy lane owns the resume
+                # (_pump_host_lane) — but cancel/deadline above still
+                # fired, so a hung DMA can never wedge the slot
+                continue
             self._advance_prefill_slot(index, state)
             chunks += 1
         return chunks
@@ -1907,6 +2385,12 @@ class SlotEngine:
             record.prefill_ms = state.prefill_ms
             record.prefill_compile = state.prefill_compile
             record.prefill_chunks = state.prefill_chunks
+            if self._host_store is not None:
+                # the DMA share of TTFT, split out of prefill_ms so "slow
+                # join" triages to copy bandwidth vs recompute honestly
+                record.host_hit_pages = state.host_hit_pages
+                if state.host_hit_pages:
+                    record.promote_ms = round(state.promote_ms, 3)
         _PREFILL_CHUNKS.observe(state.prefill_chunks)
         if state.prefill_chunks > 0:
             get_tracer().record_span(
@@ -2152,6 +2636,14 @@ class SlotEngine:
             self._finish_locked(request, outcome="timeout")
 
     def _free_slot_locked(self, index: int) -> None:
+        state = self._slots[index]
+        if self._host_store is not None and state is not None:
+            # a draining slot's prefix pages that NOBODY else holds (not
+            # the tree, not a sharer) are about to be net-freed — spill
+            # them to the host tier first, so the next identical prompt
+            # promotes by DMA instead of recomputing (docs/SERVING.md
+            # "KV-page tiering")
+            self._queue_slot_demotions_locked(index, state)
         self._slots[index] = None
         self._active[index] = False
         self._spec_windows[index] = []
@@ -2308,6 +2800,18 @@ class SlotEngine:
                                 if self._prefix is not None else None),
                 "prefillChunkTokens": (self.prefill_chunk_tokens
                                        if self._use_chunk_prefill else None),
+                "hostKvBytes": (self.host_kv_bytes
+                                if self._host_store is not None else None),
+                "hostPagesResident": (
+                    self._host_store.resident_pages
+                    if self._host_store is not None else None),
+                "hostBytesUsed": (self._host_store.bytes_used
+                                  if self._host_store is not None else None),
+                "hostHitRate": (
+                    round(self.host_kv_hits
+                          / (self.host_kv_hits + self.host_kv_misses), 4)
+                    if self._host_store is not None
+                    and self.host_kv_hits + self.host_kv_misses else None),
                 "speculative": self.speculative,
                 "specTokens": (self.spec_tokens if self._spec is not None
                                else None),

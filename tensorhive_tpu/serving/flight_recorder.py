@@ -51,6 +51,8 @@ FIELDS = (
     "pages_free",
     "compiles",
     "faults",
+    "host_demotions",
+    "host_promotions",
 )
 
 _CAMEL = {
@@ -60,6 +62,8 @@ _CAMEL = {
     "slots_busy": "slotsBusy",
     "queue_depth": "queueDepth",
     "pages_free": "pagesFree",
+    "host_demotions": "hostDemotions",
+    "host_promotions": "hostPromotions",
 }
 
 _DUMP_NAME_RE = re.compile(r"^crash-\d{8}T\d{6}-\d+(-\d{3})?\.json$")
@@ -89,7 +93,9 @@ class FlightRecorder:
                prefill_chunks: int = 0, decode_slots: int = 0,
                slots_busy: int = 0, queue_depth: int = 0,
                pages_free: int = 0, compiles: int = 0,
-               faults: int = 0, ts: Optional[float] = None) -> None:
+               faults: int = 0, host_demotions: int = 0,
+               host_promotions: int = 0,
+               ts: Optional[float] = None) -> None:
         """Stamp one tick. Hot path: column writes + one index bump."""
         slot = self._idx % self.capacity
         self._ts[slot] = time.time() if ts is None else ts
@@ -103,6 +109,8 @@ class FlightRecorder:
         col[5, slot] = pages_free
         col[6, slot] = compiles
         col[7, slot] = faults
+        col[8, slot] = host_demotions
+        col[9, slot] = host_promotions
         self._idx += 1
 
     @property

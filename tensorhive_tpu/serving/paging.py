@@ -51,10 +51,28 @@ offset-0 rebase rule makes a recycled page behave byte-identically to a
 fresh one anyway. Byte-level accounting (the ``tpuhive_generate_kv_bytes_
 capacity`` / ``_used`` gauges) lives with the engine, which knows the cell
 width; this module keeps counting pages.
+
+KV-page TIERING (docs/SERVING.md "KV-page tiering") adds a third place a
+page's *payload* can live: :class:`HostPageStore` is a bounded host-RAM
+ring of demoted int8 pages plus their per-(page, kv_head) scales, keyed by
+the radix tree's token-tuple content key. A page the pool is about to
+recycle (an LRU-evicted cache-only radix leaf, or a drained slot's last
+reference) spills its bytes host-side instead of being dropped; the next
+radix hit promotes them back through the engine's async copy lane
+(:class:`HostCopyLane`) — "recompute the prefill" becomes "DMA the pages
+back". The pool itself never changes: a demoted page's PHYSICAL page was
+freed normally, and promotion allocates a fresh physical page like any
+miss — tier membership is host bookkeeping, so the pool invariant extends
+to ``free + live == num_pages`` with ``store.resident_pages`` counted on
+both sides (pinned by the tiering churn test). Store reads/writes happen
+only on the engine's pump thread (under its lock where bookkeeping
+requires), the same single-writer discipline as the allocator.
 """
 from __future__ import annotations
 
-from typing import Dict, List, Sequence
+import queue as queue_module
+import threading
+from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
@@ -271,3 +289,157 @@ class PagePool:
             self._free.append(page)
             return True
         return False
+
+
+# -- host tier (docs/SERVING.md "KV-page tiering") ----------------------------
+
+def page_content_key(prompt: Sequence[int], page_index: int,
+                     page_size: int) -> bytes:
+    """Content key of logical page ``page_index`` of ``prompt``: the WHOLE
+    token prefix through the page's last position, serialized. K/V at a
+    position depends on every earlier token (the PR 11 sharing argument),
+    so the page's identity is the full prefix, not just its own
+    ``page_size``-token run — two prompts sharing a page's tokens but
+    diverging earlier must key differently."""
+    end = (page_index + 1) * page_size
+    return np.asarray(prompt[:end], np.int32).tobytes()
+
+
+class HostPageEntry:
+    """One demoted page: int8 K/V payload ``[layers, page_size, kv_heads,
+    d_head]`` plus the per-(page, kv_head) f32 scale rows ``[layers,
+    kv_heads]`` that travelled with it (ops/kv_quant.py). Immutable once
+    stored — a promotion reads it, never edits it — so the store can hand
+    the same entry to concurrent promote jobs without copying."""
+
+    __slots__ = ("k", "v", "k_scale", "v_scale", "nbytes", "last_used")
+
+    def __init__(self, k: np.ndarray, v: np.ndarray, k_scale: np.ndarray,
+                 v_scale: np.ndarray, last_used: int) -> None:
+        self.k = k
+        self.v = v
+        self.k_scale = k_scale
+        self.v_scale = v_scale
+        self.nbytes = int(k.nbytes + v.nbytes + k_scale.nbytes
+                          + v_scale.nbytes)
+        self.last_used = last_used
+
+
+class HostPageStore:
+    """Bounded host-RAM ring of demoted int8 pages, LRU inside a byte
+    budget (``[generation_service] host_kv_bytes``).
+
+    Keys are radix content keys (:func:`page_content_key`). ``put`` admits
+    an entry and LRU-evicts past the budget; ``get`` returns the entry and
+    touches its LRU stamp. An entry larger than the whole budget is
+    refused outright (a zero-budget store therefore stores nothing — the
+    rollback configuration never constructs one anyway). NOT internally
+    locked: the engine mutates it only from its pump thread, exactly like
+    :class:`PagePool`."""
+
+    def __init__(self, capacity_bytes: int) -> None:
+        if capacity_bytes < 1:
+            raise ValueError(
+                f"capacity_bytes must be >= 1, got {capacity_bytes}")
+        self.capacity_bytes = int(capacity_bytes)
+        self._entries: Dict[bytes, HostPageEntry] = {}
+        self._tick = 0
+        self.bytes_used = 0
+        #: lifetime pages the budget pushed back out — the host_kv_thrash
+        #: signal's raw material (demoting faster than the budget holds)
+        self.evictions = 0
+
+    @property
+    def resident_pages(self) -> int:
+        return len(self._entries)
+
+    def __contains__(self, key: bytes) -> bool:
+        return key in self._entries
+
+    def put(self, key: bytes, k: np.ndarray, v: np.ndarray,
+            k_scale: np.ndarray, v_scale: np.ndarray) -> bool:
+        """Adopt one demoted page; returns False when it can never fit.
+        Re-demoting a resident key refreshes its bytes and LRU stamp (the
+        payload is identical by construction — content-keyed)."""
+        self._tick += 1
+        entry = HostPageEntry(k, v, k_scale, v_scale, self._tick)
+        if entry.nbytes > self.capacity_bytes:
+            return False
+        old = self._entries.pop(key, None)
+        if old is not None:
+            self.bytes_used -= old.nbytes
+        self._entries[key] = entry
+        self.bytes_used += entry.nbytes
+        while self.bytes_used > self.capacity_bytes:
+            victim = min(self._entries,
+                         key=lambda k_: self._entries[k_].last_used)
+            self.bytes_used -= self._entries.pop(victim).nbytes
+            self.evictions += 1
+        return True
+
+    def get(self, key: bytes) -> Optional[HostPageEntry]:
+        entry = self._entries.get(key)
+        if entry is not None:
+            self._tick += 1
+            entry.last_used = self._tick
+        return entry
+
+    def clear(self) -> int:
+        dropped = len(self._entries)
+        self._entries.clear()
+        self.bytes_used = 0
+        return dropped
+
+
+class LaneJob:
+    """One unit of copy-lane work. ``done`` flips True (a plain attribute
+    write — atomic under the GIL) only AFTER ``result``/``error`` are set,
+    so a pump-thread poll that observes ``done`` always sees the full
+    outcome."""
+
+    __slots__ = ("fn", "result", "error", "done")
+
+    def __init__(self, fn: Callable[[], object]) -> None:
+        self.fn = fn
+        self.result: object = None
+        self.error: Optional[BaseException] = None
+        self.done = False
+
+    def run(self) -> None:
+        try:
+            self.result = self.fn()
+        except BaseException as exc:        # noqa: BLE001 - reported via poll
+            self.error = exc
+        self.done = True
+
+
+class HostCopyLane:
+    """The async promote/demote copy lane: a single background worker that
+    runs staged host<->device copies OFF the pump thread, so a promotion's
+    ``device_put`` (or a demotion's device->host materialization) overlaps
+    the running decode step instead of blocking it.
+
+    The pump thread ``submit``s a closure and polls ``job.done`` at each
+    tick — never joins, never waits (the fake-clock tiering test pins that
+    a job which NEVER completes still costs the running batch nothing).
+    The worker thread is started lazily on first submit and is a daemon:
+    an engine teardown abandons at most one idle queue reader. Tests
+    substitute a synchronous or manually-released lane through the same
+    two-method surface."""
+
+    def __init__(self) -> None:
+        self._jobs: "queue_module.Queue[LaneJob]" = queue_module.Queue()
+        self._worker: Optional[threading.Thread] = None
+
+    def submit(self, fn: Callable[[], object]) -> LaneJob:
+        job = LaneJob(fn)
+        if self._worker is None:
+            self._worker = threading.Thread(
+                target=self._run, name="host-kv-copy-lane", daemon=True)
+            self._worker.start()
+        self._jobs.put(job)
+        return job
+
+    def _run(self) -> None:
+        while True:
+            self._jobs.get().run()

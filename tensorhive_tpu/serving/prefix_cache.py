@@ -55,9 +55,9 @@ its own lock (match/insert/evict mutate LRU stamps and refcounts).
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
-from .paging import PagePool
+from .paging import PagePool, page_content_key
 
 
 class _Node:
@@ -96,6 +96,14 @@ class PrefixCache:
         self._nodes = 0
         self._tick = 0          # monotonic LRU stamp (no wall clock needed)
         self.evictions = 0      # lifetime pages evicted (the thrash signal)
+        #: demote-on-evict hook (docs/SERVING.md "KV-page tiering"): called
+        #: as ``spill(content_key, page)`` for every eviction victim BEFORE
+        #: its reference drops — the page's payload is still intact in HBM
+        #: at that moment, so the engine can queue a host-tier extraction
+        #: of exactly the bytes the tree is letting go. None = no tiering
+        #: (the host_kv_bytes=0 rollback: evict behaves byte-identically
+        #: to PR 11).
+        self.spill: Optional[Callable[[bytes, int], None]] = None
 
     # -- introspection -----------------------------------------------------
     @property
@@ -206,11 +214,27 @@ class PrefixCache:
                     victim = node
             if victim is None:
                 break
+            if self.spill is not None:
+                self.spill(self._content_key(victim), victim.page)
             self._detach(victim)
             if self.pool.cache_unref(victim.page):
                 freed += 1
             self.evictions += 1
         return freed
+
+    def _content_key(self, node: _Node) -> bytes:
+        """The victim's radix content key: the FULL token prefix through
+        its page's last position (walk to the root — a page's K/V depends
+        on every earlier token, so identity is the whole path, not the
+        edge label)."""
+        parts: List[Tuple[int, ...]] = []
+        cursor: Optional[_Node] = node
+        while cursor is not None:
+            parts.append(cursor.tokens)
+            cursor = cursor.parent
+        prefix: List[int] = [token for part in reversed(parts)
+                             for token in part]
+        return page_content_key(prefix, len(parts) - 1, self.page_size)
 
     def _detach(self, node: _Node) -> None:
         siblings = (node.parent.children if node.parent is not None

@@ -219,6 +219,16 @@ def test_serving_strip_renders_prefix_cache_badge():
     assert "stats.cachedPages" in source
 
 
+def test_serving_strip_renders_host_tier_badge():
+    """The host-tier badge (docs/SERVING.md "KV-page tiering") must render
+    from the exact ``hostPagesResident``/``hostHitRate`` fields
+    ``GET /generate/stats`` exports, and hide on the ``host_kv_bytes=0``
+    rollback (which serves null tier stats)."""
+    source = (STATIC_DIR / "js" / "nodes.js").read_text()
+    assert 'stats.hostPagesResident == null ? ""' in source  # rollback hides
+    assert "stats.hostHitRate" in source
+
+
 def test_serving_strip_renders_spec_badge():
     """The speculative-lane badge (docs/SERVING.md "Speculative decoding")
     must render from the exact ``speculative``/``specTokens``/

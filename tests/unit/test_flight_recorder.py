@@ -72,7 +72,8 @@ def test_snapshot_limit_and_field_names():
     recorder = FlightRecorder(capacity=8)
     recorder.record(duration_s=0.5, admitted=1, prefill_chunks=2,
                     decode_slots=3, slots_busy=4, queue_depth=5,
-                    pages_free=6, compiles=7, faults=8, ts=1.0)
+                    pages_free=6, compiles=7, faults=8,
+                    host_demotions=9, host_promotions=10, ts=1.0)
     recorder.record(duration_s=0.25, ts=2.0)
     rows = recorder.snapshot(last_n=1)
     assert len(rows) == 1 and rows[0]["tick"] == 1
@@ -80,7 +81,7 @@ def test_snapshot_limit_and_field_names():
     assert full == {"tick": 0, "ts": 1.0, "durationS": 0.5, "admitted": 1,
                     "prefillChunks": 2, "decodeSlots": 3, "slotsBusy": 4,
                     "queueDepth": 5, "pagesFree": 6, "compiles": 7,
-                    "faults": 8}
+                    "faults": 8, "hostDemotions": 9, "hostPromotions": 10}
 
 
 def test_ring_clear_and_capacity_validation():

@@ -1,0 +1,482 @@
+"""KV-page tiering tests: cold int8 pages spill to host RAM and promote
+back by async DMA — and none of it may be VISIBLE in tokens or recompiles.
+
+Three halves, like test_prefix_cache.py:
+
+* **Host bookkeeping** (no device): ``page_content_key`` windows,
+  ``HostPageStore`` LRU/budget/refresh semantics, and a seeded 400-step
+  churn over PagePool + PrefixCache + HostPageStore with the spill hook
+  wired — device pages are conserved (``free + live == pool``) and every
+  page ever spilled is accounted for (resident in the store or pushed out
+  by its budget) after every step.
+* **Engine exactness**: miss ≡ HBM-hit ≡ host-hit token identity (the
+  tier replaces the FILL, never the math), the zero-recompile contract
+  across demote/promote churn, ledger + stats + metrics + alert wiring,
+  and the host-aware Retry-After discount.
+* **The never-blocks contract**: a stub copy lane that completes only
+  when the test says so proves a slow promotion parks its own slot while
+  decode keeps emitting every tick — then resumes token-identically.
+"""
+import dataclasses
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tensorhive_tpu.models import decode
+from tensorhive_tpu.models.decode import _compile_seen
+from tensorhive_tpu.models.transformer import PRESETS, TransformerLM
+from tensorhive_tpu.serving.engine import SlotEngine
+from tensorhive_tpu.serving.paging import (
+    HostPageStore,
+    LaneJob,
+    PagePool,
+    page_content_key,
+)
+from tensorhive_tpu.serving.prefix_cache import PrefixCache
+
+pytestmark = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device CPU platform"
+)
+
+F32_TINY = dataclasses.replace(PRESETS["tiny"], dtype=jnp.float32,
+                               use_flash=False, remat=False, max_seq_len=128)
+
+#: 24 tokens, page_size 4 -> cacheable 20 tokens = 5 pages; long enough
+#: past prefix_min_tokens=4 that both tiers engage
+PROMPT_A = list(range(3, 27))
+PROMPT_B = list(range(40, 64))
+PROMPT_C = list(range(70, 94))
+
+
+class FakeClock:
+    def __init__(self, start: float = 1000.0) -> None:
+        self.now = start
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, seconds: float) -> None:
+        self.now += seconds
+
+
+class StubLane:
+    """A copy lane whose jobs complete only when the test runs them —
+    the deterministic stand-in for a slow DMA."""
+
+    def __init__(self) -> None:
+        self.jobs = []
+
+    def submit(self, fn):
+        job = LaneJob(fn)
+        self.jobs.append(job)
+        return job
+
+
+@pytest.fixture(scope="module")
+def params():
+    return TransformerLM.init(jax.random.PRNGKey(0), F32_TINY)
+
+
+def make_engine(params, **kwargs):
+    kwargs.setdefault("slots", 2)
+    kwargs.setdefault("max_len", 64)
+    kwargs.setdefault("queue_depth", 8)
+    kwargs.setdefault("page_size", 4)
+    kwargs.setdefault("prefix_cache", "on")
+    kwargs.setdefault("prefix_min_tokens", 4)
+    return SlotEngine(params, F32_TINY, **kwargs)
+
+
+def make_tiered(params, **kwargs):
+    kwargs.setdefault("host_kv_bytes", 1 << 20)
+    # 12 pages: one 24+6-token request needs 8, so admitting a second
+    # prompt after a completion MUST evict the first's cached pages —
+    # the demotion trigger every test here relies on
+    kwargs.setdefault("kv_pages", 12)
+    return make_engine(params, **kwargs)
+
+
+def drain(engine):
+    while engine.has_work():
+        engine.step()
+
+
+def run_one(engine, prompt, new_tokens=6):
+    handle = engine.submit(prompt, max_new_tokens=new_tokens)
+    drain(engine)
+    return handle
+
+
+def reference_tokens(params, prompt, new_tokens):
+    out = decode.generate(params, F32_TINY,
+                          jnp.asarray([prompt], jnp.int32),
+                          max_new_tokens=new_tokens, temperature=0.0)
+    return np.asarray(out)[0, len(prompt):].tolist()
+
+
+def churn_out_prompt_a(engine):
+    """Fill the tight pool with B and C so A's cached pages are evicted
+    (and therefore spilled to the host store)."""
+    for prompt in (PROMPT_B, PROMPT_C):
+        assert run_one(engine, prompt).result(
+            timeout_s=30)["outcome"] == "completed"
+
+
+# -- host-side bookkeeping ---------------------------------------------------
+
+def test_page_content_key_windows():
+    prompt = list(range(10, 30))
+    # the key covers the prompt THROUGH the page's last position — page 1
+    # of page_size 4 is positions 0..7
+    assert (page_content_key(prompt, 1, 4)
+            == np.asarray(prompt[:8], np.int32).tobytes())
+    # same page content under a longer prompt keys identically (the radix
+    # property the store inherits: a key is the prefix, not the request)
+    assert (page_content_key(prompt + [99], 1, 4)
+            == page_content_key(prompt, 1, 4))
+    # divergence INSIDE the window changes the key
+    altered = prompt[:6] + [77] + prompt[7:]
+    assert (page_content_key(altered, 1, 4)
+            != page_content_key(prompt, 1, 4))
+
+
+def _fake_page(fill, nbytes=512):
+    """A payload whose four arrays total exactly ``nbytes``."""
+    k = np.full((nbytes // 2,), fill, np.int8)
+    return k, k.copy(), np.zeros(0, np.float32), np.zeros(0, np.float32)
+
+
+def test_host_store_lru_budget_and_refresh():
+    store = HostPageStore(capacity_bytes=1024)      # holds 2 x 512B pages
+    k, v, ks, vs = _fake_page(1)
+    assert store.put(b"a", k, v, ks, vs)
+    assert store.put(b"b", k, v, ks, vs)
+    assert store.resident_pages == 2 and store.bytes_used == 1024
+    # touch "a" so "b" is the LRU victim when "c" arrives
+    assert store.get(b"a") is not None
+    assert store.put(b"c", k, v, ks, vs)
+    assert b"b" not in store and b"a" in store and b"c" in store
+    assert store.evictions == 1 and store.bytes_used == 1024
+    # re-demoting a resident key refreshes, never double-counts bytes
+    assert store.put(b"a", k, v, ks, vs)
+    assert store.resident_pages == 2 and store.bytes_used == 1024
+    assert store.clear() == 2
+    assert store.bytes_used == 0 and store.resident_pages == 0
+
+
+def test_host_store_refuses_oversized_and_bad_budget():
+    with pytest.raises(ValueError):
+        HostPageStore(capacity_bytes=0)
+    store = HostPageStore(capacity_bytes=128)
+    k, v, ks, vs = _fake_page(1, nbytes=512)        # 512B > 128B budget
+    assert store.put(b"too-big", k, v, ks, vs) is False
+    assert store.resident_pages == 0 and store.bytes_used == 0
+
+
+def test_seeded_churn_conserves_pages_and_spills():
+    """The satellite property test: 400 steps of joins/leaves/evictions
+    with the spill hook wired to a HostPageStore. After EVERY step the
+    device pool is conserved (free + live == pool size), the store never
+    exceeds its byte budget, and every page ever spilled is accounted
+    for: resident in the store or pushed out by its LRU."""
+    rng = random.Random(1234)
+    page_size = 4
+    pool = PagePool(num_pages=24, page_size=page_size, slots=6,
+                    max_pages_per_slot=6)
+    cache = PrefixCache(pool, min_tokens=0)
+    payload = _fake_page(7)
+    store = HostPageStore(capacity_bytes=8 * 512)   # 8 fake pages deep
+    adopted = [0]
+
+    def spill(key, page):
+        assert 0 <= page < pool.physical_pages
+        if key not in store:
+            if store.put(key, *payload):
+                adopted[0] += 1
+
+    cache.spill = spill
+    base = [rng.randrange(1, 50) for _ in range(20)]
+
+    def prompt_for(kind):
+        if kind == "identical":
+            return list(base)
+        if kind == "shared":
+            cut = rng.choice((4, 8, 12, 16))
+            return base[:cut] + [rng.randrange(50, 99)
+                                 for _ in range(rng.randrange(1, 21 - cut))]
+        return [rng.randrange(100, 199)
+                for _ in range(rng.randrange(2, 21))]
+
+    slots = {}
+    for _ in range(400):
+        action = rng.random()
+        free_slots = [s for s in range(pool.slots) if s not in slots]
+        if action < 0.55 and free_slots:
+            slot = rng.choice(free_slots)
+            prompt = prompt_for(rng.choice(("identical", "shared",
+                                            "divergent")))
+            needed = pool.pages_for(len(prompt) + 4)
+            cached, shared = cache.match(prompt)
+            fresh = needed - len(shared)
+            shortfall = fresh - pool.free_pages
+            if shortfall > 0:
+                cache.evict(shortfall)
+            if pool.assign_shared(slot, shared, fresh):
+                slots[slot] = prompt
+                cache.insert(prompt, pool.owned_pages(slot),
+                             cache.cacheable_tokens(len(prompt)))
+        elif slots:
+            slot = rng.choice(sorted(slots))
+            del slots[slot]
+            pool.release(slot)
+        if rng.random() < 0.1:
+            cache.evict(rng.randrange(1, 4))
+        # the conservation triple, every step
+        assert pool.free_pages + pool.live_pages == pool.num_pages
+        assert store.bytes_used <= store.capacity_bytes
+        assert store.bytes_used == sum(
+            entry.nbytes for entry in store._entries.values())
+        assert adopted[0] == store.resident_pages + store.evictions
+
+    assert adopted[0] > 0, "the churn never exercised the spill hook"
+    assert store.evictions > 0, "the budget never pushed back"
+
+
+# -- engine exactness --------------------------------------------------------
+
+def test_tier_needs_paged_quant_prefix(params):
+    with pytest.raises(ValueError, match="host_kv_bytes must be >= 0"):
+        make_engine(params, host_kv_bytes=-1)
+    with pytest.raises(ValueError, match="paged int8"):
+        make_engine(params, host_kv_bytes=1 << 20, kv_quant="off")
+    with pytest.raises(ValueError, match="paged int8"):
+        make_engine(params, host_kv_bytes=1 << 20, prefix_cache="off")
+    with pytest.raises(ValueError):
+        SlotEngine(params, F32_TINY, paged=False, host_kv_bytes=1 << 20)
+
+
+def test_miss_hbm_hit_host_hit_token_identity(params):
+    """The acceptance pin: the SAME prompt through a cold miss, a device
+    prefix hit, and a host-tier promotion after eviction emits identical
+    tokens — and the tier's counters/ledger tell the story honestly."""
+    from tensorhive_tpu.observability import get_request_ledger
+
+    engine = make_tiered(params)
+    assert engine.kv_quant == "on"
+
+    miss = run_one(engine, PROMPT_A)
+    tokens = miss.result(timeout_s=30)["tokens"]
+    assert engine.host_kv_hits == 0 and engine.host_kv_misses == 1
+
+    hbm_hit = run_one(engine, PROMPT_A)
+    assert hbm_hit.result(timeout_s=30)["tokens"] == tokens
+    # a device hit never probes past itself into a cold store... but the
+    # probe itself ran (and missed): the hit/miss split is per admission
+    assert engine.host_kv_hits == 0
+
+    churn_out_prompt_a(engine)
+    assert engine.host_kv_demotions > 0
+    assert engine._host_store.resident_pages > 0
+
+    host_hit = run_one(engine, PROMPT_A)
+    assert host_hit.result(timeout_s=30)["tokens"] == tokens
+    assert engine.host_kv_hits == 1
+    assert engine.host_kv_promotions >= 1
+
+    row = [r for r in get_request_ledger().recent()
+           if r["requestId"] == host_hit.request_id][0]
+    assert row["hostHitPages"] == engine.host_kv_promotions
+    assert row["promoteMs"] is not None and row["promoteMs"] >= 0
+    miss_row = [r for r in get_request_ledger().recent()
+                if r["requestId"] == miss.request_id][0]
+    assert miss_row["hostHitPages"] == 0 and miss_row["promoteMs"] is None
+
+    # a promotion re-seeds the RADIX tree: the next identical prompt hits
+    # on device without touching the store
+    hits_before = engine.host_kv_hits
+    again = run_one(engine, PROMPT_A)
+    assert again.result(timeout_s=30)["tokens"] == tokens
+    assert engine.host_kv_hits == hits_before
+
+
+def test_stats_metrics_and_alert_wiring(params):
+    from tensorhive_tpu.observability import get_registry
+    from tensorhive_tpu.observability.alerts import default_rule_pack
+
+    engine = make_tiered(params)
+    run_one(engine, PROMPT_A).result(timeout_s=30)
+    churn_out_prompt_a(engine)
+    run_one(engine, PROMPT_A).result(timeout_s=30)
+
+    stats = engine.stats()
+    assert stats["hostKvBytes"] == 1 << 20
+    assert stats["hostPagesResident"] == engine._host_store.resident_pages
+    assert stats["hostBytesUsed"] == engine._host_store.bytes_used
+    assert stats["hostHitRate"] == pytest.approx(
+        engine.host_kv_hits
+        / (engine.host_kv_hits + engine.host_kv_misses), abs=1e-4)
+
+    rendered = get_registry().render()
+    for metric in ("tpuhive_generate_host_kv_hits_total",
+                   "tpuhive_generate_host_kv_misses_total",
+                   "tpuhive_generate_host_kv_demotions_total",
+                   "tpuhive_generate_host_kv_promotions_total",
+                   "tpuhive_generate_host_kv_bytes_used",
+                   "tpuhive_generate_host_kv_bytes_capacity"):
+        assert metric in rendered, metric
+
+    rules = {rule.name: rule for rule in default_rule_pack()}
+    assert "host_kv_thrash" in rules
+    assert rules["host_kv_thrash"].metric == (
+        "tpuhive_generate_host_kv_demotions_total")
+    assert rules["host_kv_thrash"].kind == "increase"
+
+
+def test_zero_recompiles_across_demote_promote_churn(params):
+    """Demotion targets, promotion payloads and page assignments are all
+    traced operands of the two fixed-width copy executables warmup()
+    compiles — a full spill/promote round trip after warmup must not
+    grow the jit cache."""
+    engine = make_tiered(params)
+    engine.warmup(prompt_lens=(len(PROMPT_A),))
+    compiles = len(_compile_seen)
+    run_one(engine, PROMPT_A).result(timeout_s=30)
+    churn_out_prompt_a(engine)
+    host_hit = run_one(engine, PROMPT_A)
+    assert host_hit.result(timeout_s=30)["outcome"] == "completed"
+    assert engine.host_kv_promotions >= 1
+    assert len(_compile_seen) == compiles, (
+        "tier churn minted a new executable")
+
+
+def test_rollback_is_fingerprint_identical(params):
+    """host_kv_bytes=0 (the default) must not construct a store, a lane,
+    or EITHER copy fingerprint — and every surfaced field rides the
+    schema as null so the dashboard badge hides."""
+    seen_before = set(_compile_seen)
+    engine = make_tiered(params, host_kv_bytes=0)
+    assert engine._host_store is None and engine._host_lane is None
+    engine.warmup(prompt_lens=(len(PROMPT_A),))
+    handle = run_one(engine, PROMPT_A)
+    assert handle.result(timeout_s=30)["outcome"] == "completed"
+    assert not any("serving_page_extract" in str(key)
+                   or "serving_page_inject" in str(key)
+                   for key in set(_compile_seen) - seen_before)
+    stats = engine.stats()
+    assert stats["hostKvBytes"] is None
+    assert stats["hostPagesResident"] is None
+    assert stats["hostBytesUsed"] is None
+    assert stats["hostHitRate"] is None
+    from tensorhive_tpu.observability import get_request_ledger
+    row = [r for r in get_request_ledger().recent()
+           if r["requestId"] == handle.request_id][0]
+    assert row["hostHitPages"] is None and row["promoteMs"] is None
+
+
+def test_retry_after_discounts_cached_and_host_pages(params):
+    """The page bill quoted to a 429'd prefix-sharing client discounts
+    device-cached pages (granted shared — physically exact) and
+    host-resident continuations (filled by DMA, not recompute)."""
+    engine = make_tiered(params)
+    run_one(engine, PROMPT_A).result(timeout_s=30)
+    churn_out_prompt_a(engine)          # A's 5 cacheable pages now host-side
+    # two running sequences of very different remaining lengths: the
+    # LONG one shares C's cached run (9 pages), the SHORT private one
+    # holds 3 — its completion covers a 3-page ask but not an 8-page one
+    long = engine.submit(PROMPT_C, max_new_tokens=12)
+    engine.step()
+    short = engine.submit([200 + j for j in range(8)], max_new_tokens=4)
+    for _ in range(2):
+        engine.step()
+    for _ in range(40):
+        engine._intertoken_hist.observe(2.0)
+    with engine._lock:
+        cold = engine._retry_after_locked(needed_pages=8)
+        warm = engine._retry_after_locked(needed_pages=8, prompt=PROMPT_A)
+    # 5 of A's 8 pages are host-resident: the discounted 3-page ask is
+    # covered by the short runner's completion; the cold 8-page ask has
+    # to wait for the long one — quoting it the short ETA would be the
+    # over-promise this pins
+    assert warm < cold
+    short.cancel()
+    long.cancel()
+    drain(engine)
+
+
+# -- the never-blocks contract -----------------------------------------------
+
+def test_slow_promotion_never_stalls_decode(params):
+    """Swap the copy lane for a stub whose DMA 'completes' only when the
+    test says so: the promoting slot parks, the OTHER slot keeps emitting
+    a token every tick, and releasing the job resumes the parked prefill
+    token-identically. The pump never waits on a copy."""
+    clock = FakeClock()
+    # a roomy pool: the runner and the parked promotion must coexist, so
+    # the store is seeded by FORCED eviction instead of pool-pressure churn
+    engine = make_tiered(params, clock=clock, kv_pages=24)
+    expected = run_one(engine, PROMPT_A).result(timeout_s=30)["tokens"]
+    with engine._lock:
+        engine._prefix.evict(5)                # spills A's cacheable pages
+    drain(engine)                              # extract + adopt into store
+    assert engine._host_store.resident_pages == 5
+
+    stub = StubLane()
+    engine._host_lane = stub
+    runner = engine.submit([150 + j for j in range(8)], max_new_tokens=24)
+    engine.step()                              # join + first chunk
+    parked = engine.submit(PROMPT_A, max_new_tokens=6)
+    while not stub.jobs:
+        engine.step()                          # admit -> host hit -> park
+    assert engine.host_kv_hits >= 1
+
+    runner_request = runner._request
+    emitted = len(runner_request.generated)
+    for _ in range(10):
+        clock.advance(0.01)
+        engine.step()
+        now = len(runner_request.generated)
+        assert now > emitted, "a pending promotion stalled the pump"
+        emitted = now
+    assert len(parked._request.generated) == 0  # still parked, honestly
+    assert engine.host_kv_promotions == 0
+
+    stub.jobs[0].run()                          # the DMA "finishes"
+    clock.advance(0.01)
+    drain(engine)
+    assert engine.host_kv_promotions >= 1
+    assert parked._request.record.promote_ms == pytest.approx(0.11 * 1e3,
+                                                              abs=30.0)
+    assert parked.result(timeout_s=30)["tokens"] == expected
+    assert runner.result(timeout_s=30)["outcome"] == "completed"
+
+
+def test_lane_error_falls_back_to_recompute(params):
+    """A failed staging job must cost only its latency: the slot un-parks
+    and recomputes the span, tokens stay identical."""
+    engine = make_tiered(params)
+    expected = run_one(engine, PROMPT_A).result(timeout_s=30)["tokens"]
+    churn_out_prompt_a(engine)
+
+    stub = StubLane()
+    engine._host_lane = stub
+    retry = engine.submit(PROMPT_A, max_new_tokens=6)
+    job = None
+    while job is None:
+        engine.step()
+        with engine._lock:
+            for state in engine._slots:
+                if state is not None and state.promote_job is not None:
+                    job = state.promote_job
+    job.error = RuntimeError("injected DMA failure")
+    job.done = True
+    # the admission's evictions queued DEMOTE jobs on the stub too — run
+    # them so the engine can drain its lane backlog
+    for other in stub.jobs:
+        if other is not job and not other.done:
+            other.run()
+    drain(engine)
+    assert retry.result(timeout_s=30)["tokens"] == expected
+    assert engine.host_kv_promotions == 0

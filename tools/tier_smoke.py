@@ -1,0 +1,238 @@
+"""Smoke-test KV-page tiering end to end (``make tier-smoke``;
+docs/SERVING.md "KV-page tiering").
+
+Boots the real daemon surface — WSGI app over a real socket, a live
+GenerationService pump, in-memory DB — around a ``host_kv_bytes > 0``
+engine whose page pool is sized so ONE follow-up prompt must evict the
+first prompt's cached pages, then proves the tier's operational contract
+over HTTP:
+
+1. stream the probe prompt cold (tier MISS, full chunked prefill), churn
+   it out of HBM with a second prompt (eviction -> demotion to host RAM),
+   then stream the probe again: the host-tier HIT must emit IDENTICAL
+   tokens — promotion replaces the prefill fill, never the math;
+2. the host-hit's TTFT must beat the cold miss's (the ledger's
+   ``ttftMs`` over HTTP): a DMA promotion plus one tail chunk is cheaper
+   than recomputing every chunk — the whole point of the tier;
+3. the hit's ledger row carries ``hostHitPages > 0`` and ``promoteMs``,
+   the miss's carries ``hostHitPages == 0`` (tier on, nothing resident);
+4. ``/api/generate/stats`` reports the ``hostKvBytes`` / ``hostPagesResident``
+   / ``hostBytesUsed`` / ``hostHitRate`` block and ``/api/metrics`` exports
+   the ``tpuhive_generate_host_kv_*`` counters and byte gauges;
+5. ZERO post-warmup recompiles across the full demote/promote round trip —
+   the copy executables are fixed-width and warmed, tier membership is
+   host bookkeeping (the zero-recompile contract).
+
+Engines run the f32 tiny config (like the unit suite). Exit 0 = healthy.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+os.environ.setdefault("TPUHIVE_PYTEST", "1")          # DB goes in-memory
+
+#: 88 tokens over chunk size 8: a cold prefill pays ~11 chunk ticks while
+#: a host hit promotes 10 pages by DMA and prefills ONE tail chunk — the
+#: TTFT gap the smoke gates on is tick-count structural, not noise
+PROMPT = [(5 * j + 3) % 250 + 1 for j in range(88)]
+CHURN = [(7 * j + 11) % 250 + 1 for j in range(88)]
+NEW_TOKENS = 8
+PAGE_SIZE = 8
+CHUNK_TOKENS = 8
+#: pages_for(88 + 8) with page_size 8 — one request fills the whole pool,
+#: so the churn prompt's admission MUST evict (and thereby demote) the
+#: probe's cached pages
+KV_PAGES = 12
+
+PROBLEMS = []
+
+
+def check(ok: bool, what: str) -> None:
+    status = "ok" if ok else "FAIL"
+    print(f"tier-smoke: {status}: {what}")
+    if not ok:
+        PROBLEMS.append(what)
+
+
+def request(url: str, body=None, headers=None, method=None):
+    """(status, text, headers) over real HTTP; >=400 is a result."""
+    data = None if body is None else json.dumps(body).encode()
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers={"Content-Type": "application/json",
+                                          **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return resp.status, resp.read().decode(), dict(resp.headers)
+    except urllib.error.HTTPError as exc:
+        return exc.code, exc.read().decode(), dict(exc.headers)
+
+
+def stream(base: str, auth: dict, prompt):
+    status, body, _ = request(f"{base}/generate", body={
+        "promptTokens": prompt, "maxNewTokens": NEW_TOKENS,
+        "temperature": 0}, headers=auth)
+    check(status == 200, f"POST /generate streamed (got {status})")
+    lines = [json.loads(line) for line in body.strip().splitlines()]
+    done = lines[-1]
+    check(done.get("outcome") == "completed",
+          f"stream completed (got {done})")
+    return done.get("tokens"), done.get("requestId")
+
+
+def ledger_row(base: str, auth: dict, request_id: str):
+    status, body, _ = request(f"{base}/admin/requests", headers=auth)
+    check(status == 200, f"GET /admin/requests (got {status})")
+    rows = [row for row in json.loads(body)["requests"]
+            if row["requestId"] == request_id]
+    check(len(rows) == 1, f"ledger row for {request_id}")
+    return rows[0] if rows else {}
+
+
+def main() -> int:
+    import dataclasses
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from tensorhive_tpu.config import Config, set_config
+
+    config = Config(config_dir=Path("/tmp/tpuhive-tier-smoke"))
+    config.api.secret_key = "tier-smoke-secret"
+    config.generation.enabled = True
+    config.generation.interval_s = 0.01
+    set_config(config)
+
+    from tensorhive_tpu.db.engine import Engine, set_engine as set_db
+    from tensorhive_tpu.db.migrations import ensure_schema
+
+    engine_db = Engine(":memory:")
+    ensure_schema(engine_db)
+    set_db(engine_db)
+
+    from tensorhive_tpu.db.models import User
+
+    admin = User(username="smoke-admin", email="smoke@example.com",
+                 password="SuperSecret42").save()
+    admin.add_role("user")
+    admin.add_role("admin")
+
+    from tensorhive_tpu.core.services.generation import GenerationService
+    from tensorhive_tpu.models.decode import _compile_seen
+    from tensorhive_tpu.models.transformer import PRESETS, TransformerLM
+    from tensorhive_tpu.serving.engine import SlotEngine
+
+    f32_tiny = dataclasses.replace(PRESETS["tiny"], dtype=jnp.float32,
+                                   use_flash=False, remat=False,
+                                   max_seq_len=128)
+    params = TransformerLM.init(jax.random.PRNGKey(0), f32_tiny)
+
+    engine = SlotEngine(params, f32_tiny, slots=2, max_len=128,
+                        queue_depth=4, page_size=PAGE_SIZE,
+                        kv_pages=KV_PAGES, prefix_cache="on",
+                        prefix_min_tokens=PAGE_SIZE,
+                        prefill_chunk_tokens=CHUNK_TOKENS,
+                        host_kv_bytes=1 << 20)
+    engine.warmup(prompt_lens=(len(PROMPT),))
+    compiles_after_warmup = len(_compile_seen)
+
+    generation = GenerationService(config=config, engine=engine)
+    generation.start()
+
+    from tensorhive_tpu.api.server import APIServer
+
+    server = APIServer()
+    server.config.api.url_hostname = "127.0.0.1"
+    server.config.api.url_port = 0                     # ephemeral
+    port = server.start()
+    base = f"http://127.0.0.1:{port}/api"
+    try:
+        status, body, _ = request(f"{base}/user/login", body={
+            "username": "smoke-admin", "password": "SuperSecret42"})
+        check(status == 200, f"admin login over HTTP (got {status})")
+        auth = {"Authorization": "Bearer " + json.loads(body)["accessToken"]}
+
+        # -- 1: cold miss, churn, host hit — identical tokens --------------
+        miss_tokens, miss_id = stream(base, auth, PROMPT)
+        check(isinstance(miss_tokens, list)
+              and len(miss_tokens) == NEW_TOKENS,
+              f"cold stream emitted {NEW_TOKENS} tokens")
+        stream(base, auth, CHURN)                      # evict -> demote
+        deadline = time.monotonic() + 10
+        while (engine._host_store.resident_pages == 0
+               and time.monotonic() < deadline):
+            time.sleep(0.05)                           # lane adoption
+        check(engine._host_store.resident_pages > 0,
+              f"churn demoted {engine._host_store.resident_pages} pages "
+              "to the host store")
+        hit_tokens, hit_id = stream(base, auth, PROMPT)
+        check(hit_tokens == miss_tokens,
+              f"host-tier hit tokens identical to the cold miss "
+              f"({hit_tokens} vs {miss_tokens})")
+        check(engine.host_kv_promotions > 0,
+              f"pages promoted back by DMA ({engine.host_kv_promotions})")
+
+        # -- 2 + 3: TTFT beats the miss; the ledger tells the story --------
+        miss_row = ledger_row(base, auth, miss_id)
+        hit_row = ledger_row(base, auth, hit_id)
+        check(miss_row.get("hostHitPages") == 0
+              and miss_row.get("promoteMs") is None,
+              "miss row: hostHitPages=0, promoteMs=null")
+        check((hit_row.get("hostHitPages") or 0) > 0,
+              f"hit row promoted {hit_row.get('hostHitPages')} pages")
+        check(hit_row.get("promoteMs") is not None,
+              f"hit row carries promoteMs ({hit_row.get('promoteMs')})")
+        check((hit_row.get("ttftMs") or 1e9) < (miss_row.get("ttftMs")
+                                                or 0),
+              f"host-hit TTFT {hit_row.get('ttftMs')}ms beats the miss's "
+              f"{miss_row.get('ttftMs')}ms")
+
+        # -- 4: stats block + metric exposition ----------------------------
+        status, body, _ = request(f"{base}/generate/stats", headers=auth)
+        check(status == 200, f"GET /generate/stats (got {status})")
+        stats = json.loads(body)
+        check(stats.get("hostKvBytes") == 1 << 20,
+              "stats report the host_kv_bytes budget")
+        check((stats.get("hostPagesResident") or 0) >= 0
+              and stats.get("hostBytesUsed") is not None,
+              "stats report host store residency")
+        check((stats.get("hostHitRate") or 0) > 0,
+              f"stats report hostHitRate ({stats.get('hostHitRate')})")
+        status, scrape, _ = request(f"{base}/metrics")
+        check(status == 200, f"GET /metrics (got {status})")
+        for metric in ("tpuhive_generate_host_kv_hits_total",
+                       "tpuhive_generate_host_kv_misses_total",
+                       "tpuhive_generate_host_kv_demotions_total",
+                       "tpuhive_generate_host_kv_promotions_total",
+                       "tpuhive_generate_host_kv_bytes_used",
+                       "tpuhive_generate_host_kv_bytes_capacity"):
+            check(metric in scrape, f"{metric} in the exposition")
+
+        # -- 5: zero post-warmup recompiles through the round trip ---------
+        check(len(_compile_seen) == compiles_after_warmup,
+              "zero new executables across demote + promote "
+              f"({len(_compile_seen)} vs {compiles_after_warmup} warmed)")
+    finally:
+        server.stop()
+        generation.shutdown()
+        generation.join(timeout=5)
+
+    if PROBLEMS:
+        print(f"tier-smoke: {len(PROBLEMS)} problem(s)", file=sys.stderr)
+        return 1
+    print("tier-smoke: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
