@@ -2,7 +2,7 @@
 # mypy + flake8 per .circleci/config.yml:33-38): the dependency-free AST
 # lint + thivelint analyzer always run; mypy/ruff run when installed
 # (absent from this image).
-.PHONY: check lint analysis analysis-fast lockcheck test bench probe metrics-smoke decode-smoke alerts-smoke chaos-smoke serving-smoke serving-mesh-smoke trace-smoke prefix-smoke spec-smoke serving-chaos-smoke quant-smoke history-smoke tier-smoke
+.PHONY: check lint analysis analysis-fast lockcheck test bench probe metrics-smoke decode-smoke alerts-smoke chaos-smoke serving-smoke serving-mesh-smoke trace-smoke prefix-smoke spec-smoke serving-chaos-smoke quant-smoke history-smoke tier-smoke usage-smoke
 
 check: lint analysis
 	@command -v ruff >/dev/null 2>&1 && ruff check . || echo "ruff not installed; skipped (tools/lint.py covered the always-on subset)"
@@ -139,6 +139,14 @@ history-smoke:
 # must be scrapeable, zero post-warmup recompiles across the round trip
 tier-smoke:
 	python tools/tier_smoke.py
+
+# tenant attribution over a real socket (docs/OBSERVABILITY.md "Tenant
+# accounting"): two tenants stream concurrently -> /api/admin/usage share
+# fractions sum to 1.0 with the heavier tenant ahead, ?user= isolates one
+# tenant on both the usage rollup and the request ledger, the scrape holds
+# <= top_k_tenants+1 tenant children, zero post-warmup recompiles
+usage-smoke:
+	python tools/usage_smoke.py
 
 probe:
 	$(MAKE) -C tensorhive_tpu/native

@@ -81,6 +81,7 @@ function renderNodes(main) {
     <div id="alert-strip"></div>
     <div id="serving-strip"></div>
     <div id="requests-strip"></div>
+    <div id="tenants-strip"></div>
     <div class="card"><div class="row">
       <h3 style="margin:0">Watches</h3>
       ${["hbm", "duty", "procs"].map(name => `<label class="inline">
@@ -93,7 +94,7 @@ function renderNodes(main) {
   const refresh = async () => {
     try {
       if (isAdmin()) {
-        refreshAlerts(); refreshRecentRequests();
+        refreshAlerts(); refreshRecentRequests(); refreshTenants();
         await refreshHistory();       // sparkline data for the strips below
         refreshServiceHealth();
       }
@@ -424,6 +425,40 @@ async function refreshRecentRequests() {
     <h3 style="margin:0">Requests</h3>
     ${reqs.map(requestBadge).join("")}
     <span class="muted">${doc.recorded} recorded · ring ${doc.capacity}</span>
+  </div></div>`;
+}
+
+function tenantBar(tenant, maxShare) {
+  const pct = Math.round(tenant.share * 100);
+  const width = maxShare > 0 ? Math.round(tenant.share / maxShare * 100) : 0;
+  const detail = tenant.tenant + " · " + tenant.deviceSeconds.toFixed(1) +
+    " device-s · " + (tenant.kvByteSeconds / 1e9).toFixed(2) +
+    " GB·s KV · queue " + tenant.queueSeconds.toFixed(1) + "s" +
+    (tenant.capacityShare != null
+      ? " · " + Math.round(tenant.capacityShare * 100) + "% of capacity" : "");
+  return `<span class="badge" title="${esc(detail)}">
+    ${esc(tenant.tenant)} ${pct}%
+    <span style="display:inline-block;height:6px;border-radius:3px;
+      background:var(--accent,#4a9);vertical-align:middle;
+      width:${Math.max(width, 2) * 0.6}px"></span></span>`;
+}
+
+/* top-tenants strip from the accounting plane (GET /admin/usage) — hidden
+   while [accounting] is disabled (404) or nothing is attributed yet */
+async function refreshTenants() {
+  const el = document.getElementById("tenants-strip");
+  if (!el) return;
+  let doc;
+  try { doc = await api("/admin/usage"); }
+  catch (e) { el.innerHTML = ""; return; }   // accounting disabled or unreachable
+  const tenants = (doc.tenants || []).filter(t => t.deviceSeconds > 0);
+  if (!tenants.length) { el.innerHTML = ""; return; }
+  const maxShare = tenants[0].share;
+  el.innerHTML = `<div class="card"><div class="row">
+    <h3 style="margin:0">Tenants</h3>
+    ${tenants.map(t => tenantBar(t, maxShare)).join("")}
+    <span class="muted">device-second share ·
+      ${Math.round(doc.windowS / 60)}m window</span>
   </div></div>`;
 }
 

@@ -302,6 +302,26 @@ class HistoryConfig:
 
 
 @dataclasses.dataclass
+class AccountingConfig:
+    """Per-tenant resource attribution (docs/OBSERVABILITY.md "Tenant
+    accounting"). The TenantMeter integrates device-seconds, KV
+    byte-seconds, queue-seconds and token counts per serving user plus
+    chip-seconds per reservation owner. Disabled = the meter is never
+    built, the engine takes its meter-less fast path (byte-identical
+    rollback), ``/api/admin/usage`` answers 404 and zero
+    ``tpuhive_tenant_*`` series render."""
+    enabled: bool = True
+    top_k_tenants: int = 8           # tenants exported by name; the rest
+                                     # collapse into the 'other' bucket
+                                     # (cardinality bound = K+1 children)
+    window_s: float = 3600.0         # default /api/admin/usage rollup and
+                                     # dominance-alert lookback
+    dominance_share: float = 0.5     # tenant_dominates_capacity fires above
+                                     # this share of windowed device-seconds
+                                     # while queue-wait SLO pressure exists
+
+
+@dataclasses.dataclass
 class SloConfig:
     """SLO objectives + burn-rate evaluation (docs/OBSERVABILITY.md
     "History, SLOs & flight recorder"). Evaluated off the history store;
@@ -394,6 +414,7 @@ class Config:
     alerting: AlertingConfig = dataclasses.field(default_factory=AlertingConfig)
     generation: GenerationConfig = dataclasses.field(default_factory=GenerationConfig)
     history: HistoryConfig = dataclasses.field(default_factory=HistoryConfig)
+    accounting: AccountingConfig = dataclasses.field(default_factory=AccountingConfig)
     slo: SloConfig = dataclasses.field(default_factory=SloConfig)
     profiling: ProfilingConfig = dataclasses.field(default_factory=ProfilingConfig)
     ssh: SshConfig = dataclasses.field(default_factory=SshConfig)
@@ -445,6 +466,7 @@ _SECTION_MAP = {
     "alerting_service": "alerting",
     "generation_service": "generation",
     "history": "history",
+    "accounting": "accounting",
     "slo": "slo",
     "profiling": "profiling",
     "ssh": "ssh",
@@ -602,6 +624,15 @@ enabled = true
 # retention_s = 3600.0
 # max_points = 720      # memory bound per series, independent of retention
 # series = ""           # comma-separated allowlist ("" = shipped default)
+
+[accounting]
+# per-tenant chip-second / HBM-byte-second attribution
+# (docs/OBSERVABILITY.md "Tenant accounting"); disabled = no meter, no
+# tpuhive_tenant_* series, GET /api/admin/usage answers 404
+enabled = true
+# top_k_tenants = 8     # named tenants in the scrape; rest -> 'other'
+# window_s = 3600.0
+# dominance_share = 0.5
 
 [slo]
 # burn-rate SLO engine over the history store; disabled = no

@@ -99,6 +99,10 @@ STATS_SCHEMA = obj(
     requestsCompleted=s("integer"),
     tokensEmitted=s("integer"),
     steps=s("integer"),
+    #: tenant accounting (docs/OBSERVABILITY.md "Tenant accounting"):
+    #: busy slot-second integral the TenantMeter conserves against —
+    #: null while [accounting] is disabled
+    busySlotSeconds=s("number", nullable=True),
     ttftP50Ms=s("number", nullable=True),
     ttftP95Ms=s("number", nullable=True),
     intertokenP50Ms=s("number", nullable=True),

@@ -172,6 +172,11 @@ REQUEST_RECORD_SCHEMA = obj(
     ttftMs=s("number", nullable=True),
     decodeMs=s("number", nullable=True),
     totalMs=s("number", nullable=True),
+    #: tenant accounting (docs/OBSERVABILITY.md "Tenant accounting"): the
+    #: TenantMeter's per-request resource-time integrals, finalized at
+    #: request end (null = [accounting] off or the row predates it)
+    deviceSeconds=s("number", nullable=True),
+    kvByteSeconds=s("number", nullable=True),
     tokens=s("integer"),
     intertokenP50Ms=s("number", nullable=True),
 )
@@ -180,7 +185,8 @@ REQUEST_RECORD_SCHEMA = obj(
 @route("/admin/requests", ["GET"], auth="admin",
        summary="Per-request serving traces (phase timings + outcomes)",
        tag="observability",
-       query={"limit": s("integer"), "outcome": s("string")},
+       query={"limit": s("integer"), "outcome": s("string"),
+              "user": s("string")},
        responses={200: obj(required=["capacity", "recorded", "requests",
                                      "inFlight"],
                            capacity=s("integer"),
@@ -192,16 +198,18 @@ def get_requests(context: RequestContext) -> Dict:
     queue/prefill/decode phase breakdown, slot/page placement, prefill
     compile hit/miss and outcome (rejections included), plus the requests
     currently queued or running; ``?limit=`` caps the finished dump,
-    ``?outcome=`` filters it. Every row's ``requestId`` matches the
-    ``X-Request-Id`` response header and the ``request_id`` attr on the
-    ``generate.*`` spans in ``GET /api/admin/traces``."""
+    ``?outcome=`` and ``?user=`` (exact ``userKey`` match) filter it.
+    Every row's ``requestId`` matches the ``X-Request-Id`` response
+    header and the ``request_id`` attr on the ``generate.*`` spans in
+    ``GET /api/admin/traces``."""
     ledger = get_request_ledger()
     limit = int_arg(context, "limit")
     outcome = context.request.args.get("outcome")
+    user = context.request.args.get("user")
     return {
         "capacity": ledger.capacity,
         "recorded": len(ledger),
-        "requests": ledger.recent(limit=limit, outcome=outcome),
+        "requests": ledger.recent(limit=limit, outcome=outcome, user=user),
         "inFlight": ledger.in_flight(),
     }
 
@@ -387,6 +395,128 @@ def get_history(context: RequestContext) -> Dict:
         "windowS": history.window_s,
         "sampleIntervalS": config.history.sample_interval_s,
         "series": data,
+    }
+
+
+def _accounting_config():
+    """The [accounting] config, or a 404 while tenant accounting is
+    disabled — same contract as the profiling/history endpoints: a
+    surface the operator turned off does not exist."""
+    from ..config import get_config
+
+    config = get_config()
+    if not config.accounting.enabled:
+        raise NotFoundError(
+            "tenant accounting is disabled on this manager ([accounting] "
+            "enabled in config.toml; docs/OBSERVABILITY.md)")
+    return config
+
+
+USAGE_TENANT_SCHEMA = obj(
+    required=["tenant", "deviceSeconds", "kvByteSeconds", "queueSeconds",
+              "share"],
+    tenant=s("string"),
+    deviceSeconds=s("number"),
+    kvByteSeconds=s("number"),
+    hostKvByteSeconds=s("number"),
+    queueSeconds=s("number"),
+    prefillTokens=s("integer"),
+    decodeTokens=s("integer"),
+    cachedTokens=s("integer"),
+    specAcceptedTokens=s("integer"),
+    reservedChipSeconds=s("number"),
+    effectiveChipSeconds=s("number"),
+    #: fraction of the window's ATTRIBUTED device-seconds (all tenants'
+    #: shares sum to 1 while anything was attributed)
+    share=s("number"),
+    #: fraction of the window's theoretical capacity (numDevices x
+    #: window); null while no serving engine is published
+    capacityShare=s("number", nullable=True),
+)
+
+
+@route("/admin/usage", ["GET"], auth="admin",
+       summary="Per-tenant resource rollups (chip-seconds, HBM, queue)",
+       tag="observability",
+       query={"window": s("number"), "user": s("string")},
+       responses={200: obj(required=["windowS", "tenants", "totals"],
+                           windowS=s("number"),
+                           topKTenants=s("integer"),
+                           numDevices=s("integer", nullable=True),
+                           busySlotSeconds=s("number", nullable=True),
+                           tenants=arr(USAGE_TENANT_SCHEMA),
+                           totals={"type": "object",
+                                   "additionalProperties": True}),
+                  404: obj(required=["msg"], msg=s("string"))})
+def get_usage(context: RequestContext) -> Dict:
+    """Per-tenant rollups over the trailing window (docs/OBSERVABILITY.md
+    "Tenant accounting"): device-seconds, HBM/host KV byte-seconds,
+    queue-seconds and token splits from the serving plane plus
+    reservation chip-seconds, with share-of-attributed and
+    share-of-capacity fractions. ``?window=`` overrides the
+    ``[accounting] window_s`` lookback, ``?user=`` keeps one tenant's
+    row. 404 while ``[accounting]`` is disabled."""
+    from ..observability.accounting import get_tenant_meter
+    from ..serving import get_engine
+
+    config = _accounting_config()
+    meter = get_tenant_meter()
+    if meter is None:       # disabled between config load and this call
+        raise NotFoundError(
+            "tenant accounting is disabled on this manager ([accounting] "
+            "enabled in config.toml; docs/OBSERVABILITY.md)")
+    window_s = _float_arg(context, "window")
+    if window_s is None:
+        window_s = config.accounting.window_s
+    if window_s <= 0:
+        raise ValidationError(
+            f"query param 'window' must be > 0 seconds, got {window_s}")
+    user = context.request.args.get("user")
+    rollup = meter.rollup(window_s=window_s)
+    total_device = sum(u.device_seconds for u in rollup.values())
+    engine = get_engine()
+    capacity_s = (engine.num_devices * window_s
+                  if engine is not None else None)
+    tenants = []
+    for tenant, usage in sorted(rollup.items(),
+                                key=lambda kv: (-kv[1].device_seconds,
+                                                kv[0])):
+        if user is not None and tenant != user:
+            continue
+        tenants.append({
+            "tenant": tenant,
+            "deviceSeconds": round(usage.device_seconds, 6),
+            "kvByteSeconds": round(usage.kv_byte_seconds, 3),
+            "hostKvByteSeconds": round(usage.host_kv_byte_seconds, 3),
+            "queueSeconds": round(usage.queue_seconds, 6),
+            "prefillTokens": int(usage.prefill_tokens),
+            "decodeTokens": int(usage.decode_tokens),
+            "cachedTokens": int(usage.cached_tokens),
+            "specAcceptedTokens": int(usage.spec_accepted_tokens),
+            "reservedChipSeconds": round(usage.reserved_chip_seconds, 6),
+            "effectiveChipSeconds": round(usage.effective_chip_seconds, 6),
+            "share": (round(usage.device_seconds / total_device, 6)
+                      if total_device > 0 else 0.0),
+            "capacityShare": (round(usage.device_seconds / capacity_s, 6)
+                              if capacity_s else None),
+        })
+    return {
+        "windowS": window_s,
+        "topKTenants": meter.top_k,
+        "numDevices": engine.num_devices if engine is not None else None,
+        "busySlotSeconds": (round(engine.busy_slot_seconds, 6)
+                            if engine is not None else None),
+        "tenants": tenants,
+        "totals": {
+            "deviceSeconds": round(total_device, 6),
+            "kvByteSeconds": round(sum(u.kv_byte_seconds
+                                       for u in rollup.values()), 3),
+            "queueSeconds": round(sum(u.queue_seconds
+                                      for u in rollup.values()), 6),
+            "reservedChipSeconds": round(
+                sum(u.reserved_chip_seconds for u in rollup.values()), 6),
+            "tenantsAttributed": len(rollup),
+        },
     }
 
 

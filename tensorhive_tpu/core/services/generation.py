@@ -45,6 +45,7 @@ from typing import Callable, List, Optional
 
 from ...config import Config, get_config
 from ...observability import get_registry
+from ...observability.accounting import get_tenant_meter
 from ...serving import CheckpointLoadError
 from ...serving.faults import TRANSIENT, classify_failure
 from .base import Service
@@ -468,6 +469,7 @@ def build_engine(config: Config):
         max_new_tokens_cap=generation.max_new_tokens,
         max_concurrent_per_user=generation.max_concurrent_per_user,
         flight_recorder=build_flight_recorder(generation),
+        tenant_meter=get_tenant_meter(),
     )
     engine.warmup(prompt_lens=(16, max_len // 2))
     log.info("generation engine ready: preset=%s slots=%d max_len=%d "

@@ -23,6 +23,7 @@ from typing import Dict, List, Optional
 from ...config import Config, get_config
 from ...db.models.reservation import Reservation
 from ...observability import get_registry
+from ...observability.accounting import get_tenant_meter
 from ...utils.timeutils import isoformat, utcnow
 from .base import Service
 
@@ -56,16 +57,41 @@ class UsageLoggingService(Service):
         if not active:
             return
         self.log_dir.mkdir(parents=True, exist_ok=True)
+        meter = get_tenant_meter()
         for reservation in active:
             chip = self.infrastructure_manager.find_chip(reservation.resource_id)
             if chip is None:
                 continue
+            duty = chip.get("duty_cycle_pct")
             sample = {
                 "time": isoformat(utcnow()),
-                "duty_cycle_pct": chip.get("duty_cycle_pct"),
+                "duty_cycle_pct": duty,
                 "hbm_util_pct": chip.get("hbm_util_pct"),
             }
             self._append_sample(reservation.id, sample)
+            if meter is not None:
+                # reservation plane of the tenant attribution substrate
+                # (docs/OBSERVABILITY.md "Tenant accounting"): one held
+                # chip x the sampling cadence per tick, plus the
+                # duty-cycle-weighted share actually exercised
+                meter.charge_reservation(
+                    self._owner_key(reservation),
+                    chip_seconds=self.interval_s,
+                    effective_chip_seconds=(
+                        self.interval_s * duty / 100.0
+                        if duty is not None else None))
+
+    @staticmethod
+    def _owner_key(reservation: Reservation) -> str:
+        """Tenant key for a reservation: the owner's username (the same
+        namespace serving's ``userKey`` lives in), ``user:<id>`` when the
+        row outlived its user."""
+        from ...db.models.user import User
+
+        user = User.get_or_none(reservation.user_id)
+        if user is not None and getattr(user, "username", None):
+            return user.username
+        return f"user:{reservation.user_id}"
 
     def _path(self, reservation_id: int) -> Path:
         return self.log_dir / f"{reservation_id}.jsonl"

@@ -80,6 +80,10 @@ def reset_observability() -> None:
 
     set_metrics_history(None)
     set_slo_engine(None)
+    # the tenant meter rebuilds lazily from [accounting] too
+    from .accounting import set_tenant_meter
+
+    set_tenant_meter(None)
 
 
 __all__ = [

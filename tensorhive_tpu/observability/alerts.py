@@ -485,6 +485,18 @@ def _serving_spec_acceptance() -> Optional[float]:
     return engine.spec_acceptance_rate()
 
 
+def _tenant_dominance() -> Optional[float]:
+    """Largest single-tenant share of attributed device-seconds over the
+    accounting window, gated on queue-wait SLO pressure — the
+    noisy-neighbor signal item 4's ProtectionService will enforce
+    against. None (quiet) while [accounting] is off, no engine runs, the
+    queue is healthy, or nothing was attributed (docs/OBSERVABILITY.md
+    "Tenant accounting")."""
+    from .accounting import dominance_signal
+
+    return dominance_signal()
+
+
 def _engine_crash_loop() -> Optional[float]:
     """Source callable: 1.0 while the generation supervisor's crash-loop
     breaker is open (restart budget exhausted — the plane is 503ing with
@@ -564,6 +576,15 @@ def default_rule_pack(monitoring_interval_s: Optional[float] = None,
         log.warning("default_rule_pack: config unavailable, assuming "
                     "2s TTFT SLO / 60s slot-leak threshold", exc_info=True)
         ttft_slo_s, queue_wait_slo_s, slot_leak_after_s = 2.0, 1.0, 60.0
+    try:
+        from ..config import get_config
+
+        dominance_share = get_config().accounting.dominance_share
+    except Exception:
+        # same fallback posture: the shipped [accounting] default
+        log.warning("default_rule_pack: config unavailable, assuming 0.5 "
+                    "tenant dominance share", exc_info=True)
+        dominance_share = 0.5
     return [
         AlertRule(
             name="service_down", severity="critical",
@@ -748,6 +769,18 @@ def default_rule_pack(monitoring_interval_s: Optional[float] = None,
                         "leak that exhausts the budget well before the "
                         "window rolls (docs/OBSERVABILITY.md 'History, "
                         "SLOs & flight recorder')"),
+        AlertRule(
+            name="tenant_dominates_capacity", severity="warning",
+            kind="threshold", op=">", threshold=dominance_share,
+            for_s=2 * alert_interval_s,
+            source=_tenant_dominance,
+            description="one tenant holds more than [accounting] "
+                        "dominance_share of attributed device-seconds "
+                        "over the accounting window WHILE p95 queue wait "
+                        "breaches its SLO — a noisy neighbor is crowding "
+                        "out the queue; quiet when accounting is off or "
+                        "the queue is healthy (docs/OBSERVABILITY.md "
+                        "'Tenant accounting')"),
     ]
 
 

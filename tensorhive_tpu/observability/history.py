@@ -400,6 +400,13 @@ def default_series(generation=None) -> List[str]:
         "tpuhive_service_ticks_total",
         "tpuhive_service_tick_failures_total",
         "tpuhive_process_resident_memory_bytes",
+        # tenant accounting aggregates (docs/OBSERVABILITY.md "Tenant
+        # accounting"): a bare family name SUMS its children, so these
+        # are the all-tenant totals — per-tenant windows come from
+        # /api/admin/usage, not the history ring (cardinality policy)
+        "tpuhive_tenant_device_seconds_total",
+        "tpuhive_tenant_kv_byte_seconds_total",
+        "tpuhive_tenant_queue_seconds_total",
     ]
 
 

@@ -308,6 +308,19 @@ class MetricFamily:
             for child in self._children.values():
                 child._reset()
 
+    def retain_children(self, keys: Iterable[Tuple[str, ...]]) -> None:
+        """Drop every child whose label tuple is not in ``keys`` — the
+        cardinality bound for collector-owned families. Only valid for
+        families whose SOLE writer is a render-time collector (e.g. the
+        ``tpuhive_tenant_*`` accounting exports): instrumented modules
+        holding child references would be silently orphaned, which is
+        exactly why :meth:`reset_values` never drops children."""
+        keep = set(keys)
+        with self._lock:
+            for key in list(self._children):
+                if key not in keep:
+                    del self._children[key]
+
 
 class MetricsRegistry:
     """Thread-safe collection of metric families + Prometheus rendering."""

@@ -100,6 +100,11 @@ class RequestRecord:
     #: at EOS or the max_new budget)
     draft_tokens: Optional[int] = None
     accepted_tokens: Optional[int] = None
+    #: tenant accounting (docs/OBSERVABILITY.md "Tenant accounting"):
+    #: the TenantMeter's per-request resource-time integrals, finalized
+    #: at request end (None: [accounting] off or the row predates it)
+    device_seconds: Optional[float] = None
+    kv_byte_seconds: Optional[float] = None
     tokens: int = 0
     finished_ts: Optional[float] = None
     #: raw inter-token gaps (ms); bounded by max_new_tokens <= the engine cap
@@ -142,6 +147,10 @@ class RequestRecord:
             "acceptanceRate": (round(self.accepted_tokens
                                      / self.draft_tokens, 4)
                                if self.draft_tokens else None),
+            "deviceSeconds": (round(self.device_seconds, 6)
+                              if self.device_seconds is not None else None),
+            "kvByteSeconds": (round(self.kv_byte_seconds, 3)
+                              if self.kv_byte_seconds is not None else None),
             "tokens": self.tokens,
             "intertokenP50Ms": self.intertoken_p50_ms(),
         }
@@ -233,13 +242,17 @@ class RequestLedger:
 
     # -- reading -----------------------------------------------------------
     def recent(self, limit: Optional[int] = None,
-               outcome: Optional[str] = None) -> List[Dict]:
-        """Finished records, newest first; ``outcome=`` filters."""
+               outcome: Optional[str] = None,
+               user: Optional[str] = None) -> List[Dict]:
+        """Finished records, newest first; ``outcome=`` and ``user=``
+        (exact ``userKey`` match) filters compose."""
         with self._lock:
             records = list(self._finished)
         records.reverse()
         if outcome is not None:
             records = [r for r in records if r.outcome == outcome]
+        if user is not None:
+            records = [r for r in records if r.user_key == user]
         if limit is not None and limit >= 0:
             records = records[:limit]
         return [record.to_dict() for record in records]

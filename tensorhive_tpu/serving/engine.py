@@ -116,6 +116,7 @@ from ..observability import (
     get_request_ledger,
     get_tracer,
 )
+from ..observability.accounting import ANONYMOUS_TENANT
 from ..ops.paged_attention import resolve_paged_kernel
 from . import EngineDrainingError, QueueFullError, RateLimitError
 from .faults import ServingFaultPlan
@@ -831,6 +832,11 @@ class _Request:
     deadline_ts: Optional[float] = None
     cancelled: bool = False
     finished: bool = False
+    #: resource-time integrals the tenant meter accumulated for THIS
+    #: request (observability/accounting.py); finalized onto the ledger
+    #: row at finish — stay 0.0 while no meter is installed
+    device_seconds: float = 0.0
+    kv_byte_seconds: float = 0.0
 
     def wall(self, clock_ts: float) -> float:
         """Translate an engine-clock stamp to wall-clock seconds."""
@@ -914,6 +920,7 @@ class SlotEngine:
         fault_plan: Optional[ServingFaultPlan] = None,
         clock: Callable[[], float] = time.monotonic,
         flight_recorder=None,
+        tenant_meter=None,
     ) -> None:
         if not config.causal:
             raise ValueError("serving needs an autoregressive model; this "
@@ -961,6 +968,12 @@ class SlotEngine:
         #: step() byte-identical to the unrecorded path — the
         #: [generation_service] flight_recorder=off rollback
         self.flight_recorder = flight_recorder
+        #: per-tenant resource-time attribution (observability/
+        #: accounting.py TenantMeter); pure host bookkeeping stamped from
+        #: the pump thread, never a traced operand. None keeps every hook
+        #: a single attribute check — the [accounting] enabled=false
+        #: rollback
+        self.tenant_meter = tenant_meter
         #: drain mode: admission refused (EngineDrainingError -> 503 +
         #: Retry-After at the API edge) while in-flight requests finish
         self._draining = False
@@ -1006,6 +1019,13 @@ class SlotEngine:
         self.completed_requests = 0
         self.emitted_tokens = 0
         self.steps = 0
+        #: busy slot-second integral, accumulated from the SAME dt samples
+        #: the tenant meter charges from, so the conservation invariant
+        #: sum(tenant device-seconds) == busy_slot_seconds x num_devices
+        #: is exact under a fake clock (tests/unit/test_accounting.py);
+        #: stays 0.0 while no meter is installed
+        self.busy_slot_seconds = 0.0
+        self._last_meter_ts: Optional[float] = None
         #: private latency views backing ``stats()`` p50/p95 (the registry
         #: children are shared across engine instances in tests)
         self._ttft_hist = Histogram()
@@ -1085,6 +1105,13 @@ class SlotEngine:
             self._use_kernel = False
             self._kernel_interpret = False
             self._page_hbm_bytes = None
+            #: one slot's reserved contiguous KV footprint (the whole
+            #: max_len row, K+V, all layers) — the byte-accounting unit
+            #: the tenant meter charges for slot residency when there is
+            #: no page pool to count
+            self._slot_kv_bytes = (2 * config.n_layers * self.max_len
+                                   * config.kv_heads * config.d_head
+                                   * jnp.dtype(config.dtype).itemsize)
             if self.capacity % self.mesh_dp:
                 raise ValueError(
                     f"slots={self.capacity} must be divisible by mesh "
@@ -1533,6 +1560,57 @@ class SlotEngine:
                     or bool(self._pending_demotes)
                     or bool(self._demote_jobs))
 
+    def _meter_tick(self) -> None:
+        """Integrate one pump-tick's resource-time products into the
+        tenant meter — pure host bookkeeping on the pump thread (clock
+        reads, page counts, dict updates; never a traced operand, so the
+        zero-recompile contract is untouched). Every busy slot is charged
+        from ONE dt sample and the engine's own ``busy_slot_seconds``
+        integral accumulates from the same samples, which is what makes
+        the conservation invariant ``sum(tenant device-seconds) ==
+        busy_slot_seconds x num_devices`` exact rather than approximate.
+        The meter lock is a leaf taken after the engine lock is released;
+        no new lock-order cycle is possible (TH-LOCK)."""
+        meter = self.tenant_meter
+        now = self.clock()
+        last = self._last_meter_ts
+        self._last_meter_ts = now
+        if last is None:
+            return
+        dt = now - last
+        if dt <= 0:
+            return
+        devices = self.num_devices
+        charges: Dict[str, List[float]] = {}
+        with self._lock:
+            for index, slot in enumerate(self._slots):
+                if slot is None:
+                    continue
+                request = slot.request
+                tenant = request.user_key or ANONYMOUS_TENANT
+                self.busy_slot_seconds += dt
+                entry = charges.get(tenant)
+                if entry is None:
+                    entry = charges[tenant] = [0.0, 0.0, 0.0]
+                device_s = dt * devices
+                if self.paged:
+                    kv_byte_s = (self._pool.owned_count(index)
+                                 * self._page_hbm_bytes * dt)
+                else:
+                    kv_byte_s = self._slot_kv_bytes * dt
+                entry[0] += device_s
+                entry[1] += kv_byte_s
+                request.device_seconds += device_s
+                request.kv_byte_seconds += kv_byte_s
+                if slot.promote_entries:
+                    # host-tier residency: pages this request's promote
+                    # lane still holds in the host store (parked on DMA)
+                    entry[2] += sum(e.nbytes
+                                    for e in slot.promote_entries) * dt
+        if charges:
+            meter.charge_tick({tenant: (entry[0], entry[1], entry[2])
+                               for tenant, entry in charges.items()})
+
     def step(self) -> int:
         """One scheduler iteration: admit joins, advance every in-progress
         prefill by ONE chunk, then advance the running batch one token —
@@ -1548,6 +1626,8 @@ class SlotEngine:
         tick that *raises* is the one tick the post-mortem needs most.
         ``flight_recorder is None`` is the byte-identical unrecorded
         path."""
+        if self.tenant_meter is not None:
+            self._meter_tick()
         recorder = self.flight_recorder
         if recorder is None:
             if self._host_store is not None:
@@ -2159,6 +2239,15 @@ class SlotEngine:
                 queue_wait_s = joined_ts - request.submitted_ts
                 _QUEUE_WAIT_SECONDS.observe(queue_wait_s)
                 self._queue_wait_hist.observe(queue_wait_s)
+                meter = self.tenant_meter
+                if meter is not None:
+                    # queue phase closes here; prompt tokens split into
+                    # what the cache served vs what prefill will compute
+                    tenant = request.user_key or ANONYMOUS_TENANT
+                    meter.charge_queue(tenant, queue_wait_s)
+                    meter.count_tokens(tenant, "cached", cached_tokens)
+                    meter.count_tokens(tenant, "prefill",
+                                       len(request.prompt) - cached_tokens)
                 record = request.record
                 if record is not None:
                     record.queue_ms = queue_wait_s * 1e3
@@ -2535,6 +2624,10 @@ class SlotEngine:
                 if proposed:
                     self.spec_proposed += proposed
                     self.spec_accepted += matched
+                    if self.tenant_meter is not None:
+                        self.tenant_meter.count_tokens(
+                            request.user_key or ANONYMOUS_TENANT,
+                            "spec_accepted", matched)
                     _SPEC_PROPOSED.inc(proposed)
                     # inc(0) still materializes the series: an all-rollback
                     # engine must scrape accepted=0, not an absent family
@@ -2603,6 +2696,9 @@ class SlotEngine:
         request.generated.append(token)
         self.emitted_tokens += 1
         _TOKENS.inc()
+        if self.tenant_meter is not None:
+            self.tenant_meter.count_tokens(
+                request.user_key or ANONYMOUS_TENANT, "decode", 1)
         record = request.record
         if request.first_token_ts is None:
             request.first_token_ts = now
@@ -2692,6 +2788,11 @@ class SlotEngine:
                 record.decode_ms = (request.last_token_ts
                                     - request.first_token_ts) * 1e3
             record.total_ms = (now - request.submitted_ts) * 1e3
+            if self.tenant_meter is not None:
+                # finalize the meter's per-request integrals onto the
+                # ledger row (deviceSeconds / kvByteSeconds)
+                record.device_seconds = request.device_seconds
+                record.kv_byte_seconds = request.kv_byte_seconds
             get_request_ledger().finish(record, outcome,
                                         finished_ts=request.wall(now))
         if request.first_token_ts is not None:
@@ -2823,6 +2924,9 @@ class SlotEngine:
                 "requestsCompleted": self.completed_requests,
                 "tokensEmitted": self.emitted_tokens,
                 "steps": self.steps,
+                "busySlotSeconds": (round(self.busy_slot_seconds, 6)
+                                    if self.tenant_meter is not None
+                                    else None),
                 "ttftP50Ms": ms(self._ttft_hist.quantile(0.5)),
                 "ttftP95Ms": ms(self._ttft_hist.quantile(0.95)),
                 "intertokenP50Ms": ms(self._intertoken_hist.quantile(0.5)),

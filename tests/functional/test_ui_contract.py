@@ -84,6 +84,7 @@ UI_CALLS = {
     ("GET", "/admin/profile/memory"): 'api("/admin/profile/memory")',
     ("GET", "/admin/alerts"): 'api("/admin/alerts")',
     ("GET", "/admin/history"): 'api("/admin/history?series="',
+    ("GET", "/admin/usage"): 'api("/admin/usage")',
     ("GET", "/admin/flightrec"): 'api("/admin/flightrec?limit=40")',
     ("GET", "/admin/flightrec/dumps"): 'api("/admin/flightrec/dumps")',
     ("GET", "/metrics"): 'href="/api/metrics"',
@@ -206,6 +207,23 @@ def test_requests_strip_renders_ledger_fields():
     assert "req.requestId" in source
     assert "req.ttftMs" in source
     assert "req.prefillCompile" in source
+
+
+def test_tenants_strip_renders_usage_fields():
+    """The top-tenants strip (docs/OBSERVABILITY.md "Tenant accounting")
+    must render its share bars from the exact field names
+    ``GET /admin/usage`` exports — ``tenant``/``share``/``deviceSeconds``/
+    ``kvByteSeconds``/``capacityShare`` — and hide itself when accounting
+    is disabled (the endpoint 404s on the ``enabled=false`` rollback)."""
+    source = (STATIC_DIR / "js" / "nodes.js").read_text()
+    assert 'api("/admin/usage")' in source
+    assert "tenant.share" in source
+    assert "tenant.deviceSeconds" in source
+    assert "tenant.kvByteSeconds" in source
+    assert "tenant.capacityShare" in source
+    assert "t.deviceSeconds > 0" in source          # quiet tenants dropped
+    assert 'el.innerHTML = ""; return;' in source   # 404 / disabled -> hidden
+    assert "doc.windowS" in source
 
 
 def test_serving_strip_renders_prefix_cache_badge():

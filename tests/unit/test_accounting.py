@@ -1,0 +1,553 @@
+"""Tenant attribution plane tests (docs/OBSERVABILITY.md "Tenant
+accounting").
+
+Four layers under test:
+
+* the :class:`TenantMeter` container alone — arithmetic, windowed
+  rollups, the top-K + ``other`` bounded-cardinality export view;
+* the metric-export collector on the process registry — the K+1 scrape
+  bound under a 100-distinct-user storm, and the zero-series rollback;
+* the SlotEngine integration on a fake clock — the conservation
+  invariant ``sum(tenant device-seconds) == busy_slot_seconds x
+  num_devices`` asserted EXACTLY (one dt sample read two ways, not two
+  clocks), per-request ledger attribution, queue/token counters, and
+  the zero-recompile contract with the meter on;
+* the reservation plane (UsageLoggingService feed), the dominance alert
+  source, and ``GET /api/admin/usage`` through the real WSGI app
+  including the ``[accounting] enabled=false`` 404 rollback.
+"""
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from tensorhive_tpu.models.transformer import PRESETS, TransformerLM
+from tensorhive_tpu.observability import get_registry, reset_observability
+from tensorhive_tpu.observability.accounting import (
+    ANONYMOUS_TENANT,
+    OVERFLOW_TENANT,
+    TenantMeter,
+    TenantUsage,
+    dominance_signal,
+    get_tenant_meter,
+    set_tenant_meter,
+)
+from tensorhive_tpu.serving import set_engine as set_serving_engine
+from tensorhive_tpu.serving.engine import SlotEngine
+
+needs_devices = pytest.mark.skipif(
+    len(jax.devices()) < 8, reason="needs the 8-device CPU platform"
+)
+
+F32_TINY = dataclasses.replace(PRESETS["tiny"], dtype=jnp.float32,
+                               use_flash=False, remat=False, max_seq_len=128)
+
+
+class FakeClock:
+    def __init__(self, start: float = 1000.0) -> None:
+        self.now = start
+
+    def __call__(self) -> float:
+        return self.now
+
+    def advance(self, seconds: float) -> None:
+        self.now += seconds
+
+
+@pytest.fixture(scope="module")
+def params():
+    return TransformerLM.init(jax.random.PRNGKey(0), F32_TINY)
+
+
+@pytest.fixture(autouse=True)
+def clean_meter():
+    reset_observability()
+    yield
+    set_serving_engine(None)
+    reset_observability()
+
+
+def make_engine(params, clock, meter, **kwargs):
+    kwargs.setdefault("slots", 2)
+    kwargs.setdefault("max_len", 96)
+    kwargs.setdefault("queue_depth", 8)
+    kwargs.setdefault("kv_quant", "off")
+    return SlotEngine(params, F32_TINY, clock=clock, tenant_meter=meter,
+                      **kwargs)
+
+
+def drain_on_clock(engine, clock, dt=0.5):
+    while engine.has_work():
+        clock.advance(dt)
+        engine.step()
+    engine.step()       # one trailing tick meters the final interval
+
+
+# -- the meter alone ---------------------------------------------------------
+
+def test_charge_tick_accumulates_per_tenant():
+    meter = TenantMeter(clock=FakeClock())
+    meter.charge_tick({"a": (2.0, 100.0, 10.0), "b": (1.0, 50.0, 0.0)})
+    meter.charge_tick({"a": (0.5, 25.0, 0.0)})
+    totals = meter.totals()
+    assert totals["a"].device_seconds == 2.5
+    assert totals["a"].kv_byte_seconds == 125.0
+    assert totals["a"].host_kv_byte_seconds == 10.0
+    assert totals["b"].device_seconds == 1.0
+    assert meter.tenants() == ["a", "b"]
+
+
+def test_token_queue_and_reservation_feeds():
+    meter = TenantMeter(clock=FakeClock())
+    meter.count_tokens("a", "prefill", 32)
+    meter.count_tokens("a", "decode", 8)
+    meter.count_tokens("a", "cached", 16)
+    meter.count_tokens("a", "spec_accepted", 4)
+    meter.count_tokens("a", "decode", 0)            # ignored
+    meter.charge_queue("a", 1.25)
+    meter.charge_queue("a", -1.0)                   # ignored
+    meter.charge_reservation("a", 2.0, effective_chip_seconds=1.0)
+    meter.charge_reservation("a", 2.0)              # no duty sample
+    usage = meter.totals()["a"]
+    assert usage.prefill_tokens == 32
+    assert usage.decode_tokens == 8
+    assert usage.cached_tokens == 16
+    assert usage.spec_accepted_tokens == 4
+    assert usage.queue_seconds == 1.25
+    assert usage.reserved_chip_seconds == 4.0
+    assert usage.effective_chip_seconds == 1.0
+
+
+def test_unknown_token_kind_raises():
+    meter = TenantMeter(clock=FakeClock())
+    with pytest.raises(ValueError, match="unknown token kind"):
+        meter.count_tokens("a", "bogus", 1)
+
+
+def test_ctor_validation():
+    with pytest.raises(ValueError):
+        TenantMeter(top_k=0)
+    with pytest.raises(ValueError):
+        TenantMeter(window_s=0)
+
+
+def test_rollup_subtracts_window_baseline():
+    clock = FakeClock(start=0.0)
+    meter = TenantMeter(window_s=100.0, snapshot_interval_s=10.0,
+                        clock=clock)
+    # 1 device-second per 10 s tick for 30 ticks: 300 s of history
+    for _ in range(30):
+        meter.charge_tick({"a": (1.0, 10.0, 0.0)})
+        clock.advance(10.0)
+    lifetime = meter.rollup(window_s=10_000.0)
+    assert lifetime["a"].device_seconds == 30.0     # no baseline that old
+    windowed = meter.rollup(window_s=100.0)
+    # baseline = the snapshot at now-100s (t=200, taken right AFTER that
+    # tick's charge), so the (200, 300] window holds the 9 later ticks
+    assert windowed["a"].device_seconds == pytest.approx(9.0)
+    assert windowed["a"].kv_byte_seconds == pytest.approx(90.0)
+    # a tenant quiet through the whole window drops out of the rollup
+    meter.charge_reservation("quiet", 1.0)
+    clock.advance(200.0)
+    meter.charge_tick({"a": (1.0, 10.0, 0.0)})      # snapshots roll forward
+    assert "quiet" not in meter.rollup(window_s=50.0)
+
+
+def test_export_totals_caps_cardinality_with_overflow():
+    meter = TenantMeter(top_k=4, clock=FakeClock())
+    for index in range(100):
+        meter.charge_tick({f"user{index:03d}": (float(index + 1), 0.0, 0.0)})
+    export = meter.export_totals()
+    assert len(export) == 5                          # K + "other", exactly
+    assert OVERFLOW_TENANT in export
+    # identity kept for the top-K by device-seconds...
+    assert {"user099", "user098", "user097", "user096"} <= set(export)
+    # ...and nothing is lost: the overflow bucket absorbs the long tail
+    assert (sum(u.device_seconds for u in export.values())
+            == sum(u.device_seconds for u in meter.totals().values()))
+
+
+def test_export_totals_has_no_overflow_bucket_without_overflow():
+    meter = TenantMeter(top_k=8, clock=FakeClock())
+    meter.charge_tick({"a": (1.0, 0.0, 0.0), "b": (2.0, 0.0, 0.0)})
+    export = meter.export_totals()
+    assert set(export) == {"a", "b"}
+    assert OVERFLOW_TENANT not in export
+
+
+def test_usage_delta_clamps_at_zero():
+    newer = TenantUsage(device_seconds=1.0)
+    older = TenantUsage(device_seconds=3.0, queue_seconds=1.0)
+    delta = newer.delta(older)
+    assert delta.device_seconds == 0.0
+    assert delta.queue_seconds == 0.0
+
+
+# -- scrape export: K+1 bound + rollback -------------------------------------
+
+def _tenant_children(rendered, family="tpuhive_tenant_device_seconds_total"):
+    return [line for line in rendered.splitlines()
+            if line.startswith(family + "{")]
+
+
+def test_scrape_cardinality_bounded_under_user_storm():
+    meter = TenantMeter(top_k=4, clock=FakeClock())
+    set_tenant_meter(meter)
+    for index in range(100):
+        meter.charge_tick({f"user{index:03d}": (float(index + 1), 5.0, 0.0)})
+        meter.count_tokens(f"user{index:03d}", "decode", 3)
+    rendered = get_registry().render()
+    device_lines = _tenant_children(rendered)
+    assert 0 < len(device_lines) <= 5                # K+1 bound, pinned
+    assert any(f'tenant="{OVERFLOW_TENANT}"' in line
+               for line in device_lines)
+    token_lines = _tenant_children(rendered, "tpuhive_tenant_tokens_total")
+    assert 0 < len(token_lines) <= 5 * 4             # (K+1) x kinds
+
+
+def test_topk_membership_change_reassigns_children():
+    meter = TenantMeter(top_k=1, clock=FakeClock())
+    set_tenant_meter(meter)
+    meter.charge_tick({"a": (10.0, 0.0, 0.0), "b": (1.0, 0.0, 0.0)})
+    lines = _tenant_children(get_registry().render())
+    assert any('tenant="a"' in line for line in lines)
+    assert any(f'tenant="{OVERFLOW_TENANT}"' in line for line in lines)
+    meter.charge_tick({"b": (20.0, 0.0, 0.0)})       # b overtakes a
+    lines = _tenant_children(get_registry().render())
+    assert any('tenant="b"' in line for line in lines)
+    assert not any('tenant="a"' in line for line in lines)  # absorbed
+    # "other" now carries a's lifetime usage
+    other = next(line for line in lines
+                 if f'tenant="{OVERFLOW_TENANT}"' in line)
+    assert float(other.rsplit(" ", 1)[1]) == 10.0
+
+
+def test_disabled_meter_exports_zero_tenant_series():
+    meter = TenantMeter(top_k=4, clock=FakeClock())
+    set_tenant_meter(meter)
+    meter.charge_tick({"a": (1.0, 1.0, 0.0)})
+    assert "tpuhive_tenant_" in get_registry().render()
+    set_tenant_meter(None)
+    # lazily rebuilt from config — force the disabled path
+    from tensorhive_tpu.config import Config, reset_config, set_config
+    cfg = Config()
+    cfg.accounting.enabled = False
+    set_config(cfg)
+    try:
+        assert get_tenant_meter() is None
+        assert "tpuhive_tenant_" not in get_registry().render()
+    finally:
+        reset_config()
+
+
+# -- engine integration: the conservation invariant --------------------------
+
+@needs_devices
+def test_device_second_conservation_is_exact(params):
+    """sum over tenants of device-seconds == busy slot-seconds x mesh
+    devices, with ``==`` and not approx: both sides accumulate from the
+    SAME dt samples (0.5 s here, exactly representable), so any drift is
+    a bookkeeping bug, not float noise."""
+    clock = FakeClock()
+    meter = TenantMeter(clock=clock)
+    engine = make_engine(params, clock, meter)
+    h1 = engine.submit(list(range(3, 11)), max_new_tokens=4, user_key="u1")
+    h2 = engine.submit(list(range(5, 25)), max_new_tokens=6, user_key="u2")
+    drain_on_clock(engine, clock, dt=0.5)
+    assert h1.result(timeout_s=5)["outcome"] == "completed"
+    assert h2.result(timeout_s=5)["outcome"] == "completed"
+
+    totals = meter.totals()
+    attributed = sum(u.device_seconds for u in totals.values())
+    assert engine.busy_slot_seconds > 0
+    assert attributed == engine.busy_slot_seconds * engine.num_devices
+    assert set(totals) == {"u1", "u2"}
+    assert engine.stats()["busySlotSeconds"] == pytest.approx(
+        engine.busy_slot_seconds)
+
+    # the per-request ledger carries the same integrals: summed across
+    # every (finished) request they re-produce the engine totals
+    from tensorhive_tpu.observability import get_request_ledger
+    rows = get_request_ledger().recent()
+    assert sum(row["deviceSeconds"] for row in rows) == pytest.approx(
+        attributed)
+    assert all(row["kvByteSeconds"] >= 0 for row in rows)
+    # ?user= filtering happens in the ledger itself
+    u1_rows = get_request_ledger().recent(user="u1")
+    assert [row["userKey"] for row in u1_rows] == ["u1"]
+
+
+@needs_devices
+def test_kv_byte_seconds_bounded_by_pool_capacity(params):
+    """HBM byte-second attribution can never exceed what the page pool
+    physically holds over the metered interval — the accounting twin of
+    test_tiering's page-conservation invariant."""
+    clock = FakeClock()
+    meter = TenantMeter(clock=clock)
+    engine = make_engine(params, clock, meter)
+    start = clock.now
+    engine.submit(list(range(3, 40)), max_new_tokens=6, user_key="u1")
+    drain_on_clock(engine, clock, dt=0.5)
+    elapsed = clock.now - start
+    kv_total = sum(u.kv_byte_seconds for u in meter.totals().values())
+    pool_bytes = engine.stats()["kvPagesTotal"] * engine._page_hbm_bytes
+    assert 0 < kv_total <= pool_bytes * elapsed
+
+
+@needs_devices
+def test_contiguous_engine_charges_full_slot_footprint(params):
+    """The contiguous (paged=False) rollback charges each busy slot its
+    whole reserved KV footprint — that is what admission costs there."""
+    clock = FakeClock()
+    meter = TenantMeter(clock=clock)
+    engine = make_engine(params, clock, meter, paged=False)
+    engine.submit([1, 2, 3], max_new_tokens=4, user_key="u1")
+    drain_on_clock(engine, clock, dt=0.5)
+    kv_total = sum(u.kv_byte_seconds for u in meter.totals().values())
+    assert kv_total == engine.busy_slot_seconds * engine._slot_kv_bytes
+
+
+@needs_devices
+def test_queue_seconds_and_token_kinds_attributed(params):
+    clock = FakeClock()
+    meter = TenantMeter(clock=clock)
+    engine = make_engine(params, clock, meter, slots=1)
+    prompt = list(range(3, 11))
+    engine.submit(prompt, max_new_tokens=4, user_key="u1")
+    waiting = engine.submit(list(range(30, 42)), max_new_tokens=2,
+                            user_key="u2")
+    clock.advance(2.0)                               # u2 queue-waits >= 2 s
+    drain_on_clock(engine, clock, dt=0.5)
+    assert waiting.result(timeout_s=5)["outcome"] == "completed"
+    totals = meter.totals()
+    assert totals["u2"].queue_seconds >= 2.0
+    # fresh prompts pay full prefill; decode counts the emitted tokens
+    assert totals["u1"].prefill_tokens == len(prompt)
+    assert totals["u1"].decode_tokens == 4
+    assert totals["u2"].decode_tokens == 2
+
+
+@needs_devices
+def test_anonymous_requests_attributed_to_anonymous(params):
+    clock = FakeClock()
+    meter = TenantMeter(clock=clock)
+    engine = make_engine(params, clock, meter)
+    engine.submit([1, 2, 3], max_new_tokens=2)       # no user_key
+    drain_on_clock(engine, clock, dt=0.5)
+    totals = meter.totals()
+    assert ANONYMOUS_TENANT in totals
+    assert totals[ANONYMOUS_TENANT].device_seconds > 0
+
+
+@needs_devices
+def test_zero_recompiles_with_meter_on(params):
+    """Metering is host-side bookkeeping only: after warmup, a metered
+    mixed-length workload must reuse the same executables as ever — the
+    acceptance criterion's zero-new-compile-fingerprints pin."""
+    clock = FakeClock()
+    meter = TenantMeter(clock=clock)
+    engine = make_engine(params, clock, meter, slots=4)
+    lens = (8, 20, 1, 28)
+    engine.warmup(prompt_lens=lens)
+    step_execs = engine.step_executable._cache_size()
+    prefill_execs = engine.prefill_executable._cache_size()
+    handles = []
+    for index, plen in enumerate(lens):
+        prompt = [(3 * index + j) % F32_TINY.vocab_size or 1
+                  for j in range(plen)]
+        handles.append(engine.submit(prompt, max_new_tokens=3,
+                                     user_key=f"u{index}"))
+        clock.advance(0.5)
+        engine.step()
+    drain_on_clock(engine, clock, dt=0.5)
+    assert all(h.result(timeout_s=5)["outcome"] == "completed"
+               for h in handles)
+    assert engine.step_executable._cache_size() == step_execs
+    assert engine.prefill_executable._cache_size() == prefill_execs
+    assert sum(u.device_seconds for u in meter.totals().values()) > 0
+
+
+@needs_devices
+def test_engine_without_meter_keeps_null_fast_path(params):
+    clock = FakeClock()
+    engine = make_engine(params, clock, None)
+    handle = engine.submit([1, 2, 3], max_new_tokens=2, user_key="u1")
+    drain_on_clock(engine, clock, dt=0.5)
+    assert handle.result(timeout_s=5)["outcome"] == "completed"
+    assert engine.busy_slot_seconds == 0.0           # integral never runs
+    assert engine.stats()["busySlotSeconds"] is None
+    from tensorhive_tpu.observability import get_request_ledger
+    assert get_request_ledger().recent()[0]["deviceSeconds"] is None
+
+
+# -- reservation plane --------------------------------------------------------
+
+class _OneChipInfra:
+    def __init__(self, chip):
+        self.chip = chip
+
+    def find_chip(self, uid):
+        return self.chip
+
+
+def test_usage_logging_feeds_reservation_chip_seconds(db, config):
+    from tensorhive_tpu.core.services.usage_logging import (
+        UsageLoggingService,
+    )
+    from tests.fixtures import make_reservation, make_resource, make_user
+
+    user = make_user(username="alice")
+    resource = make_resource()
+    make_reservation(user, resource.uid, start_in_h=0, duration_h=1)
+    meter = TenantMeter(clock=FakeClock())
+    set_tenant_meter(meter)
+    service = UsageLoggingService(config)
+    service.infrastructure_manager = _OneChipInfra(
+        {"duty_cycle_pct": 50.0, "hbm_util_pct": 10.0})
+    service.log_current_usage()
+    service.log_current_usage()
+    usage = meter.totals()["alice"]
+    assert usage.reserved_chip_seconds == 2 * service.interval_s
+    assert usage.effective_chip_seconds == pytest.approx(
+        2 * service.interval_s * 0.5)
+    # chips with no duty estimate charge held time only
+    service.infrastructure_manager = _OneChipInfra({"hbm_util_pct": 5.0})
+    service.log_current_usage()
+    usage = meter.totals()["alice"]
+    assert usage.reserved_chip_seconds == 3 * service.interval_s
+    assert usage.effective_chip_seconds == pytest.approx(
+        2 * service.interval_s * 0.5)
+
+
+def test_reservation_owner_key_survives_deleted_user(db, config):
+    from tensorhive_tpu.core.services.usage_logging import (
+        UsageLoggingService,
+    )
+
+    class _Orphan:
+        user_id = 424242
+
+    assert UsageLoggingService._owner_key(_Orphan()) == "user:424242"
+
+
+# -- dominance alert ----------------------------------------------------------
+
+class _StubEngine:
+    def __init__(self, p95):
+        self.p95 = p95
+
+    def queue_wait_p95_s(self):
+        return self.p95
+
+
+def test_dominance_signal_gates_on_queue_pressure(config):
+    config.generation.queue_wait_slo_s = 1.0
+    meter = TenantMeter(clock=FakeClock())
+    meter.charge_tick({"u1": (9.0, 0.0, 0.0), "u2": (1.0, 0.0, 0.0)})
+    set_tenant_meter(meter)
+    assert dominance_signal() is None                # no engine published
+    set_serving_engine(_StubEngine(p95=0.5))
+    assert dominance_signal() is None                # queue healthy
+    set_serving_engine(_StubEngine(p95=2.0))
+    assert dominance_signal() == pytest.approx(0.9)  # u1 holds 90%
+    set_tenant_meter(TenantMeter(clock=FakeClock()))
+    assert dominance_signal() is None                # nothing attributed
+
+
+def test_dominance_rule_in_default_pack(config):
+    from tensorhive_tpu.observability.alerts import default_rule_pack
+
+    config.accounting.dominance_share = 0.7
+    rules = {rule.name: rule for rule in default_rule_pack()}
+    rule = rules["tenant_dominates_capacity"]
+    assert rule.severity == "warning"
+    assert rule.threshold == pytest.approx(0.7)
+    assert rule.source() is None                     # quiet: no engine
+
+
+# -- GET /api/admin/usage -----------------------------------------------------
+
+@pytest.fixture()
+def api(db, config):
+    from werkzeug.test import Client
+
+    from tensorhive_tpu.api.server import ApiApp
+    from tensorhive_tpu.core.managers.manager import (
+        TpuHiveManager,
+        set_manager,
+    )
+
+    config.api.secret_key = "test-secret"
+    manager = TpuHiveManager(config=config, services=[])
+    set_manager(manager)
+    yield Client(ApiApp(url_prefix="api"))
+    set_manager(None)
+
+
+@pytest.fixture()
+def admin_headers(api, db):
+    from tests.fixtures import make_user
+
+    make_user(username="root1", password="SuperSecret42", admin=True)
+    tokens = api.post("/api/user/login", json={
+        "username": "root1", "password": "SuperSecret42"}).get_json()
+    return {"Authorization": f"Bearer {tokens['accessToken']}"}
+
+
+def test_usage_endpoint_rollup_shares_and_filter(api, admin_headers):
+    meter = TenantMeter(clock=FakeClock())
+    meter.charge_tick({"u1": (3.0, 300.0, 0.0), "u2": (1.0, 100.0, 0.0)})
+    meter.charge_queue("u2", 0.5)
+    meter.charge_reservation("u1", 10.0, effective_chip_seconds=4.0)
+    set_tenant_meter(meter)
+
+    response = api.get("/api/admin/usage", headers=admin_headers)
+    assert response.status_code == 200
+    doc = response.get_json()
+    assert doc["totals"]["deviceSeconds"] == pytest.approx(4.0)
+    assert doc["totals"]["tenantsAttributed"] == 2
+    rows = doc["tenants"]
+    assert [row["tenant"] for row in rows] == ["u1", "u2"]  # by device-s
+    assert sum(row["share"] for row in rows) == pytest.approx(1.0)
+    assert rows[0]["share"] == pytest.approx(0.75)
+    assert rows[0]["reservedChipSeconds"] == pytest.approx(10.0)
+    assert rows[0]["effectiveChipSeconds"] == pytest.approx(4.0)
+    assert rows[1]["queueSeconds"] == pytest.approx(0.5)
+    # no serving engine published: capacity fractions are null, not fake
+    assert rows[0]["capacityShare"] is None
+    assert doc["numDevices"] is None
+
+    filtered = api.get("/api/admin/usage?user=u2",
+                       headers=admin_headers).get_json()
+    assert [row["tenant"] for row in filtered["tenants"]] == ["u2"]
+    assert filtered["totals"]["tenantsAttributed"] == 2  # totals unfiltered
+
+    custom = api.get("/api/admin/usage?window=60",
+                     headers=admin_headers).get_json()
+    assert custom["windowS"] == pytest.approx(60.0)
+    assert api.get("/api/admin/usage?window=-5",
+                   headers=admin_headers).status_code == 422
+
+
+def test_usage_endpoint_404_and_zero_series_when_disabled(
+        api, admin_headers, config):
+    config.accounting.enabled = False
+    set_tenant_meter(None)                           # drop to lazy rebuild
+    response = api.get("/api/admin/usage", headers=admin_headers)
+    assert response.status_code == 404
+    assert "accounting" in response.get_json()["msg"]
+    scrape = api.get("/api/metrics")
+    assert scrape.status_code == 200
+    assert "tpuhive_tenant_" not in scrape.get_data(as_text=True)
+
+
+def test_usage_endpoint_requires_admin(api, db):
+    from tests.fixtures import make_user
+
+    make_user(username="bob", password="SuperSecret42")
+    tokens = api.post("/api/user/login", json={
+        "username": "bob", "password": "SuperSecret42"}).get_json()
+    response = api.get("/api/admin/usage", headers={
+        "Authorization": f"Bearer {tokens['accessToken']}"})
+    assert response.status_code == 403

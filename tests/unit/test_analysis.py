@@ -1154,11 +1154,107 @@ class TestCrossArtifact:
                    for m in messages)
         assert any("[slo] knob 'hidden_slo_knob'" in m for m in messages)
 
+    def test_undocumented_accounting_knob_flagged(self, tmp_path):
+        root = self.build_repo(tmp_path, config_py=textwrap.dedent("""
+            import dataclasses
+
+            @dataclasses.dataclass
+            class GenerationConfig:
+                enabled: bool = False
+                slots: int = 8
+
+            @dataclasses.dataclass
+            class ProfilingConfig:
+                enabled: bool = False
+
+            @dataclasses.dataclass
+            class AccountingConfig:
+                enabled: bool = False
+                hidden_accounting_knob: int = 2
+            """))
+        messages = [f.message for f in self.check(root)]
+        assert len(messages) == 1
+        assert "[accounting] knob 'hidden_accounting_knob'" in messages[0]
+
+    ACCOUNTING_CONFIG = textwrap.dedent("""
+        import dataclasses
+
+        @dataclasses.dataclass
+        class GenerationConfig:
+            enabled: bool = False
+            slots: int = 8
+
+        @dataclasses.dataclass
+        class ProfilingConfig:
+            enabled: bool = False
+
+        @dataclasses.dataclass
+        class AccountingConfig:
+            enabled: bool = False
+            top_k_tenants: int = 8
+        """)
+
+    def test_accounting_knob_table_reverse_checked(self, tmp_path):
+        # the "## Tenant accounting" knob table is checked docs -> code
+        # too: a row naming a field AccountingConfig no longer has fails
+        root = self.build_repo(
+            tmp_path, config_py=self.ACCOUNTING_CONFIG,
+            observability_md=textwrap.dedent("""
+                | Metric | Kind | Where |
+                |---|---|---|
+                | `tpuhive_demo_requests_total` | counter | demo |
+                | `tpuhive_demo_queue_depth` | gauge | demo |
+
+                | Rule | Severity | Signal |
+                |---|---|---|
+                | `demo_down` | critical | demo |
+
+                enabled = false
+
+                ## Tenant accounting
+
+                | Knob | Default | Meaning |
+                |---|---|---|
+                | `enabled` | false | master switch |
+                | `top_k_tenants` | 8 | cardinality bound |
+                | `ghost_knob` | 3 | removed in review |
+                """))
+        findings = self.check(root)
+        assert len(findings) == 1
+        assert "'ghost_knob'" in findings[0].message
+        assert findings[0].path == "docs/OBSERVABILITY.md"
+
+    def test_accounting_knob_table_clean_when_consistent(self, tmp_path):
+        root = self.build_repo(
+            tmp_path, config_py=self.ACCOUNTING_CONFIG,
+            observability_md=textwrap.dedent("""
+                | Metric | Kind | Where |
+                |---|---|---|
+                | `tpuhive_demo_requests_total` | counter | demo |
+                | `tpuhive_demo_queue_depth` | gauge | demo |
+
+                | Rule | Severity | Signal |
+                |---|---|---|
+                | `demo_down` | critical | demo |
+
+                enabled = false
+
+                ## Tenant accounting
+
+                | Knob | Default | Meaning |
+                |---|---|---|
+                | `enabled` | false | master switch |
+                | `top_k_tenants` | 8 | cardinality bound |
+                """))
+        assert self.check(root) == []
+
     def test_live_gate_catches_deleted_endpoint_and_objective_rows(
             self, tmp_path):
         """The delete-a-row proof over the REAL artifacts: copy the repo,
-        delete the history endpoint row and the ttft objective row from
-        docs/OBSERVABILITY.md, and the full gate must exit 1 naming both."""
+        delete the history endpoint row, the ttft objective row, the
+        `top_k_tenants` accounting knob row, a tenant metric row and the
+        usage endpoint row from docs/OBSERVABILITY.md, and the full gate
+        must exit 1 naming all of them."""
         import shutil
 
         files = subprocess.run(
@@ -1172,7 +1268,11 @@ class TestCrossArtifact:
         doc = tmp_path / "docs" / "OBSERVABILITY.md"
         lines = [line for line in doc.read_text().splitlines()
                  if "`GET /api/admin/history`" not in line
-                 and not line.startswith("| `ttft` |")]
+                 and not line.startswith("| `ttft` |")
+                 and not line.startswith("| `top_k_tenants` |")
+                 and not line.startswith(
+                     "| `tpuhive_tenant_device_seconds_total")
+                 and not line.startswith("| `GET /api/admin/usage`")]
         doc.write_text("\n".join(lines) + "\n")
 
         proc = subprocess.run(
@@ -1181,6 +1281,9 @@ class TestCrossArtifact:
         assert proc.returncode == 1, proc.stdout + proc.stderr
         assert "GET /api/admin/history" in proc.stdout
         assert "'ttft'" in proc.stdout
+        assert "'top_k_tenants'" in proc.stdout
+        assert "tpuhive_tenant_device_seconds_total" in proc.stdout
+        assert "GET /api/admin/usage" in proc.stdout
 
 
 # -- satellite CLI surfaces ----------------------------------------------------

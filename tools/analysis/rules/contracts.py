@@ -277,9 +277,9 @@ def doc_rule_rows(text: str) -> List[Tuple[int, str]]:
     return rows
 
 
-def serving_config_rows(text: str) -> List[Tuple[int, str]]:
-    """Key rows of the FIRST table after the ``## Configuration`` heading
-    in docs/SERVING.md (the ``[generation_service]`` knob table)."""
+def section_config_rows(text: str, heading: str) -> List[Tuple[int, str]]:
+    """Key rows of the FIRST table after the given ``## `` heading — the
+    section's config-knob table."""
     rows: List[Tuple[int, str]] = []
     in_section = False
     in_table = False
@@ -287,7 +287,7 @@ def serving_config_rows(text: str) -> List[Tuple[int, str]]:
         if line.startswith("## "):
             if in_table:
                 break
-            in_section = line.strip() == "## Configuration"
+            in_section = line.strip() == heading
             continue
         if not in_section:
             continue
@@ -299,6 +299,12 @@ def serving_config_rows(text: str) -> List[Tuple[int, str]]:
         elif in_table:
             break               # first table ended
     return rows
+
+
+def serving_config_rows(text: str) -> List[Tuple[int, str]]:
+    """Key rows of the FIRST table after the ``## Configuration`` heading
+    in docs/SERVING.md (the ``[generation_service]`` knob table)."""
+    return section_config_rows(text, "## Configuration")
 
 
 class CrossArtifactRule(ProjectRule):
@@ -404,7 +410,8 @@ class CrossArtifactRule(ProjectRule):
             text = observability_doc.read_text()
             for class_name, section in (("ProfilingConfig", "profiling"),
                                         ("HistoryConfig", "history"),
-                                        ("SloConfig", "slo")):
+                                        ("SloConfig", "slo"),
+                                        ("AccountingConfig", "accounting")):
                 for name, lineno in dataclass_fields(tree, class_name):
                     row = re.search(r"\|\s*`" + re.escape(name) + r"`\s*\|",
                                     text)
@@ -417,6 +424,22 @@ class CrossArtifactRule(ProjectRule):
                             f"[{section}] knob {name!r} is not documented "
                             "in docs/OBSERVABILITY.md (neither a table row "
                             "nor the config snippet)"))
+            # reverse direction for the tenant-accounting knob table: a
+            # documented [accounting] row with no AccountingConfig field
+            # is docs drift (same contract the SERVING.md table enforces
+            # for [generation_service])
+            accounting_fields = {
+                name for name, _ in dataclass_fields(tree,
+                                                     "AccountingConfig")}
+            doc_rel = observability_doc.relative_to(root).as_posix()
+            for lineno, key in section_config_rows(text,
+                                                   "## Tenant accounting"):
+                if accounting_fields and key not in accounting_fields:
+                    findings.append(Finding(
+                        self.id, doc_rel, lineno,
+                        f"docs/OBSERVABILITY.md documents [accounting] "
+                        f"knob {key!r} but AccountingConfig has no such "
+                        "field — the docs drifted from config.py"))
         return findings
 
     # -- stats schema vs dashboard ------------------------------------------

@@ -1,0 +1,272 @@
+"""Smoke-test the tenant attribution plane end to end
+(``make usage-smoke``; docs/OBSERVABILITY.md "Tenant accounting").
+
+Boots the real daemon surface — WSGI app over a real socket, a live
+GenerationService pump metering into the singleton :class:`TenantMeter`,
+in-memory DB — then has TWO tenants stream ``POST /api/generate``
+concurrently and proves the accounting contract over HTTP:
+
+1. ``GET /api/admin/usage`` attributes device-seconds to both tenants,
+   the per-tenant ``share`` fractions sum to 1.0 (attribution conserves:
+   every metered busy slot-second lands on exactly one tenant), and the
+   heavier tenant's share is the larger one;
+2. ``?user=`` narrows the usage rollup to one tenant's row, and the
+   same filter on ``GET /api/admin/requests`` isolates that tenant's
+   ledger rows — each carrying the PR 19 ``deviceSeconds`` attribution;
+3. the ``/api/metrics`` scrape stays cardinality-bounded: at most
+   ``top_k_tenants + 1`` ``tpuhive_tenant_device_seconds_total``
+   children no matter who talked to the engine;
+4. the metering hooks add ZERO post-warmup recompiles — the prefill and
+   step executable caches are byte-for-byte the warmup set after all
+   the multi-tenant traffic.
+
+Engines run the f32 tiny config (like the unit suite). Exit 0 = healthy.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import shutil
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+os.environ.setdefault("TPUHIVE_PYTEST", "1")          # DB goes in-memory
+
+PROMPT = [3, 4, 5, 6, 7, 8, 9, 10]
+NEW_TOKENS = 8
+TOP_K = 4
+HEAVY_STREAMS = 3                                     # alice's request count
+
+PROBLEMS = []
+
+
+def check(ok: bool, what: str) -> None:
+    status = "ok" if ok else "FAIL"
+    print(f"usage-smoke: {status}: {what}")
+    if not ok:
+        PROBLEMS.append(what)
+
+
+def request(url: str, body=None, headers=None, method=None):
+    """(status, text, headers) over real HTTP; >=400 is a result."""
+    data = None if body is None else json.dumps(body).encode()
+    req = urllib.request.Request(url, data=data, method=method,
+                                 headers={"Content-Type": "application/json",
+                                          **(headers or {})})
+    try:
+        with urllib.request.urlopen(req, timeout=60) as resp:
+            return resp.status, resp.read().decode(), dict(resp.headers)
+    except urllib.error.HTTPError as exc:
+        return exc.code, exc.read().decode(), dict(exc.headers)
+
+
+def stream_request(base: str, auth: dict, max_new: int):
+    """Stream one generate request; returns the parsed NDJSON lines."""
+    req = urllib.request.Request(
+        f"{base}/generate",
+        data=json.dumps({"promptTokens": PROMPT, "maxNewTokens": max_new,
+                         "temperature": 0}).encode(),
+        headers={"Content-Type": "application/json", **auth})
+    lines = []
+    with urllib.request.urlopen(req, timeout=60) as resp:
+        while True:
+            raw = resp.readline()
+            if not raw:
+                break
+            lines.append(json.loads(raw))
+    return lines
+
+
+def main() -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from tensorhive_tpu.config import Config, set_config
+
+    config_dir = Path("/tmp/tpuhive-usage-smoke")
+    shutil.rmtree(config_dir, ignore_errors=True)
+    config = Config(config_dir=config_dir)
+    config.api.secret_key = "usage-smoke-secret"
+    config.generation.enabled = True
+    config.generation.interval_s = 0.01
+    config.generation.transient_backoff_s = 0.0
+    config.generation.require_restriction = False     # tenants need no
+    config.accounting.enabled = True                  # reservation here
+    config.accounting.top_k_tenants = TOP_K
+    set_config(config)
+
+    from tensorhive_tpu.db.engine import Engine, set_engine as set_db
+    from tensorhive_tpu.db.migrations import ensure_schema
+
+    engine_db = Engine(":memory:")
+    ensure_schema(engine_db)
+    set_db(engine_db)
+
+    from tensorhive_tpu.db.models import User
+
+    admin = User(username="smoke-admin", email="smoke@example.com",
+                 password="SuperSecret42").save()
+    admin.add_role("user")
+    admin.add_role("admin")
+    alice = User(username="smoke-alice", email="alice@example.com",
+                 password="SuperSecret42").save()
+    alice.add_role("user")
+    bob = User(username="smoke-bob", email="bob@example.com",
+               password="SuperSecret42").save()
+    bob.add_role("user")
+    alice_key, bob_key = str(alice.id), str(bob.id)
+
+    from tensorhive_tpu import serving
+    from tensorhive_tpu.core.services.generation import GenerationService
+    from tensorhive_tpu.models.transformer import PRESETS, TransformerLM
+    from tensorhive_tpu.observability.accounting import get_tenant_meter
+    from tensorhive_tpu.serving.engine import SlotEngine
+
+    f32_tiny = dataclasses.replace(PRESETS["tiny"], dtype=jnp.float32,
+                                   use_flash=False, remat=False,
+                                   max_seq_len=128)
+    params = TransformerLM.init(jax.random.PRNGKey(0), f32_tiny)
+
+    print(f"usage-smoke: top_k_tenants={TOP_K} "
+          f"heavy_streams={HEAVY_STREAMS}")
+
+    def factory():
+        engine = SlotEngine(params, f32_tiny, slots=2, max_len=96,
+                            queue_depth=8, kv_quant="off",
+                            tenant_meter=get_tenant_meter())
+        engine.warmup(prompt_lens=(len(PROMPT),))
+        return engine
+
+    generation = GenerationService(config=config, engine=factory(),
+                                   engine_factory=factory)
+    generation.start()
+
+    from tensorhive_tpu.api.server import APIServer
+
+    server = APIServer()
+    server.config.api.url_hostname = "127.0.0.1"
+    server.config.api.url_port = 0                     # ephemeral
+    port = server.start()
+    base = f"http://127.0.0.1:{port}/api"
+    try:
+        def login(username):
+            status, body, _ = request(f"{base}/user/login", body={
+                "username": username, "password": "SuperSecret42"})
+            check(status == 200, f"{username} login over HTTP (got {status})")
+            return {"Authorization":
+                    "Bearer " + json.loads(body)["accessToken"]}
+
+        admin_auth = login("smoke-admin")
+        alice_auth = login("smoke-alice")
+        bob_auth = login("smoke-bob")
+
+        live = serving.get_engine()
+        check(live is not None, "serving engine is up")
+        prefill_cache = live.prefill_executable._cache_size()
+        step_cache = live.step_executable._cache_size()
+
+        # -- 1: two tenants stream concurrently, alice 3x heavier ---------
+        outcomes = []
+
+        def run_stream(auth):
+            lines = stream_request(base, auth, NEW_TOKENS)
+            outcomes.append(lines[-1].get("outcome"))
+
+        threads = [threading.Thread(target=run_stream, args=(alice_auth,))
+                   for _ in range(HEAVY_STREAMS)]
+        threads.append(threading.Thread(target=run_stream, args=(bob_auth,)))
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        check(outcomes.count("completed") == HEAVY_STREAMS + 1,
+              f"all {HEAVY_STREAMS + 1} concurrent streams completed "
+              f"({outcomes})")
+        # one idle pump pass meters the final busy interval
+        time.sleep(0.1)
+
+        status, body, _ = request(f"{base}/admin/usage",
+                                  headers=admin_auth)
+        check(status == 200, f"GET /api/admin/usage answers (got {status})")
+        usage = json.loads(body)
+        rows = {row["tenant"]: row for row in usage["tenants"]}
+        check(alice_key in rows and bob_key in rows,
+              f"both tenants attributed (got {sorted(rows)})")
+        check(all(row["deviceSeconds"] > 0 for row in rows.values()),
+              "both tenants hold positive device-seconds")
+        share_sum = sum(row["share"] for row in usage["tenants"])
+        check(abs(share_sum - 1.0) < 1e-6,
+              f"shares sum to 1.0 — attribution conserves "
+              f"(got {share_sum:.9f})")
+        check(rows[alice_key]["deviceSeconds"] >
+              rows[bob_key]["deviceSeconds"],
+              f"the {HEAVY_STREAMS}-stream tenant out-charges the "
+              f"1-stream tenant "
+              f"(alice={rows[alice_key]['deviceSeconds']:.4f} "
+              f"bob={rows[bob_key]['deviceSeconds']:.4f})")
+        check(rows[alice_key]["prefillTokens"] ==
+              HEAVY_STREAMS * len(PROMPT),
+              f"alice's prefill tokens counted exactly "
+              f"(got {rows[alice_key]['prefillTokens']}, "
+              f"want {HEAVY_STREAMS * len(PROMPT)})")
+
+        # -- 2: ?user= narrows usage AND the request ledger ---------------
+        status, body, _ = request(f"{base}/admin/usage?user={bob_key}",
+                                  headers=admin_auth)
+        narrowed = json.loads(body)
+        check(status == 200 and
+              [row["tenant"] for row in narrowed["tenants"]] == [bob_key],
+              f"?user= keeps exactly bob's usage row "
+              f"(got {[r['tenant'] for r in narrowed.get('tenants', [])]})")
+
+        status, body, _ = request(
+            f"{base}/admin/requests?user={alice_key}", headers=admin_auth)
+        ledger_rows = json.loads(body)["requests"]
+        check(status == 200 and len(ledger_rows) == HEAVY_STREAMS and
+              all(row["userKey"] == alice_key for row in ledger_rows),
+              f"?user= isolates alice's {HEAVY_STREAMS} ledger rows "
+              f"(got {len(ledger_rows)})")
+        check(all(row["deviceSeconds"] > 0 for row in ledger_rows),
+              "every ledger row carries its device-seconds attribution")
+
+        # -- 3: scrape cardinality stays <= K+1 ---------------------------
+        status, scrape, _ = request(f"{base}/metrics")
+        device_lines = [line for line in scrape.splitlines() if
+                        line.startswith("tpuhive_tenant_device_seconds"
+                                        "_total{")]
+        check(status == 200 and
+              2 <= len(device_lines) <= TOP_K + 1,
+              f"tenant device-seconds scrape bounded to K+1={TOP_K + 1} "
+              f"children (got {len(device_lines)})")
+
+        # -- 4: metering added zero post-warmup recompiles ----------------
+        check(live.prefill_executable._cache_size() == prefill_cache and
+              live.step_executable._cache_size() == step_cache,
+              f"zero post-warmup recompiles with the meter on "
+              f"(prefill {prefill_cache}->"
+              f"{live.prefill_executable._cache_size()}, "
+              f"step {step_cache}->{live.step_executable._cache_size()})")
+    finally:
+        server.stop()
+        generation.shutdown()
+        generation.join(timeout=10)
+
+    if PROBLEMS:
+        print(f"usage-smoke: {len(PROBLEMS)} problem(s)", file=sys.stderr)
+        return 1
+    print("usage-smoke: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
