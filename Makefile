@@ -2,7 +2,7 @@
 # mypy + flake8 per .circleci/config.yml:33-38): the dependency-free AST
 # lint + thivelint analyzer always run; mypy/ruff run when installed
 # (absent from this image).
-.PHONY: check lint analysis analysis-fast lockcheck test bench probe metrics-smoke decode-smoke alerts-smoke chaos-smoke serving-smoke serving-mesh-smoke trace-smoke prefix-smoke spec-smoke serving-chaos-smoke quant-smoke history-smoke tier-smoke usage-smoke
+.PHONY: check lint analysis analysis-fast lockcheck test bench probe metrics-smoke decode-smoke alerts-smoke chaos-smoke serving-smoke serving-mesh-smoke trace-smoke prefix-smoke spec-smoke serving-chaos-smoke quant-smoke history-smoke tier-smoke usage-smoke agent-smoke
 
 check: lint analysis
 	@command -v ruff >/dev/null 2>&1 && ruff check . || echo "ruff not installed; skipped (tools/lint.py covered the always-on subset)"
@@ -147,6 +147,14 @@ tier-smoke:
 # <= top_k_tenants+1 tenant children, zero post-warmup recompiles
 usage-smoke:
 	python tools/usage_smoke.py
+
+# host membership plane over a real socket (docs/ROBUSTNESS.md "Host
+# membership & leases"): dynamic agent join -> live with zero SSH
+# round-trips, silence walks suspect -> expired within 3x heartbeat with
+# host_lease_expired firing exactly once, the preempted host's job is
+# reaped without crashing the scheduling tick, re-join restores service
+agent-smoke:
+	python tools/agent_smoke.py
 
 probe:
 	$(MAKE) -C tensorhive_tpu/native

@@ -513,11 +513,36 @@ async function openTracesDialog() {
   dialog.showModal();
 }
 
+/* admin drain/resume for one host (docs/ROBUSTNESS.md "Host membership &
+   leases"): drain = no new work there, running jobs stopped gracefully,
+   reservations kept; resume puts it straight back to work */
+async function toggleHostDrain(host, draining) {
+  const action = draining ? "resume" : "drain";
+  try {
+    const doc = await api("/admin/hosts/" + encodeURIComponent(host) + "/" + action, { json: {} });
+    toast(host + " " + action + "ed: lease " + (doc.lease.effective || doc.lease.state));
+  } catch (e) { toast(e.message, true); }
+}
+
+function leaseBadge(lease) {
+  if (!lease.effective || lease.effective === "live") return "";
+  const agent = lease.source === "agent";
+  const detail = agent
+    ? "membership lease from the host agent (POST /agent/report): seq " +
+      (lease.seq ?? "–") + ", last report " +
+      (lease.age_s != null ? lease.age_s + "s ago" : "never") +
+      " (docs/ROBUSTNESS.md 'Host membership & leases')"
+    : "admin drain: no new work lands here until resumed";
+  return `<div class="badge unsynchronized" style="margin-top:.3rem"
+      title="${esc(detail)}">⏻ lease: ${esc(lease.effective)}</div>`;
+}
+
 function nodeCard(host, node) {
   const cpu = Object.values(node.CPU || {})[0];
   const chips = Object.entries(node.TPU || {});
   const warnings = node.WARNINGS || [];
   const health = node.HEALTH || {};
+  const lease = node.LEASE || {};
   const unhealthy = health.state === "degraded" || health.state === "unreachable";
   const staleFor = health.staleness_s != null
     ? Math.round(health.staleness_s) + "s ago" : "never";
@@ -531,8 +556,14 @@ function nodeCard(host, node) {
           onclick="openHostDialog('${jsArg(host)}')">${esc(host)}</h3>
       <span class="muted">${cpu ? `CPU ${cpu.util_pct ?? "?"}% ·
         RAM ${cpu.mem_used_mib ?? "?"}/${cpu.mem_total_mib ?? "?"} MiB` : "no CPU data"}</span>
+      ${!isAdmin() ? "" : `<button class="ghost" style="margin-left:auto"
+        title="${lease.draining ? "resume: the host takes work again"
+          : "drain: no new work, running jobs stopped gracefully"}"
+        onclick="toggleHostDrain('${jsArg(host)}', ${!!lease.draining})">
+        ${lease.draining ? "Resume" : "Drain"}</button>`}
     </div>
     ${healthBadge}
+    ${leaseBadge(lease)}
     ${warnings.map(w => `<div class="badge unsynchronized" style="margin-top:.3rem"
       title="${esc(w.message || "")}">⚠ ${esc(w.key || "warning")}: ${esc(w.message || "")}</div>`).join("")}
     <div class="grid" style="margin-top:.6rem">${chips.map(([uid, c]) => chipCard(uid, c, host)).join("")

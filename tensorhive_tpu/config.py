@@ -347,6 +347,34 @@ class ProfilingConfig:
 
 
 @dataclasses.dataclass
+class AgentConfig:
+    """Push-based host membership plane (docs/ROBUSTNESS.md "Host membership
+    & leases"; no reference analog — the reference is pull-only). Hosts
+    running the ``tpuhive-agent`` push telemetry + a monotonically-sequenced
+    heartbeat to ``POST /api/agent/report``; the lease state machine in
+    InfrastructureManager (live → suspect → unreachable → deregistered)
+    replaces the SSH fan-out for them. ``token`` is the shared bearer secret
+    agents present; empty token disables the plane (the endpoint answers
+    404, no leases are swept)."""
+    enabled: bool = True
+    token: str = ""                  # shared agent bearer token; "" = plane off
+    heartbeat_interval_s: float = 2.0  # agent-side report cadence
+    suspect_after_s: float = 0.0     # missed-heartbeat bound before a live
+                                     # lease turns suspect; 0 = 2x heartbeat
+    lease_ttl_s: float = 0.0         # lease expiry (suspect -> unreachable,
+                                     # last-known-good retained); 0 = 3x
+                                     # heartbeat
+    deregister_after_s: float = 900.0  # unreachable dwell before the host is
+                                       # deregistered (dynamic members only)
+
+    def effective_suspect_after_s(self) -> float:
+        return self.suspect_after_s or 2.0 * self.heartbeat_interval_s
+
+    def effective_lease_ttl_s(self) -> float:
+        return self.lease_ttl_s or 3.0 * self.heartbeat_interval_s
+
+
+@dataclasses.dataclass
 class SshConfig:
     """Control-plane transport settings (reference: tensorhive/config.py:113-120).
 
@@ -394,6 +422,9 @@ class HostConfig:
     chips: int = 0               # chips attached to THIS worker VM
     slice_name: str = ""         # shared label grouping workers of one slice
     worker_index: int = 0        # index of this worker within its slice
+    agent: bool = False          # host runs the push agent: excluded from
+                                 # the SSH monitoring fan-out, liveness via
+                                 # heartbeat lease (docs/ROBUSTNESS.md)
 
     def __post_init__(self) -> None:
         if not self.address:
@@ -417,6 +448,7 @@ class Config:
     accounting: AccountingConfig = dataclasses.field(default_factory=AccountingConfig)
     slo: SloConfig = dataclasses.field(default_factory=SloConfig)
     profiling: ProfilingConfig = dataclasses.field(default_factory=ProfilingConfig)
+    agent: AgentConfig = dataclasses.field(default_factory=AgentConfig)
     ssh: SshConfig = dataclasses.field(default_factory=SshConfig)
     hosts: Dict[str, HostConfig] = dataclasses.field(default_factory=dict)
 
@@ -469,6 +501,7 @@ _SECTION_MAP = {
     "accounting": "accounting",
     "slo": "slo",
     "profiling": "profiling",
+    "agent": "agent",
     "ssh": "ssh",
 }
 
@@ -650,6 +683,18 @@ enabled = false
 # artifact_dir = "{{config_dir}}/profiles"
 # max_duration_s = 10.0
 # default_duration_s = 1.0
+
+[agent]
+# push-based host membership (docs/ROBUSTNESS.md "Host membership &
+# leases"): hosts running tpuhive-agent report over POST /api/agent/report
+# and carry a heartbeat lease instead of being SSH-polled. The plane is
+# off until a shared bearer token is set.
+enabled = true
+# token = ""               # shared agent bearer secret ("" = plane off)
+# heartbeat_interval_s = 2.0
+# suspect_after_s = 0.0    # 0 = 2x heartbeat_interval_s
+# lease_ttl_s = 0.0        # 0 = 3x heartbeat_interval_s
+# deregister_after_s = 900.0
 
 [ssh]
 timeout_s = 10.0

@@ -5,6 +5,7 @@ importing this package registers everything (the rebuild's analog of the
 reference's RestyResolver scan, api/APIServer.py:31).
 """
 from . import (
+    agent,
     generate,
     group,
     job,
@@ -19,4 +20,4 @@ from . import (
 )
 
 ALL_MODULES = (user, group, resource, nodes, reservation, restriction, schedule,
-               job, task, observability, generate)
+               job, task, observability, generate, agent)

@@ -1,0 +1,135 @@
+"""Agent controller — the push half of the hybrid monitoring plane.
+
+``POST /agent/report`` receives one heartbeat + telemetry report from a
+``tpuhive-agent`` (core/agent.py) and applies it to the membership lease
+state machine (docs/ROBUSTNESS.md "Host membership & leases"). Unlike every
+other write endpoint this one is authenticated by the shared agent bearer
+token from ``[agent] token``, not a user JWT: agents are machines, not
+users, and the token compare is constant-time. While the plane is disabled
+(``[agent] enabled = false`` or an empty token) the endpoint answers 404 —
+same knob-naming pattern as the profiling endpoints.
+
+Idempotence lives in the manager (sequence numbers per incarnation);
+telemetry subtrees are applied only for ``accepted`` reports, so a
+duplicated or replayed report can refresh a lease but never rewrite
+telemetry out of order.
+"""
+from __future__ import annotations
+
+import hmac
+import json
+import logging
+from typing import Any, Dict, Tuple
+
+from ..api.app import RequestContext, route
+from ..api.schema import obj, s
+from ..config import HostConfig, get_config
+from ..core.managers.infrastructure import AGENT_REPORTS
+from ..core.managers.manager import get_manager
+from ..core.monitors.cpu import cpu_subtree
+from ..core.monitors.probe import parse_probe_output
+from ..core.monitors.tpu import chip_subtree, host_warnings
+from ..api.jwt import AuthError
+from ..utils.exceptions import NotFoundError, ValidationError
+
+log = logging.getLogger(__name__)
+
+#: host fields an agent may self-describe on dynamic join (everything else —
+#: notably ``backend``/``user``/``port`` — stays operator-controlled)
+_JOINABLE_HOST_FIELDS = ("address", "accelerator_type", "topology", "chips",
+                         "slice_name", "worker_index")
+
+#: hostname -> (total, idle) jiffies from the previous accepted report; the
+#: push-path analog of CpuMonitor._prev (util is a cross-report delta)
+_prev_cpu: Dict[str, Tuple[int, int]] = {}
+
+AGENT_REPORT_BODY = obj(
+    required=["v", "hostname", "incarnation", "seq", "probe"],
+    v=s("integer"),
+    hostname=s("string"),
+    incarnation=s("string"),
+    seq=s("integer"),
+    sentTs=s("number"),
+    sent_ts=s("number"),
+    probe={"type": "object", "additionalProperties": True},
+    host={"type": "object", "additionalProperties": True},
+)
+
+
+def _agent_config():
+    """404 while the membership plane is off — the response names the knob,
+    like the profiling endpoints do."""
+    config = get_config().agent
+    if not config.enabled or not config.token:
+        raise NotFoundError(
+            "agent membership plane disabled — set [agent] enabled = true "
+            "and a shared token in config.toml")
+    return config
+
+
+def _check_token(context: RequestContext, config) -> None:
+    header = context.request.headers.get("Authorization", "")
+    presented = header[len("Bearer "):] if header.startswith("Bearer ") else ""
+    if not presented or not hmac.compare_digest(presented, config.token):
+        # bounded cardinality: unauthenticated reports may carry arbitrary
+        # hostnames, so the bad_token outcome is counted against "unknown"
+        AGENT_REPORTS.labels(host="unknown", outcome="bad_token").inc()
+        raise AuthError("invalid agent token")
+
+
+def _register_dynamic_host(hostname: str, host_info: Dict[str, Any]) -> None:
+    """First report from an unconfigured host = dynamic join: materialize a
+    HostConfig (agent-enabled, so the SSH fan-out never targets it) from the
+    agent's self-description."""
+    manager = get_manager()
+    if hostname in manager.config.hosts:
+        return
+    fields = {key: host_info[key] for key in _JOINABLE_HOST_FIELDS
+              if key in host_info}
+    host = HostConfig(name=hostname, agent=True, **fields)
+    manager.transport_manager.add_host(host)
+    log.info("host %s joined dynamically via agent report (%s)",
+             hostname, host.accelerator_type or "no accelerator metadata")
+
+
+@route("/agent/report", ["POST"], auth=None,
+       summary="Agent heartbeat + telemetry report (agent-token auth)",
+       tag="agent", body=AGENT_REPORT_BODY,
+       responses={200: obj(required=["outcome", "lease"],
+                           outcome=s("string"),
+                           lease={"type": "object",
+                                  "additionalProperties": True})})
+def post_agent_report(context: RequestContext):
+    config = _agent_config()
+    _check_token(context, config)
+    body = context.json()
+    if body["v"] != 1:
+        raise ValidationError(f"unsupported agent wire version {body['v']!r}")
+    hostname = body["hostname"]
+    if not hostname:
+        raise ValidationError("hostname must be non-empty")
+    manager = get_manager()
+    infra = manager.infrastructure_manager
+
+    # lease first: even a report whose telemetry fails to parse is a
+    # heartbeat (the agent process is alive on that host)
+    try:
+        sample = parse_probe_output(json.dumps(body["probe"]))
+    except Exception as exc:
+        raise ValidationError(f"unparseable probe document: {exc}")
+
+    outcome = infra.agent_report(hostname, body["incarnation"],
+                                 int(body["seq"]))
+    if outcome == "accepted":
+        _register_dynamic_host(hostname, body.get("host") or {})
+        host_cfg = manager.config.hosts.get(hostname)
+        infra.update_subtree(hostname, "TPU",
+                             chip_subtree(hostname, sample, host_cfg))
+        infra.update_subtree(hostname, "WARNINGS",
+                             host_warnings(hostname, sample))
+        prev = _prev_cpu.get(hostname)
+        if sample.cpu_total is not None and sample.cpu_idle is not None:
+            _prev_cpu[hostname] = (sample.cpu_total, sample.cpu_idle)
+        infra.update_subtree(hostname, "CPU",
+                             cpu_subtree(hostname, sample, prev))
+    return {"outcome": outcome, "lease": infra.host_lease(hostname)}

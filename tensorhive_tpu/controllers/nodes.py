@@ -127,6 +127,40 @@ def get_cpu_metrics(context: RequestContext, hostname: str):
     return node.get("CPU", {})
 
 
+_LEASE_RESPONSE = obj(
+    required=["host", "lease"],
+    host=s("string"),
+    lease={"type": "object", "additionalProperties": True})
+
+
+@route("/admin/hosts/<hostname>/drain", ["POST"], auth="admin",
+       summary="Drain a host: no new work, running jobs stopped gracefully",
+       tag="nodes", responses={200: _LEASE_RESPONSE})
+def drain_host(context: RequestContext, hostname: str):
+    """Admin drain (docs/ROBUSTNESS.md "Host membership & leases"): the
+    host leaves `_eligible_hosts_resolver`, the scheduler spawns nothing
+    new there and stops its running jobs via stop_with_grace; reservations
+    stay intact so resume puts the host straight back to work."""
+    try:
+        lease = get_manager().infrastructure_manager.drain_host(hostname)
+    except KeyError:
+        raise NotFoundError(f"unknown host {hostname!r}")
+    log.info("host %s draining (admin request)", hostname)
+    return {"host": hostname, "lease": lease}
+
+
+@route("/admin/hosts/<hostname>/resume", ["POST"], auth="admin",
+       summary="Resume a drained host", tag="nodes",
+       responses={200: _LEASE_RESPONSE})
+def resume_host(context: RequestContext, hostname: str):
+    try:
+        lease = get_manager().infrastructure_manager.resume_host(hostname)
+    except KeyError:
+        raise NotFoundError(f"unknown host {hostname!r}")
+    log.info("host %s resumed (admin request)", hostname)
+    return {"host": hostname, "lease": lease}
+
+
 @route("/admin/services", ["GET"], auth="admin",
        summary="Daemon service health (tick latency, liveness)", tag="nodes",
        responses={200: arr(obj(

@@ -1,0 +1,225 @@
+"""tpuhive-agent: push-based host membership + telemetry.
+
+The reference (and PR 1-19 of this rebuild) is pull-only: MonitoringService
+fans an SSH probe out to every configured host each 2 s tick — O(hosts)
+round-trips, membership frozen at config time, and a silent host
+indistinguishable from a slow one until the breaker trips. Following
+JIRIAF's virtual-kubelet model (PAPERS.md), this agent inverts the
+direction for hosts that run it: the host itself executes the SAME schema-v1
+probe (monitors/probe.py) locally each heartbeat interval and POSTs the
+document plus a monotonically-sequenced heartbeat to
+``POST /api/agent/report`` (token-authed). The server side keeps a lease per
+host (InfrastructureManager.agent_report/sweep_leases,
+docs/ROBUSTNESS.md "Host membership & leases"); missed heartbeats walk
+``live → suspect → unreachable → deregistered`` without a single SSH
+round-trip.
+
+Wire format (version 1)::
+
+    {"v": 1,
+     "hostname": "tpu-vm-3",
+     "incarnation": "9f2c...",     # fresh per agent process: restarting the
+                                   # agent resets the sequence space
+     "seq": 42,                    # strictly monotonic within an incarnation
+     "sent_ts": 1699999999.2,      # agent clock (informational only — the
+                                   # server measures leases on ITS clock, so
+                                   # agent clock skew cannot expire a lease)
+     "probe": {...},               # one schema-v1 probe document
+     "host": {"accelerator_type": ..., "chips": ..., ...}}  # optional
+                                   # self-description for dynamic first join
+
+The agent is dependency-free (stdlib urllib) so it can run on a bare TPU VM
+from a single file. Everything nondeterministic is injectable — clock,
+probe collection, transport — and a :class:`FaultPlan` from
+``core/transport/fake.py`` can silence/duplicate/skew reports, which is how
+membership churn becomes deterministic in CI (tools/agent_smoke.py).
+"""
+from __future__ import annotations
+
+import argparse
+import contextlib
+import io
+import json
+import logging
+import time
+import urllib.error
+import urllib.request
+import uuid
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from .monitors.probe import PYTHON_PROBE_SOURCE
+
+log = logging.getLogger(__name__)
+
+AGENT_WIRE_VERSION = 1
+
+
+def collect_local_probe() -> Dict[str, Any]:
+    """Run the inline python probe in-process and return the raw schema-v1
+    document. In-process (exec + captured stdout) rather than a subprocess:
+    the agent IS the python interpreter on the host, so a fork per heartbeat
+    would only add latency and an OOM-kill surface."""
+    buffer = io.StringIO()
+    with contextlib.redirect_stdout(buffer):
+        exec(compile(PYTHON_PROBE_SOURCE, "<tpuhive-probe>", "exec"), {})  # noqa: S102
+    return json.loads(buffer.getvalue().strip().splitlines()[-1])
+
+
+def _default_post(url: str, payload: bytes, token: str,
+                  timeout_s: float) -> Tuple[int, Dict[str, Any]]:
+    request = urllib.request.Request(
+        url, data=payload, method="POST",
+        headers={"Content-Type": "application/json",
+                 "Authorization": f"Bearer {token}"})
+    try:
+        with urllib.request.urlopen(request, timeout=timeout_s) as response:
+            body = response.read().decode("utf-8", errors="replace")
+            return response.status, _safe_json(body)
+    except urllib.error.HTTPError as exc:
+        body = exc.read().decode("utf-8", errors="replace")
+        return exc.code, _safe_json(body)
+
+
+def _safe_json(body: str) -> Dict[str, Any]:
+    try:
+        doc = json.loads(body)
+        return doc if isinstance(doc, dict) else {}
+    except ValueError:
+        return {}
+
+
+class HostAgent:
+    """One agent loop for one host. Sequence numbers are strictly monotonic
+    within an ``incarnation``; a process restart mints a new incarnation, so
+    the server's idempotence window resets cleanly on re-join."""
+
+    def __init__(
+        self,
+        hostname: str,
+        server_url: str,
+        token: str,
+        interval_s: float = 2.0,
+        host_info: Optional[Dict[str, Any]] = None,
+        collect: Optional[Callable[[], Dict[str, Any]]] = None,
+        clock: Optional[Callable[[], float]] = None,
+        post: Optional[Callable[..., Tuple[int, Dict[str, Any]]]] = None,
+        fault_plan: Optional[Any] = None,
+        incarnation: Optional[str] = None,
+        timeout_s: float = 5.0,
+    ) -> None:
+        self.hostname = hostname
+        self.server_url = server_url.rstrip("/")
+        self.token = token
+        self.interval_s = interval_s
+        self.host_info = host_info or {}
+        self._collect = collect or collect_local_probe
+        self._clock = clock or time.time
+        self._post = post or _default_post
+        self._fault_plan = fault_plan
+        self.incarnation = incarnation or uuid.uuid4().hex
+        self.timeout_s = timeout_s
+        self.seq = 0
+        self.reports_sent = 0
+        self.reports_suppressed = 0
+        self._stop = False
+
+    # ------------------------------------------------------------------
+    def build_report(self) -> Dict[str, Any]:
+        self.seq += 1
+        sent_ts = self._clock()
+        if self._fault_plan is not None:
+            # clock_skew_s only shifts the agent's self-reported stamp: the
+            # lease is measured on the SERVER clock, and the smoke/tests pin
+            # that a skewed agent cannot expire (or immortalize) its lease
+            sent_ts += getattr(self._fault_plan, "clock_skew_s", 0.0)
+        report = {
+            "v": AGENT_WIRE_VERSION,
+            "hostname": self.hostname,
+            "incarnation": self.incarnation,
+            "seq": self.seq,
+            "sent_ts": sent_ts,
+            "probe": self._collect(),
+        }
+        if self.host_info:
+            report["host"] = dict(self.host_info)
+        return report
+
+    def report_once(self) -> Optional[Tuple[int, Dict[str, Any]]]:
+        """Collect + send one report; returns (status, response) or None
+        when the fault plan silenced this heartbeat. Duplicate-delivery
+        faults send the SAME payload twice — the at-least-once case the
+        server's sequence idempotence must absorb."""
+        sends = 1
+        if self._fault_plan is not None:
+            event = self._fault_plan.agent_event()
+            if event == "silence":
+                self.reports_suppressed += 1
+                return None
+            if event == "duplicate":
+                sends = 2
+        payload = json.dumps(self.build_report()).encode()
+        url = f"{self.server_url}/agent/report"
+        outcome: Optional[Tuple[int, Dict[str, Any]]] = None
+        for _ in range(sends):
+            try:
+                outcome = self._post(url, payload, self.token, self.timeout_s)
+            except (urllib.error.URLError, OSError, TimeoutError) as exc:
+                # server briefly away: keep heartbeating — the lease plane
+                # is exactly the machinery that tolerates missed reports
+                log.warning("agent report to %s failed: %s", url, exc)
+                outcome = None
+            else:
+                self.reports_sent += 1
+        return outcome
+
+    # ------------------------------------------------------------------
+    def stop(self) -> None:
+        self._stop = True
+
+    def run(self, max_reports: Optional[int] = None,
+            sleep: Optional[Callable[[float], None]] = None) -> None:
+        sleep = sleep or time.sleep
+        sent = 0
+        while not self._stop:
+            self.report_once()
+            sent += 1
+            if max_reports is not None and sent >= max_reports:
+                return
+            sleep(self.interval_s)
+
+
+def main(argv: Optional[list] = None) -> int:
+    import socket
+
+    parser = argparse.ArgumentParser(
+        description="tpuhive host agent: push telemetry + heartbeat lease")
+    parser.add_argument("--server", required=True,
+                        help="API base URL, e.g. http://controller:1111/api")
+    parser.add_argument("--token", required=True, help="shared agent token")
+    parser.add_argument("--hostname", default=socket.gethostname())
+    parser.add_argument("--interval-s", type=float, default=2.0)
+    parser.add_argument("--accelerator-type", default="",
+                        help="self-described accelerator type for dynamic join")
+    parser.add_argument("--chips", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(levelname)s %(message)s")
+    host_info: Dict[str, Any] = {}
+    if args.accelerator_type:
+        host_info["accelerator_type"] = args.accelerator_type
+    if args.chips:
+        host_info["chips"] = args.chips
+    agent = HostAgent(args.hostname, args.server, args.token,
+                      interval_s=args.interval_s, host_info=host_info)
+    log.info("tpuhive-agent reporting %s -> %s every %.1fs",
+             args.hostname, args.server, args.interval_s)
+    try:
+        agent.run()
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

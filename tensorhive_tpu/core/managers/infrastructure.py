@@ -28,6 +28,7 @@ import copy
 import time
 from typing import Dict, List, Optional
 
+from ...observability import get_registry
 from ...utils.threading import RWLock
 
 #: host health states, surfaced in every snapshot under the ``HEALTH`` key:
@@ -41,6 +42,38 @@ from ...utils.threading import RWLock
 #: both truths: the data AND how stale it is.
 HOST_UNKNOWN, HOST_OK, HOST_DEGRADED, HOST_UNREACHABLE = (
     "unknown", "ok", "degraded", "unreachable")
+
+#: membership lease states (docs/ROBUSTNESS.md "Host membership & leases").
+#: Agent-enabled hosts push sequenced heartbeats; missed heartbeats walk the
+#: lease ``live → suspect → unreachable → deregistered``. ``draining`` is an
+#: admin-set overlay, not a lease state: a draining host keeps heartbeating
+#: (stays ``live``) but takes no new work. Statically-configured hosts hold a
+#: permanent ``live`` lease (their liveness is the PR 5 probe/health plane).
+LEASE_LIVE, LEASE_SUSPECT, LEASE_UNREACHABLE, LEASE_DEREGISTERED = (
+    "live", "suspect", "unreachable", "deregistered")
+LEASE_DRAINING = "draining"  # effective-state label for the overlay
+
+#: gauge encoding for ``tpuhive_host_lease_state{host}`` — draining (4) is
+#: reported only while the underlying lease is live; a suspect/unreachable
+#: draining host exports the more severe lease state
+LEASE_STATE_VALUES = {
+    LEASE_LIVE: 0, LEASE_SUSPECT: 1, LEASE_UNREACHABLE: 2,
+    LEASE_DEREGISTERED: 3, LEASE_DRAINING: 4,
+}
+
+_LEASE_STATE = get_registry().gauge(
+    "tpuhive_host_lease_state",
+    "Membership lease state per host: 0=live 1=suspect 2=unreachable "
+    "3=deregistered 4=draining (docs/ROBUSTNESS.md).",
+    labels=("host",))
+
+#: shared with controllers/agent.py, which stamps the ``bad_token`` outcome
+#: before the report ever reaches the manager
+AGENT_REPORTS = get_registry().counter(
+    "tpuhive_agent_reports_total",
+    "Agent membership reports by outcome "
+    "(accepted/duplicate/out_of_order/bad_token).",
+    labels=("host", "outcome"))
 
 #: executable basenames never treated as foreign/intruding (reference
 #: InfrastructureManager.ignored_processes: Xorg and friends; the TPU
@@ -72,12 +105,25 @@ class InfrastructureManager:
         #: hostname -> {state, last_seen_ts, consecutive_failures, last_error}
         self._health: Dict[str, Dict] = {
             name: self._fresh_health() for name in (hostnames or [])}
+        #: hostname -> membership lease record; static members hold a
+        #: permanent live lease (never swept), agent members are swept by
+        #: :meth:`sweep_leases`. Deregistered hosts keep a tombstone here
+        #: (so replayed reports stay detectable) but vanish from ``_infra``.
+        now = time.time()
+        self._leases: Dict[str, Dict] = {
+            name: self._fresh_lease("static", now) for name in (hostnames or [])}
         self.ignored_processes: List[str] = list(DEFAULT_IGNORED_PROCESSES)
 
     @staticmethod
     def _fresh_health() -> Dict:
         return {"state": HOST_UNKNOWN, "last_seen_ts": None,
                 "consecutive_failures": 0, "last_error": ""}
+
+    @staticmethod
+    def _fresh_lease(source: str, now: float) -> Dict:
+        return {"state": LEASE_LIVE, "draining": False, "source": source,
+                "incarnation": "", "seq": -1, "last_report_ts": now,
+                "registered_ts": now}
 
     # -- write path (monitors) ---------------------------------------------
     def update_subtree(self, hostname: str, key: str, subtree: Dict) -> None:
@@ -120,6 +166,166 @@ class InfrastructureManager:
         ignored — health is per host, not per subtree)."""
         self.record_probe_failure(hostname)
 
+    # -- membership lease plane (docs/ROBUSTNESS.md "Host membership &
+    # leases") --------------------------------------------------------------
+    def agent_report(self, hostname: str, incarnation: str, seq: int,
+                     now: Optional[float] = None) -> str:
+        """Apply one agent heartbeat; returns the outcome
+        (``accepted``/``duplicate``/``out_of_order``).
+
+        Idempotence contract: within one agent ``incarnation`` the sequence
+        number is strictly monotonic — a repeat of the last seq is a
+        ``duplicate`` (still counts as a heartbeat: at-least-once delivery
+        must not kill a lease), anything older is ``out_of_order`` and
+        changes nothing. A NEW incarnation resets the sequence space, so an
+        agent restart or a re-join after deregistration starts clean with
+        zero stale-sequence carryover. Acceptance is liveness evidence for
+        the PR 5 health plane too (the SSH fan-out never probes this host)."""
+        now = time.time() if now is None else now
+        with self._lock.write():
+            lease = self._leases.get(hostname)
+            if lease is None or lease["source"] != "agent":
+                draining = bool(lease and lease["draining"])
+                lease = self._fresh_lease("agent", now)
+                lease.update(draining=draining, incarnation=incarnation,
+                             seq=seq, last_report_ts=now)
+                self._leases[hostname] = lease
+                outcome = "accepted"
+            elif (lease["state"] == LEASE_DEREGISTERED
+                  or incarnation != lease["incarnation"]):
+                lease.update(incarnation=incarnation, seq=seq,
+                             state=LEASE_LIVE, last_report_ts=now)
+                outcome = "accepted"
+            elif seq == lease["seq"]:
+                lease["last_report_ts"] = now
+                outcome = "duplicate"
+            elif seq < lease["seq"]:
+                outcome = "out_of_order"
+            else:
+                lease.update(seq=seq, state=LEASE_LIVE, last_report_ts=now)
+                outcome = "accepted"
+            if outcome == "accepted":
+                self._infra.setdefault(hostname, {})
+                health = self._health.setdefault(hostname, self._fresh_health())
+                health.update(state=HOST_OK, last_seen_ts=now,
+                              consecutive_failures=0, last_error="")
+            self._export_lease_gauge(hostname, lease)
+            AGENT_REPORTS.labels(host=hostname, outcome=outcome).inc()
+            return outcome
+
+    def sweep_leases(self, now: Optional[float] = None,
+                     suspect_after_s: float = 4.0,
+                     lease_ttl_s: float = 6.0,
+                     deregister_after_s: float = 900.0) -> Dict[str, str]:
+        """Walk every agent lease against ``now`` and apply transitions;
+        returns ``{hostname: new_state}`` for hosts that changed. All ages
+        are measured from the last accepted/duplicate report. Transitions
+        mirror into the health plane so the existing protection/eligibility
+        gates see them (suspect → degraded, expired → unreachable with the
+        last-known-good snapshot retained); deregistration removes the host
+        from snapshots entirely, leaving only the lease tombstone."""
+        now = time.time() if now is None else now
+        transitions: Dict[str, str] = {}
+        with self._lock.write():
+            for hostname, lease in list(self._leases.items()):
+                if lease["source"] != "agent" or lease["state"] == LEASE_DEREGISTERED:
+                    continue
+                age = now - lease["last_report_ts"]
+                if age >= deregister_after_s:
+                    new_state = LEASE_DEREGISTERED
+                elif age >= lease_ttl_s:
+                    new_state = LEASE_UNREACHABLE
+                elif age >= suspect_after_s:
+                    new_state = LEASE_SUSPECT
+                else:
+                    new_state = LEASE_LIVE
+                if new_state != lease["state"]:
+                    lease["state"] = new_state
+                    transitions[hostname] = new_state
+                    if new_state == LEASE_DEREGISTERED:
+                        self._infra.pop(hostname, None)
+                        self._health.pop(hostname, None)
+                    else:
+                        health = self._health.setdefault(
+                            hostname, self._fresh_health())
+                        if new_state == LEASE_SUSPECT:
+                            health["state"] = HOST_DEGRADED
+                            health["last_error"] = (
+                                f"heartbeat missed for {age:.1f}s")
+                        elif new_state == LEASE_UNREACHABLE:
+                            health["state"] = HOST_UNREACHABLE
+                            health["last_error"] = (
+                                f"lease expired ({age:.1f}s since last report)")
+                        else:  # recovered without a report in between
+                            health["state"] = HOST_OK
+                self._export_lease_gauge(hostname, lease)
+        return transitions
+
+    def drain_host(self, hostname: str) -> Dict:
+        """Admin drain: the host takes no new work (scheduler, protection and
+        eligibility all honor it); running jobs are stopped gracefully by
+        JobSchedulingService. Raises ``KeyError`` for unknown hosts."""
+        return self._set_draining(hostname, True)
+
+    def resume_host(self, hostname: str) -> Dict:
+        return self._set_draining(hostname, False)
+
+    def _set_draining(self, hostname: str, draining: bool) -> Dict:
+        with self._lock.write():
+            if hostname not in self._leases and hostname not in self._infra:
+                raise KeyError(hostname)
+            lease = self._leases.get(hostname)
+            if lease is None:
+                lease = self._fresh_lease("static", time.time())
+                self._leases[hostname] = lease
+            lease["draining"] = draining
+            self._export_lease_gauge(hostname, lease)
+            return self._lease_view(hostname)
+
+    def host_draining(self, hostname: str) -> bool:
+        with self._lock.read():
+            lease = self._leases.get(hostname)
+            return bool(lease and lease["draining"])
+
+    def host_lease(self, hostname: str, now: Optional[float] = None) -> Dict:
+        with self._lock.read():
+            return self._lease_view(hostname, now)
+
+    def host_leases(self, now: Optional[float] = None) -> Dict[str, Dict]:
+        """{hostname: computed LEASE entry} over every known host, including
+        deregistered tombstones (metrics/readyz stay honest about them)."""
+        with self._lock.read():
+            names = set(self._infra) | set(self._leases)
+            return {name: self._lease_view(name, now) for name in sorted(names)}
+
+    def _lease_view(self, hostname: str, now: Optional[float] = None) -> Dict:
+        """Computed LEASE entry for one host; caller holds a lock."""
+        lease = self._leases.get(hostname)
+        if lease is None:
+            return {"state": LEASE_LIVE, "effective": LEASE_LIVE,
+                    "draining": False, "source": "static", "incarnation": "",
+                    "seq": None, "last_report_ts": None, "age_s": None}
+        effective = (LEASE_DRAINING
+                     if lease["draining"] and lease["state"] == LEASE_LIVE
+                     else lease["state"])
+        age = None
+        if lease["source"] == "agent":
+            age = round((now or time.time()) - lease["last_report_ts"], 1)
+        return {"state": lease["state"], "effective": effective,
+                "draining": lease["draining"], "source": lease["source"],
+                "incarnation": lease["incarnation"],
+                "seq": lease["seq"] if lease["seq"] >= 0 else None,
+                "last_report_ts": (lease["last_report_ts"]
+                                   if lease["source"] == "agent" else None),
+                "age_s": age}
+
+    @staticmethod
+    def _export_lease_gauge(hostname: str, lease: Dict) -> None:
+        state = (LEASE_DRAINING
+                 if lease["draining"] and lease["state"] == LEASE_LIVE
+                 else lease["state"])
+        _LEASE_STATE.labels(host=hostname).set(LEASE_STATE_VALUES[state])
+
     # -- read path ----------------------------------------------------------
     def _health_view(self, hostname: str, now: Optional[float] = None) -> Dict:
         """Computed HEALTH entry for one host; caller holds the read lock."""
@@ -154,12 +360,14 @@ class InfrastructureManager:
             snapshot = copy.deepcopy(self._infra)
             for hostname, node in snapshot.items():
                 node["HEALTH"] = self._health_view(hostname, now)
+                node["LEASE"] = self._lease_view(hostname, now)
             return snapshot
 
     def node(self, hostname: str) -> Dict:
         with self._lock.read():
             node = copy.deepcopy(self._infra.get(hostname, {}))
             node["HEALTH"] = self._health_view(hostname)
+            node["LEASE"] = self._lease_view(hostname)
             return node
 
     @property
@@ -187,9 +395,13 @@ class InfrastructureManager:
         """Reference InfrastructureManager.all_nodes_with_gpu_processes:63 —
         but only hosts with FRESH telemetry: now that last-known-good data is
         retained for degraded/unreachable hosts, the protection path must not
-        act (kill, email) on a process list that may be minutes dead."""
+        act (kill, email) on a process list that may be minutes dead.
+        Draining hosts are excluded too: their jobs are being stopped
+        gracefully by the scheduler, so protection actions would race the
+        drain."""
         return {host: self.node_tpu_processes(host) for host in self.hostnames
-                if self.host_state(host) not in (HOST_DEGRADED, HOST_UNREACHABLE)}
+                if self.host_state(host) not in (HOST_DEGRADED, HOST_UNREACHABLE)
+                and not self.host_draining(host)}
 
     def find_chip(self, uid: str) -> Optional[Dict]:
         """Locate a chip's metrics dict by uid across all hosts."""

@@ -61,25 +61,34 @@ class CpuMonitor(Monitor):
         return collect_probe_samples(transports, self._command)
 
     def _cpu_subtree(self, hostname: str, sample: ProbeSample) -> Dict[str, Dict]:
-        util_pct = None
+        prev = self._prev.get(hostname)
         if sample.cpu_total is not None and sample.cpu_idle is not None:
-            prev = self._prev.get(hostname)
             self._prev[hostname] = (sample.cpu_total, sample.cpu_idle)
-            if prev is not None:
-                d_total = sample.cpu_total - prev[0]
-                d_idle = sample.cpu_idle - prev[1]
-                if d_total > 0:
-                    util_pct = round(100.0 * (d_total - d_idle) / d_total, 1)
-        mem_total_mib = sample.mem_total_kb // 1024
-        mem_used_mib = max(0, (sample.mem_total_kb - sample.mem_avail_kb) // 1024)
-        return {
-            f"CPU_{hostname}": {
-                "name": f"CPU {hostname}",
-                "ncpu": sample.ncpu,
-                "util_pct": util_pct,
-                "mem_total_mib": mem_total_mib,
-                "mem_used_mib": mem_used_mib,
-                "mem_util_pct": round(100.0 * mem_used_mib / mem_total_mib, 1)
-                if mem_total_mib else None,
-            }
+        return cpu_subtree(hostname, sample, prev)
+
+
+def cpu_subtree(hostname: str, sample: ProbeSample,
+                prev: Optional[Tuple[int, int]] = None) -> Dict[str, Dict]:
+    """Build the per-host CPU subtree from one parsed probe sample; the
+    caller supplies the previous tick's ``(total, idle)`` jiffies (util is a
+    delta). Module-level because the agent push path (controllers/agent.py)
+    builds the same subtree from reported probe documents."""
+    util_pct = None
+    if sample.cpu_total is not None and sample.cpu_idle is not None and prev is not None:
+        d_total = sample.cpu_total - prev[0]
+        d_idle = sample.cpu_idle - prev[1]
+        if d_total > 0:
+            util_pct = round(100.0 * (d_total - d_idle) / d_total, 1)
+    mem_total_mib = sample.mem_total_kb // 1024
+    mem_used_mib = max(0, (sample.mem_total_kb - sample.mem_avail_kb) // 1024)
+    return {
+        f"CPU_{hostname}": {
+            "name": f"CPU {hostname}",
+            "ncpu": sample.ncpu,
+            "util_pct": util_pct,
+            "mem_total_mib": mem_total_mib,
+            "mem_used_mib": mem_used_mib,
+            "mem_util_pct": round(100.0 * mem_used_mib / mem_total_mib, 1)
+            if mem_total_mib else None,
         }
+    }

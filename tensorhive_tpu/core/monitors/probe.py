@@ -334,18 +334,25 @@ def _build_sample(doc: Dict[str, Any]) -> ProbeSample:
 
 
 def collect_probe_samples(
-    transports: Any, command: Optional[str] = None
+    transports: Any, command: Optional[str] = None,
+    hostnames: Optional[List[str]] = None,
 ) -> Dict[str, Optional[ProbeSample]]:
     """Fan the probe out to every managed host and parse replies; hosts that
     fail (unreachable or malformed output) map to None — the shared
-    per-host-isolation path of both TpuMonitor and CpuMonitor."""
+    per-host-isolation path of both TpuMonitor and CpuMonitor.
+
+    ``hostnames`` restricts the fan-out (hybrid monitoring: agent-enabled
+    hosts push their own telemetry and must cost ZERO SSH round-trips here,
+    docs/ROBUSTNESS.md "Host membership & leases"); None = every managed
+    host."""
     import logging
 
     log = logging.getLogger(__name__)
     samples: Dict[str, Optional[ProbeSample]] = {}
     started = time.perf_counter()
     with get_tracer().span("probe.collect", kind="probe") as span:
-        for hostname, result in transports.run_on_all(command or probe_command()).items():
+        for hostname, result in transports.run_on_all(
+                command or probe_command(), hostnames=hostnames).items():
             if not result.ok:
                 log.warning("probe failed on %s: %s", hostname,
                             result.stderr.strip() or f"exit {result.exit_code}")

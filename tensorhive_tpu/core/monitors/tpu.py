@@ -14,9 +14,9 @@ from __future__ import annotations
 import logging
 from typing import TYPE_CHECKING, Dict, Optional
 
-from ...config import Config, get_config
+from ...config import Config, HostConfig, get_config
 from ...observability import get_registry
-from ..managers.infrastructure import chip_uid
+from ..managers.infrastructure import LEASE_DEREGISTERED, chip_uid
 from .base import Monitor
 from .probe import ProbeSample, collect_probe_samples, probe_command
 
@@ -47,7 +47,15 @@ class TpuMonitor(Monitor):
         self._restricted_warned: set = set()
 
     def update(self, transports: "TransportManager", infra: "InfrastructureManager") -> None:
-        samples = collect_probe_samples(transports, self._command)
+        # hybrid fan-out (docs/ROBUSTNESS.md "Host membership & leases"):
+        # agent-enabled hosts push their telemetry through POST
+        # /api/agent/report and carry a heartbeat lease — the SSH probe
+        # must issue ZERO round-trips to them. Legacy hosts keep the pull
+        # path unchanged.
+        skip = self.agent_hosts(infra)
+        targets = [h for h in transports.hostnames if h not in skip]
+        samples = collect_probe_samples(transports, self._command,
+                                        hostnames=targets)
         self.last_samples = {h: s for h, s in samples.items() if s is not None}
         for hostname, sample in samples.items():
             if sample is None:
@@ -71,59 +79,81 @@ class TpuMonitor(Monitor):
                                  self._host_warnings(hostname, sample))
 
     # ------------------------------------------------------------------
-    def _host_warnings(self, hostname: str, sample: ProbeSample) -> list:
-        """Per-host health warnings surfaced through /nodes and the
-        dashboard. Blind telemetry must be visible: a TPU host whose sysfs
-        counters are absent reports ANY-workload utilization as idle, which
-        an operator cannot distinguish from a healthy quiet fleet unless
-        it is said out loud (VERDICT r3 weak #7)."""
-        warnings = []
-        if sample.chips and sample.sysfs_status != "ok":
-            warnings.append({
-                "key": "sysfs_absent",
-                "message": (
-                    "no per-chip sysfs counters (/sys/class/accel): "
-                    "utilization of non-cooperating workloads is invisible "
-                    "on this host — check the TPU kernel driver"),
-            })
-        return warnings
+    def agent_hosts(self, infra: "InfrastructureManager") -> set:
+        """Hosts the SSH fan-out must skip: statically configured with
+        ``agent = true`` OR dynamically joined through the report endpoint
+        (lease source ``agent``, not deregistered)."""
+        agents = {name for name, cfg in self.config.hosts.items()
+                  if getattr(cfg, "agent", False)}
+        for name, lease in infra.host_leases().items():
+            if lease["source"] == "agent" and lease["state"] != LEASE_DEREGISTERED:
+                agents.add(name)
+        return agents
 
     # ------------------------------------------------------------------
+    def _host_warnings(self, hostname: str, sample: ProbeSample) -> list:
+        return host_warnings(hostname, sample)
+
     def _chip_subtree(self, hostname: str, sample: ProbeSample) -> Dict[str, Dict]:
-        host_cfg = self.config.hosts.get(hostname)
-        accel_type = host_cfg.accelerator_type if host_cfg else ""
-        slice_name = host_cfg.slice_name if host_cfg else ""
-        topology = (host_cfg.topology if host_cfg else "") or ""
-        chips: Dict[str, Dict] = {}
-        for chip in sample.chips:
-            uid = chip_uid(hostname, chip.index)
-            processes = []
-            for pid in chip.pids:
-                proc = sample.procs.get(pid, {})
-                processes.append({
-                    "pid": pid,
-                    "user": proc.get("user", ""),
-                    "command": proc.get("cmd", ""),
-                })
-            hbm_used = chip.hbm_used_bytes
-            hbm_total = chip.hbm_total_bytes
-            chips[uid] = {
-                "uid": uid,
-                "index": chip.index,
-                "hostname": hostname,
-                "name": f"{accel_type or 'TPU'} chip {chip.index}",
-                "accelerator_type": accel_type,
-                "slice_name": slice_name,
-                "topology": topology,
-                "dev": chip.dev,
-                "hbm_used_mib": _to_mib(hbm_used),
-                "hbm_total_mib": _to_mib(hbm_total),
-                "hbm_util_pct": _pct(hbm_used, hbm_total),
-                "duty_cycle_pct": chip.duty_cycle_pct,
-                "metrics_age_s": chip.metrics_age_s,
-                "processes": processes,
-            }
-        return chips
+        return chip_subtree(hostname, sample, self.config.hosts.get(hostname))
+
+
+def host_warnings(hostname: str, sample: ProbeSample) -> list:
+    """Per-host health warnings surfaced through /nodes and the
+    dashboard. Blind telemetry must be visible: a TPU host whose sysfs
+    counters are absent reports ANY-workload utilization as idle, which
+    an operator cannot distinguish from a healthy quiet fleet unless
+    it is said out loud (VERDICT r3 weak #7). Module-level because the
+    agent-report path (controllers/agent.py) builds the same subtrees."""
+    warnings = []
+    if sample.chips and sample.sysfs_status != "ok":
+        warnings.append({
+            "key": "sysfs_absent",
+            "message": (
+                "no per-chip sysfs counters (/sys/class/accel): "
+                "utilization of non-cooperating workloads is invisible "
+                "on this host — check the TPU kernel driver"),
+        })
+    return warnings
+
+
+def chip_subtree(hostname: str, sample: ProbeSample,
+                 host_cfg: Optional[HostConfig] = None) -> Dict[str, Dict]:
+    """Build the per-host TPU subtree from one parsed probe sample — shared
+    between the SSH pull path (TpuMonitor) and the agent push path."""
+    accel_type = host_cfg.accelerator_type if host_cfg else ""
+    slice_name = host_cfg.slice_name if host_cfg else ""
+    topology = (host_cfg.topology if host_cfg else "") or ""
+    chips: Dict[str, Dict] = {}
+    for chip in sample.chips:
+        uid = chip_uid(hostname, chip.index)
+        processes = []
+        for pid in chip.pids:
+            proc = sample.procs.get(pid, {})
+            processes.append({
+                "pid": pid,
+                "user": proc.get("user", ""),
+                "command": proc.get("cmd", ""),
+            })
+        hbm_used = chip.hbm_used_bytes
+        hbm_total = chip.hbm_total_bytes
+        chips[uid] = {
+            "uid": uid,
+            "index": chip.index,
+            "hostname": hostname,
+            "name": f"{accel_type or 'TPU'} chip {chip.index}",
+            "accelerator_type": accel_type,
+            "slice_name": slice_name,
+            "topology": topology,
+            "dev": chip.dev,
+            "hbm_used_mib": _to_mib(hbm_used),
+            "hbm_total_mib": _to_mib(hbm_total),
+            "hbm_util_pct": _pct(hbm_used, hbm_total),
+            "duty_cycle_pct": chip.duty_cycle_pct,
+            "metrics_age_s": chip.metrics_age_s,
+            "processes": processes,
+        }
+    return chips
 
 
 def _to_mib(value_bytes: Optional[int]) -> Optional[int]:

@@ -21,6 +21,7 @@ from ...db.models.user import User
 from ...observability import get_registry, get_tracer
 from ...utils.exceptions import NotFoundError, TpuHiveError
 from ...utils.timeutils import minutes_between, utcnow
+from ..managers.infrastructure import LEASE_DEREGISTERED, LEASE_UNREACHABLE
 from ..scheduling import GreedyScheduler, Scheduler, expand_to_slice_uids
 from .base import Service
 
@@ -44,6 +45,10 @@ _STOP_ESCALATIONS = get_registry().counter(
 _PREEMPTIONS = get_registry().counter(
     "tpuhive_job_preemptions_total",
     "Queue-launched jobs preempted for a reservation or foreign process.")
+_DISPLACEMENTS = get_registry().counter(
+    "tpuhive_job_displacements_total",
+    "Running jobs stopped because their host is draining or its membership "
+    "lease expired (docs/ROBUSTNESS.md).")
 
 
 def _spawn_job(job: Job, trigger: str) -> bool:
@@ -87,6 +92,7 @@ class JobSchedulingService(Service):
             self.execute_queued(now)
         self.stop_scheduled(now)
         self.sync_running_from_queue(now)
+        self.stop_displaced_jobs(now)
 
     # -- timed starts (reference :134-171) ----------------------------------
     def execute_scheduled(self, now) -> bool:
@@ -157,6 +163,33 @@ class JobSchedulingService(Service):
                 _PREEMPTIONS.inc()
                 self.stop_with_grace(job, now)
 
+    # -- membership displacement (docs/ROBUSTNESS.md "Host membership &
+    # leases") ---------------------------------------------------------------
+    def stop_displaced_jobs(self, now) -> None:
+        """Reap running jobs on hosts that can no longer carry work: admin
+        drain (graceful stop, reservation left intact so resume picks it
+        back up) or an expired/deregistered membership lease (the host was
+        preempted or fell silent — the processes may already be dead, and
+        stop_with_grace swallows the transport errors so a vanished host can
+        never crash the scheduling tick)."""
+        if self.infrastructure_manager is None:
+            return
+        displaced = {
+            hostname for hostname, lease
+            in self.infrastructure_manager.host_leases().items()
+            if lease["draining"]
+            or lease["state"] in (LEASE_UNREACHABLE, LEASE_DEREGISTERED)}
+        if not displaced:
+            return
+        for job in Job.where("_status = ?", [JobStatus.running.value]):
+            job_hosts = {task.hostname for task in job.tasks}
+            if not (job_hosts & displaced):
+                continue
+            log.info("stopping displaced job %d: host(s) %s draining or lease "
+                     "expired", job.id, sorted(job_hosts & displaced))
+            _DISPLACEMENTS.inc()
+            self.stop_with_grace(job, now)
+
     # -- helpers -------------------------------------------------------------
     def _reservation_imminent(self, job: Job, now) -> bool:
         """A reservation by someone else is active or starts within the
@@ -199,7 +232,11 @@ class JobSchedulingService(Service):
         longer implies the host is alive — nodes whose HEALTH state is
         degraded or unreachable are excluded, as are hosts whose transport
         circuit breaker is open (a queued job must never spawn onto a node
-        the control plane cannot even reach)."""
+        the control plane cannot even reach).
+
+        Membership gating (docs/ROBUSTNESS.md "Host membership & leases"):
+        a host whose LEASE is not effectively live — draining, suspect,
+        expired or deregistered — takes no new work either."""
         if self.infrastructure_manager is None:
             return None
         open_circuit = (
@@ -210,6 +247,7 @@ class JobSchedulingService(Service):
             for hostname, node in self.infrastructure_manager.infrastructure.items()
             if "TPU" in node  # absent = never reported
             and node.get("HEALTH", {}).get("state") not in ("degraded", "unreachable")
+            and node.get("LEASE", {}).get("effective", "live") == "live"
             and hostname not in open_circuit
         }
         by_owner: Dict[int, Set[str]] = {}

@@ -39,10 +39,12 @@ class MonitoringService(Service):
         if monitors is None:
             monitors = default_monitors(config)
         self.monitors = monitors
+        self._agent_config = config.agent
 
     def do_run(self) -> None:
         assert self.infrastructure_manager is not None, "service not injected"
         assert self.transport_manager is not None, "service not injected"
+        self.sweep_leases()
         tracer = get_tracer()
         for monitor in self.monitors:
             monitor_name = type(monitor).__name__
@@ -58,6 +60,23 @@ class MonitoringService(Service):
                     span.status = "error"
             _UPDATE_SECONDS.labels(monitor=monitor_name).observe(
                 time.perf_counter() - started)
+
+    def sweep_leases(self, now: Optional[float] = None) -> None:
+        """Advance the membership lease state machine one step
+        (docs/ROBUSTNESS.md "Host membership & leases"); ``now`` is
+        injectable so fake-clock tests can drive transitions
+        deterministically. No-op while the agent plane is off (no token)."""
+        agent = self._agent_config
+        if not agent.enabled or not agent.token:
+            return
+        assert self.infrastructure_manager is not None
+        transitions = self.infrastructure_manager.sweep_leases(
+            now=now,
+            suspect_after_s=agent.effective_suspect_after_s(),
+            lease_ttl_s=agent.effective_lease_ttl_s(),
+            deregister_after_s=agent.deregister_after_s)
+        for hostname, state in transitions.items():
+            log.warning("host %s membership lease -> %s", hostname, state)
 
 
 def default_monitors(config: Config) -> List[Monitor]:

@@ -47,6 +47,20 @@ class FaultPlan:
     * ``partial_stdout_chars`` — truncate successful stdout (a cut
       connection mid-reply: drives the probe's unparseable-output path).
 
+    Membership-churn events (docs/ROBUSTNESS.md "Host membership &
+    leases") make agent/preemption chaos deterministic too:
+
+    * ``preempt_at`` — on the Nth transport call the host is preempted
+      (:meth:`FakeCluster.preempt_host`: processes killed, host
+      unreachable), exactly once — the mid-job revocation a preemptible
+      TPU VM delivers;
+    * ``agent_silence`` — the next N agent heartbeats are dropped before
+      sending (agent death / network partition as seen by the lease plane);
+    * ``duplicate_reports`` — the next N agent heartbeats are sent twice
+      (at-least-once delivery; the server's seq idempotence must absorb it);
+    * ``clock_skew_s`` — skews the agent's self-reported ``sent_ts``; the
+      server leases on ITS OWN clock, so tests pin that skew is harmless.
+
     Every injected failure increments :attr:`faults_injected`;
     :attr:`calls` counts all calls that consulted the plan (the chaos smoke
     asserts an open breaker stops the counter moving).
@@ -58,6 +72,10 @@ class FaultPlan:
     fail_probability: float = 0.0
     latency_s: float = 0.0
     partial_stdout_chars: Optional[int] = None
+    preempt_at: int = 0
+    agent_silence: int = 0
+    duplicate_reports: int = 0
+    clock_skew_s: float = 0.0
     error: str = "injected fault"
 
     def __post_init__(self) -> None:
@@ -66,6 +84,7 @@ class FaultPlan:
         with self._lock:
             self.calls = 0
             self.faults_injected = 0
+            self._preempted = False
 
     def before_call(self, hostname: str, command: str,
                     timeout: Optional[float]) -> None:
@@ -95,6 +114,32 @@ class FaultPlan:
                 return dataclasses.replace(
                     result, stdout=result.stdout[:self.partial_stdout_chars])
             return result
+
+    def take_preemption(self) -> bool:
+        """True exactly once, when the call counter has reached
+        ``preempt_at`` — the transport layer then preempts the host."""
+        with self._lock:
+            if (self.preempt_at and not self._preempted
+                    and self.calls >= self.preempt_at):
+                self._preempted = True
+                self.faults_injected += 1
+                return True
+            return False
+
+    def agent_event(self) -> str:
+        """Consumed by :class:`~...core.agent.HostAgent` once per heartbeat:
+        ``silence`` (drop the report), ``duplicate`` (send it twice) or
+        ``send`` (normal delivery)."""
+        with self._lock:
+            if self.agent_silence > 0:
+                self.agent_silence -= 1
+                self.faults_injected += 1
+                return "silence"
+            if self.duplicate_reports > 0:
+                self.duplicate_reports -= 1
+                self.faults_injected += 1
+                return "duplicate"
+            return "send"
 
 
 @dataclass
@@ -196,6 +241,24 @@ class FakeCluster:
                     host.chips[chip]["user"] = user
             return proc
 
+    def preempt_host(self, hostname: str) -> None:
+        """Preemptible-capacity revocation: every process dies and the host
+        drops off the network in one step (the cloud reclaiming a VM)."""
+        with self._lock:
+            host = self.host(hostname)
+            for pid, proc in host.processes.items():
+                proc.alive = False
+                for chip in proc.chip_ids:
+                    if chip in host.chips and host.chips[chip].get("pid") == pid:
+                        host.chips[chip]["pid"] = None
+                        host.chips[chip]["user"] = None
+            host.reachable = False
+
+    def restore_host(self, hostname: str) -> None:
+        """Bring a preempted host back (re-provisioned VM re-joining)."""
+        with self._lock:
+            self.host(hostname).reachable = True
+
     def kill_process(self, hostname: str, pid: int) -> None:
         with self._lock:
             host = self.host(hostname)
@@ -260,6 +323,8 @@ class FakeTransport(Transport):
         plan = self.cluster.fault_plans.get(self.hostname)
         if plan is not None:
             plan.before_call(self.hostname, command, timeout)
+            if plan.take_preemption():
+                self.cluster.preempt_host(self.hostname)
         if not fake_host.reachable:
             raise TransportError(f"[{self.hostname}] unreachable (fake)")
         result = self._dispatch(command)

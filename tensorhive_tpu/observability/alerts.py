@@ -421,6 +421,28 @@ def _stale_host_counter(stale_after_s: float) -> Callable[[], Optional[float]]:
     return _stale_host_count
 
 
+def _lease_state_counter(state: str) -> Callable[[], Optional[float]]:
+    """Source callable: hosts whose membership lease is in ``state``
+    (docs/ROBUSTNESS.md "Host membership & leases"). None before a manager
+    exists or while no leases are tracked — an empty inventory has no
+    membership to alert on. Static (SSH-pulled) hosts never leave ``live``,
+    so these only ever count agent-managed hosts."""
+
+    def _lease_state_count() -> Optional[float]:
+        from ..core.managers import manager as manager_module
+
+        manager = manager_module._instance
+        if manager is None:
+            return None
+        leases = manager.infrastructure_manager.host_leases()
+        if not leases:
+            return None
+        return float(sum(1 for lease in leases.values()
+                         if lease["state"] == state))
+
+    return _lease_state_count
+
+
 def _serving_queue_saturation() -> Optional[float]:
     """Admission-queue fill fraction of the serving engine (None while no
     engine is installed — serving disabled is not an alertable state)."""
@@ -626,6 +648,21 @@ def default_rule_pack(monitoring_interval_s: Optional[float] = None,
             description="a managed host's last-known-good telemetry "
                         "snapshot is older than 3x the monitoring interval "
                         "— its infra data is being served stale"),
+        AlertRule(
+            name="host_lease_suspect", severity="warning",
+            kind="threshold", op=">", threshold=0.0, for_s=0.0,
+            source=_lease_state_counter("suspect"),
+            description="an agent-managed host missed heartbeats past the "
+                        "suspect window — its membership lease is degrading "
+                        "(docs/ROBUSTNESS.md 'Host membership & leases')"),
+        AlertRule(
+            name="host_lease_expired", severity="critical",
+            kind="threshold", op=">", threshold=0.0, for_s=0.0,
+            source=_lease_state_counter("unreachable"),
+            description="an agent-managed host's membership lease expired — "
+                        "no heartbeat within the TTL; the host takes no new "
+                        "work and its running jobs are being reaped "
+                        "(docs/ROBUSTNESS.md 'Host membership & leases')"),
         AlertRule(
             name="job_spawn_failures", severity="warning",
             kind="increase", metric="tpuhive_job_spawn_failures_total",

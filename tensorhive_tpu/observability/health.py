@@ -12,7 +12,9 @@ Two distinct questions, two endpoints (controllers/observability.py):
   query, every registered daemon service is alive AND has ticked within 3x
   its interval (a wedged tick is as dead as a dead thread — it just hasn't
   admitted it yet), and the telemetry probe round is fresh when hosts are
-  managed. Any failing component flips the endpoint to 503.
+  managed, and every membership lease is live (a silent agent host must
+  not receive routed work). Any failing component flips the endpoint to
+  503.
 
 Everything takes an explicit ``now`` and manager so tests drive it on a
 fake clock with stub services; the controllers call the zero-argument form.
@@ -120,6 +122,32 @@ def check_serving() -> Optional[Dict]:
     return _component("serving", False, f"engine unavailable: {reason}")
 
 
+def check_membership(infrastructure_manager) -> Optional[Dict]:
+    """Host membership leases (docs/ROBUSTNESS.md "Host membership &
+    leases"): a suspect or expired lease means part of the fleet has gone
+    silent — work routed here would be scheduled against hosts whose agents
+    stopped heartbeating. Deregistered tombstones and admin drains do NOT
+    flip readiness (both are resolved/intentional states), but draining
+    hosts are named in the reason so the probe surface shows them. Returns
+    None — component omitted — when no hosts are tracked at all."""
+    leases = infrastructure_manager.host_leases()
+    if not leases:
+        return None
+    silent = sorted(host for host, lease in leases.items()
+                    if lease["state"] in ("suspect", "unreachable"))
+    draining = sorted(host for host, lease in leases.items()
+                      if lease["draining"] and lease["state"] == "live")
+    if silent:
+        reason = f"lease suspect/expired for: {', '.join(silent)}"
+        if draining:
+            reason += f"; draining: {', '.join(draining)}"
+        return _component("membership", False, reason)
+    if draining:
+        return _component("membership", True,
+                          f"draining: {', '.join(draining)}")
+    return _component("membership", True)
+
+
 def check_probe_freshness(now: float, interval_s: float) -> Dict:
     """Telemetry freshness off the registry gauge the probe layer stamps
     after every round — no scrape round-trip, same truth Prometheus sees."""
@@ -169,6 +197,11 @@ def readiness(manager=None, now: Optional[float] = None,
     if (manager is not None and getattr(manager.config, "hosts", None)
             and getattr(manager, "transport_manager", None) is not None):
         components.append(check_transport_breakers(manager.transport_manager))
+    if (manager is not None
+            and getattr(manager, "infrastructure_manager", None) is not None):
+        membership = check_membership(manager.infrastructure_manager)
+        if membership is not None:
+            components.append(membership)
     serving_component = check_serving()
     if serving_component is not None:
         components.append(serving_component)

@@ -468,6 +468,7 @@ def test_openapi_document_has_typed_schemas_everywhere(api):
         "/jobs/{job_id}/execute", "/jobs/{job_id}/enqueue", "/jobs/{job_id}/dequeue",
         "/tasks/{task_id}/spawn", "/user/logout", "/user/logout/refresh",
         "/admin/generate/drain", "/admin/generate/resume",
+        "/admin/hosts/{hostname}/drain", "/admin/hosts/{hostname}/resume",
         "/user/refresh", "/groups/{group_id}/users/{user_id}",
         "/restrictions/{restriction_id}/users/{user_id}",
         "/restrictions/{restriction_id}/groups/{group_id}",

@@ -1,0 +1,174 @@
+"""Functional coverage for the membership plane API (docs/ROBUSTNESS.md
+"Host membership & leases"): ``POST /api/agent/report`` (agent-token auth,
+dynamic join, telemetry application, idempotence outcomes), the admin
+drain/resume endpoints, and the ``membership`` component of
+``GET /api/readyz``.
+
+Same harness as test_api.py — the real WSGI app, real JWTs for the admin
+matrix — plus real probe documents rendered by the fake cluster so the
+production parser sits on the tested path.
+"""
+import json
+
+import pytest
+from werkzeug.test import Client
+
+from tensorhive_tpu.api.server import ApiApp
+from tensorhive_tpu.core.managers.manager import TpuHiveManager, set_manager
+from tensorhive_tpu.core.transport.fake import FakeCluster
+from tests.fixtures import make_user
+
+TOKEN = "agent-sekrit"
+
+
+@pytest.fixture()
+def cluster():
+    cluster = FakeCluster()
+    cluster.add_host("agent-0", chips=2)
+    return cluster
+
+
+@pytest.fixture()
+def api(db, config):
+    config.api.secret_key = "test-secret"
+    config.agent.token = TOKEN
+    manager = TpuHiveManager(config=config, services=[])
+    set_manager(manager)
+    yield Client(ApiApp(url_prefix="api"))
+    set_manager(None)
+
+
+@pytest.fixture()
+def admin_headers(api, db):
+    make_user(username="admin1", password="SuperSecret42", admin=True)
+    tokens = api.post("/api/user/login", json={
+        "username": "admin1", "password": "SuperSecret42"}).get_json()
+    return {"Authorization": f"Bearer {tokens['accessToken']}"}
+
+
+@pytest.fixture()
+def user_headers(api, db):
+    make_user(username="alice", password="SuperSecret42")
+    tokens = api.post("/api/user/login", json={
+        "username": "alice", "password": "SuperSecret42"}).get_json()
+    return {"Authorization": f"Bearer {tokens['accessToken']}"}
+
+
+def report_body(cluster, hostname="agent-0", incarnation="inc-1", seq=1):
+    return {
+        "v": 1,
+        "hostname": hostname,
+        "incarnation": incarnation,
+        "seq": seq,
+        "sent_ts": 1_000_000.0,
+        "probe": json.loads(cluster.probe_json(hostname)),
+        "host": {"accelerator_type": "v5litepod-8", "chips": 2},
+    }
+
+
+def post_report(api, body, token=TOKEN):
+    return api.post("/api/agent/report", json=body,
+                    headers={"Authorization": f"Bearer {token}"})
+
+
+# -- auth + gating -----------------------------------------------------------
+
+def test_report_404_while_plane_disabled(api, cluster, config):
+    config.agent.token = ""
+    response = post_report(api, report_body(cluster))
+    assert response.status_code == 404
+    assert "[agent]" in response.get_data(as_text=True)
+
+
+def test_report_401_on_bad_token(api, cluster):
+    assert post_report(api, report_body(cluster), token="wrong").status_code == 401
+    # and without any Authorization header at all
+    assert api.post("/api/agent/report",
+                    json=report_body(cluster)).status_code == 401
+
+
+def test_report_422_on_bad_wire_version(api, cluster):
+    body = report_body(cluster)
+    body["v"] = 99
+    assert post_report(api, body).status_code == 422
+
+
+def test_report_422_on_unparseable_probe(api, cluster):
+    body = report_body(cluster)
+    body["probe"] = {"not": "a probe document"}
+    assert post_report(api, body).status_code == 422
+
+
+# -- the accepted path -------------------------------------------------------
+
+def test_accepted_report_joins_host_and_applies_telemetry(
+        api, cluster, admin_headers):
+    response = post_report(api, report_body(cluster))
+    assert response.status_code == 200
+    doc = response.get_json()
+    assert doc["outcome"] == "accepted"
+    assert doc["lease"]["state"] == "live" and doc["lease"]["source"] == "agent"
+
+    # dynamic join: the host is now managed and carries pushed telemetry
+    hostnames = api.get("/api/nodes/hostnames", headers=admin_headers).get_json()
+    assert "agent-0" in hostnames
+    node = api.get("/api/nodes/agent-0/metrics", headers=admin_headers).get_json()
+    assert len(node["TPU"]) == 2
+    assert node["LEASE"]["state"] == "live"
+    assert any(key.startswith("CPU_") for key in node["CPU"])
+
+
+def test_report_idempotence_outcomes(api, cluster):
+    assert post_report(api, report_body(cluster, seq=5)).get_json()["outcome"] == "accepted"
+    assert post_report(api, report_body(cluster, seq=5)).get_json()["outcome"] == "duplicate"
+    assert post_report(api, report_body(cluster, seq=3)).get_json()["outcome"] == "out_of_order"
+    assert post_report(api, report_body(cluster, seq=6)).get_json()["outcome"] == "accepted"
+    # fresh incarnation resets the sequence space
+    body = report_body(cluster, incarnation="inc-2", seq=1)
+    assert post_report(api, body).get_json()["outcome"] == "accepted"
+
+
+# -- admin drain/resume ------------------------------------------------------
+
+def test_drain_requires_admin(api, cluster, user_headers):
+    post_report(api, report_body(cluster))
+    assert api.post("/api/admin/hosts/agent-0/drain",
+                    headers=user_headers).status_code == 403
+
+
+def test_drain_unknown_host_404(api, admin_headers):
+    assert api.post("/api/admin/hosts/ghost/drain",
+                    headers=admin_headers).status_code == 404
+
+
+def test_drain_resume_cycle(api, cluster, admin_headers):
+    post_report(api, report_body(cluster))
+    drained = api.post("/api/admin/hosts/agent-0/drain", headers=admin_headers)
+    assert drained.status_code == 200
+    assert drained.get_json()["lease"]["effective"] == "draining"
+
+    # readyz stays 200 (drain is intentional) but names the draining host
+    ready = api.get("/api/readyz")
+    assert ready.status_code == 200
+    membership = next(c for c in ready.get_json()["components"]
+                      if c["component"] == "membership")
+    assert membership["ok"] and "agent-0" in membership.get("reason", "")
+
+    resumed = api.post("/api/admin/hosts/agent-0/resume", headers=admin_headers)
+    assert resumed.status_code == 200
+    assert resumed.get_json()["lease"]["effective"] == "live"
+
+
+def test_readyz_503_names_silent_host(api, cluster):
+    post_report(api, report_body(cluster))
+    from tensorhive_tpu.core.managers.manager import get_manager
+
+    infra = get_manager().infrastructure_manager
+    last = infra.host_lease("agent-0")["last_report_ts"]
+    infra.sweep_leases(now=last + 10, suspect_after_s=4, lease_ttl_s=6)
+
+    response = api.get("/api/readyz")
+    assert response.status_code == 503
+    membership = next(c for c in response.get_json()["components"]
+                      if c["component"] == "membership")
+    assert not membership["ok"] and "agent-0" in membership["reason"]

@@ -78,6 +78,15 @@ UI_CALLS = {
         'api("/admin/generate/" + action, { json: {} })',
     ("POST", "/admin/generate/resume"):
         'api("/admin/generate/" + action, { json: {} })',
+    # host membership plane (nodes.js): drain/resume share the per-card
+    # toggle; /agent/report is machine-to-machine (tpuhive-agent posts it),
+    # so its UI surface is the lease badge that explains where the lease
+    # came from rather than a button that issues the call
+    ("POST", "/admin/hosts/<hostname>/drain"):
+        'api("/admin/hosts/" + encodeURIComponent(host) + "/" + action, { json: {} })',
+    ("POST", "/admin/hosts/<hostname>/resume"):
+        'api("/admin/hosts/" + encodeURIComponent(host) + "/" + action, { json: {} })',
+    ("POST", "/agent/report"): "(POST /agent/report)",
     ("GET", "/admin/traces"): 'api("/admin/traces',
     ("GET", "/admin/requests"): 'api("/admin/requests',
     ("POST", "/admin/profile"): 'api("/admin/profile", { json: {} })',
@@ -277,6 +286,22 @@ def test_serving_strip_renders_draining_badge():
     assert '!stats.draining ? ""' in source          # hidden while open
     assert "toggleDrain(${stats.draining})" in source
     assert '"/admin/generate/" + action' in source
+
+
+def test_node_card_renders_lease_badge_and_host_drain():
+    """The per-node lease badge + drain toggle (docs/ROBUSTNESS.md "Host
+    membership & leases") must render from the exact ``LEASE`` view
+    ``GET /nodes/metrics`` exports (``effective``/``draining``/``source``/
+    ``seq``/``age_s``), hide while the lease is plain live, and gate the
+    drain/resume button on the admin role."""
+    source = (STATIC_DIR / "js" / "nodes.js").read_text()
+    assert "node.LEASE || {}" in source
+    assert 'lease.effective === "live") return ""' in source  # hidden when live
+    assert 'lease.source === "agent"' in source
+    assert "lease.age_s" in source
+    assert "toggleHostDrain('${jsArg(host)}', ${!!lease.draining})" in source
+    assert '"/admin/hosts/" + encodeURIComponent(host) + "/" + action' in source
+    assert '!isAdmin() ? ""' in source               # drain button admin-only
 
 
 def test_serving_strip_renders_mesh_badge():

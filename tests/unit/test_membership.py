@@ -1,0 +1,445 @@
+"""Host membership plane tests (docs/ROBUSTNESS.md "Host membership &
+leases").
+
+Everything runs on an injected fake clock: lease transitions
+(``live → suspect → unreachable → deregistered``), sequence idempotence
+across duplicates/replays/re-joins, the admin draining overlay, the
+exactly-once lease alerts, the agent loop itself, and the hybrid
+monitoring guarantee — agent-enabled hosts cost the SSH fan-out ZERO
+round-trips (pinned via FaultPlan call counts).
+"""
+from datetime import timedelta
+from types import SimpleNamespace
+
+import pytest
+
+from tensorhive_tpu.config import HostConfig
+from tensorhive_tpu.core.agent import AGENT_WIRE_VERSION, HostAgent
+from tensorhive_tpu.core.managers import manager as manager_module
+from tensorhive_tpu.core.managers.infrastructure import (
+    LEASE_DEREGISTERED,
+    LEASE_LIVE,
+    LEASE_SUSPECT,
+    LEASE_UNREACHABLE,
+    InfrastructureManager,
+    chip_uid,
+)
+from tensorhive_tpu.core.monitors.tpu import TpuMonitor
+from tensorhive_tpu.core.nursery import set_ops_factory
+from tensorhive_tpu.core.services.job_scheduling import JobSchedulingService
+from tensorhive_tpu.core.services.monitoring import MonitoringService
+from tensorhive_tpu.core.transport.base import TransportManager, register_backend
+from tensorhive_tpu.core.transport.fake import (
+    FakeCluster,
+    FakeOpsFactory,
+    FakeTransport,
+    FaultPlan,
+)
+from tensorhive_tpu.db.models.job import Job, JobStatus
+from tensorhive_tpu.observability.alerts import AlertEngine, default_rule_pack
+from tensorhive_tpu.observability.metrics import MetricsRegistry
+from tensorhive_tpu.utils.timeutils import utcnow
+from tests.fixtures import make_job, make_permissive_restriction, make_task, make_user
+
+T0 = 1_000_000.0
+
+
+# -- lease state machine -----------------------------------------------------
+
+def test_lease_lifecycle_on_fake_clock():
+    infra = InfrastructureManager(["static-0"])
+    assert infra.agent_report("agent-0", "inc-a", 1, now=T0) == "accepted"
+    assert infra.host_lease("agent-0", now=T0)["state"] == LEASE_LIVE
+    infra.update_subtree("agent-0", "TPU", {"u": {"processes": []}})
+
+    # suspect after the suspect window, health mirrors to degraded
+    assert infra.sweep_leases(now=T0 + 5, suspect_after_s=4, lease_ttl_s=6) \
+        == {"agent-0": LEASE_SUSPECT}
+    assert infra.host_health()["agent-0"]["state"] == "degraded"
+
+    # expired after the TTL: unreachable, last-known-good telemetry retained
+    assert infra.sweep_leases(now=T0 + 7, suspect_after_s=4, lease_ttl_s=6) \
+        == {"agent-0": LEASE_UNREACHABLE}
+    assert infra.host_health()["agent-0"]["state"] == "unreachable"
+    assert "TPU" in infra.infrastructure["agent-0"]
+
+    # deregistered after the long window: gone from snapshots, tombstone kept
+    assert infra.sweep_leases(now=T0 + 1000, deregister_after_s=900) \
+        == {"agent-0": LEASE_DEREGISTERED}
+    assert "agent-0" not in infra.infrastructure
+    assert infra.host_leases(now=T0 + 1000)["agent-0"]["state"] == LEASE_DEREGISTERED
+
+    # static hosts are never swept
+    assert infra.host_lease("static-0")["state"] == LEASE_LIVE
+
+
+def test_heartbeat_recovers_suspect_lease_without_sweep_flap():
+    infra = InfrastructureManager([])
+    infra.agent_report("h", "inc", 1, now=T0)
+    infra.sweep_leases(now=T0 + 5, suspect_after_s=4, lease_ttl_s=6)
+    assert infra.host_lease("h")["state"] == LEASE_SUSPECT
+    # the next heartbeat restores live immediately
+    assert infra.agent_report("h", "inc", 2, now=T0 + 5.5) == "accepted"
+    assert infra.host_lease("h")["state"] == LEASE_LIVE
+    assert infra.sweep_leases(now=T0 + 6, suspect_after_s=4, lease_ttl_s=6) == {}
+
+
+def test_sequence_idempotence():
+    infra = InfrastructureManager([])
+    assert infra.agent_report("h", "inc", 3, now=T0) == "accepted"
+    # at-least-once delivery: a duplicate refreshes the lease clock...
+    assert infra.agent_report("h", "inc", 3, now=T0 + 5) == "duplicate"
+    assert infra.sweep_leases(now=T0 + 8, suspect_after_s=4, lease_ttl_s=6) == {}
+    # ...but an older seq changes nothing
+    assert infra.agent_report("h", "inc", 1, now=T0 + 6) == "out_of_order"
+    assert infra.host_lease("h")["seq"] == 3
+    assert infra.agent_report("h", "inc", 4, now=T0 + 7) == "accepted"
+
+
+def test_new_incarnation_resets_sequence_space():
+    infra = InfrastructureManager([])
+    infra.agent_report("h", "inc-old", 99, now=T0)
+    # agent restarted: seq restarts low under a fresh incarnation — accepted,
+    # not out_of_order
+    assert infra.agent_report("h", "inc-new", 1, now=T0 + 1) == "accepted"
+    lease = infra.host_lease("h")
+    assert lease["incarnation"] == "inc-new" and lease["seq"] == 1
+
+
+def test_rejoin_after_deregistration_is_clean():
+    infra = InfrastructureManager([])
+    infra.agent_report("h", "inc-old", 50, now=T0)
+    infra.sweep_leases(now=T0 + 1000, deregister_after_s=900)
+    assert infra.host_lease("h")["state"] == LEASE_DEREGISTERED
+    # re-join with a fresh incarnation: live again, zero stale-seq carryover
+    assert infra.agent_report("h", "inc-new", 1, now=T0 + 1001) == "accepted"
+    lease = infra.host_lease("h", now=T0 + 1001)
+    assert lease["state"] == LEASE_LIVE and lease["seq"] == 1
+    assert "h" in infra.infrastructure
+    assert infra.host_health()["h"]["state"] == "ok"
+
+
+def test_drain_overlay_and_resume():
+    infra = InfrastructureManager(["vm-0"])
+    infra.update_subtree("vm-0", "TPU", {
+        chip_uid("vm-0", 0): {"index": 0, "processes": [{"pid": 1}]}})
+    assert "vm-0" in infra.all_nodes_with_tpu_processes()
+
+    lease = infra.drain_host("vm-0")
+    assert lease["draining"] and lease["effective"] == "draining"
+    assert lease["state"] == LEASE_LIVE  # drain is an overlay, not a state
+    # protection skips draining hosts (its jobs are being stopped anyway)
+    assert "vm-0" not in infra.all_nodes_with_tpu_processes()
+
+    lease = infra.resume_host("vm-0")
+    assert not lease["draining"] and lease["effective"] == "live"
+    assert "vm-0" in infra.all_nodes_with_tpu_processes()
+
+    with pytest.raises(KeyError):
+        infra.drain_host("ghost")
+
+
+def test_drain_survives_agent_lease_creation():
+    infra = InfrastructureManager(["vm-0"])
+    infra.drain_host("vm-0")
+    # first agent report converts the static lease to an agent lease; the
+    # admin's drain intent must not be silently dropped by the conversion
+    infra.agent_report("vm-0", "inc", 1, now=T0)
+    assert infra.host_lease("vm-0")["draining"]
+
+
+def test_lease_gauge_tracks_states():
+    from tensorhive_tpu.observability import get_registry
+
+    infra = InfrastructureManager([])
+    infra.agent_report("gauge-host", "inc", 1, now=T0)
+    family = get_registry().get("tpuhive_host_lease_state")
+    values = {labels[0]: child.value for labels, child in family.children()}
+    assert values["gauge-host"] == 0  # live
+    infra.sweep_leases(now=T0 + 7, suspect_after_s=4, lease_ttl_s=6)
+    values = {labels[0]: child.value for labels, child in family.children()}
+    assert values["gauge-host"] == 2  # unreachable
+
+
+# -- lease alerts (exactly-once fire/resolve) --------------------------------
+
+def lease_rules():
+    return [rule for rule in default_rule_pack(monitoring_interval_s=2.0)
+            if rule.name in ("host_lease_suspect", "host_lease_expired")]
+
+
+def test_lease_expiry_alert_fires_exactly_once_and_resolves(monkeypatch):
+    infra = InfrastructureManager([])
+    monkeypatch.setattr(manager_module, "_instance",
+                        SimpleNamespace(infrastructure_manager=infra))
+    engine = AlertEngine(lease_rules(), registry=MetricsRegistry())
+
+    infra.agent_report("h", "inc", 1, now=T0)
+    assert engine.evaluate(now=T0 + 1) == []            # live: quiet
+
+    infra.sweep_leases(now=T0 + 5, suspect_after_s=4, lease_ttl_s=6)
+    events = engine.evaluate(now=T0 + 5)
+    assert [(e["rule"], e["to"]) for e in events] == [("host_lease_suspect", "firing")]
+
+    infra.sweep_leases(now=T0 + 7, suspect_after_s=4, lease_ttl_s=6)
+    events = engine.evaluate(now=T0 + 7)
+    # suspect resolved (the host moved past it), expired fires — once
+    assert sorted((e["rule"], e["to"]) for e in events) == [
+        ("host_lease_expired", "firing"), ("host_lease_suspect", "resolved")]
+    # repeated evaluation while still expired: NO duplicate notifications
+    assert engine.evaluate(now=T0 + 8) == []
+    assert engine.evaluate(now=T0 + 9) == []
+
+    # the host re-joins: exactly one resolved event
+    infra.agent_report("h", "inc-2", 1, now=T0 + 10)
+    events = engine.evaluate(now=T0 + 10)
+    assert [(e["rule"], e["to"]) for e in events] == [("host_lease_expired", "resolved")]
+    assert engine.evaluate(now=T0 + 11) == []
+
+    dump = {r["name"]: r for r in engine.dump()["rules"]}
+    assert dump["host_lease_expired"]["firedCount"] == 1
+    assert dump["host_lease_suspect"]["firedCount"] == 1
+
+
+def test_lease_rules_quiet_without_manager_or_leases(monkeypatch):
+    monkeypatch.setattr(manager_module, "_instance", None)
+    engine = AlertEngine(lease_rules(), registry=MetricsRegistry())
+    assert engine.evaluate(now=T0) == []
+    monkeypatch.setattr(
+        manager_module, "_instance",
+        SimpleNamespace(infrastructure_manager=InfrastructureManager([])))
+    assert engine.evaluate(now=T0 + 1) == []
+
+
+# -- the agent loop ----------------------------------------------------------
+
+def make_agent(posts, fault_plan=None, **kwargs):
+    def post(url, payload, token, timeout_s):
+        import json
+
+        posts.append((url, json.loads(payload), token))
+        return 200, {"outcome": "accepted", "lease": {}}
+
+    kwargs.setdefault("collect", lambda: {"schema": 1, "chips": []})
+    kwargs.setdefault("clock", lambda: T0)
+    return HostAgent("vm-a", "http://ctl/api", "sekrit", post=post,
+                     fault_plan=fault_plan, incarnation="inc-1", **kwargs)
+
+
+def test_agent_sends_monotonic_sequenced_reports():
+    posts = []
+    agent = make_agent(posts)
+    agent.run(max_reports=3, sleep=lambda s: None)
+    assert [p[1]["seq"] for p in posts] == [1, 2, 3]
+    report = posts[0][1]
+    assert report["v"] == AGENT_WIRE_VERSION
+    assert report["hostname"] == "vm-a"
+    assert report["incarnation"] == "inc-1"
+    assert posts[0][0] == "http://ctl/api/agent/report"
+    assert posts[0][2] == "sekrit"
+
+
+def test_agent_fault_plan_silence_and_duplicates():
+    posts = []
+    plan = FaultPlan(agent_silence=1, duplicate_reports=1)
+    agent = make_agent(posts, fault_plan=plan)
+    agent.run(max_reports=3, sleep=lambda s: None)
+    # report 1 silenced (no seq burned), report 2 sent twice (same
+    # payload — the at-least-once case), report 3 normal
+    assert [p[1]["seq"] for p in posts] == [1, 1, 2]
+    assert agent.reports_suppressed == 1
+    assert posts[0][1] == posts[1][1]
+
+
+def test_agent_clock_skew_only_shifts_sent_ts():
+    posts = []
+    plan = FaultPlan(clock_skew_s=3600.0)
+    agent = make_agent(posts, fault_plan=plan)
+    agent.report_once()
+    assert posts[0][1]["sent_ts"] == T0 + 3600.0
+    # the server leases on ITS clock: a skewed stamp cannot expire the lease
+    infra = InfrastructureManager([])
+    infra.agent_report("vm-a", "inc-1", posts[0][1]["seq"], now=T0)
+    assert infra.sweep_leases(now=T0 + 1, suspect_after_s=4, lease_ttl_s=6) == {}
+
+
+def test_agent_keeps_heartbeating_through_post_errors():
+    import urllib.error
+
+    calls = {"n": 0}
+
+    def flaky_post(url, payload, token, timeout_s):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise urllib.error.URLError("connection refused")
+        return 200, {"outcome": "accepted", "lease": {}}
+
+    agent = HostAgent("vm-a", "http://ctl/api", "t", post=flaky_post,
+                      collect=lambda: {"schema": 1, "chips": []},
+                      clock=lambda: T0)
+    assert agent.report_once() is None          # swallowed, not raised
+    assert agent.report_once() == (200, {"outcome": "accepted", "lease": {}})
+    assert agent.reports_sent == 1
+
+
+# -- hybrid monitoring: zero SSH round-trips to agent hosts ------------------
+
+@pytest.fixture()
+def hybrid_cluster(config):
+    cluster = FakeCluster()
+    register_backend(
+        "fake", lambda host, user=None, config=None: FakeTransport(host, cluster, user))
+    config.hosts["legacy-0"] = HostConfig(
+        name="legacy-0", user="hive", backend="fake",
+        accelerator_type="v5litepod-8", chips=4)
+    config.hosts["agent-0"] = HostConfig(
+        name="agent-0", user="hive", backend="fake",
+        accelerator_type="v5litepod-8", chips=4, agent=True)
+    cluster.add_host("legacy-0", chips=4)
+    cluster.add_host("agent-0", chips=4)
+    return cluster
+
+
+def test_agent_hosts_cost_zero_ssh_round_trips(hybrid_cluster, config):
+    config.ssh.breaker_cooldown_s = 0.0
+    transports = TransportManager(config)
+    try:
+        plans = {name: hybrid_cluster.set_fault_plan(name, FaultPlan())
+                 for name in ("legacy-0", "agent-0")}
+        infra = InfrastructureManager(list(config.hosts))
+        monitor = TpuMonitor(config)
+        for _ in range(3):
+            monitor.update(transports, infra)
+        # the legacy host is pulled every round; the agent host NEVER
+        assert plans["legacy-0"].calls == 3
+        assert plans["agent-0"].calls == 0
+        assert "TPU" in infra.infrastructure["legacy-0"]
+        # no probe round ran against agent-0, so no failure was recorded
+        assert infra.host_health()["agent-0"]["consecutive_failures"] == 0
+    finally:
+        transports.close()
+
+
+def test_dynamically_joined_host_skipped_by_fanout(hybrid_cluster, config):
+    config.ssh.breaker_cooldown_s = 0.0
+    del config.hosts["agent-0"]  # not configured: joins via report only
+    transports = TransportManager(config)
+    try:
+        infra = InfrastructureManager(list(config.hosts))
+        # a dynamic join registers the host with the transport layer but
+        # its lease source is "agent" — the fan-out must still skip it
+        transports.add_host(HostConfig(
+            name="agent-0", user="hive", backend="fake", agent=True))
+        infra.agent_report("agent-0", "inc", 1, now=T0)
+        plan = hybrid_cluster.set_fault_plan("agent-0", FaultPlan())
+        TpuMonitor(config).update(transports, infra)
+        assert plan.calls == 0
+    finally:
+        transports.close()
+
+
+def test_monitoring_service_sweeps_leases_each_tick(hybrid_cluster, config):
+    config.ssh.breaker_cooldown_s = 0.0
+    config.agent.token = "sekrit"
+    transports = TransportManager(config)
+    try:
+        infra = InfrastructureManager(list(config.hosts))
+        service = MonitoringService(config=config)
+        service.inject(infra, transports)
+        infra.agent_report("agent-0", "inc", 1, now=T0)
+        # default windows: suspect at 2x interval (4s), expired at 3x (6s)
+        service.sweep_leases(now=T0 + 5)
+        assert infra.host_lease("agent-0")["state"] == LEASE_SUSPECT
+        service.sweep_leases(now=T0 + 7)
+        assert infra.host_lease("agent-0")["state"] == LEASE_UNREACHABLE
+    finally:
+        transports.close()
+
+
+def test_sweep_is_noop_while_plane_disabled(hybrid_cluster, config):
+    config.agent.token = ""  # plane off
+    transports = TransportManager(config)
+    try:
+        infra = InfrastructureManager(list(config.hosts))
+        service = MonitoringService(config=config)
+        service.inject(infra, transports)
+        infra.agent_report("agent-0", "inc", 1, now=T0)
+        service.sweep_leases(now=T0 + 100)
+        assert infra.host_lease("agent-0")["state"] == LEASE_LIVE
+    finally:
+        transports.close()
+
+
+# -- scheduler integration: drain + displacement -----------------------------
+
+@pytest.fixture()
+def sched_cluster(db, config):
+    cluster = FakeCluster()
+    cluster.add_host("vm-0", chips=4)
+    set_ops_factory(FakeOpsFactory(cluster))
+    yield cluster
+    set_ops_factory(None)
+
+
+@pytest.fixture()
+def sched_infra(sched_cluster):
+    manager = InfrastructureManager(["vm-0"])
+    manager.update_subtree("vm-0", "TPU", {
+        chip_uid("vm-0", i): {"index": i, "processes": []} for i in range(4)})
+    return manager
+
+
+@pytest.fixture()
+def sched_service(config, sched_infra):
+    config.job_scheduling.interval_s = 0.01
+    config.job_scheduling.stop_attempts_after_mins = 5.0
+    service = JobSchedulingService(config=config)
+    service.inject(sched_infra, None)
+    return service
+
+
+@pytest.fixture()
+def owner(db):
+    user = make_user(username="alice", password="SuperSecret42")
+    make_permissive_restriction(user)
+    return user
+
+
+def test_draining_host_takes_no_new_work(sched_service, sched_infra, owner, db):
+    sched_infra.drain_host("vm-0")
+    job = make_job(owner)
+    make_task(job, hostname="vm-0", chips=None)
+    job.enqueue()
+    sched_service.do_run()
+    assert Job.get(job.id).status is JobStatus.pending
+    # resume: the very next tick launches it
+    sched_infra.resume_host("vm-0")
+    sched_service.do_run()
+    assert Job.get(job.id).status is JobStatus.running
+
+
+def test_drain_stops_running_job_gracefully(sched_service, sched_infra, owner, db):
+    job = make_job(owner, start_at=utcnow() - timedelta(minutes=1))
+    make_task(job, hostname="vm-0", chips=None)
+    sched_service.do_run()
+    assert Job.get(job.id).status is JobStatus.running
+
+    sched_infra.drain_host("vm-0")
+    sched_service.do_run()
+    assert Job.get(job.id).status is not JobStatus.running
+
+
+def test_expired_lease_reaps_job_without_crashing_tick(
+        sched_service, sched_infra, sched_cluster, owner, db):
+    job = make_job(owner, start_at=utcnow() - timedelta(minutes=1))
+    make_task(job, hostname="vm-0", chips=None)
+    sched_service.do_run()
+    assert Job.get(job.id).status is JobStatus.running
+
+    # vm-0 flips to the agent plane, then falls silent past the TTL — the
+    # host may be preempted (processes already gone); the reap must not
+    # crash the tick even if the stop path cannot reach the host
+    sched_infra.agent_report("vm-0", "inc", 1, now=T0)
+    sched_infra.sweep_leases(now=T0 + 10, suspect_after_s=4, lease_ttl_s=6)
+    sched_service.do_run()                       # must not raise
+    assert Job.get(job.id).status is not JobStatus.running

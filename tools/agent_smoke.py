@@ -1,0 +1,281 @@
+"""Smoke the host membership plane end to end (``make agent-smoke``).
+
+A real :class:`HostAgent` posts over a real socket to the real WSGI app;
+everything time-dependent runs on explicit timestamps (lease sweeps and
+alert evaluation take ``now=``), so the walk is deterministic and takes
+milliseconds. The walk (docs/ROBUSTNESS.md "Host membership & leases"):
+
+1. an agent on an UNCONFIGURED host reports in → dynamic join, lease live,
+   pushed telemetry visible, and the SSH fan-out issues zero round-trips to
+   it (the legacy host keeps being pulled);
+2. a queued job spawns onto the agent host while it is live;
+3. the host is preempted mid-job and the agent falls silent → the lease
+   walks suspect → unreachable within 3x the heartbeat interval,
+   ``host_lease_expired`` fires exactly once, readiness 503s naming the
+   host, new work refuses to land there, and the running job is reaped
+   without crashing the scheduling tick;
+4. the agent restarts (new incarnation) and re-joins → live again, the
+   alert resolves exactly once, queued work flows, and zero stale-sequence
+   reports were ever counted.
+
+Exit 0 = healthy.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import urllib.error
+import urllib.request
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+os.environ.setdefault("TPUHIVE_PYTEST", "1")          # DB goes in-memory
+
+TOKEN = "smoke-agent-token"
+PROBLEMS = []
+
+
+def check(ok: bool, what: str) -> None:
+    status = "ok" if ok else "FAIL"
+    print(f"agent-smoke: {status}: {what}")
+    if not ok:
+        PROBLEMS.append(what)
+
+
+def fetch(url: str):
+    """(status, body) — urllib raises on >=400, readiness 503 is a result."""
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as exc:
+        return exc.code, exc.read().decode()
+
+
+def main() -> int:
+    from tensorhive_tpu.config import Config, HostConfig, set_config
+
+    config = Config(config_dir=Path(tempfile.mkdtemp(prefix="tpuhive-agent-")))
+    config.agent.token = TOKEN                         # heartbeat 2s → suspect 4s, ttl 6s
+    config.hosts["legacy-0"] = HostConfig(
+        name="legacy-0", user="hive", backend="fake",
+        accelerator_type="v5litepod-8", chips=4)
+    set_config(config)
+
+    from tensorhive_tpu.db.engine import Engine, set_engine
+    from tensorhive_tpu.db.migrations import ensure_schema
+
+    engine = Engine(":memory:")
+    ensure_schema(engine)
+    set_engine(engine)
+
+    from tensorhive_tpu.core.agent import HostAgent
+    from tensorhive_tpu.core.managers.manager import TpuHiveManager, set_manager
+    from tensorhive_tpu.core.monitors.tpu import TpuMonitor
+    from tensorhive_tpu.core.nursery import set_ops_factory
+    from tensorhive_tpu.core.services.job_scheduling import JobSchedulingService
+    from tensorhive_tpu.core.services.monitoring import MonitoringService
+    from tensorhive_tpu.core.transport.base import (
+        TransportManager,
+        register_backend,
+        set_transport_manager,
+    )
+    from tensorhive_tpu.core.transport.fake import (
+        FakeCluster,
+        FakeOpsFactory,
+        FakeTransport,
+        FaultPlan,
+    )
+    from tensorhive_tpu.db.models.job import Job, JobStatus
+    from tensorhive_tpu.db.models.restriction import Restriction
+    from tensorhive_tpu.db.models.task import Task
+    from tensorhive_tpu.db.models.user import User
+    from tensorhive_tpu.observability import get_registry
+    from tensorhive_tpu.observability.alerts import AlertEngine, default_rule_pack
+    from tensorhive_tpu.utils.timeutils import utcnow
+
+    cluster = FakeCluster()
+    register_backend("fake", lambda host, user=None, config=None: FakeTransport(
+        host, cluster, user))
+    cluster.add_host("legacy-0", chips=4)
+    cluster.add_host("agent-0", chips=4)               # real machine, NOT in config
+    set_ops_factory(FakeOpsFactory(cluster))
+
+    transports = TransportManager(config)
+    set_transport_manager(transports)
+    manager = TpuHiveManager(config=config, transport_manager=transports,
+                             services=[])
+    set_manager(manager)
+    infra = manager.infrastructure_manager
+    monitor = TpuMonitor(config)
+    monitoring = MonitoringService(config=config)
+    monitoring.inject(infra, transports)
+    scheduler = JobSchedulingService(config=config)
+    scheduler.inject(infra, transports)
+
+    engine_rules = AlertEngine(default_rule_pack(monitoring_interval_s=2.0))
+    notifications = []
+
+    def evaluate(now):
+        notifications.extend(engine_rules.evaluate(now=now))
+
+    def lease_events(rule, to):
+        return [e for e in notifications if e["rule"] == rule and e["to"] == to]
+
+    def report_count(outcome):
+        family = get_registry().get("tpuhive_agent_reports_total")
+        return family.labels(host="agent-0", outcome=outcome).value
+
+    from datetime import timedelta
+
+    Restriction(name="permissive", starts_at=utcnow() - timedelta(days=1),
+                is_global=True).save()
+    owner = User(username="alice", email="alice@example.com",
+                 password="SuperSecret42").save()
+    owner.add_role("user")
+
+    from tensorhive_tpu.api.server import APIServer
+
+    server = APIServer()
+    server.config.api.url_hostname = "127.0.0.1"
+    server.config.api.url_port = 0                     # ephemeral
+    port = server.start()
+    base = f"http://127.0.0.1:{port}/api"
+    alert_now = 10_000.0
+    try:
+        # -- phase 1: dynamic join over the real socket ---------------------
+        agent = HostAgent(
+            "agent-0", base, TOKEN, incarnation="inc-1",
+            host_info={"accelerator_type": "v5litepod-8", "chips": 4},
+            collect=lambda: json.loads(cluster.probe_json("agent-0")))
+        status, doc = agent.report_once()
+        check(status == 200 and doc["outcome"] == "accepted",
+              f"first report accepted over the socket (got {status} {doc})")
+        lease = infra.host_lease("agent-0")
+        check(lease["state"] == "live" and lease["source"] == "agent",
+              "agent-0 holds a live agent lease")
+        check("agent-0" in manager.config.hosts,
+              "unconfigured host joined dynamically")
+        check(len(infra.infrastructure["agent-0"].get("TPU", {})) == 4,
+              "pushed telemetry landed (4 chips)")
+
+        # a duplicated heartbeat (at-least-once delivery) is absorbed
+        dup_agent = HostAgent(
+            "agent-0", base, TOKEN, incarnation="inc-1",
+            fault_plan=FaultPlan(duplicate_reports=1),
+            collect=lambda: json.loads(cluster.probe_json("agent-0")))
+        dup_agent.seq = agent.seq
+        dup_agent.report_once()
+        check(report_count("duplicate") == 1.0,
+              "duplicated report counted once as duplicate, lease unharmed")
+
+        # hybrid fan-out: the legacy host is pulled, the agent host is not
+        legacy_plan = cluster.set_fault_plan("legacy-0", FaultPlan())
+        monitor.update(transports, infra)
+        check(legacy_plan.calls > 0 and "TPU" in infra.infrastructure["legacy-0"],
+              "legacy host still pulled via the transport fan-out")
+        commands = get_registry().get("tpuhive_transport_commands_total")
+        agent_cmds = sum(child.value for labels, child in commands.children()
+                         if labels[0] == "agent-0")
+        check(agent_cmds == 0, "ZERO transport round-trips to the agent host")
+
+        _, scrape = fetch(f"{base}/metrics")
+        check('tpuhive_host_lease_state{host="agent-0"} 0' in scrape,
+              "lease gauge exports live (0)")
+
+        evaluate(alert_now)
+        check(not lease_events("host_lease_expired", "firing"),
+              "no lease alert while live")
+
+        # -- phase 2: a queued job lands on the live agent host -------------
+        job = Job(name="agent-job", user_id=owner.id).save()
+        Task(job_id=job.id, hostname="agent-0", command="python train.py").save()
+        job.enqueue()
+        scheduler.do_run()
+        check(Job.get(job.id).status is JobStatus.running,
+              "queued job spawned onto the live agent host")
+
+        # -- phase 3: preemption mid-job + silence --------------------------
+        cluster.preempt_host("agent-0")                # processes killed
+        t0 = infra.host_lease("agent-0")["last_report_ts"]
+        monitoring.sweep_leases(now=t0 + 4.5)          # past 2x heartbeat
+        check(infra.host_lease("agent-0")["state"] == "suspect",
+              "silent host suspect within 2x heartbeat")
+        evaluate(alert_now + 5)
+        monitoring.sweep_leases(now=t0 + 6.5)          # past 3x heartbeat
+        check(infra.host_lease("agent-0")["state"] == "unreachable",
+              "lease expired within 3x heartbeat")
+        evaluate(alert_now + 10)
+        evaluate(alert_now + 15)                       # re-evaluate: no dupes
+        fired = lease_events("host_lease_expired", "firing")
+        check(len(fired) == 1,
+              f"host_lease_expired fired exactly once (got {len(fired)})")
+
+        status, body = fetch(f"{base}/readyz")
+        doc = json.loads(body)
+        check(status == 503, f"readyz 503 while a lease is expired (got {status})")
+        check(any(c["component"] == "membership" and not c["ok"]
+                  and "agent-0" in c.get("reason", "")
+                  for c in doc.get("components", [])),
+              "readyz names agent-0 in the membership component")
+
+        scheduler.do_run()                             # must not raise
+        check(Job.get(job.id).status is not JobStatus.running,
+              "preempted host's job reaped without a hung tick")
+
+        job2 = Job(name="post-expiry-job", user_id=owner.id).save()
+        Task(job_id=job2.id, hostname="agent-0", command="python eval.py").save()
+        job2.enqueue()
+        scheduler.do_run()
+        check(Job.get(job2.id).status is JobStatus.pending,
+              "no new work lands on the expired host")
+
+        _, scrape = fetch(f"{base}/metrics")
+        check('tpuhive_host_lease_state{host="agent-0"} 2' in scrape,
+              "lease gauge exports unreachable (2)")
+
+        # -- phase 4: the agent restarts and re-joins -----------------------
+        cluster.restore_host("agent-0")
+        rejoined = HostAgent(
+            "agent-0", base, TOKEN, incarnation="inc-2",
+            collect=lambda: json.loads(cluster.probe_json("agent-0")))
+        status, doc = rejoined.report_once()
+        check(status == 200 and doc["outcome"] == "accepted",
+              "re-join report accepted under a fresh incarnation")
+        check(infra.host_lease("agent-0")["state"] == "live",
+              "lease live again after re-join")
+
+        evaluate(alert_now + 20)
+        evaluate(alert_now + 25)
+        resolved = lease_events("host_lease_expired", "resolved")
+        check(len(resolved) == 1,
+              f"host_lease_expired resolved exactly once (got {len(resolved)})")
+
+        status, _ = fetch(f"{base}/readyz")
+        check(status == 200, f"readyz back to 200 after re-join (got {status})")
+
+        scheduler.do_run()
+        check(Job.get(job2.id).status is JobStatus.running,
+              "queued job spawns once the host re-joined")
+        check(report_count("out_of_order") == 0.0,
+              "zero stale-sequence regressions across the whole churn")
+    finally:
+        server.stop()
+        transports.close()
+        set_transport_manager(None)
+        set_manager(None)
+        set_ops_factory(None)
+
+    if PROBLEMS:
+        print(f"agent-smoke: {len(PROBLEMS)} problem(s)", file=sys.stderr)
+        return 1
+    print("agent-smoke: OK — dynamic join went live with zero SSH round-trips, "
+          "silence walked suspect→expired on schedule with exactly-once "
+          "alerting, the preempted host's job was reaped without crashing the "
+          "tick, and re-join restored service cleanly")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
